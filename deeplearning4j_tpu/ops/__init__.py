@@ -1,0 +1,7 @@
+"""ND4J-equivalent tensor layer (reference: nd4j-api, SURVEY.md §2.3)."""
+
+from deeplearning4j_tpu.ops.dtype import DataType, promote, from_np  # noqa: F401
+from deeplearning4j_tpu.ops.ndarray import NDArray, NDArrayIndex  # noqa: F401
+from deeplearning4j_tpu.ops.factory import Nd4j  # noqa: F401
+from deeplearning4j_tpu.ops.random import RandomGenerator, get_random  # noqa: F401
+from deeplearning4j_tpu.ops import serde  # noqa: F401

@@ -1,0 +1,128 @@
+"""Data types and promotion rules.
+
+Reference: nd4j-api ``org/nd4j/linalg/api/buffer/DataType.java`` — the ND4J
+dtype lattice (BOOL < unsigned < signed ints < HALF < BFLOAT16 < FLOAT <
+DOUBLE).  Promotion between two types picks the wider/higher-precedence one,
+matching ND4J semantics rather than NumPy's value-based promotion.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    BOOL = "bool"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    UINT16 = "uint16"
+    INT16 = "int16"
+    UINT32 = "uint32"
+    INT32 = "int32"
+    UINT64 = "uint64"
+    INT64 = "int64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+
+    # ------------------------------------------------------------------
+    @property
+    def jnp(self):
+        return _TO_JNP[self]
+
+    @property
+    def np(self):
+        return np.dtype(_TO_JNP[self])
+
+    def isFPType(self) -> bool:
+        return self in (DataType.HALF, DataType.BFLOAT16, DataType.FLOAT,
+                        DataType.DOUBLE)
+
+    def isIntType(self) -> bool:
+        return self in (DataType.INT8, DataType.INT16, DataType.INT32,
+                        DataType.INT64, DataType.UINT8, DataType.UINT16,
+                        DataType.UINT32, DataType.UINT64)
+
+    def isSigned(self) -> bool:
+        return self.isFPType() or self in (
+            DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64)
+
+    def width(self) -> int:
+        """Bytes per element."""
+        return {DataType.BOOL: 1, DataType.UINT8: 1, DataType.INT8: 1,
+                DataType.UINT16: 2, DataType.INT16: 2, DataType.UINT32: 4,
+                DataType.INT32: 4, DataType.UINT64: 8, DataType.INT64: 8,
+                DataType.HALF: 2, DataType.BFLOAT16: 2, DataType.FLOAT: 4,
+                DataType.DOUBLE: 8}[self]
+
+    # DL4J-style aliases
+    @staticmethod
+    def fromNumpy(dt) -> "DataType":
+        return from_np(dt)
+
+
+_TO_JNP = {
+    DataType.BOOL: jnp.bool_,
+    DataType.UINT8: jnp.uint8,
+    DataType.INT8: jnp.int8,
+    DataType.UINT16: jnp.uint16,
+    DataType.INT16: jnp.int16,
+    DataType.UINT32: jnp.uint32,
+    DataType.INT32: jnp.int32,
+    DataType.UINT64: jnp.uint64,
+    DataType.INT64: jnp.int64,
+    DataType.HALF: jnp.float16,
+    DataType.BFLOAT16: jnp.bfloat16,
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float64,
+}
+
+_FROM_STR = {dt.value: dt for dt in DataType}
+# ND4J promotion precedence (higher wins).
+_RANK = {dt: i for i, dt in enumerate([
+    DataType.BOOL, DataType.UINT8, DataType.INT8, DataType.UINT16,
+    DataType.INT16, DataType.UINT32, DataType.INT32, DataType.UINT64,
+    DataType.INT64, DataType.HALF, DataType.BFLOAT16, DataType.FLOAT,
+    DataType.DOUBLE])}
+
+
+def from_np(dt) -> DataType:
+    """Map a numpy/jax dtype (or string, or DataType) to a DataType."""
+    if isinstance(dt, DataType):
+        return dt
+    name = np.dtype(dt).name if not isinstance(dt, str) else dt
+    name = {"float16": "float16"}.get(name, name)
+    if name == "bfloat16" or "bfloat16" in str(dt):
+        return DataType.BFLOAT16
+    try:
+        return _FROM_STR[name]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype: {dt!r}")
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    """ND4J-style promotion: the higher-precedence type wins.
+
+    Special case: HALF vs BFLOAT16 promotes to FLOAT (no exact common type).
+    """
+    if a is b:
+        return a
+    pair = {a, b}
+    if pair == {DataType.HALF, DataType.BFLOAT16}:
+        return DataType.FLOAT
+    return a if _RANK[a] >= _RANK[b] else b
+
+
+#: Default floating-point type for array creation (``Nd4j.setDefaultDataTypes``).
+_DEFAULT_FLOAT = [DataType.FLOAT]
+
+
+def default_float() -> DataType:
+    return _DEFAULT_FLOAT[0]
+
+
+def set_default_float(dt: DataType) -> None:
+    _DEFAULT_FLOAT[0] = DataType.fromNumpy(dt) if not isinstance(dt, DataType) else dt

@@ -1,0 +1,439 @@
+"""``Nd4j`` — the static factory/op facade.
+
+Reference: nd4j-api ``org/nd4j/linalg/factory/Nd4j.java`` (creation, gemm,
+exec, rng, serde entry points) plus the op library under
+``org/nd4j/linalg/api/ops/impl/**`` and libnd4j declarable ops
+(``include/ops/declarable/generic/**``).
+
+Every method lowers to a single XLA op (or small fusion) via jax.numpy /
+jax.lax — there is no per-op dispatch layer to a native executioner; under
+``jit`` the whole call tree compiles to one executable (SURVEY.md §3.1 north
+star).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.dtype import (DataType, default_float, from_np,
+                                          set_default_float)
+from deeplearning4j_tpu.ops.ndarray import NDArray, NDArrayIndex
+from deeplearning4j_tpu.ops.random import RandomGenerator, get_random
+from deeplearning4j_tpu.ops import serde as _serde
+
+
+def _v(x):
+    return x._value if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _dt(dtype) -> DataType:
+    if dtype is None:
+        return default_float()
+    return dtype if isinstance(dtype, DataType) else from_np(dtype)
+
+
+class Nd4j:
+    """Static tensor factory + op facade (``org.nd4j.linalg.factory.Nd4j``)."""
+
+    # ---------------- creation ----------------
+    @staticmethod
+    def create(data=None, shape=None, dtype=None) -> NDArray:
+        if data is None and shape is not None:
+            return Nd4j.zeros(*shape, dtype=dtype)
+        if shape is not None and data is not None and not np.isscalar(data):
+            a = np.asarray(data).reshape(tuple(shape))
+            return NDArray(jnp.asarray(a, dtype=_dt(dtype or a.dtype).jnp))
+        if isinstance(data, (list, tuple)) and all(isinstance(d, int) for d in data) \
+                and shape is None and dtype is None and len(data) <= 8:
+            # Nd4j.create(2, 3) style shape call is handled by varargs below
+            pass
+        a = np.asarray(data)
+        if dtype is None and a.dtype == np.float64:
+            dtype = default_float()  # ND4J defaults to float unless configured
+        return NDArray(jnp.asarray(a, dtype=_dt(dtype or a.dtype).jnp))
+
+    @staticmethod
+    def zeros(*shape, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.zeros(shape, dtype=_dt(dtype).jnp))
+
+    @staticmethod
+    def ones(*shape, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.ones(shape, dtype=_dt(dtype).jnp))
+
+    @staticmethod
+    def zerosLike(a) -> NDArray:
+        return NDArray(jnp.zeros_like(_v(a)))
+
+    @staticmethod
+    def onesLike(a) -> NDArray:
+        return NDArray(jnp.ones_like(_v(a)))
+
+    @staticmethod
+    def valueArrayOf(shape, value, dtype=None) -> NDArray:
+        if isinstance(shape, int):
+            shape = (shape,)
+        return NDArray(jnp.full(tuple(shape), value, dtype=_dt(dtype).jnp))
+
+    full = valueArrayOf
+
+    @staticmethod
+    def scalar(value, dtype=None) -> NDArray:
+        if dtype is None:
+            if isinstance(value, bool):
+                dtype = DataType.BOOL
+            elif isinstance(value, int):
+                dtype = DataType.INT64
+            else:
+                dtype = default_float()
+        return NDArray(jnp.asarray(value, dtype=_dt(dtype).jnp))
+
+    @staticmethod
+    def arange(*args, dtype=None) -> NDArray:
+        return NDArray(jnp.arange(*args, dtype=_dt(dtype or DataType.FLOAT).jnp))
+
+    @staticmethod
+    def linspace(start, stop, num, dtype=None) -> NDArray:
+        return NDArray(jnp.linspace(start, stop, int(num), dtype=_dt(dtype).jnp))
+
+    @staticmethod
+    def eye(n, dtype=None) -> NDArray:
+        return NDArray(jnp.eye(int(n), dtype=_dt(dtype).jnp))
+
+    @staticmethod
+    def diag(a) -> NDArray:
+        return NDArray(jnp.diag(_v(a)))
+
+    @staticmethod
+    def empty(dtype=None) -> NDArray:
+        return NDArray(jnp.zeros((0,), dtype=_dt(dtype).jnp))
+
+    # ---------------- random ----------------
+    @staticmethod
+    def getRandom() -> RandomGenerator:
+        return get_random()
+
+    @staticmethod
+    def rand(*shape, seed: Optional[int] = None, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        rng = RandomGenerator(seed) if seed is not None else get_random()
+        return NDArray(rng.uniform(shape, dtype=_dt(dtype)))
+
+    @staticmethod
+    def randn(*shape, seed: Optional[int] = None, dtype=None) -> NDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        rng = RandomGenerator(seed) if seed is not None else get_random()
+        return NDArray(rng.normal(shape, dtype=_dt(dtype)))
+
+    @staticmethod
+    def randomBernoulli(p, *shape) -> NDArray:
+        return NDArray(get_random().bernoulli(shape, p).astype(default_float().jnp))
+
+    # ---------------- dtype config ----------------
+    @staticmethod
+    def setDefaultDataTypes(dtype, *_):
+        set_default_float(_dt(dtype))
+
+    @staticmethod
+    def defaultFloatingPointType() -> DataType:
+        return default_float()
+
+    # ---------------- linalg ----------------
+    @staticmethod
+    def gemm(a, b, transposeA: bool = False, transposeB: bool = False,
+             alpha: float = 1.0, beta: float = 0.0, c=None) -> NDArray:
+        av, bv = _v(a), _v(b)
+        if transposeA:
+            av = av.T
+        if transposeB:
+            bv = bv.T
+        r = alpha * jnp.matmul(av, bv)
+        if c is not None and beta != 0.0:
+            r = r + beta * _v(c)
+        out = NDArray(r)
+        if c is not None and isinstance(c, NDArray):
+            c.assign(out)
+            return c
+        return out
+
+    @staticmethod
+    def matmul(a, b) -> NDArray:
+        return NDArray(jnp.matmul(_v(a), _v(b)))
+
+    @staticmethod
+    def tensorMmul(a, b, axes) -> NDArray:
+        return NDArray(jnp.tensordot(_v(a), _v(b), axes=axes))
+
+    @staticmethod
+    def dot(a, b) -> NDArray:
+        return NDArray(jnp.vdot(_v(a), _v(b)))
+
+    # ---------------- shape ops ----------------
+    @staticmethod
+    def concat(dim: int, *arrs) -> NDArray:
+        return NDArray(jnp.concatenate([_v(a) for a in arrs], axis=int(dim)))
+
+    @staticmethod
+    def hstack(*arrs) -> NDArray:
+        return NDArray(jnp.hstack([_v(a) for a in arrs]))
+
+    @staticmethod
+    def vstack(*arrs) -> NDArray:
+        return NDArray(jnp.vstack([_v(a) for a in arrs]))
+
+    @staticmethod
+    def stack(dim: int, *arrs) -> NDArray:
+        return NDArray(jnp.stack([_v(a) for a in arrs], axis=int(dim)))
+
+    @staticmethod
+    def split(a, n: int, dim: int = 0):
+        return [NDArray(x) for x in jnp.split(_v(a), n, axis=int(dim))]
+
+    @staticmethod
+    def tile(a, *reps) -> NDArray:
+        return NDArray(jnp.tile(_v(a), tuple(int(r) for r in reps)))
+
+    @staticmethod
+    def repeat(a, n: int, dim: int = 0) -> NDArray:
+        return NDArray(jnp.repeat(_v(a), int(n), axis=int(dim)))
+
+    @staticmethod
+    def pad(a, pad_width, mode: str = "constant", value=0) -> NDArray:
+        if mode == "constant":
+            return NDArray(jnp.pad(_v(a), pad_width, constant_values=value))
+        return NDArray(jnp.pad(_v(a), pad_width, mode=mode))
+
+    @staticmethod
+    def expandDims(a, dim: int) -> NDArray:
+        return NDArray(jnp.expand_dims(_v(a), int(dim)))
+
+    @staticmethod
+    def squeeze(a, dim: Optional[int] = None) -> NDArray:
+        return NDArray(jnp.squeeze(_v(a), axis=dim))
+
+    @staticmethod
+    def flip(a, *dims) -> NDArray:
+        return NDArray(jnp.flip(_v(a), axis=tuple(int(d) for d in dims) or None))
+
+    @staticmethod
+    def roll(a, shift: int, dim: Optional[int] = None) -> NDArray:
+        return NDArray(jnp.roll(_v(a), shift, axis=dim))
+
+    @staticmethod
+    def reverse(a) -> NDArray:
+        return NDArray(jnp.flip(_v(a)))
+
+    @staticmethod
+    def where(cond, x=None, y=None):
+        if x is None:
+            return [NDArray(i) for i in jnp.where(_v(cond))]
+        return NDArray(jnp.where(_v(cond), _v(x), _v(y)))
+
+    @staticmethod
+    def gather(a, indices, dim: int = 0) -> NDArray:
+        return NDArray(jnp.take(_v(a), _v(indices).astype(jnp.int32), axis=int(dim)))
+
+    @staticmethod
+    def scatterUpdate(a, indices, updates, dim: int = 0) -> NDArray:
+        av = _v(a)
+        idx = _v(indices).astype(jnp.int32)
+        if dim != 0:
+            raise NotImplementedError("scatterUpdate only supports dim=0")
+        return NDArray(av.at[idx].set(_v(updates)))
+
+    @staticmethod
+    def oneHot(indices, depth: int, dtype=None) -> NDArray:
+        return NDArray(jax.nn.one_hot(_v(indices).astype(jnp.int32), int(depth),
+                                      dtype=_dt(dtype).jnp))
+
+    @staticmethod
+    def sort(a, dim: int = -1, ascending: bool = True) -> NDArray:
+        s = jnp.sort(_v(a), axis=int(dim))
+        return NDArray(s if ascending else jnp.flip(s, axis=int(dim)))
+
+    @staticmethod
+    def argsort(a, dim: int = -1, ascending: bool = True) -> NDArray:
+        s = jnp.argsort(_v(a), axis=int(dim))
+        return NDArray(s if ascending else jnp.flip(s, axis=int(dim)))
+
+    @staticmethod
+    def topK(a, k: int):
+        vals, idx = lax.top_k(_v(a), int(k))
+        return NDArray(vals), NDArray(idx)
+
+    @staticmethod
+    def unique(a):
+        return NDArray(jnp.unique(np.asarray(_v(a))))
+
+    # ---------------- elementwise math ----------------
+    # (reference: libnd4j legacy transform ops, include/loops/legacy_ops.h)
+    @staticmethod
+    def exp(a):      return NDArray(jnp.exp(_v(a)))
+    @staticmethod
+    def log(a):      return NDArray(jnp.log(_v(a)))
+    @staticmethod
+    def log1p(a):    return NDArray(jnp.log1p(_v(a)))
+    @staticmethod
+    def sqrt(a):     return NDArray(jnp.sqrt(_v(a)))
+    @staticmethod
+    def square(a):   return NDArray(jnp.square(_v(a)))
+    @staticmethod
+    def abs(a):      return NDArray(jnp.abs(_v(a)))
+    @staticmethod
+    def sign(a):     return NDArray(jnp.sign(_v(a)))
+    @staticmethod
+    def floor(a):    return NDArray(jnp.floor(_v(a)))
+    @staticmethod
+    def ceil(a):     return NDArray(jnp.ceil(_v(a)))
+    @staticmethod
+    def round(a):    return NDArray(jnp.round(_v(a)))
+    @staticmethod
+    def sin(a):      return NDArray(jnp.sin(_v(a)))
+    @staticmethod
+    def cos(a):      return NDArray(jnp.cos(_v(a)))
+    @staticmethod
+    def tan(a):      return NDArray(jnp.tan(_v(a)))
+    @staticmethod
+    def asin(a):     return NDArray(jnp.arcsin(_v(a)))
+    @staticmethod
+    def acos(a):     return NDArray(jnp.arccos(_v(a)))
+    @staticmethod
+    def atan(a):     return NDArray(jnp.arctan(_v(a)))
+    @staticmethod
+    def sinh(a):     return NDArray(jnp.sinh(_v(a)))
+    @staticmethod
+    def cosh(a):     return NDArray(jnp.cosh(_v(a)))
+    @staticmethod
+    def tanh(a):     return NDArray(jnp.tanh(_v(a)))
+    @staticmethod
+    def erf(a):      return NDArray(jax.scipy.special.erf(_v(a)))
+    @staticmethod
+    def sigmoid(a):  return NDArray(jax.nn.sigmoid(_v(a)))
+    @staticmethod
+    def softplus(a): return NDArray(jax.nn.softplus(_v(a)))
+    @staticmethod
+    def softsign(a): return NDArray(jax.nn.soft_sign(_v(a)))
+    @staticmethod
+    def relu(a):     return NDArray(jax.nn.relu(_v(a)))
+    @staticmethod
+    def relu6(a):    return NDArray(jax.nn.relu6(_v(a)))
+    @staticmethod
+    def leakyRelu(a, alpha=0.01):
+        return NDArray(jax.nn.leaky_relu(_v(a), alpha))
+    @staticmethod
+    def elu(a, alpha=1.0):
+        return NDArray(jax.nn.elu(_v(a), alpha))
+    @staticmethod
+    def gelu(a):     return NDArray(jax.nn.gelu(_v(a)))
+    @staticmethod
+    def swish(a):    return NDArray(jax.nn.silu(_v(a)))
+    @staticmethod
+    def mish(a):
+        v = _v(a)
+        return NDArray(v * jnp.tanh(jax.nn.softplus(v)))
+    @staticmethod
+    def hardSigmoid(a):
+        return NDArray(jnp.clip(0.2 * _v(a) + 0.5, 0.0, 1.0))
+    @staticmethod
+    def hardTanh(a):
+        return NDArray(jnp.clip(_v(a), -1.0, 1.0))
+    @staticmethod
+    def softmax(a, dim: int = -1):
+        return NDArray(jax.nn.softmax(_v(a), axis=int(dim)))
+    @staticmethod
+    def logSoftmax(a, dim: int = -1):
+        return NDArray(jax.nn.log_softmax(_v(a), axis=int(dim)))
+    @staticmethod
+    def pow(a, p):   return NDArray(jnp.power(_v(a), _v(p)))
+    @staticmethod
+    def clip(a, lo, hi):
+        return NDArray(jnp.clip(_v(a), lo, hi))
+    @staticmethod
+    def reciprocal(a):
+        return NDArray(1.0 / _v(a))
+    @staticmethod
+    def rsqrt(a):
+        return NDArray(lax.rsqrt(_v(a)))
+    @staticmethod
+    def maximum(a, b): return NDArray(jnp.maximum(_v(a), _v(b)))
+    @staticmethod
+    def minimum(a, b): return NDArray(jnp.minimum(_v(a), _v(b)))
+    @staticmethod
+    def isNaN(a):    return NDArray(jnp.isnan(_v(a)))
+    @staticmethod
+    def isInf(a):    return NDArray(jnp.isinf(_v(a)))
+    @staticmethod
+    def replaceNaN(a, value):
+        v = _v(a)
+        return NDArray(jnp.where(jnp.isnan(v), value, v))
+
+    # ---------------- reductions (facade) ----------------
+    @staticmethod
+    def sum(a, *dims):  return NDArray(a).sum(*dims) if not isinstance(a, NDArray) else a.sum(*dims)
+    @staticmethod
+    def mean(a, *dims): return NDArray(a).mean(*dims) if not isinstance(a, NDArray) else a.mean(*dims)
+    @staticmethod
+    def max(a, *dims):  return NDArray(a).max(*dims) if not isinstance(a, NDArray) else a.max(*dims)
+    @staticmethod
+    def min(a, *dims):  return NDArray(a).min(*dims) if not isinstance(a, NDArray) else a.min(*dims)
+    @staticmethod
+    def argMax(a, *dims): return a.argMax(*dims)
+    @staticmethod
+    def norm2(a, *dims):  return a.norm2(*dims)
+
+    @staticmethod
+    def cosineSim(a, b) -> float:
+        av, bv = _v(a).ravel(), _v(b).ravel()
+        return float(jnp.vdot(av, bv) /
+                     (jnp.linalg.norm(av) * jnp.linalg.norm(bv) + 1e-12))
+
+    @staticmethod
+    def euclideanDistance(a, b) -> float:
+        return float(jnp.linalg.norm(_v(a).ravel() - _v(b).ravel()))
+
+    @staticmethod
+    def manhattanDistance(a, b) -> float:
+        return float(jnp.sum(jnp.abs(_v(a).ravel() - _v(b).ravel())))
+
+    # ---------------- im2col / conv helpers ----------------
+    @staticmethod
+    def im2col(img, kh: int, kw: int, sy: int, sx: int, ph: int, pw: int,
+               dh: int = 1, dw: int = 1) -> NDArray:
+        """Reference: libnd4j ``ops/declarable/generic/parity_ops/im2col`` —
+        lowered to ``lax.conv_general_dilated_patches`` (NCHW in/out)."""
+        patches = lax.conv_general_dilated_patches(
+            _v(img), (kh, kw), (sy, sx), [(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n, ckk, oh, ow = patches.shape
+        c = img.shape[1] if isinstance(img, NDArray) else _v(img).shape[1]
+        return NDArray(patches.reshape(n, c, kh, kw, oh, ow))
+
+    # ---------------- serde ----------------
+    writeAsNumpy = staticmethod(_serde.write_as_numpy)
+    createFromNpyFile = staticmethod(_serde.from_npy_file)
+    toNpyByteArray = staticmethod(_serde.to_npy_bytes)
+    createNpyFromByteArray = staticmethod(_serde.from_npy_bytes)
+
+    # ---------------- environment ----------------
+    @staticmethod
+    def getBackend() -> str:
+        return jax.default_backend()
+
+    @staticmethod
+    def getAffinityManager():
+        return jax.devices()
+
+    @staticmethod
+    def exec(op_result):
+        """Parity shim: ops here execute eagerly/under-jit; identity."""
+        return op_result

@@ -1,0 +1,528 @@
+"""NDArray — ND4J's ``INDArray`` surface over immutable ``jax.Array``.
+
+Reference: nd4j-api ``org/nd4j/linalg/api/ndarray/INDArray.java`` /
+``BaseNDArray.java``.
+
+Design (TPU-first, see SURVEY.md §7.1): ND4J arrays are mutable with aliasing
+views; ``jax.Array`` is immutable.  The facade keeps a single rebindable
+``_value`` slot — "in-place" methods (``addi``, ``assign``, ``putScalar``)
+compute a new functional value and rebind the slot.  A *view* produced by
+``get``/``getRow``/``slice`` records ``(parent, index)``; writes through a view
+propagate up the parent chain with ``value.at[index].set(...)``, reproducing
+ND4J's aliasing semantics without mutable buffers.  Under ``jit`` everything
+reduces to pure XLA ops; the mutation facade only exists at the eager API
+boundary.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.dtype import DataType, from_np, promote
+
+__all__ = ["NDArray", "NDArrayIndex"]
+
+
+class NDArrayIndex:
+    """Index builders mirroring ``org.nd4j.linalg.indexing.NDArrayIndex``."""
+
+    def __init__(self, raw):
+        self.raw = raw
+
+    @staticmethod
+    def all():
+        return NDArrayIndex(slice(None))
+
+    @staticmethod
+    def point(i: int):
+        return NDArrayIndex(int(i))
+
+    @staticmethod
+    def interval(start: int, end: int, step: int = 1):
+        return NDArrayIndex(slice(int(start), int(end), int(step)))
+
+    @staticmethod
+    def indices(*idx: int):
+        return NDArrayIndex(np.asarray(idx, dtype=np.int64))
+
+    @staticmethod
+    def newAxis():
+        return NDArrayIndex(None)
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._value
+    return x
+
+
+def _as_index(args) -> Tuple:
+    out = []
+    for a in args:
+        if isinstance(a, NDArrayIndex):
+            out.append(a.raw)
+        elif isinstance(a, NDArray):
+            out.append(np.asarray(a._value))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+class NDArray:
+    """A dense n-d tensor with ND4J ``INDArray`` semantics on TPU."""
+
+    __slots__ = ("_value", "_parent", "_index")
+
+    def __init__(self, value, parent: Optional["NDArray"] = None, index=None):
+        if isinstance(value, NDArray):
+            value = value._value
+        if not isinstance(value, (jax.Array, jnp.ndarray)):
+            value = jnp.asarray(value)
+        self._value = value
+        self._parent = parent
+        self._index = index
+
+    # -- core accessors -------------------------------------------------
+    @property
+    def jax(self) -> jax.Array:
+        return self._value
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def toDoubleMatrix(self):
+        return self.numpy().astype(np.float64)
+
+    def toFloatVector(self):
+        return self.numpy().astype(np.float32).ravel()
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._value.shape)
+
+    def shapeOf(self):
+        return self.shape
+
+    def rank(self) -> int:
+        return self._value.ndim
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    def length(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.ndim else 1
+
+    def size(self, dim: int) -> int:
+        return self._value.shape[dim]
+
+    def rows(self) -> int:
+        return self.size(0)
+
+    def columns(self) -> int:
+        return self.size(1)
+
+    def isEmpty(self) -> bool:
+        return self.length() == 0
+
+    def isScalar(self) -> bool:
+        return self._value.ndim == 0 or self.length() == 1
+
+    def isVector(self) -> bool:
+        return self._value.ndim == 1 or (
+            self._value.ndim == 2 and 1 in self.shape)
+
+    def isMatrix(self) -> bool:
+        return self._value.ndim == 2
+
+    def isView(self) -> bool:
+        return self._parent is not None
+
+    def dataType(self) -> DataType:
+        return from_np(self._value.dtype)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.dataType()
+
+    # -- mutation core --------------------------------------------------
+    def _write(self, new_value) -> "NDArray":
+        """Rebind this array's value; propagate through the view chain."""
+        new_value = jnp.asarray(new_value, dtype=self._value.dtype)
+        if new_value.shape != self._value.shape:
+            new_value = jnp.broadcast_to(new_value, self._value.shape)
+        self._value = new_value
+        if self._parent is not None:
+            p = self._parent
+            p._write(p._value.at[self._index].set(
+                new_value.astype(p._value.dtype)))
+        return self
+
+    def assign(self, other) -> "NDArray":
+        """In-place overwrite (``INDArray.assign``)."""
+        return self._write(_unwrap(other))
+
+    def assignIf(self, other, cond) -> "NDArray":
+        mask = jnp.asarray(cond(self._value)) if callable(cond) else jnp.asarray(_unwrap(cond))
+        return self._write(jnp.where(mask, jnp.asarray(_unwrap(other), dtype=self._value.dtype), self._value))
+
+    def detach(self) -> "NDArray":
+        return NDArray(self._value)
+
+    def dup(self, order: str = "c") -> "NDArray":
+        return NDArray(self._value)
+
+    # -- casting --------------------------------------------------------
+    def castTo(self, dt) -> "NDArray":
+        dt = dt if isinstance(dt, DataType) else from_np(dt)
+        return NDArray(self._value.astype(dt.jnp))
+
+    def asDataType(self, dt) -> "NDArray":
+        return self.castTo(dt)
+
+    # -- indexing / views ----------------------------------------------
+    def get(self, *indices) -> "NDArray":
+        """Return a VIEW (writes propagate to parent), like ``INDArray.get``."""
+        idx = _as_index(indices)
+        return NDArray(self._value[idx], parent=self, index=idx)
+
+    def put(self, indices, value) -> "NDArray":
+        if isinstance(indices, (list, tuple)):
+            idx = _as_index(tuple(indices))
+        else:
+            idx = _as_index((indices,))
+        return self._write(self._value.at[idx].set(
+            jnp.asarray(_unwrap(value), dtype=self._value.dtype)))
+
+    def putScalar(self, *args) -> "NDArray":
+        *idx, v = args
+        if len(idx) == 1 and isinstance(idx[0], (list, tuple, np.ndarray)):
+            idx = list(idx[0])
+        idx = tuple(int(i) for i in idx)
+        if self._value.ndim > len(idx):  # linear index into flat array
+            if len(idx) == 1:
+                flat = self._value.reshape(-1).at[idx[0]].set(v)
+                return self._write(flat.reshape(self._value.shape))
+        return self._write(self._value.at[idx].set(v))
+
+    def getScalar(self, *idx) -> "NDArray":
+        return NDArray(self._value[tuple(int(i) for i in idx)])
+
+    def getDouble(self, *idx) -> float:
+        return float(self._pick(idx))
+
+    def getFloat(self, *idx) -> float:
+        return float(self._pick(idx))
+
+    def getInt(self, *idx) -> int:
+        return int(self._pick(idx))
+
+    def _pick(self, idx):
+        if not idx:
+            return np.asarray(self._value).reshape(-1)[0]
+        if len(idx) == 1 and self._value.ndim != 1:
+            return np.asarray(self._value).reshape(-1)[int(idx[0])]
+        return np.asarray(self._value)[tuple(int(i) for i in idx)]
+
+    def getRow(self, i: int) -> "NDArray":
+        return self.get(NDArrayIndex.point(i))
+
+    def getColumn(self, i: int) -> "NDArray":
+        idx = (slice(None), int(i))
+        return NDArray(self._value[idx], parent=self, index=idx)
+
+    def getRows(self, *rows) -> "NDArray":
+        return NDArray(self._value[np.asarray(rows, dtype=np.int64)])
+
+    def getColumns(self, *cols) -> "NDArray":
+        return NDArray(self._value[:, np.asarray(cols, dtype=np.int64)])
+
+    def putRow(self, i: int, row) -> "NDArray":
+        return self.put((NDArrayIndex.point(i),), row)
+
+    def putColumn(self, i: int, col) -> "NDArray":
+        return self._write(self._value.at[:, int(i)].set(
+            jnp.asarray(_unwrap(col), dtype=self._value.dtype).reshape(-1)))
+
+    def slice_(self, i: int, dim: int = 0) -> "NDArray":
+        idx = tuple([slice(None)] * dim + [int(i)])
+        return NDArray(self._value[idx], parent=self, index=idx)
+
+    # DL4J name (``slice`` clashes with Python builtin only as identifier-safe)
+    slice = slice_
+
+    def tensorAlongDimension(self, index: int, *dims) -> "NDArray":
+        """The ``index``-th sub-tensor spanning ``dims`` (TAD semantics)."""
+        dims = tuple(sorted(d % self.ndim for d in dims))
+        other = [d for d in range(self.ndim) if d not in dims]
+        counts = [self.shape[d] for d in other]
+        sub = np.unravel_index(index, counts) if counts else ()
+        idx: list = [slice(None)] * self.ndim
+        for d, i in zip(other, sub):
+            idx[d] = int(i)
+        idx_t = tuple(idx)
+        return NDArray(self._value[idx_t], parent=self, index=idx_t)
+
+    def tensorsAlongDimension(self, *dims) -> int:
+        dims_n = {d % self.ndim for d in dims}
+        other = [self.shape[d] for d in range(self.ndim) if d not in dims_n]
+        return int(np.prod(other)) if other else 1
+
+    def __getitem__(self, idx) -> "NDArray":
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        idx = _as_index(idx)
+        return NDArray(self._value[idx], parent=self, index=idx)
+
+    def __setitem__(self, idx, value) -> None:
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        idx = _as_index(idx)
+        self._write(self._value.at[idx].set(
+            jnp.asarray(_unwrap(value), dtype=self._value.dtype)))
+
+    # -- shape manipulation --------------------------------------------
+    def reshape(self, *shape) -> "NDArray":
+        if shape and isinstance(shape[0], str):  # ND4J order char — ignored ('c')
+            shape = shape[1:]
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return NDArray(self._value.reshape(tuple(int(s) for s in shape)))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(self._value.reshape(-1))
+
+    def flatten(self) -> "NDArray":
+        return self.ravel()
+
+    def transpose(self) -> "NDArray":
+        return NDArray(self._value.T)
+
+    def transposei(self) -> "NDArray":
+        return self._write_reshaped(self._value.T)
+
+    def permute(self, *dims) -> "NDArray":
+        return NDArray(jnp.transpose(self._value, tuple(int(d) for d in dims)))
+
+    def swapAxes(self, a: int, b: int) -> "NDArray":
+        return NDArray(jnp.swapaxes(self._value, a, b))
+
+    def broadcast(self, *shape) -> "NDArray":
+        return NDArray(jnp.broadcast_to(self._value, tuple(int(s) for s in shape)))
+
+    def repeat(self, dim: int, n: int) -> "NDArray":
+        return NDArray(jnp.repeat(self._value, int(n), axis=int(dim)))
+
+    def _write_reshaped(self, v) -> "NDArray":
+        # shape-changing in-place op: only legal on non-views
+        self._value = v
+        return self
+
+    # -- arithmetic helpers --------------------------------------------
+    def _coerce(self, other):
+        o = _unwrap(other)
+        o = jnp.asarray(o)
+        out_dt = promote(self.dataType(), from_np(o.dtype)) \
+            if isinstance(other, NDArray) else self.dataType()
+        return o, out_dt
+
+    def _binary(self, other, fn) -> "NDArray":
+        o, out_dt = self._coerce(other)
+        return NDArray(fn(self._value.astype(out_dt.jnp), o.astype(out_dt.jnp)))
+
+    def _binary_i(self, other, fn) -> "NDArray":
+        o = jnp.asarray(_unwrap(other))
+        return self._write(fn(self._value, o.astype(self._value.dtype)))
+
+    # copies
+    def add(self, o):  return self._binary(o, jnp.add)
+    def sub(self, o):  return self._binary(o, jnp.subtract)
+    def mul(self, o):  return self._binary(o, jnp.multiply)
+    def div(self, o):  return self._binary(o, jnp.divide)
+    def rsub(self, o): return self._binary(o, lambda a, b: b - a)
+    def rdiv(self, o): return self._binary(o, lambda a, b: b / a)
+    def fmod(self, o): return self._binary(o, jnp.fmod)
+
+    # in-place
+    def addi(self, o):  return self._binary_i(o, jnp.add)
+    def subi(self, o):  return self._binary_i(o, jnp.subtract)
+    def muli(self, o):  return self._binary_i(o, jnp.multiply)
+    def divi(self, o):  return self._binary_i(o, jnp.divide)
+    def rsubi(self, o): return self._binary_i(o, lambda a, b: b - a)
+    def rdivi(self, o): return self._binary_i(o, lambda a, b: b / a)
+
+    # broadcast-along-dimension ops (ND4J addRowVector etc.)
+    def addRowVector(self, v):  return self._binary(v, lambda a, b: a + b.reshape(1, -1))
+    def addColumnVector(self, v): return self._binary(v, lambda a, b: a + b.reshape(-1, 1))
+    def subRowVector(self, v):  return self._binary(v, lambda a, b: a - b.reshape(1, -1))
+    def subColumnVector(self, v): return self._binary(v, lambda a, b: a - b.reshape(-1, 1))
+    def mulRowVector(self, v):  return self._binary(v, lambda a, b: a * b.reshape(1, -1))
+    def mulColumnVector(self, v): return self._binary(v, lambda a, b: a * b.reshape(-1, 1))
+    def divRowVector(self, v):  return self._binary(v, lambda a, b: a / b.reshape(1, -1))
+    def divColumnVector(self, v): return self._binary(v, lambda a, b: a / b.reshape(-1, 1))
+    def addiRowVector(self, v):  return self._binary_i(v, lambda a, b: a + b.reshape(1, -1))
+    def addiColumnVector(self, v): return self._binary_i(v, lambda a, b: a + b.reshape(-1, 1))
+    def muliRowVector(self, v):  return self._binary_i(v, lambda a, b: a * b.reshape(1, -1))
+    def muliColumnVector(self, v): return self._binary_i(v, lambda a, b: a * b.reshape(-1, 1))
+
+    def neg(self):  return NDArray(-self._value)
+
+    def negi(self):
+        return self._write(-self._value)
+
+    # -- linear algebra -------------------------------------------------
+    def mmul(self, other, out: Optional["NDArray"] = None) -> "NDArray":
+        o = jnp.asarray(_unwrap(other))
+        r = NDArray(jnp.matmul(self._value, o))
+        if out is not None:
+            out.assign(r)
+            return out
+        return r
+
+    matmul = mmul
+
+    def mmuli(self, other) -> "NDArray":
+        return self._write_reshaped(jnp.matmul(self._value, jnp.asarray(_unwrap(other))))
+
+    def dot(self, other) -> float:
+        o = jnp.asarray(_unwrap(other))
+        return float(jnp.vdot(self._value, o))
+
+    # -- reductions -----------------------------------------------------
+    def _reduce(self, fn, dims, keep=False) -> "NDArray":
+        axis = None if not dims else tuple(int(d) for d in dims)
+        return NDArray(fn(self._value, axis=axis, keepdims=keep) if axis is not None
+                       else fn(self._value))
+
+    def sum(self, *dims, keepDims: bool = False):
+        return self._reduce(jnp.sum, dims, keepDims)
+
+    def mean(self, *dims, keepDims: bool = False):
+        return self._reduce(jnp.mean, dims, keepDims)
+
+    def max(self, *dims, keepDims: bool = False):
+        return self._reduce(jnp.max, dims, keepDims)
+
+    def min(self, *dims, keepDims: bool = False):
+        return self._reduce(jnp.min, dims, keepDims)
+
+    def prod(self, *dims, keepDims: bool = False):
+        return self._reduce(jnp.prod, dims, keepDims)
+
+    def std(self, *dims, biasCorrected: bool = True):
+        ddof = 1 if biasCorrected else 0
+        axis = None if not dims else tuple(int(d) for d in dims)
+        return NDArray(jnp.std(self._value, axis=axis, ddof=ddof))
+
+    def var(self, *dims, biasCorrected: bool = True):
+        ddof = 1 if biasCorrected else 0
+        axis = None if not dims else tuple(int(d) for d in dims)
+        return NDArray(jnp.var(self._value, axis=axis, ddof=ddof))
+
+    def norm1(self, *dims):
+        return self._reduce(lambda v, **kw: jnp.sum(jnp.abs(v), **kw), dims)
+
+    def norm2(self, *dims):
+        return self._reduce(lambda v, **kw: jnp.sqrt(jnp.sum(v * v, **kw)), dims)
+
+    def normmax(self, *dims):
+        return self._reduce(lambda v, **kw: jnp.max(jnp.abs(v), **kw), dims)
+
+    def argMax(self, *dims):
+        axis = int(dims[0]) if dims else None
+        return NDArray(jnp.argmax(self._value, axis=axis))
+
+    def argMin(self, *dims):
+        axis = int(dims[0]) if dims else None
+        return NDArray(jnp.argmin(self._value, axis=axis))
+
+    def cumsum(self, dim: int = 0):
+        return NDArray(jnp.cumsum(self._value, axis=int(dim)))
+
+    def cumprod(self, dim: int = 0):
+        return NDArray(jnp.cumprod(self._value, axis=int(dim)))
+
+    def sumNumber(self) -> float:
+        return float(jnp.sum(self._value))
+
+    def meanNumber(self) -> float:
+        return float(jnp.mean(self._value))
+
+    def maxNumber(self) -> float:
+        return float(jnp.max(self._value))
+
+    def minNumber(self) -> float:
+        return float(jnp.min(self._value))
+
+    def norm1Number(self) -> float:
+        return float(jnp.sum(jnp.abs(self._value)))
+
+    def norm2Number(self) -> float:
+        return float(jnp.sqrt(jnp.sum(self._value * self._value)))
+
+    def scan(self, cond) -> int:
+        return int(jnp.sum(cond(self._value)))
+
+    # -- comparison -----------------------------------------------------
+    def gt(self, o):  return self._binary(o, jnp.greater)
+    def gte(self, o): return self._binary(o, jnp.greater_equal)
+    def lt(self, o):  return self._binary(o, jnp.less)
+    def lte(self, o): return self._binary(o, jnp.less_equal)
+    def eq(self, o):  return self._binary(o, jnp.equal)
+    def neq(self, o): return self._binary(o, jnp.not_equal)
+
+    def equalsWithEps(self, other, eps: float = 1e-5) -> bool:
+        o = np.asarray(_unwrap(other))
+        mine = self.numpy()
+        if mine.shape != o.shape:
+            return False
+        return bool(np.allclose(mine.astype(np.float64), o.astype(np.float64),
+                                atol=eps, rtol=0))
+
+    def equalShapes(self, other) -> bool:
+        return self.shape == tuple(np.asarray(_unwrap(other)).shape)
+
+    # -- python protocol -------------------------------------------------
+    def __add__(self, o):  return self.add(o)
+    def __radd__(self, o): return self.add(o)
+    def __sub__(self, o):  return self.sub(o)
+    def __rsub__(self, o): return self.rsub(o)
+    def __mul__(self, o):  return self.mul(o)
+    def __rmul__(self, o): return self.mul(o)
+    def __truediv__(self, o):  return self.div(o)
+    def __rtruediv__(self, o): return self.rdiv(o)
+    def __matmul__(self, o):   return self.mmul(o)
+    def __neg__(self):     return self.neg()
+    def __pow__(self, o):  return self._binary(o, jnp.power)
+    def __abs__(self):     return NDArray(jnp.abs(self._value))
+    def __len__(self):     return self.shape[0] if self.ndim else 0
+    def __float__(self):   return float(self._value)
+    def __int__(self):     return int(self._value)
+    def __bool__(self):
+        if self.length() != 1:
+            raise ValueError("Truth value of non-scalar NDArray is ambiguous")
+        return bool(np.asarray(self._value).reshape(-1)[0])
+
+    def __eq__(self, other):  # ND4J: elementwise via .eq; keep identity here
+        if isinstance(other, NDArray):
+            return self.equalsWithEps(other, 1e-5)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        return f"NDArray(dtype={self.dataType().name}, shape={self.shape})\n{np.asarray(self._value)}"
+
+    def toString(self):
+        return repr(self)
+
+    def toStringFull(self):
+        return repr(self)

@@ -1,0 +1,43 @@
+"""Numpy / binary serde for NDArray.
+
+Reference: nd4j-api ``org/nd4j/serde/**`` and ``Nd4j.writeAsNumpy`` /
+``Nd4j.createFromNpyFile`` / ``BinarySerde``.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Dict, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.ops.ndarray import NDArray
+
+PathLike = Union[str, os.PathLike]
+
+
+def write_as_numpy(arr: NDArray, path: PathLike) -> None:
+    np.save(os.fspath(path), arr.numpy(), allow_pickle=False)
+
+
+def from_npy_file(path: PathLike) -> NDArray:
+    return NDArray(np.load(os.fspath(path), allow_pickle=False))
+
+
+def to_npy_bytes(arr: NDArray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr.numpy(), allow_pickle=False)
+    return buf.getvalue()
+
+
+def from_npy_bytes(data: bytes) -> NDArray:
+    return NDArray(np.load(io.BytesIO(data), allow_pickle=False))
+
+
+def write_npz(arrays: Dict[str, NDArray], path: PathLike) -> None:
+    np.savez(os.fspath(path), **{k: v.numpy() for k, v in arrays.items()})
+
+
+def read_npz(path: PathLike) -> Dict[str, NDArray]:
+    with np.load(os.fspath(path), allow_pickle=False) as z:
+        return {k: NDArray(z[k]) for k in z.files}

@@ -1,0 +1,17 @@
+"""Test configuration.
+
+All tests run on CPU with 8 virtual XLA devices so the multi-chip sharding
+path is exercised without TPU hardware (the reference's analogue is
+DummyTransport / local[N] Spark masters — SURVEY.md §4).  Must run before
+jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,298 @@
+"""T0 tests: NDArray facade, dtype rules, factory ops, RNG, serde.
+
+Modeled on the reference's NDArrayTest / op-validation suites
+(libnd4j tests_cpu/layers_tests/NDArrayTest.cpp, nd4j-tests opvalidation).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import (DataType, Nd4j, NDArray, NDArrayIndex,
+                                    get_random, promote, serde)
+
+
+class TestCreation:
+    def test_zeros_ones(self):
+        z = Nd4j.zeros(2, 3)
+        assert z.shape == (2, 3)
+        assert z.dataType() == DataType.FLOAT
+        assert z.sumNumber() == 0.0
+        o = Nd4j.ones(4, dtype=DataType.DOUBLE)
+        assert o.sumNumber() == 4.0
+        assert o.dataType() == DataType.DOUBLE
+
+    def test_create_from_data(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.getDouble(1, 0) == 3.0
+        b = Nd4j.create([1, 2, 3, 4], shape=(2, 2), dtype=DataType.INT32)
+        assert b.dataType() == DataType.INT32
+
+    def test_scalar_arange_linspace_eye(self):
+        assert Nd4j.scalar(3.5).getDouble() == 3.5
+        assert Nd4j.scalar(3).dataType() == DataType.INT64
+        assert Nd4j.arange(5).shape == (5,)
+        assert Nd4j.linspace(0, 1, 11).getDouble(10) == pytest.approx(1.0)
+        assert Nd4j.eye(3).sumNumber() == 3.0
+
+    def test_value_array(self):
+        v = Nd4j.valueArrayOf((2, 2), 7.0)
+        assert v.meanNumber() == 7.0
+
+
+class TestDtype:
+    def test_promotion(self):
+        assert promote(DataType.INT32, DataType.FLOAT) == DataType.FLOAT
+        assert promote(DataType.HALF, DataType.BFLOAT16) == DataType.FLOAT
+        assert promote(DataType.BOOL, DataType.INT8) == DataType.INT8
+        assert promote(DataType.DOUBLE, DataType.BFLOAT16) == DataType.DOUBLE
+
+    def test_binary_promotes(self):
+        a = Nd4j.ones(2, dtype=DataType.INT32)
+        b = Nd4j.ones(2, dtype=DataType.FLOAT)
+        assert a.add(b).dataType() == DataType.FLOAT
+
+    def test_inplace_keeps_own_dtype(self):
+        a = Nd4j.ones(2, dtype=DataType.FLOAT)
+        a.addi(Nd4j.ones(2, dtype=DataType.DOUBLE))
+        assert a.dataType() == DataType.FLOAT
+
+    def test_cast(self):
+        a = Nd4j.create([1.7, 2.3]).castTo(DataType.INT32)
+        assert a.dataType() == DataType.INT32
+        assert a.getInt(0) == 1
+
+
+class TestArithmetic:
+    def test_copy_vs_inplace(self):
+        a = Nd4j.ones(3)
+        b = a.add(2.0)
+        assert a.sumNumber() == 3.0  # copy op leaves a untouched
+        assert b.sumNumber() == 9.0
+        a.addi(1.0)  # in-place rebinds
+        assert a.sumNumber() == 6.0
+
+    def test_operators(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        assert ((a + a) * 2.0 - a).sumNumber() == pytest.approx(18.0)
+        assert (a / 2.0).getDouble(1) == pytest.approx(1.0)
+        assert (-a).sumNumber() == -6.0
+        assert (a ** 2).sumNumber() == pytest.approx(14.0)
+
+    def test_broadcasting_row_col(self):
+        m = Nd4j.zeros(2, 3)
+        r = m.addRowVector(Nd4j.create([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(r.numpy(), [[1, 2, 3], [1, 2, 3]])
+        c = m.addColumnVector(Nd4j.create([10.0, 20.0]))
+        np.testing.assert_allclose(c.numpy(), [[10, 10, 10], [20, 20, 20]])
+        m.addiRowVector(Nd4j.create([1.0, 1.0, 1.0]))
+        assert m.sumNumber() == 6.0
+
+    def test_mmul_gemm(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        b = Nd4j.eye(2)
+        assert a.mmul(b).equalsWithEps(a)
+        g = Nd4j.gemm(a, a, transposeB=True)
+        np.testing.assert_allclose(g.numpy(), a.numpy() @ a.numpy().T)
+
+    def test_comparison(self):
+        a = Nd4j.create([1.0, 5.0, 3.0])
+        assert a.gt(2.0).numpy().tolist() == [False, True, True]
+
+
+class TestReductions:
+    def test_basic(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum(0).numpy().tolist() == [4.0, 6.0]
+        assert a.mean(1).numpy().tolist() == [1.5, 3.5]
+        assert a.maxNumber() == 4.0
+        assert a.argMax(1).numpy().tolist() == [1, 1]
+        assert a.norm1Number() == 10.0
+        assert a.norm2Number() == pytest.approx(np.sqrt(30.0))
+
+    def test_std_bias(self):
+        a = Nd4j.create([1.0, 2.0, 3.0, 4.0])
+        assert a.std().getDouble() == pytest.approx(np.std(a.numpy(), ddof=1))
+        assert a.std(biasCorrected=False).getDouble() == pytest.approx(
+            np.std(a.numpy()))
+
+    def test_cumsum(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        assert a.cumsum(0).numpy().tolist() == [1.0, 3.0, 6.0]
+
+
+class TestViewsAndIndexing:
+    def test_get_view_writeback(self):
+        a = Nd4j.zeros(3, 4)
+        v = a.get(NDArrayIndex.point(1), NDArrayIndex.all())
+        v.assign(5.0)
+        assert a.getRow(1).sumNumber() == 20.0
+        assert a.sumNumber() == 20.0
+
+    def test_interval_view(self):
+        a = Nd4j.arange(10)
+        v = a.get(NDArrayIndex.interval(2, 5))
+        assert v.numpy().tolist() == [2.0, 3.0, 4.0]
+        v.addi(100.0)
+        assert a.getDouble(3) == 103.0
+
+    def test_putscalar_put(self):
+        a = Nd4j.zeros(2, 2)
+        a.putScalar(0, 1, 7.0)
+        assert a.getDouble(0, 1) == 7.0
+        a.putRow(1, Nd4j.create([1.0, 2.0]))
+        assert a.getRow(1).numpy().tolist() == [1.0, 2.0]
+        a.putColumn(0, Nd4j.create([9.0, 9.0]))
+        assert a.getColumn(0).numpy().tolist() == [9.0, 9.0]
+
+    def test_python_indexing(self):
+        a = Nd4j.arange(12).reshape(3, 4)
+        assert a[1, 2].getDouble() == 6.0
+        a[0] = 0.0
+        assert a.getRow(0).sumNumber() == 0.0
+
+    def test_tad(self):
+        a = Nd4j.arange(24).reshape(2, 3, 4)
+        assert a.tensorsAlongDimension(2) == 6
+        t = a.tensorAlongDimension(1, 2)
+        assert t.shape == (4,)
+        assert t.numpy().tolist() == [4.0, 5.0, 6.0, 7.0]
+        t.assign(0.0)
+        assert a.sum(2).getDouble(0, 1) == 0.0
+
+    def test_getitem_view_chain(self):
+        a = Nd4j.zeros(4, 4)
+        v = a[0:2, 0:2]
+        v2 = v[0]
+        v2.assign(3.0)
+        assert a.getRow(0).sumNumber() == 6.0
+
+
+class TestShapeOps:
+    def test_reshape_permute(self):
+        a = Nd4j.arange(6).reshape(2, 3)
+        assert a.transpose().shape == (3, 2)
+        assert a.permute(1, 0).shape == (3, 2)
+        assert a.reshape("c", 3, 2).shape == (3, 2)
+        assert a.ravel().shape == (6,)
+
+    def test_concat_stack(self):
+        a, b = Nd4j.ones(2, 2), Nd4j.zeros(2, 2)
+        assert Nd4j.concat(0, a, b).shape == (4, 2)
+        assert Nd4j.concat(1, a, b).shape == (2, 4)
+        assert Nd4j.stack(0, a, b).shape == (2, 2, 2)
+        parts = Nd4j.split(Nd4j.arange(6), 3)
+        assert len(parts) == 3 and parts[1].numpy().tolist() == [2.0, 3.0]
+
+    def test_tile_repeat_pad(self):
+        a = Nd4j.ones(2, 2)
+        assert Nd4j.tile(a, 2, 1).shape == (4, 2)
+        assert Nd4j.repeat(a, 3, 0).shape == (6, 2)
+        assert Nd4j.pad(a, ((1, 1), (0, 0))).shape == (4, 2)
+
+    def test_gather_onehot_where(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        g = Nd4j.gather(a, Nd4j.create([1, 0], dtype=DataType.INT32))
+        assert g.getRow(0).numpy().tolist() == [3.0, 4.0]
+        oh = Nd4j.oneHot(Nd4j.create([0, 2], dtype=DataType.INT32), 3)
+        assert oh.numpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+        w = Nd4j.where(a.gt(2.0), a, Nd4j.zerosLike(a))
+        assert w.sumNumber() == 7.0
+
+    def test_sort_topk(self):
+        a = Nd4j.create([3.0, 1.0, 2.0])
+        assert Nd4j.sort(a).numpy().tolist() == [1.0, 2.0, 3.0]
+        vals, idx = Nd4j.topK(a, 2)
+        assert vals.numpy().tolist() == [3.0, 2.0]
+        assert idx.numpy().tolist() == [0, 2]
+
+
+class TestTransforms:
+    def test_activations(self):
+        a = Nd4j.create([-1.0, 0.0, 1.0])
+        assert Nd4j.relu(a).numpy().tolist() == [0.0, 0.0, 1.0]
+        np.testing.assert_allclose(Nd4j.sigmoid(Nd4j.zeros(1)).numpy(), [0.5])
+        sm = Nd4j.softmax(Nd4j.create([[1.0, 1.0]]))
+        np.testing.assert_allclose(sm.numpy(), [[0.5, 0.5]], atol=1e-6)
+        np.testing.assert_allclose(Nd4j.tanh(a).numpy(), np.tanh(a.numpy()),
+                                   atol=1e-5)
+
+    def test_math(self):
+        a = Nd4j.create([1.0, 4.0, 9.0])
+        assert Nd4j.sqrt(a).numpy().tolist() == [1.0, 2.0, 3.0]
+        np.testing.assert_allclose(Nd4j.log(Nd4j.exp(a)).numpy(), a.numpy(),
+                                   rtol=1e-4)
+        assert Nd4j.clip(a, 2.0, 5.0).numpy().tolist() == [2.0, 4.0, 5.0]
+
+    def test_nan_inf(self):
+        a = Nd4j.create([1.0, np.nan, np.inf])
+        assert Nd4j.isNaN(a).numpy().tolist() == [False, True, False]
+        assert Nd4j.replaceNaN(a, 0.0).getDouble(1) == 0.0
+
+    def test_im2col(self):
+        img = Nd4j.arange(16).reshape(1, 1, 4, 4)
+        col = Nd4j.im2col(img, 2, 2, 1, 1, 0, 0)
+        assert col.shape == (1, 1, 2, 2, 3, 3)
+        np.testing.assert_allclose(col.numpy()[0, 0, :, :, 0, 0],
+                                   [[0, 1], [4, 5]])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        a = Nd4j.rand(3, 3, seed=42)
+        b = Nd4j.rand(3, 3, seed=42)
+        assert a.equalsWithEps(b)
+
+    def test_stateful_advances(self):
+        rng = get_random()
+        rng.setSeed(7)
+        a = rng.uniform((4,))
+        b = rng.uniform((4,))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        rng.setSeed(7)
+        c = rng.uniform((4,))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c))
+
+    def test_distributions(self):
+        n = get_random().normal((10000,), mean=2.0, std=0.5)
+        assert abs(float(np.mean(np.asarray(n))) - 2.0) < 0.05
+        r = Nd4j.randn(1000, seed=1)
+        assert abs(r.meanNumber()) < 0.2
+
+
+class TestSerde:
+    def test_npy_roundtrip(self, tmp_path):
+        a = Nd4j.rand(3, 4, seed=5)
+        p = tmp_path / "a.npy"
+        Nd4j.writeAsNumpy(a, p)
+        b = Nd4j.createFromNpyFile(p)
+        assert a.equalsWithEps(b)
+
+    def test_bytes_roundtrip(self):
+        a = Nd4j.arange(5)
+        b = Nd4j.createNpyFromByteArray(Nd4j.toNpyByteArray(a))
+        assert a.equalsWithEps(b)
+
+    def test_npz(self, tmp_path):
+        p = tmp_path / "z.npz"
+        serde.write_npz({"x": Nd4j.ones(2), "y": Nd4j.zeros(3)}, p)
+        out = serde.read_npz(p)
+        assert out["x"].sumNumber() == 2.0 and out["y"].shape == (3,)
+
+
+class TestMisc:
+    def test_dup_detached(self):
+        a = Nd4j.ones(2)
+        d = a.dup()
+        d.addi(1.0)
+        assert a.sumNumber() == 2.0
+
+    def test_distances(self):
+        a, b = Nd4j.create([1.0, 0.0]), Nd4j.create([0.0, 1.0])
+        assert Nd4j.cosineSim(a, a) == pytest.approx(1.0)
+        assert Nd4j.euclideanDistance(a, b) == pytest.approx(np.sqrt(2))
+        assert Nd4j.manhattanDistance(a, b) == pytest.approx(2.0)
+
+    def test_predicates(self):
+        assert Nd4j.ones(1, 5).isVector()
+        assert Nd4j.ones(3, 3).isMatrix()
+        assert Nd4j.scalar(1.0).isScalar()
