@@ -1,0 +1,2 @@
+"""Autodiff utilities: SameDiff-style graph API + gradient checking."""
+from deeplearning4j_tpu.autodiff.gradcheck import GradCheckResult, check_gradients  # noqa: F401

@@ -1,0 +1,10 @@
+"""Updaters, schedules, regularization (reference: org/nd4j/linalg/learning)."""
+from deeplearning4j_tpu.learning.config import (  # noqa: F401
+    AMSGrad, AdaDelta, AdaGrad, AdaMax, Adam, AdamW, IUpdater, Nadam,
+    Nesterovs, NoOp, RmsProp, Sgd)
+from deeplearning4j_tpu.learning.schedules import (  # noqa: F401
+    CycleSchedule, ExponentialSchedule, FixedSchedule, ISchedule,
+    InverseSchedule, LinearSchedule, MapSchedule, PolySchedule, ScheduleType,
+    SigmoidSchedule, StepSchedule)
+from deeplearning4j_tpu.learning.regularization import (  # noqa: F401
+    L1Regularization, L2Regularization, Regularization, WeightDecay)

@@ -1,0 +1,232 @@
+"""Updater (optimizer) configs and pure-function appliers.
+
+Reference: nd4j-api ``org/nd4j/linalg/learning/config/*.java`` (``IUpdater``
+impls: Sgd, Adam, AdaMax, AMSGrad, Nadam, Nesterovs, RmsProp, AdaGrad,
+AdaDelta, NoOp) and the state-carrying appliers
+``org/nd4j/linalg/learning/*Updater.java``.
+
+TPU-first design: the reference applies updaters in-place on flat state views
+per ``UpdaterBlock``.  Here each config exposes
+
+- ``init(param) -> state pytree-leaf dict``
+- ``apply(grad, state, lr, iteration) -> (update, new_state)``
+
+both pure and jit-traceable, so the updater fuses into the single XLA train
+step.  ``update`` is SUBTRACTED from the param by the caller (matching the
+reference's ``params.subi(gradientView)`` step, SURVEY.md §3.1).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.learning.schedules import ISchedule
+
+__all__ = ["IUpdater", "Sgd", "Adam", "AdamW", "AdaMax", "AMSGrad", "Nadam",
+           "Nesterovs", "RmsProp", "AdaGrad", "AdaDelta", "NoOp"]
+
+
+@dataclasses.dataclass
+class IUpdater:
+    """Base updater config."""
+    learningRate: float = 1e-3
+    learningRateSchedule: Optional[ISchedule] = None
+
+    # -- API ------------------------------------------------------------
+    def currentLr(self, iteration, epoch):
+        if self.learningRateSchedule is not None:
+            return self.learningRateSchedule.valueAt(iteration, epoch)
+        return self.learningRate
+
+    def init(self, param) -> Dict[str, Any]:
+        return {}
+
+    def apply(self, grad, state, lr, iteration) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def stateSize(self, numParams: int) -> int:
+        return 0
+
+    # -- serde ----------------------------------------------------------
+    def toJson(self) -> dict:
+        d = {k: v for k, v in dataclasses.asdict(self).items()
+             if not isinstance(v, dict) or k != "learningRateSchedule"}
+        if self.learningRateSchedule is not None:
+            d["learningRateSchedule"] = self.learningRateSchedule.toJson()
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def fromJson(d: dict) -> "IUpdater":
+        d = dict(d)
+        cls = _REGISTRY[d.pop("@class")]
+        if d.get("learningRateSchedule"):
+            d["learningRateSchedule"] = ISchedule.fromJson(d["learningRateSchedule"])
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class Sgd(IUpdater):
+    def apply(self, grad, state, lr, iteration):
+        return lr * grad, state
+
+
+@dataclasses.dataclass
+class NoOp(IUpdater):
+    def apply(self, grad, state, lr, iteration):
+        return jnp.zeros_like(grad), state
+
+
+@dataclasses.dataclass
+class Adam(IUpdater):
+    learningRate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def stateSize(self, n):
+        return 2 * n
+
+    def apply(self, grad, state, lr, iteration):
+        t = iteration + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        a = lr * jnp.sqrt(1 - jnp.power(self.beta2, t)) / (1 - jnp.power(self.beta1, t))
+        return a * m / (jnp.sqrt(v) + self.epsilon), {"m": m, "v": v}
+
+
+@dataclasses.dataclass
+class AdamW(Adam):
+    """Decoupled weight decay Adam (not in the reference updater set, but a
+    standard modern companion; weight decay handled via regularization)."""
+
+
+@dataclasses.dataclass
+class AdaMax(Adam):
+    def apply(self, grad, state, lr, iteration):
+        t = iteration + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        u = jnp.maximum(self.beta2 * state["v"], jnp.abs(grad))
+        a = lr / (1 - jnp.power(self.beta1, t))
+        return a * m / (u + self.epsilon), {"m": m, "v": u}
+
+
+@dataclasses.dataclass
+class AMSGrad(Adam):
+    def init(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param),
+                "vHat": jnp.zeros_like(param)}
+
+    def stateSize(self, n):
+        return 3 * n
+
+    def apply(self, grad, state, lr, iteration):
+        t = iteration + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        vHat = jnp.maximum(state["vHat"], v)
+        a = lr * jnp.sqrt(1 - jnp.power(self.beta2, t)) / (1 - jnp.power(self.beta1, t))
+        return a * m / (jnp.sqrt(vHat) + self.epsilon), {"m": m, "v": v, "vHat": vHat}
+
+
+@dataclasses.dataclass
+class Nadam(Adam):
+    def apply(self, grad, state, lr, iteration):
+        t = iteration + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        mHat = m / (1 - jnp.power(self.beta1, t))
+        vHat = v / (1 - jnp.power(self.beta2, t))
+        mBar = self.beta1 * mHat + (1 - self.beta1) * grad / (1 - jnp.power(self.beta1, t))
+        return lr * mBar / (jnp.sqrt(vHat) + self.epsilon), {"m": m, "v": v}
+
+
+@dataclasses.dataclass
+class Nesterovs(IUpdater):
+    learningRate: float = 0.1
+    momentum: float = 0.9
+    momentumSchedule: Optional[ISchedule] = None
+
+    def init(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def stateSize(self, n):
+        return n
+
+    def apply(self, grad, state, lr, iteration):
+        mu = (self.momentumSchedule.valueAt(iteration, 0)
+              if self.momentumSchedule is not None else self.momentum)
+        # Matches reference NesterovsUpdater: v_new = mu*v - lr*g and the
+        # applied param delta is -mu*v_prev + (1+mu)*v_new; the caller
+        # SUBTRACTS the returned update, so negate.
+        vPrev = state["v"]
+        v = mu * vPrev - lr * grad
+        update = mu * vPrev - (1 + mu) * v
+        return update, {"v": v}
+
+    def toJson(self) -> dict:
+        d = IUpdater.toJson(self)
+        if self.momentumSchedule is not None:
+            d["momentumSchedule"] = self.momentumSchedule.toJson()
+        return d
+
+
+@dataclasses.dataclass
+class RmsProp(IUpdater):
+    learningRate: float = 1e-1
+    rmsDecay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, param):
+        return {"g": jnp.zeros_like(param)}
+
+    def stateSize(self, n):
+        return n
+
+    def apply(self, grad, state, lr, iteration):
+        g = self.rmsDecay * state["g"] + (1 - self.rmsDecay) * grad * grad
+        return lr * grad / (jnp.sqrt(g) + self.epsilon), {"g": g}
+
+
+@dataclasses.dataclass
+class AdaGrad(IUpdater):
+    learningRate: float = 1e-1
+    epsilon: float = 1e-6
+
+    def init(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def stateSize(self, n):
+        return n
+
+    def apply(self, grad, state, lr, iteration):
+        h = state["h"] + grad * grad
+        return lr * grad / (jnp.sqrt(h) + self.epsilon), {"h": h}
+
+
+@dataclasses.dataclass
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init(self, param):
+        return {"msg": jnp.zeros_like(param), "msdx": jnp.zeros_like(param)}
+
+    def stateSize(self, n):
+        return 2 * n
+
+    def apply(self, grad, state, lr, iteration):
+        msg = self.rho * state["msg"] + (1 - self.rho) * grad * grad
+        dx = grad * jnp.sqrt(state["msdx"] + self.epsilon) / jnp.sqrt(msg + self.epsilon)
+        msdx = self.rho * state["msdx"] + (1 - self.rho) * dx * dx
+        return dx, {"msg": msg, "msdx": msdx}
+
+
+_REGISTRY = {c.__name__: c for c in [
+    Sgd, NoOp, Adam, AdamW, AdaMax, AMSGrad, Nadam, Nesterovs, RmsProp,
+    AdaGrad, AdaDelta]}

@@ -1,0 +1,183 @@
+"""Learning-rate (and momentum) schedules.
+
+Reference: nd4j-api ``org/nd4j/linalg/schedule/*.java`` (``ISchedule`` and the
+Exponential/Inverse/Map/Poly/Sigmoid/Step/Cycle impls).
+
+``valueAt(iteration, epoch)`` must be jit-traceable: the whole train step —
+including the schedule — compiles into one XLA executable, so only jnp ops on
+the (possibly traced) iteration counter are allowed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+__all__ = ["ISchedule", "FixedSchedule", "ExponentialSchedule",
+           "InverseSchedule", "PolySchedule", "SigmoidSchedule",
+           "StepSchedule", "MapSchedule", "LinearSchedule", "CycleSchedule",
+           "ScheduleType"]
+
+
+class ScheduleType:
+    ITERATION = "ITERATION"
+    EPOCH = "EPOCH"
+
+
+@dataclasses.dataclass
+class ISchedule:
+    def valueAt(self, iteration, epoch):
+        raise NotImplementedError
+
+    def _t(self, iteration, epoch):
+        st = getattr(self, "scheduleType", ScheduleType.ITERATION)
+        return epoch if st == ScheduleType.EPOCH else iteration
+
+    def toJson(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def fromJson(d: dict) -> "ISchedule":
+        d = dict(d)
+        name = d.pop("@class")
+        if name == "MapSchedule":
+            return _REGISTRY[name](scheduleType=d["scheduleType"],
+                                   values={int(k): v for k, v in d["values"].items()})
+        return _REGISTRY[name](**d)
+
+
+@dataclasses.dataclass
+class FixedSchedule(ISchedule):
+    value: float
+
+    def valueAt(self, iteration, epoch):
+        return self.value
+
+
+@dataclasses.dataclass
+class ExponentialSchedule(ISchedule):
+    scheduleType: str
+    initialValue: float
+    gamma: float
+
+    def valueAt(self, iteration, epoch):
+        return self.initialValue * jnp.power(self.gamma, self._t(iteration, epoch))
+
+
+@dataclasses.dataclass
+class InverseSchedule(ISchedule):
+    scheduleType: str
+    initialValue: float
+    gamma: float
+    power: float
+
+    def valueAt(self, iteration, epoch):
+        return self.initialValue / jnp.power(
+            1.0 + self.gamma * self._t(iteration, epoch), self.power)
+
+
+@dataclasses.dataclass
+class PolySchedule(ISchedule):
+    scheduleType: str
+    initialValue: float
+    power: float
+    maxIter: int
+
+    def valueAt(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        frac = jnp.clip(t / self.maxIter, 0.0, 1.0)
+        return self.initialValue * jnp.power(1.0 - frac, self.power)
+
+
+@dataclasses.dataclass
+class SigmoidSchedule(ISchedule):
+    scheduleType: str
+    initialValue: float
+    gamma: float
+    stepSize: int
+
+    def valueAt(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initialValue / (
+            1.0 + jnp.exp(self.gamma * (t - self.stepSize)))
+
+
+@dataclasses.dataclass
+class StepSchedule(ISchedule):
+    scheduleType: str
+    initialValue: float
+    decayRate: float
+    step: float
+
+    def valueAt(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initialValue * jnp.power(self.decayRate,
+                                             jnp.floor(t / self.step))
+
+
+@dataclasses.dataclass
+class LinearSchedule(ISchedule):
+    scheduleType: str
+    initialValue: float
+    finalValue: float
+    maxIter: int
+
+    def valueAt(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        frac = jnp.clip(t / self.maxIter, 0.0, 1.0)
+        return self.initialValue + frac * (self.finalValue - self.initialValue)
+
+
+@dataclasses.dataclass
+class CycleSchedule(ISchedule):
+    """1-cycle policy (reference: ``CycleSchedule.java``)."""
+    scheduleType: str
+    initialLearningRate: float
+    maxLearningRate: float
+    cycleLength: int
+    annealingLength: int = 0
+    annealingDecay: float = 0.1
+
+    def valueAt(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        up = (self.cycleLength - self.annealingLength) // 2
+        pos = jnp.mod(t, self.cycleLength)
+        lr_up = self.initialLearningRate + (
+            self.maxLearningRate - self.initialLearningRate) * pos / jnp.maximum(up, 1)
+        lr_dn = self.maxLearningRate - (
+            self.maxLearningRate - self.initialLearningRate) * (pos - up) / jnp.maximum(up, 1)
+        lr_an = self.initialLearningRate * self.annealingDecay
+        return jnp.where(pos < up, lr_up, jnp.where(pos < 2 * up, lr_dn, lr_an))
+
+
+@dataclasses.dataclass
+class MapSchedule(ISchedule):
+    """Piecewise-constant values keyed by iteration/epoch (``MapSchedule.java``)."""
+    scheduleType: str
+    values: Dict[int, float]
+
+    def valueAt(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        keys = sorted(int(k) for k in self.values)
+        out = jnp.asarray(self.values[keys[0]], dtype=jnp.float32)
+        for k in keys:
+            out = jnp.where(t >= k, self.values[k], out)
+        return out
+
+    def toJson(self) -> dict:
+        return {"@class": "MapSchedule", "scheduleType": self.scheduleType,
+                "values": {str(k): v for k, v in self.values.items()}}
+
+
+_REGISTRY = {c.__name__: c for c in [
+    FixedSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+    SigmoidSchedule, StepSchedule, LinearSchedule, CycleSchedule]}
+_REGISTRY["MapSchedule"] = MapSchedule
+
+
+def _map_from_json(d):
+    return MapSchedule(scheduleType=d["scheduleType"],
+                       values={int(k): v for k, v in d["values"].items()})

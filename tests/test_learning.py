@@ -1,0 +1,168 @@
+"""T1 tests: updaters, schedules, regularization, gradient check.
+
+Modeled on the reference's UpdaterValidation / schedule tests
+(nd4j-tests org/nd4j/linalg/learning) and GradientCheckUtil usage.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import check_gradients
+from deeplearning4j_tpu.learning import (AMSGrad, AdaDelta, AdaGrad, AdaMax,
+                                         Adam, ExponentialSchedule,
+                                         FixedSchedule, ISchedule, IUpdater,
+                                         L1Regularization, L2Regularization,
+                                         MapSchedule, Nadam, Nesterovs, NoOp,
+                                         PolySchedule, RmsProp, ScheduleType,
+                                         Sgd, StepSchedule, WeightDecay)
+
+ALL_UPDATERS = [Sgd(0.1), Adam(0.01), AdaMax(0.01), AMSGrad(0.01),
+                Nadam(0.01), Nesterovs(0.1), RmsProp(0.01), AdaGrad(0.1),
+                AdaDelta(), NoOp()]
+
+
+class TestUpdaters:
+    @pytest.mark.parametrize("up", ALL_UPDATERS, ids=lambda u: type(u).__name__)
+    def test_descends_quadratic(self, up):
+        """Every updater must reduce f(w)=||w||^2 on repeated steps."""
+        w = jnp.array([1.0, -2.0, 3.0])
+        state = up.init(w)
+        f0 = float(jnp.sum(w * w))
+        for it in range(50):
+            grad = 2 * w
+            update, state = up.apply(grad, state, up.currentLr(it, 0), it)
+            w = w - update
+        f1 = float(jnp.sum(w * w))
+        if isinstance(up, NoOp):
+            assert f1 == f0
+        else:
+            assert f1 < f0 * 0.9
+
+    def test_sgd_exact(self):
+        up = Sgd(0.5)
+        update, _ = up.apply(jnp.array([2.0]), {}, 0.5, 0)
+        assert float(update[0]) == 1.0
+
+    def test_adam_matches_manual(self):
+        up = Adam(learningRate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8)
+        g = jnp.array([0.5])
+        state = up.init(g)
+        update, state = up.apply(g, state, 0.1, 0)
+        # step 1: m=0.05/..., bias-corrected exact value
+        m = 0.1 * 0.5
+        v = 0.001 * 0.25
+        a = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        expect = a * m / (np.sqrt(v) + 1e-8)
+        assert float(update[0]) == pytest.approx(expect, rel=1e-5)
+
+    def test_state_shapes(self):
+        p = jnp.zeros((3, 4))
+        assert Adam().init(p)["m"].shape == (3, 4)
+        assert AMSGrad().init(p)["vHat"].shape == (3, 4)
+        assert Adam().stateSize(12) == 24
+        assert Nesterovs().stateSize(12) == 12
+
+    def test_serde_roundtrip(self):
+        for up in [Adam(0.01), Nesterovs(0.1, momentum=0.8),
+                   Sgd(learningRate=0.2, learningRateSchedule=ExponentialSchedule(
+                       ScheduleType.ITERATION, 0.2, 0.99))]:
+            j = up.toJson()
+            back = IUpdater.fromJson(j)
+            assert type(back) is type(up)
+            assert back.learningRate == up.learningRate
+
+
+class TestSchedules:
+    def test_fixed(self):
+        assert FixedSchedule(0.1).valueAt(100, 5) == 0.1
+
+    def test_exponential(self):
+        s = ExponentialSchedule(ScheduleType.ITERATION, 1.0, 0.5)
+        assert float(s.valueAt(2, 0)) == pytest.approx(0.25)
+
+    def test_step(self):
+        s = StepSchedule(ScheduleType.ITERATION, 1.0, 0.1, 10)
+        assert float(s.valueAt(5, 0)) == pytest.approx(1.0)
+        assert float(s.valueAt(15, 0)) == pytest.approx(0.1)
+
+    def test_poly(self):
+        s = PolySchedule(ScheduleType.ITERATION, 1.0, 2.0, 100)
+        assert float(s.valueAt(0, 0)) == pytest.approx(1.0)
+        assert float(s.valueAt(100, 0)) == pytest.approx(0.0)
+
+    def test_map(self):
+        s = MapSchedule(ScheduleType.EPOCH, {0: 0.1, 10: 0.01, 20: 0.001})
+        assert float(s.valueAt(0, 5)) == pytest.approx(0.1)
+        assert float(s.valueAt(0, 15)) == pytest.approx(0.01)
+        assert float(s.valueAt(0, 25)) == pytest.approx(0.001)
+
+    def test_epoch_vs_iteration(self):
+        s = ExponentialSchedule(ScheduleType.EPOCH, 1.0, 0.5)
+        assert float(s.valueAt(99, 1)) == pytest.approx(0.5)
+
+    def test_schedule_serde(self):
+        s = MapSchedule(ScheduleType.EPOCH, {0: 0.1, 10: 0.01})
+        back = ISchedule.fromJson(s.toJson())
+        assert isinstance(back, MapSchedule)
+        assert back.values[10] == 0.01
+
+    def test_jit_traceable(self):
+        import jax
+        s = StepSchedule(ScheduleType.ITERATION, 1.0, 0.5, 10)
+        f = jax.jit(lambda it: s.valueAt(it, 0))
+        assert float(f(25)) == pytest.approx(0.25)
+
+
+class TestRegularization:
+    def test_l2_modifies_grad(self):
+        r = L2Regularization(0.1)
+        w, g = jnp.array([2.0]), jnp.array([1.0])
+        assert float(r.apply(w, g, 0.1)[0]) == pytest.approx(1.2)
+        assert float(r.score(w)) == pytest.approx(0.5 * 0.1 * 4.0)
+
+    def test_l1(self):
+        r = L1Regularization(0.1)
+        w, g = jnp.array([-2.0]), jnp.array([1.0])
+        assert float(r.apply(w, g, 0.1)[0]) == pytest.approx(0.9)
+
+    def test_weight_decay_post_updater(self):
+        r = WeightDecay(0.01, applyLR=True)
+        assert r.applyStep() == "POST_UPDATER"
+        w, u = jnp.array([1.0]), jnp.array([0.0])
+        assert float(r.apply(w, u, 0.5)[0]) == pytest.approx(0.005)
+
+
+class TestGradCheck:
+    def test_passes_on_smooth_fn(self):
+        params = {"w": jnp.array([1.0, 2.0]), "b": jnp.array([0.5])}
+        loss = lambda p: jnp.sum(jnp.tanh(p["w"]) ** 2) + p["b"][0] ** 2
+        res = check_gradients(loss, params)
+        assert res.passed, res.failures
+        assert res.totalParams == 3
+
+    def test_catches_wrong_gradient(self):
+        # a function whose jax.grad is fine, vs a deliberately broken loss
+        # pair: check that mismatched numeric/analytic is detected by
+        # comparing grad of f against numeric of g (construct via custom vjp)
+        import jax
+
+        @jax.custom_vjp
+        def broken(x):
+            return jnp.sum(x * x)
+
+        def fwd(x):
+            return jnp.sum(x * x), x
+
+        def bwd(x, ct):
+            return (ct * 3.0 * x,)  # wrong: should be 2x
+
+        broken.defvjp(fwd, bwd)
+        res = check_gradients(lambda p: broken(p["w"]), {"w": jnp.array([1.0, 2.0])})
+        assert not res.passed
+
+    def test_subset_sampling(self):
+        params = {"w": jnp.ones((10, 10))}
+        res = check_gradients(lambda p: jnp.sum(p["w"] ** 3), params,
+                              max_per_param=7)
+        assert res.totalParams == 7
+        assert res.passed
