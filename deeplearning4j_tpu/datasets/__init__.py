@@ -1,0 +1,8 @@
+"""DataSet / iterators / normalizers (reference: org/nd4j/linalg/dataset + deeplearning4j-data)."""
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
+    DataSetIterator, ExistingDataSetIterator, INDArrayDataSetIterator,
+    ListDataSetIterator)
+from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401

@@ -1,0 +1,131 @@
+"""DataSet / MultiDataSet containers.
+
+Reference: nd4j-api ``org/nd4j/linalg/dataset/{DataSet,MultiDataSet}.java`` —
+(features, labels, featuresMask, labelsMask) quadruple.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.ops import Nd4j, NDArray
+
+
+def _nd(x) -> Optional[NDArray]:
+    if x is None or isinstance(x, NDArray):
+        return x
+    return NDArray(x)
+
+
+class DataSet:
+    def __init__(self, features=None, labels=None,
+                 featuresMask=None, labelsMask=None):
+        self.features = _nd(features)
+        self.labels = _nd(labels)
+        self.featuresMask = _nd(featuresMask)
+        self.labelsMask = _nd(labelsMask)
+
+    # DL4J accessors
+    def getFeatures(self) -> NDArray:
+        return self.features
+
+    def getLabels(self) -> NDArray:
+        return self.labels
+
+    def getFeaturesMaskArray(self):
+        return self.featuresMask
+
+    def getLabelsMaskArray(self):
+        return self.labelsMask
+
+    def numExamples(self) -> int:
+        return self.features.shape[0] if self.features is not None else 0
+
+    def splitTestAndTrain(self, fractionOrCount):
+        n = self.numExamples()
+        k = int(fractionOrCount * n) if isinstance(fractionOrCount, float) \
+            else int(fractionOrCount)
+        f, l = self.features.numpy(), self.labels.numpy()
+        return SplitTestAndTrain(
+            DataSet(f[:k], l[:k]), DataSet(f[k:], l[k:]))
+
+    def shuffle(self, seed: Optional[int] = None):
+        n = self.numExamples()
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(n)
+        self.features = NDArray(self.features.numpy()[perm])
+        if self.labels is not None:
+            self.labels = NDArray(self.labels.numpy()[perm])
+
+    def batchBy(self, batchSize: int) -> List["DataSet"]:
+        n = self.numExamples()
+        out = []
+        f, l = self.features.numpy(), self.labels.numpy()
+        for i in range(0, n, batchSize):
+            out.append(DataSet(f[i:i + batchSize], l[i:i + batchSize]))
+        return out
+
+    def sample(self, n: int, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.RandomState(seed)
+        idx = rng.choice(self.numExamples(), size=n, replace=False)
+        return DataSet(self.features.numpy()[idx], self.labels.numpy()[idx])
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        f = np.concatenate([d.features.numpy() for d in datasets])
+        l = np.concatenate([d.labels.numpy() for d in datasets])
+        return DataSet(f, l)
+
+    def asList(self) -> List["DataSet"]:
+        return self.batchBy(1)
+
+    def save(self, path):
+        arrs = {"features": self.features.numpy()}
+        if self.labels is not None:
+            arrs["labels"] = self.labels.numpy()
+        if self.featuresMask is not None:
+            arrs["featuresMask"] = self.featuresMask.numpy()
+        if self.labelsMask is not None:
+            arrs["labelsMask"] = self.labelsMask.numpy()
+        np.savez(path, **arrs)
+
+    @staticmethod
+    def load(path) -> "DataSet":
+        with np.load(path, allow_pickle=False) as z:
+            return DataSet(z["features"],
+                           z["labels"] if "labels" in z.files else None,
+                           z["featuresMask"] if "featuresMask" in z.files else None,
+                           z["labelsMask"] if "labelsMask" in z.files else None)
+
+
+class SplitTestAndTrain:
+    def __init__(self, train: DataSet, test: DataSet):
+        self._train, self._test = train, test
+
+    def getTrain(self) -> DataSet:
+        return self._train
+
+    def getTest(self) -> DataSet:
+        return self._test
+
+
+class MultiDataSet:
+    """Reference: ``org/nd4j/linalg/dataset/MultiDataSet.java``."""
+
+    def __init__(self, features, labels, featuresMasks=None, labelsMasks=None):
+        as_list = lambda v: [_nd(x) for x in v] if isinstance(v, (list, tuple)) \
+            else [_nd(v)]
+        self.features = as_list(features)
+        self.labels = as_list(labels)
+        self.featuresMasks = [_nd(x) for x in featuresMasks] if featuresMasks else None
+        self.labelsMasks = [_nd(x) for x in labelsMasks] if labelsMasks else None
+
+    def getFeatures(self, i: Optional[int] = None):
+        return self.features if i is None else self.features[i]
+
+    def getLabels(self, i: Optional[int] = None):
+        return self.labels if i is None else self.labels[i]
+
+    def numExamples(self) -> int:
+        return self.features[0].shape[0]

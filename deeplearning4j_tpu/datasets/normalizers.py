@@ -1,0 +1,138 @@
+"""Data normalizers (fit/transform over iterators).
+
+Reference: nd4j-api ``org/nd4j/linalg/dataset/api/preprocessor/
+{NormalizerStandardize,NormalizerMinMaxScaler,ImagePreProcessingScaler}.java``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.ops import NDArray
+
+
+class DataNormalization:
+    def fit(self, data) -> None:
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> None:
+        raise NotImplementedError
+
+    def preProcess(self, ds: DataSet) -> None:
+        self.transform(ds)
+
+    def revert(self, ds: DataSet) -> None:
+        raise NotImplementedError
+
+    def _iterate(self, data):
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+        if isinstance(data, DataSet):
+            yield data
+        elif isinstance(data, DataSetIterator):
+            data.reset()
+            while data.hasNext():
+                yield data.next()
+            data.reset()
+        else:
+            yield from data
+
+
+class NormalizerStandardize(DataNormalization):
+    """Zero-mean unit-variance per feature column."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, data) -> None:
+        count, s, s2 = 0, None, None
+        for ds in self._iterate(data):
+            f = ds.features.numpy().astype(np.float64)
+            f2 = f.reshape(f.shape[0], -1)
+            if s is None:
+                s = f2.sum(axis=0)
+                s2 = (f2 ** 2).sum(axis=0)
+            else:
+                s += f2.sum(axis=0)
+                s2 += (f2 ** 2).sum(axis=0)
+            count += f2.shape[0]
+        self.mean = s / count
+        var = s2 / count - self.mean ** 2
+        self.std = np.sqrt(np.maximum(var, 1e-12))
+
+    def transform(self, ds: DataSet) -> None:
+        f = ds.features.numpy()
+        shp = f.shape
+        f2 = (f.reshape(shp[0], -1) - self.mean) / self.std
+        ds.features = NDArray(f2.reshape(shp).astype(f.dtype))
+
+    def revert(self, ds: DataSet) -> None:
+        f = ds.features.numpy()
+        shp = f.shape
+        f2 = f.reshape(shp[0], -1) * self.std + self.mean
+        ds.features = NDArray(f2.reshape(shp).astype(f.dtype))
+
+    def save(self, path):
+        np.savez(path, mean=self.mean, std=self.std, kind="standardize")
+
+    @staticmethod
+    def load(path) -> "NormalizerStandardize":
+        n = NormalizerStandardize()
+        with np.load(path, allow_pickle=False) as z:
+            n.mean, n.std = z["mean"], z["std"]
+        return n
+
+
+class NormalizerMinMaxScaler(DataNormalization):
+    def __init__(self, minRange: float = 0.0, maxRange: float = 1.0):
+        self.minRange, self.maxRange = minRange, maxRange
+        self.dataMin: Optional[np.ndarray] = None
+        self.dataMax: Optional[np.ndarray] = None
+
+    def fit(self, data) -> None:
+        lo, hi = None, None
+        for ds in self._iterate(data):
+            f = ds.features.numpy().reshape(ds.numExamples(), -1)
+            mn, mx = f.min(axis=0), f.max(axis=0)
+            lo = mn if lo is None else np.minimum(lo, mn)
+            hi = mx if hi is None else np.maximum(hi, mx)
+        self.dataMin, self.dataMax = lo, hi
+
+    def transform(self, ds: DataSet) -> None:
+        f = ds.features.numpy()
+        shp = f.shape
+        rng = np.maximum(self.dataMax - self.dataMin, 1e-12)
+        f2 = (f.reshape(shp[0], -1) - self.dataMin) / rng
+        f2 = f2 * (self.maxRange - self.minRange) + self.minRange
+        ds.features = NDArray(f2.reshape(shp).astype(f.dtype))
+
+    def revert(self, ds: DataSet) -> None:
+        f = ds.features.numpy()
+        shp = f.shape
+        rng = self.dataMax - self.dataMin
+        f2 = (f.reshape(shp[0], -1) - self.minRange) / (self.maxRange - self.minRange)
+        f2 = f2 * rng + self.dataMin
+        ds.features = NDArray(f2.reshape(shp).astype(f.dtype))
+
+
+class ImagePreProcessingScaler(DataNormalization):
+    """Scale pixel values [0, maxPixel] -> [minRange, maxRange]."""
+
+    def __init__(self, minRange: float = 0.0, maxRange: float = 1.0,
+                 maxPixelVal: float = 255.0):
+        self.minRange, self.maxRange, self.maxPixelVal = minRange, maxRange, maxPixelVal
+
+    def fit(self, data) -> None:
+        pass  # stateless
+
+    def transform(self, ds: DataSet) -> None:
+        f = ds.features.numpy().astype(np.float32)
+        f = f / self.maxPixelVal * (self.maxRange - self.minRange) + self.minRange
+        ds.features = NDArray(f)
+
+    def revert(self, ds: DataSet) -> None:
+        f = ds.features.numpy()
+        f = (f - self.minRange) / (self.maxRange - self.minRange) * self.maxPixelVal
+        ds.features = NDArray(f)

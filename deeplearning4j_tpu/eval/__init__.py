@@ -1,0 +1,3 @@
+"""Evaluation suite (reference: nd4j-api org/nd4j/evaluation)."""
+from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
+    Evaluation, EvaluationBinary, RegressionEvaluation, ROC, ROCMultiClass)

@@ -43,7 +43,8 @@ class IUpdater:
     def init(self, param) -> Dict[str, Any]:
         return {}
 
-    def apply(self, grad, state, lr, iteration) -> Tuple[Any, Dict[str, Any]]:
+    def apply(self, grad, state, lr, iteration, epoch=0
+              ) -> Tuple[Any, Dict[str, Any]]:
         raise NotImplementedError
 
     def stateSize(self, numParams: int) -> int:
@@ -62,20 +63,21 @@ class IUpdater:
     def fromJson(d: dict) -> "IUpdater":
         d = dict(d)
         cls = _REGISTRY[d.pop("@class")]
-        if d.get("learningRateSchedule"):
-            d["learningRateSchedule"] = ISchedule.fromJson(d["learningRateSchedule"])
+        for k in ("learningRateSchedule", "momentumSchedule"):
+            if d.get(k):
+                d[k] = ISchedule.fromJson(d[k])
         return cls(**d)
 
 
 @dataclasses.dataclass
 class Sgd(IUpdater):
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         return lr * grad, state
 
 
 @dataclasses.dataclass
 class NoOp(IUpdater):
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         return jnp.zeros_like(grad), state
 
 
@@ -92,7 +94,7 @@ class Adam(IUpdater):
     def stateSize(self, n):
         return 2 * n
 
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
@@ -102,13 +104,23 @@ class Adam(IUpdater):
 
 @dataclasses.dataclass
 class AdamW(Adam):
-    """Decoupled weight decay Adam (not in the reference updater set, but a
-    standard modern companion; weight decay handled via regularization)."""
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter).  Not in the
+    reference updater set, but a standard modern companion: the decay term
+    ``wd * lr * param`` is added to the update AFTER the Adam step, so the
+    caller needs to pass ``param`` via :meth:`applyWithParam` (the train step
+    does); plain ``apply`` behaves as Adam with no decay."""
+    weightDecay: float = 0.0
+
+    def applyWithParam(self, grad, state, lr, iteration, param, epoch=0):
+        update, new_state = Adam.apply(self, grad, state, lr, iteration, epoch)
+        if self.weightDecay:
+            update = update + self.weightDecay * lr * param
+        return update, new_state
 
 
 @dataclasses.dataclass
 class AdaMax(Adam):
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         u = jnp.maximum(self.beta2 * state["v"], jnp.abs(grad))
@@ -125,7 +137,7 @@ class AMSGrad(Adam):
     def stateSize(self, n):
         return 3 * n
 
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
@@ -136,7 +148,7 @@ class AMSGrad(Adam):
 
 @dataclasses.dataclass
 class Nadam(Adam):
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
@@ -158,8 +170,8 @@ class Nesterovs(IUpdater):
     def stateSize(self, n):
         return n
 
-    def apply(self, grad, state, lr, iteration):
-        mu = (self.momentumSchedule.valueAt(iteration, 0)
+    def apply(self, grad, state, lr, iteration, epoch=0):
+        mu = (self.momentumSchedule.valueAt(iteration, epoch)
               if self.momentumSchedule is not None else self.momentum)
         # Matches reference NesterovsUpdater: v_new = mu*v - lr*g and the
         # applied param delta is -mu*v_prev + (1+mu)*v_new; the caller
@@ -188,7 +200,7 @@ class RmsProp(IUpdater):
     def stateSize(self, n):
         return n
 
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         g = self.rmsDecay * state["g"] + (1 - self.rmsDecay) * grad * grad
         return lr * grad / (jnp.sqrt(g) + self.epsilon), {"g": g}
 
@@ -204,7 +216,7 @@ class AdaGrad(IUpdater):
     def stateSize(self, n):
         return n
 
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         h = state["h"] + grad * grad
         return lr * grad / (jnp.sqrt(h) + self.epsilon), {"h": h}
 
@@ -220,7 +232,7 @@ class AdaDelta(IUpdater):
     def stateSize(self, n):
         return 2 * n
 
-    def apply(self, grad, state, lr, iteration):
+    def apply(self, grad, state, lr, iteration, epoch=0):
         msg = self.rho * state["msg"] + (1 - self.rho) * grad * grad
         dx = grad * jnp.sqrt(state["msdx"] + self.epsilon) / jnp.sqrt(msg + self.epsilon)
         msdx = self.rho * state["msdx"] + (1 - self.rho) * dx * dx

@@ -143,14 +143,16 @@ class CycleSchedule(ISchedule):
 
     def valueAt(self, iteration, epoch):
         t = self._t(iteration, epoch)
-        up = (self.cycleLength - self.annealingLength) // 2
+        cycle = self.cycleLength - self.annealingLength
+        up = cycle // 2
+        down = cycle - up  # odd cycle lengths: down phase gets the extra step
         pos = jnp.mod(t, self.cycleLength)
         lr_up = self.initialLearningRate + (
             self.maxLearningRate - self.initialLearningRate) * pos / jnp.maximum(up, 1)
         lr_dn = self.maxLearningRate - (
-            self.maxLearningRate - self.initialLearningRate) * (pos - up) / jnp.maximum(up, 1)
+            self.maxLearningRate - self.initialLearningRate) * (pos - up) / jnp.maximum(down, 1)
         lr_an = self.initialLearningRate * self.annealingDecay
-        return jnp.where(pos < up, lr_up, jnp.where(pos < 2 * up, lr_dn, lr_an))
+        return jnp.where(pos < up, lr_up, jnp.where(pos < cycle, lr_dn, lr_an))
 
 
 @dataclasses.dataclass
@@ -176,8 +178,3 @@ _REGISTRY = {c.__name__: c for c in [
     FixedSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
     SigmoidSchedule, StepSchedule, LinearSchedule, CycleSchedule]}
 _REGISTRY["MapSchedule"] = MapSchedule
-
-
-def _map_from_json(d):
-    return MapSchedule(scheduleType=d["scheduleType"],
-                       values={int(k): v for k, v in d["values"].items()})

@@ -1,0 +1,2 @@
+"""Model classes: MultiLayerNetwork, ComputationGraph, zoo."""
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork  # noqa: F401

@@ -1,0 +1,417 @@
+"""MultiLayerNetwork — linear-stack model.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/multilayer/
+MultiLayerNetwork.java`` (fit/output/evaluate/score, flattened param views,
+per-iteration Solver/updater orchestration — SURVEY.md §3.1).
+
+TPU-first design: where the reference dispatches every op across JNI and
+mutates a flat param view in place, this model compiles ONE fused XLA
+executable per (shape, mode): forward + loss + backward (``jax.value_and_grad``)
++ gradient normalization + updater + regularization, with params/opt-state
+buffers donated.  That single-executable train step IS the north-star design
+replacing op-by-op dispatch (SURVEY.md §3.1, §7.1).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.eval.evaluation import (Evaluation,
+                                                RegressionEvaluation, ROC)
+from deeplearning4j_tpu.learning.config import Sgd
+from deeplearning4j_tpu.learning.regularization import WeightDecay
+from deeplearning4j_tpu.nn.conf import (GradientNormalization,
+                                        MultiLayerConfiguration)
+from deeplearning4j_tpu.ops import NDArray
+
+Params = Dict[str, Dict[str, jax.Array]]
+
+#: canonical intra-layer param order (serialization parity: W before b,
+#: matching DL4J's flattened-view layout; BN adds gamma/beta)
+_PARAM_ORDER = ["W", "b", "gamma", "beta", "Wi", "Wr", "bi",
+                "Wf", "Wo", "Wg", "Wx", "Wh"]
+
+
+def _param_key_order(keys):
+    known = [k for k in _PARAM_ORDER if k in keys]
+    rest = sorted(k for k in keys if k not in _PARAM_ORDER)
+    return known + rest
+
+
+def _grad_normalize(layer, g: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Per-layer gradient normalization (reference:
+    ``BaseMultiLayerUpdater.preApply``)."""
+    mode = getattr(layer, "gradientNormalization", None)
+    if not mode or mode == GradientNormalization.None_:
+        return g
+    thr = getattr(layer, "gradientNormalizationThreshold", None) or 1.0
+    if mode == GradientNormalization.RenormalizeL2PerLayer:
+        norm = jnp.sqrt(sum(jnp.sum(v * v) for v in g.values()) + 1e-12)
+        return {k: v / norm for k, v in g.items()}
+    if mode == GradientNormalization.RenormalizeL2PerParamType:
+        return {k: v / jnp.sqrt(jnp.sum(v * v) + 1e-12) for k, v in g.items()}
+    if mode == GradientNormalization.ClipElementWiseAbsoluteValue:
+        return {k: jnp.clip(v, -thr, thr) for k, v in g.items()}
+    if mode == GradientNormalization.ClipL2PerLayer:
+        norm = jnp.sqrt(sum(jnp.sum(v * v) for v in g.values()) + 1e-12)
+        scale = jnp.minimum(1.0, thr / norm)
+        return {k: v * scale for k, v in g.items()}
+    if mode == GradientNormalization.ClipL2PerParamType:
+        out = {}
+        for k, v in g.items():
+            norm = jnp.sqrt(jnp.sum(v * v) + 1e-12)
+            out[k] = v * jnp.minimum(1.0, thr / norm)
+        return out
+    raise ValueError(f"Unknown gradient normalization {mode}")
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params_: Optional[Params] = None
+        self.state_: Dict[str, Dict[str, jax.Array]] = {}
+        self.optState_: Optional[Dict] = None
+        self.iterationCount = 0
+        self.epochCount = 0
+        self.lastBatchSize = 0
+        self._score = 0.0
+        self._listeners: List = []
+        self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
+        self._dtype = jnp.float32
+        self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x5EED)
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def init(self, params: Optional[Params] = None) -> "MultiLayerNetwork":
+        if params is not None:
+            self.params_ = params
+        else:
+            root = jax.random.PRNGKey(self._rngSeed)
+            self.params_ = {}
+            for i, layer in enumerate(self.conf.layers):
+                it = self.conf.layerInputTypes[i]
+                key = jax.random.fold_in(root, i)
+                p = layer.initParams(key, it, self._dtype)
+                if p:
+                    self.params_[str(i)] = p
+        self.state_ = {}
+        for i, layer in enumerate(self.conf.layers):
+            if hasattr(layer, "initState"):
+                self.state_[str(i)] = layer.initState(
+                    self.conf.layerInputTypes[i], self._dtype)
+        self._initOptState()
+        return self
+
+    def _initOptState(self) -> None:
+        self.optState_ = {}
+        for i, layer in enumerate(self.conf.layers):
+            li = str(i)
+            if li not in (self.params_ or {}):
+                continue
+            self.optState_[li] = {}
+            for pname, pval in self.params_[li].items():
+                up = self._updaterFor(layer, pname)
+                self.optState_[li][pname] = up.init(pval)
+
+    def _updaterFor(self, layer, pname: str):
+        if pname == "b" and getattr(layer, "biasUpdater", None) is not None:
+            return layer.biasUpdater
+        return getattr(layer, "updater", None) or \
+            self.conf.globalConf.get("updater") or Sgd(1e-2)
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def _forward(self, params: Params, state, x, train: bool, key, mask=None):
+        miniBatch = x.shape[0]
+        new_state = {}
+        for i, layer in enumerate(self.conf.layers):
+            if i in self.conf.preProcessors:
+                x = self.conf.preProcessors[i].preProcess(x, miniBatch)
+            lkey = jax.random.fold_in(key, i) if key is not None else None
+            st = state.get(str(i), {})
+            p = params.get(str(i), {})
+            if type(layer).__name__ == "GlobalPoolingLayer" and mask is not None:
+                x, st2 = layer.forward(p, x, train, lkey, st, mask=mask)
+            else:
+                x, st2 = layer.forward(p, x, train, lkey, st)
+            if st2:
+                new_state[str(i)] = st2
+        return x, new_state
+
+    def _regScore(self, params: Params):
+        """L1/L2 penalty added to the loss (equivalent gradient to the
+        reference's BEFORE_UPDATER gradient modification)."""
+        total = 0.0
+        for i, layer in enumerate(self.conf.layers):
+            li = str(i)
+            if li not in params:
+                continue
+            l1 = getattr(layer, "l1", None)
+            l2 = getattr(layer, "l2", None)
+            if not l1 and not l2:
+                continue
+            for k in layer.weightParamKeys():
+                if k in params[li]:
+                    w = params[li][k]
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(w * w)
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(w))
+        return total
+
+    def _lossFn(self, params: Params, state, x, y, mask, key):
+        out, new_state = self._forward(params, state, x, True, key, mask)
+        outLayer = self.conf.layers[-1]
+        if not outLayer.hasLoss():
+            raise ValueError("Last layer must be an output/loss layer to fit")
+        per_ex = outLayer.computeScore(y, out, mask)
+        data_loss = jnp.mean(per_ex)
+        return data_loss + self._regScore(params), (new_state, data_loss)
+
+    # ------------------------------------------------------------------
+    # the fused train step (single XLA executable)
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _trainStep(self):
+        layers = self.conf.layers
+
+        def step(params, optState, state, x, y, mask, key, iteration, epoch):
+            grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
+            (loss, (new_state, data_loss)), grads = grad_fn(
+                params, state, x, y, mask, key)
+            new_params: Params = {}
+            new_opt: Dict = {}
+            for i, layer in enumerate(layers):
+                li = str(i)
+                if li not in params:
+                    continue
+                g = _grad_normalize(layer, grads[li])
+                new_params[li] = {}
+                new_opt[li] = {}
+                for pname, pval in params[li].items():
+                    up = self._updaterFor(layer, pname)
+                    lr = up.currentLr(iteration, epoch)
+                    update, ostate = up.apply(g[pname], optState[li][pname],
+                                              lr, iteration, epoch)
+                    wd = getattr(layer, "weightDecay", None)
+                    if wd and pname in layer.weightParamKeys():
+                        update = WeightDecay(coeff=wd).apply(pval, update, lr)
+                    new_params[li][pname] = pval - update
+                    new_opt[li][pname] = ostate
+            return new_params, new_opt, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _outputFn(self):
+        def run(params, state, x):
+            out, _ = self._forward(params, state, x, False, None)
+            return out
+        return jax.jit(run)
+
+    @functools.cached_property
+    def _scoreFn(self):
+        def run(params, state, x, y, mask):
+            out, _ = self._forward(params, state, x, False, None, mask)
+            per_ex = self.conf.layers[-1].computeScore(y, out, mask)
+            return jnp.mean(per_ex) + self._regScore(params)
+        return jax.jit(run)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1) -> None:
+        if self.params_ is None:
+            self.init()
+        if isinstance(data, DataSet):
+            self._fitBatch(data)
+        elif isinstance(data, DataSetIterator):
+            for _ in range(epochs):
+                self._fitEpoch(data)
+        elif labels is not None:
+            self._fitBatch(DataSet(data, labels))
+        else:
+            raise TypeError(f"Cannot fit on {type(data)}")
+
+    def _fitEpoch(self, it: DataSetIterator) -> None:
+        for l in self._listeners:
+            l.onEpochStart(self)
+        it.reset()
+        while it.hasNext():
+            self._fitBatch(it.next())
+        self.epochCount += 1
+        for l in self._listeners:
+            l.onEpochEnd(self)
+
+    def _fitBatch(self, ds: DataSet) -> None:
+        x = ds.features.jax.astype(self._dtype)
+        y = ds.labels.jax
+        mask = ds.labelsMask.jax if ds.labelsMask is not None else None
+        self.lastBatchSize = int(x.shape[0])
+        self._fitKey, key = jax.random.split(self._fitKey)
+        self.params_, self.optState_, new_state, loss = self._trainStep(
+            self.params_, self.optState_, self.state_, x, y, mask, key,
+            jnp.asarray(self.iterationCount), jnp.asarray(self.epochCount))
+        if new_state:
+            self.state_.update(new_state)
+        self._score = float(loss)
+        self.iterationCount += 1
+        for l in self._listeners:
+            l.iterationDone(self, self.iterationCount, self.epochCount)
+
+    def output(self, x, train: bool = False) -> NDArray:
+        xv = x.jax if isinstance(x, NDArray) else jnp.asarray(x)
+        return NDArray(self._outputFn(self.params_, self.state_,
+                                      xv.astype(self._dtype)))
+
+    def feedForward(self, x) -> List[NDArray]:
+        """All layer activations (inference mode)."""
+        xv = x.jax if isinstance(x, NDArray) else jnp.asarray(x)
+        acts = [NDArray(xv)]
+        cur = xv.astype(self._dtype)
+        for i, layer in enumerate(self.conf.layers):
+            if i in self.conf.preProcessors:
+                cur = self.conf.preProcessors[i].preProcess(cur, cur.shape[0])
+            cur, _ = layer.forward(self.params_.get(str(i), {}), cur, False,
+                                   None, self.state_.get(str(i), {}))
+            acts.append(NDArray(cur))
+        return acts
+
+    def predict(self, x) -> np.ndarray:
+        return np.asarray(jnp.argmax(self.output(x).jax, axis=-1))
+
+    def score(self, ds: Optional[DataSet] = None) -> float:
+        if ds is None:
+            return self._score
+        mask = ds.labelsMask.jax if ds.labelsMask is not None else None
+        return float(self._scoreFn(self.params_, self.state_,
+                                   ds.features.jax.astype(self._dtype),
+                                   ds.labels.jax, mask))
+
+    def evaluate(self, it: DataSetIterator, metric: str = "classification"):
+        ev = {"classification": Evaluation, "regression": RegressionEvaluation,
+              "roc": ROC}[metric]()
+        it.reset()
+        while it.hasNext():
+            ds = it.next()
+            out = self.output(ds.features)
+            ev.eval(ds.labels.numpy(), out.numpy(),
+                    ds.labelsMask.numpy() if ds.labelsMask is not None else None)
+        it.reset()
+        return ev
+
+    def evaluateROC(self, it: DataSetIterator) -> ROC:
+        return self.evaluate(it, metric="roc")
+
+    def evaluateRegression(self, it: DataSetIterator) -> RegressionEvaluation:
+        return self.evaluate(it, metric="regression")
+
+    # -- listeners -------------------------------------------------------
+    def setListeners(self, *listeners) -> None:
+        if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
+            listeners = tuple(listeners[0])
+        self._listeners = list(listeners)
+
+    def addListeners(self, *listeners) -> None:
+        self._listeners.extend(listeners)
+
+    def getListeners(self) -> List:
+        return self._listeners
+
+    # -- params ----------------------------------------------------------
+    def params(self) -> NDArray:
+        """Single flattened param vector (reference: ``paramsFlattened``)."""
+        chunks = []
+        for i in range(len(self.conf.layers)):
+            li = str(i)
+            if li in self.params_:
+                for k in _param_key_order(self.params_[li].keys()):
+                    chunks.append(np.asarray(self.params_[li][k]).ravel())
+        if not chunks:
+            return NDArray(jnp.zeros((0,)))
+        return NDArray(np.concatenate(chunks))
+
+    def setParams(self, flat) -> None:
+        vec = np.asarray(flat.numpy() if isinstance(flat, NDArray) else flat).ravel()
+        pos = 0
+        for i in range(len(self.conf.layers)):
+            li = str(i)
+            if li in self.params_:
+                for k in _param_key_order(self.params_[li].keys()):
+                    cur = self.params_[li][k]
+                    n = int(np.prod(cur.shape))
+                    self.params_[li][k] = jnp.asarray(
+                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype)
+                    pos += n
+        if pos != vec.size:
+            raise ValueError(f"Param vector length {vec.size} != model {pos}")
+
+    def numParams(self) -> int:
+        if self.params_ is None:
+            return 0
+        return int(sum(int(np.prod(v.shape))
+                       for lp in self.params_.values() for v in lp.values()))
+
+    def paramTable(self) -> Dict[str, NDArray]:
+        out = {}
+        for li, lp in self.params_.items():
+            for k, v in lp.items():
+                out[f"{li}_{k}"] = NDArray(v)
+        return out
+
+    def getParam(self, key: str) -> NDArray:
+        li, k = key.split("_", 1)
+        return NDArray(self.params_[li][k])
+
+    def setParam(self, key: str, value) -> None:
+        li, k = key.split("_", 1)
+        v = value.jax if isinstance(value, NDArray) else jnp.asarray(value)
+        self.params_[li][k] = v.astype(self.params_[li][k].dtype)
+
+    # -- bookkeeping ----------------------------------------------------
+    def getEpochCount(self) -> int:
+        return self.epochCount
+
+    def getIterationCount(self) -> int:
+        return self.iterationCount
+
+    def getLayerWiseConfigurations(self) -> MultiLayerConfiguration:
+        return self.conf
+
+    def getnLayers(self) -> int:
+        return len(self.conf.layers)
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        net = MultiLayerNetwork(self.conf)
+        net.params_ = jax.tree_util.tree_map(lambda v: v, self.params_)
+        net.state_ = jax.tree_util.tree_map(lambda v: v, self.state_)
+        net._initOptState()
+        net.optState_ = copy.deepcopy(
+            jax.tree_util.tree_map(lambda v: v, self.optState_))
+        return net
+
+    def summary(self) -> str:
+        lines = [f"{'idx':<4} {'layer':<28} {'params':>10} {'in -> out'}"]
+        total = 0
+        for i, layer in enumerate(self.conf.layers):
+            li = str(i)
+            n = sum(int(np.prod(v.shape))
+                    for v in self.params_.get(li, {}).values()) \
+                if self.params_ else 0
+            total += n
+            it = self.conf.layerInputTypes[i]
+            ot = layer.getOutputType(it) if it else None
+            lines.append(f"{i:<4} {type(layer).__name__:<28} {n:>10} "
+                         f"{it.getShape() if it else '?'} -> "
+                         f"{ot.getShape() if ot else '?'}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

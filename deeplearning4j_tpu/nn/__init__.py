@@ -1,0 +1,5 @@
+"""Neural-network framework layer (reference: deeplearning4j-nn)."""
+from deeplearning4j_tpu.nn.activations import Activation, get_activation  # noqa: F401
+from deeplearning4j_tpu.nn.lossfunctions import (LossFunction,  # noqa: F401
+                                                 LossFunctions, get_loss)
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weight  # noqa: F401

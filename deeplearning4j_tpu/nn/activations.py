@@ -1,0 +1,108 @@
+"""Activation functions.
+
+Reference: nd4j-api ``org/nd4j/linalg/activations/**`` (``IActivation`` impls
+and the ``Activation`` enum).  Forward-only here — backprop comes from
+``jax.grad`` of the whole step, so the reference's fused-backprop variants
+(``IActivation.backprop``) are unnecessary.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Activation", "get_activation"]
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _rationaltanh(x):
+    # DL4J RationalTanh: 1.7159 * tanh(2x/3) approximation family
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _selu(x):
+    return jax.nn.selu(x)
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def _threshrelu(x):
+    return jnp.where(x > 1.0, x, 0.0)
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": _selu,
+    "gelu": _gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.silu,
+    "mish": _mish,
+    "cube": _cube,
+    "thresholdedrelu": _threshrelu,
+}
+
+
+class Activation:
+    """Enum-style accessors (``Activation.RELU`` etc.)."""
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    TANH = "tanh"
+    HARDTANH = "hardtanh"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    MISH = "mish"
+    CUBE = "cube"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+
+def get_activation(name) -> Callable:
+    if callable(name):
+        return name
+    key = str(name).lower().replace("_", "")
+    try:
+        return _REGISTRY[key]
+    except KeyError:
+        raise ValueError(f"Unknown activation: {name!r}. "
+                         f"Available: {sorted(_REGISTRY)}")

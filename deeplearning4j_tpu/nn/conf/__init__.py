@@ -1,0 +1,251 @@
+"""Declarative network configuration DSL.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/
+{NeuralNetConfiguration,MultiLayerConfiguration}.java`` — fluent builders,
+global defaults flowing into per-layer confs, InputType-driven nIn inference
+and automatic preprocessor insertion, JSON round-trip (the serialized conf IS
+the checkpoint's ``configuration.json``, SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.learning.config import IUpdater, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (Layer, layer_from_json)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor, CnnToRnnPreProcessor,
+    FeedForwardToCnnPreProcessor, FeedForwardToRnnPreProcessor,
+    InputPreProcessor, RnnToCnnPreProcessor, RnnToFeedForwardPreProcessor)
+
+__all__ = ["NeuralNetConfiguration", "MultiLayerConfiguration",
+           "GradientNormalization", "BackpropType", "InputType",
+           "WorkspaceMode"]
+
+
+class GradientNormalization:
+    None_ = "None"
+    RenormalizeL2PerLayer = "RenormalizeL2PerLayer"
+    RenormalizeL2PerParamType = "RenormalizeL2PerParamType"
+    ClipElementWiseAbsoluteValue = "ClipElementWiseAbsoluteValue"
+    ClipL2PerLayer = "ClipL2PerLayer"
+    ClipL2PerParamType = "ClipL2PerParamType"
+
+
+class BackpropType:
+    Standard = "Standard"
+    TruncatedBPTT = "TruncatedBPTT"
+
+
+class WorkspaceMode:
+    """Accepted for parity; XLA owns buffers so this is a no-op
+    (SURVEY.md §7.1 'Workspaces → obsolete under XLA')."""
+    ENABLED = "ENABLED"
+    NONE = "NONE"
+    SINGLE = "SINGLE"
+
+
+_GLOBAL_KEYS = ["seed", "updater", "biasUpdater", "weightInit", "activation",
+                "l1", "l2", "weightDecay", "biasInit", "dropOut",
+                "convolutionMode", "gradientNormalization",
+                "gradientNormalizationThreshold", "miniBatch", "dataType",
+                "optimizationAlgo", "trainingWorkspaceMode",
+                "inferenceWorkspaceMode", "cacheMode", "cudnnAlgoMode",
+                "maxNumLineSearchIterations"]
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()`` (DL4J:
+    ``new NeuralNetConfiguration.Builder()``)."""
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration.Builder":
+        return NeuralNetConfiguration.Builder()
+
+    class Builder:
+        def __init__(self):
+            self._g: Dict[str, Any] = {"seed": 123, "updater": Sgd(1e-2)}
+
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+            if name not in _GLOBAL_KEYS:
+                raise AttributeError(
+                    f"Unknown global config option {name!r}; known: {_GLOBAL_KEYS}")
+
+            def setter(*args):
+                self._g[name] = args[0] if len(args) == 1 else tuple(args)
+                return self
+
+            return setter
+
+        def list(self) -> "ListBuilder":
+            return ListBuilder(dict(self._g))
+
+        def graphBuilder(self):
+            from deeplearning4j_tpu.models.graph_conf import GraphBuilder
+            return GraphBuilder(dict(self._g))
+
+
+class ListBuilder:
+    """DL4J ``NeuralNetConfiguration.ListBuilder``."""
+
+    def __init__(self, global_conf: Dict[str, Any]):
+        self._g = global_conf
+        self._layers: List[Layer] = []
+        self._inputType: Optional[InputType] = None
+        self._preprocs: Dict[int, InputPreProcessor] = {}
+        self._backpropType = BackpropType.Standard
+        self._tbpttFwd = 20
+        self._tbpttBack = 20
+        self._validate = True
+
+    def layer(self, idx_or_layer, maybe_layer: Optional[Layer] = None):
+        self._layers.append(maybe_layer if maybe_layer is not None else idx_or_layer)
+        return self
+
+    def setInputType(self, it: InputType):
+        self._inputType = it
+        return self
+
+    def inputPreProcessor(self, idx: int, p: InputPreProcessor):
+        self._preprocs[int(idx)] = p
+        return self
+
+    def backpropType(self, bt: str):
+        self._backpropType = bt
+        return self
+
+    def tBPTTForwardLength(self, n: int):
+        self._tbpttFwd = int(n)
+        return self
+
+    def tBPTTBackwardLength(self, n: int):
+        self._tbpttBack = int(n)
+        return self
+
+    def tBPTTLength(self, n: int):
+        self._tbpttFwd = self._tbpttBack = int(n)
+        return self
+
+    def validateOutputLayerConfig(self, v: bool):
+        self._validate = bool(v)
+        return self
+
+    def build(self) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=self._layers, globalConf=self._g, inputType=self._inputType,
+            preProcessors=dict(self._preprocs),
+            backpropType=self._backpropType, tbpttFwdLength=self._tbpttFwd,
+            tbpttBackLength=self._tbpttBack)
+
+
+def _auto_preprocessor(cur: InputType, want: Optional[str]
+                       ) -> Optional[InputPreProcessor]:
+    """DL4J ``InputType.getPreProcessorForInputType`` logic."""
+    if want is None:
+        return None
+    k = cur.kind
+    if want == "FF":
+        if k == "CNN":
+            return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        if k == "RNN":
+            return RnnToFeedForwardPreProcessor()
+    elif want == "CNN":
+        if k == "CNNFlat":
+            return FeedForwardToCnnPreProcessor(cur.height, cur.width, cur.channels)
+        if k == "FF":
+            raise ValueError("Cannot infer CNN input from FF input type; "
+                             "set an explicit preprocessor")
+    elif want == "RNN":
+        if k == "FF":
+            return FeedForwardToRnnPreProcessor()
+        if k == "CNN":
+            return CnnToRnnPreProcessor(cur.height, cur.width, cur.channels)
+    return None
+
+
+class MultiLayerConfiguration:
+    """Reference: ``MultiLayerConfiguration.java``."""
+
+    def __init__(self, layers: List[Layer], globalConf: Dict[str, Any],
+                 inputType: Optional[InputType] = None,
+                 preProcessors: Optional[Dict[int, InputPreProcessor]] = None,
+                 backpropType: str = BackpropType.Standard,
+                 tbpttFwdLength: int = 20, tbpttBackLength: int = 20):
+        self.layers = layers
+        self.globalConf = globalConf
+        self.inputType = inputType
+        self.preProcessors = preProcessors or {}
+        self.backpropType = backpropType
+        self.tbpttFwdLength = tbpttFwdLength
+        self.tbpttBackLength = tbpttBackLength
+        self.layerInputTypes: List[InputType] = []
+        self._resolve()
+
+    def _resolve(self) -> None:
+        """Apply global defaults, insert preprocessors, infer nIn per layer."""
+        cur = self.inputType
+        for i, layer in enumerate(self.layers):
+            layer.applyGlobalDefaults(self.globalConf)
+            if layer.name is None:
+                layer.name = f"layer{i}"
+            if cur is not None:
+                if i not in self.preProcessors:
+                    p = _auto_preprocessor(cur, layer.preferredFormat())
+                    if p is not None:
+                        self.preProcessors[i] = p
+                if i in self.preProcessors:
+                    cur = self.preProcessors[i].getOutputType(cur)
+                layer.inferNIn(cur)
+                self.layerInputTypes.append(cur)
+                cur = layer.getOutputType(cur)
+            else:
+                self.layerInputTypes.append(None)
+
+    # -- serde -----------------------------------------------------------
+    def toJson(self) -> str:
+        g = {}
+        for k, v in self.globalConf.items():
+            g[k] = v.toJson() if isinstance(v, IUpdater) else v
+        return json.dumps({
+            "globalConf": g,
+            "layers": [l.toJson() for l in self.layers],
+            "inputType": self.inputType.toJson() if self.inputType else None,
+            "preProcessors": {str(k): v.toJson()
+                              for k, v in self.preProcessors.items()},
+            "backpropType": self.backpropType,
+            "tbpttFwdLength": self.tbpttFwdLength,
+            "tbpttBackLength": self.tbpttBackLength,
+        }, indent=2, default=_json_default)
+
+    @staticmethod
+    def fromJson(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        g = dict(d["globalConf"])
+        if isinstance(g.get("updater"), dict):
+            g["updater"] = IUpdater.fromJson(g["updater"])
+        if isinstance(g.get("biasUpdater"), dict):
+            g["biasUpdater"] = IUpdater.fromJson(g["biasUpdater"])
+        layers = [layer_from_json(ld) for ld in d["layers"]]
+        it = InputType.fromJson(d["inputType"]) if d.get("inputType") else None
+        pps = {int(k): InputPreProcessor.fromJson(v)
+               for k, v in (d.get("preProcessors") or {}).items()}
+        return MultiLayerConfiguration(
+            layers=layers, globalConf=g, inputType=it, preProcessors=pps,
+            backpropType=d.get("backpropType", BackpropType.Standard),
+            tbpttFwdLength=d.get("tbpttFwdLength", 20),
+            tbpttBackLength=d.get("tbpttBackLength", 20))
+
+    def __len__(self):
+        return len(self.layers)
+
+
+def _json_default(o):
+    if hasattr(o, "toJson"):
+        return o.toJson()
+    if dataclasses.is_dataclass(o):
+        return dataclasses.asdict(o)
+    return str(o)

@@ -1,0 +1,634 @@
+"""Layer configurations + their functional forward implementations.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/layers/*.java``
+(config side) and ``org/deeplearning4j/nn/layers/**`` (imperative
+``activate``/``backpropGradient`` impls).
+
+TPU-first design: instead of the reference's per-layer imperative
+forward/backward pair, each layer config carries a pure ``forward`` —
+``jax.grad`` of the composed network provides backprop, and the whole
+network (fwd + bwd + updater) compiles to ONE XLA executable (SURVEY.md §3.1
+north star).  Convs lower to ``lax.conv_general_dilated`` (MXU), pooling to
+``lax.reduce_window``; there is no cuDNN/oneDNN helper SPI because XLA owns
+fusion (SURVEY.md §7.1).
+
+Data formats (DL4J conventions): FF ``(b, n)``; CNN ``(b, c, h, w)``;
+RNN ``(b, n, t)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.learning.config import IUpdater
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.lossfunctions import get_loss
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["Layer", "BaseLayer", "DenseLayer", "ConvolutionLayer",
+           "Convolution2D", "SubsamplingLayer", "BatchNormalization",
+           "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
+           "EmbeddingSequenceLayer", "GlobalPoolingLayer",
+           "LocalResponseNormalization", "OutputLayer", "LossLayer",
+           "PoolingType", "ConvolutionMode", "layer_from_json"]
+
+
+class ConvolutionMode:
+    Strict = "Strict"
+    Truncate = "Truncate"
+    Same = "Same"
+
+
+class PoolingType:
+    MAX = "MAX"
+    AVG = "AVG"
+    SUM = "SUM"
+    PNORM = "PNORM"
+
+
+class _Builder:
+    """Generic fluent builder: any method call sets the same-named field."""
+
+    def __init__(self, cls, **kw):
+        self._cls = cls
+        self._kw = kw
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def setter(*args):
+            if len(args) == 1:
+                self._kw[name] = args[0]
+            else:
+                self._kw[name] = tuple(args)
+            return self
+
+        return setter
+
+    def build(self):
+        fields = {f.name for f in dataclasses.fields(self._cls)}
+        unknown = set(self._kw) - fields
+        if unknown:
+            raise ValueError(f"{self._cls.__name__}: unknown config "
+                             f"option(s) {sorted(unknown)}")
+        return self._cls(**self._kw)
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config (reference: ``conf/layers/Layer.java``)."""
+    name: Optional[str] = None
+
+    # -- builder --------------------------------------------------------
+    @classmethod
+    def builder(cls, *args, **kw):
+        b = _Builder(cls, **kw)
+        if args:  # e.g. OutputLayer.builder("mcxent")
+            cls._builderArgs(b, *args)
+        return b
+
+    @classmethod
+    def _builderArgs(cls, b, *args):
+        raise TypeError(f"{cls.__name__}.builder takes no positional args")
+
+    # -- config resolution ----------------------------------------------
+    def applyGlobalDefaults(self, g: Dict[str, Any]) -> None:
+        for field, gkey in [("activation", "activation"),
+                            ("weightInit", "weightInit"),
+                            ("updater", "updater"),
+                            ("biasUpdater", "biasUpdater"),
+                            ("l1", "l1"), ("l2", "l2"),
+                            ("weightDecay", "weightDecay"),
+                            ("biasInit", "biasInit"),
+                            ("dropOut", "dropOut"),
+                            ("convolutionMode", "convolutionMode"),
+                            ("gradientNormalization", "gradientNormalization"),
+                            ("gradientNormalizationThreshold",
+                             "gradientNormalizationThreshold")]:
+            if hasattr(self, field) and getattr(self, field) is None \
+                    and g.get(gkey) is not None:
+                setattr(self, field, g[gkey])
+
+    # -- shape inference -------------------------------------------------
+    def preferredFormat(self) -> Optional[str]:
+        """FF / CNN / RNN / None (= passthrough)."""
+        return None
+
+    def inferNIn(self, inputType: InputType) -> None:
+        pass
+
+    def getOutputType(self, inputType: InputType) -> InputType:
+        return inputType
+
+    # -- params ----------------------------------------------------------
+    def initParams(self, key, inputType: InputType, dtype=jnp.float32) -> Dict:
+        return {}
+
+    def weightParamKeys(self):
+        """Param names treated as weights for regularization (not biases)."""
+        return ("W",)
+
+    # -- forward ---------------------------------------------------------
+    def forward(self, params: Dict, x, train: bool, key, state: Dict
+                ) -> Tuple[Any, Dict]:
+        return x, state
+
+    def hasLoss(self) -> bool:
+        return False
+
+    # -- serde -----------------------------------------------------------
+    def toJson(self) -> dict:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, IUpdater):
+                v = v.toJson()
+            d[f.name] = v
+        d["@class"] = type(self).__name__
+        return d
+
+
+@dataclasses.dataclass
+class BaseLayer(Layer):
+    """Layer with params + the shared hyper-params every DL4J layer carries."""
+    activation: Optional[str] = None
+    weightInit: Optional[str] = None
+    biasInit: Optional[float] = None
+    updater: Optional[IUpdater] = None
+    biasUpdater: Optional[IUpdater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weightDecay: Optional[float] = None
+    dropOut: Optional[float] = None  # DL4J semantics: RETAIN probability
+    gradientNormalization: Optional[str] = None
+    gradientNormalizationThreshold: Optional[float] = None
+
+    def _dropin(self, x, train, key):
+        """Apply input dropout (DL4J applies IDropout to layer input)."""
+        if train and self.dropOut is not None and 0.0 < self.dropOut < 1.0 \
+                and key is not None:
+            keep = self.dropOut
+            mask = jax.random.bernoulli(key, keep, x.shape)
+            return jnp.where(mask, x / keep, 0.0)
+        return x
+
+
+@dataclasses.dataclass
+class DenseLayer(BaseLayer):
+    """Reference: ``conf/layers/DenseLayer.java`` / ``layers/feedforward/
+    dense/DenseLayer.java`` — preOutput = x·W + b, W shape (nIn, nOut)."""
+    nIn: int = 0
+    nOut: int = 0
+    hasBias: bool = True
+
+    def preferredFormat(self):
+        return "FF"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nIn, self.nOut), self.nIn, self.nOut,
+                              self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y = x @ params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return get_activation(self.activation or "sigmoid")(y), state
+
+
+@dataclasses.dataclass
+class ConvolutionLayer(BaseLayer):
+    """2D convolution.  Reference: ``conf/layers/ConvolutionLayer.java`` +
+    libnd4j ``ops/declarable/generic/nn/convo/conv2d.cpp``; lowered to
+    ``lax.conv_general_dilated`` (NCHW/OIHW) which XLA tiles onto the MXU."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolutionMode: Optional[str] = None
+    hasBias: bool = True
+
+    def __post_init__(self):
+        self.kernelSize = _pair(self.kernelSize)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+
+    def _outSpatial(self, inH, inW):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        dh, dw = self.dilation
+        eh, ew = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            return int(np.ceil(inH / sh)), int(np.ceil(inW / sw))
+        ph, pw = self.padding
+        return (inH + 2 * ph - eh) // sh + 1, (inW + 2 * pw - ew) // sw + 1
+
+    def getOutputType(self, inputType):
+        oh, ow = self._outSpatial(inputType.height, inputType.width)
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        fan_in = self.nIn * kh * kw
+        fan_out = self.nOut * kh * kw
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nOut, self.nIn, kh, kw), fan_in,
+                              fan_out, self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def _padding_arg(self):
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride,
+            padding=self._padding_arg(), rhs_dilation=self.dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+Convolution2D = ConvolutionLayer
+
+
+@dataclasses.dataclass
+class SubsamplingLayer(BaseLayer):
+    """Pooling.  Reference: ``conf/layers/SubsamplingLayer.java`` — lowered
+    to ``lax.reduce_window``."""
+    poolingType: str = PoolingType.MAX
+    kernelSize: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolutionMode: Optional[str] = None
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernelSize = _pair(self.kernelSize)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def getOutputType(self, inputType):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            oh, ow = int(np.ceil(inputType.height / sh)), int(np.ceil(inputType.width / sw))
+        else:
+            ph, pw = self.padding
+            oh = (inputType.height + 2 * ph - kh) // sh + 1
+            ow = (inputType.width + 2 * pw - kw) // sw + 1
+        return InputType.convolutional(oh, ow, inputType.channels)
+
+    def _pads(self, inH, inW):
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            return "SAME"
+        ph, pw = self.padding
+        return [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+
+    def forward(self, params, x, train, key, state):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        dims, strides = (1, 1, kh, kw), (1, 1, sh, sw)
+        pads = self._pads(x.shape[2], x.shape[3])
+        if pads == "SAME":
+            pads = lax.padtype_to_pads(x.shape, dims, strides, "SAME")
+        pt = self.poolingType.upper()
+        if pt == PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        elif pt == PoolingType.SUM:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        elif pt == PoolingType.AVG:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads) / (kh * kw)
+        elif pt == PoolingType.PNORM:
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims,
+                                  strides, pads) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.poolingType}")
+        return y, state
+
+
+@dataclasses.dataclass
+class BatchNormalization(BaseLayer):
+    """Reference: ``conf/layers/BatchNormalization.java`` — per-feature (FF)
+    or per-channel (CNN) normalization; running stats carried in the model
+    STATE pytree (the functional analogue of the reference's mean/var
+    params), updated as ``new = decay*old + (1-decay)*batch``."""
+    nIn: int = 0
+    nOut: int = 0
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0          # init value
+    beta: float = 0.0           # init value
+    lockGammaBeta: bool = False
+
+    def preferredFormat(self):
+        return None  # operates on FF or CNN
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels if inputType.kind == "CNN" else inputType.size
+        self.nOut = self.nIn
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        n = self.nIn
+        if self.lockGammaBeta:
+            return {}
+        return {"gamma": jnp.full((n,), self.gamma, dtype),
+                "beta": jnp.full((n,), self.beta, dtype)}
+
+    def initState(self, inputType, dtype=jnp.float32):
+        n = self.nIn
+        return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+    def weightParamKeys(self):
+        return ()  # no l1/l2 on gamma/beta (matches reference default)
+
+    def forward(self, params, x, train, key, state):
+        cnn = x.ndim == 4
+        axes = (0, 2, 3) if cnn else (0,)
+        shape = (1, -1, 1, 1) if cnn else (1, -1)
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xh = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self.eps)
+        if not self.lockGammaBeta:
+            xh = xh * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
+        act = get_activation(self.activation or "identity")
+        return act(xh), new_state
+
+
+@dataclasses.dataclass
+class ActivationLayer(BaseLayer):
+    def forward(self, params, x, train, key, state):
+        return get_activation(self.activation or "identity")(x), state
+
+
+@dataclasses.dataclass
+class DropoutLayer(BaseLayer):
+    def __post_init__(self):
+        if self.dropOut is None:
+            self.dropOut = 0.5
+
+    def forward(self, params, x, train, key, state):
+        return self._dropin(x, train, key), state
+
+
+@dataclasses.dataclass
+class EmbeddingLayer(BaseLayer):
+    """Index lookup.  Reference: ``conf/layers/EmbeddingLayer.java`` —
+    input (b,) or (b,1) integer indices, output (b, nOut)."""
+    nIn: int = 0
+    nOut: int = 0
+    hasBias: bool = False
+
+    def preferredFormat(self):
+        return "FF"
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nIn, self.nOut), self.nIn, self.nOut,
+                              self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        idx = x.astype(jnp.int32).reshape(x.shape[0], -1)[:, 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.hasBias:
+            y = y + params["b"]
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(BaseLayer):
+    """Sequence lookup: (b, t) or (b, 1, t) ints -> RNN format (b, nOut, t).
+    Reference: ``conf/layers/EmbeddingSequenceLayer.java``."""
+    nIn: int = 0
+    nOut: int = 0
+    inputLength: int = -1
+    hasBias: bool = False
+
+    def preferredFormat(self):
+        return None
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, self.inputLength)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nIn, self.nOut), self.nIn, self.nOut,
+                              self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        if x.ndim == 3:  # (b, 1, t)
+            x = x[:, 0, :]
+        idx = x.astype(jnp.int32)                       # (b, t)
+        y = jnp.take(params["W"], idx, axis=0)          # (b, t, nOut)
+        if self.hasBias:
+            y = y + params["b"]
+        return y.transpose(0, 2, 1), state              # (b, nOut, t)
+
+
+@dataclasses.dataclass
+class GlobalPoolingLayer(BaseLayer):
+    """Pool CNN spatial dims or RNN time dim to FF.
+    Reference: ``conf/layers/GlobalPoolingLayer.java`` (mask-aware)."""
+    poolingType: str = PoolingType.MAX
+    pnorm: int = 2
+    collapseDimensions: bool = True
+
+    def getOutputType(self, inputType):
+        if inputType.kind == "CNN":
+            return InputType.feedForward(inputType.channels)
+        if inputType.kind == "RNN":
+            return InputType.feedForward(inputType.size)
+        return inputType
+
+    def forward(self, params, x, train, key, state, mask=None):
+        if x.ndim == 4:
+            axes = (2, 3)
+        elif x.ndim == 3:
+            axes = (2,)
+        else:
+            return x, state
+        pt = self.poolingType.upper()
+        if mask is not None and x.ndim == 3:
+            m = mask[:, None, :]
+            if pt == PoolingType.MAX:
+                x = jnp.where(m > 0, x, -jnp.inf)
+                return jnp.max(x, axis=axes), state
+            s = jnp.sum(x * m, axis=axes)
+            if pt == PoolingType.SUM:
+                return s, state
+            cnt = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+            return s / cnt, state
+        if pt == PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if pt == PoolingType.AVG:
+            return jnp.mean(x, axis=axes), state
+        if pt == PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if pt == PoolingType.PNORM:
+            p = float(self.pnorm)
+            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), state
+        raise ValueError(self.poolingType)
+
+
+@dataclasses.dataclass
+class LocalResponseNormalization(BaseLayer):
+    """Reference: ``conf/layers/LocalResponseNormalization.java`` (AlexNet
+    LRN): cross-channel normalization."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def forward(self, params, x, train, key, state):
+        half = int(self.n) // 2
+        sq = x * x
+        # sum over a window of channels via padded cumulative trick
+        padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        windows = [padded[:, i:i + x.shape[1]] for i in range(int(self.n))]
+        ssum = sum(windows)
+        denom = (self.k + self.alpha * ssum) ** self.beta
+        return x / denom, state
+
+
+@dataclasses.dataclass
+class OutputLayer(DenseLayer):
+    """Dense + activation + loss.  Reference: ``conf/layers/OutputLayer.java``
+    / ``layers/BaseOutputLayer.java``."""
+    lossFunction: str = "mcxent"
+
+    @classmethod
+    def _builderArgs(cls, b, *args):
+        if args:
+            b._kw["lossFunction"] = args[0]
+
+    def hasLoss(self) -> bool:
+        return True
+
+    def computeScore(self, labels, output, mask=None):
+        return get_loss(self.lossFunction)(labels, output, mask)
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y = x @ params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return get_activation(self.activation or "softmax")(y), state
+
+
+@dataclasses.dataclass
+class LossLayer(BaseLayer):
+    """Loss without params.  Reference: ``conf/layers/LossLayer.java``."""
+    lossFunction: str = "mcxent"
+
+    @classmethod
+    def _builderArgs(cls, b, *args):
+        if args:
+            b._kw["lossFunction"] = args[0]
+
+    def hasLoss(self) -> bool:
+        return True
+
+    def computeScore(self, labels, output, mask=None):
+        return get_loss(self.lossFunction)(labels, output, mask)
+
+    def forward(self, params, x, train, key, state):
+        return get_activation(self.activation or "identity")(x), state
+
+
+# ---------------------------------------------------------------------------
+_LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+for _c in [DenseLayer, ConvolutionLayer, SubsamplingLayer, BatchNormalization,
+           ActivationLayer, DropoutLayer, EmbeddingLayer,
+           EmbeddingSequenceLayer, GlobalPoolingLayer,
+           LocalResponseNormalization, OutputLayer, LossLayer]:
+    register_layer(_c)
+
+
+def layer_from_json(d: dict) -> Layer:
+    d = dict(d)
+    cls = _LAYER_REGISTRY[d.pop("@class")]
+    for k in ("updater", "biasUpdater"):
+        if d.get(k):
+            d[k] = IUpdater.fromJson(d[k])
+    for k in ("kernelSize", "stride", "padding", "dilation"):
+        if isinstance(d.get(k), list):
+            d[k] = tuple(d[k])
+    return cls(**d)

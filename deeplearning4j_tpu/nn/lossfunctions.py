@@ -1,0 +1,177 @@
+"""Loss functions.
+
+Reference: nd4j-api ``org/nd4j/linalg/lossfunctions/**`` (``ILossFunction``
+impls + the ``LossFunctions.LossFunction`` enum).  Each loss maps
+``(labels, preOutput-after-activation, mask) -> per-example scores`` and the
+scalar score is the mean over examples (matching
+``ILossFunction.computeScore(average=true)``).  Gradients come from
+``jax.grad`` of the scalar — no hand-written ``computeGradient``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LossFunctions", "LossFunction", "get_loss"]
+
+_EPS = 1e-7
+
+
+def _reduce(per_elem, mask):
+    """Per-example score: sum over feature dims; mask weights examples/steps."""
+    axes = tuple(range(1, per_elem.ndim))
+    per_ex = jnp.sum(per_elem, axis=axes) if axes else per_elem
+    if mask is not None:
+        m = mask
+        # broadcast time-step masks: per_ex already summed, so apply before
+        per_ex = per_ex * m.reshape(per_ex.shape)
+    return per_ex
+
+
+def _mcxent(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, 1.0 - _EPS)
+    return _reduce(-labels * jnp.log(p), mask)
+
+
+def _nll(labels, output, mask=None):
+    return _mcxent(labels, output, mask)
+
+
+def _sparse_mcxent(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, 1.0 - _EPS)
+    idx = labels.astype(jnp.int32)
+    ll = jnp.take_along_axis(jnp.log(p), idx[..., None], axis=-1)[..., 0]
+    per_ex = -ll
+    if per_ex.ndim > 1:
+        per_ex = jnp.sum(per_ex, axis=tuple(range(1, per_ex.ndim)))
+    if mask is not None:
+        per_ex = per_ex * mask.reshape(per_ex.shape)
+    return per_ex
+
+
+def _mse(labels, output, mask=None):
+    d = output - labels
+    n = labels.shape[-1]
+    return _reduce(d * d / n, mask)
+
+
+def _l2(labels, output, mask=None):
+    d = output - labels
+    return _reduce(d * d, mask)
+
+
+def _l1(labels, output, mask=None):
+    return _reduce(jnp.abs(output - labels), mask)
+
+
+def _mae(labels, output, mask=None):
+    return _reduce(jnp.abs(output - labels) / labels.shape[-1], mask)
+
+
+def _xent(labels, output, mask=None):
+    """Binary cross-entropy (sigmoid outputs)."""
+    p = jnp.clip(output, _EPS, 1.0 - _EPS)
+    return _reduce(-(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p)), mask)
+
+
+def _hinge(labels, output, mask=None):
+    # labels in {-1, 1} or {0,1} converted
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    return _reduce(jnp.maximum(0.0, 1.0 - y * output), mask)
+
+
+def _squared_hinge(labels, output, mask=None):
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    return _reduce(jnp.maximum(0.0, 1.0 - y * output) ** 2, mask)
+
+
+def _cosine(labels, output, mask=None):
+    ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + _EPS)
+    on = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + _EPS)
+    per_ex = 1.0 - jnp.sum(ln * on, axis=-1)
+    if per_ex.ndim > 1:
+        per_ex = jnp.sum(per_ex, axis=tuple(range(1, per_ex.ndim)))
+    if mask is not None:
+        per_ex = per_ex * mask.reshape(per_ex.shape)
+    return per_ex
+
+
+def _poisson(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, None)
+    return _reduce(p - labels * jnp.log(p), mask)
+
+
+def _kld(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, 1.0)
+    q = jnp.clip(labels, _EPS, 1.0)
+    return _reduce(q * (jnp.log(q) - jnp.log(p)), mask)
+
+
+def _mape(labels, output, mask=None):
+    return _reduce(100.0 * jnp.abs((labels - output) /
+                                   jnp.clip(jnp.abs(labels), _EPS, None))
+                   / labels.shape[-1], mask)
+
+
+def _msle(labels, output, mask=None):
+    d = jnp.log1p(jnp.clip(output, -1 + _EPS, None)) - \
+        jnp.log1p(jnp.clip(labels, -1 + _EPS, None))
+    return _reduce(d * d / labels.shape[-1], mask)
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "mcxent": _mcxent,
+    "negativeloglikelihood": _nll,
+    "sparse_mcxent": _sparse_mcxent,
+    "mse": _mse,
+    "squared_loss": _mse,
+    "l1": _l1,
+    "l2": _l2,
+    "mean_absolute_error": _mae,
+    "xent": _xent,
+    "hinge": _hinge,
+    "squared_hinge": _squared_hinge,
+    "cosine_proximity": _cosine,
+    "poisson": _poisson,
+    "kl_divergence": _kld,
+    "reconstruction_crossentropy": _xent,
+    "mean_absolute_percentage_error": _mape,
+    "mean_squared_logarithmic_error": _msle,
+}
+
+
+class LossFunction:
+    MCXENT = "mcxent"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    SPARSE_MCXENT = "sparse_mcxent"
+    MSE = "mse"
+    SQUARED_LOSS = "squared_loss"
+    L1 = "l1"
+    L2 = "l2"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+    XENT = "xent"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    COSINE_PROXIMITY = "cosine_proximity"
+    POISSON = "poisson"
+    KL_DIVERGENCE = "kl_divergence"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mean_absolute_percentage_error"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "mean_squared_logarithmic_error"
+
+
+class LossFunctions:
+    LossFunction = LossFunction
+
+
+def get_loss(name) -> Callable:
+    """Return ``loss(labels, output, mask=None) -> per-example scores``."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    try:
+        return _REGISTRY[key]
+    except KeyError:
+        raise ValueError(f"Unknown loss function: {name!r}. "
+                         f"Available: {sorted(_REGISTRY)}")

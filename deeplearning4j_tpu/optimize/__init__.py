@@ -1,0 +1,5 @@
+"""Training listeners & solvers (reference: org/deeplearning4j/optimize)."""
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CheckpointListener, CollectScoresIterationListener, EvaluativeListener,
+    PerformanceListener, ScoreIterationListener, TimeIterationListener,
+    TrainingListener)

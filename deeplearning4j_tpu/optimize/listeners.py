@@ -1,0 +1,161 @@
+"""Training listeners.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/optimize/api/
+TrainingListener.java`` and stock impls under
+``org/deeplearning4j/optimize/listeners/**``.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """SPI: iterationDone / onEpochStart / onEpochEnd / onForwardPass /
+    onBackwardPass / onGradientCalculation."""
+
+    def iterationDone(self, model, iteration: int, epoch: int) -> None:
+        pass
+
+    def onEpochStart(self, model) -> None:
+        pass
+
+    def onEpochEnd(self, model) -> None:
+        pass
+
+    def onForwardPass(self, model, activations=None) -> None:
+        pass
+
+    def onBackwardPass(self, model) -> None:
+        pass
+
+    def onGradientCalculation(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (``ScoreIterationListener.java``)."""
+
+    def __init__(self, printIterations: int = 10):
+        self.printIterations = max(int(printIterations), 1)
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.printIterations == 0:
+            print(f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput logging (``PerformanceListener.java``)."""
+
+    def __init__(self, frequency: int = 10, reportScore: bool = False):
+        self.frequency = max(int(frequency), 1)
+        self.reportScore = reportScore
+        self._last: Optional[float] = None
+        self._lastIter = 0
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.time()
+        if iteration % self.frequency == 0:
+            if self._last is not None and iteration > self._lastIter:
+                dt = now - self._last
+                its = (iteration - self._lastIter) / dt if dt > 0 else 0.0
+                bs = getattr(model, "lastBatchSize", 0)
+                msg = (f"iteration {iteration}; iterations/sec: {its:.2f}; "
+                       f"samples/sec: {its * bs:.2f}")
+                if self.reportScore:
+                    msg += f"; score: {model.score()}"
+                print(msg)
+            self._last = now
+            self._lastIter = iteration
+
+
+class CollectScoresIterationListener(TrainingListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(int(frequency), 1)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))
+
+    def getScores(self):
+        return self.scores
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (``TimeIterationListener.java``)."""
+
+    def __init__(self, iterationCount: int, frequency: int = 50):
+        self.iterationCount = iterationCount
+        self.frequency = max(frequency, 1)
+        self._start = time.time()
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration and iteration % self.frequency == 0:
+            elapsed = time.time() - self._start
+            per = elapsed / max(iteration, 1)
+            remain = (self.iterationCount - iteration) * per
+            print(f"Remaining time estimate: {remain:.0f}s "
+                  f"(iteration {iteration}/{self.iterationCount})")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (``EvaluativeListener.java``)."""
+
+    def __init__(self, iterator, frequency: int = 1, unit: str = "epoch"):
+        self.iterator = iterator
+        self.frequency = max(int(frequency), 1)
+        self.unit = unit
+        self.lastEvaluation = None
+
+    def _evaluate(self, model):
+        self.lastEvaluation = model.evaluate(self.iterator)
+        print(self.lastEvaluation.stats())
+
+    def iterationDone(self, model, iteration, epoch):
+        if self.unit == "iteration" and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def onEpochEnd(self, model):
+        if self.unit == "epoch" and model.getEpochCount() % self.frequency == 0:
+            self._evaluate(model)
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic model checkpointing with keep-last-K GC
+    (``CheckpointListener.java``)."""
+
+    def __init__(self, saveDir: str, saveEveryNIterations: int = 0,
+                 saveEveryNEpochs: int = 0, keepLast: int = 3):
+        import os
+        self.saveDir = saveDir
+        os.makedirs(saveDir, exist_ok=True)
+        self.everyIter = saveEveryNIterations
+        self.everyEpoch = saveEveryNEpochs
+        self.keepLast = keepLast
+        self._saved: List[str] = []
+
+    def _save(self, model, tag: str):
+        import os
+        from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        path = os.path.join(self.saveDir, f"checkpoint_{tag}.zip")
+        ModelSerializer.writeModel(model, path, saveUpdater=True)
+        self._saved.append(path)
+        while self.keepLast > 0 and len(self._saved) > self.keepLast:
+            old = self._saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iterationDone(self, model, iteration, epoch):
+        if self.everyIter and iteration and iteration % self.everyIter == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def onEpochEnd(self, model):
+        ep = model.getEpochCount()
+        if self.everyEpoch and ep and ep % self.everyEpoch == 0:
+            self._save(model, f"epoch_{ep}")

@@ -1,0 +1,2 @@
+"""Utilities: model serialization, misc helpers."""
+from deeplearning4j_tpu.utils.model_serializer import ModelSerializer  # noqa: F401

@@ -1,0 +1,149 @@
+"""Model checkpoint serialization.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/util/ModelSerializer.java``
+— zip containing ``configuration.json`` + ``coefficients.bin`` (single flat
+float param array, enabled by the flattened-view design) +
+``updaterState.bin`` + optional normalizer (SURVEY.md §5.4).
+
+Kept format-compatible in spirit: same zip layout and a flat little-endian
+float32 ``coefficients.bin`` in the same (layer, W-then-b) order; plus an
+``arrays.npz`` with the exact per-tensor pytrees (including BN running stats
+and updater state), which is the authoritative restore path.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_JSON = "configuration.json"
+COEFFICIENTS_BIN = "coefficients.bin"
+UPDATER_BIN = "updaterState.bin"
+ARRAYS_NPZ = "arrays.npz"
+NORMALIZER_NPZ = "normalizer.npz"
+META_JSON = "meta.json"
+
+
+def _flatten_tree(prefix, tree, out):
+    if isinstance(tree, dict):
+        for k in sorted(tree.keys()):
+            _flatten_tree(f"{prefix}/{k}" if prefix else str(k), tree[k], out)
+    elif tree is not None:
+        out[prefix] = np.asarray(tree)
+
+
+def _unflatten(npz) -> dict:
+    root: dict = {}
+    for key in npz.files:
+        parts = key.split("/")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = jnp.asarray(npz[key])
+    return root
+
+
+class ModelSerializer:
+    @staticmethod
+    def writeModel(model, path, saveUpdater: bool = True,
+                   normalizer=None) -> None:
+        conf_json = model.conf.toJson() if hasattr(model.conf, "toJson") else "{}"
+        arrays: dict = {}
+        _flatten_tree("params", model.params_ or {}, arrays)
+        _flatten_tree("state", model.state_ or {}, arrays)
+        if saveUpdater and model.optState_:
+            _flatten_tree("updater", model.optState_, arrays)
+        npz_buf = io.BytesIO()
+        np.savez(npz_buf, **arrays)
+        meta = {"modelType": type(model).__name__,
+                "iterationCount": getattr(model, "iterationCount", 0),
+                "epochCount": getattr(model, "epochCount", 0),
+                "framework": "deeplearning4j_tpu"}
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr(CONFIG_JSON, conf_json)
+            z.writestr(COEFFICIENTS_BIN,
+                       model.params().numpy().astype("<f4").tobytes())
+            if saveUpdater and model.optState_ is not None:
+                upd: dict = {}
+                _flatten_tree("", model.optState_, upd)
+                flat = np.concatenate([v.ravel() for v in upd.values()]) \
+                    if upd else np.zeros(0, np.float32)
+                z.writestr(UPDATER_BIN, flat.astype("<f4").tobytes())
+            z.writestr(ARRAYS_NPZ, npz_buf.getvalue())
+            z.writestr(META_JSON, json.dumps(meta))
+            if normalizer is not None:
+                nbuf = io.BytesIO()
+                if hasattr(normalizer, "mean"):
+                    np.savez(nbuf, kind="standardize", mean=normalizer.mean,
+                             std=normalizer.std)
+                elif hasattr(normalizer, "dataMin"):
+                    np.savez(nbuf, kind="minmax", dataMin=normalizer.dataMin,
+                             dataMax=normalizer.dataMax,
+                             range=[normalizer.minRange, normalizer.maxRange])
+                else:
+                    np.savez(nbuf, kind="image",
+                             range=[normalizer.minRange, normalizer.maxRange,
+                                    normalizer.maxPixelVal])
+                z.writestr(NORMALIZER_NPZ, nbuf.getvalue())
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path, loadUpdater: bool = True):
+        from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        with zipfile.ZipFile(path, "r") as z:
+            conf = MultiLayerConfiguration.fromJson(
+                z.read(CONFIG_JSON).decode())
+            net = MultiLayerNetwork(conf)
+            ModelSerializer._restoreInto(net, z, loadUpdater)
+        return net
+
+    @staticmethod
+    def restoreComputationGraph(path, loadUpdater: bool = True):
+        from deeplearning4j_tpu.models.graph import ComputationGraph
+        from deeplearning4j_tpu.models.graph_conf import \
+            ComputationGraphConfiguration
+        with zipfile.ZipFile(path, "r") as z:
+            conf = ComputationGraphConfiguration.fromJson(
+                z.read(CONFIG_JSON).decode())
+            net = ComputationGraph(conf)
+            ModelSerializer._restoreInto(net, z, loadUpdater)
+        return net
+
+    @staticmethod
+    def _restoreInto(net, z: zipfile.ZipFile, loadUpdater: bool):
+        with np.load(io.BytesIO(z.read(ARRAYS_NPZ)), allow_pickle=False) as npz:
+            tree = _unflatten(npz)
+        net.init(params=tree.get("params", {}))
+        if tree.get("state"):
+            net.state_ = tree["state"]
+        if loadUpdater and tree.get("updater"):
+            net.optState_ = tree["updater"]
+        meta = json.loads(z.read(META_JSON).decode()) if META_JSON in z.namelist() else {}
+        net.iterationCount = meta.get("iterationCount", 0)
+        net.epochCount = meta.get("epochCount", 0)
+
+    @staticmethod
+    def restoreNormalizer(path):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler, NormalizerMinMaxScaler,
+            NormalizerStandardize)
+        with zipfile.ZipFile(path, "r") as z:
+            if NORMALIZER_NPZ not in z.namelist():
+                return None
+            with np.load(io.BytesIO(z.read(NORMALIZER_NPZ)),
+                         allow_pickle=False) as npz:
+                kind = str(npz["kind"])
+                if kind == "standardize":
+                    n = NormalizerStandardize()
+                    n.mean, n.std = npz["mean"], npz["std"]
+                    return n
+                if kind == "minmax":
+                    n = NormalizerMinMaxScaler(*npz["range"].tolist())
+                    n.dataMin, n.dataMax = npz["dataMin"], npz["dataMax"]
+                    return n
+                r = npz["range"].tolist()
+                return ImagePreProcessingScaler(r[0], r[1], r[2])

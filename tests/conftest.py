@@ -2,16 +2,23 @@
 
 All tests run on CPU with 8 virtual XLA devices so the multi-chip sharding
 path is exercised without TPU hardware (the reference's analogue is
-DummyTransport / local[N] Spark masters — SURVEY.md §4).  Must run before
-jax is imported anywhere.
+DummyTransport / local[N] Spark masters — SURVEY.md §4).
+
+Note: this environment's sitecustomize imports jax and registers the axon/TPU
+platform before conftest runs, so setting ``JAX_PLATFORMS`` via os.environ is
+too late — we must go through ``jax.config.update``.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
