@@ -1,0 +1,55 @@
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+BASELINE.json metric: "ResNet-50 ImageNet images/sec/chip" (baseline TBD —
+this project's first measurements establish it; vs_baseline is 1.0 until a
+recorded baseline exists).  Runs the fused XLA train step (fwd+bwd+updater in
+one executable) on synthetic ImageNet-shaped data.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    img = int(sys.argv[2]) if len(sys.argv) > 2 else 224
+    steps = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+
+    net = ResNet50(numClasses=1000, inputShape=(3, img, img)).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 3, img, img).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    ds = DataSet(x, y)
+
+    net.fit(ds)  # compile + warm up
+    net.fit(ds)
+    jax.block_until_ready(net.params_)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit(ds)
+    jax.block_until_ready(net.params_)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,12 @@ class DataSetIterator:
     def _applyPre(self, ds: DataSet) -> DataSet:
         p = self.getPreProcessor()
         if p is not None:
+            # shallow-copy the container first: preprocessors rebind
+            # ds.features, and iterators like ListDataSetIterator hand out
+            # CACHED DataSet objects — preprocessing those in place would
+            # re-normalize the same data every epoch.
+            ds = DataSet(ds.features, ds.labels, ds.featuresMask,
+                         ds.labelsMask)
             p.preProcess(ds)
         return ds
 

@@ -29,6 +29,12 @@ class Evaluation:
         if self._cm is None:
             self.numClasses = self.numClasses or n
             self._cm = np.zeros((self.numClasses, self.numClasses), dtype=np.int64)
+        elif n > self._cm.shape[0]:
+            # grow when integer-id labels reveal a higher class id later
+            grown = np.zeros((n, n), dtype=np.int64)
+            grown[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+            self._cm = grown
+            self.numClasses = n
 
     def eval(self, labels, predictions, mask=None) -> None:
         """labels/predictions: one-hot or probability (batch, C), or int ids.
@@ -43,9 +49,8 @@ class Evaluation:
                 y, p = y[m], p[m]
         yi = y.argmax(-1) if y.ndim > 1 else y.astype(np.int64)
         pi = p.argmax(-1) if p.ndim > 1 else p.astype(np.int64)
-        n = max(int(yi.max(initial=0)), int(pi.max(initial=0))) + 1 \
-            if self.numClasses == 0 else self.numClasses
-        self._ensure(n)
+        needed = max(int(yi.max(initial=0)), int(pi.max(initial=0))) + 1
+        self._ensure(max(needed, self.numClasses))
         np.add.at(self._cm, (yi, pi), 1)
 
     # -- metrics ---------------------------------------------------------

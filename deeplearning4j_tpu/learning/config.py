@@ -43,8 +43,10 @@ class IUpdater:
     def init(self, param) -> Dict[str, Any]:
         return {}
 
-    def apply(self, grad, state, lr, iteration, epoch=0
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None
               ) -> Tuple[Any, Dict[str, Any]]:
+        """``param`` is the current parameter value — only updaters with
+        decoupled decay (AdamW) use it; train steps always pass it."""
         raise NotImplementedError
 
     def stateSize(self, numParams: int) -> int:
@@ -71,13 +73,13 @@ class IUpdater:
 
 @dataclasses.dataclass
 class Sgd(IUpdater):
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         return lr * grad, state
 
 
 @dataclasses.dataclass
 class NoOp(IUpdater):
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         return jnp.zeros_like(grad), state
 
 
@@ -94,7 +96,7 @@ class Adam(IUpdater):
     def stateSize(self, n):
         return 2 * n
 
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
@@ -106,21 +108,20 @@ class Adam(IUpdater):
 class AdamW(Adam):
     """Adam with DECOUPLED weight decay (Loshchilov & Hutter).  Not in the
     reference updater set, but a standard modern companion: the decay term
-    ``wd * lr * param`` is added to the update AFTER the Adam step, so the
-    caller needs to pass ``param`` via :meth:`applyWithParam` (the train step
-    does); plain ``apply`` behaves as Adam with no decay."""
+    ``wd * lr * param`` is added to the update AFTER the Adam step (train
+    steps pass ``param``; without it decay is skipped)."""
     weightDecay: float = 0.0
 
-    def applyWithParam(self, grad, state, lr, iteration, param, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         update, new_state = Adam.apply(self, grad, state, lr, iteration, epoch)
-        if self.weightDecay:
+        if self.weightDecay and param is not None:
             update = update + self.weightDecay * lr * param
         return update, new_state
 
 
 @dataclasses.dataclass
 class AdaMax(Adam):
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         u = jnp.maximum(self.beta2 * state["v"], jnp.abs(grad))
@@ -137,7 +138,7 @@ class AMSGrad(Adam):
     def stateSize(self, n):
         return 3 * n
 
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
@@ -148,7 +149,7 @@ class AMSGrad(Adam):
 
 @dataclasses.dataclass
 class Nadam(Adam):
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
@@ -170,7 +171,7 @@ class Nesterovs(IUpdater):
     def stateSize(self, n):
         return n
 
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         mu = (self.momentumSchedule.valueAt(iteration, epoch)
               if self.momentumSchedule is not None else self.momentum)
         # Matches reference NesterovsUpdater: v_new = mu*v - lr*g and the
@@ -200,7 +201,7 @@ class RmsProp(IUpdater):
     def stateSize(self, n):
         return n
 
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         g = self.rmsDecay * state["g"] + (1 - self.rmsDecay) * grad * grad
         return lr * grad / (jnp.sqrt(g) + self.epsilon), {"g": g}
 
@@ -216,7 +217,7 @@ class AdaGrad(IUpdater):
     def stateSize(self, n):
         return n
 
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         h = state["h"] + grad * grad
         return lr * grad / (jnp.sqrt(h) + self.epsilon), {"h": h}
 
@@ -232,7 +233,7 @@ class AdaDelta(IUpdater):
     def stateSize(self, n):
         return 2 * n
 
-    def apply(self, grad, state, lr, iteration, epoch=0):
+    def apply(self, grad, state, lr, iteration, epoch=0, param=None):
         msg = self.rho * state["msg"] + (1 - self.rho) * grad * grad
         dx = grad * jnp.sqrt(state["msdx"] + self.epsilon) / jnp.sqrt(msg + self.epsilon)
         msdx = self.rho * state["msdx"] + (1 - self.rho) * dx * dx

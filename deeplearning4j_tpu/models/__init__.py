@@ -1,2 +1,7 @@
 """Model classes: MultiLayerNetwork, ComputationGraph, zoo."""
 from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.models.graph import ComputationGraph  # noqa: F401
+from deeplearning4j_tpu.models.graph_conf import (  # noqa: F401
+    ComputationGraphConfiguration, ElementWiseVertex, GraphBuilder,
+    L2NormalizeVertex, MergeVertex, PreprocessorVertex, ScaleVertex,
+    ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)

@@ -1,0 +1,292 @@
+"""ComputationGraph — DAG model with multi-input/multi-output training.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/graph/
+ComputationGraph.java`` (topologicalSortOrder, GraphVertex.doForward/
+doBackward — SURVEY.md §3.2).
+
+Same TPU-first design as MultiLayerNetwork: the whole DAG (forward over topo
+order + all losses + backward + updaters) compiles into ONE fused XLA
+executable; vertices are pure functions so ``jax.grad`` handles the
+reference's per-vertex ``doBackward`` epsilon bookkeeping.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.learning.config import Sgd
+from deeplearning4j_tpu.learning.regularization import WeightDecay
+from deeplearning4j_tpu.models.multilayer import (_grad_normalize,
+                                                  _param_key_order,
+                                                  _reg_penalty, _updater_for)
+from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.ops import NDArray
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params_: Optional[Dict] = None
+        self.state_: Dict[str, Dict] = {}
+        self.optState_: Optional[Dict] = None
+        self.iterationCount = 0
+        self.epochCount = 0
+        self.lastBatchSize = 0
+        self._score = 0.0
+        self._listeners: List = []
+        self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
+        self._dtype = jnp.float32
+        self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x6EED)
+        self._lossNodes = [n for n in conf.outputs
+                           if isinstance(conf.nodes[n][0], Layer)
+                           and conf.nodes[n][0].hasLoss()]
+
+    # ------------------------------------------------------------------
+    def init(self, params: Optional[Dict] = None) -> "ComputationGraph":
+        """Single jitted init (see MultiLayerNetwork.init rationale)."""
+        def build_ps(root):
+            p_tree: Dict[str, Dict] = {}
+            s_tree: Dict[str, Dict] = {}
+            for idx, name in enumerate(self.conf.topoOrder):
+                node, _ = self.conf.nodes[name]
+                if isinstance(node, Layer):
+                    it = self.conf.vertexInputTypes.get(name)
+                    p = node.initParams(jax.random.fold_in(root, idx), it,
+                                        self._dtype)
+                    if p:
+                        p_tree[name] = p
+                if hasattr(node, "initState"):
+                    s_tree[name] = node.initState(
+                        self.conf.vertexInputTypes.get(name), self._dtype)
+            return p_tree, s_tree
+
+        if params is not None:
+            self.params_ = params
+            self.state_ = jax.jit(lambda: {
+                name: self.conf.nodes[name][0].initState(
+                    self.conf.vertexInputTypes.get(name), self._dtype)
+                for name in self.conf.topoOrder
+                if hasattr(self.conf.nodes[name][0], "initState")})()
+        else:
+            self.params_, self.state_ = jax.jit(build_ps)(
+                jax.random.PRNGKey(self._rngSeed))
+        self._initOptState()
+        return self
+
+    def _initOptState(self) -> None:
+        def build_opt(p_tree):
+            return {name: {pname: self._updaterFor(
+                        self.conf.nodes[name][0], pname).init(pval)
+                           for pname, pval in lp.items()}
+                    for name, lp in p_tree.items()}
+
+        self.optState_ = jax.jit(build_opt)(self.params_ or {})
+
+    def _updaterFor(self, layer, pname: str):
+        return _updater_for(self.conf.globalConf, layer, pname)
+
+    # ------------------------------------------------------------------
+    def _forward(self, params, state, inputs: Sequence, train: bool, key,
+                 mask=None):
+        """Forward over the cached topological order (reference:
+        ``topologicalSortOrder()`` + per-vertex ``doForward``)."""
+        acts: Dict[str, Any] = {}
+        miniBatch = inputs[0].shape[0]
+        for i, name in enumerate(self.conf.inputs):
+            acts[name] = inputs[i]
+        new_state: Dict[str, Dict] = {}
+        for idx, name in enumerate(self.conf.topoOrder):
+            node, ins = self.conf.nodes[name]
+            xs = [acts[i] for i in ins]
+            if isinstance(node, Layer):
+                x = xs[0]
+                if name in self.conf.preProcessors:
+                    x = self.conf.preProcessors[name].preProcess(x, miniBatch)
+                lkey = jax.random.fold_in(key, idx) if key is not None else None
+                y, st2 = node.forward(params.get(name, {}), x, train, lkey,
+                                      state.get(name, {}))
+                if st2:
+                    new_state[name] = st2
+                acts[name] = y
+            else:
+                acts[name] = node.forward(*xs)
+        return acts, new_state
+
+    def _lossFn(self, params, state, inputs, labels, masks, key):
+        acts, new_state = self._forward(params, state, inputs, True, key)
+        total = 0.0
+        for i, name in enumerate(self.conf.outputs):
+            node = self.conf.nodes[name][0]
+            if isinstance(node, Layer) and node.hasLoss():
+                mask = masks[i] if masks is not None else None
+                total = total + jnp.mean(node.computeScore(labels[i],
+                                                           acts[name], mask))
+        reg = _reg_penalty((self.conf.nodes[name][0], lp)
+                           for name, lp in params.items())
+        return total + reg, (new_state, total)
+
+    @functools.cached_property
+    def _trainStep(self):
+        def step(params, optState, state, inputs, labels, masks, key,
+                 iteration, epoch):
+            grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
+            (loss, (new_state, data_loss)), grads = grad_fn(
+                params, state, inputs, labels, masks, key)
+            new_params, new_opt = {}, {}
+            for name, lp in params.items():
+                node = self.conf.nodes[name][0]
+                g = _grad_normalize(node, grads[name])
+                new_params[name], new_opt[name] = {}, {}
+                for pname, pval in lp.items():
+                    up = self._updaterFor(node, pname)
+                    lr = up.currentLr(iteration, epoch)
+                    update, ostate = up.apply(g[pname], optState[name][pname],
+                                              lr, iteration, epoch,
+                                              param=pval)
+                    wd = getattr(node, "weightDecay", None)
+                    if wd and pname in node.weightParamKeys():
+                        update = WeightDecay(coeff=wd).apply(pval, update, lr)
+                    new_params[name][pname] = pval - update
+                    new_opt[name][pname] = ostate
+            return new_params, new_opt, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _outputFn(self):
+        def run(params, state, inputs):
+            acts, _ = self._forward(params, state, inputs, False, None)
+            return tuple(acts[n] for n in self.conf.outputs)
+        return jax.jit(run)
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1) -> None:
+        if self.params_ is None:
+            self.init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            self._fitBatch(data)
+        elif isinstance(data, DataSetIterator):
+            for _ in range(epochs):
+                for l in self._listeners:
+                    l.onEpochStart(self)
+                data.reset()
+                while data.hasNext():
+                    self._fitBatch(data.next())
+                self.epochCount += 1
+                for l in self._listeners:
+                    l.onEpochEnd(self)
+        elif labels is not None:
+            self._fitBatch(DataSet(data, labels))
+        else:
+            raise TypeError(f"Cannot fit on {type(data)}")
+
+    def _fitBatch(self, ds) -> None:
+        if isinstance(ds, MultiDataSet):
+            inputs = tuple(f.jax.astype(self._dtype) for f in ds.features)
+            labels = tuple(l.jax for l in ds.labels)
+            masks = tuple(m.jax for m in ds.labelsMasks) \
+                if ds.labelsMasks else None
+        else:
+            inputs = (ds.features.jax.astype(self._dtype),)
+            labels = (ds.labels.jax,)
+            masks = (ds.labelsMask.jax,) if ds.labelsMask is not None else None
+        self.lastBatchSize = int(inputs[0].shape[0])
+        self._fitKey, key = jax.random.split(self._fitKey)
+        self.params_, self.optState_, new_state, loss = self._trainStep(
+            self.params_, self.optState_, self.state_, inputs, labels, masks,
+            key, jnp.asarray(self.iterationCount),
+            jnp.asarray(self.epochCount))
+        if new_state:
+            self.state_.update(new_state)
+        self._score = float(loss)
+        self.iterationCount += 1
+        for l in self._listeners:
+            l.iterationDone(self, self.iterationCount, self.epochCount)
+
+    def output(self, *inputs):
+        xs = tuple((x.jax if isinstance(x, NDArray) else jnp.asarray(x))
+                   .astype(self._dtype) for x in inputs)
+        outs = self._outputFn(self.params_, self.state_, xs)
+        res = [NDArray(o) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def outputSingle(self, *inputs) -> NDArray:
+        out = self.output(*inputs)
+        return out[0] if isinstance(out, list) else out
+
+    def score(self, ds=None) -> float:
+        return self._score
+
+    def evaluate(self, it: DataSetIterator) -> Evaluation:
+        ev = Evaluation()
+        it.reset()
+        while it.hasNext():
+            ds = it.next()
+            out = self.outputSingle(ds.features)
+            ev.eval(ds.labels.numpy(), out.numpy(),
+                    ds.labelsMask.numpy() if getattr(ds, "labelsMask", None)
+                    is not None else None)
+        it.reset()
+        return ev
+
+    # -- listeners / params (same surface as MLN) -----------------------
+    def setListeners(self, *listeners) -> None:
+        if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
+            listeners = tuple(listeners[0])
+        self._listeners = list(listeners)
+
+    def params(self) -> NDArray:
+        chunks = []
+        for name in self.conf.topoOrder:
+            if name in (self.params_ or {}):
+                for k in _param_key_order(self.params_[name].keys()):
+                    chunks.append(np.asarray(self.params_[name][k]).ravel())
+        return NDArray(np.concatenate(chunks) if chunks else np.zeros(0))
+
+    def setParams(self, flat) -> None:
+        vec = np.asarray(flat.numpy() if isinstance(flat, NDArray) else flat).ravel()
+        pos = 0
+        for name in self.conf.topoOrder:
+            if name in self.params_:
+                for k in _param_key_order(self.params_[name].keys()):
+                    cur = self.params_[name][k]
+                    n = int(np.prod(cur.shape))
+                    self.params_[name][k] = jnp.asarray(
+                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype)
+                    pos += n
+
+    def numParams(self) -> int:
+        return int(sum(int(np.prod(v.shape))
+                       for lp in (self.params_ or {}).values()
+                       for v in lp.values()))
+
+    def paramTable(self) -> Dict[str, NDArray]:
+        return {f"{name}_{k}": NDArray(v)
+                for name, lp in self.params_.items() for k, v in lp.items()}
+
+    def getEpochCount(self) -> int:
+        return self.epochCount
+
+    def getNumLayers(self) -> int:
+        return sum(1 for n, _ in self.conf.nodes.values()
+                   if isinstance(n, Layer))
+
+    def summary(self) -> str:
+        lines = [f"{'vertex':<24} {'type':<26} {'params':>10} inputs"]
+        total = 0
+        for name in self.conf.topoOrder:
+            node, ins = self.conf.nodes[name]
+            n = sum(int(np.prod(v.shape))
+                    for v in (self.params_ or {}).get(name, {}).values())
+            total += n
+            lines.append(f"{name:<24} {type(node).__name__:<26} {n:>10} {ins}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

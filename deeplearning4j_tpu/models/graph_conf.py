@@ -1,0 +1,330 @@
+"""ComputationGraph configuration: DAG of layers + vertices.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/
+ComputationGraphConfiguration.java`` (+ ``GraphBuilder``) and the vertex
+impls ``org/deeplearning4j/nn/conf/graph/{MergeVertex,ElementWiseVertex,
+SubsetVertex,ScaleVertex,ShiftVertex,...}.java``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.learning.config import IUpdater
+from deeplearning4j_tpu.nn.conf import _auto_preprocessor
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, layer_from_json
+from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+
+__all__ = ["ComputationGraphConfiguration", "GraphBuilder", "GraphVertex",
+           "MergeVertex", "ElementWiseVertex", "SubsetVertex", "ScaleVertex",
+           "ShiftVertex", "StackVertex", "UnstackVertex", "L2NormalizeVertex",
+           "PreprocessorVertex"]
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    """Non-layer DAG node (reference: ``conf/graph/GraphVertex.java``)."""
+
+    def getOutputType(self, *inputTypes: InputType) -> InputType:
+        return inputTypes[0]
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def toJson(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concat along the feature dim (dim 1 for FF/CNN/RNN)."""
+
+    def getOutputType(self, *its):
+        k = its[0].kind
+        if k == "FF":
+            return InputType.feedForward(sum(i.size for i in its))
+        if k == "CNN":
+            return InputType.convolutional(its[0].height, its[0].width,
+                                           sum(i.channels for i in its))
+        if k == "RNN":
+            return InputType.recurrent(sum(i.size for i in its),
+                                       its[0].timeSeriesLength)
+        return its[0]
+
+    def forward(self, *inputs):
+        return jnp.concatenate(inputs, axis=1)
+
+
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    op: str = "Add"  # Add | Subtract | Product | Average | Max
+
+    def forward(self, *inputs):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            return inputs[0] - inputs[1]
+        if op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "average":
+            return sum(inputs) / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWiseVertex op {self.op}")
+
+
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    fromIndex: int = 0
+    toIndex: int = 0  # inclusive, like the reference
+
+    def getOutputType(self, *its):
+        n = self.toIndex - self.fromIndex + 1
+        it = its[0]
+        if it.kind == "CNN":
+            return InputType.convolutional(it.height, it.width, n)
+        if it.kind == "RNN":
+            return InputType.recurrent(n, it.timeSeriesLength)
+        return InputType.feedForward(n)
+
+    def forward(self, *inputs):
+        return inputs[0][:, self.fromIndex:self.toIndex + 1]
+
+
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    scaleFactor: float = 1.0
+
+    def forward(self, *inputs):
+        return inputs[0] * self.scaleFactor
+
+
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    shiftFactor: float = 0.0
+
+    def forward(self, *inputs):
+        return inputs[0] + self.shiftFactor
+
+
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Stack along dim 0 (minibatch) — reference ``StackVertex``."""
+
+    def forward(self, *inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    fromIndex: int = 0
+    stackSize: int = 1
+
+    def forward(self, *inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.stackSize
+        return x[self.fromIndex * n:(self.fromIndex + 1) * n]
+
+
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    eps: float = 1e-8
+
+    def forward(self, *inputs):
+        x = inputs[0]
+        norm = jnp.sqrt(jnp.sum(x * x, axis=tuple(range(1, x.ndim)),
+                                keepdims=True))
+        return x / (norm + self.eps)
+
+
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    preProcessor: Optional[InputPreProcessor] = None
+
+    def getOutputType(self, *its):
+        return self.preProcessor.getOutputType(its[0])
+
+    def forward(self, *inputs):
+        return self.preProcessor.preProcess(inputs[0], inputs[0].shape[0])
+
+    def toJson(self) -> dict:
+        return {"@class": "PreprocessorVertex",
+                "preProcessor": self.preProcessor.toJson()}
+
+
+_VERTEX_REGISTRY = {c.__name__: c for c in [
+    MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex, ShiftVertex,
+    StackVertex, UnstackVertex, L2NormalizeVertex]}
+
+
+def vertex_from_json(d: dict) -> GraphVertex:
+    d = dict(d)
+    name = d.pop("@class")
+    if name == "PreprocessorVertex":
+        return PreprocessorVertex(InputPreProcessor.fromJson(d["preProcessor"]))
+    return _VERTEX_REGISTRY[name](**d)
+
+
+class GraphBuilder:
+    """Reference: ``ComputationGraphConfiguration.GraphBuilder``."""
+
+    def __init__(self, global_conf: Dict[str, Any]):
+        self._g = global_conf
+        self._inputs: List[str] = []
+        self._inputTypes: List[InputType] = []
+        self._nodes: Dict[str, Tuple[Any, List[str]]] = {}  # name -> (layer|vertex, inputs)
+        self._outputs: List[str] = []
+        self._preprocs: Dict[str, InputPreProcessor] = {}
+
+    def addInputs(self, *names: str):
+        self._inputs.extend(names)
+        return self
+
+    def setInputTypes(self, *types: InputType):
+        self._inputTypes = list(types)
+        return self
+
+    def addLayer(self, name: str, layer: Layer, *inputs):
+        # optional preprocessor arg DL4J-style: addLayer(name, layer, preproc, *inputs)
+        if inputs and isinstance(inputs[0], InputPreProcessor):
+            self._preprocs[name] = inputs[0]
+            inputs = inputs[1:]
+        layer.name = name
+        self._nodes[name] = (layer, list(inputs))
+        return self
+
+    def addVertex(self, name: str, vertex: GraphVertex, *inputs):
+        self._nodes[name] = (vertex, list(inputs))
+        return self
+
+    def setOutputs(self, *names: str):
+        self._outputs = list(names)
+        return self
+
+    def inputPreProcessor(self, layerName: str, p: InputPreProcessor):
+        self._preprocs[layerName] = p
+        return self
+
+    def build(self) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration(
+            inputs=self._inputs, inputTypes=self._inputTypes,
+            nodes=self._nodes, outputs=self._outputs,
+            preProcessors=self._preprocs, globalConf=self._g)
+
+
+class ComputationGraphConfiguration:
+    def __init__(self, inputs: List[str], inputTypes: List[InputType],
+                 nodes: Dict[str, Tuple[Any, List[str]]], outputs: List[str],
+                 preProcessors: Dict[str, InputPreProcessor],
+                 globalConf: Dict[str, Any]):
+        self.inputs = inputs
+        self.inputTypes = inputTypes
+        self.nodes = nodes
+        self.outputs = outputs
+        self.preProcessors = preProcessors
+        self.globalConf = globalConf
+        self.topoOrder: List[str] = []
+        self.vertexInputTypes: Dict[str, InputType] = {}
+        self._resolve()
+
+    # -- topo sort + shape inference ------------------------------------
+    def _resolve(self):
+        indeg = {n: 0 for n in self.nodes}
+        dependents: Dict[str, List[str]] = {n: [] for n in self.nodes}
+        for name, (_, ins) in self.nodes.items():
+            for i in ins:
+                if i not in self.inputs and i not in self.nodes:
+                    raise ValueError(f"Vertex {name}: unknown input {i!r}")
+                if i in self.nodes:
+                    indeg[name] += 1
+                    dependents[i].append(name)
+        ready = sorted(n for n, d in indeg.items() if d == 0)
+        order = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for d in dependents[n]:
+                indeg[d] -= 1
+                if indeg[d] == 0:
+                    ready.append(d)
+        if len(order) != len(self.nodes):
+            cyclic = sorted(set(self.nodes) - set(order))
+            raise ValueError(f"Graph contains a cycle involving {cyclic}")
+        self.topoOrder = order
+
+        # shape inference
+        types: Dict[str, InputType] = {}
+        for i, name in enumerate(self.inputs):
+            if i < len(self.inputTypes):
+                types[name] = self.inputTypes[i]
+        for name in order:
+            node, ins = self.nodes[name]
+            in_types = [types.get(i) for i in ins]
+            if isinstance(node, Layer):
+                node.applyGlobalDefaults(self.globalConf)
+                it = in_types[0] if in_types else None
+                if it is not None:
+                    if name not in self.preProcessors:
+                        p = _auto_preprocessor(it, node.preferredFormat())
+                        if p is not None:
+                            self.preProcessors[name] = p
+                    if name in self.preProcessors:
+                        it = self.preProcessors[name].getOutputType(it)
+                    node.inferNIn(it)
+                    self.vertexInputTypes[name] = it
+                    types[name] = node.getOutputType(it)
+            else:
+                if all(t is not None for t in in_types) and in_types:
+                    types[name] = node.getOutputType(*in_types)
+                    self.vertexInputTypes[name] = in_types[0]
+
+    # -- serde -----------------------------------------------------------
+    def toJson(self) -> str:
+        g = {k: (v.toJson() if isinstance(v, IUpdater) else v)
+             for k, v in self.globalConf.items()}
+        return json.dumps({
+            "globalConf": g,
+            "inputs": self.inputs,
+            "inputTypes": [t.toJson() for t in self.inputTypes],
+            "outputs": self.outputs,
+            "nodes": {name: {"node": node.toJson(), "inputs": ins,
+                             "kind": "layer" if isinstance(node, Layer) else "vertex"}
+                      for name, (node, ins) in self.nodes.items()},
+            "preProcessors": {k: v.toJson()
+                              for k, v in self.preProcessors.items()},
+        }, indent=2, default=str)
+
+    @staticmethod
+    def fromJson(s: str) -> "ComputationGraphConfiguration":
+        d = json.loads(s)
+        g = dict(d["globalConf"])
+        if isinstance(g.get("updater"), dict):
+            g["updater"] = IUpdater.fromJson(g["updater"])
+        nodes = {}
+        for name, nd in d["nodes"].items():
+            node = layer_from_json(nd["node"]) if nd["kind"] == "layer" \
+                else vertex_from_json(nd["node"])
+            nodes[name] = (node, list(nd["inputs"]))
+        return ComputationGraphConfiguration(
+            inputs=list(d["inputs"]),
+            inputTypes=[InputType.fromJson(t) for t in d.get("inputTypes", [])],
+            nodes=nodes, outputs=list(d["outputs"]),
+            preProcessors={k: InputPreProcessor.fromJson(v)
+                           for k, v in (d.get("preProcessors") or {}).items()},
+            globalConf=g)

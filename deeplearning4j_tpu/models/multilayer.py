@@ -71,6 +71,33 @@ def _grad_normalize(layer, g: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     raise ValueError(f"Unknown gradient normalization {mode}")
 
 
+def _updater_for(globalConf, layer, pname: str):
+    """Effective updater for one param (shared by MLN and ComputationGraph)."""
+    if pname == "b" and getattr(layer, "biasUpdater", None) is not None:
+        return layer.biasUpdater
+    return getattr(layer, "updater", None) or globalConf.get("updater") \
+        or Sgd(1e-2)
+
+
+def _reg_penalty(pairs):
+    """L1/L2 penalty over (layer, layer_params) pairs — added to the loss
+    (equivalent gradient to the reference's BEFORE_UPDATER modification)."""
+    total = 0.0
+    for layer, lp in pairs:
+        l1 = getattr(layer, "l1", None)
+        l2 = getattr(layer, "l2", None)
+        if not l1 and not l2:
+            continue
+        for k in layer.weightParamKeys():
+            if k in lp:
+                w = lp[k]
+                if l2:
+                    total = total + 0.5 * l2 * jnp.sum(w * w)
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+    return total
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -90,41 +117,53 @@ class MultiLayerNetwork:
     # initialization
     # ------------------------------------------------------------------
     def init(self, params: Optional[Params] = None) -> "MultiLayerNetwork":
-        if params is not None:
-            self.params_ = params
-        else:
-            root = jax.random.PRNGKey(self._rngSeed)
-            self.params_ = {}
+        """Build params/state/updater-state as ONE jitted computation.
+
+        Eager per-tensor init would issue hundreds of tiny dispatches (very
+        slow on a remote-compile TPU path); a single traced function compiles
+        once and materializes everything device-side.
+        """
+        def build_ps(root):
+            p_tree: Params = {}
+            s_tree: Dict[str, Dict[str, jax.Array]] = {}
             for i, layer in enumerate(self.conf.layers):
                 it = self.conf.layerInputTypes[i]
-                key = jax.random.fold_in(root, i)
-                p = layer.initParams(key, it, self._dtype)
+                p = layer.initParams(jax.random.fold_in(root, i), it,
+                                     self._dtype)
                 if p:
-                    self.params_[str(i)] = p
-        self.state_ = {}
-        for i, layer in enumerate(self.conf.layers):
-            if hasattr(layer, "initState"):
-                self.state_[str(i)] = layer.initState(
-                    self.conf.layerInputTypes[i], self._dtype)
+                    p_tree[str(i)] = p
+                if hasattr(layer, "initState"):
+                    s_tree[str(i)] = layer.initState(it, self._dtype)
+            return p_tree, s_tree
+
+        if params is not None:
+            self.params_ = params
+            self.state_ = jax.jit(lambda: {
+                str(i): layer.initState(self.conf.layerInputTypes[i],
+                                        self._dtype)
+                for i, layer in enumerate(self.conf.layers)
+                if hasattr(layer, "initState")})()
+        else:
+            self.params_, self.state_ = jax.jit(build_ps)(
+                jax.random.PRNGKey(self._rngSeed))
         self._initOptState()
         return self
 
     def _initOptState(self) -> None:
-        self.optState_ = {}
-        for i, layer in enumerate(self.conf.layers):
-            li = str(i)
-            if li not in (self.params_ or {}):
-                continue
-            self.optState_[li] = {}
-            for pname, pval in self.params_[li].items():
-                up = self._updaterFor(layer, pname)
-                self.optState_[li][pname] = up.init(pval)
+        def build_opt(p_tree):
+            opt = {}
+            for i, layer in enumerate(self.conf.layers):
+                li = str(i)
+                if li not in p_tree:
+                    continue
+                opt[li] = {pname: self._updaterFor(layer, pname).init(pval)
+                           for pname, pval in p_tree[li].items()}
+            return opt
+
+        self.optState_ = jax.jit(build_opt)(self.params_)
 
     def _updaterFor(self, layer, pname: str):
-        if pname == "b" and getattr(layer, "biasUpdater", None) is not None:
-            return layer.biasUpdater
-        return getattr(layer, "updater", None) or \
-            self.conf.globalConf.get("updater") or Sgd(1e-2)
+        return _updater_for(self.conf.globalConf, layer, pname)
 
     # ------------------------------------------------------------------
     # forward
@@ -147,25 +186,9 @@ class MultiLayerNetwork:
         return x, new_state
 
     def _regScore(self, params: Params):
-        """L1/L2 penalty added to the loss (equivalent gradient to the
-        reference's BEFORE_UPDATER gradient modification)."""
-        total = 0.0
-        for i, layer in enumerate(self.conf.layers):
-            li = str(i)
-            if li not in params:
-                continue
-            l1 = getattr(layer, "l1", None)
-            l2 = getattr(layer, "l2", None)
-            if not l1 and not l2:
-                continue
-            for k in layer.weightParamKeys():
-                if k in params[li]:
-                    w = params[li][k]
-                    if l2:
-                        total = total + 0.5 * l2 * jnp.sum(w * w)
-                    if l1:
-                        total = total + l1 * jnp.sum(jnp.abs(w))
-        return total
+        return _reg_penalty((layer, params[str(i)])
+                            for i, layer in enumerate(self.conf.layers)
+                            if str(i) in params)
 
     def _lossFn(self, params: Params, state, x, y, mask, key):
         out, new_state = self._forward(params, state, x, True, key, mask)
@@ -200,7 +223,7 @@ class MultiLayerNetwork:
                     up = self._updaterFor(layer, pname)
                     lr = up.currentLr(iteration, epoch)
                     update, ostate = up.apply(g[pname], optState[li][pname],
-                                              lr, iteration, epoch)
+                                              lr, iteration, epoch, param=pval)
                     wd = getattr(layer, "weightDecay", None)
                     if wd and pname in layer.weightParamKeys():
                         update = WeightDecay(coeff=wd).apply(pval, update, lr)

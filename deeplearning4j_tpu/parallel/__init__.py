@@ -1,0 +1,8 @@
+"""Parallelism over the TPU mesh (replaces reference L5 — SURVEY.md §2.6)."""
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh, P, shard_params  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode  # noqa: F401
+from deeplearning4j_tpu.parallel.sharedtraining import (  # noqa: F401
+    AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm, SharedTrainingMaster,
+    SparkDl4jMultiLayer, ThresholdAlgorithm, VoidConfiguration)
+from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
+    InferenceMode, ParallelInference)

@@ -1,0 +1,127 @@
+"""ParallelInference — batched multi-device serving.
+
+Reference: deeplearning4j-scaleout-parallelwrapper
+``org/deeplearning4j/parallelism/ParallelInference.java`` — request queueing,
+dynamic batching (``ObservablesProvider``), round-robin device workers
+(SURVEY.md §2.6 P4, §3.5).
+
+TPU-native design: one jitted forward, batch sharded over the data axis —
+XLA splits work across chips; a tiny batching queue provides the dynamic
+BATCHED-mode semantics.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.ops import NDArray
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+
+class InferenceMode:
+    SEQUENTIAL = "SEQUENTIAL"
+    BATCHED = "BATCHED"
+
+
+class ParallelInference:
+    def __init__(self, model, mesh: Optional[DeviceMesh] = None,
+                 inferenceMode: str = InferenceMode.BATCHED,
+                 batchLimit: int = 32, queueLimit: int = 64,
+                 workers: int = -1):
+        self.model = model
+        self.mesh = mesh
+        self.inferenceMode = inferenceMode
+        self.batchLimit = int(batchLimit)
+        self._q: "queue.Queue" = queue.Queue(maxsize=queueLimit)
+        self._lock = threading.Lock()
+        self._running = inferenceMode == InferenceMode.BATCHED
+        if self._running:
+            self._worker = threading.Thread(target=self._loop, daemon=True)
+            self._worker.start()
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def inferenceMode(self, m):
+            self._kw["inferenceMode"] = m
+            return self
+
+        def batchLimit(self, n):
+            self._kw["batchLimit"] = n
+            return self
+
+        def queueLimit(self, n):
+            self._kw["queueLimit"] = n
+            return self
+
+        def workers(self, n):
+            self._kw["workers"] = n
+            return self
+
+        def build(self):
+            return ParallelInference(self._model, **self._kw)
+
+    # -- serving ---------------------------------------------------------
+    def output(self, x) -> NDArray:
+        xv = np.asarray(x.numpy() if isinstance(x, NDArray) else x)
+        if self.inferenceMode == InferenceMode.SEQUENTIAL:
+            return self._run(xv)
+        if not self._running:
+            raise RuntimeError("ParallelInference has been shut down")
+        ev = threading.Event()
+        holder = {}
+        self._q.put((xv, ev, holder))
+        ev.wait()
+        if "err" in holder:
+            raise holder["err"]
+        return holder["out"]
+
+    def _run(self, xv: np.ndarray) -> NDArray:
+        with self._lock:
+            if self.mesh is not None and xv.shape[0] % self.mesh.dataSize == 0:
+                xs = self.mesh.shardBatch(xv)
+                return self.model.output(NDArray(xs))
+            return self.model.output(xv)
+
+    def _loop(self):
+        while self._running:
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            while len(batch) < self.batchLimit:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            xs = [b[0] for b in batch]
+            sizes = [x.shape[0] for x in xs]
+            try:
+                out = self._run(np.concatenate(xs, axis=0)).numpy()
+                pos = 0
+                for (x, ev, holder), n in zip(batch, sizes):
+                    holder["out"] = NDArray(out[pos:pos + n])
+                    pos += n
+                    ev.set()
+            except Exception as e:  # propagate to all waiters
+                for _, ev, holder in batch:
+                    holder["err"] = e
+                    ev.set()
+
+    def shutdown(self):
+        self._running = False
+        # fail any requests still queued so callers don't block forever
+        while True:
+            try:
+                _, ev, holder = self._q.get_nowait()
+            except queue.Empty:
+                break
+            holder["err"] = RuntimeError("ParallelInference shut down")
+            ev.set()

@@ -1,0 +1,101 @@
+"""Device mesh + sharding rules — the communication layer.
+
+Reference: the ENTIRE L5 stack of the reference — ``ParallelWrapper`` (local
+DP), Spark ``ParameterAveragingTrainingMaster`` / ``SharedTrainingMaster``
+(cluster DP over Aeron UDP mesh), and the ``nd4j-parameter-server`` v2 mesh
+(``MeshOrganizer``, ``AeronUdpTransport``) — SURVEY.md §2.6.
+
+TPU-native design: there is no hand-rolled transport.  A
+``jax.sharding.Mesh`` over the chips IS the mesh; gradient exchange is the
+XLA all-reduce that GSPMD inserts when a replicated-param / sharded-batch
+train step is compiled (``psum`` over ICI).  The threshold-compression knobs
+of the reference exist for parity but are no-ops — ICI bandwidth makes them
+counterproductive (SURVEY.md §2.6 TPU mapping note).
+
+Axes:
+- ``data``  — data parallel (batch dim) — DP
+- ``model`` — tensor parallel (feature dims of big matmuls) — TP
+- ``seq``   — sequence/context parallel (NEW capability vs reference, which
+  has none — SURVEY.md §5.7); used by ring attention in ``parallel.ring``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["DeviceMesh", "P"]
+
+
+class DeviceMesh:
+    """An ND device mesh with named axes (data, model[, seq])."""
+
+    def __init__(self, data: int = -1, model: int = 1, seq: int = 1,
+                 devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        if data == -1:
+            rest = model * seq
+            if n % rest:
+                raise ValueError(f"{n} devices not divisible by model*seq={rest}")
+            data = n // rest
+        if data * model * seq != n:
+            raise ValueError(f"mesh {data}x{model}x{seq} != {n} devices")
+        arr = np.array(devices).reshape(data, model, seq)
+        self.mesh = Mesh(arr, axis_names=("data", "model", "seq"))
+        self.dataSize, self.modelSize, self.seqSize = data, model, seq
+
+    # -- shardings ------------------------------------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def dataSharding(self) -> NamedSharding:
+        """Shard dim 0 (batch) over the data axis."""
+        return NamedSharding(self.mesh, P("data"))
+
+    def spec(self, *axes) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*axes))
+
+    def shardBatch(self, *arrays):
+        """Place batch arrays sharded over the data axis (dim 0)."""
+        sh = self.dataSharding()
+        out = tuple(jax.device_put(a, sh) for a in arrays)
+        return out if len(out) > 1 else out[0]
+
+    def numDevices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    def __repr__(self):
+        return (f"DeviceMesh(data={self.dataSize}, model={self.modelSize}, "
+                f"seq={self.seqSize}, devices={self.numDevices()})")
+
+
+def _dense_tp_spec(name: str, shape: Tuple[int, ...], modelAxis: str
+                   ) -> P:
+    """Default tensor-parallel rule: column-shard 2D weights, shard the
+    matching bias; everything else replicated.  GSPMD propagates the rest."""
+    if name == "W" and len(shape) == 2:
+        return P(None, modelAxis)
+    if name == "b" and len(shape) == 1:
+        return P(modelAxis)
+    return P()
+
+
+def shard_params(mesh: DeviceMesh, params: Dict, tensorParallel: bool = False):
+    """Place a params pytree on the mesh: replicated (pure DP) or with the
+    default TP rule over the ``model`` axis."""
+    if not tensorParallel or mesh.modelSize == 1:
+        return jax.device_put(params, mesh.replicated())
+    out = {}
+    for li, lp in params.items():
+        out[li] = {}
+        for name, val in lp.items():
+            spec = _dense_tp_spec(name, tuple(val.shape), "model")
+            try:
+                out[li][name] = jax.device_put(
+                    val, NamedSharding(mesh.mesh, spec))
+            except ValueError:  # dim not divisible by axis size: replicate
+                out[li][name] = jax.device_put(val, mesh.replicated())
+    return out

@@ -1,0 +1,120 @@
+"""SharedTrainingMaster — cluster data-parallel training launcher.
+
+Reference: dl4j-spark-parameterserver
+``org/deeplearning4j/spark/parameterserver/training/SharedTrainingMaster.java``
++ ``SharedTrainingWrapper`` + ``UpdatesConsumer`` + the Aeron UDP mesh
+(``AeronUdpTransport``, ``MeshOrganizer``) — SURVEY.md §2.6 P3, §3.4.
+
+TPU-native design (the BASELINE.json north star): the entire
+threshold-encode → Aeron-push → decode-apply pipeline collapses into the XLA
+all-reduce inside one compiled step over the TPU mesh (ICI in-slice, DCN
+across slices via ``jax.distributed``).  API parity is kept:
+``VoidConfiguration`` and the threshold/encoding knobs are accepted and
+recorded but are documented no-ops — with ICI bandwidth, compression hurts.
+Semantics upgrade per SURVEY.md §7.3: the reference's ASYNC delayed-delta
+updates become SYNChronous all-reduce (better convergence, same API).
+
+Multi-host: call ``SharedTrainingMaster.connect(coordinator, rank, n)`` →
+``jax.distributed.initialize`` (the launcher role the Spark driver played).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+@dataclasses.dataclass
+class VoidConfiguration:
+    """Reference: nd4j-parameter-server ``conf/VoidConfiguration.java``.
+    Transport knobs are meaningless on ICI; kept for config parity."""
+    networkMask: Optional[str] = None
+    controllerAddress: Optional[str] = None
+    unicastPort: int = 40123
+    streamId: int = 119
+    meshBuildMode: str = "MESH"
+
+
+class ThresholdAlgorithm:
+    """Reference: AdaptiveThresholdAlgorithm etc. — no-op on TPU."""
+
+    def __init__(self, initialThreshold: float = 1e-3, **kw):
+        self.initialThreshold = initialThreshold
+
+
+AdaptiveThresholdAlgorithm = ThresholdAlgorithm
+FixedThresholdAlgorithm = ThresholdAlgorithm
+
+
+class SharedTrainingMaster:
+    def __init__(self, voidConfiguration: Optional[VoidConfiguration] = None,
+                 batchSizePerWorker: int = 32,
+                 workersPerNode: int = -1,
+                 thresholdAlgorithm: Optional[ThresholdAlgorithm] = None,
+                 mesh: Optional[DeviceMesh] = None, **_ignored):
+        self.voidConfiguration = voidConfiguration or VoidConfiguration()
+        self.batchSizePerWorker = batchSizePerWorker
+        self.workersPerNode = workersPerNode
+        self.thresholdAlgorithm = thresholdAlgorithm  # recorded, unused
+        self.mesh = mesh
+
+    class Builder:
+        def __init__(self, voidConfiguration=None, rddDataSetNumExamples: int = 1):
+            self._kw = {"voidConfiguration": voidConfiguration}
+
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+
+            def setter(v):
+                self._kw[name] = v
+                return self
+
+            return setter
+
+        def build(self) -> "SharedTrainingMaster":
+            known = {"voidConfiguration", "batchSizePerWorker",
+                     "workersPerNode", "thresholdAlgorithm", "mesh"}
+            kw = {k: v for k, v in self._kw.items() if k in known}
+            return SharedTrainingMaster(**kw)
+
+    # -- multi-host launcher --------------------------------------------
+    @staticmethod
+    def connect(coordinator_address: str, process_id: int, num_processes: int
+                ) -> None:
+        """Join the JAX distributed runtime (replaces the Spark driver +
+        Aeron handshake of SURVEY.md §3.4)."""
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   process_id=process_id,
+                                   num_processes=num_processes)
+
+    # -- training --------------------------------------------------------
+    def fitMultiLayerNetwork(self, net, iterator, epochs: int = 1):
+        mesh = self.mesh or DeviceMesh()
+        ParallelWrapper(net, mesh=mesh).fit(iterator, epochs=epochs)
+        return net
+
+    executeTraining = fitMultiLayerNetwork
+
+
+class SparkDl4jMultiLayer:
+    """Reference: dl4j-spark ``SparkDl4jMultiLayer`` — driver-side facade.
+    Here 'the cluster' is the TPU mesh; the RDD is any DataSetIterator."""
+
+    def __init__(self, sparkContext=None, net=None, trainingMaster=None):
+        self.net = net
+        self.trainingMaster = trainingMaster or SharedTrainingMaster()
+
+    def fit(self, iterator, epochs: int = 1):
+        return self.trainingMaster.fitMultiLayerNetwork(self.net, iterator,
+                                                        epochs=epochs)
+
+    def getNetwork(self):
+        return self.net
+
+    def evaluate(self, iterator):
+        return self.net.evaluate(iterator)

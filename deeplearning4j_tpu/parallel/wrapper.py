@@ -1,0 +1,114 @@
+"""ParallelWrapper — single-node multi-device data-parallel training.
+
+Reference: deeplearning4j-scaleout-parallelwrapper
+``org/deeplearning4j/parallelism/ParallelWrapper.java`` — the reference
+clones the model per device, runs a trainer thread per device, and
+averages params / shares threshold-encoded gradients every N iterations
+(SURVEY.md §2.6 P1).
+
+TPU-native design: no clones, no trainer threads, no averaging step.  The
+wrapped model's ONE fused train step is compiled with the batch sharded over
+the ``data`` mesh axis and params replicated; GSPMD inserts the gradient
+all-reduce (psum over ICI) inside the executable.  This is mathematically the
+reference's synchronous averaging with averagingFrequency=1 — every device
+steps with the globally-averaged gradient — at ICI speed.  The
+``trainingMode``/``averagingFrequency``/threshold knobs are accepted for API
+parity and ignored (documented no-ops, SURVEY.md §7.1).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh, shard_params
+
+
+class TrainingMode:
+    AVERAGING = "AVERAGING"
+    SHARED_GRADIENTS = "SHARED_GRADIENTS"
+    CUSTOM = "CUSTOM"
+
+
+class ParallelWrapper:
+    """``ParallelWrapper.Builder(net).workers(N)...build()`` parity."""
+
+    def __init__(self, model, mesh: Optional[DeviceMesh] = None,
+                 tensorParallel: bool = False, **_ignored):
+        self.model = model
+        self.mesh = mesh or DeviceMesh()
+        self.tensorParallel = tensorParallel
+
+    # -- builder ---------------------------------------------------------
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def workers(self, n: int):
+            self._kw["workers"] = n
+            return self
+
+        def trainingMode(self, mode: str):
+            self._kw["trainingMode"] = mode  # accepted, no-op (see module doc)
+            return self
+
+        def averagingFrequency(self, n: int):
+            self._kw["averagingFrequency"] = n  # no-op
+            return self
+
+        def prefetchBuffer(self, n: int):
+            self._kw["prefetchBuffer"] = n  # no-op (input pipeline is async)
+            return self
+
+        def thresholdAlgorithm(self, algo):
+            self._kw["thresholdAlgorithm"] = algo  # no-op: ICI needs no compression
+            return self
+
+        def residualPostProcessor(self, p):
+            self._kw["residualPostProcessor"] = p  # no-op
+            return self
+
+        def workspaceMode(self, m):
+            return self
+
+        def build(self) -> "ParallelWrapper":
+            workers = self._kw.get("workers")
+            mesh = None
+            if workers:
+                mesh = DeviceMesh(data=workers,
+                                  devices=jax.devices()[:workers])
+            return ParallelWrapper(self._model, mesh=mesh)
+
+    # -- API -------------------------------------------------------------
+    def fit(self, iterator, epochs: int = 1) -> None:
+        """Train with batches sharded across the mesh's data axis."""
+        net = self.model
+        if net.params_ is None:
+            net.init()
+        net.params_ = shard_params(self.mesh, net.params_,
+                                   self.tensorParallel)
+        if net.optState_ is not None:
+            net.optState_ = jax.device_put(net.optState_, self.mesh.replicated()) \
+                if not self.tensorParallel else net.optState_
+        orig_fitBatch = net._fitBatch
+
+        def shard_one(arr):
+            if arr is not None and arr.shape[0] % self.mesh.dataSize == 0:
+                arr._value = self.mesh.shardBatch(arr.jax)
+
+        def shardedFitBatch(ds):
+            feats = ds.features if isinstance(ds.features, list) else [ds.features]
+            labs = ds.labels if isinstance(ds.labels, list) else [ds.labels]
+            for a in feats + labs:
+                shard_one(a)
+            orig_fitBatch(ds)
+
+        net._fitBatch = shardedFitBatch
+        try:
+            net.fit(iterator, epochs=epochs)
+        finally:
+            net._fitBatch = orig_fitBatch
+
+    def shutdown(self) -> None:
+        pass

@@ -1,0 +1,3 @@
+"""Model zoo (reference: deeplearning4j-zoo org/deeplearning4j/zoo)."""
+from deeplearning4j_tpu.zoo.models import (  # noqa: F401
+    AlexNet, LeNet, ResNet50, SimpleCNN, VGG16, ZooModel)

@@ -1,0 +1,200 @@
+"""T3 tests: ComputationGraph, vertices, zoo, serialization.
+
+Modeled on the reference's ComputationGraph tests + zoo instantiation tests
+(deeplearning4j-zoo src/test — SURVEY.md §4 integration tests).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import (ComputationGraph,
+                                       ComputationGraphConfiguration,
+                                       ElementWiseVertex, MergeVertex,
+                                       SubsetVertex)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.utils import ModelSerializer
+from deeplearning4j_tpu.zoo import LeNet, ResNet50, SimpleCNN
+
+
+def toy(n=128, nin=4, nout=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[
+        np.clip((x.sum(1) > 0).astype(int) + (x[:, 0] > 1).astype(int),
+                0, nout - 1)]
+    return x, y
+
+
+def simple_graph_conf():
+    return (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+            .graphBuilder()
+            .addInputs("in")
+            .setInputTypes(InputType.feedForward(4))
+            .addLayer("d1", DenseLayer.builder().nOut(8).activation("relu")
+                      .build(), "in")
+            .addLayer("d2", DenseLayer.builder().nOut(8).activation("relu")
+                      .build(), "in")
+            .addVertex("merge", MergeVertex(), "d1", "d2")
+            .addLayer("out", OutputLayer.builder("mcxent").nOut(3)
+                      .activation("softmax").build(), "merge")
+            .setOutputs("out")
+            .build())
+
+
+class TestGraphConf:
+    def test_topo_and_shape_inference(self):
+        conf = simple_graph_conf()
+        assert conf.topoOrder.index("merge") > conf.topoOrder.index("d1")
+        assert conf.topoOrder.index("out") > conf.topoOrder.index("merge")
+        assert conf.nodes["out"][0].nIn == 16  # merged 8+8
+
+    def test_cycle_detection(self):
+        gb = (NeuralNetConfiguration.builder().graphBuilder()
+              .addInputs("in")
+              .addLayer("a", DenseLayer.builder().nIn(2).nOut(2).build(), "b")
+              .addLayer("b", DenseLayer.builder().nIn(2).nOut(2).build(), "a")
+              .setOutputs("b"))
+        with pytest.raises(ValueError, match="cycle"):
+            gb.build()
+
+    def test_unknown_input_rejected(self):
+        gb = (NeuralNetConfiguration.builder().graphBuilder()
+              .addInputs("in")
+              .addLayer("a", DenseLayer.builder().nIn(2).nOut(2).build(),
+                        "nonexistent")
+              .setOutputs("a"))
+        with pytest.raises(ValueError, match="unknown input"):
+            gb.build()
+
+    def test_json_roundtrip(self):
+        conf = simple_graph_conf()
+        back = ComputationGraphConfiguration.fromJson(conf.toJson())
+        assert back.topoOrder == conf.topoOrder
+        assert back.nodes["out"][0].nIn == 16
+        assert isinstance(back.nodes["merge"][0], MergeVertex)
+
+
+class TestGraphTraining:
+    def test_multibranch_learns(self):
+        x, y = toy()
+        net = ComputationGraph(simple_graph_conf())
+        net.init()
+        ds = DataSet(x, y)
+        net.fit(ds)
+        s0 = net.score()
+        for _ in range(60):
+            net.fit(ds)
+        assert net.score() < s0 * 0.6
+        ev = net.evaluate(ListDataSetIterator([ds]))
+        assert ev.accuracy() > 0.8
+
+    def test_elementwise_residual(self):
+        x, y = toy()
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+                .graphBuilder()
+                .addInputs("in")
+                .setInputTypes(InputType.feedForward(4))
+                .addLayer("proj", DenseLayer.builder().nOut(8)
+                          .activation("identity").build(), "in")
+                .addLayer("h", DenseLayer.builder().nOut(8).activation("relu")
+                          .build(), "proj")
+                .addVertex("res", ElementWiseVertex("Add"), "proj", "h")
+                .addLayer("out", OutputLayer.builder("mcxent").nOut(3)
+                          .activation("softmax").build(), "res")
+                .setOutputs("out").build())
+        net = ComputationGraph(conf)
+        net.init()
+        for _ in range(40):
+            net.fit(DataSet(x, y))
+        assert np.isfinite(net.score())
+        assert net.score() < 1.0
+
+    def test_subset_vertex(self):
+        conf = (NeuralNetConfiguration.builder().updater(Adam(0.01))
+                .graphBuilder()
+                .addInputs("in")
+                .setInputTypes(InputType.feedForward(6))
+                .addVertex("first3", SubsetVertex(0, 2), "in")
+                .addLayer("out", OutputLayer.builder("mse").nOut(2)
+                          .activation("identity").build(), "first3")
+                .setOutputs("out").build())
+        assert conf.nodes["out"][0].nIn == 3
+        net = ComputationGraph(conf)
+        net.init()
+        out = net.output(np.ones((2, 6), dtype=np.float32))
+        assert out.shape == (2, 2)
+
+    def test_multi_output(self):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        x, y = toy(64)
+        yreg = x.sum(axis=1, keepdims=True).astype(np.float32)
+        conf = (NeuralNetConfiguration.builder().updater(Adam(0.01))
+                .graphBuilder()
+                .addInputs("in")
+                .setInputTypes(InputType.feedForward(4))
+                .addLayer("trunk", DenseLayer.builder().nOut(8)
+                          .activation("relu").build(), "in")
+                .addLayer("cls", OutputLayer.builder("mcxent").nOut(3)
+                          .activation("softmax").build(), "trunk")
+                .addLayer("reg", OutputLayer.builder("mse").nOut(1)
+                          .activation("identity").build(), "trunk")
+                .setOutputs("cls", "reg").build())
+        net = ComputationGraph(conf)
+        net.init()
+        mds = MultiDataSet([x], [y, yreg])
+        for _ in range(10):
+            net.fit(mds)
+        outs = net.output(x[:4])
+        assert isinstance(outs, list) and len(outs) == 2
+        assert outs[0].shape == (4, 3) and outs[1].shape == (4, 1)
+
+    def test_graph_serialization(self, tmp_path):
+        x, y = toy(32)
+        net = ComputationGraph(simple_graph_conf())
+        net.init()
+        net.fit(DataSet(x, y))
+        p = tmp_path / "graph.zip"
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreComputationGraph(p)
+        np.testing.assert_allclose(net2.output(x[:4]).numpy(),
+                                   net.output(x[:4]).numpy(), rtol=1e-6)
+
+
+class TestZoo:
+    def test_lenet(self):
+        net = LeNet().init()
+        assert net.numParams() == 431080
+        out = net.output(np.zeros((2, 784), dtype=np.float32))
+        assert out.shape == (2, 10)
+
+    def test_simplecnn(self):
+        net = SimpleCNN(numClasses=5, inputShape=(3, 32, 32)).init()
+        out = net.output(np.zeros((2, 3, 32, 32), dtype=np.float32))
+        assert out.shape == (2, 5)
+
+    def test_resnet50_structure(self):
+        """ResNet-50 at reduced resolution: correct block count + params.
+
+        Reference parity: 53 conv layers + fc, ~25.6M params at 1000 classes.
+        """
+        model = ResNet50(numClasses=10, inputShape=(3, 64, 64))
+        conf = model.graphBuilder().build()
+        convs = [n for n in conf.nodes if n.endswith("_conv")]
+        assert len(convs) == 53  # 1 stem + 3*(3+4+6+3) bottleneck convs + 4 shortcut
+        net = ComputationGraph(conf)
+        net.init()
+        # 25.6M − fc(2048*1000+1000) + fc(2048*10+10) ≈ 23.6M
+        assert 23_000_000 < net.numParams() < 24_200_000
+
+    def test_resnet50_forward_and_train_step(self):
+        net = ResNet50(numClasses=4, inputShape=(3, 32, 32)).init()
+        x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out.numpy().sum(axis=1), 1.0, rtol=1e-4)
+        y = np.eye(4, dtype=np.float32)[[0, 1]]
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score())

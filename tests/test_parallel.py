@@ -1,0 +1,135 @@
+"""T3 tests: device mesh, data/tensor-parallel training, parallel inference.
+
+Runs on the 8-virtual-CPU-device mesh (conftest), the analogue of the
+reference's DummyTransport / local[N] Spark distributed tests (SURVEY.md §4).
+"""
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (DeviceMesh, ParallelInference,
+                                         ParallelWrapper, SharedTrainingMaster,
+                                         SparkDl4jMultiLayer, VoidConfiguration,
+                                         shard_params)
+
+
+def mlp():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01)).list()
+            .layer(DenseLayer.builder().nIn(8).nOut(16).activation("relu")
+                   .build())
+            .layer(OutputLayer.builder("mcxent").nOut(4).activation("softmax")
+                   .build())
+            .setInputType(InputType.feedForward(8)).build())
+    return MultiLayerNetwork(conf)
+
+
+def toy(n=256, nin=8, nout=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.randint(0, nout, n)]
+    # make it learnable: labels from a fixed random projection
+    w = np.random.RandomState(1).randn(nin, nout)
+    y = np.eye(nout, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+class TestDeviceMesh:
+    def test_mesh_shapes(self):
+        assert len(jax.devices()) == 8
+        m = DeviceMesh()
+        assert m.dataSize == 8 and m.modelSize == 1
+        m2 = DeviceMesh(data=4, model=2)
+        assert m2.numDevices() == 8
+
+    def test_shard_batch(self):
+        m = DeviceMesh()
+        x = np.zeros((16, 4), dtype=np.float32)
+        xs = m.shardBatch(x)
+        assert len(xs.sharding.device_set) == 8
+
+    def test_shard_params_tp(self):
+        m = DeviceMesh(data=4, model=2)
+        params = {"0": {"W": np.zeros((8, 16), np.float32),
+                        "b": np.zeros((16,), np.float32)}}
+        sp = shard_params(m, params, tensorParallel=True)
+        assert len(sp["0"]["W"].sharding.device_set) == 8
+
+
+class TestParallelWrapper:
+    def test_dp_training_learns(self):
+        x, y = toy()
+        net = mlp()
+        net.init()
+        pw = (ParallelWrapper.Builder(net).workers(8)
+              .trainingMode("SHARED_GRADIENTS").averagingFrequency(5).build())
+        it = ListDataSetIterator([DataSet(x, y)], batch=64)
+        pw.fit(it, epochs=20)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.8
+
+    def test_dp_matches_single_device(self):
+        """Sharded-batch step == single-device step (sync all-reduce DP is
+        mathematically identical to large-batch SGD)."""
+        x, y = toy(64)
+        n1, n2 = mlp(), mlp()
+        n1.init()
+        n2.init()
+        ds1, ds2 = DataSet(x, y), DataSet(x, y)
+        n1.fit(ds1)  # single device
+        ParallelWrapper(n2, mesh=DeviceMesh()).fit(
+            ListDataSetIterator([ds2]), epochs=1)
+        np.testing.assert_allclose(n1.params().numpy(), n2.params().numpy(),
+                                   rtol=2e-4, atol=2e-6)
+
+    def test_tensor_parallel_step(self):
+        x, y = toy(64)
+        net = mlp()
+        net.init()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=4, model=2),
+                             tensorParallel=True)
+        pw.fit(ListDataSetIterator([DataSet(x, y)]), epochs=2)
+        assert np.isfinite(net.score())
+
+
+class TestSharedTrainingMaster:
+    def test_api_parity_fit(self):
+        x, y = toy()
+        net = mlp()
+        net.init()
+        tm = (SharedTrainingMaster.Builder(VoidConfiguration(unicastPort=40123))
+              .batchSizePerWorker(32).workersPerNode(8)
+              .thresholdAlgorithm(None).build())
+        spark_net = SparkDl4jMultiLayer(None, net, tm)
+        it = ListDataSetIterator([DataSet(x, y)], batch=64)
+        spark_net.fit(it, epochs=10)
+        assert spark_net.evaluate(it).accuracy() > 0.6
+
+
+class TestParallelInference:
+    def test_sequential_mode(self):
+        net = mlp()
+        net.init()
+        pi = (ParallelInference.Builder(net).inferenceMode("SEQUENTIAL")
+              .build())
+        out = pi.output(np.zeros((4, 8), dtype=np.float32))
+        assert out.shape == (4, 4)
+
+    def test_batched_mode_concurrent(self):
+        import threading
+        net = mlp()
+        net.init()
+        pi = (ParallelInference.Builder(net).inferenceMode("BATCHED")
+              .batchLimit(16).build())
+        results = [None] * 8
+        def call(i):
+            results[i] = pi.output(np.full((2, 8), i, dtype=np.float32))
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        ref = net.output(np.full((2, 8), 3, dtype=np.float32)).numpy()
+        np.testing.assert_allclose(results[3].numpy(), ref, rtol=1e-5)
+        pi.shutdown()
