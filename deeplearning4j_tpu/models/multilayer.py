@@ -32,10 +32,11 @@ from deeplearning4j_tpu.ops import NDArray
 
 Params = Dict[str, Dict[str, jax.Array]]
 
-#: canonical intra-layer param order (serialization parity: W before b,
-#: matching DL4J's flattened-view layout; BN adds gamma/beta)
-_PARAM_ORDER = ["W", "b", "gamma", "beta", "Wi", "Wr", "bi",
-                "Wf", "Wo", "Wg", "Wx", "Wh"]
+#: canonical intra-layer param order (serialization parity: DL4J's
+#: flattened-view layout — input weights, recurrent weights, bias;
+#: BN adds gamma/beta; GravesLSTM peepholes; Bidirectional fwd/bwd halves)
+_PARAM_ORDER = ["W", "RW", "b", "gamma", "beta", "pI", "pF", "pO",
+                "fwd", "bwd"]
 
 
 def _param_key_order(keys):
@@ -44,30 +45,57 @@ def _param_key_order(keys):
     return known + rest
 
 
-def _grad_normalize(layer, g: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+def _iter_leaf_params(lp: Dict, prefix: str = ""):
+    """Yield ``(path, pname, value)`` over a layer's params in canonical
+    order, descending into nested dicts (Bidirectional's fwd/bwd halves)."""
+    for k in _param_key_order(lp.keys()):
+        v = lp[k]
+        if isinstance(v, dict):
+            yield from _iter_leaf_params(v, prefix + k + "/")
+        else:
+            yield prefix + k, k, v
+
+
+def _get_leaf(d: Dict, path: str):
+    for p in path.split("/"):
+        d = d[p]
+    return d
+
+
+def _set_leaf(d: Dict, path: str, value) -> None:
+    parts = path.split("/")
+    for p in parts[:-1]:
+        d = d.setdefault(p, {})
+    d[parts[-1]] = value
+
+
+def _grad_normalize(layer, g):
     """Per-layer gradient normalization (reference:
-    ``BaseMultiLayerUpdater.preApply``)."""
+    ``BaseMultiLayerUpdater.preApply``).  Tree-aware: ``g`` may contain
+    nested dicts (Bidirectional)."""
     mode = getattr(layer, "gradientNormalization", None)
     if not mode or mode == GradientNormalization.None_:
         return g
     thr = getattr(layer, "gradientNormalizationThreshold", None) or 1.0
+    tm = jax.tree_util.tree_map
+
+    def layer_norm():
+        return jnp.sqrt(sum(jnp.sum(v * v)
+                            for v in jax.tree_util.tree_leaves(g)) + 1e-12)
+
     if mode == GradientNormalization.RenormalizeL2PerLayer:
-        norm = jnp.sqrt(sum(jnp.sum(v * v) for v in g.values()) + 1e-12)
-        return {k: v / norm for k, v in g.items()}
+        norm = layer_norm()
+        return tm(lambda v: v / norm, g)
     if mode == GradientNormalization.RenormalizeL2PerParamType:
-        return {k: v / jnp.sqrt(jnp.sum(v * v) + 1e-12) for k, v in g.items()}
+        return tm(lambda v: v / jnp.sqrt(jnp.sum(v * v) + 1e-12), g)
     if mode == GradientNormalization.ClipElementWiseAbsoluteValue:
-        return {k: jnp.clip(v, -thr, thr) for k, v in g.items()}
+        return tm(lambda v: jnp.clip(v, -thr, thr), g)
     if mode == GradientNormalization.ClipL2PerLayer:
-        norm = jnp.sqrt(sum(jnp.sum(v * v) for v in g.values()) + 1e-12)
-        scale = jnp.minimum(1.0, thr / norm)
-        return {k: v * scale for k, v in g.items()}
+        scale = jnp.minimum(1.0, thr / layer_norm())
+        return tm(lambda v: v * scale, g)
     if mode == GradientNormalization.ClipL2PerParamType:
-        out = {}
-        for k, v in g.items():
-            norm = jnp.sqrt(jnp.sum(v * v) + 1e-12)
-            out[k] = v * jnp.minimum(1.0, thr / norm)
-        return out
+        return tm(lambda v: v * jnp.minimum(
+            1.0, thr / jnp.sqrt(jnp.sum(v * v) + 1e-12)), g)
     raise ValueError(f"Unknown gradient normalization {mode}")
 
 
@@ -88,9 +116,9 @@ def _reg_penalty(pairs):
         l2 = getattr(layer, "l2", None)
         if not l1 and not l2:
             continue
-        for k in layer.weightParamKeys():
-            if k in lp:
-                w = lp[k]
+        wkeys = layer.weightParamKeys()
+        for _path, pname, w in _iter_leaf_params(lp):
+            if pname in wkeys:
                 if l2:
                     total = total + 0.5 * l2 * jnp.sum(w * w)
                 if l1:
@@ -112,6 +140,7 @@ class MultiLayerNetwork:
         self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
         self._dtype = jnp.float32
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x5EED)
+        self._rnnCarries = None  # rnnTimeStep stateMap (per RNN layer idx)
 
     # ------------------------------------------------------------------
     # initialization
@@ -156,8 +185,9 @@ class MultiLayerNetwork:
                 li = str(i)
                 if li not in p_tree:
                     continue
-                opt[li] = {pname: self._updaterFor(layer, pname).init(pval)
-                           for pname, pval in p_tree[li].items()}
+                opt[li] = {path: self._updaterFor(layer, pname).init(pval)
+                           for path, pname, pval
+                           in _iter_leaf_params(p_tree[li])}
             return opt
 
         self.optState_ = jax.jit(build_opt)(self.params_)
@@ -168,36 +198,54 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # forward
     # ------------------------------------------------------------------
-    def _forward(self, params: Params, state, x, train: bool, key, mask=None):
+    def _forward(self, params: Params, state, x, train: bool, key, mask=None,
+                 carries=None):
+        """Run the stack.  ``mask`` is the (b, t) feature/timestep mask;
+        ``carries`` maps RNN layer index -> initial carry (None = zeros,
+        i.e. fresh sequences).  Returns (out, new_state, new_carries) — the
+        reference's analogue of carries is the rnn ``stateMap`` used by
+        ``rnnTimeStep``/TBPTT (``MultiLayerNetwork.rnnActivateUsingStoredState``).
+        """
         miniBatch = x.shape[0]
         new_state = {}
+        new_carries = {}
         for i, layer in enumerate(self.conf.layers):
             if i in self.conf.preProcessors:
                 x = self.conf.preProcessors[i].preProcess(x, miniBatch)
             lkey = jax.random.fold_in(key, i) if key is not None else None
             st = state.get(str(i), {})
             p = params.get(str(i), {})
-            if type(layer).__name__ == "GlobalPoolingLayer" and mask is not None:
+            if getattr(layer, "isRNN", False):
+                c0 = (carries or {}).get(str(i))
+                if c0 is None:
+                    c0 = layer.initialCarry(x.shape[0], x.dtype)
+                x, cfin = layer.scanSeq(p, x, train, lkey, c0, mask)
+                new_carries[str(i)] = cfin
+                st2 = st
+            elif getattr(layer, "acceptsMask", False):
                 x, st2 = layer.forward(p, x, train, lkey, st, mask=mask)
             else:
                 x, st2 = layer.forward(p, x, train, lkey, st)
             if st2:
                 new_state[str(i)] = st2
-        return x, new_state
+        return x, new_state, new_carries
 
     def _regScore(self, params: Params):
         return _reg_penalty((layer, params[str(i)])
                             for i, layer in enumerate(self.conf.layers)
                             if str(i) in params)
 
-    def _lossFn(self, params: Params, state, x, y, mask, key):
-        out, new_state = self._forward(params, state, x, True, key, mask)
+    def _lossFn(self, params: Params, state, x, y, fmask, lmask, key,
+                carries=None):
+        out, new_state, new_carries = self._forward(params, state, x, True,
+                                                    key, fmask, carries)
         outLayer = self.conf.layers[-1]
         if not outLayer.hasLoss():
             raise ValueError("Last layer must be an output/loss layer to fit")
-        per_ex = outLayer.computeScore(y, out, mask)
+        per_ex = outLayer.computeScore(y, out, lmask)
         data_loss = jnp.mean(per_ex)
-        return data_loss + self._regScore(params), (new_state, data_loss)
+        return (data_loss + self._regScore(params),
+                (new_state, new_carries, data_loss))
 
     # ------------------------------------------------------------------
     # the fused train step (single XLA executable)
@@ -206,10 +254,11 @@ class MultiLayerNetwork:
     def _trainStep(self):
         layers = self.conf.layers
 
-        def step(params, optState, state, x, y, mask, key, iteration, epoch):
+        def step(params, optState, state, x, y, fmask, lmask, key,
+                 iteration, epoch, carries):
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
-            (loss, (new_state, data_loss)), grads = grad_fn(
-                params, state, x, y, mask, key)
+            (loss, (new_state, new_carries, data_loss)), grads = grad_fn(
+                params, state, x, y, fmask, lmask, key, carries)
             new_params: Params = {}
             new_opt: Dict = {}
             for i, layer in enumerate(layers):
@@ -219,32 +268,34 @@ class MultiLayerNetwork:
                 g = _grad_normalize(layer, grads[li])
                 new_params[li] = {}
                 new_opt[li] = {}
-                for pname, pval in params[li].items():
+                for path, pname, pval in _iter_leaf_params(params[li]):
                     up = self._updaterFor(layer, pname)
                     lr = up.currentLr(iteration, epoch)
-                    update, ostate = up.apply(g[pname], optState[li][pname],
+                    update, ostate = up.apply(_get_leaf(g, path),
+                                              optState[li][path],
                                               lr, iteration, epoch, param=pval)
                     wd = getattr(layer, "weightDecay", None)
                     if wd and pname in layer.weightParamKeys():
                         update = WeightDecay(coeff=wd).apply(pval, update, lr)
-                    new_params[li][pname] = pval - update
-                    new_opt[li][pname] = ostate
-            return new_params, new_opt, new_state, loss
+                    _set_leaf(new_params[li], path, pval - update)
+                    new_opt[li][path] = ostate
+            return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _outputFn(self):
-        def run(params, state, x):
-            out, _ = self._forward(params, state, x, False, None)
-            return out
+        def run(params, state, x, fmask, carries):
+            out, _, new_carries = self._forward(params, state, x, False,
+                                                None, fmask, carries)
+            return out, new_carries
         return jax.jit(run)
 
     @functools.cached_property
     def _scoreFn(self):
-        def run(params, state, x, y, mask):
-            out, _ = self._forward(params, state, x, False, None, mask)
-            per_ex = self.conf.layers[-1].computeScore(y, out, mask)
+        def run(params, state, x, y, fmask, lmask):
+            out, _, _ = self._forward(params, state, x, False, None, fmask)
+            per_ex = self.conf.layers[-1].computeScore(y, out, lmask)
             return jnp.mean(per_ex) + self._regScore(params)
         return jax.jit(run)
 
@@ -275,25 +326,114 @@ class MultiLayerNetwork:
             l.onEpochEnd(self)
 
     def _fitBatch(self, ds: DataSet) -> None:
+        from deeplearning4j_tpu.nn.conf import BackpropType
         x = ds.features.jax.astype(self._dtype)
         y = ds.labels.jax
-        mask = ds.labelsMask.jax if ds.labelsMask is not None else None
+        fmask = ds.featuresMask.jax if ds.featuresMask is not None else None
+        lmask = ds.labelsMask.jax if ds.labelsMask is not None else None
         self.lastBatchSize = int(x.shape[0])
-        self._fitKey, key = jax.random.split(self._fitKey)
-        self.params_, self.optState_, new_state, loss = self._trainStep(
-            self.params_, self.optState_, self.state_, x, y, mask, key,
-            jnp.asarray(self.iterationCount), jnp.asarray(self.epochCount))
-        if new_state:
-            self.state_.update(new_state)
-        self._score = float(loss)
+
+        # TBPTT needs per-timestep (rank-3) labels; otherwise fall back to
+        # standard BP (reference: doTruncatedBPTT label-rank requirement)
+        if (self.conf.backpropType == BackpropType.TruncatedBPTT
+                and x.ndim == 3 and y.ndim == 3
+                and x.shape[2] > self.conf.tbpttFwdLength):
+            self._fitTbptt(x, y, fmask, lmask)
+        else:
+            self._runTrainStep(x, y, fmask, lmask, carries=None)
         self.iterationCount += 1
         for l in self._listeners:
             l.iterationDone(self, self.iterationCount, self.epochCount)
 
-    def output(self, x, train: bool = False) -> NDArray:
+    def _runTrainStep(self, x, y, fmask, lmask, carries):
+        self._fitKey, key = jax.random.split(self._fitKey)
+        (self.params_, self.optState_, new_state, loss,
+         new_carries) = self._trainStep(
+            self.params_, self.optState_, self.state_, x, y, fmask, lmask,
+            key, jnp.asarray(self.iterationCount),
+            jnp.asarray(self.epochCount), carries)
+        if new_state:
+            self.state_.update(new_state)
+        self._score = float(loss)
+        return new_carries
+
+    def _fitTbptt(self, x, y, fmask, lmask) -> None:
+        """Truncated BPTT: chunk the time axis, carry RNN state (detached)
+        across chunks.  Reference: ``MultiLayerNetwork.doTruncatedBPTT`` +
+        ``rnnActivateUsingStoredState``."""
+        t = x.shape[2]
+        L = self.conf.tbpttFwdLength
+        # explicit zero carries for chunk 0: keeps the carry pytree structure
+        # identical across chunks, so the train step traces/compiles ONCE
+        carries = self._zeroCarries(x.shape[0])
+        for start in range(0, t, L):
+            end = min(start + L, t)
+            xc = x[:, :, start:end]
+            yc = y[:, :, start:end] if y.ndim == 3 else y
+            fc = fmask[:, start:end] if fmask is not None else None
+            lc = lmask[:, start:end] if lmask is not None else None
+            # carries come back as concrete arrays -> implicitly detached
+            # (the reference equally truncates gradients at chunk edges)
+            carries = self._runTrainStep(xc, yc, fc, lc, carries)
+
+    def _zeroCarries(self, batch: int):
+        """Fresh-sequence RNN carries for every recurrent layer (concrete
+        zeros — cheap; keeps jit pytree structure stable vs passing None)."""
+        out = {}
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "isRNN", False):
+                out[str(i)] = layer.initialCarry(batch, self._dtype)
+        return out or None
+
+    def output(self, x, train: bool = False, featuresMask=None) -> NDArray:
         xv = x.jax if isinstance(x, NDArray) else jnp.asarray(x)
-        return NDArray(self._outputFn(self.params_, self.state_,
-                                      xv.astype(self._dtype)))
+        fm = None
+        if featuresMask is not None:
+            fm = featuresMask.jax if isinstance(featuresMask, NDArray) \
+                else jnp.asarray(featuresMask)
+        out, _ = self._outputFn(self.params_, self.state_,
+                                xv.astype(self._dtype), fm, None)
+        return NDArray(out)
+
+    # ------------------------------------------------------------------
+    # stateful RNN inference (reference: MultiLayerNetwork.rnnTimeStep /
+    # rnnClearPreviousState / rnnGetPreviousState — the ``stateMap``)
+    # ------------------------------------------------------------------
+    def rnnTimeStep(self, x) -> NDArray:
+        """Feed one or more timesteps, carrying hidden state across calls.
+
+        2d input (b, nIn) = single step -> (b, nOut); 3d (b, nIn, t) ->
+        (b, nOut, t).  State persists until ``rnnClearPreviousState``.
+        """
+        for layer in self.conf.layers:
+            if type(layer).__name__ == "Bidirectional":
+                # streaming one step at a time cannot see the future the
+                # backward half needs (the reference throws here too)
+                raise ValueError(
+                    "rnnTimeStep is not supported for bidirectional networks")
+        xv = x.jax if isinstance(x, NDArray) else jnp.asarray(x)
+        single = xv.ndim == 2
+        if single:
+            xv = xv[:, :, None]
+        if self._rnnCarries is None:
+            self._rnnCarries = self._zeroCarries(int(xv.shape[0]))
+        out, self._rnnCarries = self._outputFn(
+            self.params_, self.state_, xv.astype(self._dtype), None,
+            self._rnnCarries)
+        return NDArray(out[:, :, -1] if single and out.ndim == 3 else out)
+
+    def rnnClearPreviousState(self) -> None:
+        self._rnnCarries = None
+
+    def rnnGetPreviousState(self, layerIdx: int):
+        if self._rnnCarries is None:
+            return None
+        return self._rnnCarries.get(str(layerIdx))
+
+    def rnnSetPreviousState(self, layerIdx: int, state) -> None:
+        if self._rnnCarries is None:
+            self._rnnCarries = {}
+        self._rnnCarries[str(layerIdx)] = state
 
     def feedForward(self, x) -> List[NDArray]:
         """All layer activations (inference mode)."""
@@ -314,10 +454,11 @@ class MultiLayerNetwork:
     def score(self, ds: Optional[DataSet] = None) -> float:
         if ds is None:
             return self._score
-        mask = ds.labelsMask.jax if ds.labelsMask is not None else None
+        fmask = ds.featuresMask.jax if ds.featuresMask is not None else None
+        lmask = ds.labelsMask.jax if ds.labelsMask is not None else None
         return float(self._scoreFn(self.params_, self.state_,
                                    ds.features.jax.astype(self._dtype),
-                                   ds.labels.jax, mask))
+                                   ds.labels.jax, fmask, lmask))
 
     def evaluate(self, it: DataSetIterator, metric: str = "classification"):
         ev = {"classification": Evaluation, "regression": RegressionEvaluation,
@@ -325,7 +466,7 @@ class MultiLayerNetwork:
         it.reset()
         while it.hasNext():
             ds = it.next()
-            out = self.output(ds.features)
+            out = self.output(ds.features, featuresMask=ds.featuresMask)
             ev.eval(ds.labels.numpy(), out.numpy(),
                     ds.labelsMask.numpy() if ds.labelsMask is not None else None)
         it.reset()
@@ -356,8 +497,8 @@ class MultiLayerNetwork:
         for i in range(len(self.conf.layers)):
             li = str(i)
             if li in self.params_:
-                for k in _param_key_order(self.params_[li].keys()):
-                    chunks.append(np.asarray(self.params_[li][k]).ravel())
+                for _path, _pname, v in _iter_leaf_params(self.params_[li]):
+                    chunks.append(np.asarray(v).ravel())
         if not chunks:
             return NDArray(jnp.zeros((0,)))
         return NDArray(np.concatenate(chunks))
@@ -368,11 +509,10 @@ class MultiLayerNetwork:
         for i in range(len(self.conf.layers)):
             li = str(i)
             if li in self.params_:
-                for k in _param_key_order(self.params_[li].keys()):
-                    cur = self.params_[li][k]
+                for path, _pname, cur in _iter_leaf_params(self.params_[li]):
                     n = int(np.prod(cur.shape))
-                    self.params_[li][k] = jnp.asarray(
-                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype)
+                    _set_leaf(self.params_[li], path, jnp.asarray(
+                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype))
                     pos += n
         if pos != vec.size:
             raise ValueError(f"Param vector length {vec.size} != model {pos}")
@@ -381,23 +521,24 @@ class MultiLayerNetwork:
         if self.params_ is None:
             return 0
         return int(sum(int(np.prod(v.shape))
-                       for lp in self.params_.values() for v in lp.values()))
+                       for v in jax.tree_util.tree_leaves(self.params_)))
 
     def paramTable(self) -> Dict[str, NDArray]:
         out = {}
         for li, lp in self.params_.items():
-            for k, v in lp.items():
-                out[f"{li}_{k}"] = NDArray(v)
+            for path, _pname, v in _iter_leaf_params(lp):
+                out[f"{li}_{path}"] = NDArray(v)
         return out
 
     def getParam(self, key: str) -> NDArray:
-        li, k = key.split("_", 1)
-        return NDArray(self.params_[li][k])
+        li, path = key.split("_", 1)
+        return NDArray(_get_leaf(self.params_[li], path))
 
     def setParam(self, key: str, value) -> None:
-        li, k = key.split("_", 1)
+        li, path = key.split("_", 1)
         v = value.jax if isinstance(value, NDArray) else jnp.asarray(value)
-        self.params_[li][k] = v.astype(self.params_[li][k].dtype)
+        cur = _get_leaf(self.params_[li], path)
+        _set_leaf(self.params_[li], path, v.astype(cur.dtype))
 
     # -- bookkeeping ----------------------------------------------------
     def getEpochCount(self) -> int:

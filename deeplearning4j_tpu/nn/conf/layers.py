@@ -501,6 +501,8 @@ class GlobalPoolingLayer(BaseLayer):
     pnorm: int = 2
     collapseDimensions: bool = True
 
+    acceptsMask = True
+
     def getOutputType(self, inputType):
         if inputType.kind == "CNN":
             return InputType.feedForward(inputType.channels)
@@ -631,4 +633,6 @@ def layer_from_json(d: dict) -> Layer:
     for k in ("kernelSize", "stride", "padding", "dilation"):
         if isinstance(d.get(k), list):
             d[k] = tuple(d[k])
+    if hasattr(cls, "_fromJsonDict"):  # wrappers with nested layers
+        return cls._fromJsonDict(d)
     return cls(**d)

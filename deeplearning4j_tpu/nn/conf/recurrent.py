@@ -1,0 +1,544 @@
+"""Recurrent layers — scan-based TPU-native recurrence.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/layers/
+{LSTM,GravesLSTM,GRU,SimpleRnn,RnnOutputLayer,RnnLossLayer}.java``,
+``org/deeplearning4j/nn/conf/layers/recurrent/{Bidirectional,LastTimeStep}.java``
+and the imperative impls ``org/deeplearning4j/nn/layers/recurrent/**``
+(``LSTM.activateHelper``, ``LSTMHelpers``, ``CudnnLSTMHelper``).
+
+TPU-first design (SURVEY.md §5.7 north star "CudnnLSTMHelper → XLA
+while_loop scan"): the reference runs a per-timestep Java loop dispatching
+ops across JNI (or a cuDNN full-sequence call); here each RNN layer is ONE
+``lax.scan`` over time inside the jitted train step, so XLA compiles the
+whole sequence into a single fused loop with the input/recurrent matmuls on
+the MXU.  The input projection ``x·W`` for ALL timesteps is hoisted out of
+the scan as one big batched matmul (t·b×nIn @ nIn×4nOut) — MXU-friendly —
+and only the recurrent matmul stays inside the loop.
+
+Data format (DL4J convention): RNN activations are ``(b, n, t)``.
+Masks are ``(b, t)`` with 1 = present.  Masked steps output zeros and HOLD
+the previous hidden state, so the final carry is the state at each
+sequence's last valid step (what ``LastTimeStep`` / ``rnnTimeStep`` need).
+
+Gate order (LSTM): ``[i, f, o, g]`` along the 4·nOut axis, matching the
+reference's iFOG layout (``LSTMParamInitializer``: W=(nIn,4nOut),
+RW=(nOut,4nOut), b=(4nOut,) with forget-gate bias init, default 1.0).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BaseLayer, DenseLayer,
+                                               Layer, LossLayer,
+                                               register_layer)
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["BaseRecurrentLayer", "SimpleRnn", "LSTM", "GravesLSTM", "GRU",
+           "Bidirectional", "LastTimeStep", "RnnOutputLayer", "RnnLossLayer"]
+
+
+def _masked_scan(cell, p, x_btn, mask, carry0):
+    """Scan ``cell`` over time.
+
+    ``x_btn``: (b, n, t) pre-projected input; returns
+    ((b, nOut, t), final_carry).  ``cell(p, carry, x_t) -> (new_carry, y_t)``.
+    With a mask, masked steps output zeros and HOLD the previous carry, so
+    the final carry is each sequence's state at its last valid step.
+    """
+    xs = jnp.transpose(x_btn, (2, 0, 1))             # (t, b, n)
+    # match carry dtype to the (possibly promoted) projected input — e.g.
+    # float64 gradient checks promote params while the zero carry is f32
+    carry0 = jax.tree_util.tree_map(lambda c: c.astype(xs.dtype), carry0)
+
+    if mask is None:
+        def body(carry, xt):
+            return cell(p, carry, xt)
+        final, ys = jax.lax.scan(body, carry0, xs)
+    else:
+        ms = jnp.transpose(mask, (1, 0))[..., None]  # (t, b, 1)
+
+        def body(carry, inp):
+            xt, mt = inp
+            new_carry, y = cell(p, carry, xt)
+            new_carry = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(mt > 0, new, old), new_carry, carry)
+            return new_carry, y * mt
+
+        final, ys = jax.lax.scan(body, carry0, (xs, ms.astype(xs.dtype)))
+    return jnp.transpose(ys, (1, 2, 0)), final       # (b, nOut, t)
+
+
+@dataclasses.dataclass
+class BaseRecurrentLayer(BaseLayer):
+    """Common recurrent config (reference: ``BaseRecurrentLayer.java``)."""
+    nIn: int = 0
+    nOut: int = 0
+    weightInitRecurrent: Optional[str] = None
+
+    isRNN = True          # MLN/graph dispatch: has scanSeq + carries
+    acceptsMask = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.timeSeriesLength)
+
+    # -- recurrence interface -------------------------------------------
+    def initialCarry(self, batch: int, dtype):
+        """Zero carry for a fresh sequence."""
+        raise NotImplementedError
+
+    def scanSeq(self, params, x, train, key, carry, mask=None):
+        """(b, nIn, t) -> ((b, nOut, t), final_carry)."""
+        raise NotImplementedError
+
+    def forward(self, params, x, train, key, state):
+        y, _ = self.scanSeq(params, x, train, key,
+                            self.initialCarry(x.shape[0], x.dtype))
+        return y, state
+
+    def _rw_init(self):
+        return self.weightInitRecurrent or self.weightInit or "XAVIER"
+
+
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h_t = act(x_t·W + h_{t-1}·RW + b).
+    Reference: ``conf/layers/recurrent/SimpleRnn.java``."""
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, kR = jax.random.split(key)
+        return {"W": init_weight(kW, (self.nIn, self.nOut), self.nIn,
+                                 self.nOut, self.weightInit or "XAVIER", dtype),
+                "RW": init_weight(kR, (self.nOut, self.nOut), self.nOut,
+                                  self.nOut, self._rw_init(), dtype),
+                "b": jnp.full((self.nOut,), self.biasInit or 0.0, dtype)}
+
+    def weightParamKeys(self):
+        return ("W", "RW")
+
+    def initialCarry(self, batch, dtype):
+        return jnp.zeros((batch, self.nOut), dtype)
+
+    def scanSeq(self, params, x, train, key, carry, mask=None):
+        x = self._dropin(x, train, key)
+        act = get_activation(self.activation or "tanh")
+        # hoist input projection out of the loop: one big MXU matmul
+        xp = jnp.einsum("bnt,nh->bht", x, params["W"]) + params["b"][:, None]
+
+        def cell(p, h, xt):                      # xt: (b, nOut) projected
+            h2 = act(xt + h @ p["RW"])
+            return h2, h2
+
+        xp_btn = xp                               # (b, nOut, t)
+        return _masked_scan(cell, params, xp_btn, mask, carry)
+
+
+@dataclasses.dataclass
+class LSTM(BaseRecurrentLayer):
+    """LSTM without peepholes (reference: ``conf/layers/LSTM.java`` +
+    ``layers/recurrent/LSTM.java``; libnd4j ``lstmLayer`` declarable op).
+    Gate order iFOG; forget-gate bias init default 1.0."""
+    forgetGateBiasInit: float = 1.0
+    gateActivationFunction: str = "sigmoid"
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, kR = jax.random.split(key)
+        n, h = self.nIn, self.nOut
+        b = jnp.zeros((4 * h,), dtype)
+        b = b.at[h:2 * h].set(self.forgetGateBiasInit)   # f-gate block
+        return {"W": init_weight(kW, (n, 4 * h), n, 4 * h,
+                                 self.weightInit or "XAVIER", dtype),
+                "RW": init_weight(kR, (h, 4 * h), h, 4 * h,
+                                  self._rw_init(), dtype),
+                "b": b}
+
+    def weightParamKeys(self):
+        return ("W", "RW")
+
+    def initialCarry(self, batch, dtype):
+        return (jnp.zeros((batch, self.nOut), dtype),
+                jnp.zeros((batch, self.nOut), dtype))
+
+    def _gates(self, p, z, c_prev):
+        h = self.nOut
+        gate = get_activation(self.gateActivationFunction)
+        act = get_activation(self.activation or "tanh")
+        i = gate(z[:, 0 * h:1 * h])
+        f = gate(z[:, 1 * h:2 * h])
+        o = gate(z[:, 2 * h:3 * h])
+        g = act(z[:, 3 * h:4 * h])
+        c = f * c_prev + i * g
+        return o * act(c), c
+
+    def scanSeq(self, params, x, train, key, carry, mask=None):
+        x = self._dropin(x, train, key)
+        xp = jnp.einsum("bnt,nh->bht", x, params["W"]) + params["b"][:, None]
+
+        def cell(p, hc, xt):
+            h_prev, c_prev = hc
+            z = xt + h_prev @ p["RW"]
+            h2, c2 = self._gates(p, z, c_prev)
+            return (h2, c2), h2
+
+        return _masked_scan(cell, params, xp, mask, carry)
+
+
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013).
+    Reference: ``conf/layers/GravesLSTM.java`` / ``layers/recurrent/
+    GravesLSTM.java`` — peephole weights pI/pF from c_{t-1}, pO from c_t."""
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        p = super().initParams(key, inputType, dtype)
+        h = self.nOut
+        p["pI"] = jnp.zeros((h,), dtype)
+        p["pF"] = jnp.zeros((h,), dtype)
+        p["pO"] = jnp.zeros((h,), dtype)
+        return p
+
+    def scanSeq(self, params, x, train, key, carry, mask=None):
+        x = self._dropin(x, train, key)
+        xp = jnp.einsum("bnt,nh->bht", x, params["W"]) + params["b"][:, None]
+        h = self.nOut
+        gate = get_activation(self.gateActivationFunction)
+        act = get_activation(self.activation or "tanh")
+
+        def cell(p, hc, xt):
+            h_prev, c_prev = hc
+            z = xt + h_prev @ p["RW"]
+            i = gate(z[:, 0 * h:1 * h] + c_prev * p["pI"])
+            f = gate(z[:, 1 * h:2 * h] + c_prev * p["pF"])
+            g = act(z[:, 3 * h:4 * h])
+            c = f * c_prev + i * g
+            o = gate(z[:, 2 * h:3 * h] + c * p["pO"])
+            h2 = o * act(c)
+            return (h2, c), h2
+
+        return _masked_scan(cell, params, xp, mask, carry)
+
+
+@dataclasses.dataclass
+class GRU(BaseRecurrentLayer):
+    """Gated recurrent unit.  Reference: libnd4j ``gruCell``/``gru``
+    declarable ops (``ops/declarable/generic/nn/recurrent/gru.cpp``) wrapped
+    by SameDiff; gate order [r, u] + candidate c."""
+    gateActivationFunction: str = "sigmoid"
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, kR = jax.random.split(key)
+        n, h = self.nIn, self.nOut
+        return {"W": init_weight(kW, (n, 3 * h), n, 3 * h,
+                                 self.weightInit or "XAVIER", dtype),
+                "RW": init_weight(kR, (h, 3 * h), h, 3 * h,
+                                  self._rw_init(), dtype),
+                "b": jnp.zeros((3 * h,), dtype)}
+
+    def weightParamKeys(self):
+        return ("W", "RW")
+
+    def initialCarry(self, batch, dtype):
+        return jnp.zeros((batch, self.nOut), dtype)
+
+    def scanSeq(self, params, x, train, key, carry, mask=None):
+        x = self._dropin(x, train, key)
+        xp = jnp.einsum("bnt,nh->bht", x, params["W"]) + params["b"][:, None]
+        h = self.nOut
+        gate = get_activation(self.gateActivationFunction)
+        act = get_activation(self.activation or "tanh")
+
+        def cell(p, hp, xt):
+            r = gate(xt[:, 0:h] + hp @ p["RW"][:, 0:h])
+            u = gate(xt[:, h:2 * h] + hp @ p["RW"][:, h:2 * h])
+            c = act(xt[:, 2 * h:3 * h] + (r * hp) @ p["RW"][:, 2 * h:3 * h])
+            h2 = u * hp + (1.0 - u) * c
+            return h2, h2
+
+        return _masked_scan(cell, params, xp, mask, carry)
+
+
+class BidirectionalMode:
+    ADD = "ADD"
+    MUL = "MUL"
+    AVERAGE = "AVERAGE"
+    CONCAT = "CONCAT"
+
+
+#: hyper-params the train loop reads off a layer; wrappers delegate these to
+#: the wrapped layer (which is where applyGlobalDefaults puts them)
+_DELEGATED_HYPERPARAMS = ("l1", "l2", "weightDecay", "updater", "biasUpdater",
+                          "gradientNormalization",
+                          "gradientNormalizationThreshold", "dropOut",
+                          "activation", "weightInit", "biasInit")
+
+
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Wraps an RNN layer, running it forward and time-reversed.
+    Reference: ``conf/layers/recurrent/Bidirectional.java`` (modes
+    ADD/MUL/AVERAGE/CONCAT) + ``layers/recurrent/BidirectionalLayer.java``.
+
+    Mask-aware reversal: the backward pass flips each sequence only within
+    its valid length (the reference's ReverseTimeSeriesVertex semantics), so
+    padded steps never seed the reverse scan.
+    """
+    fwd: Optional[BaseRecurrentLayer] = None
+    mode: str = BidirectionalMode.CONCAT
+
+    isRNN = True
+    acceptsMask = True
+
+    @classmethod
+    def _builderArgs(cls, b, *args):
+        # Bidirectional.builder(mode, layer) or .builder(layer)
+        for a in args:
+            if isinstance(a, str):
+                b._kw["mode"] = a
+            else:
+                b._kw["fwd"] = a
+
+    def __init__(self, *args, name=None, fwd=None, mode=None, **kw):
+        # accept Bidirectional(LSTM(...)), Bidirectional("ADD", LSTM(...))
+        super().__init__(name=name)
+        self.mode = mode or BidirectionalMode.CONCAT
+        self.fwd = fwd
+        for a in args:
+            if isinstance(a, str):
+                self.mode = a
+            elif isinstance(a, Layer):
+                self.fwd = a
+        if self.fwd is None:
+            raise ValueError("Bidirectional requires a wrapped RNN layer")
+        self._bwd = dataclasses.replace(self.fwd)
+
+    def __getattr__(self, name):
+        # delegate hyper-param reads to the wrapped layer (the train loop
+        # reads l1/l2/updater/… off this wrapper)
+        if name in _DELEGATED_HYPERPARAMS:
+            inner = self.__dict__.get("fwd")
+            return getattr(inner, name, None) if inner is not None else None
+        raise AttributeError(name)
+
+    def applyGlobalDefaults(self, g):
+        self.fwd.applyGlobalDefaults(g)
+        self._bwd = dataclasses.replace(self.fwd)
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        self.fwd.inferNIn(inputType)
+        self._bwd = dataclasses.replace(self.fwd)
+
+    def getOutputType(self, inputType):
+        base = self.fwd.getOutputType(inputType)
+        if self.mode == BidirectionalMode.CONCAT:
+            return InputType.recurrent(2 * base.size, base.timeSeriesLength)
+        return base
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        return {"fwd": self.fwd.initParams(kf, inputType, dtype),
+                "bwd": self._bwd.initParams(kb, inputType, dtype)}
+
+    def weightParamKeys(self):
+        # leaf param names inside fwd/bwd sub-dicts (reg/weight-decay apply
+        # to the wrapped layer's weights)
+        return self.fwd.weightParamKeys()
+
+    def initialCarry(self, batch, dtype):
+        return {"fwd": self.fwd.initialCarry(batch, dtype),
+                "bwd": self._bwd.initialCarry(batch, dtype)}
+
+    @staticmethod
+    def _reverse(x, mask):
+        """Flip (b, n, t) along t within each sequence's valid length."""
+        if mask is None:
+            return jnp.flip(x, axis=2)
+        t = x.shape[2]
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)      # (b,)
+        idx = jnp.arange(t)[None, :]                           # (1, t)
+        src = (lengths[:, None] - 1 - idx) % t                 # (b, t)
+        src = jnp.where(idx < lengths[:, None], src, idx)      # keep padding
+        return jnp.take_along_axis(x, src[:, None, :], axis=2)
+
+    def scanSeq(self, params, x, train, key, carry, mask=None):
+        kf = kb = None
+        if key is not None:
+            kf, kb = jax.random.split(key)
+        yf, cf = self.fwd.scanSeq(params["fwd"], x, train, kf,
+                                  carry["fwd"], mask)
+        xr = self._reverse(x, mask)
+        yb_r, cb = self._bwd.scanSeq(params["bwd"], xr, train, kb,
+                                     carry["bwd"], mask)
+        yb = self._reverse(yb_r, mask)
+        if self.mode == BidirectionalMode.ADD:
+            y = yf + yb
+        elif self.mode == BidirectionalMode.MUL:
+            y = yf * yb
+        elif self.mode == BidirectionalMode.AVERAGE:
+            y = 0.5 * (yf + yb)
+        else:
+            y = jnp.concatenate([yf, yb], axis=1)
+        return y, {"fwd": cf, "bwd": cb}
+
+    def forward(self, params, x, train, key, state):
+        y, _ = self.scanSeq(params, x, train, key,
+                            self.initialCarry(x.shape[0], x.dtype))
+        return y, state
+
+    def toJson(self) -> dict:
+        return {"@class": "Bidirectional", "name": self.name,
+                "mode": self.mode, "fwd": self.fwd.toJson()}
+
+    @classmethod
+    def _fromJsonDict(cls, d: dict) -> "Bidirectional":
+        from deeplearning4j_tpu.nn.conf.layers import layer_from_json
+        return cls(fwd=layer_from_json(d["fwd"]), mode=d.get("mode"),
+                   name=d.get("name"))
+
+
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wraps an RNN layer, returning only the last valid time step as FF.
+    Reference: ``conf/layers/recurrent/LastTimeStep.java`` /
+    ``layers/recurrent/LastTimeStepLayer.java`` (mask-aware)."""
+    underlying: Optional[Layer] = None
+
+    acceptsMask = True
+
+    def __init__(self, underlying=None, name=None):
+        super().__init__(name=name)
+        if underlying is None:
+            raise ValueError("LastTimeStep requires an underlying RNN layer")
+        self.underlying = underlying
+
+    def __getattr__(self, name):
+        if name in _DELEGATED_HYPERPARAMS:
+            inner = self.__dict__.get("underlying")
+            return getattr(inner, name, None) if inner is not None else None
+        raise AttributeError(name)
+
+    def applyGlobalDefaults(self, g):
+        self.underlying.applyGlobalDefaults(g)
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        self.underlying.inferNIn(inputType)
+
+    def getOutputType(self, inputType):
+        rnn_out = self.underlying.getOutputType(inputType)
+        return InputType.feedForward(rnn_out.size)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        return self.underlying.initParams(key, inputType, dtype)
+
+    def weightParamKeys(self):
+        return self.underlying.weightParamKeys()
+
+    def forward(self, params, x, train, key, state, mask=None):
+        carry0 = self.underlying.initialCarry(x.shape[0], x.dtype)
+        y, _ = self.underlying.scanSeq(params, x, train, key, carry0, mask)
+        if mask is None:
+            return y[:, :, -1], state
+        # last VALID step per sequence (reference: LastTimeStepLayer's
+        # mask-aware indexing)
+        idx = (jnp.sum(mask, axis=1).astype(jnp.int32) - 1)     # (b,)
+        idx = jnp.clip(idx, 0, y.shape[2] - 1)
+        h = jnp.take_along_axis(y, idx[:, None, None], axis=2)[:, :, 0]
+        return h, state
+
+    def toJson(self) -> dict:
+        return {"@class": "LastTimeStep", "name": self.name,
+                "underlying": self.underlying.toJson()}
+
+    @classmethod
+    def _fromJsonDict(cls, d: dict) -> "LastTimeStep":
+        from deeplearning4j_tpu.nn.conf.layers import layer_from_json
+        return cls(underlying=layer_from_json(d["underlying"]),
+                   name=d.get("name"))
+
+
+@dataclasses.dataclass
+class RnnOutputLayer(DenseLayer):
+    """Per-timestep dense + activation + loss over (b, n, t).
+    Reference: ``conf/layers/RnnOutputLayer.java`` /
+    ``layers/recurrent/RnnOutputLayer.java`` — reshapes to 2d, applies the
+    dense projection at every step, loss masked per (example, step)."""
+    lossFunction: str = "mcxent"
+
+    acceptsMask = True
+
+    @classmethod
+    def _builderArgs(cls, b, *args):
+        if args:
+            b._kw["lossFunction"] = args[0]
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.timeSeriesLength)
+
+    def hasLoss(self) -> bool:
+        return True
+
+    def computeScore(self, labels, output, mask=None):
+        """labels/output (b, nOut, t), mask (b, t) -> per-example scores."""
+        from deeplearning4j_tpu.nn.lossfunctions import get_loss
+        return get_loss(self.lossFunction)(labels, output, mask)
+
+    def forward(self, params, x, train, key, state, mask=None):
+        x = self._dropin(x, train, key)
+        y = jnp.einsum("bnt,nh->bht", x, params["W"])
+        if self.hasBias:
+            y = y + params["b"][:, None]
+        act = get_activation(self.activation or "softmax")
+        if (self.activation or "softmax") == "softmax":
+            # softmax over the feature axis (axis=1 in (b, n, t))
+            y = jax.nn.softmax(y, axis=1)
+        else:
+            y = act(y)
+        if mask is not None:
+            y = y * mask[:, None, :]
+        return y, state
+
+
+@dataclasses.dataclass
+class RnnLossLayer(LossLayer):
+    """Per-timestep loss without params.
+    Reference: ``conf/layers/RnnLossLayer.java``."""
+
+    acceptsMask = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def forward(self, params, x, train, key, state, mask=None):
+        act = get_activation(self.activation or "identity")
+        if (self.activation or "identity") == "softmax":
+            y = jax.nn.softmax(x, axis=1)
+        else:
+            y = act(x)
+        if mask is not None:
+            y = y * mask[:, None, :]
+        return y, state
+
+
+for _c in [SimpleRnn, LSTM, GravesLSTM, GRU, RnnOutputLayer, RnnLossLayer,
+           Bidirectional, LastTimeStep]:
+    register_layer(_c)

@@ -20,13 +20,17 @@ _EPS = 1e-7
 
 
 def _reduce(per_elem, mask):
-    """Per-example score: sum over feature dims; mask weights examples/steps."""
+    """Per-example score: sum over feature dims; mask weights examples/steps.
+
+    RNN case (reference: ``ILossFunction`` impls applying a per-timestep
+    ``(b, t)`` mask to ``(b, n, t)`` scores before reduction)."""
+    if mask is not None and per_elem.ndim == 3 and mask.ndim == 2:
+        per_elem = per_elem * mask[:, None, :]
+        mask = None
     axes = tuple(range(1, per_elem.ndim))
     per_ex = jnp.sum(per_elem, axis=axes) if axes else per_elem
     if mask is not None:
-        m = mask
-        # broadcast time-step masks: per_ex already summed, so apply before
-        per_ex = per_ex * m.reshape(per_ex.shape)
+        per_ex = per_ex * mask.reshape(per_ex.shape)
     return per_ex
 
 

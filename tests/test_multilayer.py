@@ -241,7 +241,7 @@ class TestGradients:
         net.init()
         x, y = toy_classification(8, nin=3, nout=2)
         loss = lambda p: net._lossFn(p, {}, jnp.asarray(x), jnp.asarray(y),
-                                     None, None)[0]
+                                     None, None, None)[0]
         res = check_gradients(loss, net.params_, max_per_param=10)
         assert res.passed, res.failures[:5]
 
@@ -261,7 +261,7 @@ class TestGradients:
         x = rng.randn(4, 36).astype(np.float64)
         y = np.eye(2, dtype=np.float64)[rng.randint(0, 2, 4)]
         loss = lambda p: net._lossFn(p, {}, jnp.asarray(x), jnp.asarray(y),
-                                     None, None)[0]
+                                     None, None, None)[0]
         res = check_gradients(loss, net.params_, max_per_param=8)
         assert res.passed, res.failures[:5]
 

@@ -1,0 +1,330 @@
+"""T4 recurrence tests.
+
+Mirrors the reference's RNN test strategy (SURVEY.md §4):
+``LSTMGradientCheckTests`` (numeric-vs-analytic), masking tests,
+``MultiLayerNetworkTest.rnnTimeStep`` consistency, TBPTT tests, and the
+GravesLSTM char-modelling example (BASELINE config #4) as a learning test.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+from deeplearning4j_tpu.datasets.characters import CharacterIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.config import Adam, Sgd
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (BackpropType, InputType,
+                                        MultiLayerConfiguration,
+                                        NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import (GRU, LSTM, Bidirectional,
+                                                  GravesLSTM, LastTimeStep,
+                                                  RnnOutputLayer, SimpleRnn)
+
+RNG = np.random.default_rng(12345)
+
+
+def _seq_classification_data(b=4, n=5, t=6, nout=3):
+    x = RNG.standard_normal((b, n, t)).astype(np.float32)
+    idx = RNG.integers(0, nout, (b, t))
+    y = np.zeros((b, nout, t), np.float32)
+    for i in range(b):
+        y[i, idx[i], np.arange(t)] = 1.0
+    return x, y
+
+
+def _rnn_net(cell_builder, nIn=5, nHidden=8, nOut=3, t=6, updater=None,
+             backprop=BackpropType.Standard, tbptt=20):
+    return (NeuralNetConfiguration.builder().seed(42)
+            .updater(updater or Adam(5e-2)).list()
+            .layer(cell_builder)
+            .layer(RnnOutputLayer.builder("mcxent").nOut(nOut)
+                   .activation("softmax").build())
+            .setInputType(InputType.recurrent(nIn, t))
+            .backpropType(backprop).tBPTTLength(tbptt)
+            .build())
+
+
+class TestRnnForward:
+    @pytest.mark.parametrize("cell", [SimpleRnn, LSTM, GravesLSTM, GRU])
+    def test_output_shape(self, cell):
+        conf = _rnn_net(cell.builder().nOut(8).build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((4, 5, 6)).astype(np.float32)
+        out = net.output(x)
+        assert out.numpy().shape == (4, 3, 6)
+        # softmax over features at every step
+        np.testing.assert_allclose(out.numpy().sum(axis=1),
+                                   np.ones((4, 6)), atol=1e-5)
+
+    def test_training_reduces_score(self):
+        x, y = _seq_classification_data()
+        conf = _rnn_net(LSTM.builder().nOut(12).build())
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, y)
+        net.fit(ds)
+        first = net.score()
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score() < first * 0.7
+
+    def test_bidirectional_modes(self):
+        x = RNG.standard_normal((3, 5, 6)).astype(np.float32)
+        for mode, nout in [("CONCAT", 16), ("ADD", 8), ("AVERAGE", 8),
+                           ("MUL", 8)]:
+            conf = (NeuralNetConfiguration.builder().seed(1).list()
+                    .layer(Bidirectional(mode, LSTM.builder().nOut(8).build()))
+                    .layer(RnnOutputLayer.builder("mse").nOut(2)
+                           .activation("identity").build())
+                    .setInputType(InputType.recurrent(5, 6)).build())
+            net = MultiLayerNetwork(conf).init()
+            mid, _ = conf.layers[0].forward(
+                net.params_["0"], jnp.asarray(x), False, None, {})
+            assert mid.shape == (3, nout, 6), mode
+
+    def test_last_time_step(self):
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(LastTimeStep(LSTM.builder().nOut(7).build()))
+                .layer(OutputLayer.builder("mcxent").nOut(2)
+                       .activation("softmax").build())
+                .setInputType(InputType.recurrent(4, 5)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((3, 4, 5)).astype(np.float32)
+        assert net.output(x).numpy().shape == (3, 2)
+
+
+class TestRnnGradients:
+    """Numeric-vs-analytic gradient check per RNN cell type (reference:
+    ``LSTMGradientCheckTests`` — double precision central differences)."""
+
+    @pytest.mark.parametrize("cell", [SimpleRnn, LSTM, GravesLSTM, GRU])
+    def test_gradcheck(self, cell):
+        b, nin, t, nout = 2, 3, 4, 2
+        x, y = _seq_classification_data(b, nin, t, nout)
+        conf = _rnn_net(cell.builder().nOut(4).activation("tanh").build(),
+                        nIn=nin, nOut=nout, t=t, updater=Sgd(0.1))
+        net = MultiLayerNetwork(conf).init()
+
+        def loss(params):
+            l, _ = net._lossFn(params, {}, jnp.asarray(x), jnp.asarray(y),
+                               None, None, None)
+            return l
+
+        res = check_gradients(loss, net.params_, max_per_param=10)
+        assert res.passed, res.failures[:5]
+
+    def test_gradcheck_masked(self):
+        b, nin, t, nout = 2, 3, 5, 2
+        x, y = _seq_classification_data(b, nin, t, nout)
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        conf = _rnn_net(LSTM.builder().nOut(4).build(), nIn=nin, nOut=nout,
+                        t=t, updater=Sgd(0.1))
+        net = MultiLayerNetwork(conf).init()
+
+        def loss(params):
+            l, _ = net._lossFn(params, {}, jnp.asarray(x), jnp.asarray(y),
+                               jnp.asarray(mask), jnp.asarray(mask), None)
+            return l
+
+        res = check_gradients(loss, net.params_, max_per_param=10)
+        assert res.passed, res.failures[:5]
+
+
+class TestMasking:
+    def test_padded_equals_unpadded(self):
+        """Final-step output of a padded+masked sequence must equal the
+        unpadded sequence's output (reference: masking semantics of
+        ``LastTimeStepLayer`` / ``BaseRecurrentLayer``)."""
+        conf = (NeuralNetConfiguration.builder().seed(7).list()
+                .layer(LastTimeStep(GravesLSTM.builder().nOut(4).build()))
+                .layer(OutputLayer.builder("mse").nOut(2)
+                       .activation("identity").build())
+                .setInputType(InputType.recurrent(3, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        xs = RNG.standard_normal((2, 3, 4)).astype(np.float32)
+        xp = np.concatenate([xs, RNG.standard_normal((2, 3, 2))
+                             .astype(np.float32)], axis=2)
+        mask = np.concatenate([np.ones((2, 4)), np.zeros((2, 2))],
+                              axis=1).astype(np.float32)
+        o_short, _, _ = net._forward(net.params_, net.state_,
+                                     jnp.asarray(xs), False, None)
+        o_pad, _, _ = net._forward(net.params_, net.state_, jnp.asarray(xp),
+                                   False, None, mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(o_short), np.asarray(o_pad),
+                                   atol=1e-5)
+
+    def test_bidirectional_masked_reverse(self):
+        """Bidirectional with mask: padded steps must not leak into the
+        backward pass (mask-aware sequence reversal)."""
+        layer = Bidirectional("CONCAT", LSTM.builder().nIn(3).nOut(4).build())
+        layer.inferNIn(InputType.recurrent(3, 6))
+        key = jax.random.PRNGKey(0)
+        params = layer.initParams(key, InputType.recurrent(3, 6))
+        xs = RNG.standard_normal((2, 3, 4)).astype(np.float32)
+        xp = np.concatenate([xs, 99 * np.ones((2, 3, 2), np.float32)], axis=2)
+        mask = np.concatenate([np.ones((2, 4)), np.zeros((2, 2))],
+                              axis=1).astype(np.float32)
+        y_short, _ = layer.scanSeq(params, jnp.asarray(xs), False, None,
+                                   layer.initialCarry(2, jnp.float32))
+        y_pad, _ = layer.scanSeq(params, jnp.asarray(xp), False, None,
+                                 layer.initialCarry(2, jnp.float32),
+                                 jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(y_short),
+                                   np.asarray(y_pad)[:, :, :4], atol=1e-5)
+
+    def test_masked_loss_ignores_padding(self):
+        x, y = _seq_classification_data(2, 3, 5, 2)
+        conf = _rnn_net(LSTM.builder().nOut(4).build(), nIn=3, nOut=2, t=5)
+        net = MultiLayerNetwork(conf).init()
+        mask = np.array([[1, 1, 1, 1, 1], [1, 1, 0, 0, 0]], np.float32)
+        s_masked = net.score(DataSet(x, y, labelsMask=mask))
+        s_full = net.score(DataSet(x, y))
+        assert s_masked < s_full  # fewer contributing steps
+
+
+class TestRnnTimeStep:
+    def test_stepwise_matches_full_sequence(self):
+        conf = _rnn_net(LSTM.builder().nOut(6).build(), nIn=5, nOut=3, t=6)
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((2, 5, 6)).astype(np.float32)
+        full = net.output(x).numpy()
+        net.rnnClearPreviousState()
+        steps = [net.rnnTimeStep(x[:, :, i]).numpy() for i in range(6)]
+        for i in range(6):
+            np.testing.assert_allclose(steps[i], full[:, :, i], atol=1e-5)
+
+    def test_chunked_matches_full(self):
+        conf = _rnn_net(GRU.builder().nOut(6).build(), nIn=5, nOut=3, t=6)
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((2, 5, 6)).astype(np.float32)
+        full = net.output(x).numpy()
+        net.rnnClearPreviousState()
+        o1 = net.rnnTimeStep(x[:, :, :4]).numpy()
+        o2 = net.rnnTimeStep(x[:, :, 4:]).numpy()
+        np.testing.assert_allclose(o1, full[:, :, :4], atol=1e-5)
+        np.testing.assert_allclose(o2, full[:, :, 4:], atol=1e-5)
+
+    def test_clear_resets(self):
+        conf = _rnn_net(LSTM.builder().nOut(6).build(), nIn=5, nOut=3, t=6)
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((2, 5)).astype(np.float32)
+        a = net.rnnTimeStep(x).numpy()
+        b = net.rnnTimeStep(x).numpy()  # state carried -> differs
+        assert not np.allclose(a, b)
+        net.rnnClearPreviousState()
+        c = net.rnnTimeStep(x).numpy()
+        np.testing.assert_allclose(a, c, atol=1e-6)
+
+
+class TestTbptt:
+    def test_tbptt_trains(self):
+        x, y = _seq_classification_data(4, 5, 20, 3)
+        conf = _rnn_net(LSTM.builder().nOut(10).build(), nIn=5, nOut=3, t=20,
+                        backprop=BackpropType.TruncatedBPTT, tbptt=5)
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, y)
+        net.fit(ds)
+        first = net.score()
+        for _ in range(20):
+            net.fit(ds)
+        assert net.score() < first
+
+    def test_wrapper_serde_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(Bidirectional("ADD", LSTM.builder().nOut(8).build()))
+                .layer(LastTimeStep(GRU.builder().nOut(6).build()))
+                .layer(OutputLayer.builder("mcxent").nOut(3)
+                       .activation("softmax").build())
+                .setInputType(InputType.recurrent(5, 7)).build())
+        conf2 = MultiLayerConfiguration.fromJson(conf.toJson())
+        assert type(conf2.layers[0]).__name__ == "Bidirectional"
+        assert conf2.layers[0].mode == "ADD"
+        assert type(conf2.layers[0].fwd).__name__ == "LSTM"
+        assert conf2.layers[0].fwd.nOut == 8
+        assert type(conf2.layers[1]).__name__ == "LastTimeStep"
+        assert type(conf2.layers[1].underlying).__name__ == "GRU"
+
+    def test_wrapper_delegates_hyperparams(self):
+        """Wrappers must expose the wrapped layer's l1/l2/updater — the
+        train loop reads them off the wrapper (review finding)."""
+        conf = (NeuralNetConfiguration.builder().seed(1).l2(0.01)
+                .updater(Adam(1e-3)).list()
+                .layer(Bidirectional("CONCAT", LSTM.builder().nOut(4).build()))
+                .layer(LastTimeStep(GRU.builder().nOut(4).build()))
+                .layer(OutputLayer.builder("mcxent").nOut(2)
+                       .activation("softmax").build())
+                .setInputType(InputType.recurrent(3, 5)).build())
+        bi, lts = conf.layers[0], conf.layers[1]
+        assert bi.l2 == 0.01 and lts.l2 == 0.01
+        assert isinstance(bi.updater, Adam) and isinstance(lts.updater, Adam)
+        # reg penalty actually fires for wrapped weights
+        net = MultiLayerNetwork(conf).init()
+        from deeplearning4j_tpu.models.multilayer import _reg_penalty
+        pen = float(_reg_penalty([(bi, net.params_["0"]),
+                                  (lts, net.params_["1"])]))
+        assert pen > 0.0
+
+    def test_rnn_time_step_rejects_bidirectional(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(Bidirectional("ADD", LSTM.builder().nOut(4).build()))
+                .layer(RnnOutputLayer.builder("mcxent").nOut(2)
+                       .activation("softmax").build())
+                .setInputType(InputType.recurrent(3, 5)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="bidirectional"):
+            net.rnnTimeStep(np.zeros((1, 3), np.float32))
+
+    def test_conf_roundtrip_preserves_tbptt(self):
+        conf = _rnn_net(LSTM.builder().nOut(4).build(),
+                        backprop=BackpropType.TruncatedBPTT, tbptt=7)
+        conf2 = MultiLayerConfiguration.fromJson(conf.toJson())
+        assert conf2.backpropType == BackpropType.TruncatedBPTT
+        assert conf2.tbpttFwdLength == 7
+        assert type(conf2.layers[0]).__name__ == "LSTM"
+
+
+class TestCharRnn:
+    """BASELINE.json config #4: GravesLSTM char-RNN."""
+
+    CORPUS = ("the quick brown fox jumps over the lazy dog. " * 30 +
+              "pack my box with five dozen liquor jugs. " * 30)
+
+    def test_iterator_shapes(self):
+        it = CharacterIterator(self.CORPUS, miniBatchSize=8, exampleLength=20)
+        ds = it.next()
+        C = it.numCharacters()
+        assert ds.features.numpy().shape == (8, C, 20)
+        assert ds.labels.numpy().shape == (8, C, 20)
+        # one-hot: every (example, step) sums to 1
+        np.testing.assert_allclose(ds.features.numpy().sum(axis=1), 1.0)
+        # labels are features shifted by one step
+        np.testing.assert_allclose(ds.features.numpy()[:, :, 1:],
+                                   ds.labels.numpy()[:, :, :-1])
+
+    def test_char_rnn_learns(self):
+        it = CharacterIterator(self.CORPUS, miniBatchSize=16,
+                               exampleLength=30, seed=5)
+        C = it.numCharacters()
+        conf = (NeuralNetConfiguration.builder().seed(12345)
+                .updater(Adam(1e-2)).list()
+                .layer(GravesLSTM.builder().nOut(32).activation("tanh").build())
+                .layer(RnnOutputLayer.builder("mcxent").nOut(C)
+                       .activation("softmax").build())
+                .setInputType(InputType.recurrent(C))
+                .backpropType(BackpropType.TruncatedBPTT).tBPTTLength(10)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ds = it.next()
+        net.fit(ds)
+        first = net.score()
+        for _ in range(3):
+            net.fit(DataSet(ds.features, ds.labels))
+        for _ in range(2):
+            it.reset()
+            net.fit(it, epochs=1)
+        assert net.score() < first * 0.8
+        # sampling: predictions are a valid distribution over chars
+        out = net.output(ds.features.numpy()[:2]).numpy()
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
