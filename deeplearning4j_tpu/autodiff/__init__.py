@@ -1,2 +1,5 @@
 """Autodiff utilities: SameDiff-style graph API + gradient checking."""
 from deeplearning4j_tpu.autodiff.gradcheck import GradCheckResult, check_gradients  # noqa: F401
+from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,  # noqa: F401
+                                                  TrainingConfig,
+                                                  VariableType, register_op)

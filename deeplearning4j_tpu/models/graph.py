@@ -23,9 +23,11 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.learning.config import Sgd
 from deeplearning4j_tpu.learning.regularization import WeightDecay
-from deeplearning4j_tpu.models.multilayer import (_grad_normalize,
+from deeplearning4j_tpu.models.multilayer import (_get_leaf, _grad_normalize,
+                                                  _iter_leaf_params,
                                                   _param_key_order,
-                                                  _reg_penalty, _updater_for)
+                                                  _reg_penalty, _set_leaf,
+                                                  _updater_for)
 from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import Layer
 from deeplearning4j_tpu.ops import NDArray
@@ -83,9 +85,10 @@ class ComputationGraph:
 
     def _initOptState(self) -> None:
         def build_opt(p_tree):
-            return {name: {pname: self._updaterFor(
+            # keyed by leaf PATH so nested layers (Bidirectional) work
+            return {name: {path: self._updaterFor(
                         self.conf.nodes[name][0], pname).init(pval)
-                           for pname, pval in lp.items()}
+                           for path, pname, pval in _iter_leaf_params(lp)}
                     for name, lp in p_tree.items()}
 
         self.optState_ = jax.jit(build_opt)(self.params_ or {})
@@ -145,17 +148,18 @@ class ComputationGraph:
                 node = self.conf.nodes[name][0]
                 g = _grad_normalize(node, grads[name])
                 new_params[name], new_opt[name] = {}, {}
-                for pname, pval in lp.items():
+                for path, pname, pval in _iter_leaf_params(lp):
                     up = self._updaterFor(node, pname)
                     lr = up.currentLr(iteration, epoch)
-                    update, ostate = up.apply(g[pname], optState[name][pname],
+                    update, ostate = up.apply(_get_leaf(g, path),
+                                              optState[name][path],
                                               lr, iteration, epoch,
                                               param=pval)
                     wd = getattr(node, "weightDecay", None)
                     if wd and pname in node.weightParamKeys():
                         update = WeightDecay(coeff=wd).apply(pval, update, lr)
-                    new_params[name][pname] = pval - update
-                    new_opt[name][pname] = ostate
+                    _set_leaf(new_params[name], path, pval - update)
+                    new_opt[name][path] = ostate
             return new_params, new_opt, new_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -247,8 +251,8 @@ class ComputationGraph:
         chunks = []
         for name in self.conf.topoOrder:
             if name in (self.params_ or {}):
-                for k in _param_key_order(self.params_[name].keys()):
-                    chunks.append(np.asarray(self.params_[name][k]).ravel())
+                for _path, _pname, v in _iter_leaf_params(self.params_[name]):
+                    chunks.append(np.asarray(v).ravel())
         return NDArray(np.concatenate(chunks) if chunks else np.zeros(0))
 
     def setParams(self, flat) -> None:
@@ -256,17 +260,15 @@ class ComputationGraph:
         pos = 0
         for name in self.conf.topoOrder:
             if name in self.params_:
-                for k in _param_key_order(self.params_[name].keys()):
-                    cur = self.params_[name][k]
+                for path, _pname, cur in _iter_leaf_params(self.params_[name]):
                     n = int(np.prod(cur.shape))
-                    self.params_[name][k] = jnp.asarray(
-                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype)
+                    _set_leaf(self.params_[name], path, jnp.asarray(
+                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype))
                     pos += n
 
     def numParams(self) -> int:
         return int(sum(int(np.prod(v.shape))
-                       for lp in (self.params_ or {}).values()
-                       for v in lp.values()))
+                       for v in jax.tree_util.tree_leaves(self.params_ or {})))
 
     def paramTable(self) -> Dict[str, NDArray]:
         return {f"{name}_{k}": NDArray(v)

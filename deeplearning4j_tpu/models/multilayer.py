@@ -449,7 +449,11 @@ class MultiLayerNetwork:
         return acts
 
     def predict(self, x) -> np.ndarray:
-        return np.asarray(jnp.argmax(self.output(x).jax, axis=-1))
+        out = self.output(x).jax
+        # FF output is (b, nOut): argmax over -1.  RNN output is (b, nOut, t)
+        # (DL4J layout): the class axis is 1, NOT the trailing time axis.
+        axis = 1 if out.ndim == 3 else -1
+        return np.asarray(jnp.argmax(out, axis=axis))
 
     def score(self, ds: Optional[DataSet] = None) -> float:
         if ds is None:

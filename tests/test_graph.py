@@ -198,3 +198,36 @@ class TestZoo:
         y = np.eye(4, dtype=np.float32)[[0, 1]]
         net.fit(DataSet(x, y))
         assert np.isfinite(net.score())
+
+
+class TestBidirectionalInGraph:
+    def test_bidirectional_layer_in_graph_trains(self):
+        """Nested (Bidirectional fwd/bwd) param dicts must work in a
+        ComputationGraph: tree-aware opt-state/params/setParams."""
+        from deeplearning4j_tpu.nn.conf.recurrent import (Bidirectional,
+                                                          LSTM,
+                                                          RnnOutputLayer)
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 3, 5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            rng.randint(0, 2, (4, 5))].transpose(0, 2, 1)
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+                .graphBuilder()
+                .addInputs("in")
+                .setInputTypes(InputType.recurrent(3))
+                .addLayer("bi", Bidirectional(LSTM.builder().nOut(4).build()),
+                          "in")
+                .addLayer("out", RnnOutputLayer.builder("mcxent").nOut(2)
+                          .activation("softmax").build(), "bi")
+                .setOutputs("out")
+                .build())
+        net = ComputationGraph(conf)
+        net.init()
+        from deeplearning4j_tpu.ops import Nd4j
+        ds = DataSet(Nd4j.create(x), Nd4j.create(y))
+        net.fit(ds)
+        before = net.params().numpy().copy()
+        net.fit(ds)
+        assert not np.allclose(before, net.params().numpy())
+        net.setParams(before)
+        np.testing.assert_allclose(net.params().numpy(), before)

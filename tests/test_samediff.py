@@ -1,0 +1,172 @@
+"""SameDiff graph API tests.
+
+Reference test analogues: nd4j-tests ``org/nd4j/autodiff/samediff/*`` and the
+OpValidation harness (SURVEY.md §4: numeric-vs-analytic gradient check as a
+first-class utility).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning.config import Adam, Sgd
+from deeplearning4j_tpu.ops import Nd4j
+
+
+def test_basic_arithmetic_eval():
+    sd = SameDiff.create()
+    a = sd.var("a", np.array([1.0, 2.0, 3.0], np.float32))
+    b = sd.var("b", np.array([4.0, 5.0, 6.0], np.float32))
+    c = (a + b * 2.0).rename("c")
+    out = c.eval().numpy()
+    np.testing.assert_allclose(out, [9.0, 12.0, 15.0])
+
+
+def test_placeholder_and_mmul():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 3))
+    w = sd.var("w", np.ones((3, 2), np.float32))
+    b = sd.var("b", np.zeros((2,), np.float32))
+    y = sd.nn().linear(x, w, b, name="y")
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    res = sd.output({"x": xv}, "y")["y"].numpy()
+    np.testing.assert_allclose(res, xv @ np.ones((3, 2), np.float32))
+
+
+def test_reductions_and_shapes():
+    sd = SameDiff.create()
+    x = sd.var("x", np.arange(12, dtype=np.float32).reshape(3, 4))
+    s = x.sum(1).rename("s")
+    m = x.mean().rename("m")
+    r = x.reshape(4, 3).rename("r")
+    t = x.transpose().rename("t")
+    out = sd.output({}, "s", "m", "r", "t")
+    np.testing.assert_allclose(out["s"].numpy(), [6.0, 22.0, 38.0])
+    np.testing.assert_allclose(out["m"].numpy(), 5.5)
+    assert out["r"].numpy().shape == (4, 3)
+    assert out["t"].numpy().shape == (4, 3)
+
+
+def test_gradients_analytic_vs_numeric():
+    rng = np.random.RandomState(0)
+    wv = rng.randn(4, 3).astype(np.float64)
+    xv = rng.randn(5, 4).astype(np.float64)
+    lv = np.eye(3)[rng.randint(0, 3, 5)].astype(np.float64)
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 4))
+    label = sd.placeholder("label", shape=(None, 3))
+    w = sd.var("w", wv)
+    logits = x.mmul(w).rename("logits")
+    sd.loss().softmaxCrossEntropy(label, logits, name="loss")
+
+    g = sd.calculateGradients({"x": xv, "label": lv}, "w")["w"].numpy()
+
+    # numeric central difference
+    eps = 1e-6
+    num = np.zeros_like(wv)
+    def f(wmat):
+        z = xv @ wmat
+        p = np.exp(z - z.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        return -np.mean(np.sum(lv * np.log(p), axis=1))
+    for i in range(wv.shape[0]):
+        for j in range(wv.shape[1]):
+            wp = wv.copy(); wp[i, j] += eps
+            wm = wv.copy(); wm[i, j] -= eps
+            num[i, j] = (f(wp) - f(wm)) / (2 * eps)
+    np.testing.assert_allclose(g, num, atol=1e-5)
+
+
+def test_fit_linear_regression():
+    rng = np.random.RandomState(42)
+    true_w = np.array([[2.0], [-3.0]], np.float32)
+    X = rng.randn(256, 2).astype(np.float32)
+    Y = X @ true_w + 0.5
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 2))
+    label = sd.placeholder("label", shape=(None, 1))
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    b = sd.var("b", np.zeros((1,), np.float32))
+    pred = (x.mmul(w) + b).rename("pred")
+    sd.loss().meanSquaredError(label, pred, name="loss")
+
+    sd.setTrainingConfig(TrainingConfig.Builder()
+                         .updater(Adam(0.1))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("label")
+                         .build())
+    hist = sd.fit(DataSet(Nd4j.create(X), Nd4j.create(Y)), epochs=200)
+    assert hist.finalTrainingLoss() < 1e-2
+    np.testing.assert_allclose(sd.getVariable("w").getArr().numpy(),
+                               true_w, atol=0.1)
+    np.testing.assert_allclose(sd.getVariable("b").getArr().numpy(),
+                               [0.5], atol=0.1)
+
+
+def test_attention_op():
+    sd = SameDiff.create()
+    rng = np.random.RandomState(1)
+    b, t, d, h = 2, 5, 8, 2
+    q = sd.var("q", rng.randn(b, t, d).astype(np.float32))
+    Wq = sd.var("Wq", rng.randn(d, d).astype(np.float32) * 0.1)
+    Wk = sd.var("Wk", rng.randn(d, d).astype(np.float32) * 0.1)
+    Wv = sd.var("Wv", rng.randn(d, d).astype(np.float32) * 0.1)
+    Wo = sd.var("Wo", rng.randn(d, d).astype(np.float32) * 0.1)
+    out = sd.nn().multiHeadDotProductAttention(q, q, q, Wq, Wk, Wv, Wo,
+                                               nHeads=h, name="attn")
+    res = out.eval().numpy()
+    assert res.shape == (b, t, d)
+    assert np.isfinite(res).all()
+
+
+def test_conv_pool_graph():
+    sd = SameDiff.create()
+    rng = np.random.RandomState(2)
+    x = sd.placeholder("x", shape=(None, 1, 8, 8))
+    w = sd.var("w", rng.randn(3, 3, 1, 4).astype(np.float32) * 0.1)
+    c = sd.cnn().conv2d(x, w, isSameMode=True, name="conv")
+    p = sd.cnn().maxPooling2d(c, name="pool")
+    xv = rng.randn(2, 1, 8, 8).astype(np.float32)
+    res = sd.output({"x": xv}, "pool")["pool"].numpy()
+    assert res.shape == (2, 4, 4, 4)
+
+
+def test_save_load_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 3))
+    w = sd.var("w", np.ones((3, 2), np.float32) * 2.0)
+    b = sd.var("b", np.ones((2,), np.float32))
+    sd.nn().linear(x, w, b, name="y")
+
+    path = os.path.join(tmp_path, "model.sdz")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    xv = np.ones((1, 3), np.float32)
+    r1 = sd.output({"x": xv}, "y")["y"].numpy()
+    r2 = sd2.output({"x": xv}, "y")["y"].numpy()
+    np.testing.assert_allclose(r1, r2)
+
+
+def test_control_flow_free_ops():
+    sd = SameDiff.create()
+    x = sd.var("x", np.array([-2.0, -1.0, 0.0, 1.0, 2.0], np.float32))
+    y = sd._op("where", [x.gt(0.0), x, sd.constant(np.zeros(5, np.float32))],
+               name="relu_via_where")
+    np.testing.assert_allclose(y.eval().numpy(), [0, 0, 0, 1, 2])
+
+
+def test_onehot_gather():
+    sd = SameDiff.create()
+    idx = sd.var("idx", np.array([0, 2, 1], np.int32))
+    oh = sd._op("oneHot", [idx], {"depth": 3}, name="oh")
+    np.testing.assert_allclose(oh.eval().numpy(),
+                               np.eye(3, dtype=np.float32)[[0, 2, 1]])
+    table = sd.var("table", np.arange(12, dtype=np.float32).reshape(4, 3))
+    g = sd.nn().embeddingLookup(table, idx, name="emb")
+    np.testing.assert_allclose(
+        g.eval().numpy(),
+        np.arange(12, dtype=np.float32).reshape(4, 3)[[0, 2, 1]])
