@@ -497,12 +497,25 @@ def _mhdpa(nHeads=1, scaled=True, **_):
 
 
 # losses -----------------------------------------------------------------
-def _reduce_loss(per_ex, reduction):
+def _reduce_loss(per_ex, reduction, w=None):
+    """LossReduce semantics (reference: org/nd4j/autodiff/loss/LossReduce).
+
+    With weights, MEAN_BY_NONZERO_WEIGHT_COUNT divides by the number of
+    non-zero weights (the masked-LM convention), MEAN_BY_WEIGHT by sum(w).
+    """
+    if w is not None:
+        per_ex = per_ex * w
+        w = jnp.broadcast_to(w, per_ex.shape)  # count broadcast elements
     if reduction == "NONE":
         return per_ex
     if reduction == "SUM":
         return jnp.sum(per_ex)
-    return jnp.mean(per_ex)  # MEAN_BY_WEIGHT ~ mean
+    if w is None:
+        return jnp.mean(per_ex)
+    if reduction == "MEAN_BY_WEIGHT":
+        return jnp.sum(per_ex) / jnp.maximum(jnp.sum(w), 1e-9)
+    nz = jnp.sum((w != 0).astype(per_ex.dtype))
+    return jnp.sum(per_ex) / jnp.maximum(nz, 1.0)
 
 
 @register_op("softmaxCrossEntropy")
@@ -512,19 +525,17 @@ def _sce(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", labelSmoothing=0.0, **_):
             n = labels.shape[-1]
             labels = labels * (1.0 - labelSmoothing) + labelSmoothing / n
         per = -jnp.sum(labels * jax.nn.log_softmax(logits, -1), axis=-1)
-        if w:
-            per = per * w[0]
-        return _reduce_loss(per, reduction)
+        return _reduce_loss(per, reduction, w[0] if w else None)
     return fn
 
 
 @register_op("sparseSoftmaxCrossEntropy")
 def _ssce(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
-    def fn(logits, labels):
+    def fn(logits, labels, *w):
         lp = jax.nn.log_softmax(logits, -1)
         per = -jnp.take_along_axis(
             lp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-        return _reduce_loss(per, reduction)
+        return _reduce_loss(per, reduction, w[0] if w else None)
     return fn
 
 
@@ -534,9 +545,7 @@ def _sigce(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
         per = jnp.mean(
             jnp.maximum(logits, 0) - logits * labels
             + jnp.log1p(jnp.exp(-jnp.abs(logits))), axis=-1)
-        if w:
-            per = per * w[0]
-        return _reduce_loss(per, reduction)
+        return _reduce_loss(per, reduction, w[0] if w else None)
     return fn
 
 
@@ -544,9 +553,7 @@ def _sigce(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
 def _mse_loss(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
     def fn(pred, labels, *w):
         per = jnp.mean((pred - labels) ** 2, axis=-1)
-        if w:
-            per = per * w[0]
-        return _reduce_loss(per, reduction)
+        return _reduce_loss(per, reduction, w[0] if w else None)
     return fn
 
 
@@ -554,9 +561,7 @@ def _mse_loss(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
 def _l1_loss(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
     def fn(pred, labels, *w):
         per = jnp.mean(jnp.abs(pred - labels), axis=-1)
-        if w:
-            per = per * w[0]
-        return _reduce_loss(per, reduction)
+        return _reduce_loss(per, reduction, w[0] if w else None)
     return fn
 
 
@@ -893,8 +898,10 @@ class SDLoss(_Namespace):
                            {"labelSmoothing": labelSmoothing},
                            name=name).markAsLoss()
 
-    def sparseSoftmaxCrossEntropy(self, logits, labels, name=None):
-        return self.sd._op("sparseSoftmaxCrossEntropy", [logits, labels],
+    def sparseSoftmaxCrossEntropy(self, logits, labels, weights=None,
+                                  name=None):
+        ins = [logits, labels] + ([weights] if weights is not None else [])
+        return self.sd._op("sparseSoftmaxCrossEntropy", ins,
                            name=name).markAsLoss()
 
     def sigmoidCrossEntropy(self, label, logits, weights=None, name=None):

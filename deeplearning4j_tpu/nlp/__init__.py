@@ -1,0 +1,10 @@
+"""NLP: tokenization, vocab, BERT data pipeline, embedding models.
+
+Reference: deeplearning4j-nlp-parent/deeplearning4j-nlp (SURVEY.md §2.5 NLP
+row): tokenizers incl. BertWordPieceTokenizer, BertIterator, Word2Vec.
+"""
+from deeplearning4j_tpu.nlp.tokenization import (BertWordPieceTokenizer,  # noqa: F401
+                                                 BertWordPieceTokenizerFactory,
+                                                 DefaultTokenizer,
+                                                 DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401

@@ -251,3 +251,4 @@ def _json_default(o):
     if dataclasses.is_dataclass(o):
         return dataclasses.asdict(o)
     return str(o)
+from deeplearning4j_tpu.nn.conf import attention  # noqa: F401  (registers attention layers)

@@ -1,0 +1,260 @@
+"""Attention layers.
+
+Reference: deeplearning4j-nn ``conf/layers/{SelfAttentionLayer,
+LearnedSelfAttentionLayer,RecurrentAttentionLayer}.java`` wrapping the
+libnd4j fused ``multi_head_dot_product_attention`` declarable op
+(``ops/declarable/generic/nn/multi_head_dot_product_attention.cpp`` —
+SURVEY.md §2.5, §5.7).
+
+TPU-first: attention is ONE einsum chain (projections → scores → softmax →
+context → out-projection), fully fused by XLA onto the MXU — no custom-op
+dispatch.  Data format follows the DL4J RNN convention (b, nIn, t); masks are
+(b, t) with 1 = valid.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["SelfAttentionLayer", "LearnedSelfAttentionLayer",
+           "RecurrentAttentionLayer"]
+
+
+def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None):
+    """Multi-head attention core.  x_btn: (b, t, n); mask: (b, t_k)."""
+    q_btn = x_btn if q_btn is None else q_btn
+    b, tq, _ = q_btn.shape
+    tk = x_btn.shape[1]
+
+    def heads(inp, w):
+        y = jnp.matmul(inp, w)                       # (b, t, h*dh)
+        return y.reshape(b, inp.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = heads(q_btn, Wq), heads(x_btn, Wk), heads(x_btn, Wv)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(qh.shape[-1], qh.dtype))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if mask is not None:
+        m = mask.astype(bool).reshape(b, 1, 1, tk)
+        scores = jnp.where(m, scores, jnp.asarray(-1e9, scores.dtype))
+    w = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, tq, -1)
+    return jnp.matmul(ctx, Wo)                       # (b, tq, nOut)
+
+
+@dataclasses.dataclass
+class SelfAttentionLayer(BaseLayer):
+    """Per-timestep self-attention over the sequence.
+
+    Reference: ``conf/layers/SelfAttentionLayer.java``.  Input (b, nIn, t) →
+    output (b, nOut, t).  ``projectInput`` must be true when nHeads > 1
+    (matching the reference's validation).
+    """
+    nIn: int = 0
+    nOut: int = 0
+    nHeads: int = 1
+    headSize: int = 0
+    projectInput: bool = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+        if not self.headSize:
+            self.headSize = (self.nOut or self.nIn) // self.nHeads
+        if not self.nOut:
+            self.nOut = self.nIn if not self.projectInput \
+                else self.nHeads * self.headSize
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.timeSeriesLength)
+
+    def weightParamKeys(self):
+        return ("Wq", "Wk", "Wv", "Wo")
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        if not self.projectInput:
+            if self.nHeads > 1:  # matches the reference's validation
+                raise ValueError(
+                    "projectInput=False requires nHeads == 1")
+            return {}
+        d = self.nHeads * self.headSize
+        wi = self.weightInit or "XAVIER"
+        ks = jax.random.split(key, 4)
+        return {"Wq": init_weight(ks[0], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wk": init_weight(ks[1], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wv": init_weight(ks[2], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wo": init_weight(ks[3], (d, self.nOut), d, self.nOut, wi,
+                                  dtype)}
+
+    acceptsMask = True
+
+    def forward(self, params, x, train, key, state, mask=None):
+        x = self._dropin(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))             # (b, t, nIn)
+        if self.projectInput:
+            y = _mha(xt, params["Wq"], params["Wk"], params["Wv"],
+                     params["Wo"], self.nHeads, mask)
+        else:
+            eye = jnp.eye(self.nIn, dtype=xt.dtype)
+            y = _mha(xt, eye, eye, eye, eye, 1, mask)
+        return jnp.transpose(y, (0, 2, 1)), state
+
+
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(BaseLayer):
+    """Attention with nQueries LEARNED query vectors: pools a variable-length
+    sequence to a fixed (b, nOut, nQueries) output.
+
+    Reference: ``conf/layers/LearnedSelfAttentionLayer.java``.
+    """
+    nIn: int = 0
+    nOut: int = 0
+    nHeads: int = 1
+    headSize: int = 0
+    nQueries: int = 1
+    projectInput: bool = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+        if not self.headSize:
+            self.headSize = (self.nOut or self.nIn) // self.nHeads
+        if not self.nOut:
+            self.nOut = self.nIn if not self.projectInput \
+                else self.nHeads * self.headSize
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, self.nQueries)
+
+    def weightParamKeys(self):
+        return ("Wq", "Wk", "Wv", "Wo", "Q")
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        if not self.projectInput and self.nHeads > 1:
+            raise ValueError("projectInput=False requires nHeads == 1")
+        ks = jax.random.split(key, 5)
+        wi = self.weightInit or "XAVIER"
+        p = {"Q": init_weight(ks[4], (self.nIn, self.nQueries), self.nIn,
+                              self.nQueries, wi, dtype)}
+        if self.projectInput:
+            d = self.nHeads * self.headSize
+            p.update({
+                "Wq": init_weight(ks[0], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wk": init_weight(ks[1], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wv": init_weight(ks[2], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wo": init_weight(ks[3], (d, self.nOut), d, self.nOut, wi,
+                                  dtype)})
+        return p
+
+    acceptsMask = True
+
+    def forward(self, params, x, train, key, state, mask=None):
+        x = self._dropin(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))             # (b, t, nIn)
+        b = xt.shape[0]
+        q = jnp.broadcast_to(params["Q"].T[None], (b, self.nQueries, self.nIn))
+        if self.projectInput:
+            y = _mha(xt, params["Wq"], params["Wk"], params["Wv"],
+                     params["Wo"], self.nHeads, mask, q_btn=q)
+        else:
+            eye = jnp.eye(self.nIn, dtype=xt.dtype)
+            y = _mha(xt, eye, eye, eye, eye, 1, mask, q_btn=q)
+        return jnp.transpose(y, (0, 2, 1)), state    # (b, nOut, nQueries)
+
+
+@dataclasses.dataclass
+class RecurrentAttentionLayer(BaseLayer):
+    """Recurrent cell whose per-timestep input is augmented with an attention
+    readout over the whole input sequence.
+
+    Reference: ``conf/layers/RecurrentAttentionLayer.java`` (SimpleRnn-style
+    recurrence + attention per step).  Output (b, nOut, t).  The recurrence
+    runs as ``lax.scan`` (compiler-friendly control flow); the attention
+    context for ALL timesteps is computed as one batched einsum BEFORE the
+    scan — O(t²) matmul on the MXU instead of t sequential attention calls.
+    """
+    nIn: int = 0
+    nOut: int = 0
+    nHeads: int = 1
+    headSize: int = 0
+    projectInput: bool = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+        if not self.headSize:
+            self.headSize = (self.nOut or self.nIn) // self.nHeads
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.timeSeriesLength)
+
+    def weightParamKeys(self):
+        return ("W", "RW", "Wq", "Wk", "Wv", "Wo")
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        ks = jax.random.split(key, 7)
+        wi = self.weightInit or "XAVIER"
+        # context width: projected = nHeads*headSize, unprojected = nIn
+        d = self.nHeads * self.headSize if self.projectInput else self.nIn
+        if not self.projectInput and self.nHeads > 1:
+            raise ValueError("projectInput=False requires nHeads == 1")
+        p = {"W": init_weight(ks[0], (self.nIn + d, self.nOut),
+                              self.nIn + d, self.nOut, wi, dtype),
+             "RW": init_weight(ks[1], (self.nOut, self.nOut), self.nOut,
+                               self.nOut, wi, dtype),
+             "b": jnp.zeros((self.nOut,), dtype)}
+        if self.projectInput:
+            p.update({
+                "Wq": init_weight(ks[2], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wk": init_weight(ks[3], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wv": init_weight(ks[4], (self.nIn, d), self.nIn, d, wi, dtype),
+                "Wo": init_weight(ks[5], (d, d), d, d, wi, dtype)})
+        return p
+
+    acceptsMask = True
+
+    def forward(self, params, x, train, key, state, mask=None):
+        from deeplearning4j_tpu.nn.activations import get_activation
+        x = self._dropin(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))             # (b, t, nIn)
+        if self.projectInput:
+            ctx = _mha(xt, params["Wq"], params["Wk"], params["Wv"],
+                       params["Wo"], self.nHeads, mask)  # (b, t, d)
+        else:
+            eye = jnp.eye(self.nIn, dtype=xt.dtype)
+            ctx = _mha(xt, eye, eye, eye, eye, 1, mask)
+        inp = jnp.concatenate([xt, ctx], axis=-1)    # (b, t, nIn+d)
+        act = get_activation(self.activation or "tanh")
+        pre = jnp.einsum("btn,no->bto", inp, params["W"]) + params["b"]
+
+        def cell(h, pre_t):
+            h = act(pre_t + jnp.matmul(h, params["RW"]))
+            return h, h
+
+        h0 = jnp.zeros((xt.shape[0], self.nOut), xt.dtype)
+        _, ys = jax.lax.scan(cell, h0, jnp.transpose(pre, (1, 0, 2)))
+        y = jnp.transpose(ys, (1, 2, 0))             # (b, nOut, t)
+        if mask is not None:
+            y = y * mask[:, None, :].astype(y.dtype)
+        return y, state
+
+
+for _c in [SelfAttentionLayer, LearnedSelfAttentionLayer,
+           RecurrentAttentionLayer]:
+    register_layer(_c)

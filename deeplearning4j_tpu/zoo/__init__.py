@@ -1,3 +1,4 @@
 """Model zoo (reference: deeplearning4j-zoo org/deeplearning4j/zoo)."""
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet, LeNet, ResNet50, SimpleCNN, VGG16, ZooModel)
+from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertConfig  # noqa: F401

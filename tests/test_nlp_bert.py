@@ -1,0 +1,186 @@
+"""T5b tests: tokenization, BertIterator, attention layers, BERT-on-SameDiff.
+
+Reference analogues: deeplearning4j-nlp tokenizer tests, BertIterator tests,
+AttentionLayerTest gradient checks (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BertIterator, BertWordPieceTokenizer,
+                                    BertWordPieceTokenizerFactory)
+from deeplearning4j_tpu.nlp.tokenization import make_vocab
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "a quick movement of the enemy will jeopardize five gunboats",
+    "the five boxing wizards jump quickly",
+    "pack my box with five dozen liquor jugs",
+] * 4
+
+
+def vocab():
+    return make_vocab(CORPUS, size=200)
+
+
+class TestWordPiece:
+    def test_known_words_roundtrip(self):
+        v = vocab()
+        tf = BertWordPieceTokenizerFactory(v)
+        toks = tf.create("the quick brown fox").getTokens()
+        assert toks == ["the", "quick", "brown", "fox"]
+
+    def test_subword_split(self):
+        v = {"[UNK]": 0, "un": 1, "##able": 2, "##believ": 3}
+        t = BertWordPieceTokenizer("unbelievable", v)
+        assert t.getTokens() == ["un", "##believ", "##able"]
+
+    def test_unknown_token(self):
+        v = {"[UNK]": 0, "the": 1}
+        assert BertWordPieceTokenizer("zzz the", v).getTokens() == \
+            ["[UNK]", "the"]
+
+
+class TestBertIterator:
+    def test_mlm_batch_shapes(self):
+        tf = BertWordPieceTokenizerFactory(vocab())
+        it = (BertIterator.builder().tokenizer(tf)
+              .task(BertIterator.Task.UNSUPERVISED)
+              .lengthHandling("FIXED_LENGTH", 16)
+              .minibatchSize(4).sentenceProvider(CORPUS).build())
+        mds = it.next()
+        assert mds.features[0].shape == (4, 16)   # masked token ids
+        assert mds.features[1].shape == (4, 16)   # segments
+        assert mds.labels[0].shape == (4, 16)     # original ids
+        assert mds.labelsMasks[0].shape == (4, 16)
+        # at least one masked position across the batch (15% of ~40 tokens)
+        assert mds.labelsMasks[0].numpy().sum() >= 1
+
+    def test_classification_batch(self):
+        tf = BertWordPieceTokenizerFactory(vocab())
+        pairs = [(s, i % 2) for i, s in enumerate(CORPUS)]
+        it = (BertIterator.builder().tokenizer(tf)
+              .task(BertIterator.Task.SEQ_CLASSIFICATION)
+              .lengthHandling("FIXED_LENGTH", 16)
+              .minibatchSize(8).numLabels(2).sentenceProvider(pairs).build())
+        mds = it.next()
+        assert mds.labels[0].shape == (8, 2)
+        np.testing.assert_allclose(mds.labels[0].numpy().sum(1), 1.0)
+
+
+class TestAttentionLayers:
+    def _fit(self, layer_builder):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
+                                                       OutputLayer)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 6, 10).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.mean((1, 2)) > 0).astype(int)]
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .list()
+                .layer(layer_builder)
+                .layer(GlobalPoolingLayer.builder().poolingType("AVG").build())
+                .layer(OutputLayer.builder("mcxent").nOut(2)
+                       .activation("softmax").build())
+                .setInputType(InputType.recurrent(6, 10)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        ds = DataSet(x, y)
+        net.fit(ds)
+        s0 = net.score(ds)
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score(ds) < s0
+        return net
+
+    def test_self_attention_trains(self):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        self._fit(SelfAttentionLayer.builder().nHeads(2).headSize(4).build())
+
+    def test_learned_self_attention_trains(self):
+        from deeplearning4j_tpu.nn.conf.attention import \
+            LearnedSelfAttentionLayer
+        self._fit(LearnedSelfAttentionLayer.builder().nHeads(2).headSize(4)
+                  .nQueries(3).build())
+
+    def test_recurrent_attention_trains(self):
+        from deeplearning4j_tpu.nn.conf.attention import \
+            RecurrentAttentionLayer
+        self._fit(RecurrentAttentionLayer.builder().nOut(8).nHeads(2)
+                  .headSize(4).build())
+
+    def test_masked_attention_matches_truncated(self):
+        """Masked-out timesteps must not affect earlier outputs."""
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        import jax
+        layer = SelfAttentionLayer.builder().nHeads(1).headSize(6).build()
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        it = InputType.recurrent(6, 10)
+        layer.inferNIn(it)
+        params = layer.initParams(jax.random.PRNGKey(0), it)
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 6, 10).astype(np.float32)
+        mask = np.ones((2, 10), np.float32)
+        mask[:, 5:] = 0.0
+        ym, _ = layer.forward(params, x, False, None, {}, mask=mask)
+        x2 = x.copy()
+        x2[:, :, 5:] = 99.0  # garbage in masked region
+        ym2, _ = layer.forward(params, x2, False, None, {}, mask=mask)
+        np.testing.assert_allclose(np.asarray(ym)[:, :, :5],
+                                   np.asarray(ym2)[:, :, :5], atol=1e-5)
+
+
+class TestBertModel:
+    def _tiny(self, task="mlm", vocabSize=64):
+        from deeplearning4j_tpu.zoo import Bert, BertConfig
+        return Bert(BertConfig(vocabSize=vocabSize, hiddenSize=32,
+                               numLayers=2, numHeads=2, intermediateSize=64,
+                               maxSeqLength=16, task=task, numLabels=2))
+
+    def test_mlm_forward_and_train(self):
+        from deeplearning4j_tpu.learning import Adam
+        v = vocab()
+        tf = BertWordPieceTokenizerFactory(v)
+        it = (BertIterator.builder().tokenizer(tf)
+              .task(BertIterator.Task.UNSUPERVISED)
+              .lengthHandling("FIXED_LENGTH", 16)
+              .minibatchSize(8).sentenceProvider(CORPUS).build())
+        from deeplearning4j_tpu.zoo import Bert, BertConfig
+        model = Bert(BertConfig(vocabSize=len(v), hiddenSize=32, numLayers=2,
+                                numHeads=2, intermediateSize=64,
+                                maxSeqLength=16, task="mlm"))
+        model.setTrainingConfig(Adam(1e-3))
+        h1 = model.fit(it, epochs=1)
+        h2 = model.fit(it, epochs=4)
+        assert h2.finalTrainingLoss() < h1.lossCurve()[0]
+
+        mds = it.next() if it.hasNext() else (it.reset() or it.next())
+        out = model.output(mds.features[0].numpy(), mds.features[1].numpy(),
+                           mds.featuresMasks[0].numpy())
+        assert out.shape == (8, 16, 32)
+
+    def test_classifier_forward(self):
+        model = self._tiny(task="classification")
+        toks = np.zeros((4, 16), np.int32)
+        segs = np.zeros((4, 16), np.int32)
+        mask = np.ones((4, 16), np.float32)
+        out = model.sd.output({"tokenIds": toks, "segmentIds": segs,
+                               "featMask": mask}, "logits")["logits"]
+        assert out.shape == (4, 2)
+
+    def test_save_load(self, tmp_path):
+        import os
+        model = self._tiny()
+        toks = np.zeros((2, 16), np.int32)
+        segs = np.zeros((2, 16), np.int32)
+        mask = np.ones((2, 16), np.float32)
+        r1 = model.output(toks, segs, mask).numpy()
+        p = os.path.join(tmp_path, "bert.sdz")
+        model.save(p)
+        from deeplearning4j_tpu.zoo import Bert
+        m2 = Bert.load(p)
+        r2 = m2.output(toks, segs, mask).numpy()
+        np.testing.assert_allclose(r1, r2, atol=1e-6)
