@@ -426,26 +426,32 @@ def _conv2d(kH=1, kW=1, sH=1, sW=1, pH=0, pW=0, dH=1, dW=1,
     return fn
 
 
+def _pool_dims(kH, kW, sH, sW, pH, pW, dataFormat):
+    if dataFormat == "NHWC":
+        return (1, kH, kW, 1), (1, sH, sW, 1), \
+            ((0, 0), (pH, pH), (pW, pW), (0, 0))
+    return (1, 1, kH, kW), (1, 1, sH, sW), \
+        ((0, 0), (0, 0), (pH, pH), (pW, pW))
+
+
 @register_op("maxPooling2d")
-def _maxpool2d(kH=2, kW=2, sH=2, sW=2, pH=0, pW=0, isSameMode=False, **_):
+def _maxpool2d(kH=2, kW=2, sH=2, sW=2, pH=0, pW=0, isSameMode=False,
+               dataFormat="NCHW", **_):
+    win, stride, pad = _pool_dims(kH, kW, sH, sW, pH, pW, dataFormat)
     def fn(x):
-        pad = ("SAME" if isSameMode
-               else ((0, 0), (0, 0), (pH, pH), (pW, pW)))
-        return lax.reduce_window(x, -jnp.inf, lax.max,
-                                 (1, 1, kH, kW), (1, 1, sH, sW), pad)
+        p = "SAME" if isSameMode else pad
+        return lax.reduce_window(x, -jnp.inf, lax.max, win, stride, p)
     return fn
 
 
 @register_op("avgPooling2d")
-def _avgpool2d(kH=2, kW=2, sH=2, sW=2, pH=0, pW=0, isSameMode=False, **_):
+def _avgpool2d(kH=2, kW=2, sH=2, sW=2, pH=0, pW=0, isSameMode=False,
+               dataFormat="NCHW", **_):
+    win, stride, pad = _pool_dims(kH, kW, sH, sW, pH, pW, dataFormat)
     def fn(x):
-        pad = ("SAME" if isSameMode
-               else ((0, 0), (0, 0), (pH, pH), (pW, pW)))
-        s = lax.reduce_window(x, 0.0, lax.add,
-                              (1, 1, kH, kW), (1, 1, sH, sW), pad)
-        ones = jnp.ones_like(x)
-        n = lax.reduce_window(ones, 0.0, lax.add,
-                              (1, 1, kH, kW), (1, 1, sH, sW), pad)
+        p = "SAME" if isSameMode else pad
+        s = lax.reduce_window(x, 0.0, lax.add, win, stride, p)
+        n = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win, stride, p)
         return s / n
     return fn
 

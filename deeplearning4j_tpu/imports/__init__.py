@@ -1,0 +1,8 @@
+"""Model import: TF GraphDef -> SameDiff, Keras h5 -> MultiLayerNetwork.
+
+Reference: nd4j samediff-import (Kotlin rule-based framework; legacy facade
+``TFGraphMapper.importGraph``) and deeplearning4j-modelimport
+(``KerasModelImport``) — SURVEY.md §2.3, §2.5.
+"""
+from deeplearning4j_tpu.imports.tf_import import TFGraphMapper  # noqa: F401
+from deeplearning4j_tpu.imports.keras_import import KerasModelImport  # noqa: F401

@@ -1,0 +1,353 @@
+"""Keras HDF5 import → MultiLayerNetwork.
+
+Reference: deeplearning4j-modelimport ``org/deeplearning4j/nn/modelimport/
+keras/KerasModelImport.java`` + per-layer mapping classes
+(``KerasDense``, ``KerasConvolution2D``, ``KerasBatchNormalization``, … —
+SURVEY.md §2.5).
+
+Scope (like the reference's Sequential path): Dense, Conv2D, MaxPooling2D,
+AveragePooling2D, Flatten, Dropout, Activation, BatchNormalization, LSTM,
+Embedding.  h5py reads the file; weights are re-laid-out to this framework's
+conventions:
+
+- Conv2D kernels: Keras HWIO → OIHW.
+- Dense after Flatten of a conv feature map: Keras flattens channels-last
+  (h, w, c) while this framework flattens NCHW (c, h, w) — kernel rows are
+  permuted accordingly (the reference's KerasFlatten/preprocessor does the
+  same reordering).
+- LSTM kernels: Keras gate order (i, f, g, o) → ours (i, f, o, g).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["KerasModelImport"]
+
+
+def _cfg(layer: Dict) -> Dict:
+    return layer.get("config", {})
+
+
+_ACT = {"relu": "relu", "softmax": "softmax", "sigmoid": "sigmoid",
+        "tanh": "tanh", "linear": "identity", "elu": "elu", "selu": "selu",
+        "softplus": "softplus", "softsign": "softsign", "swish": "swish",
+        "gelu": "gelu", "hard_sigmoid": "hardsigmoid",
+        "leaky_relu": "leakyrelu", "relu6": "relu6", "exponential": "exp"}
+
+
+def _act(name: Optional[str]) -> str:
+    if not name:
+        return "identity"
+    return _ACT.get(name, name)
+
+
+class _WeightStore:
+    """Finds per-layer weight arrays in a Keras .h5 (tf.keras layout)."""
+
+    def __init__(self, f):
+        import h5py
+        self.f = f
+        root = f["model_weights"] if "model_weights" in f else f
+        self.root = root
+
+    def get(self, layer_name: str) -> List[np.ndarray]:
+        import h5py
+        if layer_name not in self.root:
+            return []
+        g = self.root[layer_name]
+        names = g.attrs.get("weight_names")
+        out = []
+        if names is not None:
+            for n in names:
+                n = n.decode() if isinstance(n, bytes) else str(n)
+                out.append(np.asarray(g[n]))
+            return out
+        # fallback: recursive in-order collect
+        def visit(name, obj):
+            if isinstance(obj, h5py.Dataset):
+                out.append(np.asarray(obj))
+        g.visititems(visit)
+        return out
+
+
+class KerasModelImport:
+    """Reference facade: KerasModelImport.importKerasSequentialModelAndWeights."""
+
+    @staticmethod
+    def importKerasSequentialModelAndWeights(path: str,
+                                             enforceTrainingConfig: bool = False):
+        import h5py
+
+        from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+
+        with h5py.File(path, "r") as f:
+            raw = f.attrs.get("model_config")
+            if raw is None:
+                raise ValueError("No model_config in h5 (not a Keras model?)")
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            model_cfg = json.loads(raw)
+            cls = model_cfg.get("class_name")
+            layers_cfg = model_cfg["config"]
+            if isinstance(layers_cfg, dict):
+                layers_cfg = layers_cfg.get("layers", [])
+            if cls in ("Functional", "Model"):
+                layers_cfg = _linearize_functional(layers_cfg)
+            elif cls != "Sequential":
+                raise ValueError(f"Unsupported Keras model class: {cls}")
+            store = _WeightStore(f)
+            return _build_sequential(layers_cfg, store, InputType,
+                                     NeuralNetConfiguration,
+                                     MultiLayerNetwork)
+
+    # parity name: also accepts Functional models whose graph is a linear
+    # chain (branching functional models are not yet supported)
+    importKerasModelAndWeights = importKerasSequentialModelAndWeights
+
+
+def _linearize_functional(layers_cfg: List[Dict]) -> List[Dict]:
+    """Order a Functional model's layers as a linear chain via inbound_nodes;
+    raises on branching topologies (DL4J maps those to ComputationGraph —
+    not yet supported here)."""
+    inbound: Dict[str, List[str]] = {}
+    for lk in layers_cfg:
+        name = _cfg(lk).get("name", lk.get("name"))
+        srcs = []
+        for node in lk.get("inbound_nodes", []):
+            if isinstance(node, dict):    # keras3 format
+                args = node.get("args", [])
+                def walk(a):
+                    if isinstance(a, dict) and "config" in a and \
+                            isinstance(a["config"], dict) and \
+                            "keras_history" in a["config"]:
+                        srcs.append(a["config"]["keras_history"][0])
+                    elif isinstance(a, (list, tuple)):
+                        for x in a:
+                            walk(x)
+                walk(args)
+            elif isinstance(node, (list, tuple)):  # keras2: [[name,0,0,{}]..]
+                for entry in node:
+                    if entry and isinstance(entry, (list, tuple)):
+                        srcs.append(entry[0])
+        inbound[name] = srcs
+    if any(len(s) > 1 for s in inbound.values()):
+        raise ValueError("Keras import: branching functional models are not "
+                         "supported yet (linear chains only)")
+    # chain order: start at the layer with no inbound
+    by_name = {_cfg(lk).get("name", lk.get("name")): lk for lk in layers_cfg}
+    succ = {s[0]: n for n, s in inbound.items() if s}
+    starts = [n for n, s in inbound.items() if not s]
+    if len(starts) != 1:
+        raise ValueError("Keras import: expected exactly one input layer")
+    order, cur = [], starts[0]
+    while cur is not None:
+        order.append(by_name[cur])
+        cur = succ.get(cur)
+    return order
+
+
+def _track_shape(cur, lay, out_channels):
+    """Track the Keras-side (h, w, c) feature-map shape through conv/pool
+    layers using the layer's own shape inference (keeps the Flatten->Dense
+    kernel permutation consistent with actual output sizes)."""
+    if cur is None:
+        return None
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+    h, w, c = cur
+    out = lay.getOutputType(IT.convolutional(h, w, c))
+    return (out.height, out.width,
+            out_channels if out_channels is not None else c)
+
+
+def _input_type(cfg: Dict, InputType):
+    shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+    if shape is None:
+        return None
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feedForward(int(dims[0]))
+    if len(dims) == 3:          # Keras default channels_last (h, w, c)
+        h, w, c = dims
+        return InputType.convolutional(int(h), int(w), int(c))
+    if len(dims) == 2:          # (t, features) -> our recurrent (n, t)
+        t, n = dims
+        return InputType.recurrent(int(n), int(t) if t else None)
+    raise ValueError(f"Unsupported input shape {shape}")
+
+
+def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
+                      MultiLayerNetwork):
+    from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                                   BatchNormalization,
+                                                   ConvolutionLayer,
+                                                   DenseLayer, DropoutLayer,
+                                                   EmbeddingSequenceLayer,
+                                                   OutputLayer,
+                                                   SubsamplingLayer)
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM
+
+    builder = NeuralNetConfiguration.builder().list()
+    input_type = None
+    our_layers: List[Tuple[Any, Optional[str], str]] = []  # (layer, kname, kind)
+    kcfgs: Dict[str, Dict] = {}        # keras layer name -> its config dict
+    flatten_from_conv = False
+    pending_flatten: Dict[int, Tuple[int, int, int]] = {}
+    cur_conv_shape: Optional[Tuple[int, int, int]] = None  # (h, w, c) Keras
+
+    idx = 0
+    n_layers = len(layers_cfg)
+    for li, lk in enumerate(layers_cfg):
+        cls = lk["class_name"]
+        cfg = _cfg(lk)
+        kname = cfg.get("name", lk.get("name"))
+        if kname:
+            kcfgs[kname] = cfg
+        if input_type is None:
+            it = _input_type(cfg, InputType)
+            if it is not None:
+                input_type = it
+                if it.kind == "CNN":
+                    cur_conv_shape = (it.height, it.width, it.channels)
+        if cls == "InputLayer":
+            continue
+        if cls == "Flatten":
+            flatten_from_conv = cur_conv_shape is not None
+            if flatten_from_conv:
+                pending_flatten[len(our_layers)] = cur_conv_shape
+            continue
+        if cls == "Dropout":
+            rate = float(cfg.get("rate", 0.5))
+            our_layers.append((DropoutLayer(dropOut=1.0 - rate), None,
+                               "dropout"))
+            continue
+        if cls == "Activation":
+            our_layers.append((
+                ActivationLayer(activation=_act(cfg.get("activation"))),
+                None, "activation"))
+            continue
+        if cls == "Dense":
+            units = int(cfg["units"])
+            act = _act(cfg.get("activation"))
+            is_last = li == n_layers - 1
+            if is_last and act == "softmax":
+                lay = OutputLayer.builder("mcxent").nOut(units) \
+                    .activation("softmax").build()
+            else:
+                lay = DenseLayer(nOut=units, activation=act)
+            our_layers.append((lay, kname, "dense"))
+            cur_conv_shape = None
+            continue
+        if cls == "Conv2D":
+            if cfg.get("data_format") == "channels_first":
+                raise ValueError("Keras import: channels_first Conv2D is "
+                                 "not supported (save as channels_last)")
+            k = cfg.get("kernel_size", [3, 3])
+            s = cfg.get("strides", [1, 1])
+            d = cfg.get("dilation_rate", [1, 1])
+            same = cfg.get("padding", "valid") == "same"
+            lay = ConvolutionLayer(
+                nOut=int(cfg["filters"]), kernelSize=tuple(int(x) for x in k),
+                stride=tuple(int(x) for x in s),
+                dilation=tuple(int(x) for x in d),
+                convolutionMode="Same" if same else "Truncate",
+                activation=_act(cfg.get("activation")),
+                hasBias=bool(cfg.get("use_bias", True)))
+            our_layers.append((lay, kname, "conv"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay,
+                                          int(cfg["filters"]))
+            continue
+        if cls in ("MaxPooling2D", "AveragePooling2D"):
+            k = cfg.get("pool_size", [2, 2])
+            s = cfg.get("strides") or k
+            same = cfg.get("padding", "valid") == "same"
+            lay = SubsamplingLayer(
+                kernelSize=tuple(int(x) for x in k),
+                stride=tuple(int(x) for x in s),
+                convolutionMode="Same" if same else "Truncate",
+                poolingType="MAX" if cls == "MaxPooling2D" else "AVG")
+            our_layers.append((lay, None, "pool"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
+            continue
+        if cls == "BatchNormalization":
+            lay = BatchNormalization(eps=float(cfg.get("epsilon", 1e-3)))
+            our_layers.append((lay, kname, "bn"))
+            continue
+        if cls == "LSTM":
+            lstm = LSTM(nOut=int(cfg["units"]),
+                        activation=_act(cfg.get("activation", "tanh")))
+            if not cfg.get("return_sequences", False):
+                from deeplearning4j_tpu.nn.conf.recurrent import LastTimeStep
+                our_layers.append((LastTimeStep(lstm), kname, "lstm"))
+            else:
+                our_layers.append((lstm, kname, "lstm"))
+            continue
+        if cls == "Embedding":
+            lay = EmbeddingSequenceLayer(nIn=int(cfg["input_dim"]),
+                                         nOut=int(cfg["output_dim"]))
+            our_layers.append((lay, kname, "embedding"))
+            continue
+        raise ValueError(f"Keras import: unsupported layer {cls}")
+
+    for lay, _k, _kind in our_layers:
+        builder = builder.layer(lay)
+    if input_type is not None:
+        builder = builder.setInputType(input_type)
+    conf = builder.build()
+    net = MultiLayerNetwork(conf)
+    net.init()
+
+    # ---- weights ----
+    import jax.numpy as jnp
+    for i, (lay, kname, kind) in enumerate(our_layers):
+        if kname is None:
+            continue
+        ws = store.get(kname)
+        if not ws:
+            continue
+        li = str(i)
+        if kind == "dense":
+            kern, bias = ws[0], (ws[1] if len(ws) > 1 else None)
+            if i in pending_flatten:
+                h, w, c = pending_flatten[i]
+                # rows are (h, w, c)-ordered; ours expect (c, h, w)
+                kern = kern.reshape(h, w, c, -1).transpose(2, 0, 1, 3) \
+                    .reshape(h * w * c, -1)
+            net.params_[li]["W"] = jnp.asarray(kern)
+            if bias is not None and "b" in net.params_[li]:
+                net.params_[li]["b"] = jnp.asarray(bias)
+        elif kind == "conv":
+            kern = ws[0]                      # HWIO
+            net.params_[li]["W"] = jnp.asarray(kern.transpose(3, 2, 0, 1))
+            if len(ws) > 1 and "b" in net.params_[li]:
+                net.params_[li]["b"] = jnp.asarray(ws[1])
+        elif kind == "bn":
+            # keras order: [gamma if scale][beta if center] mean, variance
+            cfg = kcfgs.get(kname, {})
+            idx = 0
+            if cfg.get("scale", True):
+                net.params_[li]["gamma"] = jnp.asarray(ws[idx])
+                idx += 1
+            if cfg.get("center", True):
+                net.params_[li]["beta"] = jnp.asarray(ws[idx])
+                idx += 1
+            net.state_[li]["mean"] = jnp.asarray(ws[idx])
+            net.state_[li]["var"] = jnp.asarray(ws[idx + 1])
+        elif kind == "lstm":
+            kern, rec, bias = ws[0], ws[1], (ws[2] if len(ws) > 2 else None)
+            u = rec.shape[0]
+            def reorder(m):
+                i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
+                                  m[..., 2*u:3*u], m[..., 3*u:4*u])
+                return np.concatenate([i_, f_, o_, g_], axis=-1)
+            net.params_[li]["W"] = jnp.asarray(reorder(kern))
+            net.params_[li]["RW"] = jnp.asarray(reorder(rec))
+            if bias is not None:
+                net.params_[li]["b"] = jnp.asarray(reorder(bias))
+        elif kind == "embedding":
+            net.params_[li]["W"] = jnp.asarray(ws[0])
+    return net

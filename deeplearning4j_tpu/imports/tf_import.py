@@ -1,0 +1,503 @@
+"""TF GraphDef → SameDiff importer.
+
+Reference: nd4j ``samediff-import-tensorflow`` (Kotlin ``TensorflowImporter``
+→ ``ImportGraph`` with an ``OpMappingRegistry`` of per-op declarative rules)
+and the legacy facade ``nd4j-api .../imports/graphmapper/tf/
+TFGraphMapper.java`` (SURVEY.md §3.3).
+
+Design: same rule-registry shape as the reference — ``TF_OPS`` maps a TF op
+name to an emitter that appends the equivalent ops to the target SameDiff.
+Frozen-graph Const weights import as trainable VARIABLEs (enabling
+fine-tuning, matching the reference), other Consts as constants.  Axis/shape
+tensor-inputs must be constant-foldable (the reference's rules have the same
+static requirement); graphs land as static-shape XLA-compilable functions.
+
+Parsing uses the protobuf classes from the installed tensorflow package ONLY
+to read the GraphDef — execution is entirely this framework's.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+__all__ = ["TFGraphMapper", "TF_OPS", "register_tf_op"]
+
+TF_OPS: Dict[str, Callable] = {}
+
+
+def register_tf_op(*names):
+    def deco(fn):
+        for n in names:
+            TF_OPS[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Import context: resolves TF tensor names to SDVariables and tracks
+    constant values for static folding (axes/shapes/perms)."""
+
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self.tensors: Dict[str, SDVariable] = {}   # "node:i" -> var
+        self.const_vals: Dict[str, np.ndarray] = {}
+
+    def put(self, name: str, var: SDVariable, const: Optional[np.ndarray] = None):
+        self.tensors[name] = var
+        self.tensors.setdefault(name.split(":")[0], var)
+        if const is not None:
+            self.const_vals[name] = const
+            self.const_vals.setdefault(name.split(":")[0], const)
+
+    def get(self, name: str) -> SDVariable:
+        if name in self.tensors:
+            return self.tensors[name]
+        base = name.split(":")[0]
+        return self.tensors[base]
+
+    def const(self, name: str) -> np.ndarray:
+        """Constant value of an input (for axes/shape/perm operands)."""
+        if name in self.const_vals:
+            return self.const_vals[name]
+        base = name.split(":")[0]
+        if base in self.const_vals:
+            return self.const_vals[base]
+        raise ValueError(
+            f"TF import: input '{name}' must be a foldable constant")
+
+
+def _attr(node, key, default=None):
+    if key not in node.attr:
+        return default
+    a = node.attr[key]
+    kind = a.WhichOneof("value")
+    if kind == "i":
+        return int(a.i)
+    if kind == "f":
+        return float(a.f)
+    if kind == "b":
+        return bool(a.b)
+    if kind == "s":
+        return a.s.decode("utf-8", "ignore")
+    if kind == "list":
+        if a.list.i:
+            return [int(v) for v in a.list.i]
+        if a.list.f:
+            return [float(v) for v in a.list.f]
+        return []
+    if kind == "type":
+        return int(a.type)
+    if kind == "shape":
+        return [d.size for d in a.shape.dim]
+    return default
+
+
+def _tensor_value(node) -> np.ndarray:
+    from tensorflow.python.framework import tensor_util
+    return tensor_util.MakeNdarray(node.attr["value"].tensor)
+
+
+def _data_inputs(node) -> List[str]:
+    return [i for i in node.input if not i.startswith("^")]
+
+
+# --------------------------------------------------------------------------
+# emitters
+# --------------------------------------------------------------------------
+@register_tf_op("Placeholder")
+def _ph(ctx, node):
+    shape = _attr(node, "shape")
+    if shape is not None:
+        shape = [None if int(s) < 0 else int(s) for s in shape]
+    v = ctx.sd.placeholder(node.name, shape=shape)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Const")
+def _const(ctx, node):
+    val = _tensor_value(node)
+    if np.issubdtype(val.dtype, np.floating) and val.size > 1:
+        v = ctx.sd.var(node.name, val)   # frozen weight -> trainable
+    else:
+        v = ctx.sd.constant(val, name=node.name)
+    ctx.put(node.name, v, const=val)
+
+
+@register_tf_op("Identity", "StopGradient", "PreventGradient", "Snapshot",
+                "CheckNumerics")
+def _identity(ctx, node):
+    src = _data_inputs(node)[0]
+    v = ctx.sd._op("identity", [ctx.get(src)], name=node.name)
+    ctx.put(node.name, v)
+    if src in ctx.const_vals or src.split(":")[0] in ctx.const_vals:
+        ctx.const_vals[node.name] = ctx.const(src)
+
+
+def _simple_map(tf_name, our_op, n_in=1):
+    @register_tf_op(tf_name)
+    def _f(ctx, node, _op=our_op, _n=n_in):
+        ins = [ctx.get(i) for i in _data_inputs(node)[:_n]]
+        ctx.put(node.name, ctx.sd._op(_op, ins, name=node.name))
+
+
+for _tf, _ours in [("Add", "add"), ("AddV2", "add"), ("Sub", "sub"),
+                   ("Mul", "mul"), ("RealDiv", "div"), ("Div", "div"),
+                   ("Maximum", "max_pairwise"), ("Minimum", "min_pairwise"),
+                   ("Pow", "pow"), ("SquaredDifference", "squaredDifference"),
+                   ("FloorDiv", "floordiv"), ("FloorMod", "mod"),
+                   ("Equal", "eq"), ("NotEqual", "neq"), ("Greater", "gt"),
+                   ("GreaterEqual", "gte"), ("Less", "lt"),
+                   ("LessEqual", "lte"), ("LogicalAnd", "and_"),
+                   ("LogicalOr", "or_")]:
+    _simple_map(_tf, _ours, n_in=2)
+
+for _tf, _ours in [("Neg", "neg"), ("Exp", "exp"), ("Log", "log"),
+                   ("Log1p", "log1p"), ("Sqrt", "sqrt"), ("Rsqrt", "rsqrt"),
+                   ("Square", "square"), ("Abs", "abs"), ("Sign", "sign"),
+                   ("Floor", "floor"), ("Ceil", "ceil"), ("Round", "round"),
+                   ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                   ("Tanh", "tanh"), ("Sigmoid", "sigmoid"), ("Erf", "erf"),
+                   ("Relu", "relu"), ("Relu6", "relu6"), ("Elu", "elu"),
+                   ("Selu", "selu"), ("Softplus", "softplus"),
+                   ("Softsign", "softsign"), ("LogicalNot", "not_"),
+                   ("Reciprocal", "reciprocal"), ("IsNan", "isNaN"),
+                   ("Erfc", "erfc"), ("Sinh", "sinh"), ("Cosh", "cosh"),
+                   ("Asin", "asin"), ("Acos", "acos"), ("Atan", "atan"),
+                   ("IsInf", "isInf"), ("IsFinite", "isFinite")]:
+    _simple_map(_tf, _ours, n_in=1)
+
+
+@register_tf_op("LeakyRelu")
+def _leaky_relu(ctx, node):
+    v = ctx.sd._op("leakyRelu", [ctx.get(_data_inputs(node)[0])],
+                   {"alpha": _attr(node, "alpha", 0.2)}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("MatMul")
+def _matmul(ctx, node):
+    a, b = _data_inputs(node)[:2]
+    v = ctx.sd._op("mmul", [ctx.get(a), ctx.get(b)],
+                   {"transposeA": _attr(node, "transpose_a", False),
+                    "transposeB": _attr(node, "transpose_b", False)},
+                   name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("BatchMatMul", "BatchMatMulV2")
+def _batch_matmul(ctx, node):
+    a, b = _data_inputs(node)[:2]
+    v = ctx.sd._op("mmul", [ctx.get(a), ctx.get(b)],
+                   {"transposeA": _attr(node, "adj_x", False),
+                    "transposeB": _attr(node, "adj_y", False)},
+                   name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("BiasAdd")
+def _biasadd(ctx, node):
+    x, b = _data_inputs(node)[:2]
+    if _attr(node, "data_format", "NHWC") == "NCHW":
+        xv = ctx.get(x)
+        bv = ctx.get(b)
+        bshaped = ctx.sd._op("reshape", [bv], {"shape": [-1, 1, 1]})
+        ctx.put(node.name, ctx.sd._op("add", [xv, bshaped], name=node.name))
+    else:
+        ctx.put(node.name, ctx.sd._op("add", [ctx.get(x), ctx.get(b)],
+                                      name=node.name))
+
+
+@register_tf_op("AddN")
+def _addn(ctx, node):
+    ins = _data_inputs(node)
+    acc = ctx.get(ins[0])
+    for i in ins[1:]:
+        acc = ctx.sd._op("add", [acc, ctx.get(i)])
+    ctx.put(node.name, acc.rename(ctx.sd._unique(node.name)))
+
+
+def _reduce_map(tf_name, our_op):
+    @register_tf_op(tf_name)
+    def _f(ctx, node, _op=our_op):
+        x, ax = _data_inputs(node)[:2]
+        dims = np.atleast_1d(ctx.const(ax)).astype(int).tolist()
+        v = ctx.sd._op(_op, [ctx.get(x)],
+                       {"dims": dims,
+                        "keepDims": _attr(node, "keep_dims", False)},
+                       name=node.name)
+        ctx.put(node.name, v)
+
+
+for _tf, _ours in [("Mean", "mean"), ("Sum", "sum"), ("Max", "reduce_max"),
+                   ("Min", "reduce_min"), ("Prod", "prod"), ("All", "all"),
+                   ("Any", "any")]:
+    _reduce_map(_tf, _ours)
+
+
+@register_tf_op("ArgMax")
+def _tf_argmax(ctx, node):
+    x, ax = _data_inputs(node)[:2]
+    v = ctx.sd._op("argmax", [ctx.get(x)],
+                   {"dimension": int(np.atleast_1d(ctx.const(ax))[0])},
+                   name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Softmax")
+def _tf_softmax(ctx, node):
+    ctx.put(node.name, ctx.sd._op("softmax",
+                                  [ctx.get(_data_inputs(node)[0])],
+                                  {"dimension": -1}, name=node.name))
+
+
+@register_tf_op("LogSoftmax")
+def _tf_logsoftmax(ctx, node):
+    ctx.put(node.name, ctx.sd._op("logSoftmax",
+                                  [ctx.get(_data_inputs(node)[0])],
+                                  {"dimension": -1}, name=node.name))
+
+
+@register_tf_op("Reshape")
+def _tf_reshape(ctx, node):
+    x, shp = _data_inputs(node)[:2]
+    shape = [int(s) for s in np.atleast_1d(ctx.const(shp))]
+    v = ctx.sd._op("reshape", [ctx.get(x)], {"shape": shape}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Transpose")
+def _tf_transpose(ctx, node):
+    x, perm = _data_inputs(node)[:2]
+    dims = [int(p) for p in np.atleast_1d(ctx.const(perm))]
+    ctx.put(node.name, ctx.sd._op("permute", [ctx.get(x)], {"dims": dims},
+                                  name=node.name))
+
+
+@register_tf_op("ExpandDims")
+def _tf_expand(ctx, node):
+    x, ax = _data_inputs(node)[:2]
+    ctx.put(node.name, ctx.sd._op(
+        "expandDims", [ctx.get(x)],
+        {"axis": int(np.atleast_1d(ctx.const(ax))[0])}, name=node.name))
+
+
+@register_tf_op("Squeeze")
+def _tf_squeeze(ctx, node):
+    dims = _attr(node, "squeeze_dims") or None
+    ctx.put(node.name, ctx.sd._op(
+        "squeeze", [ctx.get(_data_inputs(node)[0])],
+        {"axis": tuple(dims) if dims else None}, name=node.name))
+
+
+@register_tf_op("ConcatV2")
+def _tf_concat(ctx, node):
+    ins = _data_inputs(node)
+    axis = int(np.atleast_1d(ctx.const(ins[-1]))[0])
+    v = ctx.sd._op("concat", [ctx.get(i) for i in ins[:-1]],
+                   {"dimension": axis}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Pack")
+def _tf_pack(ctx, node):
+    v = ctx.sd._op("stack", [ctx.get(i) for i in _data_inputs(node)],
+                   {"axis": _attr(node, "axis", 0)}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("GatherV2", "Gather")
+def _tf_gather(ctx, node):
+    ins = _data_inputs(node)
+    axis = 0
+    if len(ins) > 2:
+        axis = int(np.atleast_1d(ctx.const(ins[2]))[0])
+    v = ctx.sd._op("gather", [ctx.get(ins[0]), ctx.get(ins[1])],
+                   {"axis": axis}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("OneHot")
+def _tf_onehot(ctx, node):
+    ins = _data_inputs(node)
+    depth = int(np.atleast_1d(ctx.const(ins[1]))[0])
+    on = float(np.atleast_1d(ctx.const(ins[2]))[0]) if len(ins) > 2 else 1.0
+    off = float(np.atleast_1d(ctx.const(ins[3]))[0]) if len(ins) > 3 else 0.0
+    v = ctx.sd._op("oneHot", [ctx.get(ins[0])],
+                   {"depth": depth, "on": on, "off": off,
+                    "axis": _attr(node, "axis", -1)}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Cast")
+def _tf_cast(ctx, node):
+    from tensorflow.python.framework import dtypes as tf_dtypes
+    dst = tf_dtypes.as_dtype(node.attr["DstT"].type).as_numpy_dtype
+    v = ctx.sd._op("cast", [ctx.get(_data_inputs(node)[0])],
+                   {"dtype": np.dtype(dst).name}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("StridedSlice")
+def _tf_strided_slice(ctx, node):
+    ins = _data_inputs(node)
+    begin = np.atleast_1d(ctx.const(ins[1])).astype(int)
+    end = np.atleast_1d(ctx.const(ins[2])).astype(int)
+    strides = np.atleast_1d(ctx.const(ins[3])).astype(int)
+    bm = _attr(node, "begin_mask", 0)
+    em = _attr(node, "end_mask", 0)
+    sm = _attr(node, "shrink_axis_mask", 0)
+    if _attr(node, "ellipsis_mask", 0) or _attr(node, "new_axis_mask", 0):
+        raise ValueError("TF import: StridedSlice ellipsis_mask/new_axis_mask"
+                         f" not supported (node '{node.name}')")
+    x = ctx.get(ins[0])
+    b, e, s = [], [], []
+    shrink = []
+    for i in range(len(begin)):
+        b.append(None if bm & (1 << i) else int(begin[i]))
+        e.append(None if em & (1 << i) else int(end[i]))
+        s.append(int(strides[i]))
+        if sm & (1 << i):
+            shrink.append(i)
+            bi = b[-1] if b[-1] is not None else 0
+            # begin -1 means "last element": end must be None, not 0
+            e[-1] = None if bi == -1 else bi + 1
+            s[-1] = 1
+    v = ctx.sd._op("stridedSlice", [x],
+                   {"begin": b, "end": e, "strides": s}, name=node.name)
+    if shrink:
+        v = ctx.sd._op("squeeze", [v], {"axis": tuple(shrink)})
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Slice")
+def _tf_slice(ctx, node):
+    ins = _data_inputs(node)
+    begin = np.atleast_1d(ctx.const(ins[1])).astype(int).tolist()
+    size = np.atleast_1d(ctx.const(ins[2])).astype(int).tolist()
+    v = ctx.sd._op("slice", [ctx.get(ins[0])],
+                   {"begin": begin, "size": size}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Pad", "PadV2")
+def _tf_pad(ctx, node):
+    ins = _data_inputs(node)
+    paddings = np.asarray(ctx.const(ins[1])).astype(int).tolist()
+    const = 0.0
+    if len(ins) > 2:
+        const = float(np.atleast_1d(ctx.const(ins[2]))[0])
+    v = ctx.sd._op("pad", [ctx.get(ins[0])],
+                   {"paddings": paddings, "constant": const}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("Tile")
+def _tf_tile(ctx, node):
+    ins = _data_inputs(node)
+    reps = np.atleast_1d(ctx.const(ins[1])).astype(int).tolist()
+    ctx.put(node.name, ctx.sd._op("tile", [ctx.get(ins[0])], {"reps": reps},
+                                  name=node.name))
+
+
+@register_tf_op("Select", "SelectV2")
+def _tf_select(ctx, node):
+    ins = [ctx.get(i) for i in _data_inputs(node)[:3]]
+    ctx.put(node.name, ctx.sd._op("where", ins, name=node.name))
+
+
+@register_tf_op("Conv2D")
+def _tf_conv2d(ctx, node):
+    x, w = _data_inputs(node)[:2]
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    fmt = _attr(node, "data_format", "NHWC")
+    dil = _attr(node, "dilations", [1, 1, 1, 1])
+    if fmt == "NHWC":
+        sH, sW, dH, dW = strides[1], strides[2], dil[1], dil[2]
+    else:
+        sH, sW, dH, dW = strides[2], strides[3], dil[2], dil[3]
+    v = ctx.sd._op("conv2d", [ctx.get(x), ctx.get(w)],
+                   {"sH": sH, "sW": sW, "dH": dH, "dW": dW,
+                    "isSameMode": _attr(node, "padding") == "SAME",
+                    "dataFormat": fmt}, name=node.name)
+    ctx.put(node.name, v)
+
+
+def _tf_pool(ctx, node, op):
+    x = _data_inputs(node)[0]
+    k = _attr(node, "ksize", [1, 2, 2, 1])
+    s = _attr(node, "strides", [1, 2, 2, 1])
+    fmt = _attr(node, "data_format", "NHWC")
+    if fmt == "NHWC":
+        kH, kW, sH, sW = k[1], k[2], s[1], s[2]
+    else:
+        kH, kW, sH, sW = k[2], k[3], s[2], s[3]
+    v = ctx.sd._op(op, [ctx.get(x)],
+                   {"kH": kH, "kW": kW, "sH": sH, "sW": sW,
+                    "isSameMode": _attr(node, "padding") == "SAME",
+                    "dataFormat": fmt}, name=node.name)
+    ctx.put(node.name, v)
+
+
+@register_tf_op("MaxPool")
+def _tf_maxpool(ctx, node):
+    _tf_pool(ctx, node, "maxPooling2d")
+
+
+@register_tf_op("AvgPool")
+def _tf_avgpool(ctx, node):
+    _tf_pool(ctx, node, "avgPooling2d")
+
+
+@register_tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _tf_fused_bn(ctx, node):
+    ins = _data_inputs(node)
+    x, gamma, beta, mean, var = [ctx.get(i) for i in ins[:5]]
+    fmt = _attr(node, "data_format", "NHWC")
+    axis = 3 if fmt == "NHWC" else 1
+    v = ctx.sd._op("batchNorm", [x, mean, var, gamma, beta],
+                   {"axis": axis, "eps": _attr(node, "epsilon", 1e-3)},
+                   name=node.name)
+    ctx.put(node.name, v)
+
+
+# --------------------------------------------------------------------------
+# facade
+# --------------------------------------------------------------------------
+class TFGraphMapper:
+    """Reference facade: nd4j-api .../imports/graphmapper/tf/TFGraphMapper."""
+
+    @staticmethod
+    def importGraph(graph) -> SameDiff:
+        """``graph``: path to a frozen .pb, a GraphDef, or bytes."""
+        gd = _as_graphdef(graph)
+        sd = SameDiff.create()
+        ctx = _Ctx(sd)
+        for node in gd.node:
+            if node.op in ("NoOp",):
+                continue
+            emit = TF_OPS.get(node.op)
+            if emit is None:
+                raise ValueError(
+                    f"TF import: unsupported op '{node.op}' (node "
+                    f"'{node.name}'); supported: {sorted(TF_OPS)}")
+            emit(ctx, node)
+        return sd
+
+
+def _as_graphdef(graph):
+    from tensorflow.core.framework import graph_pb2
+    if isinstance(graph, graph_pb2.GraphDef):
+        return graph
+    if isinstance(graph, bytes):
+        gd = graph_pb2.GraphDef()
+        gd.ParseFromString(graph)
+        return gd
+    if isinstance(graph, str):
+        gd = graph_pb2.GraphDef()
+        with open(graph, "rb") as f:
+            gd.ParseFromString(f.read())
+        return gd
+    raise TypeError(f"Cannot import {type(graph)}")

@@ -1,0 +1,347 @@
+"""T5c golden-file import tests.
+
+Reference pattern: nd4j-tests ``TFGraphTestAllSameDiff`` (frozen TF graphs +
+saved input/output tensors, import → execute → compare within tolerance) and
+``KerasModelEndToEndTest`` (SURVEY.md §4).  Here the goldens are generated
+locally with the installed tensorflow (CPU) instead of a downloaded corpus —
+TF is the *oracle*, execution under test is entirely this framework.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def freeze(fn, *specs):
+    """Concrete function -> frozen GraphDef with Const weights."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    return frozen, frozen.graph.as_graph_def()
+
+
+def import_and_compare(graph_def, feeds, tf_out, out_name, atol=1e-4):
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    sd = TFGraphMapper.importGraph(graph_def)
+    res = sd.output(feeds, out_name)[out_name].numpy()
+    np.testing.assert_allclose(res, tf_out, atol=atol, rtol=1e-4)
+    return sd
+
+
+class TestTFImport:
+    def test_mlp(self):
+        w1 = tf.Variable(np.random.RandomState(0).randn(8, 16)
+                         .astype(np.float32))
+        b1 = tf.Variable(np.zeros(16, np.float32))
+        w2 = tf.Variable(np.random.RandomState(1).randn(16, 4)
+                         .astype(np.float32))
+
+        def mlp(x):
+            h = tf.nn.relu(tf.matmul(x, w1) + b1)
+            return tf.nn.softmax(tf.matmul(h, w2), name="probs")
+
+        frozen, gd = freeze(mlp, tf.TensorSpec([None, 8], tf.float32))
+        x = np.random.RandomState(2).randn(5, 8).astype(np.float32)
+        tf_out = frozen(tf.constant(x))[0].numpy()
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        out = [n.name for n in gd.node
+               if n.name.startswith("probs") or "Softmax" in n.op][-1]
+        import_and_compare(gd, {ph: x}, tf_out, out)
+
+    def test_layernorm_pattern(self):
+        g = tf.Variable(np.ones(12, np.float32))
+        b = tf.Variable(np.zeros(12, np.float32))
+
+        def ln(x):
+            mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(x, mu), axis=-1,
+                                 keepdims=True)
+            return tf.identity((x - mu) * tf.math.rsqrt(var + 1e-6) * g + b,
+                               name="ln_out")
+
+        frozen, gd = freeze(ln, tf.TensorSpec([None, 12], tf.float32))
+        x = np.random.RandomState(3).randn(4, 12).astype(np.float32)
+        tf_out = frozen(tf.constant(x))[0].numpy()
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        import_and_compare(gd, {ph: x}, tf_out, "ln_out")
+
+    def test_conv_pool_nhwc(self):
+        k = tf.Variable(np.random.RandomState(4).randn(3, 3, 2, 4)
+                        .astype(np.float32) * 0.3)
+
+        def cnn(x):
+            y = tf.nn.conv2d(x, k, strides=1, padding="SAME")
+            y = tf.nn.relu(y)
+            return tf.nn.max_pool2d(y, 2, 2, "VALID", name="pool_out")
+
+        frozen, gd = freeze(cnn, tf.TensorSpec([None, 8, 8, 2], tf.float32))
+        x = np.random.RandomState(5).randn(2, 8, 8, 2).astype(np.float32)
+        tf_out = frozen(tf.constant(x))[0].numpy()
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        import_and_compare(gd, {ph: x}, tf_out, "pool_out")
+
+    def test_attention_pattern_batchmatmul(self):
+        def attn(q, kv):
+            scores = tf.matmul(q, kv, transpose_b=True) / 4.0
+            w = tf.nn.softmax(scores)
+            return tf.identity(tf.matmul(w, kv), name="attn_out")
+
+        frozen, gd = freeze(attn, tf.TensorSpec([2, 5, 16], tf.float32),
+                            tf.TensorSpec([2, 7, 16], tf.float32))
+        rng = np.random.RandomState(6)
+        q = rng.randn(2, 5, 16).astype(np.float32)
+        kv = rng.randn(2, 7, 16).astype(np.float32)
+        tf_out = frozen(tf.constant(q), tf.constant(kv))[0].numpy()
+        phs = [n.name for n in gd.node if n.op == "Placeholder"]
+        import_and_compare(gd, {phs[0]: q, phs[1]: kv}, tf_out, "attn_out")
+
+    def test_shapes_gather_concat(self):
+        def fn(x):
+            a = tf.transpose(x, [1, 0])
+            b = tf.reshape(a, [-1])
+            c = tf.gather(b, tf.constant([0, 3, 5]))
+            d = tf.concat([c, c], axis=0)
+            return tf.identity(tf.reduce_sum(tf.exp(d)), name="out")
+
+        frozen, gd = freeze(fn, tf.TensorSpec([3, 4], tf.float32))
+        x = np.random.RandomState(7).randn(3, 4).astype(np.float32)
+        tf_out = frozen(tf.constant(x))[0].numpy()
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        import_and_compare(gd, {ph: x}, tf_out, "out")
+
+    def test_imported_graph_is_trainable(self):
+        """Frozen Const weights become VARIABLEs — fine-tuning works."""
+        from deeplearning4j_tpu.autodiff.samediff import (TrainingConfig,
+                                                          VariableType)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.imports import TFGraphMapper
+        from deeplearning4j_tpu.learning import Adam
+
+        w = tf.Variable(np.zeros((4, 2), np.float32))
+
+        def lin(x):
+            return tf.identity(tf.matmul(x, w), name="pred")
+
+        _, gd = freeze(lin, tf.TensorSpec([None, 4], tf.float32))
+        sd = TFGraphMapper.importGraph(gd)
+        wnames = [v.name() for v in sd.variables()
+                  if v.variableType == VariableType.VARIABLE]
+        assert len(wnames) == 1
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        label = sd.placeholder("label", shape=(None, 2))
+        sd.loss().meanSquaredError(label, sd.getVariable("pred"), name="loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(0.1), dataSetFeatureMapping=[ph],
+            dataSetLabelMapping=["label"]))
+        rng = np.random.RandomState(8)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = (X @ rng.randn(4, 2)).astype(np.float32)
+        hist = sd.fit(DataSet(X, Y), epochs=100)
+        assert hist.finalTrainingLoss() < 0.05
+
+
+class TestKerasImport:
+    def _roundtrip(self, model, x, atol=1e-4):
+        import tempfile
+
+        from deeplearning4j_tpu.imports import KerasModelImport
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        ours = net.output(self._to_ours(x)).numpy()
+        np.testing.assert_allclose(ours, keras_out, atol=atol, rtol=1e-3)
+        return net
+
+    @staticmethod
+    def _to_ours(x):
+        if x.ndim == 4:          # NHWC -> NCHW
+            return np.transpose(x, (0, 3, 1, 2))
+        return x
+
+    def test_dense_mlp(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(10,)),
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dense(8, activation="tanh"),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(0).randn(6, 10).astype(np.float32)
+        self._roundtrip(model, x)
+
+    def test_cnn_flatten_dense(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(12, 12, 1)),
+            tf.keras.layers.Conv2D(4, 3, activation="relu"),
+            tf.keras.layers.MaxPooling2D(2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(10, activation="softmax")])
+        x = np.random.RandomState(1).randn(3, 12, 12, 1).astype(np.float32)
+        self._roundtrip(model, x)
+
+    def test_conv_same_padding_and_bn(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(8, 8, 3)),
+            tf.keras.layers.Conv2D(6, 3, padding="same"),
+            tf.keras.layers.BatchNormalization(),
+            tf.keras.layers.Activation("relu"),
+            tf.keras.layers.AveragePooling2D(2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(4, activation="softmax")])
+        # set non-trivial BN stats
+        bn = model.layers[1]
+        bn.set_weights([np.random.RandomState(2).rand(6).astype(np.float32) + .5,
+                        np.random.RandomState(3).randn(6).astype(np.float32),
+                        np.random.RandomState(4).randn(6).astype(np.float32),
+                        np.random.RandomState(5).rand(6).astype(np.float32) + .5])
+        x = np.random.RandomState(6).randn(2, 8, 8, 3).astype(np.float32)
+        self._roundtrip(model, x, atol=1e-3)
+
+
+class TestTransformerBlockImport:
+    def test_transformer_encoder_block(self):
+        """BERT-shaped block: MHA (batchmatmul path) + residual layernorm +
+        GELU FFN — the import pattern benchmark config #3 relies on."""
+        rng = np.random.RandomState(0)
+        B, T, H, nh = 2, 6, 16, 2
+        dh = H // nh
+        mk = lambda *s: tf.Variable(rng.randn(*s).astype(np.float32) * 0.2)
+        Wq, Wk, Wv, Wo = mk(H, H), mk(H, H), mk(H, H), mk(H, H)
+        g1, b1 = tf.Variable(np.ones(H, np.float32)), tf.Variable(np.zeros(H, np.float32))
+        Wi, Bi = mk(H, 32), tf.Variable(np.zeros(32, np.float32))
+        Wo2, Bo2 = mk(32, H), tf.Variable(np.zeros(H, np.float32))
+        g2, b2 = tf.Variable(np.ones(H, np.float32)), tf.Variable(np.zeros(H, np.float32))
+
+        def ln(x, g, b):
+            mu = tf.reduce_mean(x, -1, keepdims=True)
+            v = tf.reduce_mean(tf.math.squared_difference(x, mu), -1,
+                               keepdims=True)
+            return (x - mu) * tf.math.rsqrt(v + 1e-6) * g + b
+
+        def block(x):
+            def proj(w):
+                y = tf.reshape(tf.matmul(tf.reshape(x, [B * T, H]), w),
+                               [B, T, nh, dh])
+                return tf.transpose(y, [0, 2, 1, 3])
+            q, k, v = proj(Wq), proj(Wk), proj(Wv)
+            s = tf.matmul(q, k, transpose_b=True) / np.sqrt(dh).astype(
+                np.float32)
+            w = tf.nn.softmax(s)
+            ctx = tf.transpose(tf.matmul(w, v), [0, 2, 1, 3])
+            ctx = tf.reshape(ctx, [B, T, H])
+            attn = tf.matmul(tf.reshape(ctx, [B * T, H]), Wo)
+            attn = tf.reshape(attn, [B, T, H])
+            x1 = ln(x + attn, g1, b1)
+            h = tf.nn.gelu(tf.matmul(tf.reshape(x1, [B * T, H]), Wi) + Bi)
+            f = tf.matmul(h, Wo2) + Bo2
+            x2 = ln(x1 + tf.reshape(f, [B, T, H]), g2, b2)
+            return tf.identity(x2, name="block_out")
+
+        frozen, gd = freeze(block, tf.TensorSpec([B, T, H], tf.float32))
+        x = rng.randn(B, T, H).astype(np.float32)
+        tf_out = frozen(tf.constant(x))[0].numpy()
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        import_and_compare(gd, {ph: x}, tf_out, "block_out", atol=1e-3)
+
+
+class TestImportEdgeCases:
+    """Regression tests for review findings."""
+
+    def test_tf_negative_index_shrink(self):
+        def fn(x):
+            return tf.identity(x[-1], name="last")
+        frozen, gd = freeze(fn, tf.TensorSpec([4, 3], tf.float32))
+        x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        tf_out = frozen(tf.constant(x))[0].numpy()
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        import_and_compare(gd, {ph: x}, tf_out, "last")
+
+    def _kroundtrip(self, model, x, atol=1e-4):
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        xin = np.transpose(x, (0, 3, 1, 2)) if x.ndim == 4 else x
+        ours = net.output(xin).numpy()
+        assert ours.shape == keras_out.shape
+        np.testing.assert_allclose(ours, keras_out, atol=atol, rtol=1e-3)
+        return net
+
+    def test_keras_bn_scale_false(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.BatchNormalization(scale=False),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        bn = model.layers[0]
+        bn.set_weights([np.random.RandomState(0).randn(6).astype(np.float32),
+                        np.random.RandomState(1).randn(6).astype(np.float32),
+                        np.random.RandomState(2).rand(6).astype(np.float32) + .5])
+        x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
+        self._kroundtrip(model, x, atol=1e-3)
+
+    def test_keras_lstm_last_step(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5, 8)),
+            tf.keras.layers.LSTM(7),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(4).randn(2, 5, 8).astype(np.float32)
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        # keras RNN input (b, t, n) -> ours (b, n, t)
+        ours = net.output(np.transpose(x, (0, 2, 1))).numpy()
+        assert ours.shape == keras_out.shape == (2, 3)
+        np.testing.assert_allclose(ours, keras_out, atol=1e-3, rtol=1e-3)
+
+    def test_keras_pool_same_and_unequal_stride(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(7, 7, 2)),
+            tf.keras.layers.MaxPooling2D(2, padding="same"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(5).randn(2, 7, 7, 2).astype(np.float32)
+        self._kroundtrip(model, x)
+
+        model2 = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(12, 12, 1)),
+            tf.keras.layers.MaxPooling2D(pool_size=3, strides=2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x2 = np.random.RandomState(6).randn(2, 12, 12, 1).astype(np.float32)
+        self._kroundtrip(model2, x2)
+
+    def test_keras_dilated_conv(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(10, 10, 1)),
+            tf.keras.layers.Conv2D(3, 3, dilation_rate=2, activation="relu"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(2, activation="softmax")])
+        x = np.random.RandomState(7).randn(2, 10, 10, 1).astype(np.float32)
+        self._kroundtrip(model, x)
+
+    def test_keras_functional_linear_chain(self):
+        inp = tf.keras.layers.Input(shape=(10,))
+        h = tf.keras.layers.Dense(8, activation="relu")(inp)
+        out = tf.keras.layers.Dense(3, activation="softmax")(h)
+        model = tf.keras.Model(inp, out)
+        x = np.random.RandomState(8).randn(4, 10).astype(np.float32)
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            net = KerasModelImport.importKerasModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x).numpy(), keras_out,
+                                   atol=1e-4, rtol=1e-3)
