@@ -27,24 +27,23 @@ __all__ = ["SelfAttentionLayer", "LearnedSelfAttentionLayer",
            "RecurrentAttentionLayer"]
 
 
-def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None):
-    """Multi-head attention core.  x_btn: (b, t, n); mask: (b, t_k)."""
+def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None, impl="auto"):
+    """Multi-head attention core.  x_btn: (b, t, n); mask: (b, t_k).
+
+    The score/softmax/context chain dispatches through
+    ``parallel.ring.dot_product_attention``: dense (fused by XLA) for short
+    sequences, the Pallas flash kernel on TPU for long ones.
+    """
+    from deeplearning4j_tpu.parallel.ring import dot_product_attention
     q_btn = x_btn if q_btn is None else q_btn
     b, tq, _ = q_btn.shape
-    tk = x_btn.shape[1]
 
     def heads(inp, w):
         y = jnp.matmul(inp, w)                       # (b, t, h*dh)
         return y.reshape(b, inp.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
 
     qh, kh, vh = heads(q_btn, Wq), heads(x_btn, Wk), heads(x_btn, Wv)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(qh.shape[-1], qh.dtype))
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-    if mask is not None:
-        m = mask.astype(bool).reshape(b, 1, 1, tk)
-        scores = jnp.where(m, scores, jnp.asarray(-1e9, scores.dtype))
-    w = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+    ctx = dot_product_attention(qh, kh, vh, mask=mask, impl=impl)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, tq, -1)
     return jnp.matmul(ctx, Wo)                       # (b, tq, nOut)
 

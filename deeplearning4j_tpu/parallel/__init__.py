@@ -6,3 +6,6 @@ from deeplearning4j_tpu.parallel.sharedtraining import (  # noqa: F401
     SparkDl4jMultiLayer, ThresholdAlgorithm, VoidConfiguration)
 from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
     InferenceMode, ParallelInference)
+from deeplearning4j_tpu.parallel.ring import (  # noqa: F401
+    blockwise_attention, context_parallel_attention, dot_product_attention,
+    flash_attention, ring_attention)
