@@ -4,6 +4,9 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode  #
 from deeplearning4j_tpu.parallel.sharedtraining import (  # noqa: F401
     AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm, SharedTrainingMaster,
     SparkDl4jMultiLayer, ThresholdAlgorithm, VoidConfiguration)
+from deeplearning4j_tpu.parallel.gradientsharing import (  # noqa: F401
+    EncodedGradientsAccumulator, InProcessTransport, MeshOrganizer,
+    ModelParameterServer, ResidualClippingPostProcessor)
 from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
     InferenceMode, ParallelInference)
 from deeplearning4j_tpu.parallel.ring import (  # noqa: F401

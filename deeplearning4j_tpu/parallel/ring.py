@@ -183,11 +183,15 @@ except Exception:  # pragma: no cover
 
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 256, interpret: bool = False):
-    """Pallas TPU flash attention (forward).  q/k/v: (b, h, t, d).
+    """Pallas TPU flash attention.  q/k/v: (b, h, t, d).
 
     Grid (b·h, q-blocks, k-blocks); the k dimension is sequential so the
     online-softmax accumulators live in VMEM scratch across k steps.  Off
     TPU (and not ``interpret``) falls back to :func:`blockwise_attention`.
+
+    Differentiable: the forward runs the Pallas kernel; the backward
+    rematerialises through :func:`blockwise_attention`'s VJP (flash-style
+    recompute — no O(T²) residuals are ever stored).
     """
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     if not _HAVE_PALLAS or (not on_tpu and not interpret):
@@ -199,6 +203,32 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     block_k = min(block_k, tk)
     if tq % block_q or tk % block_k:
         return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               block_k=block_k), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
 
     qf = q.reshape(b * h, tq, d)
@@ -299,7 +329,12 @@ def context_parallel_attention(mesh, q, k, v, mask=None, causal: bool = False,
                            axis_size=axis_size, causal=causal)
 
     if mask is None:
-        mask = jnp.ones(q.shape[:1] + q.shape[2:3], dtype=jnp.float32)
+        # No mask operand at all: ring_attention's mm-is-None fast path skips
+        # the per-hop mask ppermute and bias construction entirely.
+        sharded = jax.shard_map(lambda a, b_, c: fn(a, b_, c, mask=None),
+                                mesh=jmesh, in_specs=(spec, spec, spec),
+                                out_specs=spec)
+        return sharded(q, k, v)
     sharded = jax.shard_map(lambda a, b_, c, m_: fn(a, b_, c, mask=m_),
                             mesh=jmesh, in_specs=(spec, spec, spec, mspec),
                             out_specs=spec)

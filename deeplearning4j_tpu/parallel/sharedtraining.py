@@ -39,15 +39,12 @@ class VoidConfiguration:
     meshBuildMode: str = "MESH"
 
 
-class ThresholdAlgorithm:
-    """Reference: AdaptiveThresholdAlgorithm etc. — no-op on TPU."""
-
-    def __init__(self, initialThreshold: float = 1e-3, **kw):
-        self.initialThreshold = initialThreshold
-
-
-AdaptiveThresholdAlgorithm = ThresholdAlgorithm
-FixedThresholdAlgorithm = ThresholdAlgorithm
+# Real threshold-compression machinery (C++ kernels + adaptive controller)
+# lives in .gradientsharing; on the default ICI path it is simply unused.
+from deeplearning4j_tpu.parallel.gradientsharing import (  # noqa: F401,E402
+    AdaptiveThresholdAlgorithm, EncodedGradientsAccumulator,
+    FixedThresholdAlgorithm, ResidualClippingPostProcessor,
+    ThresholdAlgorithm)
 
 
 class SharedTrainingMaster:

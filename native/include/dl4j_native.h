@@ -1,0 +1,125 @@
+/* dl4j_native — C++ runtime core for deeplearning4j_tpu.
+ *
+ * TPU-native analogue of the reference's libnd4j runtime surface
+ * (reference: libnd4j/include/legacy/NativeOps.h): the JAX/XLA executable is
+ * the compute path, and this library is the host-side runtime around it —
+ * threading, gradient-compression kernels for the distributed path,
+ * counter-based RNG, arena memory, and the ETL fast path.
+ *
+ * Flat C ABI by design: consumed from Python via ctypes (no pybind11 in the
+ * image), mirroring how the reference exposes a flat JNI surface.
+ */
+#ifndef DL4J_NATIVE_H
+#define DL4J_NATIVE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define DL4J_NATIVE_ABI_VERSION 1
+
+int64_t dl4j_abi_version(void);
+
+/* ------------------------------------------------------------------ */
+/* Threading (reference: libnd4j include/execution/Threads.h,
+ * samediff::Threads::parallel_for + ThreadPool)                       */
+/* ------------------------------------------------------------------ */
+
+typedef void (*dl4j_kernel_fn)(int64_t start, int64_t stop, void *arg);
+
+/* Number of worker threads in the pool (defaults to hardware_concurrency). */
+int32_t dl4j_num_threads(void);
+void dl4j_set_num_threads(int32_t n);
+
+/* Split [start, stop) into contiguous chunks executed on the pool; blocks
+ * until every chunk has run.  Degrades to inline execution for small spans. */
+void dl4j_parallel_for(dl4j_kernel_fn fn, void *arg, int64_t start,
+                       int64_t stop, int64_t min_chunk);
+
+/* ------------------------------------------------------------------ */
+/* Gradient compression (reference: libnd4j threshold/bitmap encoding
+ * kernels exposed as encodeThresholdP1..P3 / encodeBitmap /
+ * decodeThreshold / decodeBitmap in NativeOps.h; used by the
+ * gradient-sharing distributed path)                                  */
+/* ------------------------------------------------------------------ */
+
+/* Count of |grad[i]| >= threshold (capacity planning for encode). */
+int64_t dl4j_threshold_count(const float *grad, int64_t n, float threshold);
+
+/* Sparse threshold encode with residual semantics: for each |grad[i]| >=
+ * threshold emit a signed index (index+1, negated when grad[i] < 0) and
+ * subtract +/-threshold from grad in place (grad becomes the residual).
+ * Writes at most cap indices; returns the number written. */
+int64_t dl4j_threshold_encode(float *grad, int64_t n, float threshold,
+                              int32_t *out_idx, int64_t cap);
+
+/* Apply a sparse update: target[|s|-1] += sign(s) * threshold. */
+void dl4j_threshold_decode(const int32_t *idx, int64_t count, float threshold,
+                           float *target, int64_t n);
+
+/* Dense 2-bit bitmap encode (00 skip, 01 +threshold, 10 -threshold), 16
+ * values per uint32 word; same residual semantics as threshold encode.
+ * bitmap must hold (n + 15) / 16 words.  Returns count of encoded values. */
+int64_t dl4j_bitmap_encode(float *grad, int64_t n, float threshold,
+                           uint32_t *bitmap);
+void dl4j_bitmap_decode(const uint32_t *bitmap, int64_t n, float threshold,
+                        float *target);
+
+/* ------------------------------------------------------------------ */
+/* Counter-based RNG (reference: libnd4j include/graph/RandomGenerator.h
+ * — Philox-style two-key counter generator)                           */
+/* ------------------------------------------------------------------ */
+
+/* Philox4x32-10.  Streams are (seed, offset)-addressed: the same pair always
+ * produces the same values, independent of call slicing. */
+void dl4j_philox_uniform(uint64_t seed, uint64_t offset, float *out,
+                         int64_t n);                 /* U[0, 1) */
+void dl4j_philox_gaussian(uint64_t seed, uint64_t offset, float *out,
+                          int64_t n);                /* N(0, 1)  */
+void dl4j_philox_uint32(uint64_t seed, uint64_t offset, uint32_t *out,
+                        int64_t n);
+
+/* ------------------------------------------------------------------ */
+/* Workspace arena (reference: libnd4j include/memory/Workspace.h and the
+ * Java MemoryWorkspace mirror — bump allocator with spill + cyclic reset) */
+/* ------------------------------------------------------------------ */
+
+typedef struct dl4j_workspace dl4j_workspace;
+
+dl4j_workspace *dl4j_workspace_create(int64_t initial_bytes);
+/* 64-byte-aligned bump allocation; falls back to malloc ("spill") when the
+ * arena is exhausted.  Spilled bytes are tracked so the next reset can grow
+ * the arena (LEARNING policy in the reference). */
+void *dl4j_workspace_alloc(dl4j_workspace *ws, int64_t nbytes);
+/* Frees spills, optionally grows the arena to fit last cycle, rewinds. */
+void dl4j_workspace_reset(dl4j_workspace *ws);
+void dl4j_workspace_destroy(dl4j_workspace *ws);
+int64_t dl4j_workspace_capacity(const dl4j_workspace *ws);
+int64_t dl4j_workspace_used(const dl4j_workspace *ws);
+int64_t dl4j_workspace_spilled(const dl4j_workspace *ws);
+
+/* ------------------------------------------------------------------ */
+/* ETL fast path (reference: datavec CSVRecordReader — here as a native
+ * buffer->matrix parser so Python iterators stay off the hot path)    */
+/* ------------------------------------------------------------------ */
+
+/* Number of non-empty lines in buf. */
+int64_t dl4j_csv_count_rows(const char *buf, int64_t len);
+
+/* Parse delimiter-separated numeric text into a dense float32 matrix.
+ * Skips skip_rows leading lines; every remaining non-empty line must have
+ * the same column count (inferred from the first).  Returns rows parsed,
+ * stores columns in *out_cols; returns -1 on ragged rows / overflow of
+ * max_vals / malformed numbers. */
+int64_t dl4j_csv_parse_f32(const char *buf, int64_t len, char delim,
+                           int32_t skip_rows, float *out, int64_t max_vals,
+                           int32_t *out_cols);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* DL4J_NATIVE_H */

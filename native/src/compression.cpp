@@ -1,0 +1,114 @@
+/* Gradient-compression kernels for the distributed gradient-sharing path.
+ *
+ * TPU-native analogue of the reference's threshold/bitmap codecs
+ * (reference: libnd4j NativeOps.h encodeThresholdP1..P3, encodeBitmap,
+ * decodeThreshold, decodeBitmap; consumed by EncodedGradientsAccumulator /
+ * SharedTrainingMaster).  On TPU pods the default update path is an ICI
+ * all-reduce inside the jitted step, so these kernels back the *optional*
+ * host-side sharing knob kept for API parity — and they keep the reference's
+ * residual semantics: encode subtracts what it emitted, so un-sent mass
+ * accumulates locally instead of being dropped.
+ *
+ * Formats are original to this implementation:
+ *  - sparse: signed int32 per entry, (index+1) with the sign carrying the
+ *    update direction (+threshold / -threshold);
+ *  - bitmap: 2 bits per value packed 16-per-uint32 (00 skip, 01 plus,
+ *    10 minus).
+ */
+#include "dl4j_native.h"
+
+#include <atomic>
+#include <cmath>
+#include <vector>
+
+namespace {
+
+struct CountCtx {
+  const float *grad;
+  float threshold;
+  std::atomic<int64_t> total{0};
+};
+
+void count_kernel(int64_t start, int64_t stop, void *arg) {
+  auto *ctx = static_cast<CountCtx *>(arg);
+  int64_t local = 0;
+  for (int64_t i = start; i < stop; ++i)
+    if (std::fabs(ctx->grad[i]) >= ctx->threshold) ++local;
+  ctx->total.fetch_add(local, std::memory_order_relaxed);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t dl4j_threshold_count(const float *grad, int64_t n, float threshold) {
+  CountCtx ctx;
+  ctx.grad = grad;
+  ctx.threshold = threshold;
+  dl4j_parallel_for(count_kernel, &ctx, 0, n, 1 << 16);
+  return ctx.total.load();
+}
+
+int64_t dl4j_threshold_encode(float *grad, int64_t n, float threshold,
+                              int32_t *out_idx, int64_t cap) {
+  /* Sequential scan: output order must be deterministic (index-ascending)
+   * for reproducible messages; the scan is memory-bound anyway. */
+  int64_t count = 0;
+  for (int64_t i = 0; i < n && count < cap; ++i) {
+    const float g = grad[i];
+    if (g >= threshold) {
+      out_idx[count++] = static_cast<int32_t>(i + 1);
+      grad[i] = g - threshold;
+    } else if (g <= -threshold) {
+      out_idx[count++] = -static_cast<int32_t>(i + 1);
+      grad[i] = g + threshold;
+    }
+  }
+  return count;
+}
+
+void dl4j_threshold_decode(const int32_t *idx, int64_t count, float threshold,
+                           float *target, int64_t n) {
+  for (int64_t k = 0; k < count; ++k) {
+    const int32_t s = idx[k];
+    const int64_t i = (s < 0 ? -s : s) - 1;
+    if (i < 0 || i >= n) continue;  /* corrupt message: skip, don't crash */
+    target[i] += (s < 0 ? -threshold : threshold);
+  }
+}
+
+int64_t dl4j_bitmap_encode(float *grad, int64_t n, float threshold,
+                           uint32_t *bitmap) {
+  const int64_t words = (n + 15) / 16;
+  for (int64_t w = 0; w < words; ++w) bitmap[w] = 0u;
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const float g = grad[i];
+    uint32_t code = 0u;
+    if (g >= threshold) {
+      code = 1u;
+      grad[i] = g - threshold;
+    } else if (g <= -threshold) {
+      code = 2u;
+      grad[i] = g + threshold;
+    }
+    if (code) {
+      bitmap[i >> 4] |= code << ((i & 15) << 1);
+      ++count;
+    }
+  }
+  return count;
+}
+
+void dl4j_bitmap_decode(const uint32_t *bitmap, int64_t n, float threshold,
+                        float *target) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t code = (bitmap[i >> 4] >> ((i & 15) << 1)) & 3u;
+    if (code == 1u)
+      target[i] += threshold;
+    else if (code == 2u)
+      target[i] -= threshold;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+/* Native ETL fast path: delimiter-separated text -> float32 matrix.
+ *
+ * TPU-native analogue of the reference's CSV ingestion hot path
+ * (reference: datavec-api CSVRecordReader + the per-record Writable
+ * conversion feeding RecordReaderDataSetIterator).  The Python datavec layer
+ * keeps the RecordReader API; numeric bulk loads drop into this kernel so
+ * host ETL keeps up with the device step.  Rows parse in parallel on the
+ * thread pool after an index pass over line breaks.
+ */
+#include "dl4j_native.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Line {
+  const char *begin;
+  const char *end;
+};
+
+/* Collect non-empty, non-'\r' trimmed lines. */
+std::vector<Line> index_lines(const char *buf, int64_t len) {
+  std::vector<Line> lines;
+  const char *p = buf;
+  const char *limit = buf + len;
+  while (p < limit) {
+    const char *nl = static_cast<const char *>(
+        std::memchr(p, '\n', static_cast<size_t>(limit - p)));
+    const char *end = nl ? nl : limit;
+    const char *trim = end;
+    while (trim > p && (trim[-1] == '\r' || trim[-1] == ' ')) --trim;
+    if (trim > p) lines.push_back({p, trim});
+    p = nl ? nl + 1 : limit;
+  }
+  return lines;
+}
+
+int32_t count_fields(const Line &ln, char delim) {
+  int32_t fields = 1;
+  for (const char *p = ln.begin; p < ln.end; ++p)
+    if (*p == delim) ++fields;
+  return fields;
+}
+
+struct ParseCtx {
+  const Line *lines;
+  char delim;
+  int32_t cols;
+  float *out;
+  std::atomic<int32_t> error{0};
+};
+
+void parse_kernel(int64_t start, int64_t stop, void *arg) {
+  auto *ctx = static_cast<ParseCtx *>(arg);
+  for (int64_t r = start; r < stop; ++r) {
+    const Line &ln = ctx->lines[r];
+    const char *p = ln.begin;
+    float *row = ctx->out + r * ctx->cols;
+    for (int32_t c = 0; c < ctx->cols; ++c) {
+      char *next = nullptr;
+      row[c] = std::strtof(p, &next);
+      if (next == p) {  /* not a number */
+        ctx->error.store(1);
+        return;
+      }
+      p = next;
+      if (c + 1 < ctx->cols) {
+        while (p < ln.end && *p != ctx->delim) ++p;
+        if (p >= ln.end) {  /* ragged: fewer fields than expected */
+          ctx->error.store(1);
+          return;
+        }
+        ++p;
+      }
+    }
+    /* Trailing junk after the last field (other than spaces) = ragged. */
+    while (p < ln.end && (*p == ' ' || *p == '\r')) ++p;
+    if (p < ln.end) ctx->error.store(1);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t dl4j_csv_count_rows(const char *buf, int64_t len) {
+  return static_cast<int64_t>(index_lines(buf, len).size());
+}
+
+int64_t dl4j_csv_parse_f32(const char *buf, int64_t len, char delim,
+                           int32_t skip_rows, float *out, int64_t max_vals,
+                           int32_t *out_cols) {
+  std::vector<Line> lines = index_lines(buf, len);
+  if (skip_rows < 0) skip_rows = 0;
+  if (static_cast<size_t>(skip_rows) >= lines.size()) {
+    if (out_cols) *out_cols = 0;
+    return 0;
+  }
+  const Line *rows = lines.data() + skip_rows;
+  const int64_t nrows = static_cast<int64_t>(lines.size()) - skip_rows;
+  const int32_t cols = count_fields(rows[0], delim);
+  if (out_cols) *out_cols = cols;
+  if (nrows * cols > max_vals) return -1;
+  for (int64_t r = 1; r < nrows; ++r)
+    if (count_fields(rows[r], delim) != cols) return -1;
+
+  ParseCtx ctx;
+  ctx.lines = rows;
+  ctx.delim = delim;
+  ctx.cols = cols;
+  ctx.out = out;
+  dl4j_parallel_for(parse_kernel, &ctx, 0, nrows, 256);
+  return ctx.error.load() ? -1 : nrows;
+}
+
+}  // extern "C"

@@ -1,0 +1,114 @@
+/* Counter-based host RNG: Philox4x32-10.
+ *
+ * TPU-native analogue of the reference's two-key counter generator
+ * (reference: libnd4j include/graph/RandomGenerator.h + loops/cpu/
+ * random.cpp).  Counter addressing means (seed, offset) fully determines a
+ * value — reproducible regardless of threading or call slicing, the same
+ * property jax.random gets from Threefry on device.  This generator feeds
+ * host-side work: shuffles, augmentation draws, init fills in the ETL path.
+ */
+#include "dl4j_native.h"
+
+#include <cmath>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kPhiloxM0 = 0xD2511F53u;
+constexpr uint32_t kPhiloxM1 = 0xCD9E8D57u;
+constexpr uint32_t kPhiloxW0 = 0x9E3779B9u;
+constexpr uint32_t kPhiloxW1 = 0xBB67AE85u;
+
+struct Counter4 {
+  uint32_t v[4];
+};
+
+inline void mulhilo(uint32_t a, uint32_t b, uint32_t *hi, uint32_t *lo) {
+  const uint64_t p = static_cast<uint64_t>(a) * b;
+  *hi = static_cast<uint32_t>(p >> 32);
+  *lo = static_cast<uint32_t>(p);
+}
+
+inline Counter4 philox4x32(uint64_t seed, uint64_t counter) {
+  uint32_t k0 = static_cast<uint32_t>(seed);
+  uint32_t k1 = static_cast<uint32_t>(seed >> 32);
+  Counter4 c = {{static_cast<uint32_t>(counter),
+                 static_cast<uint32_t>(counter >> 32), 0u, 0u}};
+  for (int round = 0; round < 10; ++round) {
+    uint32_t hi0, lo0, hi1, lo1;
+    mulhilo(kPhiloxM0, c.v[0], &hi0, &lo0);
+    mulhilo(kPhiloxM1, c.v[2], &hi1, &lo1);
+    Counter4 next = {{hi1 ^ c.v[1] ^ k0, lo1, hi0 ^ c.v[3] ^ k1, lo0}};
+    c = next;
+    k0 += kPhiloxW0;
+    k1 += kPhiloxW1;
+  }
+  return c;
+}
+
+inline float u32_to_unit_float(uint32_t x) {
+  /* 24 mantissa-ish bits -> [0, 1) */
+  return static_cast<float>(x >> 8) * (1.0f / 16777216.0f);
+}
+
+struct FillCtx {
+  uint64_t seed;
+  uint64_t offset;
+  float *out_f;
+  uint32_t *out_u;
+  int mode;  /* 0 uniform, 1 gaussian, 2 uint32 */
+};
+
+void fill_kernel(int64_t start, int64_t stop, void *arg) {
+  auto *ctx = static_cast<FillCtx *>(arg);
+  if (ctx->mode == 1) {
+    /* Box-Muller over pairs; element i is addressed by block i/2 so any
+     * subrange produces identical values to a full-range call. */
+    for (int64_t i = start; i < stop; ++i) {
+      const uint64_t block = ctx->offset + static_cast<uint64_t>(i >> 1);
+      const Counter4 c = philox4x32(ctx->seed, block);
+      const float u1 = u32_to_unit_float(c.v[0]);
+      const float u2 = u32_to_unit_float(c.v[1]);
+      const float r = std::sqrt(-2.0f * std::log(u1 + 1e-12f));
+      const float ang = 6.28318530717958647692f * u2;
+      ctx->out_f[i] = (i & 1) ? r * std::sin(ang) : r * std::cos(ang);
+    }
+    return;
+  }
+  for (int64_t i = start; i < stop; ++i) {
+    const uint64_t block = ctx->offset + static_cast<uint64_t>(i >> 2);
+    const Counter4 c = philox4x32(ctx->seed, block);
+    const uint32_t word = c.v[i & 3];
+    if (ctx->mode == 0)
+      ctx->out_f[i] = u32_to_unit_float(word);
+    else
+      ctx->out_u[i] = word;
+  }
+}
+
+void fill(uint64_t seed, uint64_t offset, float *out_f, uint32_t *out_u,
+          int64_t n, int mode) {
+  FillCtx ctx{seed, offset, out_f, out_u, mode};
+  dl4j_parallel_for(fill_kernel, &ctx, 0, n, 1 << 14);
+}
+
+}  // namespace
+
+extern "C" {
+
+void dl4j_philox_uniform(uint64_t seed, uint64_t offset, float *out,
+                         int64_t n) {
+  fill(seed, offset, out, nullptr, n, 0);
+}
+
+void dl4j_philox_gaussian(uint64_t seed, uint64_t offset, float *out,
+                          int64_t n) {
+  fill(seed, offset, out, nullptr, n, 1);
+}
+
+void dl4j_philox_uint32(uint64_t seed, uint64_t offset, uint32_t *out,
+                        int64_t n) {
+  fill(seed, offset, nullptr, out, n, 2);
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+/* Persistent thread pool + parallel_for.
+ *
+ * TPU-native analogue of the reference's custom CPU threading layer
+ * (reference: libnd4j include/execution/Threads.h, include/execution/
+ * ThreadPool.h — samediff::Threads::parallel_for).  Kernels here are the
+ * host-side ones (compression, CSV, RNG fills); device math belongs to XLA.
+ */
+#include "dl4j_native.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+class ThreadPool {
+ public:
+  static ThreadPool &instance() {
+    static ThreadPool pool;
+    return pool;
+  }
+
+  int32_t size() const { return size_; }
+
+  void resize(int32_t n) {
+    std::lock_guard<std::mutex> outer(resize_mu_);
+    shutdown();
+    start(n);
+  }
+
+  /* Run fn over [start, stop) split into roughly equal chunks. */
+  void parallel_for(dl4j_kernel_fn fn, void *arg, int64_t start, int64_t stop,
+                    int64_t min_chunk) {
+    const int64_t span = stop - start;
+    if (span <= 0) return;
+    if (min_chunk < 1) min_chunk = 1;
+    int64_t chunks = std::min<int64_t>(size_, (span + min_chunk - 1) / min_chunk);
+    if (chunks <= 1 || size_ <= 1) {
+      fn(start, stop, arg);
+      return;
+    }
+    std::atomic<int64_t> done{0};
+    std::mutex mu;
+    std::condition_variable cv;
+    const int64_t base = span / chunks, rem = span % chunks;
+    int64_t lo = start;
+    for (int64_t c = 0; c < chunks; ++c) {
+      const int64_t hi = lo + base + (c < rem ? 1 : 0);
+      submit([fn, arg, lo, hi, &done, &mu, &cv, chunks] {
+        fn(lo, hi, arg);
+        if (done.fetch_add(1) + 1 == chunks) {
+          std::lock_guard<std::mutex> lk(mu);
+          cv.notify_one();
+        }
+      });
+      lo = hi;
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done.load() == chunks; });
+  }
+
+ private:
+  ThreadPool() {
+    unsigned hw = std::thread::hardware_concurrency();
+    start(hw ? static_cast<int32_t>(hw) : 1);
+  }
+  ~ThreadPool() { shutdown(); }
+
+  void start(int32_t n) {
+    if (n < 1) n = 1;
+    size_ = n;
+    stop_ = false;
+    for (int32_t i = 1; i < n; ++i)  /* worker 0 is the caller */
+      workers_.emplace_back([this] { loop(); });
+  }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> lk(queue_mu_);
+      stop_ = true;
+    }
+    queue_cv_.notify_all();
+    for (auto &t : workers_) t.join();
+    workers_.clear();
+    queue_.clear();
+  }
+
+  void submit(std::function<void()> task) {
+    if (workers_.empty()) {  /* single-threaded pool: run inline */
+      task();
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(queue_mu_);
+      queue_.push_back(std::move(task));
+    }
+    queue_cv_.notify_one();
+  }
+
+  void loop() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(queue_mu_);
+        queue_cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        task = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      task();
+    }
+  }
+
+  std::mutex resize_mu_;
+  std::mutex queue_mu_;
+  std::condition_variable queue_cv_;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> workers_;
+  int32_t size_ = 1;
+  bool stop_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t dl4j_abi_version(void) { return DL4J_NATIVE_ABI_VERSION; }
+
+int32_t dl4j_num_threads(void) { return ThreadPool::instance().size(); }
+
+void dl4j_set_num_threads(int32_t n) { ThreadPool::instance().resize(n); }
+
+void dl4j_parallel_for(dl4j_kernel_fn fn, void *arg, int64_t start,
+                       int64_t stop, int64_t min_chunk) {
+  ThreadPool::instance().parallel_for(fn, arg, start, stop, min_chunk);
+}
+
+}  // extern "C"

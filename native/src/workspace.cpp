@@ -1,0 +1,93 @@
+/* Workspace arena allocator.
+ *
+ * TPU-native analogue of the reference's scoped bump allocators
+ * (reference: libnd4j include/memory/Workspace.h mirrored by the Java
+ * MemoryWorkspace/Nd4jWorkspace API).  Device buffers are XLA-managed on
+ * TPU, so this arena serves the HOST side: staging buffers for ETL,
+ * compression messages, and pinned scratch — with the reference's LEARNING
+ * policy (track spills, grow on reset) so steady-state cycles allocate
+ * nothing.
+ */
+#include "dl4j_native.h"
+
+#include <cstdlib>
+#include <vector>
+
+struct dl4j_workspace {
+  char *base = nullptr;
+  int64_t capacity = 0;
+  int64_t used = 0;           /* bump pointer */
+  int64_t spilled = 0;        /* bytes served by malloc this cycle */
+  std::vector<void *> spills; /* malloc'd blocks freed on reset */
+};
+
+namespace {
+constexpr int64_t kAlign = 64;
+inline int64_t align_up(int64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+}  // namespace
+
+extern "C" {
+
+dl4j_workspace *dl4j_workspace_create(int64_t initial_bytes) {
+  auto *ws = new dl4j_workspace();
+  if (initial_bytes > 0) {
+    ws->base = static_cast<char *>(std::aligned_alloc(
+        kAlign, static_cast<size_t>(align_up(initial_bytes))));
+    ws->capacity = ws->base ? align_up(initial_bytes) : 0;
+  }
+  return ws;
+}
+
+void *dl4j_workspace_alloc(dl4j_workspace *ws, int64_t nbytes) {
+  if (!ws || nbytes <= 0) return nullptr;
+  const int64_t need = align_up(nbytes);
+  if (ws->base && ws->used + need <= ws->capacity) {
+    void *p = ws->base + ws->used;
+    ws->used += need;
+    return p;
+  }
+  /* Spill path (reference: SPILL allocation policy). */
+  void *p = std::aligned_alloc(kAlign, static_cast<size_t>(need));
+  if (!p) return nullptr;
+  ws->spills.push_back(p);
+  ws->spilled += need;
+  return p;
+}
+
+void dl4j_workspace_reset(dl4j_workspace *ws) {
+  if (!ws) return;
+  for (void *p : ws->spills) std::free(p);
+  ws->spills.clear();
+  if (ws->spilled > 0) {
+    /* LEARNING policy: grow so the next cycle fits entirely in the arena. */
+    const int64_t target = align_up(ws->capacity + ws->spilled);
+    char *grown =
+        static_cast<char *>(std::aligned_alloc(kAlign, static_cast<size_t>(target)));
+    if (grown) {
+      std::free(ws->base);
+      ws->base = grown;
+      ws->capacity = target;
+    }
+  }
+  ws->used = 0;
+  ws->spilled = 0;
+}
+
+void dl4j_workspace_destroy(dl4j_workspace *ws) {
+  if (!ws) return;
+  for (void *p : ws->spills) std::free(p);
+  std::free(ws->base);
+  delete ws;
+}
+
+int64_t dl4j_workspace_capacity(const dl4j_workspace *ws) {
+  return ws ? ws->capacity : 0;
+}
+int64_t dl4j_workspace_used(const dl4j_workspace *ws) {
+  return ws ? ws->used : 0;
+}
+int64_t dl4j_workspace_spilled(const dl4j_workspace *ws) {
+  return ws ? ws->spilled : 0;
+}
+
+}  // extern "C"

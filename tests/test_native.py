@@ -1,0 +1,121 @@
+"""C++ native runtime tests (reference test analogue: libnd4j
+tests_cpu/layers_tests — NDArrayTest/RNGTests plus the threshold-encoding
+coverage in DeclarableOpsTests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+
+def test_backend_reports():
+    assert native.backend() in ("native", "numpy")
+
+
+def test_native_library_builds():
+    # The toolchain is present in CI images; the numpy fallback is for
+    # user machines without g++.
+    assert native.available(), "native library failed to build/load"
+
+
+def test_parallel_for_covers_range():
+    seen = []
+    native.parallel_for(lambda lo, hi: seen.append((lo, hi)), 0, 1000,
+                        min_chunk=64)
+    covered = sorted(seen)
+    assert covered[0][0] == 0 and covered[-1][1] == 1000
+    # chunks tile the range exactly
+    for (a, b), (c, d) in zip(covered, covered[1:]):
+        assert b == c
+
+
+def test_threshold_encode_residual_roundtrip():
+    rng = np.random.RandomState(7)
+    grad = (rng.randn(512) * 0.01).astype(np.float32)
+    orig = grad.copy()
+    tau = 0.015
+    msg = native.threshold_encode(grad, tau)
+
+    # every index encoded once, ascending, 1-based signed
+    pos = np.abs(msg) - 1
+    assert np.all(np.diff(pos) > 0)
+    expect = np.nonzero(np.abs(orig) >= tau)[0]
+    np.testing.assert_array_equal(pos, expect)
+
+    # residual semantics: decode(msg) + residual == original
+    target = np.zeros_like(orig)
+    native.threshold_decode(msg, tau, target)
+    np.testing.assert_allclose(target + grad, orig, rtol=1e-6, atol=1e-7)
+
+
+def test_threshold_decode_accumulates():
+    target = np.zeros(8, dtype=np.float32)
+    msg = np.array([1, -3, 1], dtype=np.int32)  # index 0 twice
+    native.threshold_decode(msg, 0.5, target)
+    np.testing.assert_allclose(target[:3], [1.0, 0.0, -0.5])
+
+
+def test_bitmap_roundtrip():
+    rng = np.random.RandomState(3)
+    grad = (rng.randn(100) * 0.02).astype(np.float32)
+    orig = grad.copy()
+    tau = 0.02
+    words, count = native.bitmap_encode(grad, tau)
+    assert count == int(np.count_nonzero(np.abs(orig) >= tau))
+    target = np.zeros_like(orig)
+    native.bitmap_decode(words, orig.size, tau, target)
+    np.testing.assert_allclose(target + grad, orig, rtol=1e-6, atol=1e-7)
+
+
+def test_philox_counter_addressing():
+    a = native.philox_uniform(42, 0, 64)
+    b = native.philox_uniform(42, 0, 64)
+    np.testing.assert_array_equal(a, b)  # same (seed, offset) -> same stream
+    c = native.philox_uniform(43, 0, 64)
+    assert not np.array_equal(a, c)
+    assert np.all(a >= 0.0) and np.all(a < 1.0)
+
+
+def test_philox_gaussian_moments():
+    x = native.philox_gaussian(1, 0, 200_000)
+    assert abs(float(x.mean())) < 0.02
+    assert abs(float(x.std()) - 1.0) < 0.02
+
+
+def test_workspace_learning_policy():
+    with native.Workspace(initial_bytes=256) as ws:
+        ws.alloc_f32(64)        # 256 bytes: fits exactly
+        ws.alloc_f32(64)        # spills
+        assert ws.spilled > 0
+        ws.reset()              # LEARNING: grows to fit both
+        assert ws.capacity >= 512
+        ws.alloc_f32(64)
+        ws.alloc_f32(64)
+        assert ws.spilled == 0
+
+
+def test_workspace_alloc_usable():
+    if not native.available():
+        pytest.skip("arena views need the native lib")
+    with native.Workspace(1 << 12) as ws:
+        a = ws.alloc_f32(16)
+        a[:] = np.arange(16, dtype=np.float32)
+        b = ws.alloc_f32(16)
+        b[:] = 1.0
+        np.testing.assert_array_equal(a, np.arange(16, dtype=np.float32))
+
+
+def test_csv_parse_basic():
+    text = "h1,h2,h3\n1,2,3\n4.5,-6,7e-2\n"
+    m = native.csv_parse(text, skip_rows=1)
+    np.testing.assert_allclose(
+        m, np.array([[1, 2, 3], [4.5, -6, 0.07]], dtype=np.float32))
+
+
+def test_csv_parse_ragged_raises():
+    with pytest.raises(ValueError):
+        native.csv_parse("1,2,3\n4,5\n")
+
+
+def test_csv_parse_empty():
+    m = native.csv_parse("", skip_rows=0)
+    assert m.size == 0
