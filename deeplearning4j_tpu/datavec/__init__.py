@@ -1,0 +1,28 @@
+"""DataVec — the ETL layer (reference L3, SURVEY.md §2.4).
+
+RecordReaders + Writables + Schema/TransformProcess + image pipeline +
+iterator glue, rebuilt host-side with the C++ CSV fast path
+(:mod:`deeplearning4j_tpu.native`) feeding the jitted device step.
+"""
+from deeplearning4j_tpu.datavec.writable import (  # noqa: F401
+    BooleanWritable, DoubleWritable, FloatWritable, IntWritable, LongWritable,
+    NDArrayWritable, Text, Writable, writable)
+from deeplearning4j_tpu.datavec.records import (  # noqa: F401
+    CollectionRecordReader, CollectionSequenceRecordReader, CSVRecordReader,
+    CSVSequenceRecordReader, FileSplit, InputSplit, LineRecordReader,
+    NumberedFileInputSplit, RecordReader, RegexLineRecordReader,
+    SequenceRecordReader, StringSplit, SVMLightRecordReader)
+from deeplearning4j_tpu.datavec.schema import (  # noqa: F401
+    ColumnMetaData, ColumnType, Schema)
+from deeplearning4j_tpu.datavec.transform import (  # noqa: F401
+    CategoricalColumnCondition, ColumnCondition, ConditionFilter, ConditionOp,
+    DoubleColumnCondition, IntegerColumnCondition, LocalTransformExecutor,
+    StringColumnCondition, TransformProcess)
+from deeplearning4j_tpu.datavec.image import (  # noqa: F401
+    ColorConversionTransform, CropImageTransform, FlipImageTransform,
+    ImageRecordReader, ImageTransform, NativeImageLoader,
+    ParentPathLabelGenerator, PipelineImageTransform, RotateImageTransform,
+    ScaleImageTransform)
+from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401
+    AsyncDataSetIterator, RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator)

@@ -1,0 +1,207 @@
+"""RecordReader → DataSet iterator glue + async device prefetch.
+
+Reference: deeplearning4j-datavec-iterators
+``RecordReaderDataSetIterator`` / ``SequenceRecordReaderDataSetIterator``
+and deeplearning4j-utility-iterators ``AsyncDataSetIterator`` (the prefetch
+thread every ``fit`` wraps around its iterator — SURVEY.md §3.1).
+
+TPU-native stance: prefetch overlaps HOST record assembly with the device
+step; batches are plain NumPy (the jitted train step transfers them), and
+sequence batches pad to the longest sequence with masks — the same
+(features, labels, featuresMask, labelsMask) quadruple the reference emits.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.datavec.records import (RecordReader,
+                                                SequenceRecordReader)
+from deeplearning4j_tpu.datavec.writable import NDArrayWritable
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Batch records into (features, labels) DataSets.
+
+    ``labelIndex`` marks the label column; with ``numPossibleLabels`` the
+    label one-hot-encodes (classification); ``regression=True`` keeps raw
+    values.  NDArrayWritable feature columns (e.g. from ImageRecordReader)
+    are used as-is.
+    """
+
+    def __init__(self, recordReader: RecordReader, batchSize: int,
+                 labelIndex: Optional[int] = None,
+                 numPossibleLabels: int = -1, regression: bool = False,
+                 labelIndexTo: Optional[int] = None):
+        self.reader = recordReader
+        self.batchSize = batchSize
+        self.labelIndex = labelIndex
+        self.numPossibleLabels = numPossibleLabels
+        self.regression = regression
+        self.labelIndexTo = labelIndexTo
+
+    def hasNext(self) -> bool:
+        return self.reader.hasNext()
+
+    def _split_record(self, rec):
+        if self.labelIndex is None:
+            feats = [w for w in rec]
+            return feats, None
+        hi = self.labelIndexTo if self.labelIndexTo is not None \
+            else self.labelIndex
+        feats = rec[:self.labelIndex] + rec[hi + 1:]
+        label = rec[self.labelIndex:hi + 1]
+        return feats, label
+
+    def _feat_array(self, feats) -> np.ndarray:
+        if len(feats) == 1 and isinstance(feats[0], NDArrayWritable):
+            return feats[0].value.astype(np.float32)
+        return np.array([w.toDouble() for w in feats], dtype=np.float32)
+
+    def next(self, num: int = 0) -> DataSet:
+        n = num or self.batchSize
+        fs, ls = [], []
+        while self.reader.hasNext() and len(fs) < n:
+            feats, label = self._split_record(self.reader.next())
+            fs.append(self._feat_array(feats))
+            if label is not None:
+                if self.regression:
+                    ls.append([w.toDouble() for w in label])
+                else:
+                    k = int(label[0].toDouble())
+                    onehot = np.zeros(self.numPossibleLabels,
+                                      dtype=np.float32)
+                    onehot[k] = 1.0
+                    ls.append(onehot)
+        f = np.stack(fs)
+        l = np.asarray(ls, dtype=np.float32) if ls else None
+        return self._applyPre(DataSet(f, l))
+
+    def reset(self) -> None:
+        self.reader.reset()
+
+    def batch(self) -> int:
+        return self.batchSize
+
+    def totalOutcomes(self) -> int:
+        return self.numPossibleLabels
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Sequences → (b, c, t) batches padded to the longest, with masks.
+
+    Reference: SequenceRecordReaderDataSetIterator single-reader mode
+    (features+label per time step) — layout matches the RNN layers' NCW.
+    """
+
+    def __init__(self, reader: SequenceRecordReader, batchSize: int,
+                 numPossibleLabels: int, labelIndex: int,
+                 regression: bool = False):
+        self.reader = reader
+        self.batchSize = batchSize
+        self.numPossibleLabels = numPossibleLabels
+        self.labelIndex = labelIndex
+        self.regression = regression
+
+    def hasNext(self) -> bool:
+        return self.reader.hasNext()
+
+    def next(self, num: int = 0) -> DataSet:
+        n = num or self.batchSize
+        seqs = []
+        while self.reader.hasNext() and len(seqs) < n:
+            seqs.append(self.reader.nextSequence())
+        tmax = max(len(s) for s in seqs)
+        nin = len(seqs[0][0]) - 1
+        nout = 1 if self.regression else self.numPossibleLabels
+        b = len(seqs)
+        f = np.zeros((b, nin, tmax), dtype=np.float32)
+        l = np.zeros((b, nout, tmax), dtype=np.float32)
+        fm = np.zeros((b, tmax), dtype=np.float32)
+        for bi, seq in enumerate(seqs):
+            for t, step in enumerate(seq):
+                vals = [w.toDouble() for w in step]
+                lab = vals.pop(self.labelIndex)
+                f[bi, :, t] = vals
+                if self.regression:
+                    l[bi, 0, t] = lab
+                else:
+                    l[bi, int(lab), t] = 1.0
+                fm[bi, t] = 1.0
+        return self._applyPre(DataSet(f, l, fm, fm.copy()))
+
+    def reset(self) -> None:
+        self.reader.reset()
+
+    def batch(self) -> int:
+        return self.batchSize
+
+    def totalOutcomes(self) -> int:
+        return self.numPossibleLabels
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper.
+
+    Reference: AsyncDataSetIterator.java — a bounded queue between a
+    producer thread draining the wrapped iterator and the training loop, so
+    host ETL overlaps the device step.
+    """
+
+    _END = object()
+
+    def __init__(self, wrapped: DataSetIterator, queueSize: int = 4):
+        self.wrapped = wrapped
+        self.queueSize = queueSize
+        self._q: queue.Queue = queue.Queue(maxsize=queueSize)
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None
+        self._start()
+
+    def _start(self) -> None:
+        self._q = queue.Queue(maxsize=self.queueSize)
+        self._peek = None
+
+        def produce():
+            try:
+                while self.wrapped.hasNext():
+                    self._q.put(self.wrapped.next())
+            finally:
+                self._q.put(self._END)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+
+    def hasNext(self) -> bool:
+        if self._peek is None:
+            self._peek = self._q.get()
+        return self._peek is not self._END
+
+    def next(self, num: int = 0) -> DataSet:
+        if not self.hasNext():
+            raise StopIteration
+        ds = self._peek
+        self._peek = None
+        return ds
+
+    def reset(self) -> None:
+        # drain current producer, reset source, restart
+        while self._peek is not self._END:
+            self._peek = self._q.get()
+        self._thread.join()
+        self.wrapped.reset()
+        self._start()
+
+    def batch(self) -> int:
+        return self.wrapped.batch()
+
+    def totalOutcomes(self) -> int:
+        return self.wrapped.totalOutcomes()
+
+    def inputColumns(self) -> int:
+        return self.wrapped.inputColumns()

@@ -1,0 +1,457 @@
+"""TransformProcess — declarative column transform pipeline + executor.
+
+Reference: datavec-api ``org/datavec/api/transform/TransformProcess.java``
+(Builder: removeColumns, filter, categoricalToInteger/OneHot,
+doubleMathOp/integerMathOp, renameColumn, conditionalReplace, stringMap, …),
+``transform/condition/**`` (ConditionOp, ColumnCondition, ConditionFilter)
+and datavec-local ``LocalTransformExecutor``.
+
+Each step maps (schema, records) → (schema, records); the built process
+carries the evolved output schema (``getFinalSchema``), exactly the
+reference's contract.  Executors: :class:`LocalTransformExecutor` (rows of
+Writables) — the TPU build's Spark analogue is simply "run it on the host;
+the device never sees raw records".
+"""
+from __future__ import annotations
+
+import json
+import math
+import operator
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.schema import (ColumnMetaData, ColumnType,
+                                               Schema)
+from deeplearning4j_tpu.datavec.writable import (DoubleWritable, IntWritable,
+                                                 Text, Writable, writable)
+
+Record = List[Writable]
+
+
+# ----------------------------------------------------------- conditions ----
+
+class ConditionOp:
+    """Reference: transform/condition/ConditionOp.java."""
+    Equal = "Equal"
+    NotEqual = "NotEqual"
+    LessThan = "LessThan"
+    LessOrEqual = "LessOrEqual"
+    GreaterThan = "GreaterThan"
+    GreaterOrEqual = "GreaterOrEqual"
+    InSet = "InSet"
+    NotInSet = "NotInSet"
+
+    _OPS = {
+        "Equal": operator.eq, "NotEqual": operator.ne,
+        "LessThan": operator.lt, "LessOrEqual": operator.le,
+        "GreaterThan": operator.gt, "GreaterOrEqual": operator.ge,
+    }
+
+
+class ColumnCondition:
+    """Reference: condition/column/*ColumnCondition.java — typed compare on
+    one column."""
+
+    def __init__(self, column: str, op: str, value):
+        self.column = column
+        self.op = op
+        self.value = value
+
+    def test(self, schema: Schema, record: Record) -> bool:
+        w = record[schema.getIndexOfColumn(self.column)]
+        ctype = schema.getType(self.column)
+        if ctype in (ColumnType.String, ColumnType.Categorical):
+            v = w.toString() if isinstance(w, Text) else str(w.value)
+        else:
+            v = w.toDouble()
+        if self.op == ConditionOp.InSet:
+            return v in self.value
+        if self.op == ConditionOp.NotInSet:
+            return v not in self.value
+        return ConditionOp._OPS[self.op](v, self.value)
+
+
+# Convenience constructors mirroring the reference class names.
+def IntegerColumnCondition(column, op, value):
+    return ColumnCondition(column, op, value)
+
+
+DoubleColumnCondition = IntegerColumnCondition
+CategoricalColumnCondition = IntegerColumnCondition
+StringColumnCondition = IntegerColumnCondition
+
+
+class ConditionFilter:
+    """Reference: transform/filter/ConditionFilter.java — REMOVES records
+    matching the condition."""
+
+    def __init__(self, condition: ColumnCondition):
+        self.condition = condition
+
+    def removeExample(self, schema: Schema, record: Record) -> bool:
+        return self.condition.test(schema, record)
+
+
+# ---------------------------------------------------------------- steps ----
+
+class _Step:
+    """One pipeline stage: schema evolution + record mapping."""
+
+    def out_schema(self, schema: Schema) -> Schema:
+        return schema
+
+    def apply(self, schema: Schema, records: List[Record]) -> List[Record]:
+        return records
+
+    def describe(self) -> dict:
+        return {"op": type(self).__name__}
+
+
+class _RemoveColumns(_Step):
+    def __init__(self, names, keep=False):
+        self.names = set(names)
+        self.keep = keep
+
+    def _keep_idx(self, schema):
+        return [i for i, c in enumerate(schema.columns)
+                if (c.name in self.names) == self.keep]
+
+    def out_schema(self, schema):
+        return Schema([schema.columns[i] for i in self._keep_idx(schema)])
+
+    def apply(self, schema, records):
+        idx = self._keep_idx(schema)
+        return [[r[i] for i in idx] for r in records]
+
+
+class _Filter(_Step):
+    def __init__(self, f: ConditionFilter):
+        self.f = f
+
+    def apply(self, schema, records):
+        return [r for r in records
+                if not self.f.removeExample(schema, r)]
+
+
+class _CategoricalToInteger(_Step):
+    def __init__(self, names):
+        self.names = names
+
+    def out_schema(self, schema):
+        cols = []
+        for c in schema.columns:
+            if c.name in self.names:
+                cols.append(ColumnMetaData(c.name, ColumnType.Integer))
+            else:
+                cols.append(c)
+        return Schema(cols)
+
+    def apply(self, schema, records):
+        out = []
+        maps = {n: {s: i for i, s in
+                    enumerate(schema.getMetaData(n).stateNames or [])}
+                for n in self.names}
+        idxs = {schema.getIndexOfColumn(n): n for n in self.names}
+        for r in records:
+            row = list(r)
+            for i, n in idxs.items():
+                key = row[i].toString() if isinstance(row[i], Text) \
+                    else str(row[i].value)
+                row[i] = IntWritable(maps[n][key])
+            out.append(row)
+        return out
+
+
+class _CategoricalToOneHot(_Step):
+    def __init__(self, name):
+        self.name = name
+
+    def out_schema(self, schema):
+        cols = []
+        for c in schema.columns:
+            if c.name == self.name:
+                for s in (c.stateNames or []):
+                    cols.append(ColumnMetaData(f"{c.name}[{s}]",
+                                               ColumnType.Integer))
+            else:
+                cols.append(c)
+        return Schema(cols)
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        states = schema.getMetaData(self.name).stateNames or []
+        out = []
+        for r in records:
+            key = r[i].toString() if isinstance(r[i], Text) else str(r[i].value)
+            onehot = [IntWritable(1 if s == key else 0) for s in states]
+            out.append(list(r[:i]) + onehot + list(r[i + 1:]))
+        return out
+
+
+class _IntegerToCategorical(_Step):
+    def __init__(self, name, states):
+        self.name = name
+        self.states = list(states)
+
+    def out_schema(self, schema):
+        cols = [ColumnMetaData(c.name, ColumnType.Categorical, self.states)
+                if c.name == self.name else c for c in schema.columns]
+        return Schema(cols)
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        out = []
+        for r in records:
+            row = list(r)
+            row[i] = Text(self.states[row[i].toInt()])
+            out.append(row)
+        return out
+
+
+class _StringToCategorical(_IntegerToCategorical):
+    def apply(self, schema, records):
+        return records  # values already strings; only the type changes
+
+
+_MATH = {
+    "Add": operator.add, "Subtract": operator.sub, "Multiply": operator.mul,
+    "Divide": operator.truediv, "Modulus": operator.mod,
+    "ReverseSubtract": lambda a, b: b - a,
+    "ReverseDivide": lambda a, b: b / a,
+    "ScalarMin": min, "ScalarMax": max,
+}
+
+_MATH_FN = {
+    "ABS": abs, "CEIL": math.ceil, "FLOOR": math.floor, "EXP": math.exp,
+    "LOG": math.log, "LOG10": math.log10, "SQRT": math.sqrt,
+    "SIN": math.sin, "COS": math.cos, "TAN": math.tan, "SIGN": lambda v:
+        (v > 0) - (v < 0), "NEGATE": operator.neg,
+}
+
+
+class _MathOp(_Step):
+    """doubleMathOp / integerMathOp (reference: MathOp enum transforms)."""
+
+    def __init__(self, name, op, scalar, integer=False):
+        self.name, self.op, self.scalar, self.integer = name, op, scalar, \
+            integer
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        fn = _MATH[self.op]
+        mk = IntWritable if self.integer else DoubleWritable
+        out = []
+        for r in records:
+            row = list(r)
+            v = row[i].toInt() if self.integer else row[i].toDouble()
+            row[i] = mk(fn(v, self.scalar))
+            out.append(row)
+        return out
+
+
+class _MathFunction(_Step):
+    """doubleMathFunction (reference: MathFunction enum)."""
+
+    def __init__(self, name, fn):
+        self.name, self.fn = name, fn
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        f = _MATH_FN[self.fn]
+        out = []
+        for r in records:
+            row = list(r)
+            row[i] = DoubleWritable(f(row[i].toDouble()))
+            out.append(row)
+        return out
+
+
+class _Rename(_Step):
+    def __init__(self, old, new):
+        self.old, self.new = old, new
+
+    def out_schema(self, schema):
+        cols = [ColumnMetaData(self.new, c.columnType, c.stateNames)
+                if c.name == self.old else c for c in schema.columns]
+        return Schema(cols)
+
+
+class _Reorder(_Step):
+    def __init__(self, names):
+        self.names = list(names)
+
+    def _order(self, schema):
+        rest = [c.name for c in schema.columns if c.name not in self.names]
+        return [schema.getIndexOfColumn(n) for n in self.names + rest]
+
+    def out_schema(self, schema):
+        return Schema([schema.columns[i] for i in self._order(schema)])
+
+    def apply(self, schema, records):
+        order = self._order(schema)
+        return [[r[i] for i in order] for r in records]
+
+
+class _Duplicate(_Step):
+    def __init__(self, name, newName):
+        self.name, self.newName = name, newName
+
+    def out_schema(self, schema):
+        c = schema.getMetaData(self.name)
+        return Schema(list(schema.columns) +
+                      [ColumnMetaData(self.newName, c.columnType,
+                                      c.stateNames)])
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        return [list(r) + [r[i]] for r in records]
+
+
+class _ConditionalReplace(_Step):
+    """Reference: ConditionalReplaceValueTransform."""
+
+    def __init__(self, name, newValue, condition):
+        self.name, self.newValue, self.condition = name, newValue, condition
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        out = []
+        for r in records:
+            row = list(r)
+            if self.condition.test(schema, r):
+                row[i] = writable(self.newValue)
+            out.append(row)
+        return out
+
+
+class _StringMap(_Step):
+    """Reference: StringMapTransform — dictionary replace."""
+
+    def __init__(self, name, mapping):
+        self.name, self.mapping = name, dict(mapping)
+
+    def apply(self, schema, records):
+        i = schema.getIndexOfColumn(self.name)
+        out = []
+        for r in records:
+            row = list(r)
+            s = row[i].toString()
+            row[i] = Text(self.mapping.get(s, s))
+            out.append(row)
+        return out
+
+
+class _Lambda(_Step):
+    """Escape hatch: arbitrary (schema, records)->records callable."""
+
+    def __init__(self, fn: Callable[[Schema, List[Record]], List[Record]],
+                 schema_fn: Optional[Callable[[Schema], Schema]] = None):
+        self.fn = fn
+        self.schema_fn = schema_fn
+
+    def out_schema(self, schema):
+        return self.schema_fn(schema) if self.schema_fn else schema
+
+    def apply(self, schema, records):
+        return self.fn(schema, records)
+
+
+# -------------------------------------------------------------- process ----
+
+class TransformProcess:
+    def __init__(self, initialSchema: Schema, steps: Sequence[_Step]):
+        self.initialSchema = initialSchema
+        self.steps = list(steps)
+
+    def getFinalSchema(self) -> Schema:
+        s = self.initialSchema
+        for st in self.steps:
+            s = st.out_schema(s)
+        return s
+
+    def execute(self, records: List[Record]) -> List[Record]:
+        s = self.initialSchema
+        for st in self.steps:
+            records = st.apply(s, records)
+            s = st.out_schema(s)
+        return records
+
+    def toJson(self) -> str:
+        return json.dumps({
+            "initialSchema": json.loads(self.initialSchema.toJson()),
+            "steps": [st.describe() for st in self.steps]}, indent=2)
+
+    class Builder:
+        def __init__(self, initialSchema: Schema):
+            self._schema0 = initialSchema
+            self._schema = initialSchema  # evolves as steps are added
+            self._steps: List[_Step] = []
+
+        def _add(self, step: _Step) -> "TransformProcess.Builder":
+            self._steps.append(step)
+            self._schema = step.out_schema(self._schema)
+            return self
+
+        def removeColumns(self, *names):
+            return self._add(_RemoveColumns(names))
+
+        def removeAllColumnsExceptFor(self, *names):
+            return self._add(_RemoveColumns(names, keep=True))
+
+        def filter(self, f) -> "TransformProcess.Builder":
+            if isinstance(f, ColumnCondition):
+                f = ConditionFilter(f)
+            return self._add(_Filter(f))
+
+        def categoricalToInteger(self, *names):
+            return self._add(_CategoricalToInteger(names))
+
+        def categoricalToOneHot(self, name):
+            return self._add(_CategoricalToOneHot(name))
+
+        def integerToCategorical(self, name, states):
+            return self._add(_IntegerToCategorical(name, states))
+
+        def stringToCategorical(self, name, states):
+            return self._add(_StringToCategorical(name, states))
+
+        def doubleMathOp(self, name, op, scalar):
+            return self._add(_MathOp(name, op, scalar))
+
+        def integerMathOp(self, name, op, scalar):
+            return self._add(_MathOp(name, op, scalar, integer=True))
+
+        def doubleMathFunction(self, name, fn):
+            return self._add(_MathFunction(name, fn))
+
+        def renameColumn(self, old, new):
+            return self._add(_Rename(old, new))
+
+        def reorderColumns(self, *names):
+            return self._add(_Reorder(names))
+
+        def duplicateColumn(self, name, newName):
+            return self._add(_Duplicate(name, newName))
+
+        def conditionalReplaceValueTransform(self, name, newValue, condition):
+            return self._add(_ConditionalReplace(name, newValue, condition))
+
+        def stringMapTransform(self, name, mapping):
+            return self._add(_StringMap(name, mapping))
+
+        def transform(self, fn, schema_fn=None):
+            return self._add(_Lambda(fn, schema_fn))
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema0, self._steps)
+
+    @staticmethod
+    def builder(initialSchema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(initialSchema)
+
+
+class LocalTransformExecutor:
+    """Reference: datavec-local ``LocalTransformExecutor.execute``."""
+
+    @staticmethod
+    def execute(records: List[Record], tp: TransformProcess) -> List[Record]:
+        return tp.execute([[writable(v) for v in r] for r in records])

@@ -152,6 +152,17 @@ class MultiLayerNetwork:
         slow on a remote-compile TPU path); a single traced function compiles
         once and materializes everything device-side.
         """
+        # Fail like the reference's config validation, not with a cryptic
+        # shape error deep in the first matmul: every parameterized layer
+        # must know nIn by now (explicitly or via setInputType inference).
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "nOut", None) and \
+                    not getattr(layer, "nIn", True):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}): nIn not set and "
+                    "not inferrable — set .nIn(...) on the layer or "
+                    ".setInputType(...) on the configuration")
+
         def build_ps(root):
             p_tree: Params = {}
             s_tree: Dict[str, Dict[str, jax.Array]] = {}

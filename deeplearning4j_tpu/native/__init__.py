@@ -66,6 +66,17 @@ def _compile() -> Optional[Path]:
         return None
 
 
+def _stale(so_path: Path) -> bool:
+    """True when any C++ source/header is newer than the built library."""
+    try:
+        built = so_path.stat().st_mtime
+        srcs = list((_NATIVE_DIR / "src").glob("*.cpp")) + \
+            list((_NATIVE_DIR / "include").glob("*.h"))
+        return any(s.stat().st_mtime > built for s in srcs)
+    except OSError:
+        return True
+
+
 def _declare(lib: ctypes.CDLL) -> None:
     i32, i64, u32, u64 = (ctypes.c_int32, ctypes.c_int64, ctypes.c_uint32,
                           ctypes.c_uint64)
@@ -119,7 +130,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
             return None
         path = _BUILD_DIR / _LIB_NAME
-        if not path.exists():
+        if not path.exists() or _stale(path):
             built = _compile()
             if built is None:
                 return None
@@ -178,14 +189,27 @@ def _f32ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+def _require_f32_inplace(grad: np.ndarray, fn: str) -> np.ndarray:
+    """In-place residual semantics only work on the caller's own buffer —
+    a silent ascontiguousarray copy would mutate the copy and re-send the
+    same gradient mass every step."""
+    if not (isinstance(grad, np.ndarray) and grad.dtype == np.float32
+            and grad.flags.c_contiguous):
+        raise TypeError(f"{fn} mutates its input in place and requires a "
+                        "C-contiguous float32 ndarray; got "
+                        f"{type(grad).__name__}"
+                        f"{'/' + str(grad.dtype) if isinstance(grad, np.ndarray) else ''}")
+    return grad
+
+
 def threshold_encode(grad: np.ndarray, threshold: float) -> np.ndarray:
     """Sparse-encode ``grad`` in place (residual semantics).
 
     Returns int32 signed indices: ``index+1`` carrying the update sign.
-    ``grad`` must be a contiguous float32 vector; encoded mass is subtracted
-    from it so the caller keeps the residual.
+    ``grad`` must be a C-contiguous float32 vector (enforced); encoded mass
+    is subtracted from it so the caller keeps the residual.
     """
-    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    grad = _require_f32_inplace(grad, "threshold_encode")
     lib = _load()
     if lib is None:
         mask = np.abs(grad) >= threshold
@@ -220,8 +244,9 @@ def threshold_decode(idx: np.ndarray, threshold: float,
 
 
 def bitmap_encode(grad: np.ndarray, threshold: float) -> Tuple[np.ndarray, int]:
-    """Dense 2-bit encode of ``grad`` in place; returns (bitmap words, count)."""
-    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    """Dense 2-bit encode of ``grad`` in place; returns (bitmap words, count).
+    ``grad`` must be a C-contiguous float32 vector (enforced)."""
+    grad = _require_f32_inplace(grad, "bitmap_encode")
     words = np.zeros((grad.size + 15) // 16, dtype=np.uint32)
     lib = _load()
     if lib is None:
@@ -378,8 +403,9 @@ def csv_parse(text: bytes | str, delim: str = ",",
     if nrows <= 0:
         return np.zeros((0, 0), dtype=np.float32)
     # One probe pass sizes the buffer: columns from the first data line
-    # (same non-empty-line indexing as the C side).
-    nonempty = [ln for ln in text.split(b"\n") if ln.strip()]
+    # (same non-empty-line indexing as the C side, which trims ' ' and '\r'
+    # only — stripping other whitespace here would desynchronise the two).
+    nonempty = [ln for ln in text.split(b"\n") if ln.strip(b" \r")]
     first = nonempty[skip_rows] if len(nonempty) > skip_rows else b""
     ncols = first.count(delim.encode()) + 1
     out = np.empty(int(nrows) * ncols, dtype=np.float32)

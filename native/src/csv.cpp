@@ -60,25 +60,34 @@ void parse_kernel(int64_t start, int64_t stop, void *arg) {
     const char *p = ln.begin;
     float *row = ctx->out + r * ctx->cols;
     for (int32_t c = 0; c < ctx->cols; ++c) {
+      /* Bound the field FIRST: strtof skips leading whitespace (including
+       * '\n'), so an unbounded parse of an empty trailing field would
+       * silently steal the first number of the next line. */
+      const char *fend = p;
+      while (fend < ln.end && *fend != ctx->delim) ++fend;
       char *next = nullptr;
       row[c] = std::strtof(p, &next);
-      if (next == p) {  /* not a number */
+      if (next == p || next > fend) {  /* empty field / ran past field */
         ctx->error.store(1);
         return;
       }
-      p = next;
+      const char *rest = next;
+      while (rest < fend && (*rest == ' ' || *rest == '\r')) ++rest;
+      if (rest != fend) {  /* trailing junk inside the field */
+        ctx->error.store(1);
+        return;
+      }
       if (c + 1 < ctx->cols) {
-        while (p < ln.end && *p != ctx->delim) ++p;
-        if (p >= ln.end) {  /* ragged: fewer fields than expected */
+        if (fend >= ln.end) {  /* ragged: fewer fields than expected */
           ctx->error.store(1);
           return;
         }
-        ++p;
+        p = fend + 1;
+      } else if (fend != ln.end) {  /* extra fields = ragged */
+        ctx->error.store(1);
+        return;
       }
     }
-    /* Trailing junk after the last field (other than spaces) = ragged. */
-    while (p < ln.end && (*p == ' ' || *p == '\r')) ++p;
-    if (p < ln.end) ctx->error.store(1);
   }
 }
 

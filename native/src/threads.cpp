@@ -8,11 +8,11 @@
 #include "dl4j_native.h"
 
 #include <algorithm>
-#include <atomic>
 #include <condition_variable>
 #include <deque>
 #include <functional>
 #include <mutex>
+#include <shared_mutex>
 #include <thread>
 #include <vector>
 
@@ -28,7 +28,9 @@ class ThreadPool {
   int32_t size() const { return size_; }
 
   void resize(int32_t n) {
-    std::lock_guard<std::mutex> outer(resize_mu_);
+    /* Exclusive vs every in-flight parallel_for: resizing mid-flight would
+     * drop their queued chunks and deadlock the waiters. */
+    std::unique_lock<std::shared_mutex> outer(config_mu_);
     shutdown();
     start(n);
   }
@@ -39,12 +41,16 @@ class ThreadPool {
     const int64_t span = stop - start;
     if (span <= 0) return;
     if (min_chunk < 1) min_chunk = 1;
+    std::shared_lock<std::shared_mutex> guard(config_mu_);
     int64_t chunks = std::min<int64_t>(size_, (span + min_chunk - 1) / min_chunk);
     if (chunks <= 1 || size_ <= 1) {
       fn(start, stop, arg);
       return;
     }
-    std::atomic<int64_t> done{0};
+    /* Completion count is mutated under mu (not a bare atomic): the worker
+     * must not touch mu/cv after the waiter can observe done == chunks, or
+     * the waiter could destroy these stack objects under the worker. */
+    int64_t done = 0;
     std::mutex mu;
     std::condition_variable cv;
     const int64_t base = span / chunks, rem = span % chunks;
@@ -53,15 +59,13 @@ class ThreadPool {
       const int64_t hi = lo + base + (c < rem ? 1 : 0);
       submit([fn, arg, lo, hi, &done, &mu, &cv, chunks] {
         fn(lo, hi, arg);
-        if (done.fetch_add(1) + 1 == chunks) {
-          std::lock_guard<std::mutex> lk(mu);
-          cv.notify_one();
-        }
+        std::lock_guard<std::mutex> lk(mu);
+        if (++done == chunks) cv.notify_one();
       });
       lo = hi;
     }
     std::unique_lock<std::mutex> lk(mu);
-    cv.wait(lk, [&] { return done.load() == chunks; });
+    cv.wait(lk, [&] { return done == chunks; });
   }
 
  private:
@@ -116,7 +120,7 @@ class ThreadPool {
     }
   }
 
-  std::mutex resize_mu_;
+  std::shared_mutex config_mu_;  /* shared: parallel_for; exclusive: resize */
   std::mutex queue_mu_;
   std::condition_variable queue_cv_;
   std::deque<std::function<void()>> queue_;

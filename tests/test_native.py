@@ -119,3 +119,46 @@ def test_csv_parse_ragged_raises():
 def test_csv_parse_empty():
     m = native.csv_parse("", skip_rows=0)
     assert m.size == 0
+
+
+def test_csv_empty_trailing_field_is_error():
+    # regression: strtof must not steal the next line's first number
+    with pytest.raises(ValueError):
+        native.csv_parse("1,2,\n3,4,5\n")
+
+
+def test_csv_junk_in_field_is_error():
+    with pytest.raises(ValueError):
+        native.csv_parse("1,2 junk,3\n")
+
+
+def test_encode_rejects_non_f32():
+    with pytest.raises(TypeError):
+        native.threshold_encode(np.zeros(4, dtype=np.float64), 0.1)
+    with pytest.raises(TypeError):
+        native.bitmap_encode(np.zeros(4, dtype=np.float64)[::2], 0.1)
+
+
+def test_parallel_for_during_resize_safe():
+    import threading
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(20):
+                out = []
+                native.parallel_for(lambda lo, hi: out.append(hi - lo),
+                                    0, 10000, min_chunk=100)
+                assert sum(out) == 10000
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for _ in range(10):
+        native.set_num_threads(2)
+        native.set_num_threads(4)
+    for t in ts:
+        t.join()
+    assert not errs
