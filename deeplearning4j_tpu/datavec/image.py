@@ -121,14 +121,13 @@ class RotateImageTransform(ImageTransform):
         if not _HAVE_PIL:
             k = int(round(a / 90.0)) % 4
             return np.rot90(chw, k=k, axes=(1, 2)).copy()
-        hwc = chw.transpose(1, 2, 0)
-        mode = "F" if hwc.shape[2] == 1 else "RGB"
-        img = Image.fromarray(
-            hwc.squeeze(-1) if mode == "F" else hwc.astype(np.uint8), mode)
-        out = np.asarray(img.rotate(a, Image.BILINEAR), dtype=np.float32)
-        if out.ndim == 2:
-            out = out[:, :, None]
-        return out.transpose(2, 0, 1)
+        # rotate per channel in float32 "F" mode: a uint8 round-trip would
+        # wrap negative / >255 values (e.g. after contrast jitter) to garbage
+        out = np.stack([
+            np.asarray(Image.fromarray(ch.astype(np.float32), "F")
+                       .rotate(a, Image.BILINEAR), dtype=np.float32)
+            for ch in chw])
+        return out
 
 
 class ColorConversionTransform(ImageTransform):

@@ -171,6 +171,8 @@ class AsyncDataSetIterator(DataSetIterator):
             try:
                 while self.wrapped.hasNext():
                     self._q.put(self.wrapped.next())
+            except BaseException as e:  # surface in the consumer, not stderr
+                self._q.put(e)
             finally:
                 self._q.put(self._END)
 
@@ -180,6 +182,10 @@ class AsyncDataSetIterator(DataSetIterator):
     def hasNext(self) -> bool:
         if self._peek is None:
             self._peek = self._q.get()
+        if isinstance(self._peek, BaseException):
+            exc = self._peek
+            self._peek = None
+            raise exc  # a truncated epoch must not look like a clean end
         return self._peek is not self._END
 
     def next(self, num: int = 0) -> DataSet:

@@ -171,16 +171,20 @@ class CSVRecordReader(RecordReader):
         self._i = 0
 
     def initialize(self, split: InputSplit) -> None:
+        # skipNumLines applies PER FILE (the reference skips per location —
+        # every CSV in a directory has its own header).
+        def body(text: str) -> List[str]:
+            lines = [ln for ln in text.splitlines() if ln.strip()]
+            return lines[self.skipNumLines:]
+
         if isinstance(split, StringSplit):
-            self._raw = split.data
+            self._lines = body(split.data)
         else:
-            parts = []
+            self._lines = []
             for loc in split.locations():
                 with open(loc, "r", encoding="utf-8") as f:
-                    parts.append(f.read())
-            self._raw = "\n".join(parts)
-        self._lines = [ln for ln in self._raw.splitlines() if ln.strip()]
-        self._lines = self._lines[self.skipNumLines:]
+                    self._lines.extend(body(f.read()))
+        self._raw = "\n".join(self._lines)
         self._i = 0
 
     def hasNext(self) -> bool:
@@ -195,9 +199,19 @@ class CSVRecordReader(RecordReader):
         self._i = 0
 
     def loadAll(self) -> np.ndarray:
-        """All-numeric fast path through the native parser."""
-        return native.csv_parse(self._raw, delim=self.delimiter,
-                                skip_rows=self.skipNumLines)
+        """All-numeric bulk load through the native parser.
+
+        Falls back to the Writable path (numeric coercion per field) when
+        the data is not purely numeric; Text fields raise ValueError there
+        too — mixed-type data belongs in a TransformProcess first.
+        """
+        try:
+            # headers were already stripped per file in initialize()
+            return native.csv_parse(self._raw, delim=self.delimiter,
+                                    skip_rows=0)
+        except ValueError:
+            rows = [[w.toDouble() for w in rec] for rec in self]
+            return np.asarray(rows, dtype=np.float32)
 
 
 class CSVSequenceRecordReader(SequenceRecordReader):

@@ -1,3 +1,4 @@
 """Evaluation suite (reference: nd4j-api org/nd4j/evaluation)."""
 from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
-    Evaluation, EvaluationBinary, RegressionEvaluation, ROC, ROCMultiClass)
+    Evaluation, EvaluationBinary, EvaluationCalibration,
+    RegressionEvaluation, ROC, ROCBinary, ROCMultiClass)

@@ -85,6 +85,31 @@ class Evaluation:
         p, r = self.precision(cls), self.recall(cls)
         return 0.0 if p + r == 0 else 2 * p * r / (p + r)
 
+    def topNAccuracy(self, n: int, labels, predictions) -> float:
+        """Reference: Evaluation(topN) — fraction where the true class is in
+        the top-n predicted probabilities.  Stateless (needs raw probs, which
+        the confusion matrix no longer has)."""
+        y, p = _np(labels), _np(predictions)
+        yi = y.argmax(-1) if y.ndim > 1 else y.astype(np.int64)
+        top = np.argsort(-p, axis=-1)[:, :n]
+        return float(np.mean([yi[i] in top[i] for i in range(len(yi))]))
+
+    def matthewsCorrelation(self, cls: int) -> float:
+        """Reference: Evaluation.matthewsCorrelation — binary MCC one-vs-all."""
+        cm = self._cm
+        tp = float(cm[cls, cls])
+        fp = float(cm[:, cls].sum()) - tp
+        fn = float(cm[cls, :].sum()) - tp
+        tn = float(cm.sum()) - tp - fp - fn
+        # double throughout: the int64 product (tp+fp)(tp+fn)(tn+fp)(tn+fn)
+        # overflows past ~55k evaluated samples
+        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return 0.0 if denom == 0 else float((tp * tn - fp * fn) / denom)
+
+    def gMeasure(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return float(np.sqrt(p * r))
+
     def falsePositiveRate(self, cls: int) -> float:
         cm = self._cm
         fp = cm[:, cls].sum() - cm[cls, cls]
@@ -269,3 +294,102 @@ class RegressionEvaluation:
                          f"{self.rootMeanSquaredError(c):<14.6f} "
                          f"{self.rSquared(c):<.6f}")
         return "\n".join(lines)
+
+
+class ROCBinary:
+    """Per-output-column ROC for multi-label binary outputs.
+
+    Reference: nd4j-api ``org/nd4j/evaluation/classification/ROCBinary.java``.
+    """
+
+    def __init__(self, thresholdSteps: int = 0):
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        y, p = _np(labels), _np(predictions)
+        y = y.reshape(y.shape[0], -1)
+        p = p.reshape(p.shape[0], -1)
+        if not self._rocs:
+            self._rocs = [ROC() for _ in range(y.shape[1])]
+        for c in range(y.shape[1]):
+            self._rocs[c].eval(y[:, c], p[:, c])
+
+    def calculateAUC(self, col: int) -> float:
+        return self._rocs[col].calculateAUC()
+
+    def calculateAUCPR(self, col: int) -> float:
+        return self._rocs[col].calculateAUCPR()
+
+    def numLabels(self) -> int:
+        return len(self._rocs)
+
+
+class EvaluationCalibration:
+    """Reliability diagram + label/prediction count histograms.
+
+    Reference: nd4j-api ``org/nd4j/evaluation/classification/
+    EvaluationCalibration.java`` — bins predicted probabilities and tracks
+    observed accuracy per bin (reliability), plus residual plots.
+    """
+
+    def __init__(self, reliabilityDiagNumBins: int = 10,
+                 histogramNumBins: int = 10):
+        self.nBins = reliabilityDiagNumBins
+        self.histBins = histogramNumBins
+        self._binCounts: Optional[np.ndarray] = None   # (C, bins)
+        self._binCorrect: Optional[np.ndarray] = None
+        self._probSum: Optional[np.ndarray] = None
+        self._labelCounts: Optional[np.ndarray] = None
+        self._predCounts: Optional[np.ndarray] = None
+        self._residuals: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        y, p = _np(labels), _np(predictions)
+        if y.ndim == 3:
+            b, c, t = y.shape
+            y = y.transpose(0, 2, 1).reshape(b * t, c)
+            p = p.transpose(0, 2, 1).reshape(b * t, c)
+            if mask is not None:
+                m = _np(mask).reshape(b * t) > 0
+                y, p = y[m], p[m]
+        nC = y.shape[1]
+        if self._binCounts is None:
+            self._binCounts = np.zeros((nC, self.nBins), dtype=np.int64)
+            self._binCorrect = np.zeros((nC, self.nBins), dtype=np.int64)
+            self._probSum = np.zeros((nC, self.nBins), dtype=np.float64)
+            self._labelCounts = np.zeros(nC, dtype=np.int64)
+            self._predCounts = np.zeros(nC, dtype=np.int64)
+        yi = y.argmax(-1)
+        bins = np.clip((p * self.nBins).astype(np.int64), 0, self.nBins - 1)
+        for c in range(nC):
+            np.add.at(self._binCounts[c], bins[:, c], 1)
+            np.add.at(self._probSum[c], bins[:, c], p[:, c])
+            np.add.at(self._binCorrect[c], bins[:, c], (yi == c))
+        np.add.at(self._labelCounts, yi, 1)
+        np.add.at(self._predCounts, p.argmax(-1), 1)
+        self._residuals.append(np.abs(y - p).ravel())
+
+    def getReliabilityInfo(self, cls: int):
+        """(mean predicted prob per bin, observed frequency per bin, counts)."""
+        counts = self._binCounts[cls]
+        safe = np.maximum(counts, 1)
+        return (self._probSum[cls] / safe,
+                self._binCorrect[cls] / safe, counts.copy())
+
+    def expectedCalibrationError(self, cls: int) -> float:
+        mean_p, obs, counts = self.getReliabilityInfo(cls)
+        total = counts.sum()
+        if total == 0:
+            return 0.0
+        return float(np.sum(counts / total * np.abs(mean_p - obs)))
+
+    def getLabelCountsEachClass(self) -> np.ndarray:
+        return self._labelCounts.copy()
+
+    def getPredictionCountsEachClass(self) -> np.ndarray:
+        return self._predCounts.copy()
+
+    def getResidualPlotAllClasses(self):
+        """Histogram of |label - prediction| residuals over [0, 1]."""
+        r = np.concatenate(self._residuals)
+        return np.histogram(r, bins=self.histBins, range=(0.0, 1.0))

@@ -5,3 +5,5 @@ from deeplearning4j_tpu.models.graph_conf import (  # noqa: F401
     ComputationGraphConfiguration, ElementWiseVertex, GraphBuilder,
     L2NormalizeVertex, MergeVertex, PreprocessorVertex, ScaleVertex,
     ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)
+from deeplearning4j_tpu.models.transferlearning import (  # noqa: F401
+    FineTuneConfiguration, FrozenLayer, TransferLearning)

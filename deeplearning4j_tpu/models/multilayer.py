@@ -276,6 +276,13 @@ class MultiLayerNetwork:
                 li = str(i)
                 if li not in params:
                     continue
+                if getattr(layer, "frozen", False):
+                    # Transfer learning (reference: FrozenLayer) — params and
+                    # updater state pass through untouched; XLA dead-code-
+                    # eliminates the unused gradient computation.
+                    new_params[li] = params[li]
+                    new_opt[li] = optState[li]
+                    continue
                 g = _grad_normalize(layer, grads[li])
                 new_params[li] = {}
                 new_opt[li] = {}
@@ -504,6 +511,10 @@ class MultiLayerNetwork:
 
     def getListeners(self) -> List:
         return self._listeners
+
+    def removeListener(self, listener) -> None:
+        if listener in self._listeners:
+            self._listeners.remove(listener)
 
     # -- params ----------------------------------------------------------
     def params(self) -> NDArray:

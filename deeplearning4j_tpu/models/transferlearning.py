@@ -1,0 +1,180 @@
+"""Transfer learning — graph surgery on trained networks.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/transferlearning/
+{TransferLearning,FineTuneConfiguration}.java`` and
+``org/deeplearning4j/nn/conf/layers/misc/FrozenLayer.java``:
+freeze-up-to-layer feature extraction, output-head replacement
+(``removeOutputLayer``/``nOutReplace``/``addLayer``), and fine-tune config
+overriding the updater/lr of the unfrozen remainder.
+
+TPU-native stance: freezing is a flag the fused train step reads — frozen
+layers' params/updater-state pass through the XLA executable unchanged and
+their gradient computation is dead-code-eliminated, so a frozen backbone
+costs no updater FLOPs (the reference pays per-layer Java checks instead).
+Param transfer is a host-side dict re-wire, not a copy through flat views.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+
+def FrozenLayer(layer):
+    """Mark a layer config frozen (reference: layers/misc/FrozenLayer.java —
+    a wrapper layer; here a flag the train step honors)."""
+    layer.frozen = True
+    return layer
+
+
+class FineTuneConfiguration:
+    """Global-conf overrides applied to the transferred network.
+
+    Reference: FineTuneConfiguration.java — builder mirrors
+    NeuralNetConfiguration's global settings (updater, seed, activation,
+    weightInit, l1/l2, ...).
+    """
+
+    def __init__(self, **overrides):
+        self.overrides = overrides
+
+    class Builder:
+        def __init__(self):
+            self._kw: Dict[str, Any] = {}
+
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+
+            def setter(v):
+                self._kw[name] = v
+                return self
+
+            return setter
+
+        def build(self) -> "FineTuneConfiguration":
+            return FineTuneConfiguration(**self._kw)
+
+    @staticmethod
+    def builder() -> "FineTuneConfiguration.Builder":
+        return FineTuneConfiguration.Builder()
+
+    def appliedTo(self, globalConf: Dict[str, Any]) -> Dict[str, Any]:
+        g = dict(globalConf)
+        g.update(self.overrides)
+        return g
+
+
+class TransferLearning:
+    """Namespace matching the reference API: TransferLearning.Builder(net)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._freezeUpTo = -1
+            self._removeCount = 0
+            self._added: List = []
+            self._nOutReplace: Dict[int, tuple] = {}
+            self._inputType = net.conf.inputType
+
+        def fineTuneConfiguration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def setFeatureExtractor(self, layerIdx: int):
+            """Freeze layers 0..layerIdx inclusive."""
+            self._freezeUpTo = layerIdx
+            return self
+
+        def removeOutputLayer(self):
+            self._removeCount += 1
+            return self
+
+        def removeLayersFromOutput(self, n: int):
+            self._removeCount += n
+            return self
+
+        def addLayer(self, layer):
+            self._added.append(layer)
+            return self
+
+        def nOutReplace(self, layerIdx: int, nOut: int, weightInit=None):
+            self._nOutReplace[layerIdx] = (nOut, weightInit)
+            return self
+
+        def setInputType(self, inputType):
+            self._inputType = inputType
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            old = self._net
+            keep = len(old.conf.layers) - self._removeCount
+            if keep <= 0:
+                raise ValueError("removed every layer")
+            layers = [copy.deepcopy(l) for l in old.conf.layers[:keep]]
+
+            fresh: set = set()  # layer indices that need re-initialization
+            for idx, (nOut, wInit) in self._nOutReplace.items():
+                if idx >= keep:
+                    raise ValueError(f"nOutReplace index {idx} was removed")
+                layers[idx].nOut = nOut
+                if wInit is not None:
+                    layers[idx].weightInit = wInit
+                fresh.add(idx)
+                # the next parameterized layer's fan-in changes too
+                for j in range(idx + 1, keep):
+                    if getattr(layers[j], "nOut", 0):
+                        # with an InputType, _resolve re-infers (handles
+                        # conv->dense spatial flattening); without one the
+                        # direct fan-in is the replaced fan-out
+                        layers[j].nIn = 0 if self._inputType is not None \
+                            else nOut
+                        fresh.add(j)
+                        break
+
+            first_new = len(layers)
+            layers.extend(self._added)
+
+            g = dict(old.conf.globalConf)
+            if self._ftc is not None:
+                g = self._ftc.appliedTo(g)
+
+            for i in range(min(self._freezeUpTo + 1, len(layers))):
+                layers[i].frozen = True
+
+            pre = {i: p for i, p in old.conf.preProcessors.items()
+                   if i < first_new}
+            conf = MultiLayerConfiguration(
+                layers=layers, globalConf=g, inputType=self._inputType,
+                preProcessors=pre, backpropType=old.conf.backpropType,
+                tbpttFwdLength=old.conf.tbpttFwdLength,
+                tbpttBackLength=old.conf.tbpttBackLength)
+            net = MultiLayerNetwork(conf)
+            net.init()
+
+            # Re-wire retained params as REAL copies (fresh/new layers keep
+            # their init): the fused train step donates its buffers, so
+            # sharing arrays between old and new nets would let training one
+            # of them delete the other's params.
+            import jax
+            import jax.numpy as jnp
+            snap = lambda t: jax.tree.map(lambda a: jnp.array(a, copy=True), t)
+            params = dict(net.params_)
+            state = dict(net.state_)
+            for i in range(first_new):
+                li = str(i)
+                if i in fresh or li not in old.params_:
+                    continue
+                params[li] = snap(old.params_[li])
+                if li in old.state_:
+                    state[li] = snap(old.state_[li])
+            net.params_ = params
+            net.state_ = state
+            net._initOptState()  # updater state must match final params
+            return net
+
+    # reference also exposes TransferLearning.GraphBuilder; the CG variant
+    # lands with the ComputationGraph surgery work.

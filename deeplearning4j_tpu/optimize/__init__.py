@@ -3,3 +3,10 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CheckpointListener, CollectScoresIterationListener, EvaluativeListener,
     PerformanceListener, ScoreIterationListener, TimeIterationListener,
     TrainingListener)
+from deeplearning4j_tpu.optimize.earlystopping import (  # noqa: F401
+    BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    EarlyStoppingGraphTrainer, EarlyStoppingResult, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition, MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, TerminationReason)

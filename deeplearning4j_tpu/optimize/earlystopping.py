@@ -1,0 +1,415 @@
+"""Early stopping — trainer, termination conditions, savers.
+
+Reference: deeplearning4j-core ``org/deeplearning4j/earlystopping/**`` —
+``EarlyStoppingConfiguration`` (epoch + iteration termination conditions,
+score calculator, model saver, evaluateEveryNEpochs),
+``trainer/EarlyStoppingTrainer``, ``saver/{InMemoryModelSaver,
+LocalFileModelSaver}``, ``scorecalc/DataSetLossCalculator``,
+``EarlyStoppingResult`` with ``TerminationReason``.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import time
+from typing import List, Optional
+
+import jax
+
+
+# ----------------------------------------------------------- conditions ----
+
+class EpochTerminationCondition:
+    def initialize(self) -> None:
+        pass
+
+    def terminate(self, epochNum: int, score: float,
+                  minimize: bool) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, maxEpochs: int):
+        self.maxEpochs = maxEpochs
+
+    def terminate(self, epochNum, score, minimize):
+        return epochNum + 1 >= self.maxEpochs
+
+    def __str__(self):
+        return f"MaxEpochsTerminationCondition({self.maxEpochs})"
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop when no improvement for N evaluations (maxEpochsWithNoImprovement),
+    optionally requiring at least minImprovement delta."""
+
+    def __init__(self, maxEpochsWithNoImprovement: int,
+                 minImprovement: float = 0.0):
+        self.patience = maxEpochsWithNoImprovement
+        self.minImprovement = minImprovement
+        self._best: Optional[float] = None
+        self._bad = 0
+
+    def initialize(self):
+        self._best = None
+        self._bad = 0
+
+    def terminate(self, epochNum, score, minimize):
+        if self._best is None:
+            self._best = score
+            return False
+        improved = (self._best - score) if minimize else (score - self._best)
+        if improved > self.minImprovement:
+            self._best = score
+            self._bad = 0
+        else:
+            self._bad += 1
+        return self._bad >= self.patience
+
+    def __str__(self):
+        return ("ScoreImprovementEpochTerminationCondition("
+                f"{self.patience}, {self.minImprovement})")
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop once the score is at least as good as a target."""
+
+    def __init__(self, bestExpectedScore: float):
+        self.bestExpectedScore = bestExpectedScore
+
+    def terminate(self, epochNum, score, minimize):
+        return score <= self.bestExpectedScore if minimize \
+            else score >= self.bestExpectedScore
+
+    def __str__(self):
+        return f"BestScoreEpochTerminationCondition({self.bestExpectedScore})"
+
+
+class IterationTerminationCondition:
+    def initialize(self) -> None:
+        pass
+
+    def terminate(self, lastMiniBatchScore: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, maxTime: float, unit: str = "seconds"):
+        mult = {"seconds": 1.0, "minutes": 60.0, "hours": 3600.0}[unit]
+        self.maxSeconds = maxTime * mult
+        self._start = None
+
+    def initialize(self):
+        self._start = time.time()
+
+    def terminate(self, lastMiniBatchScore):
+        return (time.time() - self._start) > self.maxSeconds
+
+    def __str__(self):
+        return f"MaxTimeIterationTerminationCondition({self.maxSeconds}s)"
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort when the minibatch score explodes past a ceiling (divergence)."""
+
+    def __init__(self, maxScore: float):
+        self.maxScore = maxScore
+
+    def terminate(self, lastMiniBatchScore):
+        import math
+        return lastMiniBatchScore > self.maxScore or \
+            math.isnan(lastMiniBatchScore)
+
+    def __str__(self):
+        return f"MaxScoreIterationTerminationCondition({self.maxScore})"
+
+
+# ------------------------------------------------------ score calculators ----
+
+class ScoreCalculator:
+    minimizeScore: bool = True
+
+    def calculateScore(self, net) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Reference: scorecalc/DataSetLossCalculator — average loss over a
+    held-out iterator."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculateScore(self, net) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        for ds in self.iterator:
+            total += net.score(ds) * ds.numExamples()
+            n += ds.numExamples()
+        return total / max(n, 1) if self.average else total
+
+
+class ClassificationScoreCalculator(ScoreCalculator):
+    """Accuracy/F1 on a held-out set (HIGHER is better)."""
+
+    minimizeScore = False
+
+    def __init__(self, iterator, metric: str = "accuracy"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def calculateScore(self, net) -> float:
+        self.iterator.reset()
+        ev = net.evaluate(self.iterator)
+        return getattr(ev, self.metric)()
+
+
+# ---------------------------------------------------------------- savers ----
+
+class EarlyStoppingModelSaver:
+    def saveBestModel(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def saveLatestModel(self, net, score: float) -> None:
+        pass
+
+    def getBestModel(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    def __init__(self):
+        self._best = None
+
+    def saveBestModel(self, net, score):
+        # REAL device copies: the fused train step donates its param/state
+        # buffers, so holding references would alias soon-deleted arrays
+        import jax.numpy as jnp
+        snap = lambda tree: jax.tree.map(lambda a: jnp.array(a, copy=True),
+                                         tree)
+        self._best = (net, snap(net.params_), snap(net.state_))
+
+    def getBestModel(self):
+        if self._best is None:
+            return None
+        net, params, state = self._best
+        restored = copy.copy(net)
+        restored.params_ = params
+        restored.state_ = state
+        return restored
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Reference: saver/LocalFileModelSaver — bestModel.zip in a directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def saveBestModel(self, net, score):
+        from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        ModelSerializer.writeModel(net, self._path("bestModel.zip"),
+                                   saveUpdater=True)
+
+    def saveLatestModel(self, net, score):
+        from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        ModelSerializer.writeModel(net, self._path("latestModel.zip"),
+                                   saveUpdater=True)
+
+    def getBestModel(self):
+        from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        return ModelSerializer.restoreMultiLayerNetwork(
+            self._path("bestModel.zip"))
+
+
+# ---------------------------------------------------------------- config ----
+
+class EarlyStoppingConfiguration:
+    def __init__(self, epochTerminationConditions=None,
+                 iterationTerminationConditions=None,
+                 scoreCalculator: Optional[ScoreCalculator] = None,
+                 modelSaver: Optional[EarlyStoppingModelSaver] = None,
+                 evaluateEveryNEpochs: int = 1,
+                 saveLastModel: bool = False):
+        self.epochConds: List[EpochTerminationCondition] = \
+            list(epochTerminationConditions or [])
+        self.iterConds: List[IterationTerminationCondition] = \
+            list(iterationTerminationConditions or [])
+        self.scoreCalculator = scoreCalculator
+        self.modelSaver = modelSaver or InMemoryModelSaver()
+        self.evaluateEveryNEpochs = max(1, evaluateEveryNEpochs)
+        self.saveLastModel = saveLastModel
+
+    class Builder:
+        def __init__(self):
+            self._kw = {"epochTerminationConditions": [],
+                        "iterationTerminationConditions": []}
+
+        def epochTerminationConditions(self, *conds):
+            self._kw["epochTerminationConditions"].extend(conds)
+            return self
+
+        def iterationTerminationConditions(self, *conds):
+            self._kw["iterationTerminationConditions"].extend(conds)
+            return self
+
+        def scoreCalculator(self, sc):
+            self._kw["scoreCalculator"] = sc
+            return self
+
+        def modelSaver(self, saver):
+            self._kw["modelSaver"] = saver
+            return self
+
+        def evaluateEveryNEpochs(self, n):
+            self._kw["evaluateEveryNEpochs"] = n
+            return self
+
+        def saveLastModel(self, b=True):
+            self._kw["saveLastModel"] = b
+            return self
+
+        def build(self):
+            return EarlyStoppingConfiguration(**self._kw)
+
+    @staticmethod
+    def builder() -> "EarlyStoppingConfiguration.Builder":
+        return EarlyStoppingConfiguration.Builder()
+
+
+class TerminationReason:
+    EpochTerminationCondition = "EpochTerminationCondition"
+    IterationTerminationCondition = "IterationTerminationCondition"
+    Error = "Error"
+
+
+class EarlyStoppingResult:
+    def __init__(self, terminationReason, terminationDetails, scoreVsEpoch,
+                 bestModelEpoch, bestModelScore, totalEpochs, bestModel):
+        self.terminationReason = terminationReason
+        self.terminationDetails = terminationDetails
+        self.scoreVsEpoch = scoreVsEpoch
+        self.bestModelEpoch = bestModelEpoch
+        self.bestModelScore = bestModelScore
+        self.totalEpochs = totalEpochs
+        self._bestModel = bestModel
+
+    def getBestModel(self):
+        return self._bestModel
+
+    def getTerminationReason(self):
+        return self.terminationReason
+
+    def __str__(self):
+        return (f"EarlyStoppingResult(reason={self.terminationReason}, "
+                f"details={self.terminationDetails}, "
+                f"bestEpoch={self.bestModelEpoch}, "
+                f"bestScore={self.bestModelScore}, "
+                f"totalEpochs={self.totalEpochs})")
+
+
+# --------------------------------------------------------------- trainer ----
+
+class EarlyStoppingTrainer:
+    """Reference: trainer/EarlyStoppingTrainer (+ BaseEarlyStoppingTrainer).
+
+    Epoch loop: train one epoch → (every N epochs) score on the held-out
+    calculator → track/save best → check epoch conditions.  Iteration
+    conditions (time budget, divergence) are checked after every epoch and
+    after every minibatch via a listener hook.
+    """
+
+    def __init__(self, earlyStoppingConfiguration, conf_or_net, iterator):
+        self.esConfig = earlyStoppingConfiguration
+        self.net = conf_or_net
+        if not hasattr(conf_or_net, "fit"):  # a configuration was passed
+            from deeplearning4j_tpu.models import MultiLayerNetwork
+            self.net = MultiLayerNetwork(conf_or_net)
+            self.net.init()
+        self.iterator = iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.esConfig
+        for c in cfg.epochConds + cfg.iterConds:
+            c.initialize()
+        calc = cfg.scoreCalculator
+        minimize = calc.minimizeScore if calc else True
+        scoreVsEpoch = {}
+        best_score = None
+        best_epoch = -1
+        epoch = 0
+        reason, details = TerminationReason.EpochTerminationCondition, ""
+
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+        class _IterCheck(TrainingListener):
+            stop = None
+
+            def iterationDone(self, model, iteration, ep):
+                s = model.score()
+                for c in cfg.iterConds:
+                    if c.terminate(s):
+                        _IterCheck.stop = str(c)
+                        raise _StopTraining()
+
+        class _StopTraining(Exception):
+            pass
+
+        listener = _IterCheck()
+        self.net.addListeners(listener)
+        try:
+            while True:
+                try:
+                    self.iterator.reset()
+                    self.net.fit(self.iterator, epochs=1)
+                except _StopTraining:
+                    reason = TerminationReason.IterationTerminationCondition
+                    details = _IterCheck.stop
+                    break
+
+                # the (possibly expensive) held-out pass runs only on eval
+                # epochs; off-epochs reuse the last held-out score so epoch
+                # conditions keep a consistent metric (epoch 0 always evals)
+                if calc is None:
+                    score = self.net.score()
+                elif epoch % cfg.evaluateEveryNEpochs == 0:
+                    score = calc.calculateScore(self.net)
+                # else: keep previous `score`
+                if epoch % cfg.evaluateEveryNEpochs == 0 or calc is None:
+                    scoreVsEpoch[epoch] = score
+                    better = best_score is None or \
+                        (score < best_score if minimize else score > best_score)
+                    if better:
+                        best_score, best_epoch = score, epoch
+                        cfg.modelSaver.saveBestModel(self.net, score)
+                if cfg.saveLastModel:
+                    cfg.modelSaver.saveLatestModel(self.net, score)
+
+                stop = None
+                for c in cfg.epochConds:
+                    if c.terminate(epoch, score, minimize):
+                        stop = str(c)
+                        break
+                epoch += 1
+                if stop is not None:
+                    reason = TerminationReason.EpochTerminationCondition
+                    details = stop
+                    break
+        finally:
+            try:
+                self.net.removeListener(listener)
+            except Exception:
+                pass
+
+        return EarlyStoppingResult(
+            terminationReason=reason, terminationDetails=details,
+            scoreVsEpoch=scoreVsEpoch, bestModelEpoch=best_epoch,
+            bestModelScore=best_score, totalEpochs=epoch,
+            bestModel=cfg.modelSaver.getBestModel())
+
+
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

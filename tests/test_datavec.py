@@ -247,3 +247,35 @@ def test_native_image_loader_array_input():
     loader = NativeImageLoader(4, 4, 1)
     out = loader.asMatrix(np.ones((8, 8), dtype=np.float32))
     assert out.shape == (1, 4, 4)
+
+
+def test_csv_reader_skips_header_per_file(tmp_path):
+    for i in range(2):
+        (tmp_path / f"part{i}.csv").write_text(f"colA,colB\n{i},1\n{i},2\n")
+    rr = CSVRecordReader(skipNumLines=1)
+    rr.initialize(FileSplit(tmp_path, allowFormats=[".csv"]))
+    recs = list(rr)
+    assert len(recs) == 4                     # headers of BOTH files skipped
+    assert all(isinstance(r[0], IntWritable) for r in recs)
+    m = rr.loadAll()
+    assert m.shape == (4, 2)
+
+
+def test_async_iterator_propagates_producer_error():
+    class Exploding(ListDataSetIterator):
+        def next(self, num=0):
+            raise RuntimeError("corrupt record")
+
+    it = AsyncDataSetIterator(
+        Exploding([DataSet(np.zeros((1, 2), dtype=np.float32),
+                           np.zeros((1, 2), dtype=np.float32))]))
+    with pytest.raises(RuntimeError, match="corrupt record"):
+        list(it)
+
+
+def test_rotate_transform_preserves_float_range():
+    from deeplearning4j_tpu.datavec import RotateImageTransform
+    img = np.full((3, 8, 8), -5.0, dtype=np.float32)   # out of uint8 range
+    out = RotateImageTransform(10).transform(img, np.random.RandomState(0))
+    assert out.shape == (3, 8, 8)
+    assert out.min() >= -5.0 - 1e-4 and out.max() <= 0.0 + 1e-4

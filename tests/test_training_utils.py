@@ -1,0 +1,191 @@
+"""Early stopping, transfer learning, calibration/ROC-binary tests.
+
+Reference analogues: deeplearning4j-core earlystopping tests,
+TransferLearning tests (nn/transferlearning), nd4j evaluation tests.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.eval import (EvaluationCalibration, ROCBinary)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.models import (FineTuneConfiguration,
+                                       MultiLayerNetwork, TransferLearning)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import (DataSetLossCalculator,
+                                         EarlyStoppingConfiguration,
+                                         EarlyStoppingTrainer,
+                                         MaxEpochsTerminationCondition,
+                                         MaxScoreIterationTerminationCondition,
+                                         ScoreImprovementEpochTerminationCondition,
+                                         TerminationReason)
+
+
+def _toy_data(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    cls = rng.randint(0, 2, n)
+    x = rng.randn(n, 4).astype(np.float32) + cls[:, None] * 2.0
+    y = np.eye(2, dtype=np.float32)[cls]
+    return DataSet(x, y)
+
+
+def _net(seed=1, lr=5e-2):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer.builder().nIn(4).nOut(16).activation("relu")
+                   .build())
+            .layer(DenseLayer.builder().nIn(16).nOut(8).activation("relu")
+                   .build())
+            .layer(OutputLayer.builder("mcxent").nIn(8).nOut(2)
+                   .activation("softmax").build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------- early stopping ----
+
+def test_early_stopping_max_epochs():
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    test = ListDataSetIterator([_toy_data(seed=9)], batch=64)
+    es = (EarlyStoppingConfiguration.builder()
+          .epochTerminationConditions(MaxEpochsTerminationCondition(3))
+          .scoreCalculator(DataSetLossCalculator(test))
+          .build())
+    result = EarlyStoppingTrainer(es, _net(), train).fit()
+    assert result.terminationReason == \
+        TerminationReason.EpochTerminationCondition
+    assert result.totalEpochs == 3
+    assert result.getBestModel() is not None
+    assert result.bestModelScore is not None
+
+
+def test_early_stopping_score_improvement_patience():
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    test = ListDataSetIterator([_toy_data(seed=9)], batch=64)
+    es = (EarlyStoppingConfiguration.builder()
+          .epochTerminationConditions(
+              ScoreImprovementEpochTerminationCondition(2, 1e9),  # impossible improvement
+              MaxEpochsTerminationCondition(50))
+          .scoreCalculator(DataSetLossCalculator(test))
+          .build())
+    result = EarlyStoppingTrainer(es, _net(), train).fit()
+    # patience of 2 with unreachable minImprovement stops after 3 evals
+    assert result.totalEpochs <= 4
+    assert "ScoreImprovement" in result.terminationDetails
+
+
+def test_early_stopping_divergence_abort():
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    es = (EarlyStoppingConfiguration.builder()
+          .epochTerminationConditions(MaxEpochsTerminationCondition(50))
+          .iterationTerminationConditions(
+              MaxScoreIterationTerminationCondition(1e-9))  # trips instantly
+          .build())
+    result = EarlyStoppingTrainer(es, _net(), train).fit()
+    assert result.terminationReason == \
+        TerminationReason.IterationTerminationCondition
+
+
+def test_early_stopping_best_model_usable():
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    test = ListDataSetIterator([_toy_data(seed=9)], batch=64)
+    es = (EarlyStoppingConfiguration.builder()
+          .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+          .scoreCalculator(DataSetLossCalculator(test))
+          .build())
+    best = EarlyStoppingTrainer(es, _net(), train).fit().getBestModel()
+    ev = best.evaluate(test)
+    assert ev.accuracy() > 0.8
+
+
+# ----------------------------------------------------- transfer learning ----
+
+def test_transfer_learning_freeze_and_replace_head():
+    ds = _toy_data()
+    it = ListDataSetIterator([ds], batch=64)
+    base = _net()
+    base.fit(it, epochs=3)
+    w0_before = np.asarray(base.params_["0"]["W"])
+
+    # new 3-class head, backbone frozen
+    net2 = (TransferLearning.Builder(base)
+            .fineTuneConfiguration(
+                FineTuneConfiguration.builder().updater(Sgd(1e-2)).build())
+            .setFeatureExtractor(1)
+            .removeOutputLayer()
+            .addLayer(OutputLayer.builder("mcxent").nIn(8).nOut(3)
+                      .activation("softmax").build())
+            .build())
+    # backbone params transferred
+    np.testing.assert_array_equal(np.asarray(net2.params_["0"]["W"]),
+                                  w0_before)
+    assert net2.conf.layers[0].frozen and net2.conf.layers[1].frozen
+    assert not getattr(net2.conf.layers[2], "frozen", False)
+
+    rng = np.random.RandomState(3)
+    cls3 = rng.randint(0, 3, 64)
+    ds3 = DataSet(rng.randn(64, 4).astype(np.float32) + cls3[:, None],
+                  np.eye(3, dtype=np.float32)[cls3])
+    net2.fit(ListDataSetIterator([ds3], batch=32), epochs=3)
+    # frozen layers unchanged, head trained
+    np.testing.assert_array_equal(np.asarray(net2.params_["0"]["W"]),
+                                  w0_before)
+    assert net2.output(ds3.features.numpy()).shape == (64, 3)
+
+
+def test_transfer_learning_nout_replace():
+    base = _net()
+    net2 = (TransferLearning.Builder(base)
+            .nOutReplace(1, 12)           # widen middle layer
+            .build())
+    assert np.asarray(net2.params_["1"]["W"]).shape == (16, 12)
+    assert np.asarray(net2.params_["2"]["W"]).shape == (12, 2)
+    # layer 0 retained
+    np.testing.assert_array_equal(np.asarray(net2.params_["0"]["W"]),
+                                  np.asarray(base.params_["0"]["W"]))
+    out = net2.output(np.zeros((2, 4), dtype=np.float32))
+    assert out.shape == (2, 2)
+
+
+# ------------------------------------------------------------ evaluation ----
+
+def test_roc_binary_per_column():
+    rb = ROCBinary()
+    rng = np.random.RandomState(0)
+    y = (rng.rand(200, 3) > 0.5).astype(np.float32)
+    p = np.clip(y * 0.8 + rng.rand(200, 3) * 0.2, 0, 1)  # informative col 0-2
+    rb.eval(y, p)
+    assert rb.numLabels() == 3
+    for c in range(3):
+        assert rb.calculateAUC(c) > 0.9
+
+
+def test_evaluation_calibration():
+    ec = EvaluationCalibration(reliabilityDiagNumBins=5)
+    rng = np.random.RandomState(1)
+    n = 1000
+    p1 = rng.rand(n)
+    y = (rng.rand(n) < p1).astype(np.float32)   # perfectly calibrated
+    probs = np.stack([1 - p1, p1], axis=1).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[y.astype(int)]
+    ec.eval(labels, probs)
+    ece = ec.expectedCalibrationError(1)
+    assert ece < 0.08
+    counts = ec.getLabelCountsEachClass()
+    assert counts.sum() == n
+    hist, edges = ec.getResidualPlotAllClasses()
+    assert hist.sum() == 2 * n
+
+
+def test_evaluation_topn_and_mcc():
+    ev = Evaluation(numClasses=3)
+    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    p = np.array([[.6, .3, .1], [.2, .5, .3], [.1, .2, .7], [.3, .4, .3]],
+                 dtype=np.float32)
+    ev.eval(y, p)
+    assert ev.topNAccuracy(1, y, p) == pytest.approx(0.75)
+    assert ev.topNAccuracy(2, y, p) == pytest.approx(1.0)
+    assert -1.0 <= ev.matthewsCorrelation(0) <= 1.0
+    assert ev.matthewsCorrelation(2) == pytest.approx(1.0)  # perfect on cls 2
