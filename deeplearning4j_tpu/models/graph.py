@@ -123,8 +123,9 @@ class ComputationGraph:
                 acts[name] = node.forward(*xs)
         return acts, new_state
 
-    def _lossFn(self, params, state, inputs, labels, masks, key):
-        acts, new_state = self._forward(params, state, inputs, True, key)
+    def _sumLosses(self, acts, labels, masks):
+        """Accumulate every output layer's loss — THE loss semantics, shared
+        by training (_lossFn) and reporting (score)."""
         total = 0.0
         for i, name in enumerate(self.conf.outputs):
             node = self.conf.nodes[name][0]
@@ -132,6 +133,11 @@ class ComputationGraph:
                 mask = masks[i] if masks is not None else None
                 total = total + jnp.mean(node.computeScore(labels[i],
                                                            acts[name], mask))
+        return total
+
+    def _lossFn(self, params, state, inputs, labels, masks, key):
+        acts, new_state = self._forward(params, state, inputs, True, key)
+        total = self._sumLosses(acts, labels, masks)
         reg = _reg_penalty((self.conf.nodes[name][0], lp)
                            for name, lp in params.items())
         return total + reg, (new_state, total)
@@ -146,6 +152,12 @@ class ComputationGraph:
             new_params, new_opt = {}, {}
             for name, lp in params.items():
                 node = self.conf.nodes[name][0]
+                if getattr(node, "frozen", False):
+                    # transfer learning: frozen vertices pass through (same
+                    # contract as MultiLayerNetwork's train step)
+                    new_params[name] = lp
+                    new_opt[name] = optState[name]
+                    continue
                 g = _grad_normalize(node, grads[name])
                 new_params[name], new_opt[name] = {}, {}
                 for path, pname, pval in _iter_leaf_params(lp):
@@ -226,8 +238,30 @@ class ComputationGraph:
         out = self.output(*inputs)
         return out[0] if isinstance(out, list) else out
 
+    @functools.cached_property
+    def _scoreFn(self):
+        def run(params, state, inputs, labels, masks):
+            acts, _ = self._forward(params, state, inputs, False, None)
+            return self._sumLosses(acts, labels, masks) + _reg_penalty(
+                (self.conf.nodes[n][0], lp) for n, lp in params.items())
+        return jax.jit(run)
+
     def score(self, ds=None) -> float:
-        return self._score
+        """With a DataSet: compute the loss on it (reference:
+        ``ComputationGraph.score(DataSet)``); without: last training score."""
+        if ds is None:
+            return self._score
+        if isinstance(ds, MultiDataSet):
+            inputs = tuple(f.jax.astype(self._dtype) for f in ds.features)
+            labels = tuple(l.jax for l in ds.labels)
+            masks = tuple(m.jax for m in ds.labelsMasks) \
+                if ds.labelsMasks else None
+        else:
+            inputs = (ds.features.jax.astype(self._dtype),)
+            labels = (ds.labels.jax,)
+            masks = (ds.labelsMask.jax,) if ds.labelsMask is not None else None
+        return float(self._scoreFn(self.params_, self.state_, inputs, labels,
+                                   masks))
 
     def evaluate(self, it: DataSetIterator) -> Evaluation:
         ev = Evaluation()

@@ -159,9 +159,7 @@ class TransferLearning:
             # their init): the fused train step donates its buffers, so
             # sharing arrays between old and new nets would let training one
             # of them delete the other's params.
-            import jax
-            import jax.numpy as jnp
-            snap = lambda t: jax.tree.map(lambda a: jnp.array(a, copy=True), t)
+            from deeplearning4j_tpu.utils.trees import snapshot_tree as snap
             params = dict(net.params_)
             state = dict(net.state_)
             for i in range(first_new):

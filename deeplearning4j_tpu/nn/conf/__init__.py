@@ -15,8 +15,9 @@ from typing import Any, Dict, List, Optional
 from deeplearning4j_tpu.learning.config import IUpdater, Sgd
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (Layer, layer_from_json)
-# importing recurrent registers the RNN layers with the layer registry
+# importing these registers the RNN / extended-conv layers with the registry
 import deeplearning4j_tpu.nn.conf.recurrent  # noqa: F401
+import deeplearning4j_tpu.nn.conf.convolutional  # noqa: F401
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     CnnToFeedForwardPreProcessor, CnnToRnnPreProcessor,
     FeedForwardToCnnPreProcessor, FeedForwardToRnnPreProcessor,

@@ -1,0 +1,552 @@
+"""Extended convolutional layer family.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/layers/
+{Upsampling2D,ZeroPaddingLayer,Cropping2D,Deconvolution2D,
+SeparableConvolution2D,DepthwiseConvolution2D,Convolution1DLayer,
+Subsampling1DLayer,SpaceToDepthLayer,CnnLossLayer}.java`` and
+``objdetect/Yolo2OutputLayer.java`` (+ libnd4j deconv2d/sconv2d/upsampling2d
+declarable ops).
+
+TPU-first lowering: every op here is a single XLA HLO —
+``conv_general_dilated`` with ``feature_group_count`` (depthwise/separable),
+``lhs_dilation`` (transposed conv), ``jnp.repeat`` (upsampling: fuses into
+neighbors), pad/slice (zero-pad/crop).  NCHW / NCW layouts as in DL4J.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BaseLayer, ConvolutionMode,
+                                               PoolingType, register_layer)
+from deeplearning4j_tpu.nn.lossfunctions import get_loss
+from deeplearning4j_tpu.nn.weights import init_weight
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
+
+
+@dataclasses.dataclass
+class Upsampling2D(BaseLayer):
+    """Nearest-neighbour upsampling (reference: Upsampling2D.java)."""
+    size: Tuple[int, int] = (2, 2)
+
+    def __post_init__(self):
+        self.size = _pair(self.size)
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def getOutputType(self, inputType):
+        sh, sw = self.size
+        return InputType.convolutional(inputType.height * sh,
+                                       inputType.width * sw,
+                                       inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        sh, sw = self.size
+        y = jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+        return y, state
+
+
+@dataclasses.dataclass
+class ZeroPaddingLayer(BaseLayer):
+    """Zero padding (reference: ZeroPaddingLayer.java) —
+    padding = (top, bottom, left, right) or a (h, w) pair."""
+    padding: Tuple[int, ...] = (1, 1, 1, 1)
+
+    def __post_init__(self):
+        p = tuple(self.padding) if isinstance(self.padding, (tuple, list)) \
+            else (int(self.padding),) * 4
+        if len(p) == 2:
+            p = (p[0], p[0], p[1], p[1])
+        self.padding = p
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def getOutputType(self, inputType):
+        t, b, l, r = self.padding
+        return InputType.convolutional(inputType.height + t + b,
+                                       inputType.width + l + r,
+                                       inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r))), state
+
+
+@dataclasses.dataclass
+class Cropping2D(BaseLayer):
+    """Spatial crop (reference: convolutional/Cropping2D.java) —
+    cropping = (top, bottom, left, right) or a (h, w) pair."""
+    cropping: Tuple[int, ...] = (0, 0, 0, 0)
+
+    def __post_init__(self):
+        c = tuple(self.cropping) if isinstance(self.cropping, (tuple, list)) \
+            else (int(self.cropping),) * 4
+        if len(c) == 2:
+            c = (c[0], c[0], c[1], c[1])
+        self.cropping = c
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def getOutputType(self, inputType):
+        t, b, l, r = self.cropping
+        return InputType.convolutional(inputType.height - t - b,
+                                       inputType.width - l - r,
+                                       inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        t, b, l, r = self.cropping
+        h, w = x.shape[2], x.shape[3]
+        return x[:, :, t:h - b or h, l:w - r or w], state
+
+
+@dataclasses.dataclass
+class Deconvolution2D(BaseLayer):
+    """Transposed convolution (reference: Deconvolution2D.java, libnd4j
+    deconv2d.cpp).
+
+    Lowered as a fractionally-strided conv: ``lhs_dilation=stride`` with a
+    spatially-flipped kernel — one XLA conv HLO, MXU-tiled like any other.
+    Output spatial (Truncate): ``(in-1)*stride + kernel - 2*padding``.
+    """
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolutionMode: Optional[str] = None
+    hasBias: bool = True
+
+    def __post_init__(self):
+        self.kernelSize = _pair(self.kernelSize)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+
+    def getOutputType(self, inputType):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            return InputType.convolutional(inputType.height * sh,
+                                           inputType.width * sw, self.nOut)
+        ph, pw = self.padding
+        return InputType.convolutional((inputType.height - 1) * sh + kh - 2 * ph,
+                                       (inputType.width - 1) * sw + kw - 2 * pw,
+                                       self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        fan_in = self.nIn * kh * kw
+        fan_out = self.nOut * kh * kw
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nOut, self.nIn, kh, kw), fan_in,
+                              fan_out, self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            # output in*stride: symmetric residual padding
+            oh, ow = x.shape[2] * sh, x.shape[3] * sw
+            tot_h = (x.shape[2] - 1) * sh + kh - oh
+            tot_w = (x.shape[3] - 1) * sw + kw - ow
+            ph_lo = (kh - 1) - tot_h // 2 - tot_h % 2
+            ph_hi = (kh - 1) - tot_h // 2
+            pw_lo = (kw - 1) - tot_w // 2 - tot_w % 2
+            pw_hi = (kw - 1) - tot_w // 2
+            pads = [(ph_lo, ph_hi), (pw_lo, pw_hi)]
+        else:
+            ph, pw = self.padding
+            pads = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        w = params["W"][:, :, ::-1, ::-1]  # flip: transpose of the fwd conv
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=pads,
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class DepthwiseConvolution2D(BaseLayer):
+    """Depthwise conv (reference: DepthwiseConvolution2D.java) — each input
+    channel convolved with depthMultiplier filters;
+    ``feature_group_count=nIn`` maps it to one grouped-conv HLO."""
+    nIn: int = 0
+    nOut: int = 0                  # = nIn * depthMultiplier (derived)
+    depthMultiplier: int = 1
+    kernelSize: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolutionMode: Optional[str] = None
+    hasBias: bool = True
+
+    def __post_init__(self):
+        self.kernelSize = _pair(self.kernelSize)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+        self.nOut = self.nIn * self.depthMultiplier
+
+    def _outSpatial(self, inH, inW):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            return int(np.ceil(inH / sh)), int(np.ceil(inW / sw))
+        ph, pw = self.padding
+        return (inH + 2 * ph - kh) // sh + 1, (inW + 2 * pw - kw) // sw + 1
+
+    def getOutputType(self, inputType):
+        oh, ow = self._outSpatial(inputType.height, inputType.width)
+        return InputType.convolutional(oh, ow,
+                                       self.nIn * self.depthMultiplier)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        dm = self.depthMultiplier
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nIn * dm, 1, kh, kw), kh * kw,
+                              dm * kh * kw, self.weightInit or "XAVIER",
+                              dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nIn * dm,), self.biasInit or 0.0, dtype)
+        return p
+
+    def _pads(self):
+        mode = self.convolutionMode or ConvolutionMode.Truncate
+        if mode == ConvolutionMode.Same:
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride,
+            padding=self._pads(), feature_group_count=self.nIn,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class SeparableConvolution2D(DepthwiseConvolution2D):
+    """Depthwise + 1x1 pointwise (reference: SeparableConvolution2D.java,
+    libnd4j sconv2d.cpp) — two conv HLOs XLA schedules back-to-back."""
+    nOut: int = 0                  # pointwise output channels
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+
+    def getOutputType(self, inputType):
+        oh, ow = self._outSpatial(inputType.height, inputType.width)
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        dm = self.depthMultiplier
+        kD, kP, _ = jax.random.split(key, 3)
+        p = {"W": init_weight(kD, (self.nIn * dm, 1, kh, kw), kh * kw,
+                              dm * kh * kw, self.weightInit or "XAVIER",
+                              dtype),
+             "pW": init_weight(kP, (self.nOut, self.nIn * dm, 1, 1),
+                               self.nIn * dm, self.nOut,
+                               self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def weightParamKeys(self):
+        return ("W", "pW")
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride,
+            padding=self._pads(), feature_group_count=self.nIn,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y = lax.conv_general_dilated(
+            y, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class Convolution1DLayer(BaseLayer):
+    """1D conv over RNN-format (b, c, t) input (reference:
+    Convolution1DLayer.java — operates on recurrent InputType)."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolutionMode: Optional[str] = None
+    hasBias: bool = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def _outT(self, t):
+        if t < 0:
+            return -1
+        k, s, d = self.kernelSize, self.stride, self.dilation
+        e = (k - 1) * d + 1
+        mode = self.convolutionMode or ConvolutionMode.Same
+        if mode == ConvolutionMode.Same:
+            return int(np.ceil(t / s))
+        return (t + 2 * self.padding - e) // s + 1
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut,
+                                   self._outT(inputType.timeSeriesLength))
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        k = self.kernelSize
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nOut, self.nIn, k), self.nIn * k,
+                              self.nOut * k, self.weightInit or "XAVIER",
+                              dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        mode = self.convolutionMode or ConvolutionMode.Same
+        pads = "SAME" if mode == ConvolutionMode.Same \
+            else [(self.padding, self.padding)]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=pads,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class Subsampling1DLayer(BaseLayer):
+    """1D pooling over (b, c, t) (reference: Subsampling1DLayer.java)."""
+    poolingType: str = PoolingType.MAX
+    kernelSize: int = 2
+    stride: int = 2
+    padding: int = 0
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def getOutputType(self, inputType):
+        t = inputType.timeSeriesLength
+        if t >= 0:
+            t = (t + 2 * self.padding - self.kernelSize) // self.stride + 1
+        return InputType.recurrent(inputType.size, t)
+
+    def forward(self, params, x, train, key, state):
+        k, s, p = self.kernelSize, self.stride, self.padding
+        dims, strides = (1, 1, k), (1, 1, s)
+        pads = [(0, 0), (0, 0), (p, p)]
+        if self.poolingType.upper() == PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            if self.poolingType.upper() == PoolingType.AVG:
+                y = y / k
+        return y, state
+
+
+@dataclasses.dataclass
+class SpaceToDepthLayer(BaseLayer):
+    """(reference: SpaceToDepthLayer.java) — block-rearrange HxW into C."""
+    blockSize: int = 2
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def getOutputType(self, inputType):
+        bs = self.blockSize
+        return InputType.convolutional(inputType.height // bs,
+                                       inputType.width // bs,
+                                       inputType.channels * bs * bs)
+
+    def forward(self, params, x, train, key, state):
+        b, c, h, w = x.shape
+        bs = self.blockSize
+        y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+        y = y.transpose(0, 3, 5, 1, 2, 4).reshape(b, c * bs * bs,
+                                                  h // bs, w // bs)
+        return y, state
+
+
+@dataclasses.dataclass
+class CnnLossLayer(BaseLayer):
+    """Per-pixel loss over (b, c, h, w) (reference: CnnLossLayer.java) —
+    segmentation-style heads; the loss averages over pixels with an optional
+    (b, 1|c, h, w) mask."""
+    lossFunction: str = "mcxent"
+
+    @classmethod
+    def _builderArgs(cls, b, *args):
+        if args:
+            b._kw["lossFunction"] = args[0]
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def hasLoss(self) -> bool:
+        return True
+
+    def forward(self, params, x, train, key, state):
+        act = get_activation(self.activation or "identity")
+        if (self.activation or "").lower() == "softmax":
+            return jax.nn.softmax(x, axis=1), state  # over channels
+        return act(x), state
+
+    def computeScore(self, labels, output, mask=None):
+        # flatten pixels into the batch: (b, c, h, w) -> (b*h*w, c)
+        b, c, h, w = output.shape
+        o = output.transpose(0, 2, 3, 1).reshape(-1, c)
+        y = labels.transpose(0, 2, 3, 1).reshape(-1, c)
+        m = None
+        if mask is not None:
+            if mask.ndim == 4:
+                # (b, 1, h, w) or (b, c, h, w): per-pixel validity — a pixel
+                # counts if ANY channel is unmasked (get_loss masks per row)
+                m = (mask.max(axis=1) > 0).astype(output.dtype).reshape(-1)
+            else:  # (b, h, w)
+                m = mask.reshape(-1)
+        per = get_loss(self.lossFunction)(y, o, m)
+        return per.reshape(b, h * w).mean(axis=1)
+
+
+@dataclasses.dataclass
+class Yolo2OutputLayer(BaseLayer):
+    """YOLOv2 detection loss (reference: objdetect/Yolo2OutputLayer.java +
+    libnd4j yolo helpers).
+
+    Input (b, B*(5+C), H, W): per anchor box [tx, ty, tw, th, to, classes].
+    Labels (b, 4+C, H, W) DL4J format: bbox [x1, y1, x2, y2] in GRID units
+    + one-hot class, zero where no object.  Loss = lambdaCoord * position
+    (sigmoid xy, sqrt-exp wh vs anchors) + confidence (IOU target, with
+    lambdaNoObj on empty cells) + class cross-entropy — all batched XLA ops,
+    no per-cell host loop.
+    """
+    boundingBoxes: Optional[np.ndarray] = None   # (B, 2) anchor (h, w)
+    lambdaCoord: float = 5.0
+    lambdaNoObj: float = 0.5
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def hasLoss(self) -> bool:
+        return True
+
+    def _split(self, x):
+        b, ch, h, w = x.shape
+        nB = len(self.boundingBoxes)
+        nC = ch // nB - 5
+        x = x.reshape(b, nB, 5 + nC, h, w)
+        xy = jax.nn.sigmoid(x[:, :, 0:2])
+        wh = x[:, :, 2:4]
+        conf = jax.nn.sigmoid(x[:, :, 4])
+        cls = jax.nn.softmax(x[:, :, 5:], axis=2)
+        return xy, wh, conf, cls
+
+    def forward(self, params, x, train, key, state):
+        return x, state  # raw activations; loss/decoding interpret them
+
+    def computeScore(self, labels, output, mask=None):
+        anchors = jnp.asarray(self.boundingBoxes, output.dtype)  # (B, 2) h,w
+        xy, wh, conf, cls = self._split(output)
+        b, nB, _, h, w = xy.shape
+        nC = cls.shape[2]
+        lab = labels.reshape(b, 4 + nC, h, w)
+        x1, y1, x2, y2 = lab[:, 0], lab[:, 1], lab[:, 2], lab[:, 3]
+        obj = ((x2 - x1) > 0).astype(output.dtype)          # (b, h, w)
+        cx = (x1 + x2) / 2 - jnp.floor((x1 + x2) / 2)       # offset in cell
+        cy = (y1 + y2) / 2 - jnp.floor((y1 + y2) / 2)
+        tw = jnp.maximum(x2 - x1, 1e-6)                     # grid units
+        th = jnp.maximum(y2 - y1, 1e-6)
+
+        # responsible anchor = best IOU with the label box (shape-only IOU)
+        aw = anchors[:, 1].reshape(1, nB, 1, 1)
+        ah = anchors[:, 0].reshape(1, nB, 1, 1)
+        inter = jnp.minimum(tw[:, None], aw) * jnp.minimum(th[:, None], ah)
+        union = tw[:, None] * th[:, None] + aw * ah - inter
+        an_iou = inter / jnp.maximum(union, 1e-9)           # (b, nB, h, w)
+        resp = jax.nn.one_hot(jnp.argmax(an_iou, axis=1), nB,
+                              axis=1, dtype=output.dtype)   # (b, nB, h, w)
+        resp = resp * obj[:, None]
+
+        # predicted boxes (grid units) for the confidence IOU target
+        pw = aw * jnp.exp(wh[:, :, 0])
+        ph = ah * jnp.exp(wh[:, :, 1])
+        iou_wh = (jnp.minimum(pw, tw[:, None]) * jnp.minimum(ph, th[:, None])
+                  ) / jnp.maximum(
+            pw * ph + (tw * th)[:, None]
+            - jnp.minimum(pw, tw[:, None]) * jnp.minimum(ph, th[:, None]),
+            1e-9)
+
+        pos = ((xy[:, :, 0] - cx[:, None]) ** 2
+               + (xy[:, :, 1] - cy[:, None]) ** 2
+               + (jnp.sqrt(pw) - jnp.sqrt(tw)[:, None]) ** 2
+               + (jnp.sqrt(ph) - jnp.sqrt(th)[:, None]) ** 2)
+        loss_pos = self.lambdaCoord * (resp * pos).sum(axis=(1, 2, 3))
+
+        conf_t = jax.lax.stop_gradient(iou_wh)
+        loss_conf = (resp * (conf - conf_t) ** 2).sum(axis=(1, 2, 3)) \
+            + self.lambdaNoObj * ((1 - resp) * conf ** 2).sum(axis=(1, 2, 3))
+
+        cls_t = lab[:, 4:]                                  # (b, nC, h, w)
+        ce = -(cls_t[:, None] * jnp.log(jnp.maximum(cls, 1e-9))
+               ).sum(axis=2)                                # (b, nB, h, w)
+        loss_cls = (resp * ce).sum(axis=(1, 2, 3))
+
+        return loss_pos + loss_conf + loss_cls
+
+
+for _c in [Upsampling2D, ZeroPaddingLayer, Cropping2D, Deconvolution2D,
+           DepthwiseConvolution2D, SeparableConvolution2D, Convolution1DLayer,
+           Subsampling1DLayer, SpaceToDepthLayer, CnnLossLayer,
+           Yolo2OutputLayer]:
+    register_layer(_c)

@@ -183,20 +183,22 @@ class InMemoryModelSaver(EarlyStoppingModelSaver):
         self._best = None
 
     def saveBestModel(self, net, score):
-        # REAL device copies: the fused train step donates its param/state
-        # buffers, so holding references would alias soon-deleted arrays
-        import jax.numpy as jnp
-        snap = lambda tree: jax.tree.map(lambda a: jnp.array(a, copy=True),
-                                         tree)
-        self._best = (net, snap(net.params_), snap(net.state_))
+        from deeplearning4j_tpu.utils.trees import snapshot_tree
+        self._best = (net, snapshot_tree(net.params_),
+                      snapshot_tree(net.state_),
+                      snapshot_tree(net.optState_))
 
     def getBestModel(self):
         if self._best is None:
             return None
-        net, params, state = self._best
+        from deeplearning4j_tpu.utils.trees import snapshot_tree
+        net, params, state, opt = self._best
         restored = copy.copy(net)
-        restored.params_ = params
-        restored.state_ = state
+        # hand out copies so training the restored model can't delete the
+        # saved snapshot (or vice versa) through buffer donation
+        restored.params_ = snapshot_tree(params)
+        restored.state_ = snapshot_tree(state)
+        restored.optState_ = snapshot_tree(opt)
         return restored
 
 
@@ -212,6 +214,8 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
 
     def saveBestModel(self, net, score):
         from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        self._isGraph = not hasattr(net, "conf") or \
+            type(net).__name__ == "ComputationGraph"
         ModelSerializer.writeModel(net, self._path("bestModel.zip"),
                                    saveUpdater=True)
 
@@ -222,6 +226,9 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
 
     def getBestModel(self):
         from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        if getattr(self, "_isGraph", False):
+            return ModelSerializer.restoreComputationGraph(
+                self._path("bestModel.zip"))
         return ModelSerializer.restoreMultiLayerNetwork(
             self._path("bestModel.zip"))
 
@@ -372,14 +379,15 @@ class EarlyStoppingTrainer:
                     break
 
                 # the (possibly expensive) held-out pass runs only on eval
-                # epochs; off-epochs reuse the last held-out score so epoch
-                # conditions keep a consistent metric (epoch 0 always evals)
-                if calc is None:
-                    score = self.net.score()
-                elif epoch % cfg.evaluateEveryNEpochs == 0:
-                    score = calc.calculateScore(self.net)
-                # else: keep previous `score`
-                if epoch % cfg.evaluateEveryNEpochs == 0 or calc is None:
+                # epochs (epoch 0 always evals); stateful epoch conditions
+                # (score-improvement patience) are ONLY fed on eval epochs —
+                # feeding a stale score would burn patience N times faster
+                # (reference: BaseEarlyStoppingTrainer checks on eval epochs)
+                is_eval = calc is None or epoch % cfg.evaluateEveryNEpochs == 0
+                score = None
+                if is_eval:
+                    score = calc.calculateScore(self.net) if calc \
+                        else self.net.score()
                     scoreVsEpoch[epoch] = score
                     better = best_score is None or \
                         (score < best_score if minimize else score > best_score)
@@ -391,7 +399,11 @@ class EarlyStoppingTrainer:
 
                 stop = None
                 for c in cfg.epochConds:
-                    if c.terminate(epoch, score, minimize):
+                    if isinstance(c, MaxEpochsTerminationCondition):
+                        hit = c.terminate(epoch, score, minimize)
+                    else:
+                        hit = is_eval and c.terminate(epoch, score, minimize)
+                    if hit:
                         stop = str(c)
                         break
                 epoch += 1

@@ -1,0 +1,17 @@
+"""Pytree snapshot utilities.
+
+The fused train steps donate their param/opt/state buffers
+(``donate_argnums``), so any saved reference to a live model's trees MUST be
+a real device copy — aliasing a donated array means the next ``fit`` on
+either model deletes the other's buffers ("Array has been deleted").
+``snapshot_tree`` is the one shared spelling of that invariant.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def snapshot_tree(tree):
+    """Deep device-copy of every array leaf in a pytree."""
+    return jax.tree.map(lambda a: jnp.array(a, copy=True), tree)

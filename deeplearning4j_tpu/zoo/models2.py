@@ -1,0 +1,381 @@
+"""Zoo architectures, part 2: VGG19, SqueezeNet, Darknet19, TinyYOLO, UNet,
+Xception, InceptionResNetV1.
+
+Reference: deeplearning4j-zoo ``org/deeplearning4j/zoo/model/{VGG19,
+SqueezeNet,Darknet19,TinyYOLO,UNet,Xception,InceptionResNetV1}.java`` —
+hard-coded builder architectures (SURVEY.md §2.5 zoo row).
+
+TPU notes: every model compiles to one XLA executable; concat-merge vertices
+(SqueezeNet fire, UNet skips) are single HLO concatenates; separable convs
+(Xception) lower to grouped-conv HLOs (see ``nn/conf/convolutional.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.learning.config import Adam, Nesterovs
+from deeplearning4j_tpu.models.graph import ComputationGraph
+from deeplearning4j_tpu.models.graph_conf import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.convolutional import (CnnLossLayer,
+                                                      SeparableConvolution2D,
+                                                      Upsampling2D,
+                                                      Yolo2OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer,
+                                               ConvolutionMode, DenseLayer,
+                                               DropoutLayer,
+                                               GlobalPoolingLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.zoo.models import ZooModel
+
+
+@dataclasses.dataclass
+class VGG19(ZooModel):
+    """Reference: zoo/model/VGG19.java — VGG16 with [2,2,4,4,4] conv reps."""
+
+    def init(self) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(Nesterovs(1e-2, momentum=0.9)).weightInit("XAVIER")
+             .convolutionMode(ConvolutionMode.Same).list())
+        for n, reps in [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]:
+            for _ in range(reps):
+                b.layer(ConvolutionLayer.builder().nOut(n).kernelSize(3, 3)
+                        .activation("relu").build())
+            b.layer(SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(2, 2).stride(2, 2).build())
+        b.layer(DenseLayer.builder().nOut(4096).activation("relu").build())
+        b.layer(DenseLayer.builder().nOut(4096).activation("relu").build())
+        b.layer(OutputLayer.builder("negativeloglikelihood")
+                .nOut(self.numClasses).activation("softmax").build())
+        net = MultiLayerNetwork(b.setInputType(self._it()).build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class SqueezeNet(ZooModel):
+    """Reference: zoo/model/SqueezeNet.java — fire modules: 1x1 squeeze then
+    concatenated 1x1/3x3 expands (MergeVertex)."""
+
+    def graphBuilder(self):
+        gb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(Adam(1e-3)).weightInit("RELU")
+              .convolutionMode(ConvolutionMode.Same).graphBuilder())
+        gb.addInputs("input").setInputTypes(self._it())
+
+        def conv(name, inp, nOut, k, s=1, act="relu"):
+            gb.addLayer(name, ConvolutionLayer.builder().nOut(nOut)
+                        .kernelSize(k, k).stride(s, s).activation(act)
+                        .build(), inp)
+            return name
+
+        def fire(name, inp, squeeze, expand):
+            s = conv(name + "_sq", inp, squeeze, 1)
+            e1 = conv(name + "_e1", s, expand, 1)
+            e3 = conv(name + "_e3", s, expand, 3)
+            gb.addVertex(name, MergeVertex(), e1, e3)
+            return name
+
+        x = conv("conv1", "input", 64, 3, 2)
+        gb.addLayer("pool1", SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(3, 3).stride(2, 2).build(), x)
+        x = fire("fire2", "pool1", 16, 64)
+        x = fire("fire3", x, 16, 64)
+        gb.addLayer("pool3", SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(3, 3).stride(2, 2).build(), x)
+        x = fire("fire4", "pool3", 32, 128)
+        x = fire("fire5", x, 32, 128)
+        gb.addLayer("pool5", SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(3, 3).stride(2, 2).build(), x)
+        x = fire("fire6", "pool5", 48, 192)
+        x = fire("fire7", x, 48, 192)
+        x = fire("fire8", x, 64, 256)
+        x = fire("fire9", x, 64, 256)
+        x = conv("conv10", x, self.numClasses, 1)
+        gb.addLayer("avgpool", GlobalPoolingLayer.builder()
+                    .poolingType("AVG").build(), x)
+        gb.addLayer("out", OutputLayer.builder("negativeloglikelihood")
+                    .nIn(self.numClasses).nOut(self.numClasses)
+                    .activation("softmax").build(), "avgpool")
+        gb.setOutputs("out")
+        return gb
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.graphBuilder().build())
+        net.init()
+        return net
+
+
+def _darknet_backbone(b, filters):
+    """conv-bn-leakyrelu stacks with interleaved maxpools (Darknet-19
+    layout); ``filters`` = list of (nOut, kernel) per block, None = pool."""
+    for item in filters:
+        if item is None:
+            b.layer(SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(2, 2).stride(2, 2).build())
+        else:
+            nOut, k = item
+            b.layer(ConvolutionLayer.builder().nOut(nOut).kernelSize(k, k)
+                    .hasBias(False).build())
+            b.layer(BatchNormalization.builder().activation("leakyrelu")
+                    .build())
+    return b
+
+
+_DARKNET19 = [(32, 3), None, (64, 3), None, (128, 3), (64, 1), (128, 3),
+              None, (256, 3), (128, 1), (256, 3), None, (512, 3), (256, 1),
+              (512, 3), (256, 1), (512, 3), None, (1024, 3), (512, 1),
+              (1024, 3), (512, 1), (1024, 3)]
+
+
+@dataclasses.dataclass
+class Darknet19(ZooModel):
+    """Reference: zoo/model/Darknet19.java."""
+
+    def init(self) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(Nesterovs(1e-3, momentum=0.9)).weightInit("RELU")
+             .convolutionMode(ConvolutionMode.Same).list())
+        _darknet_backbone(b, _DARKNET19)
+        b.layer(ConvolutionLayer.builder().nOut(self.numClasses)
+                .kernelSize(1, 1).build())
+        b.layer(GlobalPoolingLayer.builder().poolingType("AVG").build())
+        b.layer(OutputLayer.builder("negativeloglikelihood")
+                .nIn(self.numClasses).nOut(self.numClasses)
+                .activation("softmax").build())
+        net = MultiLayerNetwork(b.setInputType(self._it()).build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class TinyYOLO(ZooModel):
+    """Reference: zoo/model/TinyYOLO.java — 9-conv darknet backbone +
+    Yolo2OutputLayer with 5 anchor boxes (VOC shape defaults)."""
+    numClasses: int = 20
+    inputShape: Tuple[int, int, int] = (3, 416, 416)
+    # anchors (h, w) in grid units — the reference's default priors
+    boundingBoxes: Tuple = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                            (9.42, 5.11), (16.62, 10.52))
+
+    def init(self) -> MultiLayerNetwork:
+        nB = len(self.boundingBoxes)
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("RELU")
+             .convolutionMode(ConvolutionMode.Same).list())
+        _darknet_backbone(b, [(16, 3), None, (32, 3), None, (64, 3), None,
+                              (128, 3), None, (256, 3), None, (512, 3),
+                              (1024, 3), (1024, 3)])
+        b.layer(ConvolutionLayer.builder().nOut(nB * (5 + self.numClasses))
+                .kernelSize(1, 1).build())
+        b.layer(Yolo2OutputLayer.builder()
+                .boundingBoxes(np.asarray(self.boundingBoxes)).build())
+        net = MultiLayerNetwork(b.setInputType(self._it()).build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class UNet(ZooModel):
+    """Reference: zoo/model/UNet.java — encoder/decoder with skip-concat
+    merges and a per-pixel sigmoid head (CnnLossLayer xent)."""
+    numClasses: int = 1
+    inputShape: Tuple[int, int, int] = (3, 128, 128)
+
+    def graphBuilder(self):
+        gb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(Adam(1e-3)).weightInit("RELU")
+              .convolutionMode(ConvolutionMode.Same).graphBuilder())
+        gb.addInputs("input").setInputTypes(self._it())
+
+        def conv2(name, inp, n):
+            gb.addLayer(name + "_1", ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(3, 3).activation("relu").build(), inp)
+            gb.addLayer(name + "_2", ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(3, 3).activation("relu").build(),
+                        name + "_1")
+            return name + "_2"
+
+        skips = []
+        x = "input"
+        widths = (32, 64, 128, 256)
+        for i, n in enumerate(widths):
+            x = conv2(f"down{i}", x, n)
+            skips.append(x)
+            gb.addLayer(f"pool{i}", SubsamplingLayer.builder()
+                        .poolingType("MAX").kernelSize(2, 2).stride(2, 2)
+                        .build(), x)
+            x = f"pool{i}"
+        x = conv2("bottom", x, 512)
+        for i, n in reversed(list(enumerate(widths))):
+            gb.addLayer(f"up{i}_us", Upsampling2D.builder().size(2).build(), x)
+            gb.addLayer(f"up{i}_c", ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(2, 2).activation("relu").build(),
+                        f"up{i}_us")
+            gb.addVertex(f"up{i}_m", MergeVertex(), skips[i], f"up{i}_c")
+            x = conv2(f"up{i}", f"up{i}_m", n)
+        gb.addLayer("head", ConvolutionLayer.builder().nOut(self.numClasses)
+                    .kernelSize(1, 1).activation("identity").build(), x)
+        gb.addLayer("out", CnnLossLayer.builder("xent")
+                    .activation("sigmoid").build(), "head")
+        gb.setOutputs("out")
+        return gb
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.graphBuilder().build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class Xception(ZooModel):
+    """Reference: zoo/model/Xception.java — separable-conv towers with
+    residual 1x1-conv shortcuts (entry/middle/exit flows; middle-flow depth
+    reduced is NOT an option in the reference, kept at 8)."""
+
+    def graphBuilder(self):
+        gb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(Adam(1e-3)).weightInit("RELU")
+              .convolutionMode(ConvolutionMode.Same).graphBuilder())
+        gb.addInputs("input").setInputTypes(self._it())
+
+        def conv_bn(name, inp, n, k, s=1, act="relu"):
+            gb.addLayer(name, ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(k, k).stride(s, s).hasBias(False).build(),
+                        inp)
+            gb.addLayer(name + "_bn", BatchNormalization.builder()
+                        .activation(act).build(), name)
+            return name + "_bn"
+
+        def sep_bn(name, inp, n, act="relu"):
+            gb.addLayer(name, SeparableConvolution2D.builder().nOut(n)
+                        .kernelSize(3, 3).hasBias(False).build(), inp)
+            gb.addLayer(name + "_bn", BatchNormalization.builder()
+                        .activation(act).build(), name)
+            return name + "_bn"
+
+        def entry_block(name, inp, n, first_relu=True):
+            sc = conv_bn(name + "_sc", inp, n, 1, 2, act="identity")
+            x = sep_bn(name + "_s1", inp, n,
+                       act="relu" if first_relu else "identity")
+            x = sep_bn(name + "_s2", x, n, act="identity")
+            gb.addLayer(name + "_pool", SubsamplingLayer.builder()
+                        .poolingType("MAX").kernelSize(3, 3).stride(2, 2)
+                        .build(), x)
+            gb.addVertex(name, ElementWiseVertex("Add"), name + "_pool", sc)
+            return name
+
+        x = conv_bn("stem1", "input", 32, 3, 2)
+        x = conv_bn("stem2", x, 64, 3)
+        x = entry_block("entry1", x, 128, first_relu=False)
+        x = entry_block("entry2", x, 256)
+        x = entry_block("entry3", x, 728)
+        for i in range(8):          # middle flow
+            inp = x
+            y = sep_bn(f"mid{i}_1", inp, 728)
+            y = sep_bn(f"mid{i}_2", y, 728)
+            y = sep_bn(f"mid{i}_3", y, 728, act="identity")
+            gb.addVertex(f"mid{i}", ElementWiseVertex("Add"), y, inp)
+            x = f"mid{i}"
+        sc = conv_bn("exit_sc", x, 1024, 1, 2, act="identity")
+        y = sep_bn("exit_s1", x, 728)
+        y = sep_bn("exit_s2", y, 1024, act="identity")
+        gb.addLayer("exit_pool", SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(3, 3).stride(2, 2).build(), y)
+        gb.addVertex("exit_add", ElementWiseVertex("Add"), "exit_pool", sc)
+        x = sep_bn("exit_s3", "exit_add", 1536)
+        x = sep_bn("exit_s4", x, 2048)
+        gb.addLayer("avgpool", GlobalPoolingLayer.builder()
+                    .poolingType("AVG").build(), x)
+        gb.addLayer("out", OutputLayer.builder("negativeloglikelihood")
+                    .nOut(self.numClasses).activation("softmax").build(),
+                    "avgpool")
+        gb.setOutputs("out")
+        return gb
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.graphBuilder().build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class InceptionResNetV1(ZooModel):
+    """Reference: zoo/model/InceptionResNetV1.java (FaceNet backbone) —
+    stem + scaled-residual inception blocks A/B/C with reduction blocks;
+    block counts (5, 10, 5) as in the reference."""
+    numClasses: int = 128            # embedding head in the reference
+
+    def graphBuilder(self):
+        gb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(Adam(1e-3)).weightInit("RELU")
+              .convolutionMode(ConvolutionMode.Same).graphBuilder())
+        gb.addInputs("input").setInputTypes(self._it())
+
+        def conv_bn(name, inp, n, k, s=1, act="relu"):
+            gb.addLayer(name, ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(k if isinstance(k, tuple) else (k, k))
+                        .stride(s, s).hasBias(False).build(), inp)
+            gb.addLayer(name + "_bn", BatchNormalization.builder()
+                        .activation(act).build(), name)
+            return name + "_bn"
+
+        def block_a(name, inp, width):
+            b0 = conv_bn(name + "_b0", inp, 32, 1)
+            b1 = conv_bn(name + "_b1b", conv_bn(name + "_b1a", inp, 32, 1),
+                         32, 3)
+            b2 = conv_bn(name + "_b2c",
+                         conv_bn(name + "_b2b",
+                                 conv_bn(name + "_b2a", inp, 32, 1), 32, 3),
+                         32, 3)
+            gb.addVertex(name + "_cat", MergeVertex(), b0, b1, b2)
+            up = conv_bn(name + "_up", name + "_cat", width, 1, act="identity")
+            gb.addVertex(name, ElementWiseVertex("Add"), inp, up)
+            gb.addLayer(name + "_relu", ActivationLayer.builder()
+                        .activation("relu").build(), name)
+            return name + "_relu"
+
+        def reduction(name, inp, n):
+            gb.addLayer(name + "_pool", SubsamplingLayer.builder()
+                        .poolingType("MAX").kernelSize(3, 3).stride(2, 2)
+                        .build(), inp)
+            c = conv_bn(name + "_c", inp, n, 3, 2)
+            gb.addVertex(name, MergeVertex(), name + "_pool", c)
+            return name
+
+        x = conv_bn("stem1", "input", 32, 3, 2)
+        x = conv_bn("stem2", x, 64, 3)
+        gb.addLayer("stem_pool", SubsamplingLayer.builder().poolingType("MAX")
+                    .kernelSize(3, 3).stride(2, 2).build(), x)
+        x = conv_bn("stem3", "stem_pool", 128, 1)
+        width = 128
+        for i in range(5):
+            x = block_a(f"a{i}", x, width)
+        x = reduction("redA", x, 128)
+        width += 128
+        for i in range(10):
+            x = block_a(f"b{i}", x, width)
+        x = reduction("redB", x, 128)
+        width += 128
+        for i in range(5):
+            x = block_a(f"c{i}", x, width)
+        gb.addLayer("avgpool", GlobalPoolingLayer.builder()
+                    .poolingType("AVG").build(), x)
+        gb.addLayer("drop", DropoutLayer.builder().dropOut(0.8).build(),
+                    "avgpool")
+        gb.addLayer("out", OutputLayer.builder("negativeloglikelihood")
+                    .nOut(self.numClasses).activation("softmax").build(),
+                    "drop")
+        gb.setOutputs("out")
+        return gb
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.graphBuilder().build())
+        net.init()
+        return net

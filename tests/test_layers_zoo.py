@@ -1,0 +1,228 @@
+"""Extended conv layers + zoo part-2 tests.
+
+Reference analogues: deeplearning4j-core gradientcheck CNN tests (layer
+semantics), deeplearning4j-zoo TestInstantiation (model builds + forward).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.convolutional import (
+    CnnLossLayer, Convolution1DLayer, Cropping2D, Deconvolution2D,
+    DepthwiseConvolution2D, SeparableConvolution2D, SpaceToDepthLayer,
+    Subsampling1DLayer, Upsampling2D, Yolo2OutputLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+
+
+def _run_layer(layer, x, input_type=None):
+    import jax
+    params = layer.initParams(jax.random.PRNGKey(0), input_type) \
+        if hasattr(layer, "initParams") else {}
+    y, _ = layer.forward(params, x, False, None, {})
+    return np.asarray(y)
+
+
+def test_upsampling_and_zeropad_and_crop():
+    x = np.arange(2 * 1 * 2 * 2, dtype=np.float32).reshape(2, 1, 2, 2)
+    up = Upsampling2D.builder().size(2).build()
+    y = _run_layer(up, x)
+    assert y.shape == (2, 1, 4, 4)
+    np.testing.assert_allclose(y[0, 0, :2, :2], x[0, 0, 0, 0])
+
+    zp = ZeroPaddingLayer.builder().padding(1, 2, 3, 4).build()
+    y = _run_layer(zp, x)
+    assert y.shape == (2, 1, 2 + 3, 2 + 7)
+    assert y[0, 0, 0, 0] == 0 and y[0, 0, 1, 3] == x[0, 0, 0, 0]
+
+    cr = Cropping2D.builder().cropping(1, 1, 0, 0).build()
+    y = _run_layer(cr, np.ones((1, 1, 4, 4), dtype=np.float32))
+    assert y.shape == (1, 1, 2, 4)
+
+    # shape inference matches the computation
+    it = InputType.convolutional(2, 2, 1)
+    assert up.getOutputType(it).getShape(2) == (2, 1, 4, 4)
+    assert zp.getOutputType(it).getShape(2) == (2, 1, 5, 9)
+
+
+def test_space_to_depth():
+    x = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+    y = _run_layer(SpaceToDepthLayer.builder().blockSize(2).build(), x)
+    assert y.shape == (1, 4, 2, 2)
+    # the 4 channels are the 2x2 sub-grids
+    np.testing.assert_allclose(np.sort(y[0, :, 0, 0]), [0, 1, 4, 5])
+
+
+def test_deconvolution_inverts_spatial_reduction():
+    import jax
+    de = Deconvolution2D.builder().nIn(3).nOut(2).kernelSize(2, 2) \
+        .stride(2, 2).build()
+    x = np.random.RandomState(0).randn(2, 3, 5, 5).astype(np.float32)
+    y = _run_layer(de, x, InputType.convolutional(5, 5, 3))
+    assert y.shape == (2, 2, 10, 10)   # (in-1)*2 + 2 = 10
+    assert de.getOutputType(InputType.convolutional(5, 5, 3)).getShape(2) \
+        == (2, 2, 10, 10)
+
+
+def test_deconvolution_is_transpose_of_conv():
+    """y = deconv(x, W) satisfies <conv(z, W), x> == <z, deconv(x, W)> —
+    the defining adjoint property of the transposed convolution."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    rng = np.random.RandomState(1)
+    W = rng.randn(4, 3, 3, 3).astype(np.float32)   # (out=4, in=3, 3, 3)
+    x = rng.randn(1, 4, 4, 4).astype(np.float32)   # conv output-space
+    # conv input-space: 7 so that (7 + 2*1 - 3)//2 + 1 == 4 EXACTLY matches
+    # the deconv output (4-1)*2 + 3 - 2*1 == 7 (adjoint pairs require it)
+    z = rng.randn(1, 3, 7, 7).astype(np.float32)
+
+    conv = lambda z_: lax.conv_general_dilated(
+        z_, W, window_strides=(2, 2), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    de = Deconvolution2D.builder().nIn(4).nOut(3).kernelSize(3, 3) \
+        .stride(2, 2).padding(1, 1).hasBias(False).build()
+    # deconv weights (nOut=3 out-ch, nIn=4 in-ch) = transpose of W's roles
+    deconv_y, _ = de.forward({"W": jnp.asarray(W).transpose(1, 0, 2, 3)},
+                             jnp.asarray(x), False, None, {})
+    assert deconv_y.shape == (1, 3, 7, 7)
+    lhs = float(jnp.sum(conv(jnp.asarray(z)) * x))
+    rhs = float(jnp.sum(jnp.asarray(deconv_y) * z))
+    assert abs(lhs - rhs) / max(abs(lhs), 1) < 1e-4
+
+
+def test_separable_conv_param_count_and_shape():
+    import jax
+    sep = SeparableConvolution2D.builder().nIn(4).nOut(8).kernelSize(3, 3) \
+        .depthMultiplier(2).convolutionMode("Same").build()
+    p = sep.initParams(jax.random.PRNGKey(0), InputType.convolutional(6, 6, 4))
+    assert p["W"].shape == (8, 1, 3, 3)       # depthwise: nIn*dm groups
+    assert p["pW"].shape == (8, 8, 1, 1)      # pointwise
+    x = np.zeros((2, 4, 6, 6), dtype=np.float32)
+    y, _ = sep.forward(p, x, False, None, {})
+    assert y.shape == (2, 8, 6, 6)
+
+
+def test_depthwise_equals_per_channel_conv():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    rng = np.random.RandomState(2)
+    dw = DepthwiseConvolution2D.builder().nIn(3).nOut(3).kernelSize(3, 3) \
+        .hasBias(False).build()
+    W = rng.randn(3, 1, 3, 3).astype(np.float32)
+    x = rng.randn(1, 3, 6, 6).astype(np.float32)
+    y, _ = dw.forward({"W": jnp.asarray(W)}, jnp.asarray(x), False, None, {})
+    # manual per-channel conv
+    for c in range(3):
+        ref = lax.conv_general_dilated(
+            jnp.asarray(x[:, c:c + 1]), jnp.asarray(W[c:c + 1]),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(np.asarray(y[:, c]), np.asarray(ref[:, 0]),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_conv1d_trains_on_sequence():
+    rng = np.random.RandomState(0)
+    # class = whether the mean of channel 0 is positive
+    x = rng.randn(64, 2, 12).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0].mean(-1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(Convolution1DLayer.builder().nOut(8).kernelSize(3)
+                   .activation("relu").build())
+            .layer(Subsampling1DLayer.builder().kernelSize(2).stride(2)
+                   .build())
+            .layer(RnnOutputLayer.builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.recurrent(2, 12)).build())
+    net = MultiLayerNetwork(conf).init()
+    out = net.output(x)
+    assert np.asarray(out).shape == (64, 2, 6)   # t halved by pooling
+
+
+def test_cnn_loss_layer_segmentation_trains():
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 1, 8, 8).astype(np.float32)
+    y = (x > 0.5).astype(np.float32)           # per-pixel identity task
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(5e-2))
+            .convolutionMode("Same").list()
+            .layer(ConvolutionLayer.builder().nOut(8).kernelSize(3, 3)
+                   .activation("relu").build())
+            .layer(ConvolutionLayer.builder().nOut(1).kernelSize(1, 1)
+                   .activation("identity").build())
+            .layer(CnnLossLayer.builder("xent").activation("sigmoid").build())
+            .setInputType(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    net.fit(ListDataSetIterator([ds]), epochs=30)
+    s1 = net.score(ds)
+    assert s1 < s0 * 0.7
+    pred = np.asarray(net.output(x))
+    acc = ((pred > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9
+
+
+def test_yolo_loss_decreases():
+    rng = np.random.RandomState(0)
+    h = w = 4
+    nC, nB = 2, 2
+    anchors = np.array([[1.0, 1.0], [2.0, 2.0]])
+    x = rng.rand(8, 3, 32, 32).astype(np.float32)
+    # one object per image in a random cell, class 0/1, 1x1 to 2x2 boxes
+    labels = np.zeros((8, 4 + nC, h, w), dtype=np.float32)
+    for i in range(8):
+        ci, cj = rng.randint(0, h), rng.randint(0, w)
+        bw = bh = rng.uniform(0.8, 2.0)
+        cx, cy = cj + 0.5, ci + 0.5
+        labels[i, :4, ci, cj] = [cx - bw / 2, cy - bh / 2,
+                                 cx + bw / 2, cy + bh / 2]
+        labels[i, 4 + rng.randint(0, nC), ci, cj] = 1.0
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+            .convolutionMode("Same").list()
+            .layer(ConvolutionLayer.builder().nOut(16).kernelSize(3, 3)
+                   .stride(2, 2).activation("relu").build())
+            .layer(ConvolutionLayer.builder().nOut(16).kernelSize(3, 3)
+                   .stride(2, 2).activation("relu").build())
+            .layer(ConvolutionLayer.builder().nOut(16).kernelSize(3, 3)
+                   .stride(2, 2).activation("relu").build())
+            .layer(ConvolutionLayer.builder().nOut(nB * (5 + nC))
+                   .kernelSize(1, 1).build())
+            .layer(Yolo2OutputLayer.builder().boundingBoxes(anchors).build())
+            .setInputType(InputType.convolutional(32, 32, 3)).build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, labels.reshape(8, -1, h, w))
+    s0 = net.score(ds)
+    net.fit(ListDataSetIterator([ds]), epochs=40)
+    s1 = net.score(ds)
+    assert np.isfinite(s0) and np.isfinite(s1)
+    assert s1 < s0 * 0.5, (s0, s1)
+
+
+@pytest.mark.parametrize("cls_name", ["VGG19", "Xception",
+                                      "InceptionResNetV1"])
+def test_heavy_zoo_builds_and_forwards(cls_name):
+    import deeplearning4j_tpu.zoo as zoo
+    cls = getattr(zoo, cls_name)
+    m = cls(numClasses=4, inputShape=(3, 64, 64)).init()
+    x = np.zeros((1, 3, 64, 64), dtype=np.float32)
+    out = m.outputSingle(x) if hasattr(m, "outputSingle") else m.output(x)
+    assert np.asarray(out).shape[-1] == 4 or np.asarray(out).shape == (1, 4)
+
+
+def test_squeezenet_unet_tinyyolo_build():
+    from deeplearning4j_tpu.zoo import Darknet19, SqueezeNet, TinyYOLO, UNet
+    sq = SqueezeNet(numClasses=5, inputShape=(3, 64, 64)).init()
+    assert np.asarray(sq.outputSingle(
+        np.zeros((2, 3, 64, 64), dtype=np.float32))).shape == (2, 5)
+    un = UNet(numClasses=1, inputShape=(3, 32, 32)).init()
+    assert np.asarray(un.outputSingle(
+        np.zeros((1, 3, 32, 32), dtype=np.float32))).shape == (1, 1, 32, 32)
+    ty = TinyYOLO(numClasses=3, inputShape=(3, 64, 64)).init()
+    out = ty.output(np.zeros((1, 3, 64, 64), dtype=np.float32))
+    assert np.asarray(out).shape == (1, 5 * (5 + 3), 2, 2)

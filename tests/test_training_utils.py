@@ -189,3 +189,72 @@ def test_evaluation_topn_and_mcc():
     assert ev.topNAccuracy(2, y, p) == pytest.approx(1.0)
     assert -1.0 <= ev.matthewsCorrelation(0) <= 1.0
     assert ev.matthewsCorrelation(2) == pytest.approx(1.0)  # perfect on cls 2
+
+
+def test_best_model_and_original_both_trainable():
+    """Regression: donated-buffer aliasing between saver snapshot and net."""
+    from deeplearning4j_tpu.optimize import InMemoryModelSaver
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    net = _net()
+    net.fit(train, epochs=1)
+    saver = InMemoryModelSaver()
+    saver.saveBestModel(net, 0.0)
+    best = saver.getBestModel()
+    best.fit(train, epochs=1)     # trains the copy
+    net.fit(train, epochs=1)      # original must still own its buffers
+    best2 = saver.getBestModel()  # snapshot still intact
+    assert np.isfinite(best2.score(_toy_data()))
+
+
+def test_patience_respects_evaluate_every_n():
+    """Regression: off-eval epochs must not burn improvement patience."""
+    train = ListDataSetIterator([_toy_data()], batch=64)
+    test = ListDataSetIterator([_toy_data(seed=9)], batch=64)
+    es = (EarlyStoppingConfiguration.builder()
+          .epochTerminationConditions(
+              ScoreImprovementEpochTerminationCondition(2, 1e9),
+              MaxEpochsTerminationCondition(50))
+          .scoreCalculator(DataSetLossCalculator(test))
+          .evaluateEveryNEpochs(5)
+          .build())
+    result = EarlyStoppingTrainer(es, _net(), train).fit()
+    # evals at 0,5,10: patience 2 exhausted at epoch 10, NOT at epoch 2
+    assert result.totalEpochs == 11, result.totalEpochs
+
+
+def test_frozen_layer_in_computation_graph():
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.models.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    gb = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-2))
+          .graphBuilder())
+    gb.addInputs("in")
+    l0 = DenseLayer.builder().nIn(4).nOut(8).activation("relu").build()
+    l0.frozen = True
+    gb.addLayer("fc0", l0, "in")
+    gb.addLayer("out", OutputLayer.builder("mcxent").nIn(8).nOut(2)
+                .activation("softmax").build(), "fc0")
+    gb.setOutputs("out")
+    g = ComputationGraph(gb.build())
+    g.init()
+    w0 = np.asarray(g.params_["fc0"]["W"]).copy()
+    ds = _toy_data()
+    for _ in range(3):
+        g.fit(ds)
+    np.testing.assert_array_equal(np.asarray(g.params_["fc0"]["W"]), w0)
+    assert not np.array_equal(
+        np.asarray(g.params_["out"]["W"]),
+        np.asarray(g.params_["out"]["W"]) * 0)  # out layer exists/trains
+
+
+def test_cnn_loss_layer_masked_shapes():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.convolutional import CnnLossLayer
+    layer = CnnLossLayer.builder("xent").activation("sigmoid").build()
+    y = np.random.RandomState(0).rand(2, 3, 4, 4).astype(np.float32)
+    o = np.clip(y + 0.1, 0, 1)
+    for mshape in [(2, 1, 4, 4), (2, 3, 4, 4)]:
+        m = np.ones(mshape, dtype=np.float32)
+        per = layer.computeScore(jnp.asarray(y), jnp.asarray(o),
+                                 jnp.asarray(m))
+        assert np.all(np.isfinite(np.asarray(per)))
