@@ -1,0 +1,3 @@
+"""Nearest neighbors (reference: deeplearning4j-nearestneighbors-parent —
+org/deeplearning4j/clustering/vptree/VPTree.java, kdtree/KDTree.java)."""
+from deeplearning4j_tpu.clustering.trees import KDTree, VPTree  # noqa: F401

@@ -1,0 +1,4 @@
+"""Graph vertex embeddings (reference: deeplearning4j-graph
+org/deeplearning4j/graph — Graph, RandomWalkIterator, DeepWalk)."""
+from deeplearning4j_tpu.graphs.deepwalk import (  # noqa: F401
+    DeepWalk, Graph, RandomWalkIterator)

@@ -1,0 +1,170 @@
+"""DeepWalk vertex embeddings.
+
+Reference: deeplearning4j-graph ``org/deeplearning4j/graph/models/deepwalk/
+DeepWalk.java`` + ``graph/Graph.java`` + ``iterator/RandomWalkIterator.java``
+— uniform random walks fed to skip-gram with hierarchical softmax.
+
+TPU-first: walks generate host-side (NumPy vectorized — one RandomState
+draw per step for ALL walks at once), then train through the same batched
+SGNS XLA step as Word2Vec (negative sampling replaces the reference's
+hierarchical softmax; same objective family, one jitted step per batch).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import (VocabCache, WordVectors,
+                                             _EmbeddingTrainer)
+
+
+class Graph:
+    """Undirected-by-default adjacency graph (reference: graph/Graph.java)."""
+
+    def __init__(self, numVertices: int, allowMultipleEdges: bool = False):
+        self.n = numVertices
+        self._adj: List[List[int]] = [[] for _ in range(numVertices)]
+        self._allowMulti = allowMultipleEdges
+
+    def addEdge(self, a: int, b: int, directed: bool = False,
+                value=None) -> None:
+        if not self._allowMulti and b in self._adj[a]:
+            return
+        self._adj[a].append(b)
+        if not directed and a != b:
+            self._adj[b].append(a)
+
+    def getConnectedVertices(self, v: int) -> List[int]:
+        return list(self._adj[v])
+
+    def numVertices(self) -> int:
+        return self.n
+
+
+class RandomWalkIterator:
+    """Uniform random walks from every vertex (reference:
+    iterator/RandomWalkIterator.java)."""
+
+    def __init__(self, graph: Graph, walkLength: int, seed: int = 123):
+        self.graph = graph
+        self.walkLength = walkLength
+        self.rng = np.random.RandomState(seed)
+        self._order = self.rng.permutation(graph.numVertices())
+        self._i = 0
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._order)
+
+    def next(self) -> List[int]:
+        v = int(self._order[self._i])
+        self._i += 1
+        walk = [v]
+        for _ in range(self.walkLength - 1):
+            nbrs = self.graph.getConnectedVertices(walk[-1])
+            if not nbrs:
+                break
+            walk.append(int(self.rng.choice(nbrs)))
+        return walk
+
+    def reset(self) -> None:
+        self._i = 0
+        self.rng.shuffle(self._order)
+
+
+class DeepWalk:
+    """Reference: DeepWalk.Builder().vectorSize(d).windowSize(w)
+    .learningRate(lr).build(); initialize(graph); fit(iterator)."""
+
+    def __init__(self, vectorSize: int = 64, windowSize: int = 4,
+                 learningRate: float = 0.025, seed: int = 123,
+                 walksPerVertex: int = 10, walkLength: int = 20,
+                 negative: int = 5, batchSize: int = 1024):
+        self.vectorSize = vectorSize
+        self.windowSize = windowSize
+        self.learningRate = learningRate
+        self.seed = seed
+        self.walksPerVertex = walksPerVertex
+        self.walkLength = walkLength
+        self.negative = negative
+        self.batchSize = batchSize
+        self._trainer: Optional[_EmbeddingTrainer] = None
+        self._graph: Optional[Graph] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+
+            def setter(v):
+                self._kw[name] = v
+                return self
+
+            return setter
+
+        def build(self) -> "DeepWalk":
+            import inspect
+            known = set(inspect.signature(DeepWalk.__init__).parameters)
+            return DeepWalk(**{k: v for k, v in self._kw.items()
+                               if k in known})
+
+    @staticmethod
+    def builder() -> "DeepWalk.Builder":
+        return DeepWalk.Builder()
+
+    def initialize(self, graph: Graph) -> None:
+        self._graph = graph
+        self._trainer = _EmbeddingTrainer(graph.numVertices(),
+                                          self.vectorSize, self.seed,
+                                          self.learningRate, self.negative)
+
+    def fit(self, iterator: Optional[RandomWalkIterator] = None) -> None:
+        if self._trainer is None:
+            raise RuntimeError("call initialize(graph) first")
+        g = self._graph
+        rng = np.random.RandomState(self.seed)
+        pairs: List[Tuple[int, int]] = []
+        for rep in range(self.walksPerVertex):
+            it = iterator or RandomWalkIterator(g, self.walkLength,
+                                                seed=self.seed + rep)
+            it.reset()
+            while it.hasNext():
+                walk = it.next()
+                for i, v in enumerate(walk):
+                    lo = max(0, i - self.windowSize)
+                    hi = min(len(walk), i + self.windowSize + 1)
+                    for j in range(lo, hi):
+                        if j != i:
+                            pairs.append((v, walk[j]))
+        pairs_arr = np.asarray(pairs, dtype=np.int32)
+        rng.shuffle(pairs_arr)
+        n = g.numVertices()
+        steps = max(1, (len(pairs_arr) + self.batchSize - 1) // self.batchSize)
+        for si, i in enumerate(range(0, len(pairs_arr), self.batchSize)):
+            b = pairs_arr[i:i + self.batchSize]
+            negs = rng.randint(0, n, size=(len(b), self.negative)
+                               ).astype(np.int32)
+            # linear lr decay (reference: DeepWalk inherits word2vec decay);
+            # without it the sum-reduced SGD diverges on dense pair streams
+            lr = max(1e-4, self.learningRate * (1.0 - si / steps))
+            self._trainer.train_batch(b[:, 0], b[:, 1], negs, lr)
+
+    def _wordvectors(self) -> WordVectors:
+        """Vertex embeddings as a WordVectors over stringified vertex ids —
+        one canonical implementation of the similarity math."""
+        vocab = VocabCache()
+        for v in range(self._graph.numVertices()):
+            vocab.addToken(str(v))
+        return WordVectors(vocab, np.asarray(self._trainer.syn0))
+
+    def getVertexVector(self, v: int) -> np.ndarray:
+        return np.asarray(self._trainer.syn0[v])
+
+    def verticesNearest(self, v: int, n: int = 10) -> List[int]:
+        return [int(w) for w in self._wordvectors().wordsNearest(str(v), n)]
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._wordvectors().similarity(str(a), str(b))

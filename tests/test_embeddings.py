@@ -1,0 +1,185 @@
+"""Word2Vec / GloVe / ParagraphVectors / DeepWalk / VPTree tests.
+
+Reference analogues: deeplearning4j-nlp Word2VecTests (similarity structure
+after fit on tiny corpora), deeplearning4j-graph DeepWalkTest,
+nearestneighbors VPTreeTest.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, VPTree
+from deeplearning4j_tpu.graphs import DeepWalk, Graph
+from deeplearning4j_tpu.nlp import (Glove, ParagraphVectors, Word2Vec,
+                                    WordVectorSerializer)
+
+
+def _corpus():
+    # two topical clusters: fruit vs vehicles
+    fruit = ["apple banana fruit sweet juice",
+             "banana apple fruit tasty sweet",
+             "juice apple sweet banana fruit",
+             "fruit juice banana sweet apple"]
+    cars = ["car truck engine road wheel",
+            "truck car road engine fast",
+            "wheel engine car truck road",
+            "road wheel truck fast car"]
+    return (fruit + cars) * 12
+
+
+def test_word2vec_learns_topical_similarity():
+    w2v = (Word2Vec.builder().iterate(_corpus()).layerSize(32)
+           .minWordFrequency(1).windowSize(3).seed(7).epochs(10)
+           .learningRate(0.025).build())
+    w2v.fit()
+    assert w2v.hasWord("apple") and w2v.hasWord("car")
+    assert w2v.similarity("apple", "banana") > w2v.similarity("apple", "car")
+    near = w2v.wordsNearest("truck", 3)
+    assert "car" in near or "engine" in near or "road" in near
+
+
+def test_word2vec_cbow_mode_runs():
+    w2v = Word2Vec(sentences=_corpus(), layerSize=16, epochs=2, seed=1,
+                   useCBOW=True)
+    w2v.fit()
+    assert w2v.getWordVector("apple").shape == (16,)
+
+
+def test_word2vec_serializer_roundtrip(tmp_path):
+    w2v = Word2Vec(sentences=_corpus(), layerSize=8, epochs=1, seed=1).fit()
+    p = tmp_path / "vecs.txt"
+    WordVectorSerializer.writeWord2VecModel(w2v, str(p))
+    loaded = WordVectorSerializer.readWord2VecModel(str(p))
+    assert loaded.vocab.numWords() == w2v.vocab.numWords()
+    np.testing.assert_allclose(loaded.getWordVector("apple"),
+                               w2v.getWordVector("apple"), atol=1e-5)
+    assert abs(loaded.similarity("apple", "banana")
+               - w2v.similarity("apple", "banana")) < 1e-4
+
+
+def test_glove_learns_cooccurrence():
+    g = Glove(sentences=_corpus(), layerSize=16, epochs=30, seed=3,
+              windowSize=3)
+    g.fit()
+    assert g.similarity("apple", "banana") > g.similarity("apple", "truck")
+
+
+def test_paragraph_vectors_docs_cluster():
+    docs = _corpus()
+    pv = ParagraphVectors(documents=docs, layerSize=24, epochs=12, seed=5)
+    pv.fit()
+    v0 = pv.getVector("DOC_0")     # fruit doc
+    v1 = pv.getVector("DOC_1")     # fruit doc
+    v4 = pv.getVector("DOC_4")     # cars doc
+    cos = lambda a, b: float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos(v0, v1) > cos(v0, v4)
+
+
+def test_deepwalk_two_cliques():
+    # two 6-cliques joined by one bridge edge
+    g = Graph(12)
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                g.addEdge(base + i, base + j)
+    g.addEdge(0, 6)
+    dw = (DeepWalk.builder().vectorSize(16).windowSize(3)
+          .walksPerVertex(20).walkLength(12).seed(11).build())
+    dw.initialize(g)
+    dw.fit()
+    # same-clique similarity beats cross-clique
+    assert dw.similarity(1, 2) > dw.similarity(1, 8)
+    near = dw.verticesNearest(3, 4)
+    assert sum(1 for v in near if v < 6) >= 3
+
+
+def _brute_knn(X, q, k):
+    d = np.linalg.norm(X - q, axis=1)
+    order = np.argsort(d)[:k]
+    return list(order), list(d[order])
+
+
+def test_vptree_matches_brute_force():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 8)
+    tree = VPTree(X, "euclidean", leafSize=16, seed=1)
+    for _ in range(10):
+        q = rng.randn(8)
+        idx, dist = tree.search(q, 5)
+        bidx, bdist = _brute_knn(X, q, 5)
+        np.testing.assert_allclose(sorted(dist), sorted(bdist), rtol=1e-9)
+        assert set(idx) == set(bidx)
+
+
+def test_vptree_cosine_metric():
+    rng = np.random.RandomState(1)
+    X = rng.randn(200, 4)
+    tree = VPTree(X, "cosine", leafSize=8)
+    q = X[17] * 3.0                     # same direction, different norm
+    idx, dist = tree.search(q, 1)
+    assert idx[0] == 17 and dist[0] < 1e-9
+
+
+def test_kdtree_matches_brute_force():
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 5)
+    tree = KDTree(X, leafSize=8)
+    for _ in range(10):
+        q = rng.randn(5)
+        idx, dist = tree.knn(q, 4)
+        bidx, bdist = _brute_knn(X, q, 4)
+        np.testing.assert_allclose(dist, bdist, rtol=1e-9)
+        assert set(idx) == set(bidx)
+
+
+def test_kdtree_insert_then_query():
+    tree = KDTree(3)
+    rng = np.random.RandomState(3)
+    pts = rng.randn(50, 3)
+    for p in pts:
+        tree.insert(p)
+    assert tree.size() == 50
+    pt, d = tree.nn(pts[10] + 1e-9)
+    np.testing.assert_allclose(pt, pts[10], atol=1e-6)
+
+
+def test_paragraph_vectors_label_alignment_with_empty_doc():
+    # regression: an empty/blank document must keep its label row aligned
+    docs = ["apple banana fruit", "   ", "car truck road"]
+    pv = ParagraphVectors(documents=docs, labels=["A", "B", "C"],
+                          layerSize=8, epochs=2, seed=1)
+    pv.fit()
+    assert pv.getVector("A") is not None
+    assert pv.getVector("C") is not None
+    # C must be a trained vector (nonzero update from its words), B untrained
+    assert np.linalg.norm(pv.getVector("C")) > 0
+
+
+def test_serializer_reads_headerless_and_multispace(tmp_path):
+    p = tmp_path / "plain.txt"
+    p.write_text("alpha 1.0 2.0 3.0\nbeta  4.0  5.0 6.0\n")  # double spaces
+    wv = WordVectorSerializer.readWord2VecModel(str(p))
+    assert wv.vocab.numWords() == 2
+    np.testing.assert_allclose(wv.getWordVector("alpha"), [1, 2, 3])
+    np.testing.assert_allclose(wv.getWordVector("beta"), [4, 5, 6])
+
+
+def test_serializer_header_mismatch_raises(tmp_path):
+    p = tmp_path / "trunc.txt"
+    p.write_text("5 3\nalpha 1 2 3\n")
+    with pytest.raises(ValueError, match="promises 5"):
+        WordVectorSerializer.readWord2VecModel(str(p))
+
+
+def test_cbow_is_context_averaging():
+    # CBOW must learn too (true averaging objective, not swapped skip-gram)
+    w2v = Word2Vec(sentences=_corpus(), layerSize=24, epochs=10, seed=2,
+                   windowSize=3, useCBOW=True, learningRate=0.025)
+    w2v.fit()
+    assert w2v.similarity("apple", "banana") > w2v.similarity("apple", "car")
+
+
+def test_subsampling_drops_frequent_words_effectively():
+    w2v = Word2Vec(sentences=_corpus(), layerSize=8, epochs=1, seed=1,
+                   subsampling=1e-5)  # aggressive: nearly everything dropped
+    w2v.fit()  # must not crash with near-empty pair stream
+    assert w2v.vocab.numWords() > 0
