@@ -2,11 +2,18 @@
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh, P, shard_params  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode  # noqa: F401
 from deeplearning4j_tpu.parallel.sharedtraining import (  # noqa: F401
-    AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm, SharedTrainingMaster,
+    AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm,
+    ParameterAveragingTrainingMaster, SharedTrainingMaster,
     SparkDl4jMultiLayer, ThresholdAlgorithm, VoidConfiguration)
 from deeplearning4j_tpu.parallel.gradientsharing import (  # noqa: F401
     EncodedGradientsAccumulator, InProcessTransport, MeshOrganizer,
     ModelParameterServer, ResidualClippingPostProcessor)
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    PipelineStack, pipeline_apply)
+from deeplearning4j_tpu.parallel.moe import (  # noqa: F401
+    MoELayer, init_moe, moe_apply, moe_apply_expert_parallel)
+from deeplearning4j_tpu.parallel.zero import (  # noqa: F401
+    ZeroStage1, shard_optimizer_state)
 from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
     InferenceMode, ParallelInference)
 from deeplearning4j_tpu.parallel.ring import (  # noqa: F401

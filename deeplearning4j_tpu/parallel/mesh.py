@@ -30,22 +30,30 @@ __all__ = ["DeviceMesh", "P"]
 
 
 class DeviceMesh:
-    """An ND device mesh with named axes (data, model[, seq])."""
+    """An ND device mesh with named axes (data, model[, seq[, stage]]).
+
+    ``model`` doubles as the expert axis for MoE (EP); ``stage`` is the
+    pipeline axis (both NEW capabilities vs the reference — SURVEY.md §2.6
+    lists TP/PP/SP/EP as ABSENT there).
+    """
 
     def __init__(self, data: int = -1, model: int = 1, seq: int = 1,
-                 devices: Optional[Sequence] = None):
+                 stage: int = 1, devices: Optional[Sequence] = None):
         devices = list(devices if devices is not None else jax.devices())
         n = len(devices)
         if data == -1:
-            rest = model * seq
+            rest = model * seq * stage
             if n % rest:
-                raise ValueError(f"{n} devices not divisible by model*seq={rest}")
+                raise ValueError(
+                    f"{n} devices not divisible by model*seq*stage={rest}")
             data = n // rest
-        if data * model * seq != n:
-            raise ValueError(f"mesh {data}x{model}x{seq} != {n} devices")
-        arr = np.array(devices).reshape(data, model, seq)
-        self.mesh = Mesh(arr, axis_names=("data", "model", "seq"))
-        self.dataSize, self.modelSize, self.seqSize = data, model, seq
+        if data * model * seq * stage != n:
+            raise ValueError(
+                f"mesh {data}x{model}x{seq}x{stage} != {n} devices")
+        arr = np.array(devices).reshape(data, model, seq, stage)
+        self.mesh = Mesh(arr, axis_names=("data", "model", "seq", "stage"))
+        self.dataSize, self.modelSize = data, model
+        self.seqSize, self.stageSize = seq, stage
 
     # -- shardings ------------------------------------------------------
     def replicated(self) -> NamedSharding:
@@ -69,7 +77,8 @@ class DeviceMesh:
 
     def __repr__(self):
         return (f"DeviceMesh(data={self.dataSize}, model={self.modelSize}, "
-                f"seq={self.seqSize}, devices={self.numDevices()})")
+                f"seq={self.seqSize}, stage={self.stageSize}, "
+                f"devices={self.numDevices()})")
 
 
 def _dense_tp_spec(name: str, shape: Tuple[int, ...], modelAxis: str

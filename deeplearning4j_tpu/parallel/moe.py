@@ -1,0 +1,172 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+Reference: **ABSENT in the reference** (SURVEY.md §2.6 — no MoE/EP).  A NEW
+capability, built the TPU way:
+
+- :func:`moe_apply` — dense dispatch: top-k gating as one-hot einsums, all
+  experts evaluated as a single batched matmul (E folded into the
+  contraction).  Under ``pjit`` with the expert dim sharded over the
+  ``model`` axis, GSPMD partitions it automatically — this is the
+  recommended single-executable path.
+- :func:`moe_apply_expert_parallel` — explicit EP under ``shard_map``:
+  tokens route to their expert's device group with ``lax.all_to_all`` over
+  the expert axis (fixed capacity per expert, overflow dropped to the
+  residual path like Switch-Transformer), experts compute locally, results
+  return with the inverse all_to_all.  Use when the expert count is too
+  large for GSPMD's dense dispatch to keep weights resident.
+
+Auxiliary load-balancing loss follows Switch (mean fraction * mean prob).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["init_moe", "moe_apply", "moe_apply_expert_parallel",
+           "MoELayer"]
+
+
+def init_moe(key, n_experts: int, d_in: int, d_hidden: int, d_out: int,
+             dtype=jnp.float32):
+    """Params for E two-layer MLP experts + a router."""
+    kr, k1, k2 = jax.random.split(key, 3)
+    s1 = (2.0 / (d_in + d_hidden)) ** 0.5
+    s2 = (2.0 / (d_hidden + d_out)) ** 0.5
+    return {
+        "router": jax.random.normal(kr, (d_in, n_experts), dtype) * 0.02,
+        "W1": jax.random.normal(k1, (n_experts, d_in, d_hidden), dtype) * s1,
+        "b1": jnp.zeros((n_experts, d_hidden), dtype),
+        "W2": jax.random.normal(k2, (n_experts, d_hidden, d_out), dtype) * s2,
+        "b2": jnp.zeros((n_experts, d_out), dtype),
+    }
+
+
+def _gate(params, x, top_k: int):
+    logits = x @ params["router"]                    # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if top_k == 1:
+        idx = jnp.argmax(probs, axis=-1)             # (T,)
+        gates = jnp.max(probs, axis=-1, keepdims=True)
+        topi = idx[:, None]
+    else:
+        gates, topi = lax.top_k(probs, top_k)        # (T, k)
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    return probs, gates, topi
+
+
+def _aux_loss(probs, topi, n_experts: int):
+    """Switch load-balance loss: E * mean(frac_tokens_e) . mean(prob_e)."""
+    frac = jnp.mean(jax.nn.one_hot(topi[:, 0], n_experts), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(frac * mean_prob)
+
+
+def moe_apply(params, x, top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense-dispatch MoE: (T, d_in) -> ((T, d_out), aux_loss).
+
+    One-hot dispatch einsums — no gather/scatter, so GSPMD shards the E dim
+    of every tensor over the ``model`` axis without host logic.
+    """
+    E = params["router"].shape[1]
+    probs, gates, topi = _gate(params, x, top_k)
+    disp = jax.nn.one_hot(topi, E, dtype=x.dtype)      # (T, k, E)
+    comb = disp * gates[..., None]                     # (T, k, E)
+    xe = jnp.einsum("tke,td->etd", disp, x)            # route tokens in
+    h = jax.nn.relu(jnp.einsum("etd,edh->eth", xe, params["W1"])
+                    + params["b1"][:, None, :])
+    ye = jnp.einsum("eth,eho->eto", h, params["W2"]) + params["b2"][:, None, :]
+    y = jnp.einsum("tke,eto->to", comb, ye)            # weighted combine
+    return y, _aux_loss(probs, topi, E)
+
+
+def moe_apply_expert_parallel(mesh, params, x, capacity_factor: float = 1.25,
+                              axis_name: str = "model"
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 (Switch) MoE with explicit all_to_all expert dispatch.
+
+    Experts are sharded over ``axis_name`` (E divisible by its size); the
+    token batch is sharded over ``data``.  Per shard: route local tokens to
+    capacity slots per expert, all_to_all to expert owners, compute, inverse
+    all_to_all home.  Overflow tokens pass through (residual), as in Switch.
+    """
+    jmesh = getattr(mesh, "mesh", mesh)
+    ep = jmesh.shape[axis_name]
+    E = params["router"].shape[1]
+    if E % ep:
+        raise ValueError(f"{E} experts not divisible by axis size {ep}")
+
+    def local(params, x_loc):
+        T = x_loc.shape[0]
+        E_loc = E // ep
+        cap = max(1, int(capacity_factor * T / E))
+        probs, gates, topi = _gate(params, x_loc, 1)
+        eidx = topi[:, 0]                              # (T,)
+        # position of each token within its expert's capacity window
+        onehot = jax.nn.one_hot(eidx, E, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot      # 1-based slot
+        slot = (pos.sum(-1) - 1)                       # (T,)
+        keep = slot < cap
+        islot = jnp.clip(slot, 0, cap - 1)
+        # dispatch buffer (E, cap, d) -> (ep, E_loc, cap, d): piece p of dim
+        # 0 ships to device p of the expert axis
+        disp = jnp.zeros((E, cap, x_loc.shape[1]), x_loc.dtype)
+        disp = disp.at[eidx, islot].add(x_loc * keep[:, None])
+        disp = disp.reshape(ep, E_loc, cap, -1)
+        # leading-axis exchange (split=concat=0, its own transpose): after
+        # it, dim 0 indexes the SOURCE device, dim 1 the local expert
+        recv = lax.all_to_all(disp, axis_name, split_axis=0, concat_axis=0)
+        # expert weights arrive ALREADY sharded over the expert axis (the
+        # whole point of EP: each device holds only its E_loc experts)
+        h = jax.nn.relu(jnp.einsum("pecd,edh->pech", recv, params["W1"])
+                        + params["b1"][None, :, None, :])
+        ye = jnp.einsum("pech,eho->peco", h, params["W2"]) \
+            + params["b2"][None, :, None, :]
+        # inverse exchange brings each token's result home
+        back = lax.all_to_all(ye, axis_name, split_axis=0, concat_axis=0)
+        back = back.reshape(E, cap, -1)
+        y = back[eidx, islot]
+        y = jnp.where(keep[:, None], y * gates, x_loc)   # overflow: residual
+        aux = lax.pmean(_aux_loss(probs, topi, E), "data")
+        return y, aux
+
+    # router replicated (every token gates locally); expert tensors sharded
+    # on their leading E dim — each device materialises only E/ep experts
+    pspec = {k: (P() if k == "router" else P(axis_name))
+             for k in params}
+    # check_vma off: the pmean'd aux IS replicated, but the static checker
+    # can't prove it through the data-dependent dispatch
+    fn = jax.shard_map(local, mesh=jmesh,
+                       in_specs=(pspec, P("data")),
+                       out_specs=(P("data"), P()), check_vma=False)
+    return fn(params, x)
+
+
+class MoELayer:
+    """Object wrapper for config-style use; see moe_apply for semantics.
+
+    The Switch load-balancing loss from the last ``__call__`` is exposed as
+    ``auxLoss`` — ADD IT to the training loss (scaled ~0.01) or the router
+    collapses onto one expert.
+    """
+
+    def __init__(self, nIn: int, nOut: int, nExperts: int = 4,
+                 hiddenSize: Optional[int] = None, topK: int = 1,
+                 seed: int = 0):
+        self.nIn, self.nOut, self.nExperts = nIn, nOut, nExperts
+        self.hiddenSize = hiddenSize or 4 * nIn
+        self.topK = topK
+        self.params = init_moe(jax.random.PRNGKey(seed), nExperts, nIn,
+                               self.hiddenSize, nOut)
+        self.auxLoss = None
+
+    def apply(self, params, x):
+        """Pure form for jit/grad: returns (y, aux_loss)."""
+        return moe_apply(params, x, self.topK)
+
+    def __call__(self, x):
+        y, self.auxLoss = moe_apply(self.params, x, self.topK)
+        return y

@@ -47,21 +47,17 @@ from deeplearning4j_tpu.parallel.gradientsharing import (  # noqa: F401,E402
     ThresholdAlgorithm)
 
 
-class SharedTrainingMaster:
-    def __init__(self, voidConfiguration: Optional[VoidConfiguration] = None,
-                 batchSizePerWorker: int = 32,
-                 workersPerNode: int = -1,
-                 thresholdAlgorithm: Optional[ThresholdAlgorithm] = None,
-                 mesh: Optional[DeviceMesh] = None, **_ignored):
-        self.voidConfiguration = voidConfiguration or VoidConfiguration()
-        self.batchSizePerWorker = batchSizePerWorker
-        self.workersPerNode = workersPerNode
-        self.thresholdAlgorithm = thresholdAlgorithm  # recorded, unused
-        self.mesh = mesh
+class _TrainingMaster:
+    """Shared base: fluent builder + mesh-backed fit (both reference
+    masters collapse to the same synchronous ICI all-reduce here)."""
 
-    class Builder:
-        def __init__(self, voidConfiguration=None, rddDataSetNumExamples: int = 1):
-            self._kw = {"voidConfiguration": voidConfiguration}
+    _KNOWN: frozenset = frozenset()
+
+    class _FluentBuilder:
+        _cls = None
+
+        def __init__(self, **seed_kw):
+            self._kw = dict(seed_kw)
 
         def __getattr__(self, name):
             if name.startswith("_"):
@@ -73,11 +69,10 @@ class SharedTrainingMaster:
 
             return setter
 
-        def build(self) -> "SharedTrainingMaster":
-            known = {"voidConfiguration", "batchSizePerWorker",
-                     "workersPerNode", "thresholdAlgorithm", "mesh"}
-            kw = {k: v for k, v in self._kw.items() if k in known}
-            return SharedTrainingMaster(**kw)
+        def build(self):
+            kw = {k: v for k, v in self._kw.items()
+                  if k in type(self)._cls._KNOWN}
+            return type(self)._cls(**kw)
 
     # -- multi-host launcher --------------------------------------------
     @staticmethod
@@ -96,6 +91,61 @@ class SharedTrainingMaster:
         return net
 
     executeTraining = fitMultiLayerNetwork
+
+
+class SharedTrainingMaster(_TrainingMaster):
+    _KNOWN = frozenset({"voidConfiguration", "batchSizePerWorker",
+                        "workersPerNode", "thresholdAlgorithm", "mesh"})
+
+    def __init__(self, voidConfiguration: Optional[VoidConfiguration] = None,
+                 batchSizePerWorker: int = 32,
+                 workersPerNode: int = -1,
+                 thresholdAlgorithm: Optional[ThresholdAlgorithm] = None,
+                 mesh: Optional[DeviceMesh] = None, **_ignored):
+        self.voidConfiguration = voidConfiguration or VoidConfiguration()
+        self.batchSizePerWorker = batchSizePerWorker
+        self.workersPerNode = workersPerNode
+        self.thresholdAlgorithm = thresholdAlgorithm  # recorded, unused
+        self.mesh = mesh
+
+    class Builder(_TrainingMaster._FluentBuilder):
+        def __init__(self, voidConfiguration=None,
+                     rddDataSetNumExamples: int = 1):
+            super().__init__(voidConfiguration=voidConfiguration)
+
+
+SharedTrainingMaster.Builder._cls = SharedTrainingMaster
+
+
+class ParameterAveragingTrainingMaster(_TrainingMaster):
+    """Reference: dl4j-spark ``ParameterAveragingTrainingMaster.java`` —
+    synchronous cluster DP: local fit per worker, params averaged every
+    ``averagingFrequency`` iterations (SURVEY.md §2.6 P2).
+
+    TPU semantics: synchronous gradient all-reduce EVERY step (psum over
+    ICI inside the jitted step) — mathematically parameter averaging with
+    frequency 1, which converges at least as well; higher frequencies only
+    existed to amortize ethernet costs that ICI doesn't have.  Builder knobs
+    are accepted for parity; ``averagingFrequency`` is recorded, not used.
+    """
+
+    _KNOWN = frozenset({"batchSizePerWorker", "averagingFrequency",
+                        "workerPrefetchNumBatches", "mesh"})
+
+    def __init__(self, batchSizePerWorker: int = 32,
+                 averagingFrequency: int = 1, workerPrefetchNumBatches: int = 2,
+                 mesh: Optional[DeviceMesh] = None, **_ignored):
+        self.batchSizePerWorker = batchSizePerWorker
+        self.averagingFrequency = averagingFrequency
+        self.workerPrefetchNumBatches = workerPrefetchNumBatches
+        self.mesh = mesh
+
+    class Builder(_TrainingMaster._FluentBuilder):
+        def __init__(self, rddDataSetNumExamples: int = 1):
+            super().__init__()
+
+
+ParameterAveragingTrainingMaster.Builder._cls = ParameterAveragingTrainingMaster
 
 
 class SparkDl4jMultiLayer:

@@ -88,9 +88,19 @@ class ParallelWrapper:
             net.init()
         net.params_ = shard_params(self.mesh, net.params_,
                                    self.tensorParallel)
-        if net.optState_ is not None:
-            net.optState_ = jax.device_put(net.optState_, self.mesh.replicated()) \
-                if not self.tensorParallel else net.optState_
+        if net.optState_ is not None and not self.tensorParallel:
+            # replicate ONLY leaves not already placed across this mesh —
+            # a ZeRO-sharded optimizer state (zero.ZeroStage1) must keep its
+            # sharding or the memory saving silently evaporates
+            mesh_devices = set(self.mesh.mesh.devices.flat)
+
+            def place(leaf):
+                if hasattr(leaf, "sharding") and \
+                        set(leaf.sharding.device_set) == mesh_devices:
+                    return leaf
+                return jax.device_put(leaf, self.mesh.replicated())
+
+            net.optState_ = jax.tree.map(place, net.optState_)
         orig_fitBatch = net._fitBatch
 
         def shard_one(arr):
