@@ -1,0 +1,238 @@
+"""Hyperparameter search: parameter spaces, generators, runner.
+
+Reference: arbiter ``org/deeplearning4j/arbiter/optimize/api/
+ParameterSpace.java`` (Continuous/Discrete/Integer spaces),
+``generator/{GridSearchCandidateGenerator,RandomSearchGenerator}.java``,
+``OptimizationConfiguration`` + ``LocalOptimizationRunner`` with
+termination conditions and a score function.
+
+TPU-native note: candidates evaluate SEQUENTIALLY on the chip (each build
+compiles its own fused step; the XLA compile cache makes same-shape
+candidates cheap).  The reference's UI/persistence layers are out of scope;
+results carry (params, score, model) triples.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ------------------------------------------------------------- spaces ----
+
+class ParameterSpace:
+    def randomValue(self, rng) -> Any:
+        raise NotImplementedError
+
+    def gridValues(self, discretization: int) -> List:
+        raise NotImplementedError
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform (optionally log-uniform) float range."""
+
+    def __init__(self, minValue: float, maxValue: float, log: bool = False):
+        self.lo, self.hi, self.log = float(minValue), float(maxValue), log
+
+    def randomValue(self, rng):
+        if self.log:
+            return float(np.exp(rng.uniform(np.log(self.lo),
+                                            np.log(self.hi))))
+        return float(rng.uniform(self.lo, self.hi))
+
+    def gridValues(self, discretization: int):
+        if self.log:
+            return [float(v) for v in np.exp(np.linspace(
+                np.log(self.lo), np.log(self.hi), discretization))]
+        return [float(v) for v in np.linspace(self.lo, self.hi,
+                                              discretization)]
+
+
+class IntegerParameterSpace(ParameterSpace):
+    def __init__(self, minValue: int, maxValue: int):
+        self.lo, self.hi = int(minValue), int(maxValue)
+
+    def randomValue(self, rng):
+        return int(rng.randint(self.lo, self.hi + 1))
+
+    def gridValues(self, discretization: int):
+        vals = np.unique(np.linspace(self.lo, self.hi,
+                                     discretization).round().astype(int))
+        return [int(v) for v in vals]
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, *values):
+        self.values = list(values[0]) if len(values) == 1 and \
+            isinstance(values[0], (list, tuple)) else list(values)
+
+    def randomValue(self, rng):
+        return self.values[rng.randint(len(self.values))]
+
+    def gridValues(self, discretization: int):
+        return list(self.values)
+
+
+# ---------------------------------------------------------- generators ----
+
+class CandidateGenerator:
+    def __init__(self, spaces: Dict[str, ParameterSpace]):
+        self.spaces = spaces
+
+    def candidates(self):
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    """Reference: RandomSearchGenerator — endless random draws."""
+
+    def __init__(self, spaces: Dict[str, ParameterSpace], seed: int = 123):
+        super().__init__(spaces)
+        self.rng = np.random.RandomState(seed)
+
+    def candidates(self):
+        while True:
+            yield {k: s.randomValue(self.rng)
+                   for k, s in self.spaces.items()}
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    """Reference: GridSearchCandidateGenerator — cartesian product with a
+    per-continuous-space discretization count."""
+
+    def __init__(self, spaces: Dict[str, ParameterSpace],
+                 discretizationCount: int = 5):
+        super().__init__(spaces)
+        self.disc = discretizationCount
+
+    def candidates(self):
+        keys = list(self.spaces)
+        grids = [self.spaces[k].gridValues(self.disc) for k in keys]
+        for combo in itertools.product(*grids):
+            yield dict(zip(keys, combo))
+
+
+# ---------------------------------------------------------- termination ----
+
+class MaxCandidatesCondition:
+    def __init__(self, n: int):
+        self.n = n
+
+    def start(self):
+        self._count = 0
+
+    def terminate(self, result) -> bool:
+        self._count += 1
+        return self._count >= self.n
+
+
+class MaxTimeCondition:
+    def __init__(self, duration: float, unit: str = "seconds"):
+        mult = {"seconds": 1.0, "minutes": 60.0, "hours": 3600.0}[unit]
+        self.maxSeconds = duration * mult
+
+    def start(self):
+        self._t0 = time.time()
+
+    def terminate(self, result) -> bool:
+        return (time.time() - self._t0) >= self.maxSeconds
+
+
+# ------------------------------------------------------------- runner ----
+
+class OptimizationResult:
+    def __init__(self, parameters: Dict, score: float, model=None,
+                 index: int = 0):
+        self.parameters = parameters
+        self.score = score
+        self.model = model
+        self.index = index
+
+    def getScore(self) -> float:
+        return self.score
+
+    def __repr__(self):
+        return f"OptimizationResult(#{self.index} score={self.score:.5f} " \
+               f"params={self.parameters})"
+
+
+class OptimizationConfiguration:
+    """Builder parity with the reference: candidateGenerator + scoreFunction
+    (+ terminationConditions).  ``scoreFunction(candidate_params) ->
+    (score, model)`` or plain score; minimization by default."""
+
+    def __init__(self, candidateGenerator: CandidateGenerator,
+                 scoreFunction: Callable,
+                 terminationConditions: Optional[Sequence] = None,
+                 minimize: bool = True):
+        self.generator = candidateGenerator
+        self.scoreFunction = scoreFunction
+        self.terminationConditions = list(terminationConditions or
+                                          [MaxCandidatesCondition(10)])
+        self.minimize = minimize
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def candidateGenerator(self, g):
+            self._kw["candidateGenerator"] = g
+            return self
+
+        def scoreFunction(self, f):
+            self._kw["scoreFunction"] = f
+            return self
+
+        def terminationConditions(self, *conds):
+            self._kw["terminationConditions"] = list(conds)
+            return self
+
+        def minimize(self, b: bool):
+            self._kw["minimize"] = b
+            return self
+
+        def build(self) -> "OptimizationConfiguration":
+            return OptimizationConfiguration(**self._kw)
+
+    @staticmethod
+    def builder() -> "OptimizationConfiguration.Builder":
+        return OptimizationConfiguration.Builder()
+
+
+class LocalOptimizationRunner:
+    """Reference: LocalOptimizationRunner — evaluate candidates until a
+    termination condition fires; keeps every result + the best."""
+
+    def __init__(self, config: OptimizationConfiguration):
+        self.config = config
+        self.results: List[OptimizationResult] = []
+
+    def execute(self) -> OptimizationResult:
+        cfg = self.config
+        for c in cfg.terminationConditions:
+            c.start()
+        best: Optional[OptimizationResult] = None
+        for i, cand in enumerate(cfg.generator.candidates()):
+            out = cfg.scoreFunction(cand)
+            score, model = out if isinstance(out, tuple) else (out, None)
+            res = OptimizationResult(cand, float(score), model, i)
+            self.results.append(res)
+            better = best is None or (
+                res.score < best.score if cfg.minimize
+                else res.score > best.score)
+            if better:
+                best = res
+            if any(c.terminate(res) for c in cfg.terminationConditions):
+                break
+        return best
+
+    def bestScore(self) -> float:
+        best = min(self.results, key=lambda r: r.score) if \
+            self.config.minimize else max(self.results,
+                                          key=lambda r: r.score)
+        return best.score
+
+    def numCandidatesCompleted(self) -> int:
+        return len(self.results)

@@ -1,0 +1,9 @@
+"""Reinforcement learning (reference: rl4j — SURVEY.md §2.7)."""
+from deeplearning4j_tpu.rl.mdp import (  # noqa: F401
+    CartPole, ChainMDP, DiscreteSpace, MDP, ObservationSpace, StepReply)
+from deeplearning4j_tpu.rl.qlearning import (  # noqa: F401
+    DQNPolicy, EpsGreedy, ExpReplay, QLConfiguration,
+    QLearningDiscreteDense)
+from deeplearning4j_tpu.rl.policy import Policy, softmax_sample  # noqa: F401
+from deeplearning4j_tpu.rl.a3c import (  # noqa: F401
+    A3CConfiguration, A3CDiscreteDense, ACPolicy, ActorCriticSeparate)

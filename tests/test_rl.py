@@ -1,0 +1,69 @@
+"""RL tests (reference analogue: rl4j-core tests — QLearning convergence on
+toy MDPs, policy play)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (A3CConfiguration, A3CDiscreteDense,
+                                   CartPole, ChainMDP, EpsGreedy, ExpReplay,
+                                   QLConfiguration, QLearningDiscreteDense)
+
+
+def test_exp_replay_ring_and_sampling():
+    r = ExpReplay(maxSize=5, batchSize=3, seed=1)
+    for i in range(8):
+        r.store(i, 0, 0.0, i + 1, False)
+    assert len(r) == 5
+    batch = r.getBatch()
+    assert len(batch) == 3
+    assert all(b[0] >= 3 for b in batch)      # oldest evicted
+
+
+def test_eps_greedy_decays():
+    eg = EpsGreedy(minEpsilon=0.1, epsilonNbStep=100, seed=0)
+    assert eg.epsilon(0) == pytest.approx(1.0)
+    assert eg.epsilon(50) == pytest.approx(0.55)
+    assert eg.epsilon(1000) == pytest.approx(0.1)
+
+
+def test_cartpole_env_contract():
+    env = CartPole(seed=3)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0
+    while not env.isDone():
+        reply = env.step(env.getActionSpace().randomAction())
+        total += reply.getReward()
+    assert 1 <= total <= 200
+
+
+def test_dqn_solves_chain():
+    mdp = ChainMDP(n=5, maxSteps=20)
+    conf = QLConfiguration(seed=4, maxStep=2500, batchSize=32,
+                           targetDqnUpdateFreq=50, updateStart=50,
+                           epsilonNbStep=1200, gamma=0.95,
+                           expRepMaxSize=5000, maxEpochStep=20)
+    dqn = QLearningDiscreteDense(mdp, conf, hidden=(32,))
+    dqn.train()
+    policy = dqn.getPolicy()
+    reward = policy.play(ChainMDP(n=5, maxSteps=20))
+    assert reward == pytest.approx(10.0)      # greedy run straight to goal
+
+
+def test_dqn_double_vs_vanilla_runs():
+    for double in (True, False):
+        conf = QLConfiguration(seed=1, maxStep=200, updateStart=20,
+                               batchSize=16, doubleDQN=double,
+                               maxEpochStep=20)
+        dqn = QLearningDiscreteDense(ChainMDP(n=4), conf, hidden=(16,))
+        dqn.train()
+        assert dqn.stepCount >= 200
+
+
+def test_a2c_improves_on_chain():
+    mdp = ChainMDP(n=5, maxSteps=20)
+    conf = A3CConfiguration(seed=2, maxStep=6000, numThread=4, nstep=10,
+                            learningRate=5e-3, gamma=0.95, maxEpochStep=20)
+    a3c = A3CDiscreteDense(mdp, conf, hidden=(32,))
+    a3c.train()
+    reward = a3c.getPolicy(greedy=True).play(ChainMDP(n=5, maxSteps=20))
+    assert reward == pytest.approx(10.0)
