@@ -223,6 +223,9 @@ class ComputationGraph:
         if new_state:
             self.state_.update(new_state)
         self._score = float(loss)
+        # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
+        from deeplearning4j_tpu.profiler import check_panic
+        check_panic(self._score)
         self.iterationCount += 1
         for l in self._listeners:
             l.iterationDone(self, self.iterationCount, self.epochCount)

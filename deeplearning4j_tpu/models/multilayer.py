@@ -373,6 +373,9 @@ class MultiLayerNetwork:
         if new_state:
             self.state_.update(new_state)
         self._score = float(loss)
+        # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
+        from deeplearning4j_tpu.profiler import check_panic
+        check_panic(self._score)
         return new_carries
 
     def _fitTbptt(self, x, y, fmask, lmask) -> None:

@@ -1,0 +1,4 @@
+"""Remote inference serving (reference: deeplearning4j-remote —
+JsonModelServer / SameDiffJsonModelServer, SURVEY.md §2.5)."""
+from deeplearning4j_tpu.remote.server import (  # noqa: F401
+    JsonModelServer, JsonRemoteInference, SameDiffJsonModelServer)

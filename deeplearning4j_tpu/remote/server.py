@@ -1,0 +1,92 @@
+"""JSON-over-HTTP inference server + client.
+
+Reference: deeplearning4j-remote ``JsonModelServer`` (serve an MLN/CG/
+SameDiff model on a port; POST JSON features → JSON predictions) and the
+``JsonRemoteInference`` client (SURVEY.md §3.5).
+
+Serving goes through :class:`~deeplearning4j_tpu.parallel.inference.
+ParallelInference`-style batching only if the caller wraps the model; this
+server itself is intentionally thin — stdlib HTTP, one POST endpoint.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class JsonModelServer:
+    """POST /v1/serving -> {"output": [...]} (reference endpoint shape)."""
+
+    def __init__(self, model, port: int = 0, outputNames=None):
+        self.model = model
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> "JsonModelServer":
+        model = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    x = np.asarray(payload["features"], dtype=np.float32)
+                    out = model.model.output(x)
+                    if isinstance(out, list):
+                        body = {"outputs": [np.asarray(o).tolist()
+                                            for o in out]}
+                    else:
+                        body = {"output": np.asarray(out).tolist()}
+                    code = 200
+                except Exception as e:  # surface errors to the client
+                    body = {"error": f"{type(e).__name__}: {e}"}
+                    code = 400
+                data = json.dumps(body).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+SameDiffJsonModelServer = JsonModelServer
+
+
+class JsonRemoteInference:
+    """Client (reference: JsonRemoteInference.java)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 endpoint: str = "/v1/serving"):
+        self.url = f"http://{host}:{port}{endpoint}"
+
+    def predict(self, features) -> np.ndarray:
+        import urllib.request
+        data = json.dumps({"features": np.asarray(features).tolist()}
+                          ).encode("utf-8")
+        req = urllib.request.Request(
+            self.url, data=data, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        if "error" in body:
+            raise RuntimeError(body["error"])
+        key = "output" if "output" in body else "outputs"
+        return np.asarray(body[key])

@@ -1,0 +1,125 @@
+"""Training dashboard web server.
+
+Reference: deeplearning4j-vertx ``VertxUIServer`` / ``UIServer.getInstance``
+— overview page with the score chart at :9000 (SURVEY.md §5.5).
+
+Stdlib ``http.server`` on a daemon thread; the overview renders the score
+curve as inline SVG (no JS deps, zero-egress friendly), plus a JSON API
+(``/train/sessions``, ``/train/<session>/data``) for programmatic access.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _svg_score_chart(scores: List[float], w: int = 640, h: int = 240) -> str:
+    import math
+    scores = [s for s in scores if math.isfinite(s)]  # a NaN score (diverged
+    # run) must not blank the chart monitoring exists to show
+    if not scores:
+        return "<p>no data yet</p>"
+    lo, hi = min(scores), max(scores)
+    span = (hi - lo) or 1.0
+    pts = " ".join(
+        f"{i * (w - 20) / max(len(scores) - 1, 1) + 10:.1f},"
+        f"{h - 20 - (s - lo) / span * (h - 40):.1f}"
+        for i, s in enumerate(scores))
+    return (f'<svg width="{w}" height="{h}" style="background:#fafafa;'
+            f'border:1px solid #ccc">'
+            f'<polyline fill="none" stroke="#1f77b4" stroke-width="1.5" '
+            f'points="{pts}"/>'
+            f'<text x="10" y="14" font-size="11">max {hi:.5f}</text>'
+            f'<text x="10" y="{h - 6}" font-size="11">min {lo:.5f}</text>'
+            f'</svg>')
+
+
+class UIServer:
+    """Reference: UIServer.getInstance().attach(statsStorage)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def getInstance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._storages.append(storage)
+        if self._httpd is None:
+            self._start()
+
+    def _sessions(self):
+        out = {}
+        for st in self._storages:
+            for sid in st.listSessionIDs():
+                out[sid] = st
+        return out
+
+    def _start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, body: str, ctype: str = "text/html"):
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                sessions = server._sessions()
+                if self.path == "/train/sessions":
+                    self._send(json.dumps(list(sessions)),
+                               "application/json")
+                    return
+                if self.path.startswith("/train/") and \
+                        self.path.endswith("/data"):
+                    sid = self.path.split("/")[2]
+                    st = sessions.get(sid)
+                    self._send(json.dumps(st.getUpdates(sid) if st else []),
+                               "application/json")
+                    return
+                # overview page
+                parts = ["<html><head><title>DL4J-TPU Training UI</title>"
+                         "</head><body><h2>Training overview</h2>"]
+                for sid, st in sessions.items():
+                    ups = st.getUpdates(sid)
+                    scores = [u["score"] for u in ups if "score" in u]
+                    last = ups[-1] if ups else {}
+                    parts.append(
+                        f"<h3>{sid}</h3>"
+                        f"<p>iterations: {len(ups)}; last score: "
+                        f"{last.get('score', float('nan')):.5f}; "
+                        f"it/s: {last.get('iterationsPerSecond', 0):.2f}</p>"
+                        + _svg_score_chart(scores))
+                parts.append("</body></html>")
+                self._send("".join(parts))
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]   # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        UIServer._instance = None

@@ -1,0 +1,125 @@
+"""Training stats collection + storage.
+
+Reference: deeplearning4j-ui-model ``org/deeplearning4j/ui/model/stats/
+StatsListener.java`` (per-iteration score, param/update histograms+norms,
+memory/GC) → ``StatsStorage`` SPI (``InMemoryStatsStorage``,
+``FileStatsStorage`` MapDB) consumed by the Vert.x server (SURVEY.md §5.5).
+
+TPU-native notes: param/update norms are computed DEVICE-side in one jitted
+reduction per iteration (not per-tensor host pulls); FileStatsStorage is
+append-only JSONL instead of MapDB — readable by anything.
+"""
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class StatsStorage:
+    """SPI: putUpdate / getAllSessions / getUpdates."""
+
+    def putUpdate(self, sessionId: str, update: dict) -> None:
+        raise NotImplementedError
+
+    def listSessionIDs(self) -> List[str]:
+        raise NotImplementedError
+
+    def getUpdates(self, sessionId: str) -> List[dict]:
+        raise NotImplementedError
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._data: Dict[str, List[dict]] = defaultdict(list)
+
+    def putUpdate(self, sessionId, update):
+        self._data[sessionId].append(update)
+
+    def listSessionIDs(self):
+        return list(self._data)
+
+    def getUpdates(self, sessionId):
+        return list(self._data[sessionId])
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL per session (reference: FileStatsStorage/MapDB)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cache: Dict[str, List[dict]] = defaultdict(list)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    rec = json.loads(line)
+                    self._cache[rec["session"]].append(rec)
+        except FileNotFoundError:
+            pass
+
+    def putUpdate(self, sessionId, update):
+        rec = dict(update, session=sessionId)
+        self._cache[sessionId].append(rec)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def listSessionIDs(self):
+        return list(self._cache)
+
+    def getUpdates(self, sessionId):
+        return list(self._cache[sessionId])
+
+
+class StatsListener(TrainingListener):
+    """Per-iteration stats → storage (reference: StatsListener.java)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 sessionId: Optional[str] = None):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.sessionId = sessionId or f"session_{int(time.time())}"
+        self._last_time = None
+
+    def _norms(self, model) -> Dict[str, float]:
+        """ALL norms in one jitted reduction → ONE host pull (per-leaf
+        float() syncs would add a device round trip per tensor per
+        iteration)."""
+        import jax
+        import jax.numpy as jnp
+        params = getattr(model, "params_", None) or {}
+        if not params:
+            return {}
+        if not hasattr(self, "_norm_fn"):
+            self._norm_fn = jax.jit(lambda tree: jax.tree.map(
+                lambda leaf: jnp.linalg.norm(leaf.ravel()), tree))
+        norm_tree = jax.device_get(self._norm_fn(params))
+        out = {}
+        for li, lp in norm_tree.items():
+            for path, leaf in jax.tree_util.tree_flatten_with_path(lp)[0]:
+                name = "_".join(str(getattr(k, "key", k)) for k in path)
+                out[f"{li}.{name}"] = float(leaf)
+        return out
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        now = time.time()
+        update = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "timestamp": now,
+            "score": float(model.score()),
+            "batchSize": getattr(model, "lastBatchSize", 0),
+            "paramNorms": self._norms(model),
+        }
+        if self._last_time is not None:
+            dt = now - self._last_time
+            if dt > 0:
+                update["iterationsPerSecond"] = 1.0 / dt
+        self._last_time = now
+        self.storage.putUpdate(self.sessionId, update)

@@ -1,0 +1,159 @@
+"""Profiler / stats UI / remote serving tests (reference analogues: nd4j
+OpProfiler tests, deeplearning4j-vertx server smoke tests, remote
+JsonModelServer tests)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.profiler import (OpProfiler, ProfilerConfig,
+                                         ProfilingListener)
+from deeplearning4j_tpu.remote import JsonModelServer, JsonRemoteInference
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, UIServer)
+
+
+def _net(lr=1e-2):
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(lr)).list()
+            .layer(DenseLayer.builder().nIn(4).nOut(8).activation("relu")
+                   .build())
+            .layer(OutputLayer.builder("mcxent").nIn(8).nOut(2)
+                   .activation("softmax").build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    cls = rng.randint(0, 2, n)
+    return DataSet((rng.randn(n, 4) + 2 * cls[:, None]).astype(np.float32),
+                   np.eye(2, dtype=np.float32)[cls])
+
+
+# ------------------------------------------------------------- profiler ----
+
+def test_profiler_phases_and_dashboard():
+    prof = OpProfiler()
+    with prof.phase("etl"):
+        sum(range(1000))
+    with prof.phase("train_step"):
+        sum(range(1000))
+    with prof.phase("train_step"):
+        sum(range(1000))
+    assert prof.invocations("train_step") == 2
+    assert prof.timeSpent("train_step") > 0
+    board = prof.printOutDashboard()
+    assert "train_step" in board
+
+
+def test_chrome_trace_format(tmp_path):
+    prof = OpProfiler()
+    with prof.phase("step"):
+        pass
+    out = tmp_path / "trace.json"
+    prof.writeChromeTrace(str(out))
+    trace = json.loads(out.read_text())
+    ev = trace["traceEvents"][0]
+    assert ev["ph"] == "X" and "ts" in ev and "dur" in ev
+
+
+def test_nan_panic_raises_during_fit():
+    prof = OpProfiler.getInstance()
+    try:
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Sgd(1e12)).list()   # raw-SGD blowup -> NaN/Inf
+                .layer(DenseLayer.builder().nIn(4).nOut(8)
+                       .activation("relu").build())
+                .layer(OutputLayer.builder("mse").nIn(8).nOut(2)
+                       .activation("identity").build())   # MSE overflows
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        prof.setConfig(ProfilerConfig(checkForNAN=True, checkForINF=True))
+        with pytest.raises(FloatingPointError, match="NAN_PANIC|INF_PANIC"):
+            for _ in range(20):
+                net.fit(_data())
+    finally:
+        prof.setConfig(ProfilerConfig())   # panic off for other tests
+
+
+def test_profiling_listener_writes_trace(tmp_path):
+    out = tmp_path / "iters.json"
+    net = _net()
+    net.setListeners(ProfilingListener(str(out)))
+    net.fit(ListDataSetIterator([_data()], batch=32), epochs=2)
+    trace = json.loads(out.read_text())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert any(n.startswith("iteration_") for n in names)
+
+
+# ------------------------------------------------------------ stats/UI ----
+
+def test_stats_listener_and_storages(tmp_path):
+    mem = InMemoryStatsStorage()
+    net = _net()
+    net.setListeners(StatsListener(mem, sessionId="s1"))
+    net.fit(ListDataSetIterator([_data()], batch=32), epochs=3)
+    ups = mem.getUpdates("s1")
+    assert len(ups) == 6                      # 2 batches x 3 epochs
+    assert ups[0]["score"] > ups[-1]["score"]
+    assert any(k.endswith("W") for k in ups[0]["paramNorms"])
+
+    f = tmp_path / "stats.jsonl"
+    fs = FileStatsStorage(str(f))
+    for u in ups:
+        fs.putUpdate("s1", u)
+    # re-open: persisted
+    fs2 = FileStatsStorage(str(f))
+    assert len(fs2.getUpdates("s1")) == 6
+
+
+def test_ui_server_serves_overview_and_json():
+    storage = InMemoryStatsStorage()
+    storage.putUpdate("sess", {"iteration": 1, "score": 0.5})
+    storage.putUpdate("sess", {"iteration": 2, "score": 0.4})
+    server = UIServer(port=0)
+    server.attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        html = urllib.request.urlopen(base, timeout=10).read().decode()
+        assert "sess" in html and "<svg" in html
+        sessions = json.loads(urllib.request.urlopen(
+            base + "/train/sessions", timeout=10).read())
+        assert sessions == ["sess"]
+        data = json.loads(urllib.request.urlopen(
+            base + "/train/sess/data", timeout=10).read())
+        assert [d["score"] for d in data] == [0.5, 0.4]
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------- remote ----
+
+def test_json_model_server_roundtrip():
+    net = _net()
+    ds = _data()
+    net.fit(ListDataSetIterator([ds], batch=32), epochs=5)
+    server = JsonModelServer(net, port=0).start()
+    try:
+        client = JsonRemoteInference(port=server.port)
+        x = ds.features.numpy()[:4]
+        remote = client.predict(x)
+        local = np.asarray(net.output(x))
+        np.testing.assert_allclose(remote, local, rtol=1e-5, atol=1e-6)
+        # malformed payload -> structured HTTP 400, not a hang
+        import urllib.error
+        import urllib.request as u
+        req = u.Request(client.url, data=b'{"bogus": 1}',
+                        headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            u.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert "error" in json.loads(ei.value.read())
+    finally:
+        server.stop()
