@@ -19,6 +19,7 @@ reference, §3.3) becomes structured ``lax.cond``/``lax.while_loop`` ops.
 """
 from __future__ import annotations
 
+import functools
 import io
 import json
 import zipfile
@@ -143,8 +144,9 @@ _axis_op("mean", jnp.mean)
 _axis_op("reduce_max", jnp.max)
 _axis_op("reduce_min", jnp.min)
 _axis_op("prod", jnp.prod)
-_axis_op("std", jnp.std)
-_axis_op("variance", jnp.var)
+# Nd4j std/variance default to biasCorrected=true (ddof=1), unlike numpy
+_axis_op("std", functools.partial(jnp.std, ddof=1))
+_axis_op("variance", functools.partial(jnp.var, ddof=1))
 _axis_op("any", jnp.any)
 _axis_op("all", jnp.all)
 _axis_op("countNonZero", lambda x, axis, keepdims: jnp.sum(
@@ -616,6 +618,46 @@ def _runiform(shape=(), seed=0, minVal=0.0, maxVal=1.0, **_):
 def _rbern(shape=(), seed=0, p=0.5, **_):
     return lambda: jax.random.bernoulli(
         jax.random.PRNGKey(seed), p, tuple(shape)).astype(jnp.float32)
+
+
+# control flow (reference: TF-style Enter/Exit/Switch/Merge interpreted in
+# AbstractSession — here lax regions compiled INTO the executable) ----------
+@register_op("while_loop")
+def _while_impl(cond_fn=None, body_fn=None, n=1, **_):
+    def fn(*args):
+        def c(carry):
+            return cond_fn(*carry)[0].astype(bool).reshape(())
+
+        def b(carry):
+            return tuple(body_fn(*carry))
+
+        out = lax.while_loop(c, b, tuple(args))
+        return out if n > 1 else out[0]
+
+    return fn
+
+
+@register_op("if_cond")
+def _if_impl(cond_fn=None, true_fn=None, false_fn=None, n_out=1, **_):
+    def fn(*args):
+        pred = cond_fn(*args)[0].astype(bool).reshape(())
+        out = lax.cond(pred, lambda a: tuple(true_fn(*a)),
+                       lambda a: tuple(false_fn(*a)), tuple(args))
+        return out if n_out > 1 else out[0]
+
+    return fn
+
+
+@register_op("for_loop")
+def _for_impl(body_fn=None, n=1, iterations=1, **_):
+    def fn(*args):
+        def step(carry, _):
+            return tuple(body_fn(*carry)), None
+
+        out, _ = lax.scan(step, tuple(args), None, length=iterations)
+        return out if n > 1 else out[0]
+
+    return fn
 
 
 # ---------------------------------------------------------------------------
@@ -1181,6 +1223,136 @@ class SameDiff:
         self._train_step = None
         return outs[0] if n_out == 1 else outs
 
+    # ---------------- control flow ----------------
+    def _stage_subgraph(self, n_in: int, build):
+        """Build a sub-SameDiff from a user lambda and stage it to a pure
+        function [args] -> [outs].  This is the TPU lowering of the
+        reference's TF-style control-flow machinery: where AbstractSession
+        interprets Enter/Exit/Switch/Merge/NextIteration frames op-by-op IN
+        JAVA (SURVEY §3.3), the subgraph here compiles INTO the parent's
+        XLA executable as a lax control-flow region."""
+        sub = SameDiff()
+        phs = [sub.placeholder(f"sub_in_{i}") for i in range(n_in)]
+        outs = build(sub, phs)
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        out_names = tuple(o.name() for o in outs)
+        subfn = sub._build_fn(out_names)
+        var_vals = sub._var_values()
+
+        def staged(*args):
+            res = subfn({f"sub_in_{i}": a for i, a in enumerate(args)},
+                        var_vals, 0)
+            return [res[n] for n in out_names]
+
+        return staged, len(out_names)
+
+    def whileLoop(self, loopVars: Sequence[SDVariable], cond, body,
+                  name: str = None):
+        """TF-style while loop (reference: SameDiff.whileLoop with
+        Enter/Exit/Switch/Merge lowering — here a single
+        ``lax.while_loop``).
+
+        ``cond(sd, vars) -> scalar-bool SDVariable``;
+        ``body(sd, vars) -> list of SDVariable`` (same arity as loopVars).
+        Forward-only: XLA's while is not reverse-differentiable — matching
+        the reference, whose imported TF loops don't train either.  Returns
+        the final loop variables.
+        """
+        n = len(loopVars)
+        cond_fn, n_c = self._stage_subgraph(n, cond)
+        if n_c != 1:
+            raise ValueError("cond must return exactly one scalar")
+        body_fn, n_b = self._stage_subgraph(n, body)
+        if n_b != n:
+            raise ValueError(f"body returns {n_b} vars, expected {n}")
+        out = self._op("while_loop", list(loopVars),
+                       {"cond_fn": cond_fn, "body_fn": body_fn, "n": n},
+                       n_out=n, name=name or "while")
+        return out if isinstance(out, list) else [out]
+
+    def ifCond(self, inputs: Sequence[SDVariable], cond, trueBody, falseBody,
+               name: str = None):
+        """TF-style conditional (reference: SameDiff.ifCond / Switch+Merge —
+        here one ``lax.cond``, differentiable).  cond/trueBody/falseBody are
+        ``f(sd, vars)`` lambdas; the two branches must return the same
+        number (and shapes) of outputs."""
+        n = len(inputs)
+        cond_fn, n_c = self._stage_subgraph(n, cond)
+        if n_c != 1:
+            raise ValueError("cond must return exactly one scalar")
+        t_fn, n_t = self._stage_subgraph(n, trueBody)
+        f_fn, n_f = self._stage_subgraph(n, falseBody)
+        if n_t != n_f:
+            raise ValueError(f"branches return {n_t} vs {n_f} outputs")
+        out = self._op("if_cond", list(inputs),
+                       {"cond_fn": cond_fn, "true_fn": t_fn,
+                        "false_fn": f_fn, "n_out": n_t},
+                       n_out=n_t, name=name or "cond")
+        return out if isinstance(out, list) else [out]
+
+    def forLoop(self, nIterations: int, loopVars: Sequence[SDVariable], body,
+                name: str = None):
+        """Fixed-trip-count loop via ``lax.scan`` — DIFFERENTIABLE (the
+        TPU-native recurrence primitive; use instead of whileLoop when the
+        trip count is static and gradients must flow)."""
+        n = len(loopVars)
+        body_fn, n_b = self._stage_subgraph(n, body)
+        if n_b != n:
+            raise ValueError(f"body returns {n_b} vars, expected {n}")
+        out = self._op("for_loop", list(loopVars),
+                       {"body_fn": body_fn, "n": n,
+                        "iterations": int(nIterations)},
+                       n_out=n, name=name or "for")
+        return out if isinstance(out, list) else [out]
+
+    # ---------------- shape / array ops (reference: SDBaseOps on the
+    # SameDiff class itself — sd.concat/gather/tile/...) ----------------
+    def concat(self, dimension: int, *vars, name=None):
+        return self._op("concat", list(vars), {"dimension": dimension},
+                        name=name)
+
+    def stack(self, axis: int, *vars, name=None):
+        return self._op("stack", list(vars), {"axis": axis}, name=name)
+
+    def unstack(self, var, axis: int, num: int, name=None):
+        return self._op("unstack", [var], {"axis": axis, "num": num},
+                        n_out=num, name=name)
+
+    def gather(self, x, indices, axis=0, name=None):
+        ix = indices if isinstance(indices, SDVariable) \
+            else self.constant(np.asarray(indices))
+        return self._op("gather", [x, ix], {"axis": axis}, name=name)
+
+    def tile(self, x, reps, name=None):
+        return self._op("tile", [x], {"reps": tuple(reps)}, name=name)
+
+    def reverse(self, x, *dims, name=None):
+        return self._op("reverse", [x], {"dims": dims or (0,)}, name=name)
+
+    def slice(self, x, begin, size, name=None):
+        return self._op("slice", [x], {"begin": tuple(begin),
+                                       "size": tuple(size)}, name=name)
+
+    def stridedSlice(self, x, begin, end, strides=None, name=None):
+        return self._op("stridedSlice", [x],
+                        {"begin": tuple(begin), "end": tuple(end),
+                         "strides": tuple(strides) if strides else None},
+                        name=name)
+
+    def oneHot(self, indices, depth, on=1.0, off=0.0, axis=-1, name=None):
+        return self._op("oneHot", [indices],
+                        {"depth": depth, "on": on, "off": off, "axis": axis},
+                        name=name)
+
+    def where(self, cond, x, y, name=None):
+        return self._op("where", [cond, x, y], name=name)
+
+    def zerosLike(self, x, name=None):
+        return self._op("zerosLike", [x], name=name)
+
+    def onesLike(self, x, name=None):
+        return self._op("onesLike", [x], name=name)
+
     def invokeGraphOn(self, other: "SameDiff"):
         """Copy this graph's structure into ``other`` (used by subgraphs)."""
         for n, v in self._vars.items():
@@ -1414,6 +1586,12 @@ class SameDiff:
         """Zip with graph.json + npz arrays (reference: SameDiff.save →
         FlatBuffers, libnd4j graph/scheme/*.fbs; same content, JSON+npz
         container)."""
+        for n in self._ops:
+            if any(callable(a) for a in n.attrs.values()):
+                raise ValueError(
+                    f"cannot serialize op '{n.name}' ({n.op}): staged "
+                    "control-flow subgraphs are compile-time closures — "
+                    "rebuild the graph from code after load instead")
         graph = {
             "variables": [
                 {"name": v.name(), "type": v.variableType,

@@ -31,6 +31,7 @@ from deeplearning4j_tpu.models.multilayer import (_get_leaf, _grad_normalize,
 from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import Layer
 from deeplearning4j_tpu.ops import NDArray
+from deeplearning4j_tpu.profiler import check_panic
 
 
 class ComputationGraph:
@@ -224,7 +225,6 @@ class ComputationGraph:
             self.state_.update(new_state)
         self._score = float(loss)
         # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
-        from deeplearning4j_tpu.profiler import check_panic
         check_panic(self._score)
         self.iterationCount += 1
         for l in self._listeners:

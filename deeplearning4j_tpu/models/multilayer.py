@@ -29,6 +29,7 @@ from deeplearning4j_tpu.learning.regularization import WeightDecay
 from deeplearning4j_tpu.nn.conf import (GradientNormalization,
                                         MultiLayerConfiguration)
 from deeplearning4j_tpu.ops import NDArray
+from deeplearning4j_tpu.profiler import check_panic
 
 Params = Dict[str, Dict[str, jax.Array]]
 
@@ -374,7 +375,6 @@ class MultiLayerNetwork:
             self.state_.update(new_state)
         self._score = float(loss)
         # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
-        from deeplearning4j_tpu.profiler import check_panic
         check_panic(self._score)
         return new_carries
 

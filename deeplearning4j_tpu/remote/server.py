@@ -24,7 +24,25 @@ class JsonModelServer:
     def __init__(self, model, port: int = 0, outputNames=None):
         self.model = model
         self.port = port
+        # restrict ComputationGraph responses to these named outputs
+        self.outputNames = list(outputNames) if outputNames else None
         self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def _run(self, x: np.ndarray) -> dict:
+        out = self.model.output(x)
+        if isinstance(out, list):
+            names = list(getattr(self.model.conf, "outputs", None) or
+                         range(len(out)))
+            sel = {str(n): np.asarray(o).tolist()
+                   for n, o in zip(names, out)}
+            if self.outputNames is not None:
+                missing = [n for n in self.outputNames if n not in sel]
+                if missing:
+                    raise KeyError(f"unknown output(s) {missing}; "
+                                   f"model outputs: {list(sel)}")
+                sel = {n: sel[n] for n in self.outputNames}
+            return {"outputs": sel}
+        return {"output": np.asarray(out).tolist()}
 
     def start(self) -> "JsonModelServer":
         model = self
@@ -34,20 +52,20 @@ class JsonModelServer:
                 pass
 
             def do_POST(self):
+                # payload faults are the CLIENT's (400); model-execution
+                # faults are OURS (500) — retry/alerting logic keys on this
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     x = np.asarray(payload["features"], dtype=np.float32)
-                    out = model.model.output(x)
-                    if isinstance(out, list):
-                        body = {"outputs": [np.asarray(o).tolist()
-                                            for o in out]}
-                    else:
-                        body = {"output": np.asarray(out).tolist()}
-                    code = 200
-                except Exception as e:  # surface errors to the client
-                    body = {"error": f"{type(e).__name__}: {e}"}
-                    code = 400
+                except Exception as e:
+                    body, code = {"error": f"{type(e).__name__}: {e}"}, 400
+                else:
+                    try:
+                        body, code = model._run(x), 200
+                    except Exception as e:
+                        body = {"error": f"{type(e).__name__}: {e}"}
+                        code = 500
                 data = json.dumps(body).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")

@@ -10,11 +10,24 @@ curve as inline SVG (no JS deps, zero-egress friendly), plus a JSON API
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from deeplearning4j_tpu.ui.stats import StatsStorage
+
+
+def _json_safe(obj):
+    """NaN/Inf → null: Python's json emits bare NaN tokens (invalid JSON)
+    that break strict parsers exactly when a run diverges."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
 
 
 def _svg_score_chart(scores: List[float], w: int = 640, h: int = 240) -> str:
@@ -92,8 +105,9 @@ class UIServer:
                         self.path.endswith("/data"):
                     sid = self.path.split("/")[2]
                     st = sessions.get(sid)
-                    self._send(json.dumps(st.getUpdates(sid) if st else []),
-                               "application/json")
+                    self._send(json.dumps(
+                        _json_safe(st.getUpdates(sid) if st else []),
+                        allow_nan=False), "application/json")
                     return
                 # overview page
                 parts = ["<html><head><title>DL4J-TPU Training UI</title>"

@@ -120,6 +120,7 @@ class StatsListener(TrainingListener):
         if self._last_time is not None:
             dt = now - self._last_time
             if dt > 0:
-                update["iterationsPerSecond"] = 1.0 / dt
+                # dt spans `frequency` iterations between recorded updates
+                update["iterationsPerSecond"] = self.frequency / dt
         self._last_time = now
         self.storage.putUpdate(self.sessionId, update)
