@@ -1,0 +1,89 @@
+"""Runtime environment + flag registry.
+
+Reference: nd4j-common ``org/nd4j/config/{ND4JSystemProperties,
+ND4JEnvironmentVars}.java`` and ``org/nd4j/linalg/factory/Environment.java``
+mirroring libnd4j ``sd::Environment`` (debug/verbose/maxThreads/precision —
+SURVEY.md §5.6).
+
+TPU-native mapping: the native-side knobs steer the C++ host runtime
+(:mod:`deeplearning4j_tpu.native` thread pool) and JAX/XLA flags instead of
+libnd4j; workspace modes are accepted-but-ignored (XLA owns buffers —
+SURVEY.md §7.1).  Access via ``Nd4j.getEnvironment()``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ND4JEnvironmentVars:
+    """Reference: ND4JEnvironmentVars.java — env-var name registry."""
+    ND4J_DATA_DIR = "DL4J_TPU_DATA_DIR"
+    OMP_NUM_THREADS = "OMP_NUM_THREADS"
+    ND4J_DEBUG = "DL4J_TPU_DEBUG"
+    ND4J_VERBOSE = "DL4J_TPU_VERBOSE"
+    DISABLE_NATIVE = "DL4J_TPU_DISABLE_NATIVE"
+
+
+class ND4JSystemProperties:
+    """Reference: ND4JSystemProperties.java (JVM -D flags; here env too)."""
+    DATA_DIR = ND4JEnvironmentVars.ND4J_DATA_DIR
+    LOG_INITIALIZATION = "DL4J_TPU_LOG_INIT"
+
+
+class Environment:
+    """Reference: Nd4j.getEnvironment() — runtime flag mirror."""
+
+    _instance: Optional["Environment"] = None
+
+    def __init__(self):
+        self._debug = bool(os.environ.get(ND4JEnvironmentVars.ND4J_DEBUG))
+        self._verbose = bool(os.environ.get(ND4JEnvironmentVars.ND4J_VERBOSE))
+        self._allowHelpers = True
+
+    @classmethod
+    def getInstance(cls) -> "Environment":
+        if cls._instance is None:
+            cls._instance = Environment()
+        return cls._instance
+
+    # -- debug/verbose ---------------------------------------------------
+    def isDebug(self) -> bool:
+        return self._debug
+
+    def isVerbose(self) -> bool:
+        return self._verbose
+
+    def setDebug(self, b: bool) -> None:
+        self._debug = bool(b)
+
+    def setVerbose(self, b: bool) -> None:
+        self._verbose = bool(b)
+
+    # -- threading (steers the C++ host runtime) -------------------------
+    def maxThreads(self) -> int:
+        from deeplearning4j_tpu import native
+        return native.num_threads()
+
+    def setMaxThreads(self, n: int) -> None:
+        from deeplearning4j_tpu import native
+        native.set_num_threads(int(n))
+
+    # -- device info -----------------------------------------------------
+    def isCPU(self) -> bool:
+        import jax
+        return jax.devices()[0].platform == "cpu"
+
+    def blasMajorVersion(self) -> int:
+        return 0    # BLAS is XLA's concern on TPU
+
+    # -- precision -------------------------------------------------------
+    def allowsPrecisionDowncast(self) -> bool:
+        return True   # bf16 mixed precision via .dataType("BFLOAT16")
+
+    def allowHelpers(self, b: Optional[bool] = None):
+        """Reference: cuDNN/oneDNN helper toggle — here gates nothing (XLA
+        owns fusion) but the knob is preserved."""
+        if b is not None:
+            self._allowHelpers = bool(b)
+        return self._allowHelpers

@@ -7,3 +7,5 @@ from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize)
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.characters import CharacterIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator)

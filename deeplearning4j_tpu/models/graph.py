@@ -47,6 +47,9 @@ class ComputationGraph:
         self._listeners: List = []
         self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
         self._dtype = jnp.float32
+        dt = str(conf.globalConf.get("dataType") or "FLOAT").upper()
+        self._computeDtype = jnp.bfloat16 \
+            if dt in ("BFLOAT16", "HALF", "FLOAT16") else jnp.float32
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x6EED)
         self._lossNodes = [n for n in conf.outputs
                            if isinstance(conf.nodes[n][0], Layer)
@@ -136,8 +139,23 @@ class ComputationGraph:
                                                            acts[name], mask))
         return total
 
+    def _cast_compute(self, tree):
+        """f32 -> compute dtype (mixed precision; see MultiLayerNetwork)."""
+        if self._computeDtype == jnp.float32:
+            return tree
+        cd = self._computeDtype
+        return jax.tree.map(
+            lambda a: a.astype(cd) if hasattr(a, "dtype")
+            and a.dtype == jnp.float32 else a, tree)
+
     def _lossFn(self, params, state, inputs, labels, masks, key):
-        acts, new_state = self._forward(params, state, inputs, True, key)
+        # state stays f32 (see MultiLayerNetwork._lossFn note)
+        acts, new_state = self._forward(
+            self._cast_compute(params), state,
+            self._cast_compute(inputs), True, key)
+        if self._computeDtype != jnp.float32:   # losses evaluate in f32
+            acts = {n: (a.astype(jnp.float32) if hasattr(a, "astype") else a)
+                    for n, a in acts.items()}
         total = self._sumLosses(acts, labels, masks)
         reg = _reg_penalty((self.conf.nodes[name][0], lp)
                            for name, lp in params.items())
@@ -180,7 +198,12 @@ class ComputationGraph:
     @functools.cached_property
     def _outputFn(self):
         def run(params, state, inputs):
-            acts, _ = self._forward(params, state, inputs, False, None)
+            acts, _ = self._forward(
+                self._cast_compute(params), state,
+                self._cast_compute(inputs), False, None)
+            if self._computeDtype != jnp.float32:
+                return tuple(acts[n].astype(jnp.float32)
+                             for n in self.conf.outputs)
             return tuple(acts[n] for n in self.conf.outputs)
         return jax.jit(run)
 
@@ -244,7 +267,13 @@ class ComputationGraph:
     @functools.cached_property
     def _scoreFn(self):
         def run(params, state, inputs, labels, masks):
-            acts, _ = self._forward(params, state, inputs, False, None)
+            acts, _ = self._forward(
+                self._cast_compute(params), state,
+                self._cast_compute(inputs), False, None)
+            if self._computeDtype != jnp.float32:
+                acts = {n: (a.astype(jnp.float32)
+                            if hasattr(a, "astype") else a)
+                        for n, a in acts.items()}
             return self._sumLosses(acts, labels, masks) + _reg_penalty(
                 (self.conf.nodes[n][0], lp) for n, lp in params.items())
         return jax.jit(run)

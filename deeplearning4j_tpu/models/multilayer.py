@@ -140,8 +140,23 @@ class MultiLayerNetwork:
         self._listeners: List = []
         self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
         self._dtype = jnp.float32
+        # Mixed precision (reference: .dataType(DataType.HALF/BFLOAT16) in
+        # the config builder): compute in bf16 on the MXU, keep f32 master
+        # params/opt-state/BN-statistics — grads flow through the casts.
+        dt = str(conf.globalConf.get("dataType") or "FLOAT").upper()
+        self._computeDtype = jnp.bfloat16 \
+            if dt in ("BFLOAT16", "HALF", "FLOAT16") else jnp.float32
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x5EED)
         self._rnnCarries = None  # rnnTimeStep stateMap (per RNN layer idx)
+
+    def _cast_compute(self, tree):
+        """f32 leaves -> compute dtype (no-op at full precision)."""
+        if self._computeDtype == jnp.float32:
+            return tree
+        cd = self._computeDtype
+        return jax.tree.map(
+            lambda a: a.astype(cd) if hasattr(a, "dtype")
+            and a.dtype == jnp.float32 else a, tree)
 
     # ------------------------------------------------------------------
     # initialization
@@ -249,11 +264,18 @@ class MultiLayerNetwork:
 
     def _lossFn(self, params: Params, state, x, y, fmask, lmask, key,
                 carries=None):
-        out, new_state, new_carries = self._forward(params, state, x, True,
-                                                    key, fmask, carries)
+        # state stays f32: BatchNormalization accumulates its EMA in the
+        # state dtype and casts only the normalization arithmetic (see
+        # BatchNormalization.forward) — casting here would quantize masters
+        out, new_state, new_carries = self._forward(
+            self._cast_compute(params), state,
+            self._cast_compute(x), True, key, fmask,
+            self._cast_compute(carries))
         outLayer = self.conf.layers[-1]
         if not outLayer.hasLoss():
             raise ValueError("Last layer must be an output/loss layer to fit")
+        if self._computeDtype != jnp.float32:
+            out = out.astype(jnp.float32)   # loss in f32 under bf16 compute
         per_ex = outLayer.computeScore(y, out, lmask)
         data_loss = jnp.mean(per_ex)
         return (data_loss + self._regScore(params),
@@ -305,15 +327,23 @@ class MultiLayerNetwork:
     @functools.cached_property
     def _outputFn(self):
         def run(params, state, x, fmask, carries):
-            out, _, new_carries = self._forward(params, state, x, False,
-                                                None, fmask, carries)
+            out, _, new_carries = self._forward(
+                self._cast_compute(params), state,
+                self._cast_compute(x), False, None, fmask,
+                self._cast_compute(carries))
+            if self._computeDtype != jnp.float32:
+                out = out.astype(jnp.float32)
             return out, new_carries
         return jax.jit(run)
 
     @functools.cached_property
     def _scoreFn(self):
         def run(params, state, x, y, fmask, lmask):
-            out, _, _ = self._forward(params, state, x, False, None, fmask)
+            out, _, _ = self._forward(
+                self._cast_compute(params), state,
+                self._cast_compute(x), False, None, fmask)
+            if self._computeDtype != jnp.float32:
+                out = out.astype(jnp.float32)
             per_ex = self.conf.layers[-1].computeScore(y, out, lmask)
             return jnp.mean(per_ex) + self._regScore(params)
         return jax.jit(run)

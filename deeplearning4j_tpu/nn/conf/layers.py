@@ -398,16 +398,25 @@ class BatchNormalization(BaseLayer):
         cnn = x.ndim == 4
         axes = (0, 2, 3) if cnn else (0,)
         shape = (1, -1, 1, 1) if cnn else (1, -1)
+        # Mixed-precision contract: the EMA accumulates in the STATE's dtype
+        # (f32 master — repeated bf16 round-trips would quantize the running
+        # stats), while the normalization arithmetic runs in x's compute
+        # dtype so a bf16 forward stays bf16 end to end.
+        sdt = state["mean"].dtype
         if train:
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
             new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1 - self.decay) * var}
+                "mean": self.decay * state["mean"]
+                + (1 - self.decay) * mean.astype(sdt),
+                "var": self.decay * state["var"]
+                + (1 - self.decay) * var.astype(sdt)}
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = state["mean"].astype(x.dtype), \
+                state["var"].astype(x.dtype)
             new_state = state
         xh = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self.eps)
+        xh = xh.astype(x.dtype)
         if not self.lockGammaBeta:
             xh = xh * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
         act = get_activation(self.activation or "identity")

@@ -39,6 +39,12 @@ def _dt(dtype) -> DataType:
 class Nd4j:
     """Static tensor factory + op facade (``org.nd4j.linalg.factory.Nd4j``)."""
 
+    @staticmethod
+    def getEnvironment():
+        """Runtime flag mirror (reference: Nd4j.getEnvironment())."""
+        from deeplearning4j_tpu.config import Environment
+        return Environment.getInstance()
+
     # ---------------- creation ----------------
     @staticmethod
     def create(data=None, shape=None, dtype=None) -> NDArray:

@@ -45,6 +45,15 @@ class JsonModelServer:
         return {"output": np.asarray(out).tolist()}
 
     def start(self) -> "JsonModelServer":
+        # fail fast on static misconfiguration — a bad outputNames list is
+        # not a per-request 500, it's a server-construction error
+        if self.outputNames is not None:
+            known = getattr(self.model.conf, "outputs", None)
+            if known is not None:
+                missing = [n for n in self.outputNames if n not in known]
+                if missing:
+                    raise ValueError(f"unknown output(s) {missing}; model "
+                                     f"outputs: {list(known)}")
         model = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -96,7 +105,9 @@ class JsonRemoteInference:
                  endpoint: str = "/v1/serving"):
         self.url = f"http://{host}:{port}{endpoint}"
 
-    def predict(self, features) -> np.ndarray:
+    def predict(self, features):
+        """Single-output models return an ndarray; multi-output graphs a
+        {name: ndarray} dict (mirroring the server's response shape)."""
         import urllib.request
         data = json.dumps({"features": np.asarray(features).tolist()}
                           ).encode("utf-8")
@@ -106,5 +117,6 @@ class JsonRemoteInference:
             body = json.loads(resp.read())
         if "error" in body:
             raise RuntimeError(body["error"])
-        key = "output" if "output" in body else "outputs"
-        return np.asarray(body[key])
+        if "output" in body:
+            return np.asarray(body["output"])
+        return {n: np.asarray(v) for n, v in body["outputs"].items()}

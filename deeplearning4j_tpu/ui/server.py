@@ -31,7 +31,6 @@ def _json_safe(obj):
 
 
 def _svg_score_chart(scores: List[float], w: int = 640, h: int = 240) -> str:
-    import math
     scores = [s for s in scores if math.isfinite(s)]  # a NaN score (diverged
     # run) must not blank the chart monitoring exists to show
     if not scores:

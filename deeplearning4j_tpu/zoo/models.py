@@ -31,6 +31,7 @@ class ZooModel:
     numClasses: int = 1000
     seed: int = 123
     inputShape: Tuple[int, int, int] = (3, 224, 224)  # (c, h, w)
+    dataType: str = "FLOAT"   # "BFLOAT16" = mixed precision on the MXU
 
     @classmethod
     def builder(cls, **kw):
@@ -187,6 +188,7 @@ class ResNet50(ZooModel):
     def graphBuilder(self):
         gb = (NeuralNetConfiguration.builder().seed(self.seed)
               .updater(Nesterovs(1e-1, momentum=0.9)).weightInit("RELU")
+              .dataType(self.dataType)
               .graphBuilder())
         c, h, w = self.inputShape
         gb.addInputs("input").setInputTypes(self._it())

@@ -1,0 +1,61 @@
+"""Environment registry + dataset fetchers tests."""
+import numpy as np
+
+from deeplearning4j_tpu.config import Environment, ND4JEnvironmentVars
+from deeplearning4j_tpu.datasets import (Cifar10DataSetIterator,
+                                         EmnistDataSetIterator,
+                                         IrisDataSetIterator)
+from deeplearning4j_tpu.ops import Nd4j
+
+
+def test_environment_registry():
+    env = Nd4j.getEnvironment()
+    assert env is Environment.getInstance()
+    env.setDebug(True)
+    assert env.isDebug()
+    env.setDebug(False)
+    assert env.maxThreads() >= 1
+    assert isinstance(env.isCPU(), bool)
+    assert env.allowsPrecisionDowncast()
+    assert ND4JEnvironmentVars.ND4J_DATA_DIR == "DL4J_TPU_DATA_DIR"
+
+
+def test_cifar_iterator_shapes():
+    it = Cifar10DataSetIterator(32, train=True, numExamples=128)
+    ds = it.next()
+    assert ds.features.shape == (32, 3, 32, 32)
+    assert ds.labels.shape == (32, 10)
+    n = 32
+    while it.hasNext():
+        n += it.next().numExamples()
+    assert n == 128
+    it.reset()
+    assert it.hasNext()
+
+
+def test_emnist_iterator_letters():
+    it = EmnistDataSetIterator("LETTERS", 64, numExamples=256)
+    ds = it.next()
+    assert ds.features.shape == (64, 784)
+    assert ds.labels.shape == (64, 26)
+    assert it.totalOutcomes() == 26
+
+
+def test_iris_trains_to_high_accuracy():
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    it = IrisDataSetIterator(batch=50, numExamples=150)
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-2))
+            .list()
+            .layer(DenseLayer.builder().nIn(4).nOut(16).activation("tanh")
+                   .build())
+            .layer(OutputLayer.builder("mcxent").nIn(16).nOut(3)
+                   .activation("softmax").build())
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=60)
+    it.reset()
+    assert net.evaluate(it).accuracy() > 0.93

@@ -157,3 +157,32 @@ def test_json_model_server_roundtrip():
         assert "error" in json.loads(ei.value.read())
     finally:
         server.stop()
+
+
+def test_json_server_multi_output_graph_and_validation():
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    gb = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+          .graphBuilder())
+    gb.addInputs("in")
+    gb.addLayer("fc", DenseLayer.builder().nIn(4).nOut(8)
+                .activation("relu").build(), "in")
+    gb.addLayer("outA", OutputLayer.builder("mcxent").nIn(8).nOut(2)
+                .activation("softmax").build(), "fc")
+    gb.addLayer("outB", OutputLayer.builder("mse").nIn(8).nOut(3)
+                .activation("identity").build(), "fc")
+    gb.setOutputs("outA", "outB")
+    g = ComputationGraph(gb.build())
+    g.init()
+
+    with pytest.raises(ValueError, match="unknown output"):
+        JsonModelServer(g, port=0, outputNames=["typo"]).start()
+
+    server = JsonModelServer(g, port=0, outputNames=["outB"]).start()
+    try:
+        client = JsonRemoteInference(port=server.port)
+        out = client.predict(np.zeros((2, 4), dtype=np.float32))
+        assert isinstance(out, dict) and set(out) == {"outB"}
+        assert out["outB"].shape == (2, 3)
+    finally:
+        server.stop()
