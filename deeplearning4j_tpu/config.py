@@ -37,8 +37,13 @@ class Environment:
     _instance: Optional["Environment"] = None
 
     def __init__(self):
-        self._debug = bool(os.environ.get(ND4JEnvironmentVars.ND4J_DEBUG))
-        self._verbose = bool(os.environ.get(ND4JEnvironmentVars.ND4J_VERBOSE))
+        def env_flag(name: str) -> bool:
+            # "0"/"false"/"" must DISABLE — bool(raw string) would not
+            return os.environ.get(name, "").strip().lower() \
+                not in ("", "0", "false", "no", "off")
+
+        self._debug = env_flag(ND4JEnvironmentVars.ND4J_DEBUG)
+        self._verbose = env_flag(ND4JEnvironmentVars.ND4J_VERBOSE)
         self._allowHelpers = True
 
     @classmethod

@@ -95,12 +95,15 @@ class Cifar10DataSetIterator(_ArrayIterator):
             if train else [base / "test_batch.bin"]
         if not all(f.exists() for f in files):
             return None
-        xs, ys = [], []
+        xs, ys, have = [], [], 0
         for f in files:
             raw = np.frombuffer(f.read_bytes(), dtype=np.uint8)
             rec = raw.reshape(-1, 3073)
             ys.append(rec[:, 0])
             xs.append(rec[:, 1:].reshape(-1, 3, 32, 32))
+            have += len(rec)
+            if have >= n:       # don't materialize all 50k for a tiny ask
+                break
         x = np.concatenate(xs)[:n].astype(np.float32)
         y = np.concatenate(ys)[:n].astype(np.int64)
         return x, y
@@ -137,7 +140,9 @@ class EmnistDataSetIterator(_ArrayIterator):
         from deeplearning4j_tpu.datasets.mnist import _read_idx
         x = _read_idx(imgs)[:n].reshape(-1, 28 * 28).astype(np.float32) / 255.0
         y = _read_idx(labs)[:n].astype(np.int64)
-        y = y - y.min()   # EMNIST letters are 1-based
+        if name == "LETTERS":
+            y = y - 1   # the LETTERS split is 1-based BY SPEC; rebasing on
+            # the observed min would make the mapping subset-dependent
         return x, y
 
 

@@ -6,3 +6,5 @@ Reference: nd4j samediff-import (Kotlin rule-based framework; legacy facade
 """
 from deeplearning4j_tpu.imports.tf_import import TFGraphMapper  # noqa: F401
 from deeplearning4j_tpu.imports.keras_import import KerasModelImport  # noqa: F401
+from deeplearning4j_tpu.imports.onnx_import import (  # noqa: F401
+    OnnxImporter, importOnnxModel)

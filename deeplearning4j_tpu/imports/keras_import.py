@@ -291,6 +291,110 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
                                          nOut=int(cfg["output_dim"]))
             our_layers.append((lay, kname, "embedding"))
             continue
+        if cls == "UpSampling2D":
+            from deeplearning4j_tpu.nn.conf.convolutional import Upsampling2D
+            sz = cfg.get("size", [2, 2])
+            lay = Upsampling2D(size=tuple(int(x) for x in sz))
+            our_layers.append((lay, None, "upsample"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
+            continue
+        if cls == "ZeroPadding2D":
+            from deeplearning4j_tpu.nn.conf.convolutional import \
+                ZeroPaddingLayer
+            p = cfg.get("padding", [[1, 1], [1, 1]])
+            if isinstance(p, int):
+                pad = (p, p, p, p)
+            elif isinstance(p[0], (list, tuple)):
+                pad = (int(p[0][0]), int(p[0][1]), int(p[1][0]), int(p[1][1]))
+            else:
+                pad = (int(p[0]), int(p[0]), int(p[1]), int(p[1]))
+            lay = ZeroPaddingLayer(padding=pad)
+            our_layers.append((lay, None, "zeropad"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
+            continue
+        if cls == "Cropping2D":
+            from deeplearning4j_tpu.nn.conf.convolutional import Cropping2D
+            p = cfg.get("cropping", [[0, 0], [0, 0]])
+            if isinstance(p[0], (list, tuple)):
+                crop = (int(p[0][0]), int(p[0][1]), int(p[1][0]),
+                        int(p[1][1]))
+            else:
+                crop = (int(p[0]), int(p[0]), int(p[1]), int(p[1]))
+            lay = Cropping2D(cropping=crop)
+            our_layers.append((lay, None, "crop"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
+            continue
+        if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+            from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+            lay = GlobalPoolingLayer(
+                poolingType="AVG" if "Average" in cls else "MAX")
+            our_layers.append((lay, None, "globalpool"))
+            cur_conv_shape = None
+            continue
+        if cls in ("SeparableConv2D", "DepthwiseConv2D"):
+            from deeplearning4j_tpu.nn.conf.convolutional import (
+                DepthwiseConvolution2D, SeparableConvolution2D)
+            k = cfg.get("kernel_size", [3, 3])
+            s = cfg.get("strides", [1, 1])
+            same = cfg.get("padding", "valid") == "same"
+            dm = int(cfg.get("depth_multiplier", 1))
+            common = dict(kernelSize=tuple(int(x) for x in k),
+                          stride=tuple(int(x) for x in s),
+                          depthMultiplier=dm,
+                          convolutionMode="Same" if same else "Truncate",
+                          activation=_act(cfg.get("activation")),
+                          hasBias=bool(cfg.get("use_bias", True)))
+            if cls == "SeparableConv2D":
+                lay = SeparableConvolution2D(nOut=int(cfg["filters"]),
+                                             **common)
+                out_c = int(cfg["filters"])
+            else:
+                lay = DepthwiseConvolution2D(**common)
+                out_c = (cur_conv_shape[2] * dm) if cur_conv_shape else None
+            our_layers.append((lay, kname, "sepconv"
+                               if cls == "SeparableConv2D" else "dwconv"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay, out_c)
+            continue
+        if cls == "Conv2DTranspose":
+            from deeplearning4j_tpu.nn.conf.convolutional import \
+                Deconvolution2D
+            k = cfg.get("kernel_size", [2, 2])
+            s = cfg.get("strides", [2, 2])
+            same = cfg.get("padding", "valid") == "same"
+            lay = Deconvolution2D(
+                nOut=int(cfg["filters"]),
+                kernelSize=tuple(int(x) for x in k),
+                stride=tuple(int(x) for x in s),
+                convolutionMode="Same" if same else "Truncate",
+                activation=_act(cfg.get("activation")),
+                hasBias=bool(cfg.get("use_bias", True)))
+            our_layers.append((lay, kname, "deconv"))
+            cur_conv_shape = _track_shape(cur_conv_shape, lay,
+                                          int(cfg["filters"]))
+            continue
+        if cls == "SimpleRNN":
+            from deeplearning4j_tpu.nn.conf.recurrent import (LastTimeStep,
+                                                              SimpleRnn)
+            rnn = SimpleRnn(nOut=int(cfg["units"]),
+                            activation=_act(cfg.get("activation", "tanh")))
+            lay = rnn if cfg.get("return_sequences", False) \
+                else LastTimeStep(rnn)
+            our_layers.append((lay, kname, "simplernn"))
+            continue
+        if cls == "GRU":
+            if cfg.get("reset_after", True):
+                raise ValueError(
+                    "Keras import: GRU with reset_after=True has different "
+                    "candidate-gate semantics; re-save with "
+                    "GRU(..., reset_after=False) for exact import")
+            from deeplearning4j_tpu.nn.conf.recurrent import (GRU as OurGRU,
+                                                              LastTimeStep)
+            gru = OurGRU(nOut=int(cfg["units"]),
+                         activation=_act(cfg.get("activation", "tanh")))
+            lay = gru if cfg.get("return_sequences", False) \
+                else LastTimeStep(gru)
+            our_layers.append((lay, kname, "gru"))
+            continue
         raise ValueError(f"Keras import: unsupported layer {cls}")
 
     for lay, _k, _kind in our_layers:
@@ -350,4 +454,39 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
                 net.params_[li]["b"] = jnp.asarray(reorder(bias))
         elif kind == "embedding":
             net.params_[li]["W"] = jnp.asarray(ws[0])
+        elif kind in ("sepconv", "dwconv"):
+            # depthwise kernel (kh, kw, in, dm) -> (in*dm, 1, kh, kw)
+            dk = ws[0]
+            kh, kw, cin, dm = dk.shape
+            net.params_[li]["W"] = jnp.asarray(
+                dk.transpose(2, 3, 0, 1).reshape(cin * dm, 1, kh, kw))
+            rest = 1
+            if kind == "sepconv":
+                # pointwise (1, 1, in*dm, out) -> (out, in*dm, 1, 1)
+                net.params_[li]["pW"] = jnp.asarray(
+                    ws[1].transpose(3, 2, 0, 1))
+                rest = 2
+            if len(ws) > rest and "b" in net.params_[li]:
+                net.params_[li]["b"] = jnp.asarray(ws[rest])
+        elif kind == "deconv":
+            # Keras kernel (kh, kw, out, in) -> ours (out, in, kh, kw)
+            net.params_[li]["W"] = jnp.asarray(ws[0].transpose(2, 3, 0, 1))
+            if len(ws) > 1 and "b" in net.params_[li]:
+                net.params_[li]["b"] = jnp.asarray(ws[1])
+        elif kind == "simplernn":
+            net.params_[li]["W"] = jnp.asarray(ws[0])
+            net.params_[li]["RW"] = jnp.asarray(ws[1])
+            if len(ws) > 2:
+                net.params_[li]["b"] = jnp.asarray(ws[2])
+        elif kind == "gru":
+            # Keras gate order (z, r, h) -> ours (r, u=z, c=h)
+            u = ws[1].shape[0]
+            def gru_reorder(m):
+                z_, r_, h_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
+                              m[..., 2*u:3*u])
+                return np.concatenate([r_, z_, h_], axis=-1)
+            net.params_[li]["W"] = jnp.asarray(gru_reorder(ws[0]))
+            net.params_[li]["RW"] = jnp.asarray(gru_reorder(ws[1]))
+            if len(ws) > 2:
+                net.params_[li]["b"] = jnp.asarray(gru_reorder(ws[2]))
     return net

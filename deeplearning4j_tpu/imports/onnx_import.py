@@ -1,0 +1,437 @@
+"""ONNX import → SameDiff.
+
+Reference: nd4j samediff-import-onnx (Kotlin rule-based importer,
+``OnnxImporter`` / ``OpMappingRegistry`` — SURVEY.md §2.3): protobuf op
+defs + declarative per-op mapping rules emitting SameDiff ops.
+
+This environment has no ``onnx`` package, so the ModelProto is decoded with
+a minimal protobuf WIRE-FORMAT reader (varint/length-delimited framing is a
+stable public spec, as are ONNX's field numbers) — no generated code, no new
+dependencies.  Scope: the inference op set torch.onnx exports for MLP/CNN
+classifiers (Gemm/MatMul/Conv/pools/BN/activations/shape ops); the op table
+extends the same way the reference's rule registry does.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+__all__ = ["OnnxImporter", "importOnnxModel"]
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire decoder
+# ---------------------------------------------------------------------------
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message buffer."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fnum, wt = key >> 3, key & 7
+        if wt == 0:                      # varint
+            val, i = _varint(buf, i)
+        elif wt == 1:                    # 64-bit
+            val = buf[i:i + 8]
+            i += 8
+        elif wt == 2:                    # length-delimited
+            ln, i = _varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wt == 5:                    # 32-bit
+            val = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fnum, wt, val
+
+
+def _collect(buf: bytes) -> Dict[int, List]:
+    out: Dict[int, List] = {}
+    for fnum, _wt, val in _fields(buf):
+        out.setdefault(fnum, []).append(val)
+    return out
+
+
+# ONNX dtypes (TensorProto.DataType)
+_DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+           11: np.float64, 10: np.float16}
+
+
+def _unpack_varints(vals) -> List[int]:
+    """Repeated-int field values: proto3 serializers emit PACKED blobs (one
+    length-delimited bytes value), hand encoders may emit unpacked ints —
+    accept both."""
+    out: List[int] = []
+    for v in vals:
+        if isinstance(v, bytes):
+            j = 0
+            while j < len(v):
+                x, j = _varint(v, j)
+                out.append(x)
+        else:
+            out.append(v)
+    return out
+
+
+def _tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    f = _collect(buf)
+    dims = _unpack_varints(f.get(1, []))
+    dtype = _DTYPES.get(f.get(2, [1])[0], np.float32)
+    name = f.get(8, [b""])[0].decode()
+    if 9 in f:                                        # raw_data
+        arr = np.frombuffer(f[9][0], dtype=dtype)
+    elif 4 in f:                                      # float_data (packed?)
+        vals = []
+        for v in f[4]:
+            if isinstance(v, bytes):                  # packed
+                vals.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            else:
+                vals.append(v)
+        arr = np.asarray(vals, dtype=np.float32)
+    elif 7 in f:                                      # int64_data
+        arr = np.asarray(_unpack_varints(f[7]), dtype=np.int64)
+    else:
+        arr = np.zeros(0, dtype=dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _attr(buf: bytes) -> Tuple[str, Any]:
+    f = _collect(buf)
+    name = f.get(1, [b""])[0].decode()
+    if 2 in f:                                        # f (float, fixed32)
+        return name, struct.unpack("<f", f[2][0])[0]
+    if 3 in f:                                        # i
+        v = f[3][0]
+        return name, v - (1 << 64) if v >= (1 << 63) else v
+    if 4 in f:                                        # s
+        return name, f[4][0].decode()
+    if 5 in f:                                        # t (tensor)
+        return name, _tensor(f[5][0])[1]
+    if 8 in f:                                        # ints (maybe packed)
+        return name, _unpack_varints(f[8])
+    if 7 in f:                                        # floats
+        vals = []
+        for v in f[7]:
+            if isinstance(v, bytes):
+                vals.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            else:
+                vals.append(struct.unpack("<f", v)[0])
+        return name, vals
+    return name, None
+
+
+def _value_info_shape(buf: bytes) -> Tuple[str, Optional[List[int]]]:
+    f = _collect(buf)
+    name = f.get(1, [b""])[0].decode()
+    shape = None
+    if 2 in f:                                        # TypeProto
+        tp = _collect(f[2][0])
+        if 1 in tp:                                   # tensor_type
+            tt = _collect(tp[1][0])
+            if 2 in tt:                               # shape
+                dims = []
+                for d in _collect(tt[2][0]).get(1, []):
+                    dd = _collect(d)
+                    dims.append(int(dd[1][0]) if 1 in dd else -1)
+                shape = dims
+    return name, shape
+
+
+class _Node:
+    def __init__(self, buf: bytes):
+        f = _collect(buf)
+        self.inputs = [v.decode() for v in f.get(1, [])]
+        self.outputs = [v.decode() for v in f.get(2, [])]
+        self.name = f.get(3, [b""])[0].decode()
+        self.op_type = f.get(4, [b""])[0].decode()
+        self.attrs = dict(_attr(a) for a in f.get(5, []))
+
+
+def _parse_model(data: bytes):
+    model = _collect(data)
+    graph = _collect(model[7][0])                     # ModelProto.graph
+    nodes = [_Node(b) for b in graph.get(1, [])]
+    inits = dict(_tensor(b) for b in graph.get(5, []))
+    inputs = [_value_info_shape(b) for b in graph.get(11, [])]
+    outputs = [_value_info_shape(b) for b in graph.get(12, [])]
+    return nodes, inits, inputs, outputs
+
+
+# ---------------------------------------------------------------------------
+# op mapping rules (reference: OpMappingRegistry)
+# ---------------------------------------------------------------------------
+
+class _Ctx:
+    def __init__(self, sd: SameDiff, consts: Dict[str, np.ndarray]):
+        self.sd = sd
+        self.vars: Dict[str, Any] = {}
+        self.consts = dict(consts)
+
+    def get(self, name):
+        if name not in self.vars:
+            if name in self.consts:
+                self.vars[name] = self.sd.constant(self.consts[name],
+                                                   name=f"c_{name}")
+            else:
+                raise KeyError(f"undefined tensor {name!r}")
+        return self.vars[name]
+
+    def const_val(self, name) -> np.ndarray:
+        if name in self.consts:
+            return self.consts[name]
+        raise ValueError(f"{name!r} must be a constant initializer")
+
+
+_ONNX_OPS: Dict[str, Any] = {}
+
+
+def _op(name):
+    def deco(fn):
+        _ONNX_OPS[name] = fn
+        return fn
+    return deco
+
+
+def _bin(our):
+    def fn(ctx, node):
+        a, b = ctx.get(node.inputs[0]), ctx.get(node.inputs[1])
+        return getattr(ctx.sd.math(), our)(a, b)
+    return fn
+
+
+for onnx_name, our in [("Add", "add"), ("Sub", "sub"), ("Mul", "mul"),
+                       ("Div", "div"), ("Pow", "pow")]:
+    _ONNX_OPS[onnx_name] = _bin(our)
+
+
+def _un(ns, our):
+    def fn(ctx, node):
+        return getattr(ns(ctx.sd), our)(ctx.get(node.inputs[0]))
+    return fn
+
+
+for onnx_name, our in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                       ("Tanh", "tanh"), ("Elu", "elu"), ("Selu", "selu"),
+                       ("Softplus", "softplus")]:
+    _ONNX_OPS[onnx_name] = _un(lambda sd: sd.nn(), our)
+for onnx_name, our in [("Sqrt", "sqrt"), ("Exp", "exp"), ("Log", "log"),
+                       ("Abs", "abs"), ("Neg", "neg"), ("Erf", "erf")]:
+    _ONNX_OPS[onnx_name] = _un(lambda sd: sd.math(), our)
+
+
+@_op("Identity")
+def _identity(ctx, node):
+    return ctx.get(node.inputs[0])
+
+
+@_op("Constant")
+def _constant(ctx, node):
+    val = node.attrs.get("value")
+    ctx.consts[node.outputs[0]] = np.asarray(val)
+    return ctx.sd.constant(np.asarray(val), name=f"c_{node.outputs[0]}")
+
+
+@_op("Softmax")
+def _softmax(ctx, node):
+    return ctx.sd.nn().softmax(ctx.get(node.inputs[0]),
+                               dimension=int(node.attrs.get("axis", -1)))
+
+
+@_op("Gemm")
+def _gemm(ctx, node):
+    a = ctx.get(node.inputs[0])
+    B = ctx.const_val(node.inputs[1]).astype(np.float32)
+    if node.attrs.get("transB", 0):
+        B = B.T
+    if node.attrs.get("transA", 0):
+        a = a.transpose()
+    alpha = float(node.attrs.get("alpha", 1.0))
+    beta = float(node.attrs.get("beta", 1.0))
+    y = a.mmul(ctx.sd.constant(alpha * B, name=f"w_{node.name}"))
+    if len(node.inputs) > 2 and beta != 0.0:
+        c = ctx.get(node.inputs[2])
+        if beta != 1.0:
+            c = c.mul(ctx.sd.constant(np.float32(beta)))
+        y = y.add(c)
+    return y
+
+
+@_op("MatMul")
+def _matmul(ctx, node):
+    return ctx.get(node.inputs[0]).mmul(ctx.get(node.inputs[1]))
+
+
+from deeplearning4j_tpu.autodiff.samediff import register_op  # noqa: E402
+
+
+@_op("Flatten")
+def _flatten(ctx, node):
+    axis = int(node.attrs.get("axis", 1))
+    return ctx.sd._op("onnx_flatten", [ctx.get(node.inputs[0])],
+                      {"axis": axis})
+
+
+@register_op("onnx_flatten")
+def _onnx_flatten_impl(axis=1, **_):
+    import math as _m
+
+    def fn(x):
+        lead = int(_m.prod(x.shape[:axis])) if axis > 0 else 1
+        return x.reshape(lead, -1)
+
+    return fn
+
+
+@_op("Reshape")
+def _reshape(ctx, node):
+    shape = tuple(int(v) for v in ctx.const_val(node.inputs[1]))
+    return ctx.sd._op("reshape", [ctx.get(node.inputs[0])],
+                      {"shape": shape})
+
+
+@_op("Transpose")
+def _transpose(ctx, node):
+    perm = tuple(node.attrs.get("perm", []))
+    return ctx.sd._op("permute", [ctx.get(node.inputs[0])], {"dims": perm})
+
+
+@_op("Concat")
+def _concat(ctx, node):
+    return ctx.sd.concat(int(node.attrs.get("axis", 0)),
+                         *[ctx.get(i) for i in node.inputs])
+
+
+@_op("Gather")
+def _gather(ctx, node):
+    idx = ctx.const_val(node.inputs[1])
+    return ctx.sd.gather(ctx.get(node.inputs[0]), idx.astype(np.int32),
+                         axis=int(node.attrs.get("axis", 0)))
+
+
+@_op("Conv")
+def _conv(ctx, node):
+    W = ctx.const_val(node.inputs[1]).astype(np.float32)   # OIHW already
+    kh, kw = W.shape[2], W.shape[3]
+    strides = node.attrs.get("strides", [1, 1])
+    pads = node.attrs.get("pads", [0, 0, 0, 0])
+    dil = node.attrs.get("dilations", [1, 1])
+    auto = node.attrs.get("auto_pad", "NOTSET")
+    if pads[0] != pads[2] or pads[1] != pads[3]:
+        raise ValueError("asymmetric Conv pads unsupported")
+    b = None
+    if len(node.inputs) > 2:
+        b = ctx.const_val(node.inputs[2]).astype(np.float32)
+    kw_attrs = {"kH": kh, "kW": kw, "sH": int(strides[0]),
+                "sW": int(strides[1]), "pH": int(pads[0]), "pW": int(pads[1]),
+                "dH": int(dil[0]), "dW": int(dil[1]),
+                "isSameMode": auto in ("SAME_UPPER", "SAME_LOWER"),
+                "dataFormat": "NCHW"}
+    # ONNX weights are OIHW; the SameDiff conv2d op takes HWIO
+    ins = [ctx.get(node.inputs[0]),
+           ctx.sd.constant(W.transpose(2, 3, 1, 0), name=f"w_{node.name}")]
+    if b is not None:
+        ins.append(ctx.sd.constant(b, name=f"b_{node.name}"))
+    return ctx.sd._op("conv2d", ins, kw_attrs)
+
+
+def _pool(ctx, node, pool_op):
+    k = node.attrs.get("kernel_shape", [2, 2])
+    s = node.attrs.get("strides", k)
+    pads = node.attrs.get("pads", [0, 0, 0, 0])
+    if pads[0] != pads[2] or pads[1] != pads[3]:
+        raise ValueError(f"asymmetric {node.op_type} pads unsupported")
+    return ctx.sd._op(pool_op, [ctx.get(node.inputs[0])],
+                      {"kH": int(k[0]), "kW": int(k[1]), "sH": int(s[0]),
+                       "sW": int(s[1]), "pH": int(pads[0]),
+                       "pW": int(pads[1]),
+                       "isSameMode": node.attrs.get("auto_pad", "NOTSET")
+                       in ("SAME_UPPER", "SAME_LOWER"),
+                       "dataFormat": "NCHW"})
+
+
+@_op("MaxPool")
+def _maxpool(ctx, node):
+    return _pool(ctx, node, "maxPooling2d")
+
+
+@_op("AveragePool")
+def _avgpool(ctx, node):
+    return _pool(ctx, node, "avgPooling2d")
+
+
+@_op("GlobalAveragePool")
+def _gap(ctx, node):
+    x = ctx.get(node.inputs[0])
+    return ctx.sd._op("onnx_global_avg_pool", [x], {})
+
+
+@register_op("onnx_global_avg_pool")
+def _gap_impl(**_):
+    import jax.numpy as jnp
+    return lambda x: jnp.mean(x, axis=(2, 3), keepdims=True)
+
+
+@_op("BatchNormalization")
+def _bn(ctx, node):
+    x = ctx.get(node.inputs[0])
+    sd = ctx.sd
+    g = sd.constant(ctx.const_val(node.inputs[1]), name=f"g_{node.name}")
+    b = sd.constant(ctx.const_val(node.inputs[2]), name=f"bb_{node.name}")
+    m = sd.constant(ctx.const_val(node.inputs[3]), name=f"m_{node.name}")
+    v = sd.constant(ctx.const_val(node.inputs[4]), name=f"v_{node.name}")
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    return sd.nn().batchNorm(x, m, v, g, b, eps=eps, axis=1)
+
+
+# ---------------------------------------------------------------------------
+
+class OnnxImporter:
+    """Reference facade: OnnxImporter.runImport → SameDiff."""
+
+    @staticmethod
+    def importModel(path: str) -> Tuple[SameDiff, List[str], List[str]]:
+        """Returns (sd, input_names, output_names)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        nodes, inits, inputs, outputs = _parse_model(data)
+        sd = SameDiff.create()
+        ctx = _Ctx(sd, inits)
+        in_names = []
+        for name, _shape in inputs:
+            if name in inits:
+                continue        # initializers may appear as graph inputs
+            ctx.vars[name] = sd.placeholder(name)
+            in_names.append(name)
+        for node in nodes:
+            if node.op_type not in _ONNX_OPS:
+                raise ValueError(f"ONNX import: unsupported op "
+                                 f"{node.op_type!r} (node {node.name!r})")
+            out = _ONNX_OPS[node.op_type](ctx, node)
+            ctx.vars[node.outputs[0]] = out
+        out_names = []
+        for name, _shape in outputs:
+            var = ctx.get(name)
+            if var.name() != name and not sd.hasVariable(name):
+                sd.renameVariable(var.name(), name)
+            out_names.append(name)
+        return sd, in_names, out_names
+
+
+def importOnnxModel(path: str):
+    return OnnxImporter.importModel(path)
