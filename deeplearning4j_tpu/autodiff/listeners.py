@@ -1,0 +1,115 @@
+"""SameDiff listener SPI + stock listeners.
+
+Reference: nd4j-api ``org/nd4j/autodiff/listeners/BaseListener.java``
+(epochStart/epochEnd/iterationStart/iterationDone/preOpExecution/
+opExecution hooks) and ``impl/ExecDebuggingListener`` (prints every executed
+op + inputs — SURVEY.md §5.1).
+
+TPU mapping: per-op hooks can't intercept INSIDE the fused executable — the
+whole graph is one XLA program.  ``iterationStart/iterationDone/epoch*``
+fire exactly as in the reference; the per-op hooks fire during a DEBUG
+(op-by-op, uncompiled) execution that :class:`ExecDebuggingListener`
+triggers via ``SameDiff.execDebug`` — the observability trade the reference
+makes implicitly (its per-op dispatch is why it can hook ops, and why it is
+slow).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Loss:
+    """Reference: listeners/Loss.java — named loss values for a step."""
+
+    def __init__(self, names: List[str], values: List[float]):
+        self.names = names
+        self.values = values
+
+    def totalLoss(self) -> float:
+        return float(sum(self.values))
+
+
+class BaseListener:
+    """SPI — override what you need."""
+
+    def epochStart(self, sd, at) -> None:
+        pass
+
+    def epochEnd(self, sd, at, loss_curve=None) -> None:
+        pass
+
+    def iterationStart(self, sd, at, data, etl_ms: int = 0) -> None:
+        pass
+
+    def iterationDone(self, sd, at, data, loss: Optional[Loss] = None) -> None:
+        pass
+
+    def preOpExecution(self, sd, at, op) -> None:
+        pass
+
+    def opExecution(self, sd, at, op, outputs) -> None:
+        pass
+
+
+class At:
+    """Reference: listeners/At.java — where training currently is."""
+
+    def __init__(self, epoch: int = 0, iteration: int = 0):
+        self.epoch_ = epoch
+        self.iteration_ = iteration
+
+    def epoch(self) -> int:
+        return self.epoch_
+
+    def iteration(self) -> int:
+        return self.iteration_
+
+
+class ExecDebuggingListener(BaseListener):
+    """Print every executed op with inputs/outputs (reference:
+    impl/ExecDebuggingListener).  Use with ``SameDiff.execDebug``."""
+
+    def __init__(self, printArrays: bool = False, maxIterations: int = -1):
+        self.printArrays = printArrays
+        self.maxIterations = maxIterations
+        self._iters = 0          # execDebug PASSES seen (not ops)
+
+    def _silenced(self) -> bool:
+        return 0 <= self.maxIterations <= self._iters
+
+    def iterationStart(self, sd, at, data, etl_ms: int = 0):
+        pass
+
+    def epochEnd(self, sd, at, loss_curve=None):
+        pass
+
+    def preOpExecution(self, sd, at, op):
+        if self._silenced():
+            return
+        print(f"[exec] {op.op:<24} inputs={op.inputs} -> {op.outputs}")
+
+    def iterationDone(self, sd, at, data, loss=None):
+        self._iters += 1
+
+    def opExecution(self, sd, at, op, outputs):
+        if self._silenced():
+            return
+        for name, val in zip(op.outputs, outputs):
+            arr = np.asarray(val)
+            line = f"        {name}: shape={arr.shape} dtype={arr.dtype}"
+            if self.printArrays:
+                line += f" values={arr!r}"
+            print(line)
+
+
+class HistoryListener(BaseListener):
+    """Collect per-iteration losses (handy programmatic listener)."""
+
+    def __init__(self):
+        self.losses: List[float] = []
+
+    def iterationDone(self, sd, at, data, loss=None):
+        if loss is not None:
+            self.losses.append(loss.totalLoss())

@@ -1079,6 +1079,7 @@ class SameDiff:
         self._last_grads: Dict[str, jnp.ndarray] = {}
         self.iterationCount = 0
         # namespaces
+        self._listeners: List = []
         self._math = SDMath(self)
         self._nn = SDNN(self)
         self._cnn = SDCNN(self)
@@ -1131,7 +1132,19 @@ class SameDiff:
     def constant(self, value, name: str = None) -> SDVariable:
         name = self._unique(name or f"const_{self._counter}")
         self._counter += 1
-        a = jnp.asarray(_to_np(value))
+        # Bare python scalars must NOT become float64/int64 (the package
+        # enables x64): one f64 constant silently promotes every downstream
+        # op to f64, which the TPU EMULATES — ruinously slow and 2x memory.
+        # Promotion keeps explicit f64 graphs f64 (f64 op f32 -> f64).
+        if isinstance(value, float):
+            a = jnp.float32(value)
+        elif isinstance(value, bool):
+            a = jnp.asarray(value)
+        elif isinstance(value, int):
+            a = jnp.int32(value) if -(2**31) <= value < 2**31 \
+                else jnp.int64(value)
+        else:
+            a = jnp.asarray(_to_np(value))
         self._arrays[name] = a
         return self._register(name, VariableType.CONSTANT, a.shape, a.dtype)
 
@@ -1526,7 +1539,13 @@ class SameDiff:
                 new_state[n] = st
             return new_vars, new_state, loss
 
-        self._train_step = jax.jit(step, donate_argnums=(0, 1))
+        # NO buffer donation here (unlike MultiLayerNetwork's fused step):
+        # donated outputs can carry non-default layouts, so the NEXT fit()
+        # call — whose inputs are those outputs — misses the jit cache and
+        # recompiles with layout-conversion copies (observed: a BERT-base
+        # second fit recompiling for minutes, then OOMing on copy temps).
+        # Default layouts keep every fit() call on one cached executable.
+        self._train_step = jax.jit(step)
         self._ph_names = ph_names
 
     def fit(self, data=None, epochs: int = 1) -> "History":
@@ -1548,8 +1567,12 @@ class SameDiff:
         for n, v in variables.items():
             if n not in self._opt_state:  # extend for vars added after a fit
                 self._opt_state[n] = cfg.updater.init(v)
+        from deeplearning4j_tpu.autodiff.listeners import At, Loss
         losses = []
-        for _ in range(int(epochs)):
+        for ep in range(int(epochs)):
+            at = At(epoch=ep, iteration=self.iterationCount)
+            for l in self._listeners:
+                l.epochStart(self, at)
             if isinstance(data, (DataSet, MultiDataSet)):
                 batches = [data]
             else:
@@ -1557,12 +1580,22 @@ class SameDiff:
                     data.reset()
                 batches = data
             for ds in batches:
+                at = At(epoch=ep, iteration=self.iterationCount)
+                for l in self._listeners:
+                    l.iterationStart(self, at, ds)
                 ph = self._bind(ds, cfg)
                 variables, self._opt_state, loss = self._train_step(
                     variables, self._opt_state, ph,
                     jnp.asarray(self.iterationCount, jnp.int32))
                 self.iterationCount += 1
                 losses.append(float(loss))
+                for l in self._listeners:
+                    l.iterationDone(self, at, ds,
+                                    Loss(["loss"], [losses[-1]]))
+            for l in self._listeners:
+                l.epochEnd(self, At(epoch=ep,
+                                    iteration=self.iterationCount),
+                           loss_curve=list(losses))
         self._arrays.update(variables)
         return History(losses)
 
@@ -1580,6 +1613,44 @@ class SameDiff:
         for n, a in zip(cfg.dataSetLabelMapping, labs):
             ph[n] = a
         return ph
+
+    # ---------------- listeners (reference: BaseListener SPI) ----------
+    def setListeners(self, *listeners) -> None:
+        if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
+            listeners = tuple(listeners[0])
+        self._listeners = list(listeners)
+
+    def addListeners(self, *listeners) -> None:
+        self._listeners.extend(listeners)
+
+    def execDebug(self, placeholders: Dict[str, Any], *outputs):
+        """Op-by-op UNCOMPILED execution firing preOpExecution/opExecution
+        on every listener — the observability mode the reference gets for
+        free from per-op dispatch (and pays for in speed).  Returns the same
+        dict as :meth:`output`."""
+        from deeplearning4j_tpu.autodiff.listeners import At
+        out_names = tuple(o.name() if isinstance(o, SDVariable) else o
+                          for o in outputs) or tuple(self._loss_vars)
+        nodes = self._needed_nodes(out_names)
+        env = {n: a for n, a in self._arrays.items()}
+        env.update({k: jnp.asarray(_to_np(v))
+                    for k, v in placeholders.items()})
+        at = At(iteration=self.iterationCount)
+        for node in nodes:
+            for l in self._listeners:
+                l.preOpExecution(self, at, node)
+            args = [env[i] for i in node.inputs]
+            if node.op in RNG_TRAIN_OPS:
+                # inference semantics, like output(): dropout is identity
+                res = args[0]
+            else:
+                res = OP_IMPLS[node.op](**node.attrs)(*args)
+            res_t = res if isinstance(res, tuple) else (res,)
+            for nm, r in zip(node.outputs, res_t):
+                env[nm] = r
+            for l in self._listeners:
+                l.opExecution(self, at, node, list(res_t))
+        return {n: NDArray(env[n]) for n in out_names}
 
     # ---------------- serde ----------------
     def save(self, path: str, saveUpdaterState: bool = False):

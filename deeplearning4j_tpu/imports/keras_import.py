@@ -293,6 +293,12 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
             continue
         if cls == "UpSampling2D":
             from deeplearning4j_tpu.nn.conf.convolutional import Upsampling2D
+            interp = cfg.get("interpolation", "nearest")
+            if interp != "nearest":
+                raise ValueError(
+                    f"Keras import: UpSampling2D interpolation={interp!r} "
+                    "is unsupported (only 'nearest'); importing it silently "
+                    "would change the numerics")
             sz = cfg.get("size", [2, 2])
             lay = Upsampling2D(size=tuple(int(x) for x in sz))
             our_layers.append((lay, None, "upsample"))

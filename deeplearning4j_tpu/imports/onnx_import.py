@@ -73,19 +73,24 @@ _DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
            11: np.float64, 10: np.float16}
 
 
+def _signed64(v: int) -> int:
+    """Two's-complement correction: -1 serializes as 2^64-1 on the wire."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _unpack_varints(vals) -> List[int]:
     """Repeated-int field values: proto3 serializers emit PACKED blobs (one
     length-delimited bytes value), hand encoders may emit unpacked ints —
-    accept both."""
+    accept both; values are sign-corrected (Reshape shapes carry -1)."""
     out: List[int] = []
     for v in vals:
         if isinstance(v, bytes):
             j = 0
             while j < len(v):
                 x, j = _varint(v, j)
-                out.append(x)
+                out.append(_signed64(x))
         else:
-            out.append(v)
+            out.append(_signed64(v))
     return out
 
 
@@ -117,8 +122,7 @@ def _attr(buf: bytes) -> Tuple[str, Any]:
     if 2 in f:                                        # f (float, fixed32)
         return name, struct.unpack("<f", f[2][0])[0]
     if 3 in f:                                        # i
-        v = f[3][0]
-        return name, v - (1 << 64) if v >= (1 << 63) else v
+        return name, _signed64(f[3][0])
     if 4 in f:                                        # s
         return name, f[4][0].decode()
     if 5 in f:                                        # t (tensor)

@@ -83,6 +83,14 @@ class NoOp(IUpdater):
         return jnp.zeros_like(grad), state
 
 
+def _bpow(beta: float, t):
+    """beta^t in float32.  Under x64, ``jnp.power(python_float, int_tracer)``
+    promotes to STRONG float64, silently poisoning the whole update (and the
+    params it feeds) into TPU-emulated f64 — observed as a BERT train step
+    recompiling to f64 after the first fit."""
+    return jnp.power(jnp.float32(beta), jnp.asarray(t, jnp.float32))
+
+
 @dataclasses.dataclass
 class Adam(IUpdater):
     learningRate: float = 1e-3
@@ -100,7 +108,7 @@ class Adam(IUpdater):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
-        a = lr * jnp.sqrt(1 - jnp.power(self.beta2, t)) / (1 - jnp.power(self.beta1, t))
+        a = lr * jnp.sqrt(1 - _bpow(self.beta2, t)) / (1 - _bpow(self.beta1, t))
         return a * m / (jnp.sqrt(v) + self.epsilon), {"m": m, "v": v}
 
 
@@ -125,7 +133,7 @@ class AdaMax(Adam):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         u = jnp.maximum(self.beta2 * state["v"], jnp.abs(grad))
-        a = lr / (1 - jnp.power(self.beta1, t))
+        a = lr / (1 - _bpow(self.beta1, t))
         return a * m / (u + self.epsilon), {"m": m, "v": u}
 
 
@@ -143,7 +151,7 @@ class AMSGrad(Adam):
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
         vHat = jnp.maximum(state["vHat"], v)
-        a = lr * jnp.sqrt(1 - jnp.power(self.beta2, t)) / (1 - jnp.power(self.beta1, t))
+        a = lr * jnp.sqrt(1 - _bpow(self.beta2, t)) / (1 - _bpow(self.beta1, t))
         return a * m / (jnp.sqrt(vHat) + self.epsilon), {"m": m, "v": v, "vHat": vHat}
 
 
@@ -153,9 +161,9 @@ class Nadam(Adam):
         t = iteration + 1
         m = self.beta1 * state["m"] + (1 - self.beta1) * grad
         v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
-        mHat = m / (1 - jnp.power(self.beta1, t))
-        vHat = v / (1 - jnp.power(self.beta2, t))
-        mBar = self.beta1 * mHat + (1 - self.beta1) * grad / (1 - jnp.power(self.beta1, t))
+        mHat = m / (1 - _bpow(self.beta1, t))
+        vHat = v / (1 - _bpow(self.beta2, t))
+        mBar = self.beta1 * mHat + (1 - self.beta1) * grad / (1 - _bpow(self.beta1, t))
         return lr * mBar / (jnp.sqrt(vHat) + self.epsilon), {"m": m, "v": v}
 
 

@@ -14,6 +14,14 @@ from typing import Dict
 
 import jax.numpy as jnp
 
+
+def _f32pow(base, exponent):
+    """base^exponent in float32 — jnp.power(python_float, traced_int) under
+    x64 yields STRONG float64 that poisons the whole jitted update (see
+    learning.config._bpow)."""
+    return jnp.power(jnp.asarray(base, jnp.float32),
+                     jnp.asarray(exponent, jnp.float32))
+
 __all__ = ["ISchedule", "FixedSchedule", "ExponentialSchedule",
            "InverseSchedule", "PolySchedule", "SigmoidSchedule",
            "StepSchedule", "MapSchedule", "LinearSchedule", "CycleSchedule",
@@ -64,7 +72,7 @@ class ExponentialSchedule(ISchedule):
     gamma: float
 
     def valueAt(self, iteration, epoch):
-        return self.initialValue * jnp.power(self.gamma, self._t(iteration, epoch))
+        return self.initialValue * _f32pow(self.gamma, self._t(iteration, epoch))
 
 
 @dataclasses.dataclass
@@ -75,7 +83,7 @@ class InverseSchedule(ISchedule):
     power: float
 
     def valueAt(self, iteration, epoch):
-        return self.initialValue / jnp.power(
+        return self.initialValue / _f32pow(
             1.0 + self.gamma * self._t(iteration, epoch), self.power)
 
 
@@ -89,7 +97,7 @@ class PolySchedule(ISchedule):
     def valueAt(self, iteration, epoch):
         t = self._t(iteration, epoch)
         frac = jnp.clip(t / self.maxIter, 0.0, 1.0)
-        return self.initialValue * jnp.power(1.0 - frac, self.power)
+        return self.initialValue * _f32pow(1.0 - frac, self.power)
 
 
 @dataclasses.dataclass
@@ -114,7 +122,7 @@ class StepSchedule(ISchedule):
 
     def valueAt(self, iteration, epoch):
         t = self._t(iteration, epoch)
-        return self.initialValue * jnp.power(self.decayRate,
+        return self.initialValue * _f32pow(self.decayRate,
                                              jnp.floor(t / self.step))
 
 

@@ -175,3 +175,30 @@ def test_parameter_averaging_master_trains():
     tm.fitMultiLayerNetwork(net, ListDataSetIterator([ds], batch=64),
                             epochs=15)
     assert net.score(ds) < s0 * 0.5
+
+
+def test_config5_resnet50_shared_training_on_mesh():
+    """BASELINE config #5: ResNet-50 (ComputationGraph) trained through
+    SharedTrainingMaster over the 8-device mesh — the reference's Spark +
+    Aeron gradient-sharing path collapsed into one sharded executable."""
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.parallel import (SharedTrainingMaster,
+                                             SparkDl4jMultiLayer,
+                                             VoidConfiguration)
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    net = ResNet50(numClasses=4, inputShape=(3, 32, 32)).init()
+    tm = (SharedTrainingMaster.Builder(VoidConfiguration())
+          .batchSizePerWorker(2)
+          .mesh(DeviceMesh(data=8)).build())
+    spark_net = SparkDl4jMultiLayer(None, net, tm)
+    rng = np.random.RandomState(0)
+    cls = rng.randint(0, 4, 16)
+    x = (rng.randn(16, 3, 32, 32) * 0.1).astype(np.float32)
+    for i, c in enumerate(cls):
+        x[i, c % 3] += 1.0
+    ds = DataSet(x, np.eye(4, dtype=np.float32)[cls])
+    s0 = net.score(ds)
+    spark_net.fit(ListDataSetIterator([ds], batch=16), epochs=2)
+    assert np.isfinite(net.score(ds))
+    assert net.score(ds) < s0 * 1.5   # moving (2 steps of a 50-layer net)

@@ -224,3 +224,33 @@ def test_registered_op_coverage():
     missing = OpValidation.coverageReport()
     frac = OpValidation.coverageFraction()
     assert frac >= 0.95, f"op coverage {frac:.2%}; missing: {missing}"
+
+
+def test_samediff_listeners_and_exec_debug(capsys):
+    from deeplearning4j_tpu.autodiff.listeners import (ExecDebuggingListener,
+                                                       HistoryListener)
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.learning import Sgd
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    w = sd.var("w", np.ones((3, 2), np.float32) * 0.1)
+    y = sd.placeholder("y")
+    pred = x.mmul(w)
+    loss = sd.loss().meanSquaredError(pred, y, name="loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Sgd(0.1),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["y"]))
+    hist = HistoryListener()
+    sd.setListeners(hist, ExecDebuggingListener())
+    X = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    Y = (X @ np.ones((3, 2), np.float32)).astype(np.float32)
+    sd.fit(DataSet(X, Y), epochs=3)
+    assert len(hist.losses) == 3
+    assert hist.losses[-1] < hist.losses[0]
+
+    out = sd.execDebug({"x": X}, pred.name())
+    printed = capsys.readouterr().out
+    assert "[exec] mmul" in printed
+    np.testing.assert_allclose(out[pred.name()].numpy().shape, (8, 2))
