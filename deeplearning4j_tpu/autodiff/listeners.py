@@ -74,7 +74,7 @@ class ExecDebuggingListener(BaseListener):
     def __init__(self, printArrays: bool = False, maxIterations: int = -1):
         self.printArrays = printArrays
         self.maxIterations = maxIterations
-        self._iters = 0          # execDebug PASSES seen (not ops)
+        self._iters = 0          # execDebug passes completed
 
     def _silenced(self) -> bool:
         return 0 <= self.maxIterations <= self._iters
@@ -90,7 +90,7 @@ class ExecDebuggingListener(BaseListener):
             return
         print(f"[exec] {op.op:<24} inputs={op.inputs} -> {op.outputs}")
 
-    def iterationDone(self, sd, at, data, loss=None):
+    def execDebugPassDone(self, sd, at):
         self._iters += 1
 
     def opExecution(self, sd, at, op, outputs):

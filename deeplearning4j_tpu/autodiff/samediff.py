@@ -1002,13 +1002,16 @@ class TrainingConfig:
                  dataSetFeatureMapping: Sequence[str] = (),
                  dataSetLabelMapping: Sequence[str] = (),
                  l1: float = 0.0, l2: float = 0.0,
-                 minimize: bool = True):
+                 minimize: bool = True, dataType: str = "FLOAT"):
         self.updater = updater or Adam()
         self.dataSetFeatureMapping = list(dataSetFeatureMapping)
         self.dataSetLabelMapping = list(dataSetLabelMapping)
         self.l1 = l1
         self.l2 = l2
         self.minimize = minimize
+        # "BFLOAT16"/"HALF": bf16 compute with f32 master variables (same
+        # mixed-precision contract as MultiLayerNetwork's dataType config)
+        self.dataType = dataType
 
     class Builder:
         def __init__(self):
@@ -1024,6 +1027,10 @@ class TrainingConfig:
 
         def dataSetLabelMapping(self, *names):
             self._kw["dataSetLabelMapping"] = list(names)
+            return self
+
+        def dataType(self, dt: str):
+            self._kw["dataType"] = dt
             return self
 
         def l1(self, v):
@@ -1136,8 +1143,8 @@ class SameDiff:
         # enables x64): one f64 constant silently promotes every downstream
         # op to f64, which the TPU EMULATES — ruinously slow and 2x memory.
         # Promotion keeps explicit f64 graphs f64 (f64 op f32 -> f64).
-        if isinstance(value, float):
-            a = jnp.float32(value)
+        if type(value) is float:   # NOT np.float64 (a float subclass):
+            a = jnp.float32(value)  # explicit f64 scalars keep f64
         elif isinstance(value, bool):
             a = jnp.asarray(value)
         elif isinstance(value, int):
@@ -1402,7 +1409,8 @@ class SameDiff:
             visit(n)
         return needed
 
-    def _build_fn(self, out_names: Tuple[str, ...], training: bool = False):
+    def _build_fn(self, out_names: Tuple[str, ...], training: bool = False,
+                  compute_dtype=None):
         """Stage the graph into a pure fn(placeholders, variables, it) -> outs.
 
         ``it`` is the iteration counter: train-time RNG ops (dropout) fold it
@@ -1418,6 +1426,12 @@ class SameDiff:
                 compiled.append((node, OP_IMPLS[node.op](**node.attrs)))
         consts = {n: a for n, a in self._arrays.items()
                   if self._vars[n].variableType == VariableType.CONSTANT}
+        if compute_dtype is not None:
+            # graph constants must follow the compute dtype, or one strong
+            # f32 constant re-promotes its whole bf16 subgraph back to f32
+            consts = {n: (a.astype(compute_dtype) if hasattr(a, "dtype")
+                          and a.dtype == jnp.float32 else a)
+                      for n, a in consts.items()}
 
         def fn(placeholders: Dict[str, jnp.ndarray],
                variables: Dict[str, jnp.ndarray],
@@ -1515,10 +1529,25 @@ class SameDiff:
         updater = cfg.updater
         ph_names = cfg.dataSetFeatureMapping + cfg.dataSetLabelMapping
         sign = 1.0 if cfg.minimize else -1.0
+        cdt = jnp.bfloat16 if str(cfg.dataType).upper() in (
+            "BFLOAT16", "HALF", "FLOAT16") else jnp.float32
+        if cdt != jnp.float32:
+            fn = self._build_fn(tuple(self._loss_vars), training=True,
+                                compute_dtype=cdt)
+
+        def cast_compute(tree):
+            if cdt == jnp.float32:
+                return tree
+            return {k: (v.astype(cdt) if hasattr(v, "dtype")
+                        and v.dtype == jnp.float32 else v)
+                    for k, v in tree.items()}
 
         def loss_fn(variables, ph, it):
-            outs = fn(ph, variables, it)
-            loss = sum(jnp.sum(v) for v in outs.values())
+            outs = fn(cast_compute(ph), cast_compute(variables), it)
+            # loss reductions in f32 under bf16 compute
+            loss = sum(jnp.sum(v.astype(jnp.float32)
+                               if hasattr(v, "dtype") and v.dtype == cdt
+                               else v) for v in outs.values())
             if cfg.l2:
                 # 0.5*l2*sum(w^2) — matches _reg_penalty / DL4J convention
                 loss = loss + 0.5 * cfg.l2 * sum(
@@ -1650,6 +1679,10 @@ class SameDiff:
                 env[nm] = r
             for l in self._listeners:
                 l.opExecution(self, at, node, list(res_t))
+        for l in self._listeners:
+            hook = getattr(l, "execDebugPassDone", None)
+            if hook is not None:
+                hook(self, at)
         return {n: NDArray(env[n]) for n in out_names}
 
     # ---------------- serde ----------------

@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.learning.schedules import ISchedule
+from deeplearning4j_tpu.learning.schedules import ISchedule, _f32pow
 
 __all__ = ["IUpdater", "Sgd", "Adam", "AdamW", "AdaMax", "AMSGrad", "Nadam",
            "Nesterovs", "RmsProp", "AdaGrad", "AdaDelta", "NoOp"]
@@ -83,12 +83,9 @@ class NoOp(IUpdater):
         return jnp.zeros_like(grad), state
 
 
-def _bpow(beta: float, t):
-    """beta^t in float32.  Under x64, ``jnp.power(python_float, int_tracer)``
-    promotes to STRONG float64, silently poisoning the whole update (and the
-    params it feeds) into TPU-emulated f64 — observed as a BERT train step
-    recompiling to f64 after the first fit."""
-    return jnp.power(jnp.float32(beta), jnp.asarray(t, jnp.float32))
+# beta^t in float32 — the shared x64 f64-poison workaround lives in
+# schedules._f32pow; see its docstring
+_bpow = _f32pow
 
 
 @dataclasses.dataclass

@@ -254,3 +254,28 @@ def test_samediff_listeners_and_exec_debug(capsys):
     printed = capsys.readouterr().out
     assert "[exec] mmul" in printed
     np.testing.assert_allclose(out[pred.name()].numpy().shape, (8, 2))
+
+
+def test_samediff_bf16_training_keeps_f32_masters():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.learning import Adam
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    w = sd.var("w", np.random.RandomState(0).randn(4, 2).astype(np.float32)
+               * 0.2)
+    sd.loss().meanSquaredError(x.mmul(w), y, name="loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(5e-2),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["y"],
+                                        dataType="BFLOAT16"))
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 4).astype(np.float32)
+    Y = (X[:, :2] * 0.7).astype(np.float32)
+    h = sd.fit(DataSet(X, Y), epochs=60)
+    assert h.finalTrainingLoss() < h.lossCurve()[0] * 0.2
+    # master variables remain f32 across fits (mixed-precision contract)
+    assert np.asarray(sd._arrays["w"]).dtype == np.float32
