@@ -1643,6 +1643,34 @@ class SameDiff:
             ph[n] = a
         return ph
 
+    def evaluate(self, iterator, outputVariable, evaluation=None):
+        """Reference: SameDiff.evaluate(DataSetIterator, outputVariable,
+        IEvaluation) — features bind via the TrainingConfig feature mapping,
+        labels come from each DataSet, predictions from ``outputVariable``."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        if self._training_config is None:
+            raise ValueError("setTrainingConfig first (feature mappings)")
+        cfg = self._training_config
+        ev = evaluation or Evaluation()
+        name = outputVariable.name() if isinstance(outputVariable,
+                                                   SDVariable) \
+            else outputVariable
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            feats = ds.features if isinstance(ds.features, list) \
+                else [ds.features]
+            ph = {n: _to_np(f) for n, f in
+                  zip(cfg.dataSetFeatureMapping, feats)}
+            out = self.output(ph, name)[name]
+            labels = ds.labels[0] if isinstance(ds.labels, list) else ds.labels
+            lmask = getattr(ds, "labelsMask", None)
+            if isinstance(lmask, list):
+                lmask = lmask[0] if lmask else None
+            ev.eval(_to_np(labels), out.numpy(),
+                    _to_np(lmask) if lmask is not None else None)
+        return ev
+
     # ---------------- listeners (reference: BaseListener SPI) ----------
     def setListeners(self, *listeners) -> None:
         if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):

@@ -174,5 +174,120 @@ class TransferLearning:
             net._initOptState()  # updater state must match final params
             return net
 
-    # reference also exposes TransferLearning.GraphBuilder; the CG variant
-    # lands with the ComputationGraph surgery work.
+    class GraphBuilder:
+        """ComputationGraph surgery (reference:
+        TransferLearning.GraphBuilder): freeze vertices, remove/replace
+        outputs, add new layers/vertices, fine-tune the remainder."""
+
+        def __init__(self, graph):
+            self._graph = graph
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._frozen_until: Optional[str] = None
+            self._removed: set = set()
+            self._added: List[tuple] = []       # (name, layer_or_vertex, inputs)
+            self._outputs: Optional[List[str]] = None
+
+        def fineTuneConfiguration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def setFeatureExtractor(self, vertexName: str):
+            """Freeze vertexName and every ancestor of it."""
+            self._frozen_until = vertexName
+            return self
+
+        def removeVertexAndConnections(self, name: str):
+            """Remove the vertex AND its edges: downstream vertices drop
+            this input (a Merge keeps its remaining inputs) — reference
+            semantics; a vertex left with NO inputs fails conf validation
+            with a clear error, prompting a rewire."""
+            self._removed.add(name)
+            self._strip_edges = getattr(self, "_strip_edges", set())
+            self._strip_edges.add(name)
+            return self
+
+        def removeVertexKeepConnections(self, name: str):
+            """Remove the vertex but KEEP downstream references to its name
+            — re-adding a vertex under the same name reconnects them
+            (the reference's replace-in-place idiom)."""
+            self._removed.add(name)
+            return self
+
+        def addLayer(self, name: str, layer, *inputs):
+            self._added.append((name, layer, list(inputs)))
+            return self
+
+        def addVertex(self, name: str, vertex, *inputs):
+            self._added.append((name, vertex, list(inputs)))
+            return self
+
+        def setOutputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        def build(self):
+            from deeplearning4j_tpu.models.graph import ComputationGraph
+            from deeplearning4j_tpu.models.graph_conf import \
+                ComputationGraphConfiguration
+            from deeplearning4j_tpu.utils.trees import snapshot_tree
+
+            old = self._graph
+            oc = old.conf
+            strip = getattr(self, "_strip_edges", set())
+            nodes = {n: (copy.deepcopy(node),
+                         [i for i in ins if i not in strip])
+                     for n, (node, ins) in oc.nodes.items()
+                     if n not in self._removed}
+            for name, node, ins in self._added:
+                nodes[name] = (node, list(ins))
+            outputs = self._outputs or [o for o in oc.outputs
+                                        if o not in self._removed]
+            g = dict(oc.globalConf)
+            if self._ftc is not None:
+                g = self._ftc.appliedTo(g)
+
+            if self._frozen_until is not None:
+                frozen = set()
+                stack = [self._frozen_until]
+                while stack:
+                    n = stack.pop()
+                    if n in frozen or n not in nodes:
+                        continue
+                    frozen.add(n)
+                    stack.extend(i for i in nodes[n][1] if i in nodes)
+                for n in frozen:
+                    nodes[n][0].frozen = True
+
+            pre = {n: p for n, p in oc.preProcessors.items() if n in nodes}
+            conf = ComputationGraphConfiguration(
+                inputs=list(oc.inputs), inputTypes=list(oc.inputTypes),
+                nodes=nodes, outputs=outputs, preProcessors=pre,
+                globalConf=g)
+            net = ComputationGraph(conf)
+            net.init()
+            import jax
+
+            def shapes_match(a, b):
+                la = jax.tree_util.tree_leaves(a)
+                lb = jax.tree_util.tree_leaves(b)
+                return len(la) == len(lb) and all(
+                    x.shape == y.shape for x, y in zip(la, lb))
+
+            new_names = {name for name, _n, _i in self._added}
+            params = dict(net.params_)
+            state = dict(net.state_)
+            for n in nodes:
+                if n in new_names:
+                    continue        # fresh init for added vertices
+                if n in old.params_ and n in params and \
+                        shapes_match(old.params_[n], params[n]):
+                    # transplant ONLY when surgery didn't resize this
+                    # vertex (a changed fan-in keeps its fresh init)
+                    params[n] = snapshot_tree(old.params_[n])
+                if n in old.state_ and n in state and \
+                        shapes_match(old.state_[n], state[n]):
+                    state[n] = snapshot_tree(old.state_[n])
+            net.params_ = params
+            net.state_ = state
+            net._initOptState()
+            return net

@@ -279,3 +279,79 @@ def test_samediff_bf16_training_keeps_f32_masters():
     assert h.finalTrainingLoss() < h.lossCurve()[0] * 0.2
     # master variables remain f32 across fits (mixed-precision contract)
     assert np.asarray(sd._arrays["w"]).dtype == np.float32
+
+
+def test_samediff_evaluate():
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.learning import Adam
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    w = sd.var("w", np.random.RandomState(0).randn(4, 3).astype(np.float32)
+               * 0.1)
+    logits = x.mmul(w)
+    probs = sd.nn().softmax(logits, name="probs")
+    sd.loss().softmaxCrossEntropy(y, logits, name="loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(5e-2),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["y"]))
+    rng = np.random.RandomState(1)
+    cls = rng.randint(0, 3, 128)
+    X = (rng.randn(128, 4) + 2.0 * np.eye(3, 4)[cls]).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[cls]
+    it = ListDataSetIterator([DataSet(X, Y)], batch=64)
+    sd.fit(it, epochs=100)
+    ev = sd.evaluate(ListDataSetIterator([DataSet(X, Y)], batch=64), probs)
+    assert ev.accuracy() > 0.85   # linear model; Bayes ~0.9 on this noise
+
+
+def test_transfer_learning_graph_builder():
+    from deeplearning4j_tpu.learning import Adam, Sgd
+    from deeplearning4j_tpu.models import (ComputationGraph,
+                                           FineTuneConfiguration,
+                                           TransferLearning)
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+
+    gb = (NeuralNetConfiguration.builder().seed(2).updater(Adam(5e-2))
+          .graphBuilder())
+    gb.addInputs("in")
+    gb.addLayer("fc0", DenseLayer.builder().nIn(4).nOut(12)
+                .activation("relu").build(), "in")
+    gb.addLayer("fc1", DenseLayer.builder().nIn(12).nOut(8)
+                .activation("relu").build(), "fc0")
+    gb.addLayer("out", OutputLayer.builder("mcxent").nIn(8).nOut(2)
+                .activation("softmax").build(), "fc1")
+    gb.setOutputs("out")
+    base = ComputationGraph(gb.build())
+    base.init()
+    rng = np.random.RandomState(0)
+    cls = rng.randint(0, 2, 64)
+    ds = DataSet((rng.randn(64, 4) + 2 * cls[:, None]).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[cls])
+    base.fit(ListDataSetIterator([ds], batch=64), epochs=5)
+    w0 = np.asarray(base.params_["fc0"]["W"]).copy()
+
+    net2 = (TransferLearning.GraphBuilder(base)
+            .fineTuneConfiguration(
+                FineTuneConfiguration.builder().updater(Sgd(5e-2)).build())
+            .setFeatureExtractor("fc1")        # freezes fc1 + ancestors
+            .removeVertexAndConnections("out")
+            .addLayer("newOut", OutputLayer.builder("mcxent").nIn(8).nOut(3)
+                      .activation("softmax").build(), "fc1")
+            .setOutputs("newOut")
+            .build())
+    assert net2.conf.nodes["fc0"][0].frozen
+    assert net2.conf.nodes["fc1"][0].frozen
+    np.testing.assert_array_equal(np.asarray(net2.params_["fc0"]["W"]), w0)
+
+    cls3 = rng.randint(0, 3, 64)
+    ds3 = DataSet((rng.randn(64, 4) + 2 * np.eye(3, 4)[cls3]
+                   ).astype(np.float32),
+                  np.eye(3, dtype=np.float32)[cls3])
+    net2.fit(ListDataSetIterator([ds3], batch=32), epochs=5)
+    np.testing.assert_array_equal(np.asarray(net2.params_["fc0"]["W"]), w0)
+    assert np.asarray(net2.outputSingle(ds3.features.numpy())).shape == (64, 3)
