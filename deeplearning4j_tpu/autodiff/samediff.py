@@ -1658,15 +1658,18 @@ class SameDiff:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            feats = ds.features if isinstance(ds.features, list) \
-                else [ds.features]
-            ph = {n: _to_np(f) for n, f in
-                  zip(cfg.dataSetFeatureMapping, feats)}
-            out = self.output(ph, name)[name]
+            # one binding path: _bind handles DataSet vs MultiDataSet (the
+            # bound label placeholders are simply unused by the output fetch)
+            ph = self._bind(ds, cfg)
+            out = self.outputSingle(
+                {k: v for k, v in ph.items()
+                 if k in cfg.dataSetFeatureMapping}, name)
             labels = ds.labels[0] if isinstance(ds.labels, list) else ds.labels
-            lmask = getattr(ds, "labelsMask", None)
+            lmask = getattr(ds, "labelsMasks", None)   # MultiDataSet plural
             if isinstance(lmask, list):
                 lmask = lmask[0] if lmask else None
+            if lmask is None:
+                lmask = getattr(ds, "labelsMask", None)
             ev.eval(_to_np(labels), out.numpy(),
                     _to_np(lmask) if lmask is not None else None)
         return ev

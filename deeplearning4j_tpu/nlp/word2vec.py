@@ -217,7 +217,41 @@ class WordVectors:
             return float("nan")
         return float(self._unit[i] @ self._unit[j])
 
-    def wordsNearest(self, word_or_vec, n: int = 10) -> List[str]:
+    def wordsNearest(self, positive, negative=None, n: int = 10
+                     ) -> List[str]:
+        """Nearest words; with lists, the classic analogy arithmetic
+        (reference: WordVectors.wordsNearest(positive, negative, n) —
+        king - man + woman).  A single word/vector behaves as before."""
+        if isinstance(negative, int):      # old 2-positional form:
+            negative, n = None, negative   # wordsNearest(word, n)
+        if isinstance(positive, (list, tuple)) or negative is not None:
+            pos = list(positive) if isinstance(positive, (list, tuple)) \
+                else [positive]
+            neg = list(negative or [])
+            vec = np.zeros(self._vec.shape[1], dtype=np.float64)
+            exclude = set()
+            for w in pos:
+                i = self.vocab.indexOf(w)
+                if i < 0:
+                    return []
+                vec += self._unit[i]
+                exclude.add(i)
+            for w in neg:
+                i = self.vocab.indexOf(w)
+                if i < 0:
+                    return []
+                vec -= self._unit[i]
+                exclude.add(i)
+            nv = np.linalg.norm(vec)
+            sims = self._unit @ (vec / max(nv, 1e-12))
+            order = np.argsort(-sims)
+            return [self.vocab.wordAtIndex(int(k)) for k in order
+                    if int(k) not in exclude][:n]
+        return self._wordsNearestSingle(positive, n)
+
+    wordsNearestSum = wordsNearest
+
+    def _wordsNearestSingle(self, word_or_vec, n: int = 10) -> List[str]:
         if isinstance(word_or_vec, str):
             i = self.vocab.indexOf(word_or_vec)
             if i < 0:

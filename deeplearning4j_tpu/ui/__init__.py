@@ -1,4 +1,5 @@
 """Training UI (reference: deeplearning4j-ui-parent — SURVEY.md §5.5)."""
 from deeplearning4j_tpu.ui.stats import (  # noqa: F401
-    FileStatsStorage, InMemoryStatsStorage, StatsListener)
+    FileStatsStorage, InMemoryStatsStorage, RemoteUIStatsStorageRouter,
+    StatsListener)
 from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401

@@ -58,6 +58,10 @@ class UIServer:
     def __init__(self, port: int = 9000):
         self.port = port
         self._storages: List[StatsStorage] = []
+        # eager: handler threads race a lazy check-then-create
+        from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+        self._remote = InMemoryStatsStorage()
+        self._storages.append(self._remote)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -71,6 +75,9 @@ class UIServer:
         self._storages.append(storage)
         if self._httpd is None:
             self._start()
+
+    def _remote_storage(self):
+        return self._remote
 
     def _sessions(self):
         out = {}
@@ -93,6 +100,27 @@ class UIServer:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_POST(self):
+                # remote stats push (reference: RemoteUIStatsStorageRouter
+                # -> remote-mode UIServer): {"session": ..., "update": {...}}
+                if self.path != "/train/post":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n))
+                    server._remote_storage().putUpdate(payload["session"],
+                                                       payload["update"])
+                    self._send(json.dumps({"ok": True}), "application/json")
+                except Exception as e:
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
 
             def do_GET(self):
                 sessions = server._sessions()

@@ -124,3 +124,40 @@ class StatsListener(TrainingListener):
                 update["iterationsPerSecond"] = self.frequency / dt
         self._last_time = now
         self.storage.putUpdate(self.sessionId, update)
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """Push updates to a remote UIServer over HTTP.
+
+    Reference: deeplearning4j-ui ``RemoteUIStatsStorageRouter`` — attach a
+    StatsListener to this router on the TRAINING process and view the charts
+    on a UIServer running elsewhere (``UIServer`` accepts the POSTs at
+    ``/train/post``).
+    """
+
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+        self.failureCount = 0
+
+    def putUpdate(self, sessionId, update):
+        # a MONITORING failure must never kill the training run it watches
+        # (reference router queues + retries; we log and count)
+        import logging
+        import urllib.request
+        data = json.dumps({"session": sessionId, "update": update}
+                          ).encode("utf-8")
+        req = urllib.request.Request(
+            f"{self.address}/train/post", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception as e:
+            self.failureCount += 1
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "remote stats push failed (%s): %s", self.address, e)
+
+    def listSessionIDs(self):
+        return []          # write-only router (reference behavior)
+
+    def getUpdates(self, sessionId):
+        return []

@@ -183,3 +183,22 @@ def test_subsampling_drops_frequent_words_effectively():
                    subsampling=1e-5)  # aggressive: nearly everything dropped
     w2v.fit()  # must not crash with near-empty pair stream
     assert w2v.vocab.numWords() > 0
+
+
+def test_words_nearest_analogy_api():
+    w2v = Word2Vec(sentences=_corpus(), layerSize=24, epochs=10, seed=7,
+                   windowSize=3, learningRate=0.025).fit()
+    # single-word form unchanged
+    assert len(w2v.wordsNearest("apple", n=3)) == 3
+    # analogy form runs and excludes the query words
+    res = w2v.wordsNearest(["apple", "car"], ["banana"], n=5)
+    assert len(res) == 5
+    assert "apple" not in res and "car" not in res and "banana" not in res
+    # unknown word -> empty, not crash
+    assert w2v.wordsNearest(["apple", "zzz"], n=3) == []
+
+
+def test_words_nearest_positional_n_regression():
+    w2v = Word2Vec(sentences=_corpus(), layerSize=8, epochs=1, seed=1).fit()
+    # old 2-positional call form: wordsNearest(word, n)
+    assert len(w2v.wordsNearest("apple", 3)) == 3

@@ -186,3 +186,23 @@ def test_json_server_multi_output_graph_and_validation():
         assert out["outB"].shape == (2, 3)
     finally:
         server.stop()
+
+
+def test_remote_stats_router_pushes_to_ui_server():
+    from deeplearning4j_tpu.ui import (RemoteUIStatsStorageRouter,
+                                       StatsListener, UIServer)
+    server = UIServer(port=0)
+    server.attach(InMemoryStatsStorage())    # starts the HTTP server
+    try:
+        router = RemoteUIStatsStorageRouter(
+            f"http://127.0.0.1:{server.port}")
+        net = _net()
+        net.setListeners(StatsListener(router, sessionId="remote-run"))
+        net.fit(ListDataSetIterator([_data()], batch=32), epochs=2)
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/train/remote-run/data",
+            timeout=10).read())
+        assert len(data) == 4
+        assert all("score" in d for d in data)
+    finally:
+        server.stop()
