@@ -258,3 +258,34 @@ def test_cnn_loss_layer_masked_shapes():
         per = layer.computeScore(jnp.asarray(y), jnp.asarray(o),
                                  jnp.asarray(m))
         assert np.all(np.isfinite(np.asarray(per)))
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    from deeplearning4j_tpu.utils import ShardedCheckpointer
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    net = _net()
+    net.fit(train, epochs=2)
+    ckpt = ShardedCheckpointer(str(tmp_path / "ck"), keepLast=2)
+    step = ckpt.save(net)
+    w_saved = np.asarray(net.params_["0"]["W"]).copy()
+    it_saved = net.iterationCount
+
+    net.fit(train, epochs=2)        # drift past the checkpoint
+    assert not np.array_equal(np.asarray(net.params_["0"]["W"]), w_saved)
+
+    ckpt.restore(net, step=step)
+    np.testing.assert_array_equal(np.asarray(net.params_["0"]["W"]), w_saved)
+    assert net.iterationCount == it_saved
+    # training resumes cleanly from the restored state
+    net.fit(train, epochs=1)
+    assert np.isfinite(net.score(_toy_data()))
+
+    # retention: keepLast=2 prunes the oldest of three saves
+    s2 = ckpt.save(net, step=step + 100)
+    s3 = ckpt.save(net, step=step + 200)
+    ckpt.waitUntilFinished()
+    steps = set(ckpt.allSteps())
+    assert steps == {s2, s3}, steps          # first save pruned
+    assert ckpt.latestStep() == s3
+    ckpt.restore(net)                        # latest restores fine
+    ckpt.close()
