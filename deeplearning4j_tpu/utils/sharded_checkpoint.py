@@ -76,14 +76,18 @@ class ShardedCheckpointer:
         return list(self._mgr.all_steps())
 
     def restore(self, net, step: Optional[int] = None):
-        """Restore IN PLACE (params/opt/state/counters); returns net."""
-        import orbax.checkpoint as ocp
-        self._mgr.wait_until_finished()    # join in-flight writes first
+        """Restore IN PLACE (params/opt/state/counters); returns net.
+
+        Restores with the CHECKPOINT's own tree structure (not the live
+        net's): a fresh post-preemption net may lack optional slots the
+        save carried (rnn carries, fit key) or differ in their shapes —
+        using the live net as a template would mismatch and crash the
+        resume path this class exists for.
+        """
         step = self.latestStep() if step is None else int(step)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(self._tree(net)))
+        restored = self._mgr.restore(step)
         net.params_ = restored["params"]
         net.optState_ = restored["optState"]
         net.state_ = restored["state"]
@@ -96,5 +100,4 @@ class ShardedCheckpointer:
         return net
 
     def close(self):
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        self._mgr.close()    # joins outstanding writes itself

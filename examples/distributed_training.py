@@ -1,0 +1,49 @@
+"""BASELINE config #5: distributed data-parallel ResNet-50 through
+SharedTrainingMaster.
+
+Shaped like the reference's Spark gradient-sharing example
+(SparkDl4jMultiLayer + SharedTrainingMaster + Aeron mesh) — here the mesh IS
+the TPU mesh: the batch shards over the `data` axis and GSPMD inserts the
+gradient all-reduce (psum over ICI) inside the ONE compiled train step.
+Threshold-compression knobs are accepted for parity (ICI needs none); the
+host-side compression/mesh stack lives in parallel.gradientsharing.
+
+Run multi-host with SharedTrainingMaster.connect(coordinator, rank, n).
+Single-process demo: set XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for a virtual 8-device mesh.
+"""
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.parallel import (DeviceMesh, SharedTrainingMaster,
+                                         SparkDl4jMultiLayer,
+                                         VoidConfiguration)
+from deeplearning4j_tpu.zoo import ResNet50
+
+
+def main(epochs: int = 2, batch: int = 16, numClasses: int = 8,
+         img: int = 64) -> float:
+    import jax
+    mesh = DeviceMesh(data=len(jax.devices()))
+    net = ResNet50(numClasses=numClasses, inputShape=(3, img, img)).init()
+    tm = (SharedTrainingMaster.Builder(VoidConfiguration())
+          .batchSizePerWorker(batch // mesh.dataSize or 1)
+          .mesh(mesh).build())
+    spark_net = SparkDl4jMultiLayer(None, net, tm)
+
+    rng = np.random.RandomState(0)
+    cls = rng.randint(0, numClasses, batch)
+    x = (rng.randn(batch, 3, img, img) * 0.1).astype(np.float32)
+    for i, c in enumerate(cls):
+        x[i, c % 3] += 1.0
+    ds = DataSet(x, np.eye(numClasses, dtype=np.float32)[cls])
+    spark_net.fit(ListDataSetIterator([ds], batch=batch), epochs=epochs)
+    score = net.score(ds)
+    print(f"mesh {mesh} trained {epochs} epochs; loss {score:.4f}")
+    return score
+
+
+if __name__ == "__main__":
+    main(epochs=int(sys.argv[1]) if len(sys.argv) > 1 else 2)

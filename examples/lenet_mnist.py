@@ -1,0 +1,46 @@
+"""BASELINE config #1: LeNet-MNIST with MultiLayerNetwork.
+
+Shaped like dl4j-examples' LeNetMNIST: builder config -> fit -> evaluate.
+Runs on the TPU chip when present; MNIST falls back to a bundled synthetic
+glyph set offline (set $DL4J_TPU_DATA_DIR for the real idx files).
+"""
+import sys
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.optimize import ScoreIterationListener
+
+
+def main(epochs: int = 8, batch: int = 128, n_train: int = 4096,
+         n_test: int = 1024) -> float:
+    conf = (NeuralNetConfiguration.builder().seed(123).updater(Adam(1e-3))
+            .weightInit("XAVIER").list()
+            .layer(ConvolutionLayer.builder().nIn(1).nOut(20)
+                   .kernelSize(5, 5).stride(1, 1).activation("relu").build())
+            .layer(SubsamplingLayer.builder().poolingType("MAX")
+                   .kernelSize(2, 2).stride(2, 2).build())
+            .layer(ConvolutionLayer.builder().nOut(50).kernelSize(5, 5)
+                   .activation("relu").build())
+            .layer(SubsamplingLayer.builder().poolingType("MAX")
+                   .kernelSize(2, 2).stride(2, 2).build())
+            .layer(DenseLayer.builder().nOut(500).activation("relu").build())
+            .layer(OutputLayer.builder("negativeloglikelihood").nOut(10)
+                   .activation("softmax").build())
+            .setInputType(InputType.convolutionalFlat(28, 28, 1)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    net.setListeners(ScoreIterationListener(10))
+    net.fit(MnistDataSetIterator(batch, True, 123, numExamples=n_train),
+            epochs=epochs)
+    ev = net.evaluate(MnistDataSetIterator(256, False, 123,
+                                           numExamples=n_test))
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main(epochs=int(sys.argv[1]) if len(sys.argv) > 1 else 8)

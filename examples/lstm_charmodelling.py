@@ -1,0 +1,61 @@
+"""BASELINE config #4: GravesLSTM character modelling.
+
+Shaped like dl4j-examples' LSTMCharModellingExample: CharacterIterator ->
+stacked GravesLSTM -> RnnOutputLayer, TBPTT training, then sampling with
+rnnTimeStep.  The recurrence compiles to lax.scan (reference:
+CudnnLSTMHelper -> XLA while_loop north star).
+"""
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.characters import CharacterIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (BackpropType, InputType,
+                                        NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM, RnnOutputLayer
+
+_TEXT = ("to be or not to be that is the question "
+         "whether tis nobler in the mind to suffer "
+         "the slings and arrows of outrageous fortune ") * 40
+
+
+def main(epochs: int = 3, batch: int = 16, seqLen: int = 50,
+         hidden: int = 96) -> str:
+    it = CharacterIterator(_TEXT, miniBatchSize=batch,
+                           exampleLength=seqLen, seed=12345)
+    nChars = it.inputColumns()
+    conf = (NeuralNetConfiguration.builder().seed(12345).updater(Adam(5e-3))
+            .weightInit("XAVIER").list()
+            .layer(GravesLSTM.builder().nIn(nChars).nOut(hidden)
+                   .activation("tanh").build())
+            .layer(GravesLSTM.builder().nIn(hidden).nOut(hidden)
+                   .activation("tanh").build())
+            .layer(RnnOutputLayer.builder("mcxent").nIn(hidden).nOut(nChars)
+                   .activation("softmax").build())
+            .backpropType(BackpropType.TruncatedBPTT)
+            .tBPTTForwardLength(25).tBPTTBackwardLength(25)
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    net.fit(it, epochs=epochs)
+
+    # sample with rnnTimeStep (stateful stepping, reference semantics)
+    rng = np.random.RandomState(7)
+    net.rnnClearPreviousState()
+    idx = rng.randint(nChars)
+    out = [it.convertIndexToCharacter(idx)]
+    for _ in range(120):
+        x = np.zeros((1, nChars, 1), np.float32)
+        x[0, idx, 0] = 1.0
+        probs = np.asarray(net.rnnTimeStep(x)).reshape(-1)
+        idx = int(rng.choice(nChars, p=probs / probs.sum()))
+        out.append(it.convertIndexToCharacter(idx))
+    sample = "".join(out)
+    print("sample:", sample)
+    return sample
+
+
+if __name__ == "__main__":
+    main(epochs=int(sys.argv[1]) if len(sys.argv) > 1 else 3)

@@ -289,3 +289,24 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     assert ckpt.latestStep() == s3
     ckpt.restore(net)                        # latest restores fine
     ckpt.close()
+
+
+def test_sharded_checkpoint_restores_into_fresh_net(tmp_path):
+    """Preemption scenario: restore into a brand-new process's net (no
+    template mismatch on optional slots like rnn carries / fit key)."""
+    from deeplearning4j_tpu.utils import ShardedCheckpointer
+    train = ListDataSetIterator([_toy_data()], batch=32)
+    net = _net()
+    net.fit(train, epochs=2)
+    ck = ShardedCheckpointer(str(tmp_path / "ck"))
+    step = ck.save(net)
+    ck.waitUntilFinished()
+    w = np.asarray(net.params_["0"]["W"]).copy()
+
+    fresh = _net()                      # new process simulation
+    ck.restore(fresh, step=step)
+    np.testing.assert_array_equal(np.asarray(fresh.params_["0"]["W"]), w)
+    assert fresh.iterationCount == net.iterationCount
+    fresh.fit(train, epochs=1)          # resumes
+    assert np.isfinite(fresh.score(_toy_data()))
+    ck.close()
