@@ -1617,16 +1617,19 @@ class SameDiff:
                     variables, self._opt_state, ph,
                     jnp.asarray(self.iterationCount, jnp.int32))
                 self.iterationCount += 1
-                losses.append(float(loss))
+                # Device scalar, fetched lazily — a float() here would block
+                # dispatch on a host round-trip every step.
+                losses.append(loss)
                 for l in self._listeners:
                     l.iterationDone(self, at, ds,
                                     Loss(["loss"], [losses[-1]]))
-            for l in self._listeners:
-                l.epochEnd(self, At(epoch=ep,
-                                    iteration=self.iterationCount),
-                           loss_curve=list(losses))
+            if self._listeners:
+                for l in self._listeners:
+                    l.epochEnd(self, At(epoch=ep,
+                                        iteration=self.iterationCount),
+                               loss_curve=[float(x) for x in losses])
         self._arrays.update(variables)
-        return History(losses)
+        return History([float(x) for x in losses])
 
     def _bind(self, ds, cfg) -> Dict[str, jnp.ndarray]:
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet

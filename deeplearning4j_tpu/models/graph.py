@@ -23,7 +23,8 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.learning.config import Sgd
 from deeplearning4j_tpu.learning.regularization import WeightDecay
-from deeplearning4j_tpu.models.multilayer import (_get_leaf, _grad_normalize,
+from deeplearning4j_tpu.models.multilayer import (_apply_updates, _get_leaf,
+                                                  _grad_normalize,
                                                   _iter_leaf_params,
                                                   _param_key_order,
                                                   _reg_penalty, _set_leaf,
@@ -31,7 +32,7 @@ from deeplearning4j_tpu.models.multilayer import (_get_leaf, _grad_normalize,
 from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import Layer
 from deeplearning4j_tpu.ops import NDArray
-from deeplearning4j_tpu.profiler import check_panic
+from deeplearning4j_tpu.profiler import check_panic, panic_enabled
 
 
 class ComputationGraph:
@@ -44,6 +45,7 @@ class ComputationGraph:
         self.epochCount = 0
         self.lastBatchSize = 0
         self._score = 0.0
+        self._scoreArr = None  # pending async device-scalar loss
         self._listeners: List = []
         self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
         self._dtype = jnp.float32
@@ -168,29 +170,10 @@ class ComputationGraph:
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
             (loss, (new_state, data_loss)), grads = grad_fn(
                 params, state, inputs, labels, masks, key)
-            new_params, new_opt = {}, {}
-            for name, lp in params.items():
-                node = self.conf.nodes[name][0]
-                if getattr(node, "frozen", False):
-                    # transfer learning: frozen vertices pass through (same
-                    # contract as MultiLayerNetwork's train step)
-                    new_params[name] = lp
-                    new_opt[name] = optState[name]
-                    continue
-                g = _grad_normalize(node, grads[name])
-                new_params[name], new_opt[name] = {}, {}
-                for path, pname, pval in _iter_leaf_params(lp):
-                    up = self._updaterFor(node, pname)
-                    lr = up.currentLr(iteration, epoch)
-                    update, ostate = up.apply(_get_leaf(g, path),
-                                              optState[name][path],
-                                              lr, iteration, epoch,
-                                              param=pval)
-                    wd = getattr(node, "weightDecay", None)
-                    if wd and pname in node.weightParamKeys():
-                        update = WeightDecay(coeff=wd).apply(pval, update, lr)
-                    _set_leaf(new_params[name], path, pval - update)
-                    new_opt[name][path] = ostate
+            new_params, new_opt = _apply_updates(
+                ((name, self.conf.nodes[name][0]) for name in params),
+                self.conf.globalConf, params, grads, optState, iteration,
+                epoch)
             return new_params, new_opt, new_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -246,9 +229,13 @@ class ComputationGraph:
             jnp.asarray(self.epochCount))
         if new_state:
             self.state_.update(new_state)
-        self._score = float(loss)
-        # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
-        check_panic(self._score)
+        # Async device scalar; score() materializes lazily (see multilayer).
+        self._scoreArr = loss
+        if panic_enabled():
+            # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
+            self._score = float(loss)
+            self._scoreArr = None
+            check_panic(self._score)
         self.iterationCount += 1
         for l in self._listeners:
             l.iterationDone(self, self.iterationCount, self.epochCount)
@@ -282,6 +269,9 @@ class ComputationGraph:
         """With a DataSet: compute the loss on it (reference:
         ``ComputationGraph.score(DataSet)``); without: last training score."""
         if ds is None:
+            if self._scoreArr is not None:
+                self._score = float(self._scoreArr)
+                self._scoreArr = None
             return self._score
         if isinstance(ds, MultiDataSet):
             inputs = tuple(f.jax.astype(self._dtype) for f in ds.features)

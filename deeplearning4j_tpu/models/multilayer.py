@@ -29,7 +29,7 @@ from deeplearning4j_tpu.learning.regularization import WeightDecay
 from deeplearning4j_tpu.nn.conf import (GradientNormalization,
                                         MultiLayerConfiguration)
 from deeplearning4j_tpu.ops import NDArray
-from deeplearning4j_tpu.profiler import check_panic
+from deeplearning4j_tpu.profiler import check_panic, panic_enabled
 
 Params = Dict[str, Dict[str, jax.Array]]
 
@@ -108,6 +108,52 @@ def _updater_for(globalConf, layer, pname: str):
         or Sgd(1e-2)
 
 
+def _apply_updates(units, globalConf, params, grads, optState, iteration,
+                   epoch):
+    """Apply updaters over all trainable leaves (per-leaf math).
+
+    ``units`` is an iterable of ``(key, layer)`` — MLN layer indices or
+    ComputationGraph node names.  Frozen layers pass through untouched;
+    layers with per-layer gradient normalization get their norms over
+    exactly their own leaves.  Returns ``(new_params, new_opt)``.
+
+    Perf note (measured, v5e, ResNet-50 bf16 B=256): concatenating leaves
+    that share an updater config into one flat vector — the reference's
+    flattened-view design (``BaseMultiLayerUpdater`` over
+    ``paramsFlattened``) — was tried and is ~50 ms/step SLOWER than this
+    per-leaf form: XLA keeps conv weights in conv-friendly tiled layouts,
+    and the concat/split forces a layout-normalization copy of every
+    param/grad/updater-state tensor.  Per-leaf updates fuse into ~2 small
+    kernels per tensor and leave layouts alone.
+    """
+    new_params: Dict = {}
+    new_opt: Dict = {}
+    for key, layer in units:
+        if key not in params:
+            continue
+        if getattr(layer, "frozen", False):
+            # Transfer learning (reference: FrozenLayer) — params and updater
+            # state pass through; XLA dead-code-eliminates the unused grads.
+            new_params[key] = params[key]
+            new_opt[key] = optState[key]
+            continue
+        new_params[key] = {}
+        new_opt[key] = {}
+        g = _grad_normalize(layer, grads[key])
+        for path, pname, pval in _iter_leaf_params(params[key]):
+            up = _updater_for(globalConf, layer, pname)
+            lr = up.currentLr(iteration, epoch)
+            update, ostate = up.apply(_get_leaf(g, path),
+                                      optState[key][path], lr,
+                                      iteration, epoch, param=pval)
+            wd = getattr(layer, "weightDecay", None)
+            if wd and pname in layer.weightParamKeys():
+                update = WeightDecay(coeff=wd).apply(pval, update, lr)
+            _set_leaf(new_params[key], path, pval - update)
+            new_opt[key][path] = ostate
+    return new_params, new_opt
+
+
 def _reg_penalty(pairs):
     """L1/L2 penalty over (layer, layer_params) pairs — added to the loss
     (equivalent gradient to the reference's BEFORE_UPDATER modification)."""
@@ -137,6 +183,7 @@ class MultiLayerNetwork:
         self.epochCount = 0
         self.lastBatchSize = 0
         self._score = 0.0
+        self._scoreArr = None  # pending async device-scalar loss
         self._listeners: List = []
         self._rngSeed = int(conf.globalConf.get("seed", 123) or 123)
         self._dtype = jnp.float32
@@ -293,33 +340,10 @@ class MultiLayerNetwork:
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
             (loss, (new_state, new_carries, data_loss)), grads = grad_fn(
                 params, state, x, y, fmask, lmask, key, carries)
-            new_params: Params = {}
-            new_opt: Dict = {}
-            for i, layer in enumerate(layers):
-                li = str(i)
-                if li not in params:
-                    continue
-                if getattr(layer, "frozen", False):
-                    # Transfer learning (reference: FrozenLayer) — params and
-                    # updater state pass through untouched; XLA dead-code-
-                    # eliminates the unused gradient computation.
-                    new_params[li] = params[li]
-                    new_opt[li] = optState[li]
-                    continue
-                g = _grad_normalize(layer, grads[li])
-                new_params[li] = {}
-                new_opt[li] = {}
-                for path, pname, pval in _iter_leaf_params(params[li]):
-                    up = self._updaterFor(layer, pname)
-                    lr = up.currentLr(iteration, epoch)
-                    update, ostate = up.apply(_get_leaf(g, path),
-                                              optState[li][path],
-                                              lr, iteration, epoch, param=pval)
-                    wd = getattr(layer, "weightDecay", None)
-                    if wd and pname in layer.weightParamKeys():
-                        update = WeightDecay(coeff=wd).apply(pval, update, lr)
-                    _set_leaf(new_params[li], path, pval - update)
-                    new_opt[li][path] = ostate
+            new_params, new_opt = _apply_updates(
+                ((str(i), layer) for i, layer in enumerate(layers)),
+                self.conf.globalConf, params, grads, optState, iteration,
+                epoch)
             return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -403,9 +427,16 @@ class MultiLayerNetwork:
             jnp.asarray(self.epochCount), carries)
         if new_state:
             self.state_.update(new_state)
-        self._score = float(loss)
-        # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
-        check_panic(self._score)
+        # Keep the loss as an async device scalar: syncing it here would
+        # serialize every step on a host round-trip (fatal over a TPU
+        # tunnel).  score() materializes it lazily on demand.
+        self._scoreArr = loss
+        if panic_enabled():
+            # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
+            # — opt-in mode that needs the value immediately.
+            self._score = float(loss)
+            self._scoreArr = None
+            check_panic(self._score)
         return new_carries
 
     def _fitTbptt(self, x, y, fmask, lmask) -> None:
@@ -508,6 +539,9 @@ class MultiLayerNetwork:
 
     def score(self, ds: Optional[DataSet] = None) -> float:
         if ds is None:
+            if self._scoreArr is not None:
+                self._score = float(self._scoreArr)
+                self._scoreArr = None
             return self._score
         fmask = ds.featuresMask.jax if ds.featuresMask is not None else None
         lmask = ds.labelsMask.jax if ds.labelsMask is not None else None

@@ -404,8 +404,16 @@ class BatchNormalization(BaseLayer):
         # dtype so a bf16 forward stays bf16 end to end.
         sdt = state["mean"].dtype
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # ONE pass over x: E[x] and E[x^2] are sibling reductions XLA
+            # fuses into a single HBM read (jnp.var would re-derive the mean
+            # -> extra passes over a large activation).  Accumulate in f32:
+            # bf16 reduction over N*H*W elements loses the stats entirely.
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            # clamp: f32 cancellation can drive E[x^2]-mean^2 slightly
+            # negative when |mean| >> std, and sqrt(var+eps) would NaN
+            var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+            mean, var = mean.astype(x.dtype), var.astype(x.dtype)
             new_state = {
                 "mean": self.decay * state["mean"]
                 + (1 - self.decay) * mean.astype(sdt),

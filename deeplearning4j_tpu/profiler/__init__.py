@@ -115,6 +115,16 @@ def check_panic(value: float, where: str = "loss") -> None:
         prof.hookOut(value, where)
 
 
+def panic_enabled() -> bool:
+    """True when NAN/INF panic mode is on — train loops use this to decide
+    whether the per-step loss must be synced to host (panic needs the value
+    NOW; otherwise the loss stays an async device scalar and dispatch never
+    blocks on the host round-trip)."""
+    prof = OpProfiler._instance
+    return prof is not None and (prof.config.checkForNAN or
+                                 prof.config.checkForINF)
+
+
 # -- device-level traces (TensorBoard) --------------------------------------
 
 def start_trace(log_dir: str) -> None:

@@ -190,6 +190,7 @@ class QLearningDiscreteDense:
         if new_state:
             net.state_.update(new_state)
         net._score = float(loss)
+        net._scoreArr = None  # direct set must not be shadowed by a stale async loss
         net.iterationCount += 1
 
     # -- training loop ------------------------------------------------------
