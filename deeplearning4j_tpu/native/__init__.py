@@ -334,6 +334,10 @@ class Workspace:
         nbytes = int(n) * 4
         if self._lib is not None:
             p = self._lib.dl4j_workspace_alloc(self._ptr, nbytes)
+            if not p:  # NULL: allocation failure or destroyed workspace —
+                # from_address would segfault instead of raising
+                raise MemoryError(
+                    f"workspace alloc of {nbytes} bytes failed")
             buf = (ctypes.c_float * int(n)).from_address(p)
             return np.frombuffer(buf, dtype=np.float32)
         a = np.empty(int(n), dtype=np.float32)

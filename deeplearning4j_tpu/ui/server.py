@@ -9,6 +9,7 @@ curve as inline SVG (no JS deps, zero-egress friendly), plus a JSON API
 """
 from __future__ import annotations
 
+import html
 import json
 import math
 import threading
@@ -139,16 +140,28 @@ class UIServer:
                 # overview page
                 parts = ["<html><head><title>DL4J-TPU Training UI</title>"
                          "</head><body><h2>Training overview</h2>"]
+                def _num(v, default=float("nan")):
+                    try:
+                        return float(v)
+                    except (TypeError, ValueError):
+                        return default
+
                 for sid, st in sessions.items():
                     ups = st.getUpdates(sid)
-                    scores = [u["score"] for u in ups if "score" in u]
+                    # escape/coerce: session ids and update values arrive via
+                    # the unauthenticated /train/post — raw rendering would
+                    # be stored XSS, and a non-numeric score would 500 the
+                    # whole overview (stored DoS)
+                    scores = [s for s in (_num(u["score"]) for u in ups
+                                          if "score" in u)
+                              if not math.isnan(s)]
                     last = ups[-1] if ups else {}
                     parts.append(
-                        f"<h3>{sid}</h3>"
+                        f"<h3>{html.escape(str(sid))}</h3>"
                         f"<p>iterations: {len(ups)}; last score: "
-                        f"{last.get('score', float('nan')):.5f}; "
-                        f"it/s: {last.get('iterationsPerSecond', 0):.2f}</p>"
-                        + _svg_score_chart(scores))
+                        f"{_num(last.get('score', float('nan'))):.5f}; "
+                        f"it/s: {_num(last.get('iterationsPerSecond', 0), 0.0):.2f}"
+                        "</p>" + _svg_score_chart(scores))
                 parts.append("</body></html>")
                 self._send("".join(parts))
 

@@ -78,16 +78,43 @@ class ShardedCheckpointer:
     def restore(self, net, step: Optional[int] = None):
         """Restore IN PLACE (params/opt/state/counters); returns net.
 
-        Restores with the CHECKPOINT's own tree structure (not the live
-        net's): a fresh post-preemption net may lack optional slots the
-        save carried (rnn carries, fit key) or differ in their shapes —
-        using the live net as a template would mismatch and crash the
-        resume path this class exists for.
+        When the live net already has device placements, restore is given an
+        abstract template (``jax.ShapeDtypeStruct`` leaves carrying the live
+        arrays' shardings) so each host reads only ITS shards and arrays come
+        back sharded onto the current mesh — a template-free restore would
+        materialize every array fully replicated per host (memory blowup at
+        pod scale).  Falls back to the checkpoint's own tree when the net has
+        no placement yet or its structure/shapes differ from the save (a
+        fresh post-preemption net may lack optional slots like rnn carries
+        or the fit key — the fallback keeps that resume path working).
         """
+        import orbax.checkpoint as ocp
         step = self.latestStep() if step is None else int(step)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        restored = self._mgr.restore(step)
+        restored = None
+        if getattr(net, "params_", None):
+            import jax
+            try:
+                tpl = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                   sharding=a.sharding)
+                    if hasattr(a, "sharding") else a, self._tree(net))
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(tpl))
+            except Exception as e:
+                # structure/shape skew (fresh post-preemption net) -> fall
+                # back to the checkpoint's own tree.  Logged, not silent: the
+                # fallback restores FULLY REPLICATED per host, and an OOM
+                # there should point back to whatever failed here.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "sharded restore with live-net template failed (%s: %s);"
+                    " falling back to template-free (replicated) restore",
+                    type(e).__name__, e)
+                restored = None
+        if restored is None:
+            restored = self._mgr.restore(step)
         net.params_ = restored["params"]
         net.optState_ = restored["optState"]
         net.state_ = restored["state"]

@@ -8,6 +8,7 @@
 #include "dl4j_native.h"
 
 #include <algorithm>
+#include <chrono>
 #include <condition_variable>
 #include <deque>
 #include <functional>
@@ -41,31 +42,64 @@ class ThreadPool {
     const int64_t span = stop - start;
     if (span <= 0) return;
     if (min_chunk < 1) min_chunk = 1;
-    std::shared_lock<std::shared_mutex> guard(config_mu_);
-    int64_t chunks = std::min<int64_t>(size_, (span + min_chunk - 1) / min_chunk);
-    if (chunks <= 1 || size_ <= 1) {
-      fn(start, stop, arg);
-      return;
-    }
     /* Completion count is mutated under mu (not a bare atomic): the worker
      * must not touch mu/cv after the waiter can observe done == chunks, or
      * the waiter could destroy these stack objects under the worker. */
     int64_t done = 0;
     std::mutex mu;
     std::condition_variable cv;
-    const int64_t base = span / chunks, rem = span % chunks;
-    int64_t lo = start;
-    for (int64_t c = 0; c < chunks; ++c) {
-      const int64_t hi = lo + base + (c < rem ? 1 : 0);
-      submit([fn, arg, lo, hi, &done, &mu, &cv, chunks] {
-        fn(lo, hi, arg);
-        std::lock_guard<std::mutex> lk(mu);
-        if (++done == chunks) cv.notify_one();
-      });
-      lo = hi;
+    int64_t chunks, lo = start;
+    {
+      /* The shared config lock covers ONLY sizing + submission.  It must be
+       * released before any chunk body runs on this thread: kernels may
+       * themselves call dl4j_parallel_for, and a recursive lock_shared on a
+       * shared_mutex the thread already holds is UB (and deadlocks under a
+       * writer-preferring implementation when resize() is waiting).  A
+       * resize that sneaks in after submission is safe: shutdown's workers
+       * drain the queue to empty before joining, so submitted chunks still
+       * execute. */
+      std::shared_lock<std::shared_mutex> guard(config_mu_);
+      chunks = std::min<int64_t>(size_, (span + min_chunk - 1) / min_chunk);
+      if (chunks > 1 && size_ > 1) {
+        const int64_t base = span / chunks, rem = span % chunks;
+        for (int64_t c = 0; c < chunks - 1; ++c) {
+          const int64_t hi = lo + base + (c < rem ? 1 : 0);
+          submit([fn, arg, lo, hi, &done, &mu, &cv, chunks] {
+            fn(lo, hi, arg);
+            std::lock_guard<std::mutex> lk(mu);
+            if (++done == chunks) cv.notify_one();
+          });
+          lo = hi;
+        }
+      }
+    }
+    if (chunks <= 1 || lo == start) {  /* no chunks were submitted */
+      fn(start, stop, arg);
+      return;
+    }
+    /* The caller runs the last chunk itself, then HELPS DRAIN the queue
+     * while its chunks are outstanding: a kernel that itself calls
+     * dl4j_parallel_for can therefore never deadlock (on a size-2 pool the
+     * lone worker's nested chunks would otherwise sit queued while it
+     * blocks in wait), and the calling thread is never idle parallelism. */
+    fn(lo, stop, arg);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      ++done;
     }
     std::unique_lock<std::mutex> lk(mu);
-    cv.wait(lk, [&] { return done == chunks; });
+    while (done != chunks) {
+      lk.unlock();
+      if (!run_one_queued()) {
+        lk.lock();
+        /* Bounded wait: a helpable task may be enqueued after the empty
+         * queue check; re-poll rather than sleeping indefinitely. */
+        cv.wait_for(lk, std::chrono::milliseconds(1),
+                    [&] { return done == chunks; });
+      } else {
+        lk.lock();
+      }
+    }
   }
 
  private:
@@ -92,6 +126,20 @@ class ThreadPool {
     for (auto &t : workers_) t.join();
     workers_.clear();
     queue_.clear();
+  }
+
+  /* Pop-and-run one queued task (any parallel_for's chunk — all are
+   * independent closures).  Returns false when the queue is empty. */
+  bool run_one_queued() {
+    std::function<void()> task;
+    {
+      std::lock_guard<std::mutex> lk(queue_mu_);
+      if (queue_.empty()) return false;
+      task = std::move(queue_.front());
+      queue_.pop_front();
+    }
+    task();
+    return true;
   }
 
   void submit(std::function<void()> task) {
