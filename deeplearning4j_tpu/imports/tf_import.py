@@ -119,6 +119,12 @@ def _ph(ctx, node):
 @register_tf_op("Const")
 def _const(ctx, node):
     val = _tensor_value(node)
+    if not np.issubdtype(val.dtype, np.number) and val.dtype != np.bool_:
+        # string/resource consts (Assert messages etc.) — host-side only;
+        # their consumers are dropped bookkeeping nodes
+        ctx.const_vals[node.name] = val
+        ctx.const_vals.setdefault(node.name.split(":")[0], val)
+        return
     if np.issubdtype(val.dtype, np.floating) and val.size > 1:
         v = ctx.sd.var(node.name, val)   # frozen weight -> trainable
     else:
@@ -406,6 +412,35 @@ def _tf_tile(ctx, node):
 def _tf_select(ctx, node):
     ins = [ctx.get(i) for i in _data_inputs(node)[:3]]
     ctx.put(node.name, ctx.sd._op("where", ins, name=node.name))
+
+
+@register_tf_op("Assert")
+def _tf_assert(ctx, node):
+    # Runtime assertion machinery (input-validation subgraphs in frozen
+    # Keras/HF models): dropped at import, like the reference mapper skips
+    # framework bookkeeping nodes.  Its operand subgraph becomes dead code.
+    pass
+
+
+@register_tf_op("Fill")
+def _tf_fill(ctx, node):
+    ins = _data_inputs(node)
+    dims = np.atleast_1d(ctx.const(ins[0])).astype(int).tolist()
+    val = np.atleast_1d(ctx.const(ins[1]))[0]
+    arr = np.full(dims, val)
+    v = ctx.sd.constant(arr, name=node.name)
+    ctx.put(node.name, v, const=arr)
+
+
+@register_tf_op("Range")
+def _tf_range(ctx, node):
+    ins = _data_inputs(node)
+    start = np.atleast_1d(ctx.const(ins[0]))[0]
+    limit = np.atleast_1d(ctx.const(ins[1]))[0]
+    delta = np.atleast_1d(ctx.const(ins[2]))[0]
+    arr = np.arange(start, limit, delta)
+    v = ctx.sd.constant(arr, name=node.name)
+    ctx.put(node.name, v, const=arr)
 
 
 @register_tf_op("Conv2D")

@@ -1,0 +1,123 @@
+"""BASELINE config #3, import variant: frozen BERT GraphDef -> SameDiff.
+
+The reference satisfies "BERT-base via SameDiff TF-import" by running a
+frozen ``bert.pb`` through ``TFGraphMapper.importGraph`` (nd4j-api
+``imports/graphmapper/tf/TFGraphMapper.java``, SURVEY.md §3.3) and
+fine-tuning the imported graph.  This entry point does exactly that:
+
+1. obtain a frozen BERT GraphDef — from ``--pb path/to/bert.pb`` if you have
+   one, else freeze a genuine HuggingFace TF BERT in-process (random-init;
+   zero-egress environment);
+2. ``TFGraphMapper.importGraph`` — Const weights become trainable VARIABLEs;
+3. verify forward parity against TF as the oracle;
+4. attach a classification head and fine-tune with Adam.
+
+The sibling ``bert_finetune.py`` covers the natively-built Bert
+(``zoo/bert.py``) + BertIterator MLM path.
+"""
+import sys
+
+import numpy as np
+
+
+def frozen_bert_graphdef(batch=8, seq=32, vocab=2000, hidden=128, layers=4,
+                         heads=4):
+    """Freeze a real HF TF BERT (the genuine graph structure: gather
+    embeddings, Mean/SquaredDifference/Rsqrt layernorm, BatchMatMulV2
+    attention, Erf GELU) into a GraphDef.
+
+    Static batch in the signature: a ``None`` batch dim makes TF emit
+    Shape/StridedSlice/Pack chains whose values only exist at runtime —
+    the import rules require static shapes (the reference's rule tables
+    have the same constraint; SameDiff graphs land as static-shape XLA
+    executables either way)."""
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    from transformers import BertConfig, TFBertModel
+    cfg = BertConfig(vocab_size=vocab, hidden_size=hidden,
+                     num_hidden_layers=layers, num_attention_heads=heads,
+                     intermediate_size=hidden * 4,
+                     max_position_embeddings=max(seq * 2, 64))
+    model = TFBertModel(cfg)
+
+    @tf.function(input_signature=[tf.TensorSpec([batch, seq], tf.int32),
+                                  tf.TensorSpec([batch, seq], tf.int32)])
+    def f(input_ids, attention_mask):
+        return model(input_ids=input_ids,
+                     attention_mask=attention_mask).last_hidden_state
+
+    frozen = convert_variables_to_constants_v2(f.get_concrete_function())
+    return frozen, frozen.graph.as_graph_def(), hidden
+
+
+def main(pb_path=None, steps=16, batch=8, seq=32):
+    import tensorflow as tf
+
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    from deeplearning4j_tpu.learning import Adam
+
+    if pb_path:
+        gd = pb_path            # TFGraphMapper reads .pb paths directly
+        frozen, hidden = None, None
+        sd = TFGraphMapper.importGraph(gd)
+        import tensorflow as _tf
+        from tensorflow.core.framework import graph_pb2
+        g = graph_pb2.GraphDef()
+        with open(pb_path, "rb") as f:
+            g.ParseFromString(f.read())
+        gd = g
+    else:
+        frozen, gd, hidden = frozen_bert_graphdef(batch=batch, seq=seq)
+        sd = TFGraphMapper.importGraph(gd)
+
+    phs = [n.name for n in gd.node if n.op == "Placeholder"]
+    outname = [n.name for n in gd.node if n.op == "Identity"][-1]
+    ids_ph = [p for p in phs if "input_ids" in p][0]
+    mask_ph = [p for p in phs if "attention_mask" in p][0]
+    print(f"imported {len(gd.node)} nodes; {len(sd.variables())} trainable "
+          f"vars; inputs {phs} -> {outname}")
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, 1999, (batch, seq)).astype(np.int32)
+    mask = np.ones((batch, seq), np.int32)
+
+    if frozen is not None:
+        golden = frozen(tf.constant(ids), tf.constant(mask))
+        golden = (golden[0] if isinstance(golden, (list, tuple))
+                  else golden).numpy()
+        ours = sd.outputSingle({ids_ph: ids, mask_ph: mask}, outname).numpy()
+        diff = float(np.abs(ours - golden).max())
+        print(f"forward parity vs TF oracle: max|diff| = {diff:.2e}")
+        if hidden is None:
+            hidden = ours.shape[-1]
+    else:
+        hidden = sd.outputSingle({ids_ph: ids, mask_ph: mask},
+                                 outname).numpy().shape[-1]
+
+    # classification fine-tune head on the imported encoder
+    w = sd.var("cls/W", rng.randn(hidden, 2).astype(np.float32) * 0.05)
+    labels = sd.placeholder("labels", shape=[batch, 2])
+    logits = sd.getVariable(outname).mean(1).mmul(w)
+    loss = sd.loss().softmaxCrossEntropy(labels, logits, name="loss")
+    sd.setLossVariables(loss)
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Adam(3e-4), dataSetFeatureMapping=[ids_ph, mask_ph],
+        dataSetLabelMapping=["labels"]))
+
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, batch)]
+    mds = MultiDataSet([ids, mask], [y])
+    feed = {ids_ph: ids, mask_ph: mask, "labels": y}
+    l0 = float(sd.outputSingle(feed, "loss").numpy())
+    for _ in range(steps):
+        sd.fit(mds, epochs=1)
+    l1 = float(sd.outputSingle(feed, "loss").numpy())
+    print(f"fine-tune loss {l0:.4f} -> {l1:.4f} over {steps} steps")
+    return l1
+
+
+if __name__ == "__main__":
+    pb = sys.argv[1] if len(sys.argv) > 1 else None
+    main(pb_path=pb)

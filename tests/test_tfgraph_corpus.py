@@ -1,0 +1,375 @@
+"""TF-import golden corpus — the ``TFGraphTestAllSameDiff`` pattern.
+
+Reference: nd4j-tests ``org/nd4j/imports/tfgraphs/TFGraphTestAllSameDiff.java``
+(SURVEY.md §4): a corpus of frozen TF graphs, each executed by TF as the
+oracle and by this framework after import, compared within tolerance.  The
+reference ships ``.pb`` + ``.npy`` resources; here the graphs are built and
+frozen in-process with the installed tensorflow (zero-egress environment) —
+the execution under test is entirely this framework's.
+
+Each corpus entry is ``(name, build_fn)`` where ``build_fn`` returns
+``(tf_callable, [TensorSpec...], feeds)``.  One parameterized test imports
+and compares every entry.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+_R = np.random.RandomState
+
+
+def _spec(*shape, dtype=None):
+    return tf.TensorSpec(list(shape), dtype or tf.float32)
+
+
+def _x(*shape, seed=0, scale=1.0, pos=False):
+    a = _R(seed).randn(*shape).astype(np.float32) * scale
+    return np.abs(a) + 0.1 if pos else a
+
+
+CORPUS = {}
+
+
+def corpus(name):
+    def deco(fn):
+        CORPUS[name] = fn
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------- unary math
+def _unary(name, tf_fn, pos=False, scale=1.0):
+    @corpus(name)
+    def _f(tf_fn=tf_fn, pos=pos, scale=scale):
+        return (lambda x: tf_fn(x), [_spec(3, 4)],
+                {"x": _x(3, 4, seed=1, scale=scale, pos=pos)})
+
+
+_unary("neg", lambda x: -x)
+_unary("exp", lambda x: tf.exp(x))
+_unary("log", lambda x: tf.math.log(x), pos=True)
+_unary("log1p", lambda x: tf.math.log1p(x), pos=True)
+_unary("sqrt", lambda x: tf.sqrt(x), pos=True)
+_unary("rsqrt", lambda x: tf.math.rsqrt(x), pos=True)
+_unary("square", lambda x: tf.square(x))
+_unary("abs", lambda x: tf.abs(x))
+_unary("sign", lambda x: tf.sign(x))
+_unary("floor", lambda x: tf.floor(x), scale=3.0)
+_unary("ceil", lambda x: tf.math.ceil(x), scale=3.0)
+_unary("round", lambda x: tf.round(x), scale=3.0)
+_unary("sin", lambda x: tf.sin(x))
+_unary("cos", lambda x: tf.cos(x))
+_unary("tanh", lambda x: tf.tanh(x))
+_unary("sigmoid", lambda x: tf.sigmoid(x))
+_unary("erf", lambda x: tf.math.erf(x))
+_unary("erfc", lambda x: tf.math.erfc(x))
+_unary("sinh", lambda x: tf.sinh(x))
+_unary("cosh", lambda x: tf.cosh(x))
+_unary("asin", lambda x: tf.asin(x), scale=0.3)
+_unary("acos", lambda x: tf.acos(x), scale=0.3)
+_unary("atan", lambda x: tf.atan(x))
+_unary("relu", lambda x: tf.nn.relu(x))
+_unary("relu6", lambda x: tf.nn.relu6(x), scale=4.0)
+_unary("elu", lambda x: tf.nn.elu(x))
+_unary("selu", lambda x: tf.nn.selu(x))
+_unary("softplus", lambda x: tf.nn.softplus(x))
+_unary("softsign", lambda x: tf.nn.softsign(x))
+_unary("reciprocal", lambda x: tf.math.reciprocal(x), pos=True)
+_unary("leaky_relu", lambda x: tf.nn.leaky_relu(x, alpha=0.3))
+_unary("softmax", lambda x: tf.nn.softmax(x))
+_unary("log_softmax", lambda x: tf.nn.log_softmax(x))
+
+
+# --------------------------------------------------------------- binary math
+def _binary(name, tf_fn, pos_b=False):
+    @corpus(name)
+    def _f(tf_fn=tf_fn, pos_b=pos_b):
+        return (lambda a, b: tf_fn(a, b), [_spec(3, 4), _spec(3, 4)],
+                {"a": _x(3, 4, seed=2), "b": _x(3, 4, seed=3, pos=pos_b)})
+
+
+_binary("add", lambda a, b: a + b)
+_binary("sub", lambda a, b: a - b)
+_binary("mul", lambda a, b: a * b)
+_binary("div", lambda a, b: a / b, pos_b=True)
+_binary("pow", lambda a, b: tf.pow(tf.abs(a) + 0.5, b))
+_binary("maximum", lambda a, b: tf.maximum(a, b))
+_binary("minimum", lambda a, b: tf.minimum(a, b))
+_binary("squared_difference", lambda a, b: tf.math.squared_difference(a, b))
+_binary("floordiv", lambda a, b: tf.math.floordiv(a, b), pos_b=True)
+
+
+@corpus("broadcast_row")
+def _bcast_row():
+    return (lambda a, b: a + b, [_spec(3, 4), _spec(1, 4)],
+            {"a": _x(3, 4, seed=2), "b": _x(1, 4, seed=3)})
+
+
+@corpus("cmp_select")
+def _cmp_select():
+    return (lambda a, b: tf.where(a > b, a, b * 2.0),
+            [_spec(3, 4), _spec(3, 4)],
+            {"a": _x(3, 4, seed=4), "b": _x(3, 4, seed=5)})
+
+
+@corpus("logical_ops")
+def _logical():
+    return (lambda a, b: tf.cast(
+        tf.logical_and(a > 0.0, tf.logical_not(b > 0.0)), tf.float32),
+        [_spec(3, 4), _spec(3, 4)],
+        {"a": _x(3, 4, seed=6), "b": _x(3, 4, seed=7)})
+
+
+# ---------------------------------------------------------------- reductions
+def _reduce(name, tf_fn, axis, keepdims):
+    @corpus(name)
+    def _f(tf_fn=tf_fn, axis=axis, keepdims=keepdims):
+        return (lambda x: tf_fn(x, axis=axis, keepdims=keepdims),
+                [_spec(3, 4, 5)], {"x": _x(3, 4, 5, seed=8)})
+
+
+_reduce("mean_ax1", tf.reduce_mean, 1, False)
+_reduce("sum_keepdims", tf.reduce_sum, -1, True)
+_reduce("max_ax02", tf.reduce_max, (0, 2), False)
+_reduce("min_ax0", tf.reduce_min, 0, False)
+_reduce("prod_ax2", tf.reduce_prod, 2, False)
+
+
+@corpus("argmax")
+def _argmax():
+    return (lambda x: tf.cast(tf.argmax(x, axis=1), tf.float32),
+            [_spec(3, 5)], {"x": _x(3, 5, seed=9)})
+
+
+# ------------------------------------------------------------- shape surgery
+@corpus("reshape_transpose")
+def _resh():
+    return (lambda x: tf.transpose(tf.reshape(x, [4, 3, 5]), [2, 0, 1]),
+            [_spec(3, 4, 5)], {"x": _x(3, 4, 5, seed=10)})
+
+
+@corpus("expand_squeeze")
+def _exp_sq():
+    return (lambda x: tf.squeeze(tf.expand_dims(x, 1) * 2.0, axis=1),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=11)})
+
+
+@corpus("concat_stack")
+def _concat():
+    return (lambda a, b: tf.concat([tf.stack([a, b], axis=0),
+                                    tf.stack([b, a], axis=0)], axis=2),
+            [_spec(3, 4), _spec(3, 4)],
+            {"a": _x(3, 4, seed=12), "b": _x(3, 4, seed=13)})
+
+
+@corpus("tile_pad")
+def _tile_pad():
+    return (lambda x: tf.pad(tf.tile(x, [2, 1]), [[1, 0], [0, 2]],
+                             constant_values=0.5),
+            [_spec(2, 3)], {"x": _x(2, 3, seed=14)})
+
+
+@corpus("slice_basic")
+def _slice():
+    return (lambda x: tf.slice(x, [1, 0, 2], [2, 3, 2]),
+            [_spec(4, 3, 5)], {"x": _x(4, 3, 5, seed=15)})
+
+
+@corpus("strided_slice_step")
+def _sslice():
+    return (lambda x: x[::2, 1:4], [_spec(5, 6)], {"x": _x(5, 6, seed=16)})
+
+
+@corpus("strided_slice_shrink")
+def _sslice_shrink():
+    return (lambda x: x[:, -1], [_spec(4, 6)], {"x": _x(4, 6, seed=17)})
+
+
+@corpus("gather_axis")
+def _gather():
+    idx = tf.constant([2, 0, 1], tf.int32)
+    return (lambda x: tf.gather(x, idx, axis=1),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=18)})
+
+
+@corpus("embedding_gather")
+def _embed():
+    table = tf.Variable(_x(10, 6, seed=19))
+    ids = tf.constant([[1, 3], [7, 0]], tf.int32)
+    return (lambda x: tf.gather(table, ids) + x,
+            [_spec(2, 2, 6)], {"x": _x(2, 2, 6, seed=20)})
+
+
+@corpus("one_hot_matmul")
+def _onehot():
+    ids = tf.constant([0, 2, 1], tf.int32)
+    return (lambda x: tf.matmul(tf.one_hot(ids, 4), x),
+            [_spec(4, 5)], {"x": _x(4, 5, seed=21)})
+
+
+@corpus("fill_range")
+def _fill_range():
+    return (lambda x: x + tf.fill([3, 4], 2.0)
+            + tf.reshape(tf.range(0.0, 4.0, 1.0), [1, 4]),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=22)})
+
+
+@corpus("cast_chain")
+def _cast():
+    return (lambda x: tf.cast(tf.cast(x * 3.0, tf.int32), tf.float32),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=23)})
+
+
+# ----------------------------------------------------------------- linalg/nn
+@corpus("matmul_plain")
+def _mm():
+    w = tf.Variable(_x(4, 6, seed=24))
+    return (lambda x: tf.matmul(x, w), [_spec(3, 4)],
+            {"x": _x(3, 4, seed=25)})
+
+
+@corpus("matmul_transpose_b")
+def _mm_tb():
+    w = tf.Variable(_x(6, 4, seed=26))
+    return (lambda x: tf.matmul(x, w, transpose_b=True), [_spec(3, 4)],
+            {"x": _x(3, 4, seed=27)})
+
+
+@corpus("batch_matmul")
+def _bmm():
+    return (lambda a, b: tf.matmul(a, b), [_spec(2, 3, 4), _spec(2, 4, 5)],
+            {"a": _x(2, 3, 4, seed=28), "b": _x(2, 4, 5, seed=29)})
+
+
+@corpus("batch_matmul_adj")
+def _bmm_adj():
+    return (lambda a, b: tf.matmul(a, b, adjoint_b=True),
+            [_spec(2, 3, 4), _spec(2, 5, 4)],
+            {"a": _x(2, 3, 4, seed=30), "b": _x(2, 5, 4, seed=31)})
+
+
+@corpus("bias_add_nhwc")
+def _bias():
+    b = tf.Variable(_x(5, seed=32))
+    return (lambda x: tf.nn.bias_add(x, b), [_spec(2, 3, 4, 5)],
+            {"x": _x(2, 3, 4, 5, seed=33)})
+
+
+@corpus("addn")
+def _addn():
+    return (lambda a, b: tf.add_n([a, b, a]), [_spec(3, 4), _spec(3, 4)],
+            {"a": _x(3, 4, seed=34), "b": _x(3, 4, seed=35)})
+
+
+@corpus("conv2d_same")
+def _conv_same():
+    w = tf.Variable(_x(3, 3, 2, 4, seed=36, scale=0.5))
+    return (lambda x: tf.nn.conv2d(x, w, strides=1, padding="SAME"),
+            [_spec(2, 8, 8, 2)], {"x": _x(2, 8, 8, 2, seed=37)})
+
+
+@corpus("conv2d_valid_stride2")
+def _conv_valid():
+    w = tf.Variable(_x(3, 3, 2, 4, seed=38, scale=0.5))
+    return (lambda x: tf.nn.conv2d(x, w, strides=2, padding="VALID"),
+            [_spec(2, 9, 9, 2)], {"x": _x(2, 9, 9, 2, seed=39)})
+
+
+@corpus("maxpool")
+def _maxpool():
+    return (lambda x: tf.nn.max_pool2d(x, 2, 2, "VALID"),
+            [_spec(2, 8, 8, 3)], {"x": _x(2, 8, 8, 3, seed=40)})
+
+
+@corpus("avgpool_same")
+def _avgpool():
+    return (lambda x: tf.nn.avg_pool2d(x, 3, 2, "SAME"),
+            [_spec(2, 8, 8, 3)], {"x": _x(2, 8, 8, 3, seed=41)})
+
+
+@corpus("fused_batchnorm_inference")
+def _fbn():
+    g = tf.Variable(np.abs(_x(4, seed=42)) + 0.5)
+    b = tf.Variable(_x(4, seed=43))
+    m = tf.Variable(_x(4, seed=44) * 0.1)
+    v = tf.Variable(np.abs(_x(4, seed=45)) + 0.5)
+    return (lambda x: tf.nn.batch_normalization(
+        x, m, v, b, g, variance_epsilon=1e-3),
+        [_spec(2, 6, 6, 4)], {"x": _x(2, 6, 6, 4, seed=46)})
+
+
+@corpus("layernorm_pattern")
+def _ln():
+    g = tf.Variable(np.ones(6, np.float32))
+    b = tf.Variable(np.zeros(6, np.float32))
+
+    def ln(x):
+        mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mu), axis=-1,
+                             keepdims=True)
+        return (x - mu) * tf.math.rsqrt(var + 1e-6) * g + b
+    return (ln, [_spec(3, 6)], {"x": _x(3, 6, seed=47)})
+
+
+@corpus("gelu_erf_pattern")
+def _gelu():
+    return (lambda x: 0.5 * x * (1.0 + tf.math.erf(
+        x / tf.cast(tf.sqrt(2.0), tf.float32))),
+        [_spec(3, 4)], {"x": _x(3, 4, seed=48)})
+
+
+@corpus("attention_core")
+def _attn():
+    def f(q, k, v):
+        s = tf.matmul(q, k, transpose_b=True) / 2.0
+        return tf.matmul(tf.nn.softmax(s), v)
+    return (f, [_spec(2, 4, 8), _spec(2, 4, 8), _spec(2, 4, 8)],
+            {"q": _x(2, 4, 8, seed=49), "k": _x(2, 4, 8, seed=50),
+             "v": _x(2, 4, 8, seed=51)})
+
+
+@corpus("mlp_two_layer")
+def _mlp():
+    w1 = tf.Variable(_x(6, 8, seed=52, scale=0.5))
+    b1 = tf.Variable(np.zeros(8, np.float32))
+    w2 = tf.Variable(_x(8, 3, seed=53, scale=0.5))
+    return (lambda x: tf.nn.softmax(
+        tf.matmul(tf.nn.relu(tf.matmul(x, w1) + b1), w2)),
+        [_spec(4, 6)], {"x": _x(4, 6, seed=54)})
+
+
+# ----------------------------------------------------------------- the tests
+def _freeze(fn, specs):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    return frozen, frozen.graph.as_graph_def()
+
+
+def test_corpus_size():
+    assert len(CORPUS) >= 60, f"corpus shrank: {len(CORPUS)}"
+
+
+@pytest.mark.parametrize("name", sorted(CORPUS))
+def test_tf_graph(name):
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    fn, specs, feeds = CORPUS[name]()
+    frozen, gd = _freeze(fn, specs)
+    feed_vals = list(feeds.values())
+    golden = frozen(*[tf.constant(v) for v in feed_vals])
+    golden = (golden[0] if isinstance(golden, (list, tuple)) else
+              golden).numpy()
+
+    sd = TFGraphMapper.importGraph(gd)
+    phs = [n.name for n in gd.node if n.op == "Placeholder"]
+    assert len(phs) == len(feed_vals)
+    # Placeholders are NOT in argument order in the frozen graph (TF emits
+    # them in an arbitrary order); match by argument name.
+    feed = {ph: feeds[ph] for ph in phs} if all(p in feeds for p in phs) \
+        else dict(zip(phs, feed_vals))
+    outname = [n.name for n in gd.node if n.op == "Identity"][-1]
+    res = sd.outputSingle(feed, outname).numpy()
+    np.testing.assert_allclose(res, golden, atol=1e-4, rtol=1e-3,
+                               err_msg=f"corpus graph '{name}'")
