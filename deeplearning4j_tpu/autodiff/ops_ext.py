@@ -1,0 +1,751 @@
+"""Extended SameDiff op registry: segment/scatter/reduce3/summarystats/
+image/linalg/rnn families.
+
+Reference: libnd4j ``include/ops/declarable/generic/**`` — the declarable-op
+breadth beyond the core set registered in :mod:`.samediff` (SURVEY.md §2.1:
+parity_ops, broadcastable, images, random, tests in ``DeclarableOpsTests*``).
+Each op here is a thin XLA lowering; autodiff comes from ``jax.grad`` over
+the staged executable, replacing the reference's per-op ``doDiff``.
+
+Imported for its registration side effects at the bottom of ``samediff.py``;
+also defines the ``sd.image()`` / ``sd.rnn()`` / ``sd.linalg()`` namespaces
+(reference: ``org/nd4j/autodiff/samediff/ops/{SDImage,SDRNN,SDLinalg}.java``).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.samediff import (OP_IMPLS, SDMath, SDNN,
+                                                  _Namespace, _axis_op,
+                                                  _ns_binary, _ns_unary,
+                                                  _simple, register_op)
+
+_CORE_OPS = set(OP_IMPLS)   # what samediff.py itself registered
+
+# ---------------------------------------------------------------------------
+# math breadth (reference: generic/transforms, parity_ops)
+# ---------------------------------------------------------------------------
+_simple("expm1", jnp.expm1)
+_simple("log2", lambda x: jnp.log2(x))
+_simple("log10", lambda x: jnp.log10(x))
+_simple("cbrt", jnp.cbrt)
+_simple("cube", lambda x: x * x * x)
+_simple("oneMinus", lambda x: 1.0 - x)
+_simple("timesOneMinus", lambda x: x * (1.0 - x))
+_simple("step", lambda x: (x > 0).astype(x.dtype))
+_simple("trunc", jnp.trunc)
+_simple("rint", jnp.rint)
+_simple("frac", lambda x: x - jnp.trunc(x))
+_simple("lgamma", jax.scipy.special.gammaln)
+_simple("digamma", jax.scipy.special.digamma)
+_simple("igamma", jax.scipy.special.gammainc)
+_simple("igammac", jax.scipy.special.gammaincc)
+_simple("rationalTanh",
+        lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0))
+_simple("rectifiedTanh", lambda x: jnp.maximum(0.0, jnp.tanh(x)))
+_simple("hardSwish", lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+_simple("logAddExp", jnp.logaddexp)
+_simple("heavyside",
+        lambda x: jnp.where(x > 0, 1.0, jnp.where(x < 0, 0.0, 0.5)))
+_simple("invertPermutation",
+        lambda p: jnp.argsort(p.astype(jnp.int32)))
+
+
+@register_op("prelu")
+def _prelu(**_):
+    return lambda x, alpha: jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("thresholdRelu")
+def _threshold_relu(cutoff=0.0, **_):
+    return lambda x: jnp.where(x > cutoff, x, 0.0)
+
+
+@register_op("clipByNorm")
+def _clip_by_norm(clipValue=1.0, dims=None, **_):
+    ax = tuple(dims) if dims else None
+
+    def f(x):
+        n = jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=ax is not None))
+        return x * jnp.minimum(1.0, clipValue / jnp.maximum(n, 1e-12))
+    return f
+
+
+@register_op("standardize")
+def _standardize(dims=None, **_):
+    ax = tuple(dims) if dims is not None else (-1,)
+
+    def f(x):
+        mu = jnp.mean(x, axis=ax, keepdims=True)
+        sd = jnp.std(x, axis=ax, keepdims=True)
+        return (x - mu) / jnp.maximum(sd, 1e-12)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# summary statistics (reference: loops/summarystats + parity entropy ops)
+# ---------------------------------------------------------------------------
+_axis_op("amean", lambda x, axis, keepdims: jnp.mean(jnp.abs(x), axis=axis,
+                                                     keepdims=keepdims))
+_axis_op("amax", lambda x, axis, keepdims: jnp.max(jnp.abs(x), axis=axis,
+                                                   keepdims=keepdims))
+_axis_op("amin", lambda x, axis, keepdims: jnp.min(jnp.abs(x), axis=axis,
+                                                   keepdims=keepdims))
+_axis_op("asum", lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis,
+                                                   keepdims=keepdims))
+_axis_op("logSumExp", lambda x, axis, keepdims: jax.scipy.special.logsumexp(
+    x, axis=axis, keepdims=keepdims))
+_axis_op("entropy", lambda x, axis, keepdims: -jnp.sum(
+    x * jnp.log(jnp.maximum(x, 1e-30)), axis=axis, keepdims=keepdims))
+_axis_op("logEntropy", lambda x, axis, keepdims: jnp.log(jnp.maximum(-jnp.sum(
+    x * jnp.log(jnp.maximum(x, 1e-30)), axis=axis, keepdims=keepdims),
+    1e-30)))
+_axis_op("shannonEntropy", lambda x, axis, keepdims: -jnp.sum(
+    x * jnp.log2(jnp.maximum(x, 1e-30)), axis=axis, keepdims=keepdims))
+_axis_op("zeroFraction", lambda x, axis, keepdims: jnp.mean(
+    (x == 0).astype(jnp.float32), axis=axis, keepdims=keepdims))
+
+
+def _moment(x, axis, keepdims, power):
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    sd = jnp.std(x, axis=axis, keepdims=True)
+    z = (x - mu) / jnp.maximum(sd, 1e-12)
+    return jnp.mean(z ** power, axis=axis, keepdims=keepdims)
+
+
+_axis_op("skewness", functools.partial(_moment, power=3))
+_axis_op("kurtosis", lambda x, axis, keepdims: _moment(
+    x, axis, keepdims, 4) - 3.0)
+
+
+# ---------------------------------------------------------------------------
+# reduce3 / distance family (reference: loops/reduce3, generic distances)
+# ---------------------------------------------------------------------------
+def _dist_op(name, fn):
+    def factory(dims=None, keepDims=False, **_):
+        ax = tuple(dims) if dims is not None else None
+        return lambda x, y: fn(x, y, ax, bool(keepDims))
+    OP_IMPLS[name] = factory
+
+
+_dist_op("euclideanDistance", lambda x, y, ax, kd: jnp.sqrt(
+    jnp.sum((x - y) ** 2, axis=ax, keepdims=kd)))
+_dist_op("manhattanDistance", lambda x, y, ax, kd: jnp.sum(
+    jnp.abs(x - y), axis=ax, keepdims=kd))
+_dist_op("hammingDistance", lambda x, y, ax, kd: jnp.sum(
+    (x != y).astype(jnp.float32), axis=ax, keepdims=kd))
+_dist_op("cosineSimilarity", lambda x, y, ax, kd: jnp.sum(
+    x * y, axis=ax, keepdims=kd) / jnp.maximum(
+    jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=kd))
+    * jnp.sqrt(jnp.sum(y * y, axis=ax, keepdims=kd)), 1e-12))
+_dist_op("jaccardDistance", lambda x, y, ax, kd: 1.0 - jnp.sum(
+    jnp.minimum(x, y), axis=ax, keepdims=kd) / jnp.maximum(jnp.sum(
+        jnp.maximum(x, y), axis=ax, keepdims=kd), 1e-12))
+_dist_op("dot_reduce", lambda x, y, ax, kd: jnp.sum(x * y, axis=ax,
+                                                    keepdims=kd))
+
+
+# ---------------------------------------------------------------------------
+# segment ops (reference: generic/parity_ops/segment_*.cpp)
+# ---------------------------------------------------------------------------
+def _segment(name, seg_fn):
+    def factory(numSegments=None, **_):
+        def f(data, ids):
+            ids = ids.astype(jnp.int32)
+            n = int(numSegments) if numSegments is not None \
+                else None
+            if n is None:
+                raise ValueError(f"{name}: numSegments attr is required "
+                                 "(static output shape)")
+            return seg_fn(data, ids, n)
+        return f
+    OP_IMPLS[name] = factory
+
+
+def _seg_sum(d, i, n):
+    return jax.ops.segment_sum(d, i, num_segments=n)
+
+
+def _seg_count(d, i, n):
+    ones = jnp.ones(d.shape[:1] + (1,) * (d.ndim - 1), d.dtype)
+    return jnp.maximum(jax.ops.segment_sum(
+        jnp.broadcast_to(ones, d.shape), i, num_segments=n), 1.0)
+
+
+_segment("segmentSum", _seg_sum)
+_segment("segmentMean", lambda d, i, n: _seg_sum(d, i, n) / _seg_count(d, i, n))
+_segment("segmentSqrtN", lambda d, i, n: _seg_sum(d, i, n)
+         / jnp.sqrt(_seg_count(d, i, n)))
+_segment("segmentMax", lambda d, i, n: jax.ops.segment_max(
+    d, i, num_segments=n))
+_segment("segmentMin", lambda d, i, n: jax.ops.segment_min(
+    d, i, num_segments=n))
+_segment("segmentProd", lambda d, i, n: jax.ops.segment_prod(
+    d, i, num_segments=n))
+# unsorted variants share the lowering: jax.ops.segment_* never requires
+# sorted ids (the reference's sorted forms are an optimization contract)
+for _u, _s in [("unsortedSegmentSum", "segmentSum"),
+               ("unsortedSegmentMean", "segmentMean"),
+               ("unsortedSegmentSqrtN", "segmentSqrtN"),
+               ("unsortedSegmentMax", "segmentMax"),
+               ("unsortedSegmentMin", "segmentMin"),
+               ("unsortedSegmentProd", "segmentProd")]:
+    OP_IMPLS[_u] = OP_IMPLS[_s]
+
+
+# ---------------------------------------------------------------------------
+# scatter family (reference: generic/parity_ops/scatter_*.cpp — dim-0 slice
+# semantics, like the reference)
+# ---------------------------------------------------------------------------
+def _scatter(name, apply):
+    def factory(**_):
+        return lambda ref, idx, upd: apply(ref, idx.astype(jnp.int32), upd)
+    OP_IMPLS[name] = factory
+
+
+_scatter("scatterSub", lambda r, i, u: r.at[i].subtract(u))
+_scatter("scatterMul", lambda r, i, u: r.at[i].multiply(u))
+_scatter("scatterDiv", lambda r, i, u: r.at[i].divide(u))
+_scatter("scatterMax", lambda r, i, u: r.at[i].max(u))
+_scatter("scatterMin", lambda r, i, u: r.at[i].min(u))
+
+
+@register_op("scatterNd")
+def _scatter_nd(shape=None, **_):
+    def f(idx, upd):
+        out = jnp.zeros(tuple(int(s) for s in shape), upd.dtype)
+        return out.at[tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))].add(
+            upd)
+    return f
+
+
+@register_op("scatterNdAdd")
+def _scatter_nd_add(**_):
+    return lambda ref, idx, upd: ref.at[
+        tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))].add(upd)
+
+
+@register_op("scatterNdSub")
+def _scatter_nd_sub(**_):
+    return lambda ref, idx, upd: ref.at[
+        tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))].subtract(upd)
+
+
+@register_op("scatterNdUpdate")
+def _scatter_nd_update(**_):
+    return lambda ref, idx, upd: ref.at[
+        tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))].set(upd)
+
+
+@register_op("gatherNd")
+def _gather_nd(**_):
+    return lambda x, idx: x[tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))]
+
+
+# ---------------------------------------------------------------------------
+# shape surgery breadth (reference: generic/shape, generic/parity_ops)
+# ---------------------------------------------------------------------------
+@register_op("repeat")
+def _repeat(repeats=1, axis=0, **_):
+    return lambda x: jnp.repeat(x, int(repeats), axis=int(axis))
+
+
+@register_op("reverseSequence")
+def _reverse_sequence(seqAxis=1, batchAxis=0, **_):
+    def f(x, lengths):
+        t = x.shape[seqAxis]
+        idx = jnp.arange(t)
+        lens = lengths.astype(jnp.int32)
+        # per-batch: positions < len are mirrored, the rest stay
+        def rev_one(row_len):
+            return jnp.where(idx < row_len, row_len - 1 - idx, idx)
+        gather_idx = jax.vmap(rev_one)(lens)            # (b, t)
+        xm = jnp.moveaxis(x, (batchAxis, seqAxis), (0, 1))
+        out = jax.vmap(lambda xi, gi: jnp.take(xi, gi, axis=0))(xm, gather_idx)
+        return jnp.moveaxis(out, (0, 1), (batchAxis, seqAxis))
+    return f
+
+
+@register_op("spaceToDepth")
+def _space_to_depth(blockSize=2, dataFormat="NCHW", **_):
+    bs = int(blockSize)
+
+    def f(x):
+        if dataFormat == "NHWC":
+            b, h, w, c = x.shape
+            x = x.reshape(b, h // bs, bs, w // bs, bs, c)
+            return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h // bs, w // bs, c * bs * bs)
+        b, c, h, w = x.shape
+        x = x.reshape(b, c, h // bs, bs, w // bs, bs)
+        return x.transpose(0, 3, 5, 1, 2, 4).reshape(
+            b, c * bs * bs, h // bs, w // bs)
+    return f
+
+
+@register_op("depthToSpace")
+def _depth_to_space(blockSize=2, dataFormat="NCHW", **_):
+    bs = int(blockSize)
+
+    def f(x):
+        if dataFormat == "NHWC":
+            b, h, w, c = x.shape
+            x = x.reshape(b, h, w, bs, bs, c // (bs * bs))
+            return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h * bs, w * bs, c // (bs * bs))
+        b, c, h, w = x.shape
+        x = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+        return x.transpose(0, 3, 4, 1, 5, 2).reshape(
+            b, c // (bs * bs), h * bs, w * bs)
+    return f
+
+
+@register_op("batchToSpace")
+def _batch_to_space(blocks=(2, 2), crops=((0, 0), (0, 0)), **_):
+    b0, b1 = int(blocks[0]), int(blocks[1])
+
+    def f(x):
+        n, h, w, c = x.shape
+        x = x.reshape(b0, b1, n // (b0 * b1), h, w, c)
+        x = x.transpose(2, 3, 0, 4, 1, 5).reshape(
+            n // (b0 * b1), h * b0, w * b1, c)
+        (ct0, cb0), (ct1, cb1) = crops
+        return x[:, ct0:x.shape[1] - cb0 or None,
+                 ct1:x.shape[2] - cb1 or None, :]
+    return f
+
+
+@register_op("spaceToBatch")
+def _space_to_batch(blocks=(2, 2), pads=((0, 0), (0, 0)), **_):
+    b0, b1 = int(blocks[0]), int(blocks[1])
+
+    def f(x):
+        (p0a, p0b), (p1a, p1b) = pads
+        x = jnp.pad(x, ((0, 0), (p0a, p0b), (p1a, p1b), (0, 0)))
+        n, h, w, c = x.shape
+        x = x.reshape(n, h // b0, b0, w // b1, b1, c)
+        return x.transpose(2, 4, 0, 1, 3, 5).reshape(
+            n * b0 * b1, h // b0, w // b1, c)
+    return f
+
+
+@register_op("sequenceMask")
+def _sequence_mask(maxLen=None, dtype="float32", **_):
+    def f(lengths):
+        t = int(maxLen) if maxLen is not None else None
+        if t is None:
+            raise ValueError("sequenceMask: maxLen attr required")
+        return (jnp.arange(t)[None, :]
+                < lengths.astype(jnp.int32)[:, None]).astype(jnp.dtype(dtype))
+    return f
+
+
+@register_op("confusionMatrix")
+def _confusion_matrix(numClasses=None, **_):
+    def f(labels, pred):
+        n = int(numClasses)
+        idx = labels.astype(jnp.int32) * n + pred.astype(jnp.int32)
+        return jnp.bincount(idx, length=n * n).reshape(n, n)
+    return f
+
+
+@register_op("bincount")
+def _bincount(maxLength=None, **_):
+    """``maxLength`` is the EXACT static output length (XLA needs static
+    shapes); values >= maxLength are clipped into the last bin by
+    jnp.bincount semantics — size the histogram for your value range."""
+    def f(x):
+        n = int(maxLength) if maxLength is not None else 0
+        if n <= 0:
+            raise ValueError("bincount: static maxLength attr required")
+        return jnp.bincount(x.astype(jnp.int32).reshape(-1), length=n)
+    return f
+
+
+@register_op("topK")
+def _topk(k=1, sorted=True, **_):
+    def f(x):
+        v, i = lax.top_k(x, int(k))
+        return [v, i]
+    return f
+
+
+@register_op("inTopK")
+def _in_topk(k=1, **_):
+    def f(pred, targets):
+        _, idx = lax.top_k(pred, int(k))
+        return jnp.any(idx == targets.astype(jnp.int32)[:, None], axis=-1)
+    return f
+
+
+@register_op("sortAlongAxis")
+def _sort_axis(axis=-1, descending=False, **_):
+    def f(x):
+        s = jnp.sort(x, axis=int(axis))
+        return jnp.flip(s, axis=int(axis)) if descending else s
+    return f
+
+
+@register_op("argsortAlongAxis")
+def _argsort_axis(axis=-1, descending=False, **_):
+    def f(x):
+        s = jnp.argsort(x, axis=int(axis))
+        return jnp.flip(s, axis=int(axis)) if descending else s
+    return f
+
+
+@register_op("takeAlongAxis")
+def _take_along_axis(axis=-1, **_):
+    return lambda x, i: jnp.take_along_axis(x, i.astype(jnp.int32),
+                                            axis=int(axis))
+
+
+@register_op("split")
+def _split(numSplit=2, dimension=0, **_):
+    def f(x):
+        return list(jnp.split(x, int(numSplit), axis=int(dimension)))
+    return f
+
+
+@register_op("meshgrid")
+def _meshgrid(indexing="xy", **_):
+    def f(*xs):
+        return list(jnp.meshgrid(*xs, indexing=indexing))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# linalg (reference: generic/blas + parity_ops matrix ops)
+# ---------------------------------------------------------------------------
+_simple("matrixInverse", jnp.linalg.inv)
+_simple("matrixDeterminant", jnp.linalg.det)
+_simple("logdet", lambda x: jnp.linalg.slogdet(x)[1])
+_simple("cholesky", jnp.linalg.cholesky)
+_simple("solve", jnp.linalg.solve)
+_simple("matrixDiagPart",
+        lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
+_simple("diag", lambda x: jnp.diagflat(x).reshape(x.shape + x.shape)
+        if x.ndim > 1 else jnp.diag(x))
+
+
+@register_op("triangularSolve")
+def _triangular_solve(lower=True, adjoint=False, **_):
+    return lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=lower, trans=1 if adjoint else 0)
+
+
+@register_op("matrixBandPart")
+def _band_part(numLower=-1, numUpper=-1, **_):
+    def f(x):
+        m, n = x.shape[-2], x.shape[-1]
+        i = jnp.arange(m)[:, None]
+        j = jnp.arange(n)[None, :]
+        keep = jnp.ones((m, n), bool)
+        if int(numLower) >= 0:
+            keep &= (i - j) <= int(numLower)
+        if int(numUpper) >= 0:
+            keep &= (j - i) <= int(numUpper)
+        return jnp.where(keep, x, jnp.zeros((), x.dtype))
+    return f
+
+
+@register_op("matrixSetDiag")
+def _set_diag(**_):
+    def f(x, d):
+        m = min(x.shape[-2], x.shape[-1])
+        i = jnp.arange(m)
+        return x.at[..., i, i].set(d)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# image ops (reference: generic/images/*.cpp — resize_bilinear,
+# resize_nearest, crop_and_resize, adjust_*)
+# ---------------------------------------------------------------------------
+def _resize(name, method):
+    def factory(height=None, width=None, alignCorners=False, **_):
+        def f(x):  # NHWC
+            b, h, w, c = x.shape
+            return jax.image.resize(x, (b, int(height), int(width), c),
+                                    method=method)
+        return f
+    OP_IMPLS[name] = factory
+
+
+_resize("resizeBilinear", "linear")
+_resize("resizeNearestNeighbor", "nearest")
+_resize("resizeBicubic", "cubic")
+
+
+@register_op("cropAndResize")
+def _crop_and_resize(cropHeight=None, cropWidth=None, method="bilinear", **_):
+    ch, cw = int(cropHeight), int(cropWidth)
+    meth = "linear" if method == "bilinear" else "nearest"
+
+    def f(img, boxes, boxIdx):
+        # img NHWC; boxes (n,4) normalized y1,x1,y2,x2; boxIdx (n,)
+        _, h, w, c = img.shape
+
+        def one(box, bi):
+            y1, x1, y2, x2 = box
+            src = img[bi.astype(jnp.int32)]
+            ys = y1 * (h - 1) + jnp.arange(ch) * (y2 - y1) * (h - 1) \
+                / jnp.maximum(ch - 1, 1)
+            xs = x1 * (w - 1) + jnp.arange(cw) * (x2 - x1) * (w - 1) \
+                / jnp.maximum(cw - 1, 1)
+            if meth == "nearest":
+                yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+                xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+                return src[yi][:, xi]
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = (ys - y0)[:, None, None]
+            wx = (xs - x0)[None, :, None]
+            a = src[y0][:, x0]
+            bq = src[y0][:, x1i]
+            cq = src[y1i][:, x0]
+            dq = src[y1i][:, x1i]
+            return (a * (1 - wy) * (1 - wx) + bq * (1 - wy) * wx
+                    + cq * wy * (1 - wx) + dq * wy * wx)
+        return jax.vmap(one)(boxes, boxIdx)
+    return f
+
+
+_simple("imageFlipLeftRight", lambda x: jnp.flip(x, axis=-2))
+_simple("imageFlipUpDown", lambda x: jnp.flip(x, axis=-3))
+_simple("rgbToGrayscale", lambda x: jnp.sum(
+    x * jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype), axis=-1,
+    keepdims=True))
+
+
+@register_op("adjustBrightness")
+def _adjust_brightness(delta=0.0, **_):
+    return lambda x: x + jnp.asarray(delta, x.dtype)
+
+
+@register_op("adjustContrast")
+def _adjust_contrast(factor=1.0, **_):
+    def f(x):
+        mu = jnp.mean(x, axis=(-3, -2), keepdims=True)
+        return (x - mu) * jnp.asarray(factor, x.dtype) + mu
+    return f
+
+
+@register_op("adjustSaturation")
+def _adjust_saturation(factor=1.0, **_):
+    def f(x):
+        gray = jnp.sum(x * jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype),
+                       axis=-1, keepdims=True)
+        return jnp.clip(gray + (x - gray) * jnp.asarray(factor, x.dtype),
+                        0.0, 1.0)
+    return f
+
+
+@register_op("extractImagePatches")
+def _extract_patches(kH=3, kW=3, sH=1, sW=1, isSameMode=False, **_):
+    def f(x):  # NHWC
+        patches = lax.conv_general_dilated_patches(
+            jnp.moveaxis(x, -1, 1), (int(kH), int(kW)), (int(sH), int(sW)),
+            "SAME" if isSameMode else "VALID")
+        # (b, c*kh*kw, oh, ow) -> (b, oh, ow, kh*kw*c)
+        b, ckk, oh, ow = patches.shape
+        c = x.shape[-1]
+        p = patches.reshape(b, c, int(kH) * int(kW), oh, ow)
+        return jnp.moveaxis(p, (1, 2), (4, 3)).reshape(
+            b, oh, ow, int(kH) * int(kW) * c)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# rnn ops (reference: generic/nn/recurrent/{gruCell,lstmCell,lstmLayer}.cpp;
+# sequence forms lower to lax.scan — SURVEY.md §5.7's prescription)
+# ---------------------------------------------------------------------------
+@register_op("gruCell")
+def _gru_cell(**_):
+    def f(x, hLast, Wru, Wc, bru, bc):
+        xh = jnp.concatenate([x, hLast], axis=-1)
+        ru = jax.nn.sigmoid(xh @ Wru + bru)
+        r, u = jnp.split(ru, 2, axis=-1)
+        c = jnp.tanh(jnp.concatenate([x, r * hLast], axis=-1) @ Wc + bc)
+        return u * hLast + (1.0 - u) * c
+    return f
+
+
+@register_op("lstmCell")
+def _lstm_cell(**_):
+    def f(x, hLast, cLast, W, b):
+        z = jnp.concatenate([x, hLast], axis=-1) @ W + b
+        i, fg, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(fg) * cLast + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return [h, c]
+    return f
+
+
+@register_op("gru")
+def _gru_seq(**_):
+    def f(x, h0, Wru, Wc, bru, bc):
+        # x: (t, b, nIn) time-major (reference lstmLayer TNS format)
+        cell = _gru_cell()
+
+        def stepfn(h, xt):
+            h2 = cell(xt, h, Wru, Wc, bru, bc)
+            return h2, h2
+        _, hs = lax.scan(stepfn, h0, x)
+        return hs
+    return f
+
+
+@register_op("lstmLayer")
+def _lstm_layer(**_):
+    def f(x, h0, c0, W, b):
+        cell = _lstm_cell()
+
+        def stepfn(carry, xt):
+            h, c = carry
+            h2, c2 = cell(xt, h, c, W, b)
+            return (h2, c2), h2
+        _, hs = lax.scan(stepfn, (h0, c0), x)
+        return hs
+    return f
+
+
+@register_op("simpleRnnLayer")
+def _simple_rnn_layer(**_):
+    def f(x, h0, Wx, Wh, b):
+        def stepfn(h, xt):
+            h2 = jnp.tanh(xt @ Wx + h @ Wh + b)
+            return h2, h2
+        _, hs = lax.scan(stepfn, h0, x)
+        return hs
+    return f
+
+
+# ---------------------------------------------------------------------------
+# namespaces (reference: org/nd4j/autodiff/samediff/ops/*.java)
+# ---------------------------------------------------------------------------
+class SDImage(_Namespace):
+    def resizeBilinear(self, x, height, width, name=None):
+        return self.sd._op("resizeBilinear", [x],
+                           {"height": height, "width": width}, name=name)
+
+    def resizeNearestNeighbor(self, x, height, width, name=None):
+        return self.sd._op("resizeNearestNeighbor", [x],
+                           {"height": height, "width": width}, name=name)
+
+    def resizeBiCubic(self, x, height, width, name=None):
+        return self.sd._op("resizeBicubic", [x],
+                           {"height": height, "width": width}, name=name)
+
+    def cropAndResize(self, img, boxes, boxIdx, cropHeight, cropWidth,
+                      method="bilinear", name=None):
+        return self.sd._op("cropAndResize", [img, boxes, boxIdx],
+                           {"cropHeight": cropHeight, "cropWidth": cropWidth,
+                            "method": method}, name=name)
+
+    def adjustBrightness(self, x, delta, name=None):
+        return self.sd._op("adjustBrightness", [x], {"delta": delta},
+                           name=name)
+
+    def adjustContrast(self, x, factor, name=None):
+        return self.sd._op("adjustContrast", [x], {"factor": factor},
+                           name=name)
+
+    def adjustSaturation(self, x, factor, name=None):
+        return self.sd._op("adjustSaturation", [x], {"factor": factor},
+                           name=name)
+
+    def flipLeftRight(self, x, name=None):
+        return self.sd._op("imageFlipLeftRight", [x], name=name)
+
+    def flipUpDown(self, x, name=None):
+        return self.sd._op("imageFlipUpDown", [x], name=name)
+
+    def rgbToGrayscale(self, x, name=None):
+        return self.sd._op("rgbToGrayscale", [x], name=name)
+
+    def extractImagePatches(self, x, kH, kW, sH=1, sW=1, sameMode=False,
+                            name=None):
+        return self.sd._op("extractImagePatches", [x],
+                           {"kH": kH, "kW": kW, "sH": sH, "sW": sW,
+                            "isSameMode": sameMode}, name=name)
+
+
+class SDRNN(_Namespace):
+    def gruCell(self, x, hLast, Wru, Wc, bru, bc, name=None):
+        return self.sd._op("gruCell", [x, hLast, Wru, Wc, bru, bc], name=name)
+
+    def lstmCell(self, x, hLast, cLast, W, b, name=None):
+        return self.sd._op("lstmCell", [x, hLast, cLast, W, b], n_out=2,
+                           name=name)
+
+    def gru(self, x, h0, Wru, Wc, bru, bc, name=None):
+        """Full sequence, time-major x (t, b, nIn) -> (t, b, nOut)."""
+        return self.sd._op("gru", [x, h0, Wru, Wc, bru, bc], name=name)
+
+    def lstmLayer(self, x, h0, c0, W, b, name=None):
+        """Full sequence, time-major x (t, b, nIn) -> (t, b, nOut)."""
+        return self.sd._op("lstmLayer", [x, h0, c0, W, b], name=name)
+
+    def simpleRnn(self, x, h0, Wx, Wh, b, name=None):
+        return self.sd._op("simpleRnnLayer", [x, h0, Wx, Wh, b], name=name)
+
+
+class SDLinalg(_Namespace):
+    def inverse(self, x, name=None):
+        return self.sd._op("matrixInverse", [x], name=name)
+
+    def det(self, x, name=None):
+        return self.sd._op("matrixDeterminant", [x], name=name)
+
+    def logdet(self, x, name=None):
+        return self.sd._op("logdet", [x], name=name)
+
+    def cholesky(self, x, name=None):
+        return self.sd._op("cholesky", [x], name=name)
+
+    def solve(self, a, b, name=None):
+        return self.sd._op("solve", [a, b], name=name)
+
+    def triangularSolve(self, a, b, lower=True, adjoint=False, name=None):
+        return self.sd._op("triangularSolve", [a, b],
+                           {"lower": lower, "adjoint": adjoint}, name=name)
+
+    def matrixBandPart(self, x, numLower, numUpper, name=None):
+        return self.sd._op("matrixBandPart", [x],
+                           {"numLower": numLower, "numUpper": numUpper},
+                           name=name)
+
+    def diagPart(self, x, name=None):
+        return self.sd._op("matrixDiagPart", [x], name=name)
+
+    def mmul(self, a, b, transposeA=False, transposeB=False, name=None):
+        return self.sd._op("mmul", [a, b], {"transposeA": transposeA,
+                                            "transposeB": transposeB},
+                           name=name)
+
+
+# extend sd.math()/sd.nn() with the new elementwise breadth
+for _n in ["expm1", "log2", "log10", "cbrt", "cube", "oneMinus",
+           "timesOneMinus", "step", "trunc", "rint", "frac", "lgamma",
+           "digamma", "logSumExp", "entropy", "shannonEntropy", "amean",
+           "amax", "amin", "asum", "skewness", "kurtosis", "standardize",
+           "invertPermutation"]:
+    setattr(SDMath, _n, _ns_unary(_n))
+for _n in ["logAddExp", "igamma", "igammac", "euclideanDistance",
+           "manhattanDistance", "hammingDistance", "cosineSimilarity",
+           "jaccardDistance"]:
+    setattr(SDMath, _n, _ns_binary(_n))
+for _n in ["rationalTanh", "rectifiedTanh", "hardSwish"]:
+    setattr(SDNN, _n, _ns_unary(_n))
+del _n
+
+#: names THIS module added to the registry (coverage-gate bookkeeping:
+#: distinguishes "ops_ext battery didn't run" from "op lacks a test")
+OPS_EXT_NAMES = set(OP_IMPLS) - _CORE_OPS

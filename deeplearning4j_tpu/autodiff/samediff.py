@@ -1104,6 +1104,24 @@ class SameDiff:
     def loss(self): return self._loss
     def random(self): return self._random
 
+    def image(self):
+        if not hasattr(self, "_image"):
+            from deeplearning4j_tpu.autodiff.ops_ext import SDImage
+            self._image = SDImage(self)
+        return self._image
+
+    def rnn(self):
+        if not hasattr(self, "_rnn"):
+            from deeplearning4j_tpu.autodiff.ops_ext import SDRNN
+            self._rnn = SDRNN(self)
+        return self._rnn
+
+    def linalg(self):
+        if not hasattr(self, "_linalg"):
+            from deeplearning4j_tpu.autodiff.ops_ext import SDLinalg
+            self._linalg = SDLinalg(self)
+        return self._linalg
+
     # ---------------- variable management ----------------
     def _unique(self, base: str) -> str:
         if base not in self._vars:
@@ -1448,7 +1466,7 @@ class SameDiff:
                     res = impl(*args, it)
                 else:
                     res = impl(*args)
-                if isinstance(res, tuple):
+                if isinstance(res, (tuple, list)):
                     for nm, r in zip(node.outputs, res):
                         env[nm] = r
                 else:
@@ -1708,7 +1726,7 @@ class SameDiff:
                 res = args[0]
             else:
                 res = OP_IMPLS[node.op](**node.attrs)(*args)
-            res_t = res if isinstance(res, tuple) else (res,)
+            res_t = res if isinstance(res, (tuple, list)) else (res,)
             for nm, r in zip(node.outputs, res_t):
                 env[nm] = r
             for l in self._listeners:
@@ -1808,3 +1826,9 @@ class History:
 
     def finalTrainingLoss(self) -> float:
         return self._losses[-1] if self._losses else float("nan")
+
+
+# Extended declarable-op families (segment/scatter/reduce3/summarystats/
+# image/linalg/rnn) register themselves into OP_IMPLS on import; kept in a
+# sibling module so this file stays the core graph machinery.
+from deeplearning4j_tpu.autodiff import ops_ext  # noqa: E402,F401  isort:skip

@@ -88,10 +88,36 @@ class OpValidation:
                 md = float(np.abs(got - exp).max())
                 return f"{name}: max abs diff {md} > {tc._tolerance}"
         if tc._gradCheck and sd.getLossVariables():
-            from deeplearning4j_tpu.autodiff.gradcheck import GradCheckUtil
-            ok = GradCheckUtil.checkGradients(sd, tc._placeholders)
-            if not ok:
-                return "gradient check failed"
+            err = cls._gradient_check(sd, tc)
+            if err:
+                return err
+        return None
+
+    @classmethod
+    def _gradient_check(cls, sd: SameDiff, tc: TestCase) -> Optional[str]:
+        """Central-difference vs jax.grad over the graph's loss variables,
+        perturbing the FLOAT placeholders (reference: TestCase.gradientCheck
+        → GradientCheckUtil central differences)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+        loss_names = tuple(sd.getLossVariables())
+        fn = sd._build_fn(loss_names)
+        var_vals = sd._var_values()
+        float_phs = {k: np.asarray(v) for k, v in tc._placeholders.items()
+                     if np.issubdtype(np.asarray(v).dtype, np.floating)}
+        other_phs = {k: np.asarray(v) for k, v in tc._placeholders.items()
+                     if k not in float_phs}
+
+        def loss_fn(p):
+            res = fn({**other_phs, **p}, var_vals, 0)
+            return sum(jnp.sum(v) for v in res.values())
+
+        r = check_gradients(loss_fn, float_phs)
+        if not r.passed:
+            return (f"gradient check failed: {r.totalFailures}/"
+                    f"{r.totalParams} coords, maxRelErr={r.maxRelError:.3g},"
+                    f" first={r.failures[:3]}")
         return None
 
     @classmethod

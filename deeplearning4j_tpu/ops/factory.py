@@ -252,9 +252,15 @@ class Nd4j:
     def scatterUpdate(a, indices, updates, dim: int = 0) -> NDArray:
         av = _v(a)
         idx = _v(indices).astype(jnp.int32)
-        if dim != 0:
-            raise NotImplementedError("scatterUpdate only supports dim=0")
-        return NDArray(av.at[idx].set(_v(updates)))
+        dim = int(dim)
+        if dim == 0:
+            return NDArray(av.at[idx].set(_v(updates)))
+        # general axis: move the scatter axis to the front, scatter on
+        # dim 0, move back (one transposed .at[].set — XLA fuses the moves)
+        avm = jnp.moveaxis(av, dim, 0)
+        upd = jnp.moveaxis(_v(updates), dim, 0) if _v(updates).ndim == av.ndim \
+            else _v(updates)
+        return NDArray(jnp.moveaxis(avm.at[idx].set(upd), 0, dim))
 
     @staticmethod
     def oneHot(indices, depth: int, dtype=None) -> NDArray:

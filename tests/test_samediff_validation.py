@@ -202,8 +202,18 @@ def test_save_rejects_control_flow():
 def test_registered_op_coverage():
     """The reference fails CI when registered ops lack coverage
     (OpValidation.allOpsTested).  The battery above plus the dedicated
-    suites (test_samediff, test_nlp_bert, test_imports) must keep coverage
-    high; anything newly registered without a test shows up here."""
+    suites (test_samediff, test_ops_ext_validation, test_imports) must keep
+    coverage high; anything newly registered without a test shows up here.
+
+    Coverage accounting is process-wide: the gate only judges when the
+    batteries actually ran in this process (full ``pytest tests/`` runs
+    them first in collection order).  A filtered single-file run skips
+    rather than reporting a bogus 30% coverage; a run where the ops_ext
+    battery DID run but left ops untested still fails."""
+    from deeplearning4j_tpu.autodiff.ops_ext import OPS_EXT_NAMES
+    if not (OpValidation._tested & OPS_EXT_NAMES):
+        pytest.skip("ops_ext validation battery did not run in this process "
+                    "(filtered run) — coverage gate judged only on full runs")
     # credit ops exercised by the other suites through their own asserts
     OpValidation.recordTested(
         "conv2d", "maxPooling2d", "avgPooling2d", "batchNorm", "layerNorm",
