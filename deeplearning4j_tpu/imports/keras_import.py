@@ -96,24 +96,26 @@ class KerasModelImport:
             layers_cfg = model_cfg["config"]
             if isinstance(layers_cfg, dict):
                 layers_cfg = layers_cfg.get("layers", [])
+            store = _WeightStore(f)
             if cls in ("Functional", "Model"):
-                layers_cfg = _linearize_functional(layers_cfg)
+                chain = _linearize_functional(layers_cfg)
+                if chain is None:   # branching -> ComputationGraph
+                    return _build_graph(layers_cfg, store)
+                layers_cfg = chain
             elif cls != "Sequential":
                 raise ValueError(f"Unsupported Keras model class: {cls}")
-            store = _WeightStore(f)
             return _build_sequential(layers_cfg, store, InputType,
                                      NeuralNetConfiguration,
                                      MultiLayerNetwork)
 
-    # parity name: also accepts Functional models whose graph is a linear
-    # chain (branching functional models are not yet supported)
+    # parity name (reference: KerasModelImport.importKerasModelAndWeights):
+    # linear Functional chains come back as MultiLayerNetwork, branching
+    # topologies (merge/residual) as ComputationGraph — like the reference.
     importKerasModelAndWeights = importKerasSequentialModelAndWeights
 
 
-def _linearize_functional(layers_cfg: List[Dict]) -> List[Dict]:
-    """Order a Functional model's layers as a linear chain via inbound_nodes;
-    raises on branching topologies (DL4J maps those to ComputationGraph —
-    not yet supported here)."""
+def _inbound_edges(layers_cfg: List[Dict]) -> Dict[str, List[str]]:
+    """keras layer name -> list of source layer names (keras2 + keras3)."""
     inbound: Dict[str, List[str]] = {}
     for lk in layers_cfg:
         name = _cfg(lk).get("name", lk.get("name"))
@@ -121,6 +123,7 @@ def _linearize_functional(layers_cfg: List[Dict]) -> List[Dict]:
         for node in lk.get("inbound_nodes", []):
             if isinstance(node, dict):    # keras3 format
                 args = node.get("args", [])
+
                 def walk(a):
                     if isinstance(a, dict) and "config" in a and \
                             isinstance(a["config"], dict) and \
@@ -135,15 +138,20 @@ def _linearize_functional(layers_cfg: List[Dict]) -> List[Dict]:
                     if entry and isinstance(entry, (list, tuple)):
                         srcs.append(entry[0])
         inbound[name] = srcs
+    return inbound
+
+
+def _linearize_functional(layers_cfg: List[Dict]) -> Optional[List[Dict]]:
+    """Order a Functional model's layers as a linear chain via inbound_nodes;
+    returns None on branching topologies (those import as ComputationGraph)."""
+    inbound = _inbound_edges(layers_cfg)
     if any(len(s) > 1 for s in inbound.values()):
-        raise ValueError("Keras import: branching functional models are not "
-                         "supported yet (linear chains only)")
-    # chain order: start at the layer with no inbound
+        return None
     by_name = {_cfg(lk).get("name", lk.get("name")): lk for lk in layers_cfg}
     succ = {s[0]: n for n, s in inbound.items() if s}
     starts = [n for n, s in inbound.items() if not s]
     if len(starts) != 1:
-        raise ValueError("Keras import: expected exactly one input layer")
+        return None          # multiple inputs -> graph path
     order, cur = [], starts[0]
     while cur is not None:
         order.append(by_name[cur])
@@ -180,26 +188,184 @@ def _input_type(cfg: Dict, InputType):
     raise ValueError(f"Unsupported input shape {shape}")
 
 
-def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
-                      MultiLayerNetwork):
+#: kinds that carry weights (their keras name is kept for the weight store)
+_WEIGHTY = {"dense", "conv", "bn", "lstm", "embedding", "sepconv", "dwconv",
+            "deconv", "simplernn", "gru"}
+#: kinds whose output stays in CNN format (conv-shape tracking continues)
+_CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
+              "dwconv", "deconv"}
+
+
+def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
+    """One Keras layer config -> ``(our_layer, kind, out_channels)``.
+
+    ``out_channels``: int = new channel count; None = channels unchanged;
+    ``("mult", m)`` = multiply current channels (depthwise).  Returns None
+    for unsupported classes.  Shared by the Sequential and the
+    ComputationGraph (branching Functional) import paths.
+    """
     from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
                                                    BatchNormalization,
                                                    ConvolutionLayer,
                                                    DenseLayer, DropoutLayer,
                                                    EmbeddingSequenceLayer,
+                                                   GlobalPoolingLayer,
                                                    OutputLayer,
                                                    SubsamplingLayer)
-    from deeplearning4j_tpu.nn.conf.recurrent import LSTM
+    if cls == "Dropout":
+        rate = float(cfg.get("rate", 0.5))
+        return DropoutLayer(dropOut=1.0 - rate), "dropout", None
+    if cls == "Activation":
+        return (ActivationLayer(activation=_act(cfg.get("activation"))),
+                "activation", None)
+    if cls == "Dense":
+        units = int(cfg["units"])
+        act = _act(cfg.get("activation"))
+        if is_last and act == "softmax":
+            lay = OutputLayer.builder("mcxent").nOut(units) \
+                .activation("softmax").build()
+        else:
+            lay = DenseLayer(nOut=units, activation=act)
+        return lay, "dense", None
+    if cls == "Conv2D":
+        if cfg.get("data_format") == "channels_first":
+            raise ValueError("Keras import: channels_first Conv2D is "
+                             "not supported (save as channels_last)")
+        k = cfg.get("kernel_size", [3, 3])
+        s = cfg.get("strides", [1, 1])
+        d = cfg.get("dilation_rate", [1, 1])
+        same = cfg.get("padding", "valid") == "same"
+        lay = ConvolutionLayer(
+            nOut=int(cfg["filters"]), kernelSize=tuple(int(x) for x in k),
+            stride=tuple(int(x) for x in s),
+            dilation=tuple(int(x) for x in d),
+            convolutionMode="Same" if same else "Truncate",
+            activation=_act(cfg.get("activation")),
+            hasBias=bool(cfg.get("use_bias", True)))
+        return lay, "conv", int(cfg["filters"])
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        k = cfg.get("pool_size", [2, 2])
+        s = cfg.get("strides") or k
+        same = cfg.get("padding", "valid") == "same"
+        lay = SubsamplingLayer(
+            kernelSize=tuple(int(x) for x in k),
+            stride=tuple(int(x) for x in s),
+            convolutionMode="Same" if same else "Truncate",
+            poolingType="MAX" if cls == "MaxPooling2D" else "AVG")
+        return lay, "pool", None
+    if cls == "BatchNormalization":
+        return (BatchNormalization(eps=float(cfg.get("epsilon", 1e-3))),
+                "bn", None)
+    if cls == "LSTM":
+        from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
+        lstm = LSTM(nOut=int(cfg["units"]),
+                    activation=_act(cfg.get("activation", "tanh")))
+        lay = lstm if cfg.get("return_sequences", False) \
+            else LastTimeStep(lstm)
+        return lay, "lstm", None
+    if cls == "Embedding":
+        return (EmbeddingSequenceLayer(nIn=int(cfg["input_dim"]),
+                                       nOut=int(cfg["output_dim"])),
+                "embedding", None)
+    if cls == "UpSampling2D":
+        from deeplearning4j_tpu.nn.conf.convolutional import Upsampling2D
+        interp = cfg.get("interpolation", "nearest")
+        if interp != "nearest":
+            raise ValueError(
+                f"Keras import: UpSampling2D interpolation={interp!r} "
+                "is unsupported (only 'nearest'); importing it silently "
+                "would change the numerics")
+        sz = cfg.get("size", [2, 2])
+        return Upsampling2D(size=tuple(int(x) for x in sz)), "upsample", None
+    if cls == "ZeroPadding2D":
+        from deeplearning4j_tpu.nn.conf.convolutional import ZeroPaddingLayer
+        p = cfg.get("padding", [[1, 1], [1, 1]])
+        if isinstance(p, int):
+            pad = (p, p, p, p)
+        elif isinstance(p[0], (list, tuple)):
+            pad = (int(p[0][0]), int(p[0][1]), int(p[1][0]), int(p[1][1]))
+        else:
+            pad = (int(p[0]), int(p[0]), int(p[1]), int(p[1]))
+        return ZeroPaddingLayer(padding=pad), "zeropad", None
+    if cls == "Cropping2D":
+        from deeplearning4j_tpu.nn.conf.convolutional import Cropping2D
+        p = cfg.get("cropping", [[0, 0], [0, 0]])
+        if isinstance(p[0], (list, tuple)):
+            crop = (int(p[0][0]), int(p[0][1]), int(p[1][0]), int(p[1][1]))
+        else:
+            crop = (int(p[0]), int(p[0]), int(p[1]), int(p[1]))
+        return Cropping2D(cropping=crop), "crop", None
+    if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        return (GlobalPoolingLayer(
+            poolingType="AVG" if "Average" in cls else "MAX"),
+            "globalpool", None)
+    if cls in ("SeparableConv2D", "DepthwiseConv2D"):
+        from deeplearning4j_tpu.nn.conf.convolutional import (
+            DepthwiseConvolution2D, SeparableConvolution2D)
+        k = cfg.get("kernel_size", [3, 3])
+        s = cfg.get("strides", [1, 1])
+        same = cfg.get("padding", "valid") == "same"
+        dm = int(cfg.get("depth_multiplier", 1))
+        common = dict(kernelSize=tuple(int(x) for x in k),
+                      stride=tuple(int(x) for x in s),
+                      depthMultiplier=dm,
+                      convolutionMode="Same" if same else "Truncate",
+                      activation=_act(cfg.get("activation")),
+                      hasBias=bool(cfg.get("use_bias", True)))
+        if cls == "SeparableConv2D":
+            return (SeparableConvolution2D(nOut=int(cfg["filters"]),
+                                           **common),
+                    "sepconv", int(cfg["filters"]))
+        return DepthwiseConvolution2D(**common), "dwconv", ("mult", dm)
+    if cls == "Conv2DTranspose":
+        from deeplearning4j_tpu.nn.conf.convolutional import Deconvolution2D
+        k = cfg.get("kernel_size", [2, 2])
+        s = cfg.get("strides", [2, 2])
+        same = cfg.get("padding", "valid") == "same"
+        lay = Deconvolution2D(
+            nOut=int(cfg["filters"]),
+            kernelSize=tuple(int(x) for x in k),
+            stride=tuple(int(x) for x in s),
+            convolutionMode="Same" if same else "Truncate",
+            activation=_act(cfg.get("activation")),
+            hasBias=bool(cfg.get("use_bias", True)))
+        return lay, "deconv", int(cfg["filters"])
+    if cls == "SimpleRNN":
+        from deeplearning4j_tpu.nn.conf.recurrent import (LastTimeStep,
+                                                          SimpleRnn)
+        rnn = SimpleRnn(nOut=int(cfg["units"]),
+                        activation=_act(cfg.get("activation", "tanh")))
+        lay = rnn if cfg.get("return_sequences", False) \
+            else LastTimeStep(rnn)
+        return lay, "simplernn", None
+    if cls == "GRU":
+        from deeplearning4j_tpu.nn.conf.recurrent import (GRU as OurGRU,
+                                                          LastTimeStep)
+        gru = OurGRU(nOut=int(cfg["units"]),
+                     activation=_act(cfg.get("activation", "tanh")),
+                     resetAfter=bool(cfg.get("reset_after", True)))
+        lay = gru if cfg.get("return_sequences", False) \
+            else LastTimeStep(gru)
+        return lay, "gru", None
+    return None
 
+
+def _out_channels(out_c, cur_shape):
+    if isinstance(out_c, tuple):     # ("mult", m): depthwise
+        return cur_shape[2] * out_c[1] if cur_shape else None
+    return out_c
+
+
+def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
+                      MultiLayerNetwork):
     builder = NeuralNetConfiguration.builder().list()
     input_type = None
     our_layers: List[Tuple[Any, Optional[str], str]] = []  # (layer, kname, kind)
     kcfgs: Dict[str, Dict] = {}        # keras layer name -> its config dict
-    flatten_from_conv = False
     pending_flatten: Dict[int, Tuple[int, int, int]] = {}
     cur_conv_shape: Optional[Tuple[int, int, int]] = None  # (h, w, c) Keras
 
-    idx = 0
     n_layers = len(layers_cfg)
     for li, lk in enumerate(layers_cfg):
         cls = lk["class_name"]
@@ -216,192 +382,19 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         if cls == "InputLayer":
             continue
         if cls == "Flatten":
-            flatten_from_conv = cur_conv_shape is not None
-            if flatten_from_conv:
+            if cur_conv_shape is not None:
                 pending_flatten[len(our_layers)] = cur_conv_shape
             continue
-        if cls == "Dropout":
-            rate = float(cfg.get("rate", 0.5))
-            our_layers.append((DropoutLayer(dropOut=1.0 - rate), None,
-                               "dropout"))
-            continue
-        if cls == "Activation":
-            our_layers.append((
-                ActivationLayer(activation=_act(cfg.get("activation"))),
-                None, "activation"))
-            continue
-        if cls == "Dense":
-            units = int(cfg["units"])
-            act = _act(cfg.get("activation"))
-            is_last = li == n_layers - 1
-            if is_last and act == "softmax":
-                lay = OutputLayer.builder("mcxent").nOut(units) \
-                    .activation("softmax").build()
-            else:
-                lay = DenseLayer(nOut=units, activation=act)
-            our_layers.append((lay, kname, "dense"))
+        mapped = _map_keras_layer(cls, cfg, is_last=(li == n_layers - 1))
+        if mapped is None:
+            raise ValueError(f"Keras import: unsupported layer {cls}")
+        lay, kind, out_c = mapped
+        our_layers.append((lay, kname if kind in _WEIGHTY else None, kind))
+        if kind in ("dense", "globalpool"):
             cur_conv_shape = None
-            continue
-        if cls == "Conv2D":
-            if cfg.get("data_format") == "channels_first":
-                raise ValueError("Keras import: channels_first Conv2D is "
-                                 "not supported (save as channels_last)")
-            k = cfg.get("kernel_size", [3, 3])
-            s = cfg.get("strides", [1, 1])
-            d = cfg.get("dilation_rate", [1, 1])
-            same = cfg.get("padding", "valid") == "same"
-            lay = ConvolutionLayer(
-                nOut=int(cfg["filters"]), kernelSize=tuple(int(x) for x in k),
-                stride=tuple(int(x) for x in s),
-                dilation=tuple(int(x) for x in d),
-                convolutionMode="Same" if same else "Truncate",
-                activation=_act(cfg.get("activation")),
-                hasBias=bool(cfg.get("use_bias", True)))
-            our_layers.append((lay, kname, "conv"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay,
-                                          int(cfg["filters"]))
-            continue
-        if cls in ("MaxPooling2D", "AveragePooling2D"):
-            k = cfg.get("pool_size", [2, 2])
-            s = cfg.get("strides") or k
-            same = cfg.get("padding", "valid") == "same"
-            lay = SubsamplingLayer(
-                kernelSize=tuple(int(x) for x in k),
-                stride=tuple(int(x) for x in s),
-                convolutionMode="Same" if same else "Truncate",
-                poolingType="MAX" if cls == "MaxPooling2D" else "AVG")
-            our_layers.append((lay, None, "pool"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
-            continue
-        if cls == "BatchNormalization":
-            lay = BatchNormalization(eps=float(cfg.get("epsilon", 1e-3)))
-            our_layers.append((lay, kname, "bn"))
-            continue
-        if cls == "LSTM":
-            lstm = LSTM(nOut=int(cfg["units"]),
-                        activation=_act(cfg.get("activation", "tanh")))
-            if not cfg.get("return_sequences", False):
-                from deeplearning4j_tpu.nn.conf.recurrent import LastTimeStep
-                our_layers.append((LastTimeStep(lstm), kname, "lstm"))
-            else:
-                our_layers.append((lstm, kname, "lstm"))
-            continue
-        if cls == "Embedding":
-            lay = EmbeddingSequenceLayer(nIn=int(cfg["input_dim"]),
-                                         nOut=int(cfg["output_dim"]))
-            our_layers.append((lay, kname, "embedding"))
-            continue
-        if cls == "UpSampling2D":
-            from deeplearning4j_tpu.nn.conf.convolutional import Upsampling2D
-            interp = cfg.get("interpolation", "nearest")
-            if interp != "nearest":
-                raise ValueError(
-                    f"Keras import: UpSampling2D interpolation={interp!r} "
-                    "is unsupported (only 'nearest'); importing it silently "
-                    "would change the numerics")
-            sz = cfg.get("size", [2, 2])
-            lay = Upsampling2D(size=tuple(int(x) for x in sz))
-            our_layers.append((lay, None, "upsample"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
-            continue
-        if cls == "ZeroPadding2D":
-            from deeplearning4j_tpu.nn.conf.convolutional import \
-                ZeroPaddingLayer
-            p = cfg.get("padding", [[1, 1], [1, 1]])
-            if isinstance(p, int):
-                pad = (p, p, p, p)
-            elif isinstance(p[0], (list, tuple)):
-                pad = (int(p[0][0]), int(p[0][1]), int(p[1][0]), int(p[1][1]))
-            else:
-                pad = (int(p[0]), int(p[0]), int(p[1]), int(p[1]))
-            lay = ZeroPaddingLayer(padding=pad)
-            our_layers.append((lay, None, "zeropad"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
-            continue
-        if cls == "Cropping2D":
-            from deeplearning4j_tpu.nn.conf.convolutional import Cropping2D
-            p = cfg.get("cropping", [[0, 0], [0, 0]])
-            if isinstance(p[0], (list, tuple)):
-                crop = (int(p[0][0]), int(p[0][1]), int(p[1][0]),
-                        int(p[1][1]))
-            else:
-                crop = (int(p[0]), int(p[0]), int(p[1]), int(p[1]))
-            lay = Cropping2D(cropping=crop)
-            our_layers.append((lay, None, "crop"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay, None)
-            continue
-        if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
-            from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
-            lay = GlobalPoolingLayer(
-                poolingType="AVG" if "Average" in cls else "MAX")
-            our_layers.append((lay, None, "globalpool"))
-            cur_conv_shape = None
-            continue
-        if cls in ("SeparableConv2D", "DepthwiseConv2D"):
-            from deeplearning4j_tpu.nn.conf.convolutional import (
-                DepthwiseConvolution2D, SeparableConvolution2D)
-            k = cfg.get("kernel_size", [3, 3])
-            s = cfg.get("strides", [1, 1])
-            same = cfg.get("padding", "valid") == "same"
-            dm = int(cfg.get("depth_multiplier", 1))
-            common = dict(kernelSize=tuple(int(x) for x in k),
-                          stride=tuple(int(x) for x in s),
-                          depthMultiplier=dm,
-                          convolutionMode="Same" if same else "Truncate",
-                          activation=_act(cfg.get("activation")),
-                          hasBias=bool(cfg.get("use_bias", True)))
-            if cls == "SeparableConv2D":
-                lay = SeparableConvolution2D(nOut=int(cfg["filters"]),
-                                             **common)
-                out_c = int(cfg["filters"])
-            else:
-                lay = DepthwiseConvolution2D(**common)
-                out_c = (cur_conv_shape[2] * dm) if cur_conv_shape else None
-            our_layers.append((lay, kname, "sepconv"
-                               if cls == "SeparableConv2D" else "dwconv"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay, out_c)
-            continue
-        if cls == "Conv2DTranspose":
-            from deeplearning4j_tpu.nn.conf.convolutional import \
-                Deconvolution2D
-            k = cfg.get("kernel_size", [2, 2])
-            s = cfg.get("strides", [2, 2])
-            same = cfg.get("padding", "valid") == "same"
-            lay = Deconvolution2D(
-                nOut=int(cfg["filters"]),
-                kernelSize=tuple(int(x) for x in k),
-                stride=tuple(int(x) for x in s),
-                convolutionMode="Same" if same else "Truncate",
-                activation=_act(cfg.get("activation")),
-                hasBias=bool(cfg.get("use_bias", True)))
-            our_layers.append((lay, kname, "deconv"))
-            cur_conv_shape = _track_shape(cur_conv_shape, lay,
-                                          int(cfg["filters"]))
-            continue
-        if cls == "SimpleRNN":
-            from deeplearning4j_tpu.nn.conf.recurrent import (LastTimeStep,
-                                                              SimpleRnn)
-            rnn = SimpleRnn(nOut=int(cfg["units"]),
-                            activation=_act(cfg.get("activation", "tanh")))
-            lay = rnn if cfg.get("return_sequences", False) \
-                else LastTimeStep(rnn)
-            our_layers.append((lay, kname, "simplernn"))
-            continue
-        if cls == "GRU":
-            if cfg.get("reset_after", True):
-                raise ValueError(
-                    "Keras import: GRU with reset_after=True has different "
-                    "candidate-gate semantics; re-save with "
-                    "GRU(..., reset_after=False) for exact import")
-            from deeplearning4j_tpu.nn.conf.recurrent import (GRU as OurGRU,
-                                                              LastTimeStep)
-            gru = OurGRU(nOut=int(cfg["units"]),
-                         activation=_act(cfg.get("activation", "tanh")))
-            lay = gru if cfg.get("return_sequences", False) \
-                else LastTimeStep(gru)
-            our_layers.append((lay, kname, "gru"))
-            continue
-        raise ValueError(f"Keras import: unsupported layer {cls}")
+        elif kind in _CNN_KINDS and cur_conv_shape is not None:
+            cur_conv_shape = _track_shape(
+                cur_conv_shape, lay, _out_channels(out_c, cur_conv_shape))
 
     for lay, _k, _kind in our_layers:
         builder = builder.layer(lay)
@@ -494,5 +487,10 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
             net.params_[li]["W"] = jnp.asarray(gru_reorder(ws[0]))
             net.params_[li]["RW"] = jnp.asarray(gru_reorder(ws[1]))
             if len(ws) > 2:
-                net.params_[li]["b"] = jnp.asarray(gru_reorder(ws[2]))
+                bias = ws[2]
+                if bias.ndim == 2:   # reset_after: (2, 3u) in/rec biases
+                    net.params_[li]["b"] = jnp.asarray(gru_reorder(bias[0]))
+                    net.params_[li]["b2"] = jnp.asarray(gru_reorder(bias[1]))
+                else:
+                    net.params_[li]["b"] = jnp.asarray(gru_reorder(bias))
     return net

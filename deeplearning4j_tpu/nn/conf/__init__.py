@@ -18,10 +18,12 @@ from deeplearning4j_tpu.nn.conf.layers import (Layer, layer_from_json)
 # importing these registers the RNN / extended-conv layers with the registry
 import deeplearning4j_tpu.nn.conf.recurrent  # noqa: F401
 import deeplearning4j_tpu.nn.conf.convolutional  # noqa: F401
+import deeplearning4j_tpu.nn.conf.convolutional3d  # noqa: F401
 from deeplearning4j_tpu.nn.conf.preprocessors import (
-    CnnToFeedForwardPreProcessor, CnnToRnnPreProcessor,
-    FeedForwardToCnnPreProcessor, FeedForwardToRnnPreProcessor,
-    InputPreProcessor, RnnToCnnPreProcessor, RnnToFeedForwardPreProcessor)
+    Cnn3DToFeedForwardPreProcessor, CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor, FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor, InputPreProcessor, RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor)
 
 __all__ = ["NeuralNetConfiguration", "MultiLayerConfiguration",
            "GradientNormalization", "BackpropType", "InputType",
@@ -154,6 +156,9 @@ def _auto_preprocessor(cur: InputType, want: Optional[str]
     if want == "FF":
         if k == "CNN":
             return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        if k == "CNN3D":
+            return Cnn3DToFeedForwardPreProcessor(
+                cur.depth, cur.height, cur.width, cur.channels)
         if k == "RNN":
             return RnnToFeedForwardPreProcessor()
     elif want == "CNN":

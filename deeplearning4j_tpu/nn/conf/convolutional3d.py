@@ -1,0 +1,457 @@
+"""3D convolutional family + locally-connected + PReLU layers.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/layers/
+{Convolution3D,Subsampling3DLayer,Upsampling3D,Cropping3D,Deconvolution3D,
+LocallyConnected1D,LocallyConnected2D,PReLULayer}.java`` and libnd4j
+``ops/declarable/generic/nn/convo/{conv3d,deconv3d}.cpp``,
+``.../pooling/{maxpool3d,avgpool3d}.cpp``.
+
+TPU-first lowering: 3D convs are ONE ``conv_general_dilated`` HLO in
+NCDHW/OIDHW (XLA tiles 3D convolutions onto the MXU exactly like 2D — the
+spatial dims just carry one more member); pooling is ``reduce_window``;
+the transposed conv uses ``lhs_dilation``; locally-connected layers lower
+to patch extraction + one batched einsum (an MXU contraction with the
+position axis batched), which is the XLA-native shape of "conv with
+unshared weights".
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BaseLayer, ConvolutionMode,
+                                               PoolingType, register_layer)
+from deeplearning4j_tpu.nn.weights import init_weight
+
+
+def _triple(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(i) for i in v)
+    return (int(v), int(v), int(v))
+
+
+def _out_dim(size, k, s, d, pad, same):
+    eff = (k - 1) * d + 1
+    if same:
+        return int(np.ceil(size / s))
+    return (size + 2 * pad - eff) // s + 1
+
+
+@dataclasses.dataclass
+class Convolution3D(BaseLayer):
+    """3D convolution, NCDHW (reference: Convolution3D.java, conv3d.cpp)."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    convolutionMode: Optional[str] = None
+    hasBias: bool = True
+
+    def __post_init__(self):
+        self.kernelSize = _triple(self.kernelSize)
+        self.stride = _triple(self.stride)
+        self.padding = _triple(self.padding)
+        self.dilation = _triple(self.dilation)
+
+    def preferredFormat(self):
+        return "CNN3D"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+
+    def _same(self):
+        return (self.convolutionMode or ConvolutionMode.Truncate) == \
+            ConvolutionMode.Same
+
+    def getOutputType(self, inputType):
+        same = self._same()
+        od, oh, ow = (
+            _out_dim(s, k, st, d, p, same)
+            for s, k, st, d, p in zip(
+                (inputType.depth, inputType.height, inputType.width),
+                self.kernelSize, self.stride, self.dilation, self.padding))
+        return InputType.convolutional3D(od, oh, ow, self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kd, kh, kw = self.kernelSize
+        fan_in = self.nIn * kd * kh * kw
+        fan_out = self.nOut * kd * kh * kw
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nOut, self.nIn, kd, kh, kw), fan_in,
+                              fan_out, self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        pad = "SAME" if self._same() else \
+            [(p, p) for p in self.padding]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1, 1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class Subsampling3DLayer(BaseLayer):
+    """3D max/avg pooling (reference: Subsampling3DLayer.java,
+    maxpool3d/avgpool3d.cpp) — one ``reduce_window`` HLO."""
+    poolingType: str = PoolingType.MAX
+    kernelSize: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    convolutionMode: Optional[str] = None
+
+    def __post_init__(self):
+        self.kernelSize = _triple(self.kernelSize)
+        self.stride = _triple(self.stride)
+        self.padding = _triple(self.padding)
+
+    def preferredFormat(self):
+        return "CNN3D"
+
+    def getOutputType(self, inputType):
+        same = (self.convolutionMode or ConvolutionMode.Truncate) == \
+            ConvolutionMode.Same
+        od, oh, ow = (
+            _out_dim(s, k, st, 1, p, same)
+            for s, k, st, p in zip(
+                (inputType.depth, inputType.height, inputType.width),
+                self.kernelSize, self.stride, self.padding))
+        return InputType.convolutional3D(od, oh, ow, inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        same = (self.convolutionMode or ConvolutionMode.Truncate) == \
+            ConvolutionMode.Same
+        window = (1, 1) + self.kernelSize
+        strides = (1, 1) + self.stride
+        if same:
+            pads = "SAME"
+        else:
+            pads = [(0, 0), (0, 0)] + [(p, p) for p in self.padding]
+        # literal inits (not device arrays): JAX's reduce_window autodiff
+        # pattern-matches the monoid on them (same as the 2D layer)
+        if self.poolingType == PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            y = y / float(np.prod(self.kernelSize))
+        return y, state
+
+
+@dataclasses.dataclass
+class Upsampling3D(BaseLayer):
+    """Nearest-neighbour 3D upsampling (reference: Upsampling3D.java)."""
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def __post_init__(self):
+        self.size = _triple(self.size)
+
+    def preferredFormat(self):
+        return "CNN3D"
+
+    def getOutputType(self, inputType):
+        sd_, sh, sw = self.size
+        return InputType.convolutional3D(
+            inputType.depth * sd_, inputType.height * sh,
+            inputType.width * sw, inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        sd_, sh, sw = self.size
+        y = jnp.repeat(jnp.repeat(jnp.repeat(x, sd_, axis=2), sh, axis=3),
+                       sw, axis=4)
+        return y, state
+
+
+@dataclasses.dataclass
+class Cropping3D(BaseLayer):
+    """Crop NCDHW spatial dims (reference: Cropping3D.java)."""
+    cropDepth: Tuple[int, int] = (0, 0)
+    cropHeight: Tuple[int, int] = (0, 0)
+    cropWidth: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        self.cropDepth = tuple(self.cropDepth)
+        self.cropHeight = tuple(self.cropHeight)
+        self.cropWidth = tuple(self.cropWidth)
+
+    def preferredFormat(self):
+        return "CNN3D"
+
+    def getOutputType(self, inputType):
+        return InputType.convolutional3D(
+            inputType.depth - sum(self.cropDepth),
+            inputType.height - sum(self.cropHeight),
+            inputType.width - sum(self.cropWidth), inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        (d0, d1), (h0, h1), (w0, w1) = \
+            self.cropDepth, self.cropHeight, self.cropWidth
+        return x[:, :, d0:x.shape[2] - d1 or None,
+                 h0:x.shape[3] - h1 or None,
+                 w0:x.shape[4] - w1 or None], state
+
+
+@dataclasses.dataclass
+class Deconvolution3D(BaseLayer):
+    """Transposed 3D conv (reference: Deconvolution3D.java, deconv3d.cpp):
+    flipped-kernel conv with ``lhs_dilation`` = stride."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    convolutionMode: Optional[str] = None
+    hasBias: bool = True
+
+    def __post_init__(self):
+        self.kernelSize = _triple(self.kernelSize)
+        self.stride = _triple(self.stride)
+        self.padding = _triple(self.padding)
+
+    def preferredFormat(self):
+        return "CNN3D"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+
+    def getOutputType(self, inputType):
+        same = (self.convolutionMode or ConvolutionMode.Truncate) == \
+            ConvolutionMode.Same
+        sizes = (inputType.depth, inputType.height, inputType.width)
+        if same:
+            od, oh, ow = (s * st for s, st in zip(sizes, self.stride))
+        else:
+            od, oh, ow = ((s - 1) * st + k - 2 * p for s, st, k, p in zip(
+                sizes, self.stride, self.kernelSize, self.padding))
+        return InputType.convolutional3D(od, oh, ow, self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kd, kh, kw = self.kernelSize
+        fan_in = self.nIn * kd * kh * kw
+        fan_out = self.nOut * kd * kh * kw
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (self.nOut, self.nIn, kd, kh, kw), fan_in,
+                              fan_out, self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        same = (self.convolutionMode or ConvolutionMode.Truncate) == \
+            ConvolutionMode.Same
+        kd, kh, kw = self.kernelSize
+        if same:
+            sizes = x.shape[2:]
+            pads = []
+            for s, st, k in zip(sizes, self.stride, (kd, kh, kw)):
+                tot = (s - 1) * st + k - s * st
+                lo = (k - 1) - tot // 2 - tot % 2
+                hi = (k - 1) - tot // 2
+                pads.append((lo, hi))
+        else:
+            pads = [(k - 1 - p, k - 1 - p)
+                    for k, p in zip((kd, kh, kw), self.padding)]
+        w = params["W"][:, :, ::-1, ::-1, ::-1]
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=self.stride,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if self.hasBias:
+            y = y + params["b"].reshape(1, -1, 1, 1, 1)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class PReLULayer(BaseLayer):
+    """Parametric ReLU with learned per-element (or shared-axis) alpha
+    (reference: PReLULayer.java, libnd4j prelu.cpp)."""
+    inputShape: Tuple[int, ...] = ()    # per-example shape, set or inferred
+    sharedAxes: Tuple[int, ...] = ()    # 1-based per-example axes to share
+
+    def __post_init__(self):
+        self.inputShape = tuple(self.inputShape or ())
+        self.sharedAxes = tuple(self.sharedAxes or ())
+
+    def preferredFormat(self):
+        return None
+
+    def inferNIn(self, inputType):
+        if not self.inputShape:
+            self.inputShape = tuple(inputType.getShape(1)[1:])
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def _alphaShape(self):
+        shape = list(self.inputShape)
+        for ax in self.sharedAxes:
+            shape[ax - 1] = 1
+        return tuple(shape)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        # reference default: alpha init 0 (nd4j PReLU paramInitializer)
+        return {"alpha": jnp.zeros(self._alphaShape(), dtype)}
+
+    def forward(self, params, x, train, key, state):
+        alpha = params["alpha"][None]       # broadcast over batch
+        return jnp.where(x >= 0, x, alpha * x), state
+
+
+class _LocallyConnectedBase(BaseLayer):
+    """Patch-extraction + batched einsum: the XLA-native lowering of a conv
+    with unshared weights — the position axis becomes a batched contraction
+    on the MXU rather than libnd4j's per-position im2col GEMM loop."""
+
+
+@dataclasses.dataclass
+class LocallyConnected2D(_LocallyConnectedBase):
+    """Unshared 2D conv (reference: LocallyConnected2D.java)."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    inputSize: Tuple[int, int] = ()      # (h, w), inferred
+    hasBias: bool = True
+
+    def __post_init__(self):
+        def _pair(v):
+            return tuple(v) if isinstance(v, (tuple, list)) \
+                else (int(v), int(v))
+        self.kernelSize = _pair(self.kernelSize)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.inputSize = tuple(self.inputSize or ())
+
+    def preferredFormat(self):
+        return "CNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.channels
+        if not self.inputSize:
+            self.inputSize = (inputType.height, inputType.width)
+
+    def _outSpatial(self):
+        (h, w) = self.inputSize
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        ph, pw = self.padding
+        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+
+    def getOutputType(self, inputType):
+        oh, ow = self._outSpatial()
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        oh, ow = self._outSpatial()
+        fan_in = self.nIn * kh * kw
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (oh * ow, self.nIn * kh * kw, self.nOut),
+                              fan_in, self.nOut,
+                              self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        kh, kw = self.kernelSize
+        ph, pw = self.padding
+        if ph or pw:
+            x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), self.stride, "VALID")      # (b, c*kh*kw, oh, ow)
+        b, ckk, oh, ow = patches.shape
+        pf = patches.reshape(b, ckk, oh * ow)       # (b, ckk, P)
+        # batched per-position contraction: (b,ckk,P) x (P,ckk,o) -> (b,P,o)
+        y = jnp.einsum("bcp,pco->bpo", pf, params["W"])
+        if self.hasBias:
+            y = y + params["b"]
+        y = y.transpose(0, 2, 1).reshape(b, self.nOut, oh, ow)
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class LocallyConnected1D(_LocallyConnectedBase):
+    """Unshared 1D conv over RNN-format (b, c, t) input (reference:
+    LocallyConnected1D.java)."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: int = 2
+    stride: int = 1
+    padding: int = 0
+    inputSize: int = 0                   # t, inferred
+    hasBias: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.kernelSize, (tuple, list)):
+            self.kernelSize = int(self.kernelSize[0])
+        if isinstance(self.stride, (tuple, list)):
+            self.stride = int(self.stride[0])
+        if isinstance(self.padding, (tuple, list)):
+            self.padding = int(self.padding[0])
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+        if not self.inputSize:
+            self.inputSize = inputType.timeSeriesLength
+
+    def _outT(self):
+        return (self.inputSize + 2 * self.padding - self.kernelSize) \
+            // self.stride + 1
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, self._outT())
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        k = self.kernelSize
+        ot = self._outT()
+        kW, _ = jax.random.split(key)
+        p = {"W": init_weight(kW, (ot, self.nIn * k, self.nOut),
+                              self.nIn * k, self.nOut,
+                              self.weightInit or "XAVIER", dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)                 # (b, c, t)
+        if self.padding:
+            x = jnp.pad(x, ((0, 0), (0, 0), (self.padding, self.padding)))
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernelSize,), (self.stride,), "VALID")  # (b, c*k, ot)
+        y = jnp.einsum("bcp,pco->bpo", patches, params["W"])
+        if self.hasBias:
+            y = y + params["b"]
+        y = y.transpose(0, 2, 1)                        # (b, nOut, ot)
+        return get_activation(self.activation or "identity")(y), state
+
+
+for _c in [Convolution3D, Subsampling3DLayer, Upsampling3D, Cropping3D,
+           Deconvolution3D, PReLULayer, LocallyConnected1D,
+           LocallyConnected2D]:
+    register_layer(_c)

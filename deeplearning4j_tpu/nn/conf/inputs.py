@@ -15,12 +15,13 @@ from typing import Optional, Tuple
 
 @dataclasses.dataclass(frozen=True)
 class InputType:
-    kind: str                       # FF | CNN | CNNFlat | RNN
+    kind: str                       # FF | CNN | CNNFlat | RNN | CNN3D
     size: int = 0                   # FF/RNN feature size
     height: int = 0
     width: int = 0
     channels: int = 0
     timeSeriesLength: int = -1      # RNN; -1 = variable
+    depth: int = 0                  # CNN3D (NCDHW)
 
     # -- factories (DL4J names) -----------------------------------------
     @staticmethod
@@ -42,12 +43,22 @@ class InputType:
         return InputType("RNN", size=int(size),
                          timeSeriesLength=int(timeSeriesLength))
 
+    @staticmethod
+    def convolutional3D(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """NCDHW (reference: InputType.convolutional3D, Convolution3D.java
+        default data format)."""
+        return InputType("CNN3D", depth=int(depth), height=int(height),
+                         width=int(width), channels=int(channels))
+
     # -- helpers ---------------------------------------------------------
     def arrayElementsPerExample(self) -> int:
         if self.kind == "FF":
             return self.size
         if self.kind in ("CNN", "CNNFlat"):
             return self.height * self.width * self.channels
+        if self.kind == "CNN3D":
+            return self.depth * self.height * self.width * self.channels
         if self.kind == "RNN":
             t = max(self.timeSeriesLength, 1)
             return self.size * t
@@ -62,6 +73,8 @@ class InputType:
             return (batch, self.channels * self.height * self.width)
         if self.kind == "RNN":
             return (batch, self.size, self.timeSeriesLength)
+        if self.kind == "CNN3D":
+            return (batch, self.channels, self.depth, self.height, self.width)
         raise ValueError(self.kind)
 
     def toJson(self) -> dict:

@@ -131,7 +131,25 @@ class RnnToCnnPreProcessor(InputPreProcessor):
                                        self.numChannels)
 
 
+@dataclasses.dataclass
+class Cnn3DToFeedForwardPreProcessor(InputPreProcessor):
+    """NCDHW (b, c, d, h, w) -> (b, c*d*h*w); reference:
+    ``preprocessor/Cnn3DToFeedForwardPreProcessor.java``."""
+    inputDepth: int
+    inputHeight: int
+    inputWidth: int
+    numChannels: int
+
+    def preProcess(self, x, miniBatch: int = -1):
+        return x.reshape(x.shape[0], -1)
+
+    def getOutputType(self, inputType: InputType) -> InputType:
+        return InputType.feedForward(self.inputDepth * self.inputHeight
+                                     * self.inputWidth * self.numChannels)
+
+
 _REGISTRY = {c.__name__: c for c in [
     FeedForwardToCnnPreProcessor, CnnToFeedForwardPreProcessor,
     FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor,
-    CnnToRnnPreProcessor, RnnToCnnPreProcessor]}
+    CnnToRnnPreProcessor, RnnToCnnPreProcessor,
+    Cnn3DToFeedForwardPreProcessor]}

@@ -234,17 +234,26 @@ class GravesLSTM(LSTM):
 class GRU(BaseRecurrentLayer):
     """Gated recurrent unit.  Reference: libnd4j ``gruCell``/``gru``
     declarable ops (``ops/declarable/generic/nn/recurrent/gru.cpp``) wrapped
-    by SameDiff; gate order [r, u] + candidate c."""
+    by SameDiff; gate order [r, u] + candidate c.
+
+    ``resetAfter=True`` gives the CuDNN-compatible GRU-v2 cell (the stock
+    tf.keras default since TF2): the reset gate multiplies the candidate's
+    RECURRENT projection after the matmul — ``c = act(xW + r*(h@R + b2))``
+    — with a separate recurrent bias ``b2``."""
     gateActivationFunction: str = "sigmoid"
+    resetAfter: bool = False
 
     def initParams(self, key, inputType, dtype=jnp.float32):
         kW, kR = jax.random.split(key)
         n, h = self.nIn, self.nOut
-        return {"W": init_weight(kW, (n, 3 * h), n, 3 * h,
-                                 self.weightInit or "XAVIER", dtype),
-                "RW": init_weight(kR, (h, 3 * h), h, 3 * h,
-                                  self._rw_init(), dtype),
-                "b": jnp.zeros((3 * h,), dtype)}
+        p = {"W": init_weight(kW, (n, 3 * h), n, 3 * h,
+                              self.weightInit or "XAVIER", dtype),
+             "RW": init_weight(kR, (h, 3 * h), h, 3 * h,
+                               self._rw_init(), dtype),
+             "b": jnp.zeros((3 * h,), dtype)}
+        if self.resetAfter:
+            p["b2"] = jnp.zeros((3 * h,), dtype)   # recurrent bias (v2)
+        return p
 
     def weightParamKeys(self):
         return ("W", "RW")
@@ -259,12 +268,22 @@ class GRU(BaseRecurrentLayer):
         gate = get_activation(self.gateActivationFunction)
         act = get_activation(self.activation or "tanh")
 
-        def cell(p, hp, xt):
-            r = gate(xt[:, 0:h] + hp @ p["RW"][:, 0:h])
-            u = gate(xt[:, h:2 * h] + hp @ p["RW"][:, h:2 * h])
-            c = act(xt[:, 2 * h:3 * h] + (r * hp) @ p["RW"][:, 2 * h:3 * h])
-            h2 = u * hp + (1.0 - u) * c
-            return h2, h2
+        if self.resetAfter:
+            def cell(p, hp, xt):
+                rp = hp @ p["RW"] + p["b2"]
+                r = gate(xt[:, 0:h] + rp[:, 0:h])
+                u = gate(xt[:, h:2 * h] + rp[:, h:2 * h])
+                c = act(xt[:, 2 * h:3 * h] + r * rp[:, 2 * h:3 * h])
+                h2 = u * hp + (1.0 - u) * c
+                return h2, h2
+        else:
+            def cell(p, hp, xt):
+                r = gate(xt[:, 0:h] + hp @ p["RW"][:, 0:h])
+                u = gate(xt[:, h:2 * h] + hp @ p["RW"][:, h:2 * h])
+                c = act(xt[:, 2 * h:3 * h]
+                        + (r * hp) @ p["RW"][:, 2 * h:3 * h])
+                h2 = u * hp + (1.0 - u) * c
+                return h2, h2
 
         return _masked_scan(cell, params, xp, mask, carry)
 

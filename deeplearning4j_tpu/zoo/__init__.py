@@ -3,5 +3,5 @@ from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet, LeNet, ResNet50, SimpleCNN, VGG16, ZooModel)
 from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertConfig  # noqa: F401
 from deeplearning4j_tpu.zoo.models2 import (  # noqa: F401
-    Darknet19, InceptionResNetV1, SqueezeNet, TinyYOLO, UNet, VGG19,
+    C3D, Darknet19, InceptionResNetV1, SqueezeNet, TinyYOLO, UNet, VGG19,
     Xception)

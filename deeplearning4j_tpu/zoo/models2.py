@@ -379,3 +379,40 @@ class InceptionResNetV1(ZooModel):
         net = ComputationGraph(self.graphBuilder().build())
         net.init()
         return net
+
+
+@dataclasses.dataclass
+class C3D(ZooModel):
+    """3D-convolutional video/volume classifier (C3D-style stack).
+
+    The reference zoo has no 3D model; this exercises the Convolution3D /
+    Subsampling3DLayer family end to end (conf/layers/Convolution3D.java,
+    libnd4j conv3d.cpp are the layer references).  Input NCDHW."""
+    numClasses: int = 10
+    inputShape3d: Tuple[int, int, int, int] = (3, 8, 32, 32)  # (c, d, h, w)
+
+    def init(self) -> MultiLayerNetwork:
+        from deeplearning4j_tpu.nn.conf.convolutional3d import (
+            Convolution3D, Subsampling3DLayer)
+        c, d, h, w = self.inputShape3d
+        conf = (NeuralNetConfiguration.builder().seed(self.seed)
+                .updater(Adam(1e-3)).weightInit("RELU")
+                .dataType(self.dataType)
+                .list()
+                .layer(Convolution3D.builder().nIn(c).nOut(16)
+                       .kernelSize(3, 3, 3).convolutionMode("Same")
+                       .activation("relu").build())
+                .layer(Subsampling3DLayer.builder().kernelSize(1, 2, 2)
+                       .stride(1, 2, 2).build())
+                .layer(Convolution3D.builder().nOut(32).kernelSize(3, 3, 3)
+                       .convolutionMode("Same").activation("relu").build())
+                .layer(Subsampling3DLayer.builder().kernelSize(2, 2, 2)
+                       .stride(2, 2, 2).build())
+                .layer(DenseLayer.builder().nOut(128).activation("relu")
+                       .build())
+                .layer(OutputLayer.builder("mcxent").nOut(self.numClasses)
+                       .activation("softmax").build())
+                .setInputType(InputType.convolutional3D(d, h, w, c)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net

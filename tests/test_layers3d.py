@@ -1,0 +1,243 @@
+"""3D conv family + LocallyConnected + PReLU layer tests.
+
+Reference pattern (SURVEY.md §4): gradient checks per layer type
+(deeplearning4j-core ``gradientcheck/CNN3DGradientCheckTest.java``,
+``CNNGradientCheckTest`` LocallyConnected cases) + shape/forward goldens.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (InputType, MultiLayerConfiguration,
+                                        NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.convolutional3d import (
+    Convolution3D, Cropping3D, Deconvolution3D, LocallyConnected1D,
+    LocallyConnected2D, PReLULayer, Subsampling3DLayer, Upsampling3D)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+_R = np.random.RandomState
+
+
+def _net(layers, input_type, seed=7):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+         .weightInit("XAVIER").list())
+    for l in layers:
+        b = b.layer(l)
+    conf = b.setInputType(input_type).build()
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestConv3DShapes:
+    def test_conv3d_truncate_shapes(self):
+        net = _net([
+            Convolution3D.builder().nOut(4).kernelSize(2, 2, 2).build(),
+            OutputLayer.builder("mse").nOut(3).activation("identity")
+            .build(),
+        ], InputType.convolutional3D(5, 6, 7, 2))
+        x = _R(0).randn(2, 2, 5, 6, 7).astype(np.float32)
+        out = net.output(x)
+        assert out.numpy().shape == (2, 3)
+        # conv output itself: (2, 4, 4, 5, 6)
+        it = net.conf.layers[0].getOutputType(
+            InputType.convolutional3D(5, 6, 7, 2))
+        assert (it.depth, it.height, it.width, it.channels) == (4, 5, 6, 4)
+
+    def test_conv3d_same_stride(self):
+        lay = Convolution3D.builder().nIn(2).nOut(4).kernelSize(3, 3, 3) \
+            .stride(2, 2, 2).convolutionMode("Same").build()
+        it = lay.getOutputType(InputType.convolutional3D(8, 8, 8, 2))
+        assert (it.depth, it.height, it.width) == (4, 4, 4)
+
+    def test_subsampling3d_max_avg(self):
+        for pt in ("MAX", "AVG"):
+            lay = Subsampling3DLayer.builder().poolingType(pt) \
+                .kernelSize(2, 2, 2).stride(2, 2, 2).build()
+            x = _R(1).randn(1, 2, 4, 4, 4).astype(np.float32)
+            y, _ = lay.forward({}, x, False, None, {})
+            assert y.shape == (1, 2, 2, 2, 2)
+            blk = x[0, 0, :2, :2, :2]
+            want = blk.max() if pt == "MAX" else blk.mean()
+            assert np.allclose(np.asarray(y)[0, 0, 0, 0, 0], want,
+                               atol=1e-5)
+
+    def test_upsampling_cropping3d(self):
+        up = Upsampling3D.builder().size(2).build()
+        x = _R(2).randn(1, 3, 2, 2, 2).astype(np.float32)
+        y, _ = up.forward({}, x, False, None, {})
+        assert y.shape == (1, 3, 4, 4, 4)
+        assert np.allclose(np.asarray(y)[0, 0, :2, :2, :2], x[0, 0, 0, 0, 0])
+        crop = Cropping3D.builder().cropDepth((1, 0)).cropHeight((0, 1)) \
+            .cropWidth((1, 1)).build()
+        z, _ = crop.forward({}, np.asarray(y), False, None, {})
+        assert z.shape == (1, 3, 3, 3, 2)
+
+    def test_deconv3d_inverts_stride(self):
+        lay = Deconvolution3D.builder().nIn(2).nOut(3).kernelSize(2, 2, 2) \
+            .stride(2, 2, 2).build()
+        it = lay.getOutputType(InputType.convolutional3D(3, 3, 3, 2))
+        assert (it.depth, it.height, it.width, it.channels) == (6, 6, 6, 3)
+        p = lay.initParams(__import__("jax").random.PRNGKey(0),
+                           InputType.convolutional3D(3, 3, 3, 2))
+        x = _R(3).randn(1, 2, 3, 3, 3).astype(np.float32)
+        y, _ = lay.forward(p, x, False, None, {})
+        assert np.asarray(y).shape == (1, 3, 6, 6, 6)
+
+
+class TestLocallyConnected:
+    def test_lc2d_matches_manual(self):
+        lay = LocallyConnected2D.builder().nIn(2).nOut(3).kernelSize(2, 2) \
+            .stride(1, 1).inputSize((3, 3)).hasBias(False).build()
+        import jax
+        p = lay.initParams(jax.random.PRNGKey(0),
+                           InputType.convolutional(3, 3, 2))
+        x = _R(4).randn(2, 2, 3, 3).astype(np.float32)
+        y, _ = lay.forward(p, x, False, None, {})
+        W = np.asarray(p["W"])                   # (4, 2*2*2, 3)
+        got = np.asarray(y)                      # (2, 3, 2, 2)
+        # manual: position (i,j) uses its own weight slice
+        for i in range(2):
+            for j in range(2):
+                patch = x[:, :, i:i + 2, j:j + 2].reshape(2, -1)
+                want = patch @ W[i * 2 + j]
+                assert np.allclose(got[:, :, i, j], want, atol=1e-4), (i, j)
+
+    def test_lc2d_differs_from_shared_conv(self):
+        """Unshared weights: two positions with identical input patches must
+        produce different outputs (the whole point of LocallyConnected)."""
+        lay = LocallyConnected2D.builder().nIn(1).nOut(1).kernelSize(1, 1) \
+            .inputSize((2, 2)).hasBias(False).build()
+        import jax
+        p = lay.initParams(jax.random.PRNGKey(1),
+                           InputType.convolutional(2, 2, 1))
+        x = np.ones((1, 1, 2, 2), np.float32)
+        y, _ = lay.forward(p, x, False, None, {})
+        flat = np.asarray(y).reshape(-1)
+        assert not np.allclose(flat, flat[0])
+
+    def test_lc1d_shapes_and_training(self):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        net = _net([
+            LocallyConnected1D.builder().nOut(4).kernelSize(2).build(),
+            GlobalPoolingLayer.builder().poolingType("AVG").build(),
+            OutputLayer.builder("mse").nOut(2).activation("identity")
+            .build(),
+        ], InputType.recurrent(3, 6))
+        x = _R(5).randn(4, 3, 6).astype(np.float32)
+        y = _R(6).randn(4, 2).astype(np.float32)
+        net.fit(DataSet(x, y))
+        s0 = net.score()
+        for _ in range(20):
+            net.fit(DataSet(x, y))
+        assert net.score() < s0
+
+
+class TestPReLU:
+    def test_prelu_zero_alpha_is_relu(self):
+        lay = PReLULayer.builder().build()
+        lay.inferNIn(InputType.feedForward(5))
+        import jax
+        p = lay.initParams(jax.random.PRNGKey(0), InputType.feedForward(5))
+        x = _R(7).randn(3, 5).astype(np.float32)
+        y, _ = lay.forward(p, x, False, None, {})
+        assert np.allclose(np.asarray(y), np.maximum(x, 0))
+
+    def test_prelu_shared_axes_and_learning(self):
+        lay = PReLULayer.builder().sharedAxes((2, 3)).build()
+        lay.inferNIn(InputType.convolutional(4, 4, 3))
+        assert lay._alphaShape() == (3, 1, 1)
+        net = _net([
+            PReLULayer.builder().build(),
+            OutputLayer.builder("mse").nOut(2).activation("identity")
+            .build(),
+        ], InputType.feedForward(5))
+        x = -np.abs(_R(8).randn(8, 5)).astype(np.float32)   # all negative
+        y = _R(9).randn(8, 2).astype(np.float32)
+        for _ in range(30):
+            net.fit(DataSet(x, y))
+        alpha = np.asarray(net.params_["0"]["alpha"])
+        assert np.abs(alpha).max() > 1e-4   # alpha moved from its 0 init
+
+
+class TestGradients3D:
+    def test_conv3d_stack_gradcheck(self):
+        """Central-difference check through conv3d+pool3d+dense (reference:
+        CNN3DGradientCheckTest)."""
+        from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+        net = _net([
+            Convolution3D.builder().nOut(2).kernelSize(2, 2, 2)
+            .activation("tanh").build(),
+            Subsampling3DLayer.builder().kernelSize(2, 2, 2).stride(2, 2, 2)
+            .poolingType("AVG").build(),
+            OutputLayer.builder("mse").nOut(2).activation("identity")
+            .build(),
+        ], InputType.convolutional3D(4, 4, 4, 1))
+        x = _R(10).randn(2, 1, 4, 4, 4).astype(np.float32)
+        y = _R(11).randn(2, 2).astype(np.float32)
+        import jax.numpy as jnp
+
+        def loss_fn(params):
+            dt = __import__("jax").tree.leaves(params)[0].dtype
+            out, _, _ = net._forward(params, net.state_,
+                                     jnp.asarray(x, dt), False, None, None)
+            return jnp.mean((out - jnp.asarray(y, dt)) ** 2)
+
+        r = check_gradients(loss_fn, net.params_, max_per_param=6)
+        assert r.passed, f"{r.totalFailures} failures, max {r.maxRelError}"
+
+    def test_lc2d_prelu_gradcheck(self):
+        from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+        net = _net([
+            LocallyConnected2D.builder().nOut(2).kernelSize(2, 2)
+            .activation("tanh").build(),
+            PReLULayer.builder().build(),
+            OutputLayer.builder("mse").nOut(2).activation("identity")
+            .build(),
+        ], InputType.convolutional(3, 3, 1))
+        x = _R(12).randn(2, 1, 3, 3).astype(np.float32)
+        y = _R(13).randn(2, 2).astype(np.float32)
+        import jax.numpy as jnp
+
+        def loss_fn(params):
+            dt = __import__("jax").tree.leaves(params)[0].dtype
+            out, _, _ = net._forward(params, net.state_,
+                                     jnp.asarray(x, dt), False, None, None)
+            return jnp.mean((out - jnp.asarray(y, dt)) ** 2)
+
+        r = check_gradients(loss_fn, net.params_, max_per_param=6)
+        assert r.passed, f"{r.totalFailures} failures, max {r.maxRelError}"
+
+
+class TestEndToEnd3D:
+    def test_c3d_zoo_trains(self):
+        from deeplearning4j_tpu.zoo import C3D
+        net = C3D(numClasses=4, inputShape3d=(1, 4, 8, 8)).init()
+        x = _R(14).randn(6, 1, 4, 8, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[_R(15).randint(0, 4, 6)]
+        net.fit(DataSet(x, y))
+        s0 = net.score()
+        for _ in range(15):
+            net.fit(DataSet(x, y))
+        assert net.score() < s0
+        assert net.output(x).numpy().shape == (6, 4)
+
+    def test_json_roundtrip_3d(self):
+        net = _net([
+            Convolution3D.builder().nOut(2).kernelSize(2, 2, 2).build(),
+            Subsampling3DLayer.builder().kernelSize(2, 2, 2).stride(2, 2, 2)
+            .build(),
+            OutputLayer.builder("mse").nOut(2).activation("identity")
+            .build(),
+        ], InputType.convolutional3D(4, 4, 4, 1))
+        js = net.conf.toJson()
+        conf2 = MultiLayerConfiguration.fromJson(js)
+        assert type(conf2.layers[0]).__name__ == "Convolution3D"
+        assert conf2.layers[0].kernelSize == (2, 2, 2)
+        net2 = MultiLayerNetwork(conf2)
+        net2.init(params=net.params_)
+        x = _R(16).randn(2, 1, 4, 4, 4).astype(np.float32)
+        assert np.allclose(net.output(x).numpy(), net2.output(x).numpy(),
+                           atol=1e-6)
