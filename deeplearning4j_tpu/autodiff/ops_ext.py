@@ -368,6 +368,8 @@ def _bincount(maxLength=None, **_):
 
 @register_op("topK")
 def _topk(k=1, sorted=True, **_):
+    # sorted=False only relaxes the output-order contract; lax.top_k's
+    # sorted output is a valid "arbitrary order", so no branch is needed.
     def f(x):
         v, i = lax.top_k(x, int(k))
         return [v, i]
@@ -466,10 +468,43 @@ def _set_diag(**_):
 # image ops (reference: generic/images/*.cpp — resize_bilinear,
 # resize_nearest, crop_and_resize, adjust_*)
 # ---------------------------------------------------------------------------
+def _resize_align_corners(x, oh, ow, method):
+    """align_corners sampling grid: out pixel i ↦ in coord i*(in-1)/(out-1)
+    (jax.image.resize only offers the half-pixel convention; the reference's
+    resize_bilinear/resize_nearest honor align_corners explicitly)."""
+    b, h, w, c = x.shape
+    ys = jnp.linspace(0.0, h - 1.0, oh)
+    xs = jnp.linspace(0.0, w - 1.0, ow)
+    if method == "nearest":
+        # TF/libnd4j round half AWAY from zero (roundf), not half-to-even
+        yi = jnp.floor(ys + 0.5).astype(jnp.int32)
+        xi = jnp.floor(xs + 0.5).astype(jnp.int32)
+        return x[:, yi][:, :, xi]
+    # interpolate in float (TF ResizeBilinear outputs float32 even for
+    # integer images); fractional weights would truncate in int arithmetic
+    xf = x if jnp.issubdtype(x.dtype, jnp.inexact) else x.astype(jnp.float32)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    wy = (ys - y0).astype(xf.dtype)[None, :, None, None]
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wx = (xs - x0).astype(xf.dtype)[None, None, :, None]
+    top = xf[:, y0][:, :, x0] * (1 - wx) + xf[:, y0][:, :, x1] * wx
+    bot = xf[:, y1][:, :, x0] * (1 - wx) + xf[:, y1][:, :, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
 def _resize(name, method):
     def factory(height=None, width=None, alignCorners=False, **_):
+        if alignCorners and method == "cubic":
+            raise ValueError(f"{name}: alignCorners=True is unsupported "
+                             "for bicubic (would silently change numerics)")
+
         def f(x):  # NHWC
             b, h, w, c = x.shape
+            if alignCorners:
+                return _resize_align_corners(x, int(height), int(width),
+                                             method)
             return jax.image.resize(x, (b, int(height), int(width), c),
                                     method=method)
         return f

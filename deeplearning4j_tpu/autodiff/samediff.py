@@ -1636,11 +1636,13 @@ class SameDiff:
                     jnp.asarray(self.iterationCount, jnp.int32))
                 self.iterationCount += 1
                 # Device scalar, fetched lazily — a float() here would block
-                # dispatch on a host round-trip every step.
+                # dispatch on a host round-trip every step.  With listeners
+                # attached the host sync is paid anyway (the listener
+                # contract is a Python float), so convert only then.
                 losses.append(loss)
                 for l in self._listeners:
                     l.iterationDone(self, at, ds,
-                                    Loss(["loss"], [losses[-1]]))
+                                    Loss(["loss"], [float(losses[-1])]))
             if self._listeners:
                 for l in self._listeners:
                     l.epochEnd(self, At(epoch=ep,

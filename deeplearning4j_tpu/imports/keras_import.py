@@ -100,7 +100,9 @@ class KerasModelImport:
             if cls in ("Functional", "Model"):
                 chain = _linearize_functional(layers_cfg)
                 if chain is None:   # branching -> ComputationGraph
-                    return _build_graph(layers_cfg, store)
+                    full = model_cfg["config"] \
+                        if isinstance(model_cfg["config"], dict) else {}
+                    return _build_graph(full, layers_cfg, store)
                 layers_cfg = chain
             elif cls != "Sequential":
                 raise ValueError(f"Unsupported Keras model class: {cls}")
@@ -405,7 +407,6 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
     net.init()
 
     # ---- weights ----
-    import jax.numpy as jnp
     for i, (lay, kname, kind) in enumerate(our_layers):
         if kname is None:
             continue
@@ -413,84 +414,281 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         if not ws:
             continue
         li = str(i)
-        if kind == "dense":
-            kern, bias = ws[0], (ws[1] if len(ws) > 1 else None)
-            if i in pending_flatten:
-                h, w, c = pending_flatten[i]
-                # rows are (h, w, c)-ordered; ours expect (c, h, w)
-                kern = kern.reshape(h, w, c, -1).transpose(2, 0, 1, 3) \
-                    .reshape(h * w * c, -1)
-            net.params_[li]["W"] = jnp.asarray(kern)
-            if bias is not None and "b" in net.params_[li]:
-                net.params_[li]["b"] = jnp.asarray(bias)
-        elif kind == "conv":
-            kern = ws[0]                      # HWIO
-            net.params_[li]["W"] = jnp.asarray(kern.transpose(3, 2, 0, 1))
-            if len(ws) > 1 and "b" in net.params_[li]:
-                net.params_[li]["b"] = jnp.asarray(ws[1])
-        elif kind == "bn":
-            # keras order: [gamma if scale][beta if center] mean, variance
-            cfg = kcfgs.get(kname, {})
-            idx = 0
-            if cfg.get("scale", True):
-                net.params_[li]["gamma"] = jnp.asarray(ws[idx])
-                idx += 1
-            if cfg.get("center", True):
-                net.params_[li]["beta"] = jnp.asarray(ws[idx])
-                idx += 1
-            net.state_[li]["mean"] = jnp.asarray(ws[idx])
-            net.state_[li]["var"] = jnp.asarray(ws[idx + 1])
-        elif kind == "lstm":
-            kern, rec, bias = ws[0], ws[1], (ws[2] if len(ws) > 2 else None)
-            u = rec.shape[0]
-            def reorder(m):
-                i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
-                                  m[..., 2*u:3*u], m[..., 3*u:4*u])
-                return np.concatenate([i_, f_, o_, g_], axis=-1)
-            net.params_[li]["W"] = jnp.asarray(reorder(kern))
-            net.params_[li]["RW"] = jnp.asarray(reorder(rec))
-            if bias is not None:
-                net.params_[li]["b"] = jnp.asarray(reorder(bias))
-        elif kind == "embedding":
-            net.params_[li]["W"] = jnp.asarray(ws[0])
-        elif kind in ("sepconv", "dwconv"):
-            # depthwise kernel (kh, kw, in, dm) -> (in*dm, 1, kh, kw)
-            dk = ws[0]
-            kh, kw, cin, dm = dk.shape
-            net.params_[li]["W"] = jnp.asarray(
-                dk.transpose(2, 3, 0, 1).reshape(cin * dm, 1, kh, kw))
-            rest = 1
-            if kind == "sepconv":
-                # pointwise (1, 1, in*dm, out) -> (out, in*dm, 1, 1)
-                net.params_[li]["pW"] = jnp.asarray(
-                    ws[1].transpose(3, 2, 0, 1))
-                rest = 2
-            if len(ws) > rest and "b" in net.params_[li]:
-                net.params_[li]["b"] = jnp.asarray(ws[rest])
-        elif kind == "deconv":
-            # Keras kernel (kh, kw, out, in) -> ours (out, in, kh, kw)
-            net.params_[li]["W"] = jnp.asarray(ws[0].transpose(2, 3, 0, 1))
-            if len(ws) > 1 and "b" in net.params_[li]:
-                net.params_[li]["b"] = jnp.asarray(ws[1])
-        elif kind == "simplernn":
-            net.params_[li]["W"] = jnp.asarray(ws[0])
-            net.params_[li]["RW"] = jnp.asarray(ws[1])
-            if len(ws) > 2:
-                net.params_[li]["b"] = jnp.asarray(ws[2])
-        elif kind == "gru":
-            # Keras gate order (z, r, h) -> ours (r, u=z, c=h)
-            u = ws[1].shape[0]
-            def gru_reorder(m):
-                z_, r_, h_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
-                              m[..., 2*u:3*u])
-                return np.concatenate([r_, z_, h_], axis=-1)
-            net.params_[li]["W"] = jnp.asarray(gru_reorder(ws[0]))
-            net.params_[li]["RW"] = jnp.asarray(gru_reorder(ws[1]))
-            if len(ws) > 2:
-                bias = ws[2]
-                if bias.ndim == 2:   # reset_after: (2, 3u) in/rec biases
-                    net.params_[li]["b"] = jnp.asarray(gru_reorder(bias[0]))
-                    net.params_[li]["b2"] = jnp.asarray(gru_reorder(bias[1]))
+        _load_layer_weights(net.params_.get(li), net.state_.get(li),
+                            kind, ws, kcfgs.get(kname, {}),
+                            flatten_shape=pending_flatten.get(i))
+    return net
+
+
+def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
+    """Write one Keras layer's weight list into this framework's param/state
+    dicts (mutated in place), re-laid-out per the module docstring.  Shared
+    by the Sequential and ComputationGraph import paths (the reference's
+    per-layer ``KerasLayer.setWeights`` — SURVEY §2.5)."""
+    import jax.numpy as jnp
+    if p is None:
+        return
+    if kind == "dense":
+        kern, bias = ws[0], (ws[1] if len(ws) > 1 else None)
+        if flatten_shape is not None:
+            h, w, c = flatten_shape
+            # rows are (h, w, c)-ordered; ours expect (c, h, w)
+            kern = kern.reshape(h, w, c, -1).transpose(2, 0, 1, 3) \
+                .reshape(h * w * c, -1)
+        p["W"] = jnp.asarray(kern)
+        if bias is not None and "b" in p:
+            p["b"] = jnp.asarray(bias)
+    elif kind == "conv":
+        kern = ws[0]                      # HWIO
+        p["W"] = jnp.asarray(kern.transpose(3, 2, 0, 1))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1])
+    elif kind == "bn":
+        # keras order: [gamma if scale][beta if center] mean, variance
+        idx = 0
+        if kcfg.get("scale", True):
+            p["gamma"] = jnp.asarray(ws[idx])
+            idx += 1
+        if kcfg.get("center", True):
+            p["beta"] = jnp.asarray(ws[idx])
+            idx += 1
+        s["mean"] = jnp.asarray(ws[idx])
+        s["var"] = jnp.asarray(ws[idx + 1])
+    elif kind == "lstm":
+        kern, rec, bias = ws[0], ws[1], (ws[2] if len(ws) > 2 else None)
+        u = rec.shape[0]
+        def reorder(m):
+            i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
+                              m[..., 2*u:3*u], m[..., 3*u:4*u])
+            return np.concatenate([i_, f_, o_, g_], axis=-1)
+        p["W"] = jnp.asarray(reorder(kern))
+        p["RW"] = jnp.asarray(reorder(rec))
+        if bias is not None:
+            p["b"] = jnp.asarray(reorder(bias))
+    elif kind == "embedding":
+        p["W"] = jnp.asarray(ws[0])
+    elif kind in ("sepconv", "dwconv"):
+        # depthwise kernel (kh, kw, in, dm) -> (in*dm, 1, kh, kw)
+        dk = ws[0]
+        kh, kw, cin, dm = dk.shape
+        p["W"] = jnp.asarray(
+            dk.transpose(2, 3, 0, 1).reshape(cin * dm, 1, kh, kw))
+        rest = 1
+        if kind == "sepconv":
+            # pointwise (1, 1, in*dm, out) -> (out, in*dm, 1, 1)
+            p["pW"] = jnp.asarray(ws[1].transpose(3, 2, 0, 1))
+            rest = 2
+        if len(ws) > rest and "b" in p:
+            p["b"] = jnp.asarray(ws[rest])
+    elif kind == "deconv":
+        # Keras kernel (kh, kw, out, in) -> ours (out, in, kh, kw)
+        p["W"] = jnp.asarray(ws[0].transpose(2, 3, 0, 1))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1])
+    elif kind == "simplernn":
+        p["W"] = jnp.asarray(ws[0])
+        p["RW"] = jnp.asarray(ws[1])
+        if len(ws) > 2:
+            p["b"] = jnp.asarray(ws[2])
+    elif kind == "gru":
+        # Keras gate order (z, r, h) -> ours (r, u=z, c=h)
+        u = ws[1].shape[0]
+        def gru_reorder(m):
+            z_, r_, h_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
+                          m[..., 2*u:3*u])
+            return np.concatenate([r_, z_, h_], axis=-1)
+        p["W"] = jnp.asarray(gru_reorder(ws[0]))
+        p["RW"] = jnp.asarray(gru_reorder(ws[1]))
+        if len(ws) > 2:
+            bias = ws[2]
+            if bias.ndim == 2:   # reset_after: (2, 3u) in/rec biases
+                p["b"] = jnp.asarray(gru_reorder(bias[0]))
+                p["b2"] = jnp.asarray(gru_reorder(bias[1]))
+            else:
+                p["b"] = jnp.asarray(gru_reorder(bias))
+
+
+#: Keras merge-layer class -> graph vertex construction
+_MERGE_CLASSES = {"Add": "Add", "Subtract": "Subtract",
+                  "Multiply": "Product", "Average": "Average",
+                  "Maximum": "Max", "Concatenate": None}
+
+
+def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
+    """Branching Functional Keras model → ComputationGraph.
+
+    Reference: ``KerasModel``'s Functional handling (deeplearning4j-
+    modelimport ``.../keras/KerasModel.java``, SURVEY §2.5): layers are
+    topologically ordered via ``inbound_nodes``; merge layers become graph
+    vertices (Add/Subtract/Multiply/Average/Maximum → ElementWiseVertex,
+    Concatenate → MergeVertex); everything else reuses the Sequential
+    path's per-layer mapping (``_map_keras_layer``) and weight re-layout
+    (``_load_layer_weights``)."""
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    from deeplearning4j_tpu.models.graph_conf import (ElementWiseVertex,
+                                                      MergeVertex)
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+
+    inbound = _inbound_edges(layers_cfg)
+    by_name: Dict[str, Dict] = {}
+    for lk in layers_cfg:
+        by_name[_cfg(lk).get("name", lk.get("name"))] = lk
+
+    # Kahn topo sort (keras serializes in topo order already; be robust)
+    indeg = {n: len([s for s in srcs if s in by_name])
+             for n, srcs in inbound.items()}
+    consumers: Dict[str, List[str]] = {n: [] for n in by_name}
+    for n, srcs in inbound.items():
+        for s in srcs:
+            if s in consumers:
+                consumers[s].append(n)
+    ready = sorted(n for n, d in indeg.items() if d == 0)
+    order: List[str] = []
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for d in consumers[n]:
+            indeg[d] -= 1
+            if indeg[d] == 0:
+                ready.append(d)
+    if len(order) != len(by_name):
+        raise ValueError("Keras import: cyclic Functional topology")
+
+    # output nodes: model config's output_layers, else no-consumer nodes
+    outputs: List[str] = []
+    for entry in full_cfg.get("output_layers", []):
+        if isinstance(entry, (list, tuple)):
+            outputs.append(entry[0])
+        elif isinstance(entry, dict):      # keras3 keras_history form
+            outputs.append(entry.get("config", {})
+                           .get("keras_history", [None])[0])
+    outputs = [o for o in outputs if o] or \
+        [n for n in order if not consumers[n]]
+
+    gb = NeuralNetConfiguration.builder().graphBuilder()
+    input_types: List = []
+    alias: Dict[str, str] = {}          # skipped node -> effective source
+    shapes: Dict[str, Optional[Tuple[int, int, int]]] = {}  # keras (h,w,c)
+    rnn: set = set()                    # nodes with 3D (b, t, f) output
+    flat_of: Dict[str, Tuple[int, int, int]] = {}  # node -> conv shape its
+    # flattened output came from (propagated through layout-preserving nodes)
+    weighty: List[Tuple[str, str]] = []  # (node name, kind)
+    kcfgs: Dict[str, Dict] = {}
+    pending_flatten: Dict[str, Tuple[int, int, int]] = {}
+
+    def src_of(name: str) -> List[str]:
+        return [alias.get(s, s) for s in inbound.get(name, [])]
+
+    for name in order:
+        lk = by_name[name]
+        cls = lk["class_name"]
+        cfg = _cfg(lk)
+        kcfgs[name] = cfg
+        raw_srcs = inbound.get(name, [])
+        srcs = src_of(name)
+        if cls == "InputLayer":
+            gb.addInputs(name)
+            it = _input_type(cfg, InputType)
+            if it is None:
+                raise ValueError(
+                    f"Keras import: InputLayer {name!r} lacks batch_shape")
+            input_types.append(it)
+            if it.kind == "CNN":
+                shapes[name] = (it.height, it.width, it.channels)
+            else:
+                shapes[name] = None
+                if it.kind == "RNN":
+                    rnn.add(name)
+            continue
+        if cls == "Flatten":
+            alias[name] = srcs[0]
+            if shapes.get(srcs[0]) is not None:
+                flat_of[name] = shapes[srcs[0]]
+            shapes[name] = None
+            continue
+        # Keras flattens (h, w, c)-order; our CnnToFF flattens (c, h, w).
+        # Only a Dense consumer can absorb that by kernel-row permutation;
+        # Dropout/Activation preserve the layout (propagate), anything else
+        # would silently mis-order features -> reject.
+        flat_src = next((flat_of[s] for s in raw_srcs if s in flat_of), None)
+        if cls in _MERGE_CLASSES:
+            if flat_src is not None:
+                raise ValueError(
+                    f"Keras import: {cls} over a Flatten of a conv map is "
+                    "unsupported (keras (h,w,c) vs our (c,h,w) flatten "
+                    "order would silently mis-order features)")
+            op = _MERGE_CLASSES[cls]
+            if op is None:
+                axis = cfg.get("axis", -1)
+                s0 = shapes.get(srcs[0])
+                if any(s in rnn for s in srcs):   # (b, t, f): f is 2 / -1
+                    ok = axis in (-1, 2)
+                elif s0 is not None:              # (b, h, w, c): c is 3 / -1
+                    ok = axis in (-1, 3)
+                else:                             # (b, f)
+                    ok = axis in (-1, 1)
+                if not ok:
+                    raise ValueError(
+                        f"Keras import: Concatenate axis={axis} unsupported "
+                        "(only the channel/feature axis)")
+                gb.addVertex(name, MergeVertex(), *srcs)
+                if all(shapes.get(s) is not None for s in srcs):
+                    h, w, _ = shapes[srcs[0]]
+                    shapes[name] = (h, w,
+                                    sum(shapes[s][2] for s in srcs))
                 else:
-                    net.params_[li]["b"] = jnp.asarray(gru_reorder(bias))
+                    shapes[name] = None
+            else:
+                gb.addVertex(name, ElementWiseVertex(op), *srcs)
+                shapes[name] = shapes.get(srcs[0])
+            if any(s in rnn for s in srcs):
+                rnn.add(name)
+            continue
+        mapped = _map_keras_layer(cls, cfg, is_last=(name in outputs))
+        if mapped is None:
+            raise ValueError(f"Keras import: unsupported layer {cls}")
+        lay, kind, out_c = mapped
+        if flat_src is not None:
+            if kind == "dense":
+                # (h, w, c)->(c, h, w) kernel-row permutation
+                pending_flatten[name] = flat_src
+            elif kind in ("dropout", "activation"):
+                flat_of[name] = flat_src       # layout-preserving: propagate
+            else:
+                raise ValueError(
+                    f"Keras import: {cls} consuming a Flatten of a conv "
+                    "map is unsupported (flatten-order mismatch would "
+                    "silently mis-order features)")
+        gb.addLayer(name, lay, *srcs)
+        if kind in _WEIGHTY:
+            weighty.append((name, kind))
+        if kind in ("lstm", "simplernn", "gru"):
+            shapes[name] = None
+            if cfg.get("return_sequences", False):
+                rnn.add(name)
+        elif kind == "embedding":
+            shapes[name] = None
+            rnn.add(name)                      # sequence embedding: (b,t,f)
+        elif kind in ("dense", "globalpool"):
+            shapes[name] = None
+        elif kind in _CNN_KINDS:
+            cur = shapes.get(srcs[0])
+            shapes[name] = _track_shape(cur, lay, _out_channels(out_c, cur))
+        else:                               # bn / activation / dropout
+            shapes[name] = shapes.get(srcs[0])
+            if srcs[0] in rnn:
+                rnn.add(name)
+
+    gb.setInputTypes(*input_types)
+    gb.setOutputs(*[alias.get(o, o) for o in outputs])
+    net = ComputationGraph(gb.build())
+    net.init()
+
+    for name, kind in weighty:
+        ws = store.get(name)
+        if not ws:
+            continue
+        _load_layer_weights(net.params_.get(name), net.state_.get(name),
+                            kind, ws, kcfgs.get(name, {}),
+                            flatten_shape=pending_flatten.get(name))
     return net

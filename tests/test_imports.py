@@ -395,19 +395,97 @@ class TestKerasImportExtended:
         ours = net.output(np.transpose(x, (0, 2, 1))).numpy()
         np.testing.assert_allclose(ours, keras_out, atol=1e-4, rtol=1e-3)
 
-    def test_gru_reset_after_true_rejected(self):
+    def test_gru_reset_after_true_parity(self):
+        # reset_after=True is the CuDNN-compatible GRU-v2 cell (separate
+        # recurrent bias, reset gate applied after the recurrent matmul);
+        # round 2 added importer support — this is the parity coverage.
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(5, 4)),
             tf.keras.layers.GRU(3, reset_after=True),
             tf.keras.layers.Dense(2, activation="softmax")])
+        x = np.random.RandomState(3).randn(2, 5, 4).astype(np.float32)
         import os, tempfile
-        import pytest as _pytest
         from deeplearning4j_tpu.imports import KerasModelImport
         with tempfile.TemporaryDirectory() as d:
             p = os.path.join(d, "m.h5")
             model.save(p)
-            with _pytest.raises(ValueError, match="reset_after"):
-                KerasModelImport.importKerasSequentialModelAndWeights(p)
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        ours = net.output(np.transpose(x, (0, 2, 1))).numpy()
+        np.testing.assert_allclose(ours, keras_out, atol=1e-4, rtol=1e-3)
+
+
+class TestKerasFunctionalGraphImport:
+    """Branching Functional → ComputationGraph (reference: KerasModel's
+    Functional handling, KerasModelEndToEndTest pattern)."""
+
+    @staticmethod
+    def _graph_roundtrip(model):
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        from deeplearning4j_tpu.models.graph import ComputationGraph
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            net = KerasModelImport.importKerasModelAndWeights(p)
+        assert isinstance(net, ComputationGraph)
+        return net
+
+    def test_two_branch_residual_dense(self):
+        inp = tf.keras.layers.Input(shape=(10,))
+        h = tf.keras.layers.Dense(10, activation="relu")(inp)
+        h2 = tf.keras.layers.Dense(10)(h)
+        added = tf.keras.layers.Add()([h, h2])
+        out = tf.keras.layers.Dense(3, activation="softmax")(added)
+        model = tf.keras.Model(inp, out)
+        x = np.random.RandomState(11).randn(4, 10).astype(np.float32)
+        net = self._graph_roundtrip(model)
+        keras_out = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x).numpy(), keras_out,
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_concat_branches_dense(self):
+        inp = tf.keras.layers.Input(shape=(6,))
+        a = tf.keras.layers.Dense(4, activation="tanh")(inp)
+        b = tf.keras.layers.Dense(5, activation="relu")(inp)
+        cat = tf.keras.layers.Concatenate()([a, b])
+        out = tf.keras.layers.Dense(2, activation="softmax")(cat)
+        model = tf.keras.Model(inp, out)
+        x = np.random.RandomState(12).randn(3, 6).astype(np.float32)
+        net = self._graph_roundtrip(model)
+        keras_out = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x).numpy(), keras_out,
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_conv_residual_block_with_flatten(self):
+        inp = tf.keras.layers.Input(shape=(8, 8, 3))
+        c1 = tf.keras.layers.Conv2D(4, 3, padding="same",
+                                    activation="relu")(inp)
+        c2 = tf.keras.layers.Conv2D(4, 3, padding="same")(c1)
+        added = tf.keras.layers.Add()([c1, c2])
+        flat = tf.keras.layers.Flatten()(added)
+        out = tf.keras.layers.Dense(3, activation="softmax")(flat)
+        model = tf.keras.Model(inp, out)
+        x = np.random.RandomState(13).randn(2, 8, 8, 3).astype(np.float32)
+        net = self._graph_roundtrip(model)
+        keras_out = model.predict(x, verbose=0)
+        ours = net.output(np.transpose(x, (0, 3, 1, 2))).numpy()
+        np.testing.assert_allclose(ours, keras_out, atol=1e-3, rtol=1e-3)
+
+    def test_multi_input_concat(self):
+        in1 = tf.keras.layers.Input(shape=(5,))
+        in2 = tf.keras.layers.Input(shape=(7,))
+        a = tf.keras.layers.Dense(6, activation="relu")(in1)
+        b = tf.keras.layers.Dense(6, activation="relu")(in2)
+        m = tf.keras.layers.Average()([a, b])
+        out = tf.keras.layers.Dense(2, activation="softmax")(m)
+        model = tf.keras.Model([in1, in2], out)
+        x1 = np.random.RandomState(14).randn(3, 5).astype(np.float32)
+        x2 = np.random.RandomState(15).randn(3, 7).astype(np.float32)
+        net = self._graph_roundtrip(model)
+        keras_out = model.predict([x1, x2], verbose=0)
+        np.testing.assert_allclose(net.output(x1, x2).numpy(), keras_out,
+                                   atol=1e-4, rtol=1e-3)
 
 
 class TestOnnxImport:
