@@ -41,12 +41,15 @@ class ZooModel:
     def init(self):
         raise NotImplementedError
 
-    def initPretrained(self, pretrainedType: str = "IMAGENET"):
-        raise RuntimeError(
-            f"{type(self).__name__}: pretrained weights unavailable offline; "
-            "place converted checkpoints under $DL4J_TPU_DATA_DIR and use "
-            "ModelSerializer.restoreComputationGraph, or train from scratch "
-            "via init().")
+    def initPretrained(self, pretrainedType: str = "IMAGENET",
+                       path: Optional[str] = None):
+        """Reference: ``ZooModel.initPretrained(PretrainedType)``.  The
+        download step becomes a local weight repository lookup
+        ($DL4J_TPU_DATA_DIR/pretrained — zero-egress environment); restore
+        (.zip) and Keras-h5 transplant (.h5) are real.  See
+        ``zoo/pretrained.py``."""
+        from deeplearning4j_tpu.zoo.pretrained import loadPretrained
+        return loadPretrained(self, pretrainedType, path)
 
     def metaData(self):
         return {"name": type(self).__name__, "inputShape": self.inputShape,

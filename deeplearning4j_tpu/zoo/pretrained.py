@@ -1,0 +1,136 @@
+"""Pretrained-weights machinery for the zoo.
+
+Reference: deeplearning4j-zoo ``org/deeplearning4j/zoo/ZooModel.java``
+(``initPretrained(PretrainedType)`` — download + checksum + local cache +
+``ModelSerializer.restore*``) and the Keras-h5 transfer path
+(``KerasModelImport`` feeding zoo-shaped nets — SURVEY.md §2.5).
+
+This environment is zero-egress, so the *download* step is replaced by a
+local weight repository: checkpoints live under
+``$DL4J_TPU_DATA_DIR/pretrained`` (default ``~/.deeplearning4j_tpu/
+pretrained``) named ``<ModelName>_<TYPE>.zip`` (this framework's
+ModelSerializer format) or ``<ModelName>_<TYPE>.h5`` (a Keras model whose
+weights are transplanted into the zoo architecture by position + shape).
+Everything downstream of the download — repository resolution, restore,
+h5→zoo transplant — is real and tested.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+__all__ = ["weightsDir", "resolvePretrained", "transplant"]
+
+
+def weightsDir() -> str:
+    """Local weight repository root (reference: ``ZooModel.rootCacheDir`` /
+    ``DL4JResources.getDirectory``)."""
+    root = os.environ.get("DL4J_TPU_DATA_DIR",
+                          os.path.expanduser("~/.deeplearning4j_tpu"))
+    return os.path.join(root, "pretrained")
+
+
+def resolvePretrained(modelName: str, pretrainedType: str) -> Optional[str]:
+    """``<repo>/<ModelName>_<TYPE>.{zip,h5}`` — first hit wins."""
+    d = weightsDir()
+    for ext in (".zip", ".h5"):
+        p = os.path.join(d, f"{modelName}_{pretrainedType.upper()}{ext}")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _weighty_layers(net) -> List[Tuple[str, dict]]:
+    """(key, param-dict) per parameterized layer, in network order."""
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    if isinstance(net, ComputationGraph):
+        return [(n, net.params_[n]) for n in net.conf.topoOrder
+                if net.params_.get(n)]
+    # MultiLayerNetwork: params_ keyed by stringified layer index
+    keys = sorted((k for k in net.params_ if net.params_[k]), key=int)
+    return [(k, net.params_[k]) for k in keys]
+
+
+def transplant(src, dst, strict: bool = False) -> List[str]:
+    """Copy parameters from ``src`` into ``dst`` by layer position + shape.
+
+    The workhorse of the h5→zoo path: ``src`` is typically a net produced
+    by ``KerasModelImport`` and ``dst`` a zoo architecture.  Layers are
+    paired in network order; every param whose name and shape match is
+    copied.  Mismatched layers (e.g. a replaced classifier head, or a
+    conv-only h5 against a net with dense layers) are skipped unless
+    ``strict``.  Returns the list of dst layer keys that received weights.
+    """
+    src_layers = _weighty_layers(src)
+    dst_layers = _weighty_layers(dst)
+    loaded: List[str] = []
+    si = 0
+    for dk, dp in dst_layers:
+        # find the next src layer that matches this dst layer's shapes
+        matched = None
+        for j in range(si, len(src_layers)):
+            sp = src_layers[j][1]
+            common = [k for k in dp if k in sp]
+            if common and all(
+                    tuple(sp[k].shape) == tuple(dp[k].shape)
+                    for k in common):
+                matched = j
+                break
+        if matched is None:
+            if strict:
+                raise ValueError(
+                    f"transplant: no source layer matches dst layer {dk} "
+                    f"(shapes { {k: tuple(v.shape) for k, v in dp.items()} })")
+            continue
+        sp = src_layers[matched][1]
+        for k in dp:
+            if k in sp and tuple(sp[k].shape) == tuple(dp[k].shape):
+                dp[k] = sp[k]
+        # batch-norm running stats live in state_, keyed like params_
+        s_key, d_key = src_layers[matched][0], dk
+        s_state = getattr(src, "state_", {}).get(s_key)
+        d_state = getattr(dst, "state_", {}).get(d_key)
+        if s_state and d_state:
+            for k in d_state:
+                if k in s_state and tuple(s_state[k].shape) == \
+                        tuple(d_state[k].shape):
+                    d_state[k] = s_state[k]
+        loaded.append(dk)
+        si = matched + 1
+    if strict and len(loaded) != len(dst_layers):
+        raise ValueError("transplant: not all dst layers were loaded")
+    return loaded
+
+
+def loadPretrained(model, pretrainedType: str = "IMAGENET",
+                   path: Optional[str] = None):
+    """Implements ``ZooModel.initPretrained``: resolve a checkpoint from
+    the local repository (or explicit ``path``), then restore (.zip) or
+    transplant (.h5) into the model's freshly-built architecture."""
+    name = type(model).__name__
+    p = path or resolvePretrained(name, pretrainedType)
+    if p is None:
+        raise RuntimeError(
+            f"{name}: no pretrained checkpoint for type "
+            f"{pretrainedType!r}. This environment has no network egress; "
+            f"place {name}_{pretrainedType.upper()}.zip (ModelSerializer "
+            f"format) or .h5 (Keras) under {weightsDir()}, or pass "
+            "initPretrained(path=...).")
+    if p.endswith(".zip"):
+        from deeplearning4j_tpu.models.graph import ComputationGraph
+        from deeplearning4j_tpu.utils import ModelSerializer
+        built = model.init()
+        if isinstance(built, ComputationGraph):
+            return ModelSerializer.restoreComputationGraph(p)
+        return ModelSerializer.restoreMultiLayerNetwork(p)
+    if p.endswith(".h5"):
+        from deeplearning4j_tpu.imports import KerasModelImport
+        imported = KerasModelImport.importKerasModelAndWeights(p)
+        net = model.init()
+        loaded = transplant(imported, net)
+        if not loaded:
+            raise ValueError(
+                f"{name}: transplant from {p} matched no layers "
+                "(architecture mismatch)")
+        return net
+    raise ValueError(f"Unsupported pretrained checkpoint format: {p}")
