@@ -1,0 +1,1007 @@
+"""Declarable-op breadth sprint 2: importer-driven op families.
+
+Reference: libnd4j ``include/ops/declarable/generic/**`` (SURVEY.md §2.1) —
+the families the round-2 verdict probed absent: im2col/col2im (BASELINE
+north-star-named), fft, ctcLoss, decompositions (svd/qr/eig/lu), dynamic
+partition/stitch, unique/listdiff, bitwise, roll, histogram — plus loss,
+random, image-colorspace, 1d/3d convolution and percentile families.
+
+TPU-first notes:
+- Everything executes inside the ONE jitted graph executable, so ops whose
+  reference semantics have data-dependent output shapes (unique,
+  dynamicPartition, listDiff, nonMaxSuppression) use **XLA bounded
+  semantics**: outputs are padded to their static upper bound (input size)
+  with a sentinel (0 for data, -1 for index outputs), exactly like TF2XLA's
+  lowering of the same ops.  ``dynamicStitch`` drops negative indices, so
+  the canonical partition→stitch round-trip is exact.
+- ``col2im`` is the linear adjoint of ``im2col``; it is implemented via
+  ``jax.vjp`` of the forward (the reference implements the pair by hand in
+  ``helpers/cpu/im2col.cpp`` / ``col2im.cpp``).
+- ``ctcLoss`` is the standard alpha (forward-variable) recursion staged as
+  ``lax.scan`` over time — log-space, batch-vectorized; gradients come from
+  autodiff through the scan instead of the reference's hand-written beta
+  recursion.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.samediff import (OP_IMPLS, SDMath, SDNN,
+                                                  SameDiff, _Namespace,
+                                                  _ns_binary, _ns_unary,
+                                                  _simple, register_op)
+from deeplearning4j_tpu.autodiff.ops_ext import SDLinalg
+
+# ---------------------------------------------------------------------------
+# math breadth (generic/transforms + parity_ops stragglers)
+# ---------------------------------------------------------------------------
+_simple("asinh", jnp.arcsinh)
+_simple("acosh", jnp.arccosh)
+_simple("atanh", jnp.arctanh)
+_simple("sinc", jnp.sinc)
+_simple("erfinv", lax.erf_inv)
+_simple("hypot", jnp.hypot)
+_simple("copySign", jnp.copysign)
+_simple("nextAfter", jnp.nextafter)
+_simple("toDegrees", jnp.degrees)
+_simple("toRadians", jnp.radians)
+_simple("fmod", jnp.fmod)
+_simple("betainc", jax.scipy.special.betainc)
+_simple("zeta", jax.scipy.special.zeta)
+_simple("stopGradient", lax.stop_gradient)
+_simple("assign", lambda x, y: y)          # reference: assign(target, src)
+_simple("divNoNan", lambda x, y: jnp.where(y == 0, 0.0, x / y))
+_simple("safeDivide", lambda x, y: jnp.where(y == 0, 0.0, x / y))
+_simple("crelu", lambda x: jnp.concatenate(
+    [jax.nn.relu(x), jax.nn.relu(-x)], axis=-1))
+_simple("l2Normalize", lambda x: x / jnp.maximum(
+    jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True)), 1e-12))
+_simple("swishDerivative", lambda x: jax.grad(
+    lambda v: jnp.sum(jax.nn.swish(v)))(x))
+
+
+@register_op("polygamma")
+def _polygamma(**_):
+    return lambda n, x: jax.scipy.special.polygamma(
+        n.astype(jnp.int32) if hasattr(n, "astype") else n, x)
+
+
+@register_op("checkNumerics")
+def _checknum(message="", **_):
+    def f(x):
+        return lax.cond(jnp.all(jnp.isfinite(x)), lambda: x,
+                        lambda: x * jnp.nan)  # taint like the reference panic
+    return f
+
+
+@register_op("broadcastTo")
+def _broadcast_to(shape=(), **_):
+    return lambda x: jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+@register_op("rot90")
+def _rot90(k=1, axes=(0, 1), **_):
+    return lambda x: jnp.rot90(x, int(k), tuple(axes))
+
+
+@register_op("mirrorPad")
+def _mirror_pad(mode="REFLECT", paddings=None, **_):
+    # paddings are shape metadata -> static attr (XLA needs static shapes;
+    # the importer lowers the TF paddings const input to this attr)
+    m = "reflect" if str(mode).upper() == "REFLECT" else "symmetric"
+
+    def f(x, *_ignored_pad_input):
+        pads = [(int(a), int(b)) for a, b in np.asarray(paddings)]
+        return jnp.pad(x, pads, mode=m)
+    return f
+
+
+@register_op("isMax")
+def _ismax(**_):
+    def f(x):
+        flat = x.reshape(-1)
+        return (jnp.arange(flat.size) == jnp.argmax(flat)) \
+            .reshape(x.shape).astype(x.dtype)
+    return f
+
+
+@register_op("clipByAvgNorm")
+def _clip_avg_norm(clipValue=1.0, **_):
+    def f(x):
+        avg = jnp.sqrt(jnp.sum(x * x)) / x.size
+        return jnp.where(avg > clipValue, x * (clipValue / avg), x)
+    return f
+
+
+@register_op("roll")
+def _roll(shift=0, dims=None, **_):
+    ax = tuple(dims) if dims is not None else None
+    sh = tuple(shift) if isinstance(shift, (tuple, list)) else int(shift)
+    return lambda x: jnp.roll(x, sh, axis=ax)
+
+
+@register_op("tri")
+def _tri(row=1, column=None, diag=0, **_):
+    return lambda: jnp.tri(int(row), int(column) if column else None,
+                           int(diag), dtype=jnp.float32)
+
+
+_simple("triu", lambda x: jnp.triu(x))
+_simple("tril", lambda x: jnp.tril(x))
+_simple("ravel", lambda x: x.reshape(-1))
+
+
+def _cum_extreme(name, combine, identity):
+    def factory(dims=0, exclusive=False, reverse=False, **_):
+        ax = int(dims[0]) if isinstance(dims, (tuple, list)) else int(dims)
+
+        def f(x):
+            y = jnp.flip(x, ax) if reverse else x
+            if exclusive:   # scan over [identity, y[:-1]] like TF cumsum
+                pad = jnp.full_like(jnp.take(y, jnp.arange(1), axis=ax),
+                                    identity)
+                body = lax.slice_in_dim(y, 0, y.shape[ax] - 1, axis=ax)
+                y = jnp.concatenate([pad, body], axis=ax)
+            y = lax.associative_scan(combine, y, axis=ax)
+            return jnp.flip(y, ax) if reverse else y
+        return f
+    OP_IMPLS[name] = factory
+
+
+_cum_extreme("cumMax", jnp.maximum, -jnp.inf)
+_cum_extreme("cumMin", jnp.minimum, jnp.inf)
+
+
+@register_op("percentile")
+def _percentile(percentile=50.0, dims=None, keepDims=False, **_):
+    ax = tuple(dims) if dims is not None else None
+    return lambda x: jnp.percentile(x, float(percentile), axis=ax,
+                                    keepdims=bool(keepDims))
+
+
+@register_op("median")
+def _median(dims=None, keepDims=False, **_):
+    ax = tuple(dims) if dims is not None else None
+    return lambda x: jnp.median(x, axis=ax, keepdims=bool(keepDims))
+
+
+@register_op("moments")
+def _moments(dims=None, keepDims=False, **_):
+    ax = tuple(dims) if dims is not None else None
+
+    def f(x):
+        mu = jnp.mean(x, axis=ax, keepdims=bool(keepDims))
+        var = jnp.var(x, axis=ax, keepdims=bool(keepDims))
+        return [mu, var]
+    return f
+
+
+@register_op("normalizeMoments")
+def _normalize_moments(shift=0.0, **_):
+    def f(counts, meanSS, varSS):
+        mu = meanSS / counts + shift
+        var = varSS / counts - (meanSS / counts) ** 2
+        return [mu, var]
+    return f
+
+
+@register_op("matrixPower")
+def _matrix_power(n=1, **_):
+    return lambda x: jnp.linalg.matrix_power(x, int(n))
+
+
+_simple("kron", jnp.kron)
+_simple("outer", jnp.outer)
+
+
+# ---------------------------------------------------------------------------
+# bitwise family (reference: generic/bitwise/**; SDBitwise namespace)
+# ---------------------------------------------------------------------------
+_simple("bitwiseAnd", jnp.bitwise_and)
+_simple("bitwiseOr", jnp.bitwise_or)
+_simple("bitwiseXor", jnp.bitwise_xor)
+_simple("bitwiseNot", jnp.bitwise_not)
+_simple("toggleBits", jnp.bitwise_not)
+_simple("leftShift", jnp.left_shift)
+_simple("rightShift", jnp.right_shift)
+_simple("bitCount", lambda x: lax.population_count(x))
+
+
+def _nbits(x):
+    return jnp.iinfo(x.dtype).bits
+
+
+@register_op("cyclicShiftLeft")
+def _rotl(**_):
+    def f(x, s):
+        n = _nbits(x)
+        s = s % n
+        return jnp.left_shift(x, s) | lax.shift_right_logical(x, n - s)
+    return f
+
+
+@register_op("cyclicShiftRight")
+def _rotr(**_):
+    def f(x, s):
+        n = _nbits(x)
+        s = s % n
+        return lax.shift_right_logical(x, s) | jnp.left_shift(x, n - s)
+    return f
+
+
+@register_op("bitsHammingDistance")
+def _bits_hamming(**_):
+    return lambda x, y: jnp.sum(
+        lax.population_count(jnp.bitwise_xor(x, y))).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# fft family (reference: generic/fft/**; CPU-backed — complex is not a TPU
+# MXU type; the reference likewise routes fft through helper kernels)
+# ---------------------------------------------------------------------------
+@register_op("fft")
+def _fft(**_):
+    return lambda x: jnp.fft.fft(x)
+
+
+@register_op("ifft")
+def _ifft(**_):
+    return lambda x: jnp.fft.ifft(x)
+
+
+@register_op("rfft")
+def _rfft(**_):
+    return lambda x: jnp.fft.rfft(x)
+
+
+@register_op("irfft")
+def _irfft(n=None, **_):
+    return lambda x: jnp.fft.irfft(x, n=int(n) if n else None)
+
+
+@register_op("fft2d")
+def _fft2(**_):
+    return lambda x: jnp.fft.fft2(x)
+
+
+@register_op("ifft2d")
+def _ifft2(**_):
+    return lambda x: jnp.fft.ifft2(x)
+
+
+# ---------------------------------------------------------------------------
+# linalg decompositions (reference: generic/blas + parity_ops)
+# ---------------------------------------------------------------------------
+@register_op("svd")
+def _svd(fullUV=False, computeUv=True, **_):
+    def f(x):
+        if not computeUv:
+            return jnp.linalg.svd(x, compute_uv=False)
+        u, s, vh = jnp.linalg.svd(x, full_matrices=bool(fullUV))
+        # reference Svd outputs (s, u, v) with v NOT conj-transposed
+        return [s, u, jnp.swapaxes(vh, -1, -2)]
+    return f
+
+
+@register_op("qr")
+def _qr(fullMatrices=False, **_):
+    def f(x):
+        q, r = jnp.linalg.qr(x, mode="complete" if fullMatrices
+                             else "reduced")
+        return [q, r]
+    return f
+
+
+@register_op("lu")
+def _lu(**_):
+    def f(x):
+        lu, piv, _perm = lax.linalg.lu(x)
+        return [lu, piv.astype(jnp.int32)]
+    return f
+
+
+@register_op("eig")
+def _eig(**_):
+    def f(x):
+        w, v = jnp.linalg.eig(x)
+        return [w, v]
+    return f
+
+
+@register_op("selfAdjointEig")
+def _eigh(**_):
+    def f(x):
+        w, v = jnp.linalg.eigh(x)
+        return [w, v]
+    return f
+
+
+@register_op("lstsq")
+def _lstsq(fast=True, l2Regularizer=0.0, **_):
+    def f(a, b):
+        if l2Regularizer:
+            ata = a.T @ a + l2Regularizer * jnp.eye(a.shape[-1], dtype=a.dtype)
+            return jnp.linalg.solve(ata, a.T @ b)
+        return jnp.linalg.lstsq(a, b)[0]
+    return f
+
+
+_simple("cross", jnp.cross)
+
+
+@register_op("batchMmul")
+def _batch_mmul(transposeA=False, transposeB=False, **_):
+    def f(a, b):
+        if transposeA:
+            a = jnp.swapaxes(a, -1, -2)
+        if transposeB:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im (reference: generic/convo/im2col.cpp, col2im.cpp +
+# helpers/cpu/im2col.cpp — BASELINE.json north-star-named)
+# ---------------------------------------------------------------------------
+def _im2col_fwd(x, kh, kw, sh, sw, ph, pw, dh, dw, same):
+    pad = "SAME" if same else [(ph, ph), (pw, pw)]
+    cols = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), pad, rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b, _, oh, ow = cols.shape
+    c = x.shape[1]
+    # (b, c*kh*kw, oh, ow) -> nd4j layout (b, c, kh, kw, oh, ow)
+    return cols.reshape(b, c, kh, kw, oh, ow)
+
+
+@register_op("im2col")
+def _im2col(kH=2, kW=2, sH=1, sW=1, pH=0, pW=0, dH=1, dW=1,
+            isSameMode=False, **_):
+    return lambda x: _im2col_fwd(x, int(kH), int(kW), int(sH), int(sW),
+                                 int(pH), int(pW), int(dH), int(dW),
+                                 bool(isSameMode))
+
+
+@register_op("col2im")
+def _col2im(sH=1, sW=1, pH=0, pW=0, imgH=1, imgW=1, dH=1, dW=1,
+            isSameMode=False, **_):
+    def f(cols):
+        b, c, kh, kw, _oh, _ow = cols.shape
+        x0 = jnp.zeros((b, c, int(imgH), int(imgW)), cols.dtype)
+        _, vjp = jax.vjp(
+            lambda x: _im2col_fwd(x, kh, kw, int(sH), int(sW), int(pH),
+                                  int(pW), int(dH), int(dW),
+                                  bool(isSameMode)), x0)
+        return vjp(cols)[0]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: generic/loss/ctcLoss.cpp — alpha recursion)
+# ---------------------------------------------------------------------------
+@register_op("ctcLoss")
+def _ctc_loss(blankIndex=0, **_):
+    def f(targetLabels, logitInput, targetLabelLengths, logitInputLengths):
+        """targetLabels (b, S) int; logitInput (b, T, C) raw logits;
+        lengths (b,) int.  Returns per-example negative log likelihood."""
+        labels = targetLabels.astype(jnp.int32)
+        lab_len = targetLabelLengths.astype(jnp.int32)
+        log_len = logitInputLengths.astype(jnp.int32)
+        # dtype follows the input (f64 under gradient checks, f32/bf16 in
+        # production) — a forced f32 here would hide 1e-6 perturbations
+        dt = logitInput.dtype if jnp.issubdtype(logitInput.dtype,
+                                                jnp.floating) \
+            else jnp.float32
+        logp = jax.nn.log_softmax(logitInput.astype(dt), axis=-1)
+        b, t_max, _c = logp.shape
+        s_max = labels.shape[1]
+        blank = jnp.int32(blankIndex)
+        neg_inf = jnp.asarray(-1e30, dt)
+
+        # extended sequence: blank, l1, blank, l2, ..., blank  (2S+1)
+        ext_len = 2 * s_max + 1
+        pos = jnp.arange(ext_len)
+        lab_idx = jnp.broadcast_to(
+            jnp.minimum(pos[None, :] // 2, s_max - 1), (b, ext_len))
+        lab_at = jnp.take_along_axis(labels, lab_idx, axis=1)
+        ext = jnp.where(pos[None, :] % 2 == 0, blank, lab_at)  # (b, 2S+1)
+        valid_ext = pos[None, :] < (2 * lab_len[:, None] + 1)
+
+        # can we skip from s-2? only onto a non-blank differing label
+        ext_m2 = jnp.concatenate([jnp.full((b, 2), blank, jnp.int32),
+                                  ext[:, :-2]], axis=1)
+        can_skip = (pos[None, :] % 2 == 1) & (ext != ext_m2)
+
+        def emit(tstep):
+            return jnp.take_along_axis(logp[:, tstep, :], ext, axis=1)
+
+        alpha0 = jnp.full((b, ext_len), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[:, 0, :], labels[:, :1],
+                                axis=1)[:, 0])
+        alpha0 = jnp.where(valid_ext, alpha0, neg_inf)
+
+        def step(alpha, tstep):
+            shift1 = jnp.concatenate(
+                [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate(
+                [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+            shift2 = jnp.where(can_skip, shift2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+            new = merged + emit(tstep)
+            new = jnp.where(valid_ext, new, neg_inf)
+            # freeze alpha past each example's logit length
+            active = (tstep < log_len)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = lax.scan(step, alpha0, jnp.arange(1, t_max))
+        # ll = logaddexp(alpha[2L-1], alpha[2L])
+        iL = 2 * lab_len
+        aL = jnp.take_along_axis(alpha, iL[:, None], axis=1)[:, 0]
+        aLm1 = jnp.take_along_axis(
+            alpha, jnp.maximum(iL - 1, 0)[:, None], axis=1)[:, 0]
+        # zero-length labels: only the all-blank path (aL) exists — the
+        # clamped iL-1 would double-count it
+        aLm1 = jnp.where(iL > 0, aLm1, neg_inf)
+        return -jnp.logaddexp(aL, aLm1)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# dynamic partition / stitch / unique / listdiff (XLA bounded semantics —
+# see module docstring; reference: generic/parity_ops/dynamic_*.cpp,
+# unique.cpp, listdiff.cpp)
+# ---------------------------------------------------------------------------
+def _compact(x, mask, fill=0):
+    """Stable-move elements where mask holds to the front; pad with fill."""
+    order = jnp.argsort(jnp.where(mask, 0, 1), stable=True)
+    gathered = jnp.take(x, order, axis=0)
+    count = jnp.sum(mask)
+    keep = jnp.arange(x.shape[0]) < count
+    return jnp.where(keep, gathered, fill), count
+
+
+@register_op("dynamicPartition")
+def _dyn_partition(numPartitions=2, **_):
+    k = int(numPartitions)
+
+    def f(x, partitions):
+        outs = []
+        for i in range(k):
+            data, _n = _compact(x, partitions == i, fill=0)
+            outs.append(data)
+        return outs
+    return f
+
+
+@register_op("dynamicStitch")
+def _dyn_stitch(numPartitions=2, **_):
+    k = int(numPartitions)
+
+    def f(*args):
+        idx = args[:k]
+        data = args[k:2 * k]
+        total = sum(d.shape[0] for d in data)
+        out = jnp.zeros((total,) + data[0].shape[1:], data[0].dtype)
+        for i, d in zip(idx, data):
+            # drop negative (padded) indices: jnp normalizes -1 to total-1
+            # BEFORE mode="drop" applies, so remap them out of bounds first
+            i = i.astype(jnp.int32)
+            i = jnp.where(i < 0, total, i)
+            out = out.at[i].set(d, mode="drop")
+        return out
+    return f
+
+
+@register_op("unique")
+def _unique(**_):
+    def f(x):
+        vals, inv = jnp.unique(x, size=x.size, fill_value=0,
+                               return_inverse=True)
+        return [vals, inv.reshape(x.shape).astype(jnp.int32)]
+    return f
+
+
+@register_op("uniqueWithCounts")
+def _unique_counts(**_):
+    def f(x):
+        vals, inv, cnt = jnp.unique(x, size=x.size, fill_value=0,
+                                    return_inverse=True, return_counts=True)
+        return [vals, inv.reshape(x.shape).astype(jnp.int32),
+                cnt.astype(jnp.int32)]
+    return f
+
+
+@register_op("listDiff")
+def _listdiff(**_):
+    def f(x, y):
+        mask = ~jnp.isin(x, y)
+        vals, _n = _compact(x, mask, fill=0)
+        idx, _n2 = _compact(jnp.arange(x.shape[0]), mask, fill=-1)
+        return [vals, idx.astype(jnp.int32)]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# histogram (reference: generic/parity_ops/histogram*.cpp)
+# ---------------------------------------------------------------------------
+@register_op("histogram")
+def _histogram(numBins=10, **_):
+    n = int(numBins)
+
+    def f(x):
+        lo, hi = jnp.min(x), jnp.max(x)
+        width = jnp.maximum(hi - lo, 1e-12)
+        idx = jnp.clip(((x - lo) / width * n).astype(jnp.int32), 0, n - 1)
+        return jnp.bincount(idx.reshape(-1), length=n).astype(jnp.int64)
+    return f
+
+
+@register_op("histogramFixedWidth")
+def _hist_fixed(numBins=100, **_):
+    n = int(numBins)
+
+    def f(x, valueRange):
+        lo, hi = valueRange[0], valueRange[1]
+        idx = jnp.clip(((x - lo) / (hi - lo) * n).astype(jnp.int32),
+                       0, n - 1)
+        return jnp.bincount(idx.reshape(-1), length=n).astype(jnp.int64)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: generic/loss/**)
+# ---------------------------------------------------------------------------
+def _reduce_loss2(per, reduction):
+    if reduction in ("MEAN", "MEAN_BY_NONZERO_WEIGHT_COUNT",
+                     "MEAN_BY_WEIGHT"):
+        return jnp.mean(per)
+    if reduction == "SUM":
+        return jnp.sum(per)
+    return per
+
+
+@register_op("hingeLoss")
+def _hinge(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
+    def f(labels, pred):
+        # labels {0,1} -> {-1,1} like the reference
+        y = 2.0 * labels - 1.0
+        return _reduce_loss2(jax.nn.relu(1.0 - y * pred), reduction)
+    return f
+
+
+@register_op("squaredHingeLoss")
+def _sq_hinge(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
+    def f(labels, pred):
+        y = 2.0 * labels - 1.0
+        return _reduce_loss2(jax.nn.relu(1.0 - y * pred) ** 2, reduction)
+    return f
+
+
+@register_op("poissonLoss")
+def _poisson(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", full=False, **_):
+    def f(labels, pred):
+        per = pred - labels * jnp.log(jnp.maximum(pred, 1e-12))
+        if full:
+            per = per + (labels * jnp.log(jnp.maximum(labels, 1e-12))
+                         - labels + 0.5 * jnp.log(
+                             jnp.maximum(2 * jnp.pi * labels, 1e-12)))
+        return _reduce_loss2(per, reduction)
+    return f
+
+
+@register_op("weightedCrossEntropyWithLogits")
+def _weighted_ce(**_):
+    def f(targets, logits, weights):
+        log_w = (1 + (weights - 1) * targets)
+        return jnp.mean(
+            (1 - targets) * logits
+            + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                       + jax.nn.relu(-logits)))
+    return f
+
+
+@register_op("l2Loss")
+def _l2loss(**_):
+    return lambda x: 0.5 * jnp.sum(x * x)
+
+
+@register_op("klDivergence")
+def _kld(reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
+    def f(labels, pred):
+        per = jnp.sum(labels * (jnp.log(jnp.maximum(labels, 1e-12))
+                                - jnp.log(jnp.maximum(pred, 1e-12))),
+                      axis=-1)
+        return _reduce_loss2(per, reduction)
+    return f
+
+
+@register_op("cosineDistanceLoss")
+def _cos_loss(dimension=-1, reduction="MEAN_BY_NONZERO_WEIGHT_COUNT", **_):
+    def f(labels, pred):
+        return _reduce_loss2(
+            1.0 - jnp.sum(labels * pred, axis=dimension), reduction)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# convolution breadth as graph ops (reference: generic/convo/conv{1,3}d.cpp,
+# pooling3d.cpp, deconv2d.cpp, depthwiseConv2d.cpp — the layer classes in
+# nn/conf wrap the same lowerings; these are the raw SameDiff ops)
+# ---------------------------------------------------------------------------
+@register_op("conv1d")
+def _conv1d(s=1, p=0, isSameMode=False, **_):
+    def f(x, w, *bias):   # x (b, c, t); w (o, i, k)
+        pad = "SAME" if isSameMode else [(int(p), int(p))]
+        y = lax.conv_general_dilated(
+            x, w, (int(s),), pad, dimension_numbers=("NCH", "OIH", "NCH"))
+        if bias:
+            y = y + bias[0].reshape(1, -1, 1)
+        return y
+    return f
+
+
+@register_op("conv3d")
+def _conv3d(sD=1, sH=1, sW=1, isSameMode=False, **_):
+    def f(x, w, *bias):   # x (b, c, d, h, w); w (o, i, kd, kh, kw)
+        pad = "SAME" if isSameMode else "VALID"
+        y = lax.conv_general_dilated(
+            x, w, (int(sD), int(sH), int(sW)), pad,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if bias:
+            y = y + bias[0].reshape(1, -1, 1, 1, 1)
+        return y
+    return f
+
+
+@register_op("deconv2d")
+def _deconv2d(sH=1, sW=1, pH=0, pW=0, isSameMode=False, **_):
+    def f(x, w, *bias):   # w (o, i, kh, kw)
+        # fractionally-strided conv with flipped kernel — same lowering as
+        # nn/conf Deconvolution2D.forward (one MXU-tiled conv HLO)
+        kh, kw = w.shape[2], w.shape[3]
+        if isSameMode:
+            oh, ow = x.shape[2] * int(sH), x.shape[3] * int(sW)
+            th = (x.shape[2] - 1) * int(sH) + kh - oh
+            tw = (x.shape[3] - 1) * int(sW) + kw - ow
+            pads = [((kh - 1) - th // 2 - th % 2, (kh - 1) - th // 2),
+                    ((kw - 1) - tw // 2 - tw % 2, (kw - 1) - tw // 2)]
+        else:
+            pads = [(kh - 1 - int(pH),) * 2, (kw - 1 - int(pW),) * 2]
+        y = lax.conv_general_dilated(
+            x, w[:, :, ::-1, ::-1], (1, 1), pads,
+            lhs_dilation=(int(sH), int(sW)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if bias:
+            y = y + bias[0].reshape(1, -1, 1, 1)
+        return y
+    return f
+
+
+@register_op("depthwiseConv2d")
+def _depthwise2d(sH=1, sW=1, isSameMode=False, **_):
+    def f(x, w, *bias):   # w (c*m, 1, kh, kw)
+        pad = "SAME" if isSameMode else "VALID"
+        c = x.shape[1]
+        y = lax.conv_general_dilated(
+            x, w, (int(sH), int(sW)), pad, feature_group_count=c,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if bias:
+            y = y + bias[0].reshape(1, -1, 1, 1)
+        return y
+    return f
+
+
+@register_op("sconv2d")
+def _sepconv2d(sH=1, sW=1, isSameMode=False, **_):
+    def f(x, dw, pw, *bias):
+        pad = "SAME" if isSameMode else "VALID"
+        c = x.shape[1]
+        y = lax.conv_general_dilated(
+            x, dw, (int(sH), int(sW)), pad, feature_group_count=c,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y = lax.conv_general_dilated(
+            y, pw, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if bias:
+            y = y + bias[0].reshape(1, -1, 1, 1)
+        return y
+    return f
+
+
+def _pool3d(kind):
+    def factory(kD=2, kH=2, kW=2, sD=None, sH=None, sW=None,
+                isSameMode=False, **_):
+        kd, kh, kw = int(kD), int(kH), int(kW)
+        sd_, sh, sw = int(sD or kd), int(sH or kh), int(sW or kw)
+        pad = "SAME" if isSameMode else "VALID"
+
+        def f(x):
+            if kind == "max":
+                return lax.reduce_window(
+                    x, -jnp.inf, lax.max, (1, 1, kd, kh, kw),
+                    (1, 1, sd_, sh, sw), pad)
+            s = lax.reduce_window(x, 0.0, lax.add, (1, 1, kd, kh, kw),
+                                  (1, 1, sd_, sh, sw), pad)
+            ones = jnp.ones_like(x)
+            n = lax.reduce_window(ones, 0.0, lax.add, (1, 1, kd, kh, kw),
+                                  (1, 1, sd_, sh, sw), pad)
+            return s / n
+        return f
+    return factory
+
+
+OP_IMPLS["maxPooling3d"] = _pool3d("max")
+OP_IMPLS["avgPooling3d"] = _pool3d("avg")
+
+
+@register_op("upsampling2d")
+def _upsampling2d(scaleH=2, scaleW=2, **_):
+    return lambda x: jnp.repeat(jnp.repeat(x, int(scaleH), axis=2),
+                                int(scaleW), axis=3)
+
+
+@register_op("upsampling3d")
+def _upsampling3d(scaleD=2, scaleH=2, scaleW=2, **_):
+    def f(x):
+        x = jnp.repeat(x, int(scaleD), axis=2)
+        x = jnp.repeat(x, int(scaleH), axis=3)
+        return jnp.repeat(x, int(scaleW), axis=4)
+    return f
+
+
+@register_op("localResponseNormalization")
+def _lrn(depth=5, bias=1.0, alpha=1e-4, beta=0.75, **_):
+    def f(x):   # NCHW, across channels like the reference
+        half = int(depth) // 2
+        sq = x * x
+        pads = [(0, 0), (half, half), (0, 0), (0, 0)]
+        padded = jnp.pad(sq, pads)
+        acc = sum(padded[:, i:i + x.shape[1]] for i in range(int(depth)))
+        return x / jnp.power(bias + alpha * acc, beta)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# random breadth (reference: generic/random/**; counter-based like the
+# existing random_* ops — seeded per node, reproducible under jit)
+# ---------------------------------------------------------------------------
+@register_op("random_exponential")
+def _rexp(shape=(), seed=0, lambda_=1.0, **attrs):
+    lam = float(attrs.get("lambda", lambda_))
+    return lambda: jax.random.exponential(
+        jax.random.PRNGKey(seed), tuple(shape)) / lam
+
+
+@register_op("random_gamma")
+def _rgamma(shape=(), seed=0, alpha=1.0, beta=1.0, **_):
+    return lambda: jax.random.gamma(
+        jax.random.PRNGKey(seed), alpha, tuple(shape)) / beta
+
+
+@register_op("random_poisson")
+def _rpoisson(shape=(), seed=0, lam=1.0, **_):
+    return lambda: jax.random.poisson(
+        jax.random.PRNGKey(seed), lam, tuple(shape)).astype(jnp.float32)
+
+
+@register_op("random_shuffle")
+def _rshuffle(seed=0, **_):
+    return lambda x: jax.random.permutation(
+        jax.random.PRNGKey(seed), x, axis=0)
+
+
+@register_op("random_multinomial")
+def _rmultinomial(numSamples=1, seed=0, **_):
+    def f(logits):
+        draws = jax.random.categorical(
+            jax.random.PRNGKey(seed), logits,
+            shape=(int(numSamples), logits.shape[0]))   # (samples, batch)
+        return draws.T.astype(jnp.int32)
+    return f
+
+
+@register_op("random_truncated_normal")
+def _rtrunc(shape=(), seed=0, mean=0.0, stddev=1.0, **_):
+    return lambda: mean + stddev * jax.random.truncated_normal(
+        jax.random.PRNGKey(seed), -2.0, 2.0, tuple(shape))
+
+
+@register_op("random_gumbel")
+def _rgumbel(shape=(), seed=0, **_):
+    return lambda: jax.random.gumbel(jax.random.PRNGKey(seed), tuple(shape))
+
+
+# ---------------------------------------------------------------------------
+# image colorspace + NMS (reference: generic/images/**)
+# ---------------------------------------------------------------------------
+@register_op("rgbToHsv")
+def _rgb_to_hsv(**_):
+    def f(x):  # (..., 3) in [0,1]
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        mx = jnp.maximum(jnp.maximum(r, g), b)
+        mn = jnp.minimum(jnp.minimum(r, g), b)
+        d = mx - mn
+        safe = jnp.where(d == 0, 1.0, d)
+        h = jnp.where(
+            mx == r, (g - b) / safe % 6.0,
+            jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+        h = jnp.where(d == 0, 0.0, h) / 6.0
+        s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+        return jnp.stack([h, s, mx], axis=-1)
+    return f
+
+
+@register_op("hsvToRgb")
+def _hsv_to_rgb(**_):
+    def f(x):
+        h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+        i = jnp.floor(h)
+        fr = h - i
+        p = v * (1 - s)
+        q = v * (1 - s * fr)
+        t = v * (1 - s * (1 - fr))
+        i = i.astype(jnp.int32) % 6
+        r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                       [v, q, p, p, t, v])
+        g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                       [t, v, v, q, p, p])
+        b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                       [p, p, t, v, v, q])
+        return jnp.stack([r, g, b], axis=-1)
+    return f
+
+
+@register_op("rgbToYuv")
+def _rgb_to_yuv(**_):
+    M = jnp.array([[0.299, 0.587, 0.114],
+                   [-0.14714119, -0.28886916, 0.43601035],
+                   [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
+    return lambda x: jnp.einsum("...c,dc->...d", x, M)
+
+
+@register_op("yuvToRgb")
+def _yuv_to_rgb(**_):
+    M = jnp.array([[0.299, 0.587, 0.114],
+                   [-0.14714119, -0.28886916, 0.43601035],
+                   [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
+    Minv = jnp.linalg.inv(M)
+    return lambda x: jnp.einsum("...c,dc->...d", x, Minv)
+
+
+@register_op("adjustHue")
+def _adjust_hue(delta=0.0, **_):
+    to_hsv = OP_IMPLS["rgbToHsv"]()
+    to_rgb = OP_IMPLS["hsvToRgb"]()
+
+    def f(x):
+        hsv = to_hsv(x)
+        h = (hsv[..., 0] + delta) % 1.0
+        return to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+    return f
+
+
+@register_op("nonMaxSuppression")
+def _nms(maxOutputSize=10, iouThreshold=0.5, scoreThreshold=-jnp.inf, **_):
+    k = int(maxOutputSize)
+
+    def iou(box, boxes):
+        y1 = jnp.maximum(box[0], boxes[:, 0])
+        x1 = jnp.maximum(box[1], boxes[:, 1])
+        y2 = jnp.minimum(box[2], boxes[:, 2])
+        x2 = jnp.minimum(box[3], boxes[:, 3])
+        inter = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / jnp.maximum(a1 + a2 - inter, 1e-12)
+
+    def f(boxes, scores):
+        live = scores > scoreThreshold
+
+        def body(i, state):
+            live, out = state
+            masked = jnp.where(live, scores, -jnp.inf)
+            best = jnp.argmax(masked)
+            ok = masked[best] > -jnp.inf
+            sel = jnp.where(ok, best, -1)
+            out = out.at[i].set(sel.astype(jnp.int32))
+            overlaps = iou(boxes[best], boxes) > iouThreshold
+            live = live & ~overlaps & \
+                (jnp.arange(live.shape[0]) != best) & ok
+            return live, out
+
+        _, out = lax.fori_loop(0, k, body,
+                               (live, jnp.full((k,), -1, jnp.int32)))
+        return out
+    return f
+
+
+# ---------------------------------------------------------------------------
+# namespaces (reference: SDBitwise / SDLinalg additions / SDFFT)
+# ---------------------------------------------------------------------------
+class SDBitwise(_Namespace):
+    """Reference: org/nd4j/autodiff/samediff/ops/SDBitwise.java."""
+
+    def and_(self, x, y, name=None):
+        return self.sd._op("bitwiseAnd", [x, y], name=name)
+
+    def or_(self, x, y, name=None):
+        return self.sd._op("bitwiseOr", [x, y], name=name)
+
+    def xor(self, x, y, name=None):
+        return self.sd._op("bitwiseXor", [x, y], name=name)
+
+    def leftShift(self, x, s, name=None):
+        return self.sd._op("leftShift", [x, s], name=name)
+
+    def rightShift(self, x, s, name=None):
+        return self.sd._op("rightShift", [x, s], name=name)
+
+    def leftShiftCyclic(self, x, s, name=None):
+        return self.sd._op("cyclicShiftLeft", [x, s], name=name)
+
+    def rightShiftCyclic(self, x, s, name=None):
+        return self.sd._op("cyclicShiftRight", [x, s], name=name)
+
+    def bitsHammingDistance(self, x, y, name=None):
+        return self.sd._op("bitsHammingDistance", [x, y], name=name)
+
+
+def _sd_bitwise(self) -> SDBitwise:
+    return SDBitwise(self)
+
+
+SameDiff.bitwise = _sd_bitwise
+
+
+def _linalg_svd(self, x, fullUV=False, computeUv=True, name=None):
+    return self.sd._op("svd", [x], {"fullUV": fullUV,
+                                    "computeUv": computeUv},
+                       n_out=3 if computeUv else 1, name=name)
+
+
+def _linalg_qr(self, x, fullMatrices=False, name=None):
+    return self.sd._op("qr", [x], {"fullMatrices": fullMatrices},
+                       n_out=2, name=name)
+
+
+def _linalg_lu(self, x, name=None):
+    return self.sd._op("lu", [x], n_out=2, name=name)
+
+
+def _linalg_eig(self, x, name=None):
+    return self.sd._op("selfAdjointEig", [x], n_out=2, name=name)
+
+
+def _linalg_lstsq(self, a, b, l2Regularizer=0.0, fast=True, name=None):
+    return self.sd._op("lstsq", [a, b],
+                       {"l2Regularizer": l2Regularizer, "fast": fast},
+                       name=name)
+
+
+def _linalg_cross(self, a, b, name=None):
+    return self.sd._op("cross", [a, b], name=name)
+
+
+SDLinalg.svd = _linalg_svd
+SDLinalg.qr = _linalg_qr
+SDLinalg.lu = _linalg_lu
+SDLinalg.eig = _linalg_eig
+SDLinalg.lstsq = _linalg_lstsq
+SDLinalg.cross = _linalg_cross
+
+for _n in ["asinh", "acosh", "atanh", "sinc", "erfinv", "toDegrees",
+           "toRadians", "isMax", "median", "triu", "tril"]:
+    setattr(SDMath, _n, _ns_unary(_n))
+for _n in ["hypot", "copySign", "nextAfter", "fmod", "polygamma", "zeta",
+           "kron", "outer"]:
+    setattr(SDMath, _n, _ns_binary(_n))
+for _n in ["crelu", "l2Normalize"]:
+    setattr(SDNN, _n, _ns_unary(_n))
+del _n

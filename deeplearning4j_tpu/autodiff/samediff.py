@@ -1834,3 +1834,4 @@ class History:
 # image/linalg/rnn) register themselves into OP_IMPLS on import; kept in a
 # sibling module so this file stays the core graph machinery.
 from deeplearning4j_tpu.autodiff import ops_ext  # noqa: E402,F401  isort:skip
+from deeplearning4j_tpu.autodiff import ops_ext2  # noqa: E402,F401  isort:skip

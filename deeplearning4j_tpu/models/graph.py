@@ -27,6 +27,7 @@ from deeplearning4j_tpu.models.multilayer import (_apply_updates, _get_leaf,
                                                   _grad_normalize,
                                                   _iter_leaf_params,
                                                   _param_key_order,
+                                                  _place_batch_with,
                                                   _reg_penalty, _set_leaf,
                                                   _updater_for)
 from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
@@ -53,6 +54,7 @@ class ComputationGraph:
         self._computeDtype = jnp.bfloat16 \
             if dt in ("BFLOAT16", "HALF", "FLOAT16") else jnp.float32
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x6EED)
+        self._batchSharding = None  # set by ParallelWrapper (DP over mesh)
         self._lossNodes = [n for n in conf.outputs
                            if isinstance(conf.nodes[n][0], Layer)
                            and conf.nodes[n][0].hasLoss()]
@@ -211,16 +213,27 @@ class ComputationGraph:
         else:
             raise TypeError(f"Cannot fit on {type(data)}")
 
+    def setBatchSharding(self, sharding) -> None:
+        """See MultiLayerNetwork.setBatchSharding — DP via GSPMD on the
+        model's own compiled step (ParallelWrapper integration point)."""
+        self._batchSharding = sharding
+
+    def _place_batch(self, arr):
+        return _place_batch_with(self._batchSharding, arr)
+
     def _fitBatch(self, ds) -> None:
+        pb = self._place_batch
         if isinstance(ds, MultiDataSet):
-            inputs = tuple(f.jax.astype(self._dtype) for f in ds.features)
-            labels = tuple(l.jax for l in ds.labels)
-            masks = tuple(m.jax for m in ds.labelsMasks) \
+            inputs = tuple(pb(f.jax.astype(self._dtype))
+                           for f in ds.features)
+            labels = tuple(pb(l.jax) for l in ds.labels)
+            masks = tuple(pb(m.jax) for m in ds.labelsMasks) \
                 if ds.labelsMasks else None
         else:
-            inputs = (ds.features.jax.astype(self._dtype),)
-            labels = (ds.labels.jax,)
-            masks = (ds.labelsMask.jax,) if ds.labelsMask is not None else None
+            inputs = (pb(ds.features.jax.astype(self._dtype)),)
+            labels = (pb(ds.labels.jax),)
+            masks = (pb(ds.labelsMask.jax),) \
+                if ds.labelsMask is not None else None
         self.lastBatchSize = int(inputs[0].shape[0])
         self._fitKey, key = jax.random.split(self._fitKey)
         self.params_, self.optState_, new_state, loss = self._trainStep(
@@ -342,8 +355,8 @@ class ComputationGraph:
         total = 0
         for name in self.conf.topoOrder:
             node, ins = self.conf.nodes[name]
-            n = sum(int(np.prod(v.shape))
-                    for v in (self.params_ or {}).get(name, {}).values())
+            n = sum(int(np.prod(v.shape)) for _p, _k, v in
+                    _iter_leaf_params((self.params_ or {}).get(name, {})))
             total += n
             lines.append(f"{name:<24} {type(node).__name__:<26} {n:>10} {ins}")
         lines.append(f"Total params: {total}")

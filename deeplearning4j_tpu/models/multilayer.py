@@ -46,6 +46,18 @@ def _param_key_order(keys):
     return known + rest
 
 
+def _place_batch_with(sharding, arr):
+    """Place a batch array with a mesh NamedSharding (None/odd batch sizes
+    pass through) — shared by MultiLayerNetwork and ComputationGraph."""
+    if arr is None or sharding is None:
+        return arr
+    try:
+        sharding.shard_shape(arr.shape)  # divisibility check
+    except ValueError:
+        return arr
+    return jax.device_put(arr, sharding)
+
+
 def _iter_leaf_params(lp: Dict, prefix: str = ""):
     """Yield ``(path, pname, value)`` over a layer's params in canonical
     order, descending into nested dicts (Bidirectional's fwd/bwd halves)."""
@@ -195,6 +207,20 @@ class MultiLayerNetwork:
             if dt in ("BFLOAT16", "HALF", "FLOAT16") else jnp.float32
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x5EED)
         self._rnnCarries = None  # rnnTimeStep stateMap (per RNN layer idx)
+        self._batchSharding = None  # set by ParallelWrapper (DP over mesh)
+
+    def setBatchSharding(self, sharding) -> None:
+        """Shard incoming batches over a device mesh: batch arrays are
+        placed with this ``NamedSharding`` before entering the jitted step,
+        so GSPMD compiles the step data-parallel and inserts the gradient
+        all-reduce (psum over ICI) inside the ONE executable.  Pass None to
+        go back to single-device placement.  (ParallelWrapper's integration
+        point — the sharding is part of the model's own step compilation,
+        not a wrapper-side patch.)"""
+        self._batchSharding = sharding
+
+    def _place_batch(self, arr):
+        return _place_batch_with(self._batchSharding, arr)
 
     def _cast_compute(self, tree):
         """f32 leaves -> compute dtype (no-op at full precision)."""
@@ -400,10 +426,12 @@ class MultiLayerNetwork:
 
     def _fitBatch(self, ds: DataSet) -> None:
         from deeplearning4j_tpu.nn.conf import BackpropType
-        x = ds.features.jax.astype(self._dtype)
-        y = ds.labels.jax
-        fmask = ds.featuresMask.jax if ds.featuresMask is not None else None
-        lmask = ds.labelsMask.jax if ds.labelsMask is not None else None
+        x = self._place_batch(ds.features.jax.astype(self._dtype))
+        y = self._place_batch(ds.labels.jax)
+        fmask = self._place_batch(
+            ds.featuresMask.jax if ds.featuresMask is not None else None)
+        lmask = self._place_batch(
+            ds.labelsMask.jax if ds.labelsMask is not None else None)
         self.lastBatchSize = int(x.shape[0])
 
         # TBPTT needs per-timestep (rank-3) labels; otherwise fall back to
@@ -661,8 +689,8 @@ class MultiLayerNetwork:
         total = 0
         for i, layer in enumerate(self.conf.layers):
             li = str(i)
-            n = sum(int(np.prod(v.shape))
-                    for v in self.params_.get(li, {}).values()) \
+            n = sum(int(np.prod(v.shape)) for _p, _k, v in
+                    _iter_leaf_params(self.params_.get(li, {}))) \
                 if self.params_ else 0
             total += n
             it = self.conf.layerInputTypes[i]

@@ -82,7 +82,13 @@ class ParallelWrapper:
 
     # -- API -------------------------------------------------------------
     def fit(self, iterator, epochs: int = 1) -> None:
-        """Train with batches sharded across the mesh's data axis."""
+        """Train with batches sharded across the mesh's data axis.
+
+        Sharding is part of the model's OWN step compilation: the model's
+        ``setBatchSharding`` places every incoming batch with the mesh's
+        data-axis NamedSharding, and GSPMD specializes the already-fused
+        train step with the psum all-reduce inside — no wrapper-side
+        monkey-patching or NDArray mutation."""
         net = self.model
         if net.params_ is None:
             net.init()
@@ -101,24 +107,11 @@ class ParallelWrapper:
                 return jax.device_put(leaf, self.mesh.replicated())
 
             net.optState_ = jax.tree.map(place, net.optState_)
-        orig_fitBatch = net._fitBatch
-
-        def shard_one(arr):
-            if arr is not None and arr.shape[0] % self.mesh.dataSize == 0:
-                arr._value = self.mesh.shardBatch(arr.jax)
-
-        def shardedFitBatch(ds):
-            feats = ds.features if isinstance(ds.features, list) else [ds.features]
-            labs = ds.labels if isinstance(ds.labels, list) else [ds.labels]
-            for a in feats + labs:
-                shard_one(a)
-            orig_fitBatch(ds)
-
-        net._fitBatch = shardedFitBatch
+        net.setBatchSharding(self.mesh.dataSharding())
         try:
             net.fit(iterator, epochs=epochs)
         finally:
-            net._fitBatch = orig_fitBatch
+            net.setBatchSharding(None)
 
     def shutdown(self) -> None:
         pass

@@ -1,0 +1,733 @@
+"""Validation battery for the sprint-2 op families (ops_ext2).
+
+Same pattern as test_ops_ext_validation.py (reference: nd4j OpValidation
+suites, SURVEY.md §4): golden-output TestCase per op; torch (CPU) is the
+oracle for the convolution/pooling families, scipy for special functions,
+brute-force enumeration for ctcLoss; decompositions are checked by
+reconstruction (sign-ambiguous factors can't be golden-compared).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.validation import OpValidation, TestCase
+
+_R = np.random.RandomState
+
+
+def _validate(build, expected, placeholders=None, tol=1e-4):
+    sd = SameDiff.create()
+    out = build(sd)
+    tc = TestCase(sd).expectedOutput(out, np.asarray(expected))
+    tc.expectedPrecision(tol)
+    for k, v in (placeholders or {}).items():
+        tc._placeholders[k] = np.asarray(v)
+    err = OpValidation.validate(tc)
+    assert err is None, err
+
+
+def _run(build, placeholders=None):
+    """Execute and mark covered; returns outputs dict-like list."""
+    sd = SameDiff.create()
+    outs = build(sd)
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    names = [o.name() for o in outs]
+    res = sd.output(placeholders or {}, *names)
+    for node in sd._ops:
+        OpValidation.recordTested(node.op)
+    return [np.asarray(res[n].numpy()) for n in names]
+
+
+X = _R(0).randn(3, 4).astype(np.float32)
+XP = (np.abs(X) + 0.2).astype(np.float32)
+XI = _R(1).randint(0, 255, (3, 4)).astype(np.int32)
+YI = _R(2).randint(0, 255, (3, 4)).astype(np.int32)
+
+
+# ---------------------------------------------------------------- math ----
+def test_unary_math_ext2():
+    import scipy.special as sp
+    cases = [
+        ("asinh", np.arcsinh(X), X),
+        ("acosh", np.arccosh(1.0 + XP), 1.0 + XP),
+        ("atanh", np.arctanh(0.9 * np.tanh(X)), 0.9 * np.tanh(X)),
+        ("sinc", np.sinc(X), X),
+        ("erfinv", sp.erfinv(np.clip(X, -0.9, 0.9)).astype(np.float32),
+         np.clip(X, -0.9, 0.9)),
+        ("toDegrees", np.degrees(X), X),
+        ("toRadians", np.radians(X), X),
+        ("stopGradient", X, X),
+        ("ravel", X.reshape(-1), X),
+        ("triu", np.triu(X), X),
+        ("tril", np.tril(X), X),
+        ("l2Normalize", X / np.maximum(
+            np.sqrt((X * X).sum(-1, keepdims=True)), 1e-12), X),
+        ("crelu", np.concatenate([np.maximum(X, 0), np.maximum(-X, 0)],
+                                 axis=-1), X),
+        ("l2Loss", np.float32(0.5 * (X * X).sum()), X),
+        ("checkNumerics", X, X),
+        ("identity", X, X),
+        ("transpose", X.T, X),
+    ]
+    for op, ref, inp in cases:
+        _validate(lambda sd, op=op: sd._op(op, [sd.placeholder("x")],
+                                           name="o"),
+                  ref, {"x": inp})
+
+
+def test_binary_math_ext2():
+    import scipy.special as sp
+    Y = _R(3).randn(3, 4).astype(np.float32)
+    A = (np.abs(_R(4).randn(3, 4)) + 0.5).astype(np.float32)
+    B = (np.abs(_R(5).randn(3, 4)) + 0.5).astype(np.float32)
+    Z = (np.abs(_R(6).randn(3, 4)) + 1.1).astype(np.float32)
+    cases = [
+        ("hypot", np.hypot(X, Y), X, Y),
+        ("copySign", np.copysign(X, Y), X, Y),
+        ("nextAfter", np.nextafter(X, Y), X, Y),
+        ("fmod", np.fmod(X, np.abs(Y) + 0.5), X, np.abs(Y) + 0.5),
+        ("divNoNan", np.where(Y == 0, 0, X / Y), X, Y),
+        ("safeDivide", np.where(Y == 0, 0, X / Y), X, Y),
+        ("assign", Y, X, Y),
+        ("kron", np.kron(X, Y), X, Y),
+        ("outer", np.outer(X.ravel(), Y.ravel()), X.ravel(), Y.ravel()),
+    ]
+    for op, ref, a, b in cases:
+        _validate(lambda sd, op=op: sd._op(
+            op, [sd.placeholder("a"), sd.placeholder("b")], name="o"),
+            ref, {"a": a, "b": b}, tol=1e-3)
+    _validate(lambda sd: sd._op(
+        "betainc", [sd.placeholder("a"), sd.placeholder("b"),
+                    sd.placeholder("x")], name="o"),
+        sp.betainc(A, B, np.full_like(A, 0.4)).astype(np.float32),
+        {"a": A, "b": B, "x": np.full_like(A, 0.4)}, tol=1e-3)
+    # zeta/polygamma want x > 1 domains
+    _validate(lambda sd: sd._op("zeta", [sd.placeholder("a"),
+                                         sd.placeholder("b")], name="o"),
+              sp.zeta(Z, A).astype(np.float32), {"a": Z, "b": A}, tol=1e-3)
+    n = np.array([[1, 2], [3, 1]], np.int32)
+    xx = (np.abs(_R(7).randn(2, 2)) + 0.5).astype(np.float32)
+    _validate(lambda sd: sd._op("polygamma", [sd.placeholder("n"),
+                                              sd.placeholder("x")],
+                                name="o"),
+              sp.polygamma(n.ravel(), xx.ravel()).reshape(2, 2)
+              .astype(np.float32), {"n": n, "x": xx}, tol=1e-2)
+
+
+def test_misc_shape_ext2():
+    _validate(lambda sd: sd._op("broadcastTo", [sd.placeholder("x")],
+                                {"shape": (2, 3, 4)}, name="o"),
+              np.broadcast_to(X, (2, 3, 4)), {"x": X})
+    _validate(lambda sd: sd._op("rot90", [sd.placeholder("x")],
+                                {"k": 1, "axes": (0, 1)}, name="o"),
+              np.rot90(X), {"x": X})
+    _validate(lambda sd: sd._op("roll", [sd.placeholder("x")],
+                                {"shift": 2, "dims": (1,)}, name="o"),
+              np.roll(X, 2, axis=1), {"x": X})
+    _validate(lambda sd: sd._op("mirrorPad", [sd.placeholder("x")],
+                                {"mode": "REFLECT",
+                                 "paddings": ((1, 1), (2, 2))}, name="o"),
+              np.pad(X, [(1, 1), (2, 2)], mode="reflect"), {"x": X})
+    _validate(lambda sd: sd._op("tri", [], {"row": 3, "column": 4,
+                                            "diag": 0}, name="o"),
+              np.tri(3, 4).astype(np.float32))
+    flat = X.reshape(-1)
+    ref = (np.arange(flat.size) == flat.argmax()).reshape(X.shape) \
+        .astype(np.float32)
+    _validate(lambda sd: sd._op("isMax", [sd.placeholder("x")], name="o"),
+              ref, {"x": X})
+    # clipByAvgNorm
+    avg = np.sqrt((X * X).sum()) / X.size
+    cv = 0.01
+    ref = X * (cv / avg) if avg > cv else X
+    _validate(lambda sd: sd._op("clipByAvgNorm", [sd.placeholder("x")],
+                                {"clipValue": cv}, name="o"), ref, {"x": X})
+    _validate(lambda sd: sd._op("swishDerivative", [sd.placeholder("x")],
+                                name="o"),
+              (lambda s: s + (X * np.exp(-X) * s * s))(1 / (1 + np.exp(-X))),
+              {"x": X}, tol=1e-3)
+
+
+def test_cumulative_percentile_moments():
+    _validate(lambda sd: sd._op("cumMax", [sd.placeholder("x")],
+                                {"dims": 1}, name="o"),
+              np.maximum.accumulate(X, axis=1), {"x": X})
+    _validate(lambda sd: sd._op("cumMin", [sd.placeholder("x")],
+                                {"dims": 0}, name="o"),
+              np.minimum.accumulate(X, axis=0), {"x": X})
+    _validate(lambda sd: sd._op("cumprod", [sd.placeholder("x")],
+                                {"axis": 1}, name="o"),
+              np.cumprod(X, axis=1), {"x": X}, tol=1e-3)
+    _validate(lambda sd: sd._op("percentile", [sd.placeholder("x")],
+                                {"percentile": 75.0, "dims": (1,)},
+                                name="o"),
+              np.percentile(X, 75.0, axis=1).astype(np.float32), {"x": X},
+              tol=1e-3)
+    _validate(lambda sd: sd._op("median", [sd.placeholder("x")], name="o"),
+              np.float32(np.median(X)), {"x": X})
+    mu, var = _run(lambda sd: sd._op("moments", [sd.placeholder("x")],
+                                     {"dims": (0,)}, n_out=2),
+                   {"x": X})
+    np.testing.assert_allclose(mu, X.mean(0), atol=1e-5)
+    np.testing.assert_allclose(var, X.var(0), atol=1e-5)
+    cnt, mss, vss = 8.0, X.sum(0), (X * X).sum(0)
+    m2, v2 = _run(lambda sd: sd._op(
+        "normalizeMoments", [sd.placeholder("c"), sd.placeholder("m"),
+                             sd.placeholder("v")], n_out=2),
+        {"c": np.float32(cnt), "m": mss, "v": vss})
+    np.testing.assert_allclose(m2, mss / cnt, atol=1e-5)
+    np.testing.assert_allclose(v2, vss / cnt - (mss / cnt) ** 2, atol=1e-4)
+    # nd4j variance defaults to biasCorrected=true (ddof=1)
+    _validate(lambda sd: sd._op("variance", [sd.placeholder("x")],
+                                {"dims": (0,)}, name="o"),
+              X.var(0, ddof=1), {"x": X}, tol=1e-4)
+    _validate(lambda sd: sd._op("normMax", [sd.placeholder("x")],
+                                name="o"),
+              np.float32(np.abs(X).max()), {"x": X})
+
+
+# ------------------------------------------------------------- bitwise ----
+def test_bitwise_family():
+    cases = [
+        ("bitwiseAnd", XI & YI), ("bitwiseOr", XI | YI),
+        ("bitwiseXor", XI ^ YI),
+    ]
+    for op, ref in cases:
+        _validate(lambda sd, op=op: sd._op(
+            op, [sd.placeholder("a"), sd.placeholder("b")], name="o"),
+            ref, {"a": XI, "b": YI})
+    _validate(lambda sd: sd._op("bitwiseNot", [sd.placeholder("a")],
+                                name="o"), ~XI, {"a": XI})
+    _validate(lambda sd: sd._op("toggleBits", [sd.placeholder("a")],
+                                name="o"), ~XI, {"a": XI})
+    s = np.full_like(XI, 3)
+    _validate(lambda sd: sd._op("leftShift", [sd.placeholder("a"),
+                                              sd.placeholder("s")],
+                                name="o"), XI << 3, {"a": XI, "s": s})
+    _validate(lambda sd: sd._op("rightShift", [sd.placeholder("a"),
+                                               sd.placeholder("s")],
+                                name="o"), XI >> 3, {"a": XI, "s": s})
+    u = XI.astype(np.uint32)
+    rotl = ((u << np.uint32(3)) | (u >> np.uint32(29))).astype(np.int32)
+    _validate(lambda sd: sd._op("cyclicShiftLeft", [sd.placeholder("a"),
+                                                    sd.placeholder("s")],
+                                name="o"), rotl, {"a": XI, "s": s})
+    rotr = ((u >> np.uint32(3)) | (u << np.uint32(29))).astype(np.int32)
+    _validate(lambda sd: sd._op("cyclicShiftRight", [sd.placeholder("a"),
+                                                     sd.placeholder("s")],
+                                name="o"), rotr, {"a": XI, "s": s})
+    ham = np.float64(bin(int.from_bytes(
+        np.bitwise_xor(XI, YI).astype(np.uint32).tobytes(), "little"))
+        .count("1"))
+    [got] = _run(lambda sd: sd._op("bitsHammingDistance",
+                                   [sd.placeholder("a"),
+                                    sd.placeholder("b")]),
+                 {"a": XI, "b": YI})
+    assert got == ham
+    _validate(lambda sd: sd._op("bitCount", [sd.placeholder("a")],
+                                name="o"),
+              np.vectorize(lambda v: bin(int(v) & 0xFFFFFFFF).count("1"))(XI)
+              .astype(np.int32), {"a": XI})
+
+
+# ----------------------------------------------------------------- fft ----
+def test_fft_family():
+    x = _R(8).randn(8).astype(np.float32)
+    x2 = _R(9).randn(4, 8).astype(np.float32)
+    c = (x + 1j * _R(10).randn(8)).astype(np.complex64)
+    _validate(lambda sd: sd._op("fft", [sd.placeholder("x")], name="o"),
+              np.fft.fft(c), {"x": c}, tol=1e-3)
+    _validate(lambda sd: sd._op("ifft", [sd.placeholder("x")], name="o"),
+              np.fft.ifft(c), {"x": c}, tol=1e-3)
+    _validate(lambda sd: sd._op("rfft", [sd.placeholder("x")], name="o"),
+              np.fft.rfft(x), {"x": x}, tol=1e-3)
+    _validate(lambda sd: sd._op("irfft", [sd.placeholder("x")], name="o"),
+              np.fft.irfft(np.fft.rfft(x)), {"x": np.fft.rfft(x)}, tol=1e-3)
+    _validate(lambda sd: sd._op("fft2d", [sd.placeholder("x")], name="o"),
+              np.fft.fft2(x2), {"x": x2.astype(np.complex64)}, tol=1e-2)
+    _validate(lambda sd: sd._op("ifft2d", [sd.placeholder("x")], name="o"),
+              np.fft.ifft2(x2), {"x": x2.astype(np.complex64)}, tol=1e-3)
+
+
+# -------------------------------------------------------------- linalg ----
+def test_decompositions_reconstruct():
+    a = _R(11).randn(5, 3).astype(np.float64)
+    s, u, v = _run(lambda sd: sd.linalg().svd(sd.placeholder("a")),
+                   {"a": a})
+    np.testing.assert_allclose(u @ np.diag(s) @ v.T, a, atol=1e-8)
+    q, r = _run(lambda sd: sd.linalg().qr(sd.placeholder("a")), {"a": a})
+    np.testing.assert_allclose(q @ r, a, atol=1e-8)
+    np.testing.assert_allclose(np.triu(r), r, atol=1e-12)
+    sym = a.T @ a
+    w, vec = _run(lambda sd: sd.linalg().eig(sd.placeholder("a")),
+                  {"a": sym})
+    np.testing.assert_allclose(vec @ np.diag(w) @ vec.T, sym, atol=1e-8)
+    sq = _R(12).randn(4, 4)
+    lu, piv = _run(lambda sd: sd.linalg().lu(sd.placeholder("a")),
+                   {"a": sq})
+    L = np.tril(lu, -1) + np.eye(4)
+    U = np.triu(lu)
+    P = np.eye(4)[list(np.argsort(_perm_from_pivots(piv, 4)))]
+    np.testing.assert_allclose((L @ U), (P @ sq)[np.argsort(
+        np.argsort(_perm_from_pivots(piv, 4)))][
+        np.argsort(np.argsort(np.arange(4)))], atol=1e-6) \
+        if False else None
+    # simpler check: P L U == A with P from lax convention (row permutation)
+    perm = _perm_from_pivots(piv, 4)
+    np.testing.assert_allclose((L @ U), sq[perm], atol=1e-6)
+    # general (possibly complex) eig
+    w2, v2 = _run(lambda sd: sd._op("eig", [sd.placeholder("a")], n_out=2),
+                  {"a": sq})
+    np.testing.assert_allclose(v2 @ np.diag(w2),
+                               sq.astype(v2.dtype) @ v2, atol=1e-6)
+    # lstsq / cross / batchMmul / matrixPower
+    b = _R(13).randn(5, 2)
+    got = _run(lambda sd: sd.linalg().lstsq(sd.placeholder("a"),
+                                            sd.placeholder("b")),
+               {"a": a, "b": b})[0]
+    np.testing.assert_allclose(got, np.linalg.lstsq(a, b, rcond=None)[0],
+                               atol=1e-6)
+    u3 = _R(14).randn(4, 3)
+    v3 = _R(15).randn(4, 3)
+    _validate(lambda sd: sd.linalg().cross(sd.placeholder("a"),
+                                           sd.placeholder("b")),
+              np.cross(u3, v3), {"a": u3, "b": v3})
+    A = _R(16).randn(2, 3, 4).astype(np.float32)
+    B = _R(17).randn(2, 4, 5).astype(np.float32)
+    _validate(lambda sd: sd._op("batchMmul", [sd.placeholder("a"),
+                                              sd.placeholder("b")],
+                                name="o"),
+              A @ B, {"a": A, "b": B}, tol=1e-3)
+    M = _R(18).randn(3, 3).astype(np.float32) * 0.5
+    _validate(lambda sd: sd._op("matrixPower", [sd.placeholder("a")],
+                                {"n": 3}, name="o"),
+              M @ M @ M, {"a": M}, tol=1e-3)
+
+
+def _perm_from_pivots(piv, n):
+    perm = np.arange(n)
+    for i, p in enumerate(piv.astype(int)):
+        perm[i], perm[p] = perm[p], perm[i]
+    return perm
+
+
+# ------------------------------------------------------ im2col / col2im ----
+def test_im2col_golden_and_adjoint():
+    x = _R(19).randn(2, 3, 5, 5).astype(np.float64)
+    kh = kw = 2
+    [cols] = _run(lambda sd: sd._op("im2col", [sd.placeholder("x")],
+                                    {"kH": 2, "kW": 2, "sH": 1, "sW": 1}),
+                  {"x": x})
+    assert cols.shape == (2, 3, 2, 2, 4, 4)
+    for b, c, i, j, oi, oj in itertools.product(
+            range(2), range(3), range(2), range(2), range(4), range(4)):
+        assert cols[b, c, i, j, oi, oj] == x[b, c, oi + i, oj + j]
+    # col2im is the exact adjoint: <im2col(x), c> == <x, col2im(c)>
+    cvec = _R(20).randn(*cols.shape)
+    [back] = _run(lambda sd: sd._op(
+        "col2im", [sd.placeholder("c")],
+        {"sH": 1, "sW": 1, "imgH": 5, "imgW": 5}), {"c": cvec})
+    np.testing.assert_allclose((cols * cvec).sum(), (x * back).sum(),
+                               rtol=1e-10)
+
+
+# ----------------------------------------------------------------- ctc ----
+def _ctc_brute(logits, labels, blank=0):
+    """Sum probability over ALL alignments that collapse to `labels`."""
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: merge repeats then drop blanks
+        coll = []
+        prev = None
+        for s in path:
+            if s != prev:
+                coll.append(s)
+            prev = s
+        coll = [s for s in coll if s != blank]
+        if coll == list(labels):
+            pr = 1.0
+            for t, s in enumerate(path):
+                pr *= p[t, s]
+            total += pr
+    return -np.log(total)
+
+
+def test_ctc_loss_vs_bruteforce():
+    rng = _R(21)
+    T, C = 4, 3
+    logits = rng.randn(2, T, C).astype(np.float32)
+    labels = np.array([[1, 2], [2, 0]], np.int32)   # 2nd uses length 1
+    lab_len = np.array([2, 1], np.int32)
+    log_len = np.array([T, T], np.int32)
+    [loss] = _run(lambda sd: sd._op(
+        "ctcLoss", [sd.placeholder("l"), sd.placeholder("x"),
+                    sd.placeholder("ll"), sd.placeholder("xl")]),
+        {"l": labels, "x": logits, "ll": lab_len, "xl": log_len})
+    exp0 = _ctc_brute(logits[0], [1, 2])
+    exp1 = _ctc_brute(logits[1], [2])
+    np.testing.assert_allclose(loss, [exp0, exp1], rtol=1e-4)
+
+
+def test_ctc_loss_respects_logit_lengths():
+    rng = _R(22)
+    T, C = 5, 3
+    logits = rng.randn(1, T, C).astype(np.float32)
+    labels = np.array([[1, 2]], np.int32)
+    [l_full] = _run(lambda sd: sd._op(
+        "ctcLoss", [sd.placeholder("l"), sd.placeholder("x"),
+                    sd.placeholder("ll"), sd.placeholder("xl")]),
+        {"l": labels, "x": logits, "ll": np.array([2], np.int32),
+         "xl": np.array([3], np.int32)})
+    exp = _ctc_brute(logits[0, :3], [1, 2])
+    np.testing.assert_allclose(l_full, [exp], rtol=1e-4)
+
+
+# ---------------------------------------- dynamic / unique / listdiff ----
+def test_dynamic_partition_stitch_roundtrip():
+    x = np.array([10., 20., 30., 40., 50.], np.float32)
+    parts = np.array([0, 1, 0, 1, 0], np.int32)
+    p0, p1 = _run(lambda sd: sd._op(
+        "dynamicPartition", [sd.placeholder("x"), sd.placeholder("p")],
+        {"numPartitions": 2}, n_out=2), {"x": x, "p": parts})
+    # XLA bounded semantics: compacted to front, zero-padded
+    np.testing.assert_allclose(p0, [10, 30, 50, 0, 0])
+    np.testing.assert_allclose(p1, [20, 40, 0, 0, 0])
+    # canonical roundtrip: partition arange indices the same way -> stitch
+    i0, i1 = _run(lambda sd: sd._op(
+        "dynamicPartition", [sd.placeholder("x"), sd.placeholder("p")],
+        {"numPartitions": 2}, n_out=2),
+        {"x": np.arange(5, dtype=np.int32), "p": parts})
+    i0 = np.where(np.arange(5) < 3, i0, -1)   # mark padding invalid
+    i1 = np.where(np.arange(5) < 2, i1, -1)
+    [merged] = _run(lambda sd: sd._op(
+        "dynamicStitch",
+        [sd.placeholder("i0"), sd.placeholder("i1"),
+         sd.placeholder("d0"), sd.placeholder("d1")],
+        {"numPartitions": 2}), {"i0": i0, "i1": i1, "d0": p0, "d1": p1})
+    np.testing.assert_allclose(merged[:5], x)
+
+
+def test_dynamic_stitch_negative_padding_not_wrapped():
+    """-1 padding indices must be DROPPED, not wrap to the last row."""
+    i0 = np.array([0, 3], np.int32)
+    i1 = np.array([1, -1], np.int32)       # -1 is padding
+    d0 = np.array([10., 40.], np.float32)
+    d1 = np.array([20., 99.], np.float32)  # 99 must NOT land anywhere
+    [out] = _run(lambda sd: sd._op(
+        "dynamicStitch",
+        [sd.placeholder("i0"), sd.placeholder("i1"),
+         sd.placeholder("d0"), sd.placeholder("d1")],
+        {"numPartitions": 2}),
+        {"i0": i0, "i1": i1, "d0": d0, "d1": d1})
+    np.testing.assert_allclose(out, [10, 20, 0, 40])
+
+
+def test_cummax_exclusive_reverse():
+    x = np.array([[3., 1., 4., 1.], [5., 9., 2., 6.]], np.float32)
+    [r] = _run(lambda sd: sd._op("cumMax", [sd.placeholder("x")],
+                                 {"dims": 1, "reverse": True}), {"x": x})
+    np.testing.assert_allclose(
+        r, np.flip(np.maximum.accumulate(np.flip(x, 1), 1), 1))
+    [e] = _run(lambda sd: sd._op("cumMax", [sd.placeholder("x")],
+                                 {"dims": 1, "exclusive": True}), {"x": x})
+    ref = np.concatenate([np.full((2, 1), -np.inf),
+                          np.maximum.accumulate(x, 1)[:, :-1]], axis=1)
+    np.testing.assert_allclose(e, ref)
+    [m] = _run(lambda sd: sd._op("cumMin", [sd.placeholder("x")],
+                                 {"dims": 1, "reverse": True}), {"x": x})
+    np.testing.assert_allclose(
+        m, np.flip(np.minimum.accumulate(np.flip(x, 1), 1), 1))
+
+
+def test_ctc_loss_zero_length_label():
+    """lab_len=0: loss is the all-blank path NLL (no log(2) offset)."""
+    rng = _R(50)
+    T, C = 3, 2
+    logits = rng.randn(1, T, C).astype(np.float32)
+    [loss] = _run(lambda sd: sd._op(
+        "ctcLoss", [sd.placeholder("l"), sd.placeholder("x"),
+                    sd.placeholder("ll"), sd.placeholder("xl")]),
+        {"l": np.zeros((1, 2), np.int32), "x": logits,
+         "ll": np.array([0], np.int32), "xl": np.array([T], np.int32)})
+    p = np.exp(logits[0] - logits[0].max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(loss, [-np.log(np.prod(p[:, 0]))], rtol=1e-4)
+
+
+def test_unique_listdiff():
+    x = np.array([3, 1, 3, 2, 1, 3], np.int64)
+    vals, idx = _run(lambda sd: sd._op("unique", [sd.placeholder("x")],
+                                       n_out=2), {"x": x})
+    np.testing.assert_array_equal(vals[:3], [1, 2, 3])
+    np.testing.assert_array_equal(vals[3:], [0, 0, 0])  # padded
+    np.testing.assert_array_equal([vals[i] for i in idx], x)
+    vals2, idx2, cnt = _run(lambda sd: sd._op(
+        "uniqueWithCounts", [sd.placeholder("x")], n_out=3), {"x": x})
+    np.testing.assert_array_equal(cnt[:3], [2, 1, 3])
+    a = np.array([1, 2, 3, 4, 5, 6], np.int64)
+    b = np.array([2, 4], np.int64)
+    dv, di = _run(lambda sd: sd._op("listDiff", [sd.placeholder("a"),
+                                                 sd.placeholder("b")],
+                                    n_out=2), {"a": a, "b": b})
+    np.testing.assert_array_equal(dv[:4], [1, 3, 5, 6])
+    np.testing.assert_array_equal(di[:4], [0, 2, 4, 5])
+    np.testing.assert_array_equal(di[4:], [-1, -1])
+
+
+def test_histogram():
+    x = _R(23).randn(100).astype(np.float32)
+    [h] = _run(lambda sd: sd._op("histogram", [sd.placeholder("x")],
+                                 {"numBins": 10}), {"x": x})
+    ref, _ = np.histogram(x, bins=10, range=(x.min(), x.max()))
+    np.testing.assert_array_equal(h, ref)
+    [h2] = _run(lambda sd: sd._op(
+        "histogramFixedWidth", [sd.placeholder("x"), sd.placeholder("r")],
+        {"numBins": 8}), {"x": x, "r": np.array([-2.0, 2.0], np.float32)})
+    idx = np.clip(((x + 2) / 4 * 8).astype(int), 0, 7)
+    np.testing.assert_array_equal(h2, np.bincount(idx, minlength=8))
+
+
+# -------------------------------------------------------------- losses ----
+def test_loss_ops():
+    lab = (_R(24).rand(4, 3) > 0.5).astype(np.float32)
+    pred = _R(25).randn(4, 3).astype(np.float32)
+    y = 2 * lab - 1
+    _validate(lambda sd: sd._op("hingeLoss", [sd.placeholder("l"),
+                                              sd.placeholder("p")],
+                                name="o"),
+              np.float32(np.maximum(0, 1 - y * pred).mean()),
+              {"l": lab, "p": pred})
+    _validate(lambda sd: sd._op("squaredHingeLoss", [sd.placeholder("l"),
+                                                     sd.placeholder("p")],
+                                name="o"),
+              np.float32((np.maximum(0, 1 - y * pred) ** 2).mean()),
+              {"l": lab, "p": pred})
+    rate = np.abs(pred) + 0.1
+    _validate(lambda sd: sd._op("poissonLoss", [sd.placeholder("l"),
+                                                sd.placeholder("p")],
+                                name="o"),
+              np.float32((rate - lab * np.log(rate)).mean()),
+              {"l": lab, "p": rate})
+    w = np.float32(2.0)
+    sig = 1 / (1 + np.exp(-pred))
+    ref = -(lab * np.log(sig) * w + (1 - lab) * np.log(1 - sig))
+    _validate(lambda sd: sd._op(
+        "weightedCrossEntropyWithLogits",
+        [sd.placeholder("t"), sd.placeholder("x"), sd.placeholder("w")],
+        name="o"),
+        np.float32(ref.mean()), {"t": lab, "x": pred, "w": w}, tol=1e-3)
+    P = np.abs(_R(26).randn(4, 3)) + 0.1
+    P /= P.sum(-1, keepdims=True)
+    Q = np.abs(_R(27).randn(4, 3)) + 0.1
+    Q /= Q.sum(-1, keepdims=True)
+    _validate(lambda sd: sd._op("klDivergence", [sd.placeholder("l"),
+                                                 sd.placeholder("p")],
+                                name="o"),
+              np.float32((P * (np.log(P) - np.log(Q))).sum(-1).mean()),
+              {"l": P.astype(np.float32), "p": Q.astype(np.float32)},
+              tol=1e-3)
+    _validate(lambda sd: sd._op("cosineDistanceLoss",
+                                [sd.placeholder("l"), sd.placeholder("p")],
+                                name="o"),
+              np.float32((1 - (lab * pred).sum(-1)).mean()),
+              {"l": lab, "p": pred}, tol=1e-3)
+
+
+# ------------------------------------------------- conv family (torch) ----
+def test_conv_ops_vs_torch():
+    torch = pytest.importorskip("torch")
+    F = torch.nn.functional
+    x1 = _R(28).randn(2, 3, 9).astype(np.float32)
+    w1 = _R(29).randn(4, 3, 3).astype(np.float32)
+    b1 = _R(30).randn(4).astype(np.float32)
+    ref = F.conv1d(torch.tensor(x1), torch.tensor(w1), torch.tensor(b1),
+                   stride=2).numpy()
+    _validate(lambda sd: sd._op("conv1d", [sd.placeholder("x"),
+                                           sd.placeholder("w"),
+                                           sd.placeholder("b")],
+                                {"s": 2}, name="o"),
+              ref, {"x": x1, "w": w1, "b": b1}, tol=1e-3)
+
+    x3 = _R(31).randn(1, 2, 5, 6, 7).astype(np.float32)
+    w3 = _R(32).randn(3, 2, 2, 2, 2).astype(np.float32)
+    ref = F.conv3d(torch.tensor(x3), torch.tensor(w3), stride=1).numpy()
+    _validate(lambda sd: sd._op("conv3d", [sd.placeholder("x"),
+                                           sd.placeholder("w")], name="o"),
+              ref, {"x": x3, "w": w3}, tol=1e-3)
+
+    xd = _R(33).randn(2, 4, 6, 6).astype(np.float32)
+    wd = _R(34).randn(4, 2, 3, 3).astype(np.float32)   # (in, out, kh, kw)
+    ref = F.conv_transpose2d(torch.tensor(xd),
+                             torch.tensor(wd), stride=2).numpy()
+    # ours: w (o, i, kh, kw)
+    _validate(lambda sd: sd._op("deconv2d", [sd.placeholder("x"),
+                                             sd.placeholder("w")],
+                                {"sH": 2, "sW": 2}, name="o"),
+              ref, {"x": xd, "w": wd.transpose(1, 0, 2, 3)}, tol=1e-3)
+
+    xw = _R(35).randn(2, 3, 7, 7).astype(np.float32)
+    ww = _R(36).randn(6, 1, 3, 3).astype(np.float32)   # mult=2
+    ref = F.conv2d(torch.tensor(xw), torch.tensor(ww), groups=3).numpy()
+    _validate(lambda sd: sd._op("depthwiseConv2d", [sd.placeholder("x"),
+                                                    sd.placeholder("w")],
+                                name="o"),
+              ref, {"x": xw, "w": ww}, tol=1e-3)
+
+    pw = _R(37).randn(5, 6, 1, 1).astype(np.float32)
+    ref = F.conv2d(torch.tensor(ref), torch.tensor(pw)).numpy()
+    _validate(lambda sd: sd._op("sconv2d", [sd.placeholder("x"),
+                                            sd.placeholder("d"),
+                                            sd.placeholder("p")],
+                                name="o"),
+              ref, {"x": xw, "d": ww, "p": pw}, tol=1e-3)
+
+
+def test_pool3d_upsample_lrn_vs_torch():
+    torch = pytest.importorskip("torch")
+    F = torch.nn.functional
+    x = _R(38).randn(2, 3, 6, 6, 6).astype(np.float32)
+    ref = F.max_pool3d(torch.tensor(x), 2, 2).numpy()
+    _validate(lambda sd: sd._op("maxPooling3d", [sd.placeholder("x")],
+                                {"kD": 2, "kH": 2, "kW": 2}, name="o"),
+              ref, {"x": x}, tol=1e-4)
+    ref = F.avg_pool3d(torch.tensor(x), 2, 2).numpy()
+    _validate(lambda sd: sd._op("avgPooling3d", [sd.placeholder("x")],
+                                {"kD": 2, "kH": 2, "kW": 2}, name="o"),
+              ref, {"x": x}, tol=1e-4)
+    x2 = _R(39).randn(1, 2, 3, 4).astype(np.float32)
+    _validate(lambda sd: sd._op("upsampling2d", [sd.placeholder("x")],
+                                {"scaleH": 2, "scaleW": 3}, name="o"),
+              x2.repeat(2, axis=2).repeat(3, axis=3), {"x": x2})
+    x3 = _R(40).randn(1, 2, 2, 2, 2).astype(np.float32)
+    _validate(lambda sd: sd._op("upsampling3d", [sd.placeholder("x")],
+                                {"scaleD": 2, "scaleH": 2, "scaleW": 2},
+                                name="o"),
+              x3.repeat(2, axis=2).repeat(2, axis=3).repeat(2, axis=4),
+              {"x": x3})
+    xl = np.abs(_R(41).randn(2, 7, 4, 4)).astype(np.float32)
+    depth, alpha, beta, k = 5, 1e-3, 0.75, 1.0
+    ref = F.local_response_norm(torch.tensor(xl), size=depth,
+                                alpha=alpha * depth, beta=beta, k=k).numpy()
+    _validate(lambda sd: sd._op("localResponseNormalization",
+                                [sd.placeholder("x")],
+                                {"depth": depth, "bias": k, "alpha": alpha,
+                                 "beta": beta}, name="o"),
+              ref, {"x": xl}, tol=1e-3)
+
+
+# -------------------------------------------------------------- random ----
+def test_random_family():
+    outs = {}
+    for op, attrs in [
+        ("random_exponential", {"shape": (4000,), "seed": 1,
+                                "lambda": 2.0}),
+        ("random_gamma", {"shape": (4000,), "seed": 2, "alpha": 3.0}),
+        ("random_poisson", {"shape": (4000,), "seed": 3, "lam": 4.0}),
+        ("random_truncated_normal", {"shape": (4000,), "seed": 4}),
+        ("random_gumbel", {"shape": (4000,), "seed": 5}),
+    ]:
+        [v] = _run(lambda sd, op=op, attrs=attrs: sd._op(op, [], attrs))
+        outs[op] = v
+    assert abs(outs["random_exponential"].mean() - 0.5) < 0.05
+    assert abs(outs["random_gamma"].mean() - 3.0) < 0.2
+    assert abs(outs["random_poisson"].mean() - 4.0) < 0.2
+    assert np.abs(outs["random_truncated_normal"]).max() <= 2.0
+    assert abs(outs["random_gumbel"].mean() - 0.5772) < 0.1
+    x = np.arange(10, dtype=np.float32)
+    [sh] = _run(lambda sd: sd._op("random_shuffle", [sd.placeholder("x")],
+                                  {"seed": 6}), {"x": x})
+    assert sorted(sh.tolist()) == x.tolist() and not (sh == x).all()
+    logits = np.log(np.array([[0.8, 0.1, 0.1], [0.05, 0.9, 0.05]],
+                             np.float32))
+    [samp] = _run(lambda sd: sd._op("random_multinomial",
+                                    [sd.placeholder("x")],
+                                    {"numSamples": 500, "seed": 7}),
+                  {"x": logits})
+    assert samp.shape == (2, 500)
+    assert (samp[0] == 0).mean() > 0.6 and (samp[1] == 1).mean() > 0.75
+
+
+# --------------------------------------------------------------- image ----
+def test_colorspace_roundtrips():
+    import colorsys
+    rgb = _R(42).rand(5, 4, 3).astype(np.float32)
+    [hsv] = _run(lambda sd: sd._op("rgbToHsv", [sd.placeholder("x")]),
+                 {"x": rgb})
+    for i, j in itertools.product(range(5), range(4)):
+        exp = colorsys.rgb_to_hsv(*rgb[i, j])
+        np.testing.assert_allclose(hsv[i, j], exp, atol=1e-5)
+    [back] = _run(lambda sd: sd._op("hsvToRgb", [sd.placeholder("x")]),
+                  {"x": hsv})
+    np.testing.assert_allclose(back, rgb, atol=1e-5)
+    [yuv] = _run(lambda sd: sd._op("rgbToYuv", [sd.placeholder("x")]),
+                 {"x": rgb})
+    [rgb2] = _run(lambda sd: sd._op("yuvToRgb", [sd.placeholder("x")]),
+                  {"x": yuv})
+    np.testing.assert_allclose(rgb2, rgb, atol=1e-5)
+    [same] = _run(lambda sd: sd._op("adjustHue", [sd.placeholder("x")],
+                                    {"delta": 0.0}), {"x": rgb})
+    np.testing.assert_allclose(same, rgb, atol=1e-4)
+    [shifted] = _run(lambda sd: sd._op("adjustHue", [sd.placeholder("x")],
+                                       {"delta": 0.25}), {"x": rgb})
+    for i, j in itertools.product(range(5), range(4)):
+        h, s, v = colorsys.rgb_to_hsv(*rgb[i, j])
+        exp = colorsys.hsv_to_rgb((h + 0.25) % 1.0, s, v)
+        np.testing.assert_allclose(shifted[i, j], exp, atol=1e-4)
+
+
+def test_non_max_suppression():
+    boxes = np.array([[0, 0, 1, 1], [0, 0.05, 1, 1.05], [0, 2, 1, 3],
+                      [0, 2.02, 1, 3.02], [5, 5, 6, 6]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.85, 0.1], np.float32)
+    [sel] = _run(lambda sd: sd._op(
+        "nonMaxSuppression", [sd.placeholder("b"), sd.placeholder("s")],
+        {"maxOutputSize": 4, "iouThreshold": 0.5}),
+        {"b": boxes, "s": scores})
+    np.testing.assert_array_equal(sel, [0, 3, 4, -1])
+
+
+# ------------------------------------------------------- gradient checks --
+def test_gradients_new_families():
+    """Numeric-vs-analytic gradcheck on differentiable representatives."""
+    from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff.samediff import OP_IMPLS
+
+    x = _R(43).randn(1, 2, 4, 4)
+
+    def loss_im2col(p):
+        f = OP_IMPLS["im2col"](kH=2, kW=2, sH=1, sW=1)
+        return jnp.sum(jnp.sin(f(p["x"])))
+    r = check_gradients(loss_im2col, {"x": x})
+    assert r.passed, r.failures[:3]
+
+    logits = _R(44).randn(2, 4, 3)
+
+    def loss_ctc(p):
+        f = OP_IMPLS["ctcLoss"]()
+        return jnp.sum(f(jnp.array([[1, 2], [2, 1]], jnp.int32), p["x"],
+                         jnp.array([2, 2], jnp.int32),
+                         jnp.array([4, 4], jnp.int32)))
+    r = check_gradients(loss_ctc, {"x": logits})
+    assert r.passed, r.failures[:3]
+
+    def loss_hinge(p):
+        f = OP_IMPLS["hingeLoss"]()
+        lab = jnp.asarray((_R(45).rand(3, 2) > 0.5).astype(np.float64))
+        return f(lab, p["x"])
+    r = check_gradients(loss_hinge, {"x": _R(46).randn(3, 2) * 0.3})
+    assert r.passed, r.failures[:3]
+
+    def loss_conv3d(p):
+        f = OP_IMPLS["conv3d"]()
+        return jnp.sum(f(p["x"], p["w"]) ** 2)
+    r = check_gradients(loss_conv3d,
+                        {"x": _R(47).randn(1, 1, 3, 3, 3) * 0.5,
+                         "w": _R(48).randn(2, 1, 2, 2, 2) * 0.5})
+    assert r.passed, r.failures[:3]
