@@ -1,24 +1,51 @@
 """Test configuration.
 
-All tests run on CPU with 8 virtual XLA devices so the multi-chip sharding
-path is exercised without TPU hardware (the reference's analogue is
-DummyTransport / local[N] Spark masters — SURVEY.md §4).
+By default all tests run on CPU with 8 virtual XLA devices so the
+multi-chip sharding path is exercised without TPU hardware (the reference's
+analogue is DummyTransport / local[N] Spark masters — SURVEY.md §4).
 
-Note: this environment's sitecustomize imports jax and registers the axon/TPU
-platform before conftest runs, so setting ``JAX_PLATFORMS`` via os.environ is
-too late — we must go through ``jax.config.update``.
+``pytest -m tpu tests/`` instead keeps the real chip (axon platform) and
+runs ONLY the ``@pytest.mark.tpu`` smoke tests — the backend cross-check
+pattern (SURVEY.md §4): same APIs, real hardware, catches libtpu skew /
+f64-poisoning classes of breakage before the driver's bench run does.
+
+Note: this environment's sitecustomize imports jax and registers the
+axon/TPU platform before conftest runs, so setting ``JAX_PLATFORMS`` via
+os.environ is too late — we must go through ``jax.config.update``.
 """
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+_TPU_RUN = "tpu" in os.environ.get("PYTEST_ADDOPTS", "") or \
+    any(a == "tpu" for i, a in enumerate(sys.argv)
+        if i and sys.argv[i - 1] == "-m")
+
+if not _TPU_RUN:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_RUN:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: smoke tests that need the real TPU chip "
+        "(run with `pytest -m tpu`; skipped on the CPU mesh)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+    if _TPU_RUN:
+        return
+    skip = pytest.mark.skip(reason="needs real TPU (run: pytest -m tpu)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
