@@ -109,11 +109,13 @@ class _EmbeddingTrainer:
     """Shared SGNS machinery: one jitted step over index batches."""
 
     def __init__(self, vocabSize: int, layerSize: int, seed: int,
-                 learningRate: float, negative: int, extraRows: int = 0):
+                 learningRate: float, negative: int, extraRows: int = 0,
+                 mesh=None):
         self.vocabSize = vocabSize
         self.layerSize = layerSize
         self.negative = max(1, int(negative))
         self.lr = learningRate
+        self.mesh = mesh
         key = jax.random.PRNGKey(seed)
         k1, _ = jax.random.split(key)
         # syn0 uniform(-0.5/d, 0.5/d) like the reference; syn1neg zeros
@@ -122,6 +124,24 @@ class _EmbeddingTrainer:
             k1, (rows, layerSize), jnp.float32,
             -0.5 / layerSize, 0.5 / layerSize)
         self.syn1 = jnp.zeros((vocabSize, layerSize), jnp.float32)
+        if mesh is not None:
+            # Distributed SGNS (reference P5: VoidParameterServer v1 +
+            # SkipGramTrainer pushing rows over Aeron UDP — SURVEY §2.6).
+            # TPU-native: embedding tables replicated, the PAIR batch
+            # sharded over the data axis; GSPMD turns the grad of the
+            # SUM-reduction loss into one psum over ICI inside the step —
+            # mathematically the server's row aggregation, at ICI speed.
+            rep = mesh.replicated()
+            self.syn0 = jax.device_put(self.syn0, rep)
+            self.syn1 = jax.device_put(self.syn1, rep)
+
+    def _shard(self, arr):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        a = jnp.asarray(arr)
+        if a.shape[0] % self.mesh.dataSize:
+            return a
+        return jax.device_put(a, self.mesh.dataSharding())
 
     @functools.cached_property
     def _step(self):
@@ -177,16 +197,16 @@ class _EmbeddingTrainer:
 
     def train_batch(self, centers, contexts, negatives, lr=None):
         self.syn0, self.syn1, loss = self._step(
-            self.syn0, self.syn1, jnp.asarray(centers),
-            jnp.asarray(contexts), jnp.asarray(negatives),
+            self.syn0, self.syn1, self._shard(centers),
+            self._shard(contexts), self._shard(negatives),
             jnp.asarray(lr if lr is not None else self.lr, jnp.float32))
         return float(loss)
 
     def train_batch_cbow(self, ctx, ctx_mask, centers, negatives, lr=None):
         self.syn0, self.syn1, loss = self._step_cbow(
-            self.syn0, self.syn1, jnp.asarray(ctx),
-            jnp.asarray(ctx_mask, jnp.float32), jnp.asarray(centers),
-            jnp.asarray(negatives),
+            self.syn0, self.syn1, self._shard(ctx),
+            self._shard(jnp.asarray(ctx_mask, jnp.float32)),
+            self._shard(centers), self._shard(negatives),
             jnp.asarray(lr if lr is not None else self.lr, jnp.float32))
         return float(loss)
 
@@ -285,7 +305,8 @@ class Word2Vec(WordVectors):
                  batchSize: int = 512, useCBOW: bool = False,
                  subsampling: float = 0.0,
                  tokenizerFactory: Optional[TokenizerFactory] = None,
-                 elementsLearningAlgorithm: Optional[str] = None):
+                 elementsLearningAlgorithm: Optional[str] = None,
+                 workers: int = 1):
         self.sentencesSrc = sentences
         self.minWordFrequency = minWordFrequency
         self.layerSize = layerSize
@@ -300,6 +321,10 @@ class Word2Vec(WordVectors):
         self.useCBOW = useCBOW or (elementsLearningAlgorithm == "CBOW")
         self.subsampling = subsampling
         self.tokenizerFactory = tokenizerFactory or DefaultTokenizerFactory()
+        # workers>1 = distributed SGNS over a device mesh (reference P5:
+        # Word2Vec.Builder#workers fed VoidParameterServer shards; here the
+        # mesh's data axis takes that role — see _EmbeddingTrainer)
+        self.workers = int(workers)
         self._fitted = False
 
     class Builder:
@@ -349,9 +374,14 @@ class Word2Vec(WordVectors):
                for s in sents]
         ids = _subsample(ids, vocab, self.subsampling, rng)
         sampler = _NegativeSampler(vocab)
+        mesh = None
+        if self.workers > 1:
+            from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+            mesh = DeviceMesh(data=self.workers,
+                              devices=jax.devices()[:self.workers])
         trainer = _EmbeddingTrainer(vocab.numWords(), self.layerSize,
                                     self.seed, self.learningRate,
-                                    self.negativeSample)
+                                    self.negativeSample, mesh=mesh)
         if self.useCBOW:
             self._fit_cbow(ids, trainer, sampler, rng)
         else:

@@ -37,6 +37,27 @@ def test_word2vec_learns_topical_similarity():
     assert "car" in near or "engine" in near or "road" in near
 
 
+def test_word2vec_distributed_workers_quality_parity():
+    """Distributed SGNS over the 8-device CPU mesh (reference P5:
+    VoidParameterServer sharded Word2Vec).  Same seed/batches as the
+    single-device run -> the psum'd update is the same math, so the
+    learned similarity structure must match."""
+    kw = dict(layerSize=32, minWordFrequency=1, windowSize=3, seed=7,
+              epochs=10, learningRate=0.025, batchSize=512)
+    single = Word2Vec(sentences=_corpus(), **kw).fit()
+    dist = Word2Vec(sentences=_corpus(), workers=8, **kw).fit()
+    # identical similarity structure
+    assert dist.similarity("apple", "banana") > \
+        dist.similarity("apple", "car")
+    for a, b in [("apple", "banana"), ("car", "truck"), ("apple", "car")]:
+        assert abs(dist.similarity(a, b) - single.similarity(a, b)) < 0.05
+    # vectors numerically track the single-device run (same update math;
+    # only the all-reduce changes summation order)
+    va, vb = single.getWordVector("apple"), dist.getWordVector("apple")
+    cos = float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)))
+    assert cos > 0.99
+
+
 def test_word2vec_cbow_mode_runs():
     w2v = Word2Vec(sentences=_corpus(), layerSize=16, epochs=2, seed=1,
                    useCBOW=True)
