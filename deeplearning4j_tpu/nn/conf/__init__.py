@@ -18,6 +18,8 @@ from deeplearning4j_tpu.nn.conf.layers import (Layer, layer_from_json)
 # importing these registers the RNN / extended-conv layers with the registry
 import deeplearning4j_tpu.nn.conf.recurrent  # noqa: F401
 import deeplearning4j_tpu.nn.conf.convolutional  # noqa: F401
+from deeplearning4j_tpu.nn.conf.samediff_layer import (  # noqa: F401
+    SameDiffLambdaLayer, SameDiffLayer, SDLayerParams)
 import deeplearning4j_tpu.nn.conf.convolutional3d  # noqa: F401
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     Cnn3DToFeedForwardPreProcessor, CnnToFeedForwardPreProcessor,

@@ -1,0 +1,174 @@
+"""SameDiffLayer — user-defined layers inside MultiLayerNetwork /
+ComputationGraph.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/layers/samediff/
+SameDiffLayer.java`` + ``conf/layers/samediff/AbstractSameDiffLayer.java``
+(SURVEY.md §2.5): a user subclass declares its parameters
+(``defineParameters``) and defines the forward pass on a SameDiff graph
+(``defineLayer``); the framework owns initialization, gradients, updater
+state and serialization.
+
+TPU-first: the user's ``defineLayer`` builds a small SameDiff graph whose
+inputs (layer input + every parameter) are placeholders; that graph is
+staged ONCE to a pure jax function and inlined into the enclosing model's
+single fused train-step executable.  Gradients come from ``jax.grad``
+over the whole model — no per-layer backprop contract to implement (the
+reference derives backprop from the layer's SameDiff autodiff too, but
+executes it op-by-op through InferenceSession).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BaseLayer, register_layer)
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["SDLayerParams", "SameDiffLayer", "SameDiffLambdaLayer"]
+
+_INPUT = "layerInput"
+
+
+class SDLayerParams:
+    """Reference: ``conf/layers/samediff/SDLayerParams.java`` — collects
+    the shapes a SameDiffLayer declares in ``defineParameters``."""
+
+    def __init__(self):
+        self.weightParams: Dict[str, Tuple[int, ...]] = {}
+        self.biasParams: Dict[str, Tuple[int, ...]] = {}
+
+    def addWeightParam(self, name: str, *shape: int) -> "SDLayerParams":
+        self.weightParams[name] = tuple(int(s) for s in shape)
+        return self
+
+    def addBiasParam(self, name: str, *shape: int) -> "SDLayerParams":
+        self.biasParams[name] = tuple(int(s) for s in shape)
+        return self
+
+
+@dataclasses.dataclass
+class SameDiffLayer(BaseLayer):
+    """Subclass and implement:
+
+    - ``defineParameters(params: SDLayerParams)`` — declare weight/bias
+      shapes (may use ``self.nIn`` — filled by shape inference first).
+    - ``defineLayer(sd, layerInput, paramTable) -> SDVariable`` — the
+      forward pass on a :class:`SameDiff` using its op surface
+      (``sd.math()``, ``sd.nn()``, mmul, …).
+    - ``getOutputType(inputType)`` — output shape.
+    - optionally ``initializeParameters(params: dict) -> dict`` to override
+      the default init (weights: the layer/global ``weightInit`` scheme;
+      biases: zeros).
+
+    Subclasses auto-register for JSON/zip serde; restoring a checkpoint
+    needs the subclass imported first (same contract as the reference's
+    Jackson class-name mapping).
+    """
+    nIn: int = 0
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        register_layer(cls)
+
+    # -- user contract ---------------------------------------------------
+    def defineParameters(self, params: SDLayerParams) -> None:
+        raise NotImplementedError
+
+    def defineLayer(self, sd, layerInput, paramTable):
+        raise NotImplementedError
+
+    def initializeParameters(self, params: Dict) -> Dict:
+        return params
+
+    # -- framework side --------------------------------------------------
+    def preferredFormat(self) -> Optional[str]:
+        return "FF"
+
+    def inferNIn(self, inputType) -> None:
+        if not self.nIn and hasattr(inputType, "size"):
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType) -> InputType:
+        raise NotImplementedError(
+            f"{type(self).__name__}.getOutputType must be implemented")
+
+    def _declared(self) -> SDLayerParams:
+        ps = SDLayerParams()
+        self.defineParameters(ps)
+        return ps
+
+    def initParams(self, key, inputType, dtype=jnp.float32) -> Dict:
+        ps = self._declared()
+        out: Dict = {}
+        for i, (name, shape) in enumerate(ps.weightParams.items()):
+            fan_in = int(shape[0]) if shape else 1
+            fan_out = int(shape[-1]) if shape else 1
+            out[name] = init_weight(jax.random.fold_in(key, i), shape,
+                                    fan_in, fan_out,
+                                    self.weightInit or "XAVIER", dtype)
+        for name, shape in ps.biasParams.items():
+            out[name] = jnp.full(shape, self.biasInit or 0.0, dtype)
+        return self.initializeParameters(out)
+
+    def _staged(self, train: bool):
+        cache = self.__dict__.setdefault("_staged_fns", {})
+        if train not in cache:
+            from deeplearning4j_tpu.autodiff.samediff import SameDiff
+            sd = SameDiff.create()
+            inp = sd.placeholder(_INPUT)
+            ps = self._declared()
+            table = {n: sd.placeholder(n)
+                     for n in list(ps.weightParams) + list(ps.biasParams)}
+            out = self.defineLayer(sd, inp, table)
+            fn = sd._build_fn((out.name(),), training=train)
+            cache[train] = (fn, out.name())
+        return cache[train]
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        fn, out_name = self._staged(bool(train))
+        res = fn({_INPUT: x, **params}, {}, 0)
+        return res[out_name], state
+
+    def toJson(self) -> dict:
+        d = super().toJson()
+        d.pop("_staged_fns", None)
+        return d
+
+
+@dataclasses.dataclass
+class SameDiffLambdaLayer(SameDiffLayer):
+    """Parameter-free variant (reference: ``SameDiffLambdaLayer.java``):
+    implement only ``defineLayer(sd, layerInput)`` and ``getOutputType``."""
+
+    def defineParameters(self, params: SDLayerParams) -> None:
+        pass
+
+    def initParams(self, key, inputType, dtype=jnp.float32) -> Dict:
+        return {}
+
+    def getOutputType(self, inputType) -> InputType:
+        return inputType
+
+    def forward(self, params, x, train, key, state):
+        fn, out_name = self._staged(bool(train))
+        res = fn({_INPUT: x}, {}, 0)
+        return res[out_name], state
+
+    def _staged(self, train: bool):
+        cache = self.__dict__.setdefault("_staged_fns", {})
+        if train not in cache:
+            from deeplearning4j_tpu.autodiff.samediff import SameDiff
+            sd = SameDiff.create()
+            inp = sd.placeholder(_INPUT)
+            out = self.defineLayer(sd, inp)
+            fn = sd._build_fn((out.name(),), training=train)
+            cache[train] = (fn, out.name())
+        return cache[train]
+
+    def defineLayer(self, sd, layerInput):  # noqa: D102 (user hook)
+        raise NotImplementedError

@@ -1,0 +1,127 @@
+"""SameDiffLayer escape hatch (reference: deeplearning4j-nn
+layers/samediff/SameDiffLayer.java; test analogue: TestSameDiffDense —
+custom layer behaves identically to the built-in and trains/serializes)."""
+import dataclasses
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (InputType, NeuralNetConfiguration,
+                                        SameDiffLambdaLayer, SameDiffLayer,
+                                        SDLayerParams)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+
+@dataclasses.dataclass
+class MyDense(SameDiffLayer):
+    """User-defined dense+tanh via the SameDiff op surface."""
+    nOut: int = 8
+
+    def defineParameters(self, params: SDLayerParams):
+        params.addWeightParam("W", self.nIn, self.nOut)
+        params.addBiasParam("b", self.nOut)
+
+    def defineLayer(self, sd, layerInput, paramTable):
+        return sd.math().tanh(
+            sd.nn().linear(layerInput, paramTable["W"], paramTable["b"]))
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+
+@dataclasses.dataclass
+class TimesTwo(SameDiffLambdaLayer):
+    def defineLayer(self, sd, layerInput):
+        return layerInput * 2.0
+
+
+def _net(layer):
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+            .list()
+            .layer(layer)
+            .layer(OutputLayer.builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(10)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy(n=96):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 10).astype(np.float32)
+    w = rng.randn(10, 3)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, 1)]
+    return DataSet(x, y)
+
+
+class TestSameDiffLayer:
+    def test_trains_inside_mln(self):
+        net = _net(MyDense(nOut=16))
+        ds = _toy()
+        net.fit(ds)
+        first = net.score()
+        for _ in range(60):
+            net.fit(ds)
+        assert net.score() < first * 0.5
+        ev = net.evaluate(
+            __import__("deeplearning4j_tpu.datasets.iterator",
+                       fromlist=["ListDataSetIterator"])
+            .ListDataSetIterator([ds], batch=96))
+        assert ev.accuracy() > 0.8
+
+    def test_matches_builtin_dense(self):
+        """Same math as DenseLayer(tanh) given identical params."""
+        net_sd = _net(MyDense(nOut=8))
+        net_bi = _net(DenseLayer(nOut=8, activation="tanh"))
+        net_bi.params_["0"]["W"] = net_sd.params_["0"]["W"]
+        net_bi.params_["0"]["b"] = net_sd.params_["0"]["b"]
+        net_bi.params_["1"] = net_sd.params_["1"]
+        x = np.random.RandomState(1).randn(4, 10).astype(np.float32)
+        np.testing.assert_allclose(net_sd.output(x).numpy(),
+                                   net_bi.output(x).numpy(), atol=1e-6)
+
+    def test_serializes(self, tmp_path):
+        from deeplearning4j_tpu.utils import ModelSerializer
+        net = _net(MyDense(nOut=12))
+        ds = _toy(32)
+        net.fit(ds)
+        p = str(tmp_path / "sdlayer.zip")
+        ModelSerializer.writeModel(net, p, saveUpdater=True)
+        restored = ModelSerializer.restoreMultiLayerNetwork(p)
+        x = np.random.RandomState(2).randn(5, 10).astype(np.float32)
+        np.testing.assert_allclose(restored.output(x).numpy(),
+                                   net.output(x).numpy(), atol=1e-6)
+        # training resumes (updater state round-tripped)
+        restored.fit(ds)
+        assert np.isfinite(restored.score())
+
+    def test_lambda_layer(self):
+        net = _net(TimesTwo())
+        x = np.random.RandomState(3).randn(4, 10).astype(np.float32)
+        out = net.output(x).numpy()
+        assert out.shape == (4, 3)
+        # gradient flows through the lambda: training still works
+        ds = _toy(32)
+        net.fit(ds)
+        first = net.score()
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score() < first
+
+    def test_inside_computation_graph(self):
+        from deeplearning4j_tpu.models.graph import ComputationGraph
+        gb = (NeuralNetConfiguration.builder().seed(9).updater(Sgd(5e-2))
+              .graphBuilder())
+        gb.addInputs("in").setInputTypes(InputType.feedForward(10))
+        gb.addLayer("sd", MyDense(nOut=8), "in")
+        gb.addLayer("out", OutputLayer.builder("mcxent").nOut(3)
+                    .activation("softmax").build(), "sd")
+        gb.setOutputs("out")
+        net = ComputationGraph(gb.build()).init()
+        ds = _toy(64)
+        net.fit(ds)
+        first = net.score()
+        for _ in range(60):
+            net.fit(ds)
+        assert net.score() < first
