@@ -23,6 +23,11 @@ from deeplearning4j_tpu.datavec.image import (  # noqa: F401
     ImageRecordReader, ImageTransform, NativeImageLoader,
     ParentPathLabelGenerator, PipelineImageTransform, RotateImageTransform,
     ScaleImageTransform)
+from deeplearning4j_tpu.datavec.audio import (  # noqa: F401
+    AudioFeatureRecordReader, WavFileRecordReader, mfcc, read_wav,
+    spectrogram)
+from deeplearning4j_tpu.datavec.columnar import (  # noqa: F401
+    ColumnarConverter, JDBCRecordReader)
 from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401
     AsyncDataSetIterator, RecordReaderDataSetIterator,
     SequenceRecordReaderDataSetIterator)

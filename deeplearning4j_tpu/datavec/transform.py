@@ -455,3 +455,26 @@ class LocalTransformExecutor:
     @staticmethod
     def execute(records: List[Record], tp: TransformProcess) -> List[Record]:
         return tp.execute([[writable(v) for v in r] for r in records])
+
+    @staticmethod
+    def executeParallel(records: List[Record], tp: TransformProcess,
+                        minChunk: int = 256) -> List[Record]:
+        """Partitioned TransformProcess execution over the native
+        work-stealing pool (reference: datavec-spark
+        ``SparkTransformExecutor`` mapPartitions — here the partitions run
+        on ``native/src/threads.cpp``'s parallel_for instead of a
+        cluster).  Every built-in step is row-wise, so chunked execution
+        is exactly sequential execution; chunk results are concatenated
+        in order (filters may shrink chunks independently)."""
+        from deeplearning4j_tpu import native
+        recs = [[writable(v) for v in r] for r in records]
+        results: dict = {}
+
+        def work(lo, hi):
+            results[int(lo)] = tp.execute(recs[lo:hi])
+
+        native.parallel_for(work, 0, len(recs), minChunk)
+        out: List[Record] = []
+        for lo in sorted(results):
+            out.extend(results[lo])
+        return out
