@@ -1,8 +1,8 @@
 """Arbiter — hyperparameter optimization (reference: arbiter/ — SURVEY.md
 §2.7: ParameterSpace, OptimizationConfiguration, grid/random search)."""
 from deeplearning4j_tpu.arbiter.optimize import (  # noqa: F401
-    CandidateGenerator, ContinuousParameterSpace, DiscreteParameterSpace,
-    GridSearchCandidateGenerator, IntegerParameterSpace,
-    LocalOptimizationRunner, MaxCandidatesCondition, MaxTimeCondition,
-    OptimizationConfiguration, OptimizationResult,
+    BayesianSearchGenerator, CandidateGenerator, ContinuousParameterSpace,
+    DiscreteParameterSpace, GridSearchCandidateGenerator,
+    IntegerParameterSpace, LocalOptimizationRunner, MaxCandidatesCondition,
+    MaxTimeCondition, OptimizationConfiguration, OptimizationResult,
     RandomSearchGenerator)

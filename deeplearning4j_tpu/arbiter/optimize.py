@@ -14,6 +14,7 @@ results carry (params, score, model) triples.
 from __future__ import annotations
 
 import itertools
+import math
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -84,6 +85,11 @@ class CandidateGenerator:
     def candidates(self):
         raise NotImplementedError
 
+    def report(self, params: Dict, score: float) -> None:
+        """Feedback hook the runner calls after scoring a candidate
+        (reference: BaseCandidateGenerator.reportResults).  Sequential
+        model-based generators (TPE) use it; random/grid ignore it."""
+
 
 class RandomSearchGenerator(CandidateGenerator):
     """Reference: RandomSearchGenerator — endless random draws."""
@@ -96,6 +102,126 @@ class RandomSearchGenerator(CandidateGenerator):
         while True:
             yield {k: s.randomValue(self.rng)
                    for k, s in self.spaces.items()}
+
+
+class BayesianSearchGenerator(CandidateGenerator):
+    """Sequential model-based search — TPE-lite.
+
+    Reference role: arbiter's Bayesian optimization option (SURVEY.md
+    §2.7).  Algorithm (Bergstra et al.'s Tree-structured Parzen Estimator,
+    simplified): after ``numInitialRandom`` random draws, observed
+    candidates are split at the ``gamma`` score quantile into good l(x)
+    and bad g(x) sets; each new candidate is the best of ``nCandidates``
+    samples drawn from a Parzen (KDE) model of the GOOD set, ranked by the
+    density ratio l(x)/g(x).  Continuous/integer dimensions use Gaussian
+    kernels (log-space when the space is log-scaled); discrete dimensions
+    use smoothed categorical counts.
+    """
+
+    def __init__(self, spaces: Dict[str, ParameterSpace], seed: int = 123,
+                 minimize: bool = True, numInitialRandom: int = 8,
+                 gamma: float = 0.25, nCandidates: int = 24,
+                 priorWeight: float = 0.2):
+        super().__init__(spaces)
+        self.rng = np.random.RandomState(seed)
+        self.minimize = minimize
+        self.n0 = int(numInitialRandom)
+        self.gamma = float(gamma)
+        self.nCand = int(nCandidates)
+        self.priorWeight = float(priorWeight)
+        self._hist: List[tuple] = []    # (params, score)
+
+    def report(self, params: Dict, score: float) -> None:
+        self._hist.append((params, float(score)))
+
+    # -- per-dimension Parzen helpers -----------------------------------
+    def _raw(self, space, v):
+        if isinstance(space, ContinuousParameterSpace) and space.log:
+            return math.log(v)
+        return float(v) if not isinstance(space, DiscreteParameterSpace) \
+            else v
+
+    def _fit_dim(self, space, vals):
+        """Fit one dimension's Parzen model ONCE per round (reused for
+        all nCandidates samples + density evaluations)."""
+        if isinstance(space, DiscreteParameterSpace):
+            counts = {v: 1.0 for v in space.values}        # +1 smoothing
+            for v in vals:
+                counts[v] = counts.get(v, 1.0) + 1.0
+            return ("cat", counts, sum(counts.values()))
+        xs = np.asarray([self._raw(space, v) for v in vals])
+        lo, hi = space.lo, space.hi
+        if isinstance(space, ContinuousParameterSpace) and space.log:
+            lo, hi = math.log(lo), math.log(hi)
+        # shrink the kernel as evidence accumulates so proposals refine
+        bw = max(xs.std() * len(xs) ** -0.25, (hi - lo) / 60.0, 1e-12)
+        return ("kde", xs, lo, hi, bw)
+
+    def _sample_dim(self, space, model):
+        # TPE's Parzen estimator mixes the uniform PRIOR into l(x) — that
+        # mixture is what keeps exploration alive after the model locks on
+        if self.rng.rand() < self.priorWeight:
+            return space.randomValue(self.rng)
+        if model[0] == "cat":
+            _, counts, _total = model
+            vals = list(counts)
+            p = np.asarray([counts[v] for v in vals])
+            return vals[self.rng.choice(len(vals), p=p / p.sum())]
+        _, xs, lo, hi, bw = model
+        x = xs[self.rng.randint(len(xs))] + bw * self.rng.randn()
+        x = float(np.clip(x, lo, hi))
+        if isinstance(space, ContinuousParameterSpace):
+            return float(math.exp(x)) if space.log else x
+        return int(round(x))
+
+    def _log_density(self, space, model, v):
+        """log of the PRIOR-MIXED Parzen density (1-w)*KDE + w*uniform.
+        The prior component is load-bearing: it keeps unexplored regions
+        at ratio≈0 while an over-exploited cluster accumulates bad-set
+        density and goes ratio<0 — that is TPE's escape mechanism."""
+        w = self.priorWeight
+        if model[0] == "cat":
+            _, counts, total = model
+            return math.log((1 - w) * counts.get(v, 1.0) / total
+                            + w / len(space.values))
+        _, xs, lo, hi, bw = model
+        x = self._raw(space, v)
+        z = (x - xs) / bw
+        kde = np.exp(-0.5 * z * z).sum() / (len(xs) * bw * 2.5066282746)
+        return math.log(max((1 - w) * kde + w / max(hi - lo, 1e-12),
+                            1e-300))
+
+    def candidates(self):
+        while True:
+            if len(self._hist) < self.n0:
+                yield {k: s.randomValue(self.rng)
+                       for k, s in self.spaces.items()}
+                continue
+            # hyperopt-style selectivity: the good set is only the TOP
+            # ~gamma*sqrt(n) observations — a large good set drags l(x)
+            # toward the history centroid and the search crawls
+            n = len(self._hist)
+            n_good = max(3, int(math.ceil(
+                4.0 * self.gamma * math.sqrt(n))))
+            order = sorted(self._hist, key=lambda t: t[1],
+                           reverse=not self.minimize)
+            good = [p for p, _ in order[:n_good]]
+            bad = [p for p, _ in order[n_good:]] or [p for p, _ in order]
+            gm = {k: self._fit_dim(sp, [g[k] for g in good])
+                  for k, sp in self.spaces.items()}
+            bm = {k: self._fit_dim(sp, [b[k] for b in bad])
+                  for k, sp in self.spaces.items()}
+            best, best_ratio = None, -math.inf
+            for _ in range(self.nCand):
+                cand = {k: self._sample_dim(sp, gm[k])
+                        for k, sp in self.spaces.items()}
+                ratio = sum(
+                    self._log_density(sp, gm[k], cand[k])
+                    - self._log_density(sp, bm[k], cand[k])
+                    for k, sp in self.spaces.items())
+                if ratio > best_ratio:
+                    best, best_ratio = cand, ratio
+            yield best
 
 
 class GridSearchCandidateGenerator(CandidateGenerator):
@@ -211,6 +337,10 @@ class LocalOptimizationRunner:
 
     def execute(self) -> OptimizationResult:
         cfg = self.config
+        # the config owns the optimization direction — sync it into
+        # model-based generators so the two can't silently disagree
+        if hasattr(cfg.generator, "minimize"):
+            cfg.generator.minimize = cfg.minimize
         for c in cfg.terminationConditions:
             c.start()
         best: Optional[OptimizationResult] = None
@@ -219,6 +349,7 @@ class LocalOptimizationRunner:
             score, model = out if isinstance(out, tuple) else (out, None)
             res = OptimizationResult(cand, float(score), model, i)
             self.results.append(res)
+            cfg.generator.report(cand, float(score))
             better = best is None or (
                 res.score < best.score if cfg.minimize
                 else res.score > best.score)

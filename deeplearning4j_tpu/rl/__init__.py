@@ -6,4 +6,5 @@ from deeplearning4j_tpu.rl.qlearning import (  # noqa: F401
     QLearningDiscreteDense)
 from deeplearning4j_tpu.rl.policy import Policy, softmax_sample  # noqa: F401
 from deeplearning4j_tpu.rl.a3c import (  # noqa: F401
-    A3CConfiguration, A3CDiscreteDense, ACPolicy, ActorCriticSeparate)
+    A3CConfiguration, A3CDiscreteDense, A3CDiscreteDenseAsync, ACPolicy,
+    ActorCriticSeparate)

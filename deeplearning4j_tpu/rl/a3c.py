@@ -209,3 +209,84 @@ class A3CDiscreteDense:
 
     def getPolicy(self, greedy: bool = True) -> ACPolicy:
         return ACPolicy(self.net, self.conf.seed, greedy=greedy)
+
+
+class A3CDiscreteDenseAsync(A3CDiscreteDense):
+    """True asynchronous A3C: one Python worker thread per environment,
+    Hogwild-style updates against the shared parameters.
+
+    Reference: rl4j ``A3CDiscrete`` / ``AsyncLearning`` — worker threads
+    roll out against a stale copy of the global network and apply their
+    n-step gradients asynchronously (SURVEY.md §2.7).
+
+    Measured round 3 (``tests/test_rl_async.py``): for this
+    env-in-the-loop workload async WINS wall-clock on both the CPU mesh
+    (183 vs 133 steps/s) and the tunneled chip (~29 vs ~21 steps/s) —
+    each policy query must round-trip host<->device before the env can
+    step, so latency dominates and worker threads pipeline it (the
+    economics that motivated the reference's thread model).  The batched
+    synchronous ``A3CDiscreteDense`` remains the default for its
+    deterministic, reproducible updates (fixed seeds -> fixed policy; no
+    Hogwild scheduling dependence) and because batched steps win wherever
+    compute, not dispatch latency, dominates (PROFILE_r03.md).
+    """
+
+    def train(self) -> None:
+        import threading
+        c = self.conf
+        lock = threading.Lock()     # serializes the shared-param update
+        self._updates = 0           # optimizer iteration (NOT env steps:
+        # Adam bias correction / LR schedules count updates, same as sync)
+
+        def worker(widx: int):
+            env = self.mdps[widx]
+            rng = np.random.RandomState(c.seed + 1000 * widx)
+            obs = env.reset()
+            ep_steps = 0
+            while True:
+                with lock:
+                    if self.stepCount >= c.maxStep:
+                        return
+                    params = self.net.params   # stale snapshot (Hogwild)
+                o_l, a_l, r_l = [], [], []
+                done = False
+                for _ in range(c.nstep):
+                    logits = np.asarray(ActorCriticSeparate.logits(
+                        params, jnp.asarray(obs[None], jnp.float32)))[0]
+                    a = softmax_sample(rng, logits)
+                    reply = env.step(a)
+                    o_l.append(obs)
+                    a_l.append(a)
+                    r_l.append(reply.getReward())
+                    obs = reply.getObservation()
+                    ep_steps += 1
+                    if reply.isDone() or ep_steps >= c.maxEpochStep:
+                        obs = env.reset()
+                        ep_steps = 0
+                        done = True
+                        break
+                R = 0.0 if done else float(np.asarray(
+                    ActorCriticSeparate.value(
+                        params, jnp.asarray(obs[None], jnp.float32)))[0])
+                rets = []
+                for rr in reversed(r_l):
+                    R = rr + c.gamma * R
+                    rets.append(R)
+                rets.reverse()
+                with lock:
+                    # async apply: gradients computed from the stale
+                    # snapshot, applied to the CURRENT shared params
+                    self.net.params, self._optState, _ = self._update(
+                        self.net.params, self._optState,
+                        jnp.asarray(np.stack(o_l), jnp.float32),
+                        jnp.asarray(a_l), jnp.asarray(rets, jnp.float32),
+                        self._updates)
+                    self._updates += 1
+                    self.stepCount += len(o_l)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(len(self.mdps))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
