@@ -1,3 +1,5 @@
 """Nearest neighbors (reference: deeplearning4j-nearestneighbors-parent —
 org/deeplearning4j/clustering/vptree/VPTree.java, kdtree/KDTree.java)."""
 from deeplearning4j_tpu.clustering.trees import KDTree, VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.server import (  # noqa: F401
+    NearestNeighborsClient, NearestNeighborsServer)

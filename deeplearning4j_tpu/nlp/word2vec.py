@@ -105,12 +105,53 @@ def _subsample(ids: List[List[int]], vocab: VocabCache, t: float, rng
             for sent in ids]
 
 
+def _build_huffman(vocab: "VocabCache"):
+    """Frequency-Huffman coding of the vocabulary (reference:
+    ``models/word2vec/Huffman.java``).  Returns padded arrays
+    ``(points (V, L) inner-node ids, codes (V, L) 0/1, mask (V, L))`` —
+    the per-word root-to-leaf paths hierarchical softmax walks."""
+    import heapq
+    V = vocab.numWords()
+    heap = [(vocab.wordFrequency(vocab.wordAtIndex(i)), i)
+            for i in range(V)]
+    heapq.heapify(heap)
+    parent: Dict[int, int] = {}
+    binary: Dict[int, int] = {}
+    nxt = V
+    while len(heap) > 1:
+        c1, n1 = heapq.heappop(heap)
+        c2, n2 = heapq.heappop(heap)
+        parent[n1], parent[n2] = nxt, nxt
+        binary[n1], binary[n2] = 0, 1
+        heapq.heappush(heap, (c1 + c2, nxt))
+        nxt += 1
+    root = heap[0][1]
+    paths, codes = [], []
+    for i in range(V):
+        p, c, n = [], [], i
+        while n != root:
+            c.append(binary[n])
+            n = parent[n]
+            p.append(n - V)          # inner-node row in syn1
+        paths.append(p[::-1])
+        codes.append(c[::-1])
+    L = max(1, max(len(p) for p in paths))
+    P = np.zeros((V, L), np.int32)
+    C = np.zeros((V, L), np.float32)
+    M = np.zeros((V, L), np.float32)
+    for i, (p, c) in enumerate(zip(paths, codes)):
+        P[i, :len(p)] = p
+        C[i, :len(c)] = c
+        M[i, :len(p)] = 1.0
+    return P, C, M
+
+
 class _EmbeddingTrainer:
-    """Shared SGNS machinery: one jitted step over index batches."""
+    """Shared SGNS/HS machinery: one jitted step over index batches."""
 
     def __init__(self, vocabSize: int, layerSize: int, seed: int,
                  learningRate: float, negative: int, extraRows: int = 0,
-                 mesh=None):
+                 mesh=None, hs: bool = False):
         self.vocabSize = vocabSize
         self.layerSize = layerSize
         self.negative = max(1, int(negative))
@@ -123,7 +164,9 @@ class _EmbeddingTrainer:
         self.syn0 = jax.random.uniform(
             k1, (rows, layerSize), jnp.float32,
             -0.5 / layerSize, 0.5 / layerSize)
-        self.syn1 = jnp.zeros((vocabSize, layerSize), jnp.float32)
+        # HS: one output row per Huffman INNER node (V-1); SGNS: per word
+        self.syn1 = jnp.zeros((max(1, vocabSize - 1) if hs else vocabSize,
+                               layerSize), jnp.float32)
         if mesh is not None:
             # Distributed SGNS (reference P5: VoidParameterServer v1 +
             # SkipGramTrainer pushing rows over Aeron UDP — SURVEY §2.6).
@@ -194,6 +237,33 @@ class _EmbeddingTrainer:
             return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
 
         return jax.jit(step, donate_argnums=(0, 1))
+
+    @functools.cached_property
+    def _step_hs(self):
+        def step(syn0, syn1, centers, points, codes, mask, lr):
+            """Hierarchical softmax (reference SkipGram HS path): walk the
+            context word's Huffman path, maximize sig(±center·node) per
+            branch.  One batched gather + einsum instead of the
+            reference's per-node JNI dot products."""
+            def loss_fn(s0, s1):
+                v = s0[centers]                     # (B, D)
+                nodes = s1[points]                  # (B, L, D)
+                dots = jnp.einsum("bd,bld->bl", v, nodes)
+                sgn = 1.0 - 2.0 * codes             # code 0 -> +1, 1 -> -1
+                return (jax.nn.softplus(-sgn * dots) * mask).sum()
+
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1)
+            return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_batch_hs(self, centers, points, codes, mask, lr=None):
+        self.syn0, self.syn1, loss = self._step_hs(
+            self.syn0, self.syn1, self._shard(centers),
+            self._shard(points), self._shard(codes), self._shard(mask),
+            jnp.asarray(lr if lr is not None else self.lr, jnp.float32))
+        return float(loss)
 
     def train_batch(self, centers, contexts, negatives, lr=None):
         self.syn0, self.syn1, loss = self._step(
@@ -306,7 +376,7 @@ class Word2Vec(WordVectors):
                  subsampling: float = 0.0,
                  tokenizerFactory: Optional[TokenizerFactory] = None,
                  elementsLearningAlgorithm: Optional[str] = None,
-                 workers: int = 1):
+                 workers: int = 1, useHierarchicSoftmax: bool = False):
         self.sentencesSrc = sentences
         self.minWordFrequency = minWordFrequency
         self.layerSize = layerSize
@@ -325,6 +395,9 @@ class Word2Vec(WordVectors):
         # Word2Vec.Builder#workers fed VoidParameterServer shards; here the
         # mesh's data axis takes that role — see _EmbeddingTrainer)
         self.workers = int(workers)
+        # reference default objective is HS; ours is SGNS — HS is opt-in
+        # (skip-gram only, like the reference's SkipGram HS learner)
+        self.useHierarchicSoftmax = bool(useHierarchicSoftmax)
         self._fitted = False
 
     class Builder:
@@ -381,8 +454,15 @@ class Word2Vec(WordVectors):
                               devices=jax.devices()[:self.workers])
         trainer = _EmbeddingTrainer(vocab.numWords(), self.layerSize,
                                     self.seed, self.learningRate,
-                                    self.negativeSample, mesh=mesh)
-        if self.useCBOW:
+                                    self.negativeSample, mesh=mesh,
+                                    hs=self.useHierarchicSoftmax)
+        if self.useHierarchicSoftmax:
+            if self.useCBOW:
+                raise ValueError("useHierarchicSoftmax currently pairs "
+                                 "with skip-gram (like the reference's "
+                                 "SkipGram HS learner); disable CBOW")
+            self._fit_skipgram_hs(ids, trainer, vocab, rng)
+        elif self.useCBOW:
             self._fit_cbow(ids, trainer, sampler, rng)
         else:
             self._fit_skipgram(ids, trainer, sampler, rng)
@@ -412,6 +492,25 @@ class Word2Vec(WordVectors):
                                         (len(batch), self.negativeSample))
                     trainer.train_batch(centers, contexts, negs,
                                         self._decayed_lr(step, total))
+                    step += 1
+
+    def _fit_skipgram_hs(self, ids, trainer, vocab, rng) -> None:
+        """Skip-gram with hierarchical softmax: (center, context) pairs;
+        the CONTEXT word's Huffman path is the prediction target."""
+        P, C, M = _build_huffman(vocab)
+        pairs = self._pairs(ids, rng)
+        total = max(1, self.epochs * self.iterations *
+                    ((len(pairs) + self.batchSize - 1) // self.batchSize))
+        step = 0
+        for _ in range(self.epochs):
+            for _ in range(self.iterations):
+                rng.shuffle(pairs)
+                for i in range(0, len(pairs), self.batchSize):
+                    batch = pairs[i:i + self.batchSize]
+                    centers = np.array([p[0] for p in batch], np.int32)
+                    ctx = np.array([p[1] for p in batch], np.int32)
+                    trainer.train_batch_hs(centers, P[ctx], C[ctx], M[ctx],
+                                           self._decayed_lr(step, total))
                     step += 1
 
     def _fit_cbow(self, ids, trainer, sampler, rng) -> None:
@@ -461,13 +560,30 @@ class Word2Vec(WordVectors):
 
 
 class ParagraphVectors(Word2Vec):
-    """PV-DBOW: doc vectors predict their words (reference:
-    models/paragraphvectors/ParagraphVectors.java, labels = doc ids)."""
+    """Doc embeddings (reference: models/paragraphvectors/
+    ParagraphVectors.java, labels = doc ids).  Two modes:
+
+    - ``sequenceLearningAlgorithm="PV-DBOW"`` (default, the reference's
+      ``DBOW``): the doc vector predicts each of its words (SGNS pairs).
+    - ``"PV-DM"`` (the reference's ``DM``, distributed-memory mean): the
+      MEAN of window context vectors + the doc vector predicts the center
+      word — reuses the CBOW step with the doc row as an always-valid
+      extra context slot.
+    """
 
     def __init__(self, documents: Optional[Sequence[str]] = None,
-                 labels: Optional[Sequence[str]] = None, **kw):
+                 labels: Optional[Sequence[str]] = None,
+                 sequenceLearningAlgorithm: str = "PV-DBOW", **kw):
         super().__init__(sentences=documents, **kw)
         self._labels = list(labels) if labels else None
+        alg = sequenceLearningAlgorithm.upper().replace("_", "-")
+        if alg in ("DBOW", "PV-DBOW"):
+            self.sequenceLearningAlgorithm = "PV-DBOW"
+        elif alg in ("DM", "PV-DM"):
+            self.sequenceLearningAlgorithm = "PV-DM"
+        else:
+            raise ValueError(
+                f"Unknown sequenceLearningAlgorithm {sequenceLearningAlgorithm!r}")
 
     @staticmethod
     def builder() -> "Word2Vec.Builder":
@@ -494,23 +610,52 @@ class ParagraphVectors(Word2Vec):
                                     self.learningRate, self.negativeSample,
                                     extraRows=len(docs))
         rng = np.random.RandomState(self.seed)
-        # PV-DBOW pairs: (doc_row, word)
-        pairs = [(nW + d, w) for d, sent in enumerate(ids) for w in sent]
-        for _ in range(max(1, self.epochs)):
-            for _ in range(max(1, self.iterations)):
-                rng.shuffle(pairs)
-                for i in range(0, len(pairs), self.batchSize):
-                    batch = pairs[i:i + self.batchSize]
-                    centers = np.array([p[0] for p in batch], np.int32)
-                    contexts = np.array([p[1] for p in batch], np.int32)
-                    negs = sampler.draw(rng,
-                                        (len(batch), self.negativeSample))
-                    trainer.train_batch(centers, contexts, negs)
+        if self.sequenceLearningAlgorithm == "PV-DM":
+            self._fit_pvdm(ids, nW, trainer, sampler, rng)
+        else:
+            # PV-DBOW pairs: (doc_row, word)
+            pairs = [(nW + d, w) for d, sent in enumerate(ids) for w in sent]
+            for _ in range(max(1, self.epochs)):
+                for _ in range(max(1, self.iterations)):
+                    rng.shuffle(pairs)
+                    for i in range(0, len(pairs), self.batchSize):
+                        batch = pairs[i:i + self.batchSize]
+                        centers = np.array([p[0] for p in batch], np.int32)
+                        contexts = np.array([p[1] for p in batch], np.int32)
+                        negs = sampler.draw(
+                            rng, (len(batch), self.negativeSample))
+                        trainer.train_batch(centers, contexts, negs)
         vecs = np.asarray(trainer.syn0)
         WordVectors.__init__(self, vocab, vecs[:nW])
         self._docvecs = {lbl: vecs[nW + i]
                          for i, lbl in enumerate(self._labels)}
         return self
+
+    def _fit_pvdm(self, ids, nW, trainer, sampler, rng) -> None:
+        """PV-DM: window context + doc vector (always-valid extra context
+        slot) averaged to predict the center word via the CBOW step."""
+        C = 2 * self.windowSize + 1          # + 1 slot for the doc row
+        examples = []
+        for d, sent in enumerate(ids):
+            for pos, c in enumerate(sent):
+                b = rng.randint(1, self.windowSize + 1)
+                ctx = [sent[pos + off] for off in range(-b, b + 1)
+                       if off != 0 and 0 <= pos + off < len(sent)]
+                examples.append((c, ctx + [nW + d]))
+        for _ in range(max(1, self.epochs)):
+            for _ in range(max(1, self.iterations)):
+                rng.shuffle(examples)
+                for i in range(0, len(examples), self.batchSize):
+                    batch = examples[i:i + self.batchSize]
+                    B = len(batch)
+                    centers = np.array([b_[0] for b_ in batch], np.int32)
+                    ctx = np.zeros((B, C), np.int32)
+                    mask = np.zeros((B, C), np.float32)
+                    for r, (_, cx) in enumerate(batch):
+                        ctx[r, :len(cx)] = cx
+                        mask[r, :len(cx)] = 1.0
+                    negs = sampler.draw(rng, (B, self.negativeSample))
+                    trainer.train_batch_cbow(ctx, mask, centers, negs)
 
     def getVector(self, label: str) -> Optional[np.ndarray]:
         return self._docvecs.get(label)

@@ -58,6 +58,73 @@ def test_word2vec_distributed_workers_quality_parity():
     assert cos > 0.99
 
 
+def test_word2vec_hierarchical_softmax():
+    """HS objective (reference's default SkipGram learner): same topical
+    similarity structure as SGNS on the two-cluster corpus."""
+    w2v = Word2Vec(sentences=_corpus(), layerSize=32, minWordFrequency=1,
+                   windowSize=3, seed=7, epochs=10, learningRate=0.05,
+                   useHierarchicSoftmax=True)
+    w2v.fit()
+    assert w2v.similarity("apple", "banana") > w2v.similarity("apple", "car")
+    assert w2v.similarity("car", "truck") > w2v.similarity("car", "banana")
+
+
+def test_huffman_codes_prefix_free_and_frequency_ordered():
+    from deeplearning4j_tpu.nlp.word2vec import (_build_huffman,
+                                                 _build_vocab)
+    sents = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+    vocab = _build_vocab(sents, 1)
+    P, C, M = _build_huffman(vocab)
+    lengths = {vocab.wordAtIndex(i): int(M[i].sum())
+               for i in range(vocab.numWords())}
+    # most frequent word gets the shortest code
+    assert lengths["a"] <= lengths["b"] <= lengths["c"]
+    codes = {w: tuple(C[vocab.indexOf(w)][:lengths[w]].astype(int))
+             for w in lengths}
+    # prefix-free: no code is a prefix of another
+    for w1, c1 in codes.items():
+        for w2, c2 in codes.items():
+            if w1 != w2:
+                assert c2[:len(c1)] != c1 or len(c1) >= len(c2)
+
+
+def test_paragraph_vectors_pvdm_mode():
+    docs = (["apple banana fruit sweet", "banana apple juice fruit"] * 6
+            + ["car truck engine road", "truck car wheel engine"] * 6)
+    pv = ParagraphVectors(documents=docs, layerSize=24, seed=5, epochs=40,
+                          learningRate=0.05, windowSize=2,
+                          sequenceLearningAlgorithm="PV-DM")
+    pv.fit()
+    v0 = pv.getVector("DOC_0")
+    v1 = pv.getVector("DOC_1")       # same topic
+    v2 = pv.getVector("DOC_12")      # other topic
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos(v0, v1) > cos(v0, v2)
+
+
+def test_nearest_neighbors_server():
+    from deeplearning4j_tpu.clustering import (NearestNeighborsClient,
+                                               NearestNeighborsServer)
+    rng = np.random.RandomState(0)
+    pts = rng.randn(200, 6)
+    srv = NearestNeighborsServer(pts, k=3).start()
+    try:
+        cli = NearestNeighborsClient(port=srv.port)
+        q = pts[17] + 1e-6
+        res = cli.knn(q, k=3)
+        assert res[0]["index"] == 17
+        assert res[0]["distance"] < 1e-4
+        # brute-force agreement for the full k
+        d = np.linalg.norm(pts - q, axis=1)
+        assert [r["index"] for r in res] == list(np.argsort(d)[:3])
+        batch = cli.knnNew(pts[[3, 9]] + 1e-6, k=2)
+        assert batch[0][0]["index"] == 3 and batch[1][0]["index"] == 9
+    finally:
+        srv.stop()
+
+
 def test_word2vec_cbow_mode_runs():
     w2v = Word2Vec(sentences=_corpus(), layerSize=16, epochs=2, seed=1,
                    useCBOW=True)
