@@ -434,9 +434,16 @@ class MultiLayerNetwork:
             ds.labelsMask.jax if ds.labelsMask is not None else None)
         self.lastBatchSize = int(x.shape[0])
 
+        algo = str(self.conf.globalConf.get("optimizationAlgo")
+                   or "STOCHASTIC_GRADIENT_DESCENT").upper()
         # TBPTT needs per-timestep (rank-3) labels; otherwise fall back to
         # standard BP (reference: doTruncatedBPTT label-rank requirement)
-        if (self.conf.backpropType == BackpropType.TruncatedBPTT
+        if algo != "STOCHASTIC_GRADIENT_DESCENT":
+            # legacy line-search solvers (LBFGS/CG/line GD): one
+            # line-searched iteration per fit call — reference Solver
+            # semantics (optimize/solvers.py)
+            self._runSolverStep(x, y, fmask, lmask, algo)
+        elif (self.conf.backpropType == BackpropType.TruncatedBPTT
                 and x.ndim == 3 and y.ndim == 3
                 and x.shape[2] > self.conf.tbpttFwdLength):
             self._fitTbptt(x, y, fmask, lmask)
@@ -445,6 +452,33 @@ class MultiLayerNetwork:
         self.iterationCount += 1
         for l in self._listeners:
             l.iterationDone(self, self.iterationCount, self.epochCount)
+
+    def _runSolverStep(self, x, y, fmask, lmask, algo: str) -> None:
+        from jax.flatten_util import ravel_pytree
+
+        from deeplearning4j_tpu.optimize.solvers import make_solver
+        flat, unravel = ravel_pytree(self.params_)
+        if getattr(self, "_solver", None) is None or \
+                self._solverAlgo != algo or \
+                self._solverSize != flat.size:
+            self._solver = make_solver(
+                algo, int(self.conf.globalConf.get(
+                    "maxNumLineSearchIterations") or 5))
+            self._solverAlgo, self._solverSize = algo, flat.size
+            key = jax.random.fold_in(self._fitKey, 0)
+            state = self.state_
+
+            def loss_flat(v, xb, yb, fm, lm):
+                loss, _aux = self._lossFn(unravel(v), state, xb, yb,
+                                          fm, lm, key, None)
+                return loss
+
+            self._solver.bind(loss_flat)
+        # masks enter as jit args too; None stays None (static)
+        new_flat, f_new = self._solver.step(flat, x, y, fmask, lmask)
+        self.params_ = unravel(new_flat)
+        self._score = float(f_new)
+        self._scoreArr = None
 
     def _runTrainStep(self, x, y, fmask, lmask, carries):
         self._fitKey, key = jax.random.split(self._fitKey)

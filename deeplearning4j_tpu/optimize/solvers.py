@@ -1,0 +1,188 @@
+"""Legacy line-search solvers: L-BFGS, conjugate gradient, line GD.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/optimize/solvers/
+{LBFGS,ConjugateGradient,LineGradientDescent}.java`` +
+``BackTrackLineSearch.java`` (SURVEY.md §2.5) — full-batch second-order
+training drivers selected via
+``NeuralNetConfiguration.builder().optimizationAlgo(...)``.
+
+TPU-first: the loss+grad of the WHOLE net is one jitted executable over
+the raveled parameter vector (``jax.flatten_util.ravel_pytree``); the
+solver itself (two-loop recursion, Polak-Ribière beta, Armijo
+backtracking) is tiny host-side vector algebra — one device call per
+probe, exactly the structure the reference has, minus the per-op JNI.
+
+Semantics match the reference: each ``fit`` call performs ONE
+line-searched solver iteration on that batch; L-BFGS curvature history
+persists on the solver across calls.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BackTrackLineSearch", "LBFGS", "ConjugateGradient",
+           "LineGradientDescent", "make_solver"]
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (reference: BackTrackLineSearch.java)."""
+
+    def __init__(self, maxIterations: int = 5, c1: float = 1e-4,
+                 backtrack: float = 0.5, initialStep: float = 1.0):
+        self.maxIterations = max(1, int(maxIterations))
+        self.c1 = c1
+        self.backtrack = backtrack
+        self.initialStep = initialStep
+
+    def search(self, loss_fn: Callable, x: jnp.ndarray, f0: float,
+               g: jnp.ndarray, d: jnp.ndarray):
+        """Returns (alpha, new_x, new_f); alpha=0 if no decrease found."""
+        slope = float(jnp.vdot(g, d))
+        if slope >= 0:          # not a descent direction
+            return 0.0, x, f0
+        alpha = self.initialStep
+        for _ in range(self.maxIterations):
+            x_new = x + alpha * d
+            f_new = float(loss_fn(x_new))
+            if np.isfinite(f_new) and f_new <= f0 + self.c1 * alpha * slope:
+                return alpha, x_new, f_new
+            alpha *= self.backtrack
+        return 0.0, x, f0
+
+
+class _FlatSolver:
+    """Shared machinery: jitted loss/grad over the raveled param vector."""
+
+    def __init__(self, maxLineSearchIterations: int = 5):
+        self.lineSearch = BackTrackLineSearch(maxLineSearchIterations)
+        self._loss = None
+        self._valgrad = None
+
+    def bind(self, loss_fn: Callable):
+        """loss_fn: (flat jnp vector, *batch) -> scalar loss (pure,
+        jittable).  Batch arrays are jit ARGUMENTS, not closure constants
+        — each step may carry a different minibatch."""
+        self._loss_raw = jax.jit(loss_fn)
+        self._valgrad_raw = jax.jit(jax.value_and_grad(loss_fn))
+        return self
+
+    def step(self, x: jnp.ndarray, *batch) -> tuple:
+        """One line-searched iteration; returns (new_x, new_loss)."""
+        self._loss = lambda v: self._loss_raw(v, *batch)
+        self._valgrad = lambda v: self._valgrad_raw(v, *batch)
+        return self._step(x)
+
+    def _step(self, x: jnp.ndarray) -> tuple:
+        raise NotImplementedError
+
+
+class LineGradientDescent(_FlatSolver):
+    """Steepest descent + line search (reference:
+    LineGradientDescent.java)."""
+
+    def _step(self, x):
+        f0, g = self._valgrad(x)
+        _, x_new, f_new = self.lineSearch.search(self._loss, x, float(f0),
+                                                 g, -g)
+        return x_new, float(f_new)
+
+
+class ConjugateGradient(_FlatSolver):
+    """Polak-Ribière nonlinear CG with automatic restart (reference:
+    ConjugateGradient.java)."""
+
+    def __init__(self, maxLineSearchIterations: int = 5):
+        super().__init__(maxLineSearchIterations)
+        self._g_prev: Optional[jnp.ndarray] = None
+        self._d_prev: Optional[jnp.ndarray] = None
+
+    def _step(self, x):
+        f0, g = self._valgrad(x)
+        if self._g_prev is None:
+            d = -g
+        else:
+            beta = float(jnp.vdot(g, g - self._g_prev)
+                         / jnp.maximum(jnp.vdot(self._g_prev,
+                                                self._g_prev), 1e-30))
+            beta = max(0.0, beta)           # PR+ restart
+            d = -g + beta * self._d_prev
+            if float(jnp.vdot(g, d)) >= 0:  # lost descent: restart
+                d = -g
+        alpha, x_new, f_new = self.lineSearch.search(
+            self._loss, x, float(f0), g, d)
+        if alpha == 0.0 and self._g_prev is not None:
+            # stuck on a conjugate direction: restart with steepest descent
+            alpha, x_new, f_new = self.lineSearch.search(
+                self._loss, x, float(f0), g, -g)
+            d = -g
+        self._g_prev, self._d_prev = g, d
+        return x_new, float(f_new)
+
+
+class LBFGS(_FlatSolver):
+    """Limited-memory BFGS two-loop recursion (reference: LBFGS.java,
+    default history m=4 like the reference's `m`)."""
+
+    def __init__(self, maxLineSearchIterations: int = 5, m: int = 10):
+        super().__init__(maxLineSearchIterations)
+        self.m = int(m)
+        self._hist: deque = deque(maxlen=self.m)    # (s, y, rho)
+        self._x_prev: Optional[jnp.ndarray] = None
+        self._g_prev: Optional[jnp.ndarray] = None
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y, rho in reversed(self._hist):
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append(a)
+            q = q - a * y
+        if self._hist:
+            s, y, _ = self._hist[-1]
+            gamma = float(jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y),
+                                                       1e-30))
+            q = gamma * q
+        for (s, y, rho), a in zip(self._hist, reversed(alphas)):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        return -q
+
+    def _step(self, x):
+        f0, g = self._valgrad(x)
+        if self._g_prev is not None:
+            s = x - self._x_prev
+            y = g - self._g_prev
+            sy = float(jnp.vdot(s, y))
+            if sy > 1e-10:          # curvature condition
+                self._hist.append((s, y, 1.0 / sy))
+        d = self._direction(g)
+        alpha, x_new, f_new = self.lineSearch.search(
+            self._loss, x, float(f0), g, d)
+        if alpha == 0.0:
+            # bad curvature model: drop history, steepest-descent step
+            self._hist.clear()
+            alpha, x_new, f_new = self.lineSearch.search(
+                self._loss, x, float(f0), g, -g)
+        self._x_prev, self._g_prev = x, g
+        return x_new, float(f_new)
+
+
+_SOLVERS = {
+    "LBFGS": LBFGS,
+    "CONJUGATE_GRADIENT": ConjugateGradient,
+    "LINE_GRADIENT_DESCENT": LineGradientDescent,
+}
+
+
+def make_solver(optimizationAlgo: str, maxLineSearchIterations: int = 5):
+    name = str(optimizationAlgo).upper()
+    if name not in _SOLVERS:
+        raise ValueError(
+            f"Unknown optimizationAlgo {optimizationAlgo!r}; known: "
+            f"{sorted(_SOLVERS)} or STOCHASTIC_GRADIENT_DESCENT")
+    return _SOLVERS[name](maxLineSearchIterations)

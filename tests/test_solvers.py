@@ -1,0 +1,95 @@
+"""Line-search solvers (reference: optimize/solvers LBFGS /
+ConjugateGradient / LineGradientDescent + BackTrackLineSearch;
+selected via NeuralNetConfiguration.optimizationAlgo)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+
+def _reg_net(algo):
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(1e-2))
+            .optimizationAlgo(algo).list()
+            .layer(OutputLayer.builder("mse").nOut(3)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _mlp_net(algo):
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(1e-2))
+            .optimizationAlgo(algo)
+            .maxNumLineSearchIterations(8).list()
+            .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
+            .layer(OutputLayer.builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _linear_data(n=128):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = rng.randn(8, 3)
+    y = (x @ w).astype(np.float32)
+    return DataSet(x, y)
+
+
+def _cls_data(n=128):
+    rng = np.random.RandomState(1)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = rng.randn(8, 3)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, 1)]
+    return DataSet(x, y)
+
+
+class TestSolvers:
+    def test_lbfgs_solves_linear_regression_nearly_exactly(self):
+        """On a quadratic objective L-BFGS converges orders of magnitude
+        past what the same number of SGD steps reaches."""
+        ds = _linear_data()
+        net = _reg_net("LBFGS")
+        for _ in range(40):
+            net.fit(ds)
+        assert net.score() < 1e-4, net.score()
+
+        sgd = _reg_net("STOCHASTIC_GRADIENT_DESCENT")
+        for _ in range(40):
+            sgd.fit(ds)
+        assert net.score() < sgd.score() * 1e-2
+
+    @pytest.mark.parametrize("algo", ["CONJUGATE_GRADIENT",
+                                      "LINE_GRADIENT_DESCENT"])
+    def test_cg_and_linegd_descend(self, algo):
+        ds = _cls_data()
+        net = _mlp_net(algo)
+        net.fit(ds)
+        first = net.score()
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score() < first * 0.5
+        # line-searched steps never increase the full-batch loss
+        prev = net.score()
+        for _ in range(5):
+            net.fit(ds)
+            assert net.score() <= prev + 1e-9
+            prev = net.score()
+
+    def test_lbfgs_trains_mlp_classifier(self):
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        ds = _cls_data()
+        net = _mlp_net("LBFGS")
+        for _ in range(60):
+            net.fit(ds)
+        ev = net.evaluate(ListDataSetIterator([ds], batch=128))
+        assert ev.accuracy() > 0.9
+
+    def test_unknown_algo_raises(self):
+        ds = _cls_data(16)
+        net = _mlp_net("NEWTON_RAPHSON")
+        with pytest.raises(ValueError, match="optimizationAlgo"):
+            net.fit(ds)
