@@ -757,14 +757,19 @@ def _upsampling3d(scaleD=2, scaleH=2, scaleW=2, **_):
 
 
 @register_op("localResponseNormalization")
-def _lrn(depth=5, bias=1.0, alpha=1e-4, beta=0.75, **_):
-    def f(x):   # NCHW, across channels like the reference
+def _lrn(depth=5, bias=1.0, alpha=1e-4, beta=0.75, dataFormat="NCHW", **_):
+    # across-channel LRN; TF graphs are NHWC (channel last), DL4J NCHW
+    ch_axis = 1 if str(dataFormat).upper() == "NCHW" else -1
+
+    def f(x):
         half = int(depth) // 2
-        sq = x * x
-        pads = [(0, 0), (half, half), (0, 0), (0, 0)]
+        sq = jnp.moveaxis(x * x, ch_axis, 1)
+        c = sq.shape[1]
+        pads = [(0, 0), (half, half)] + [(0, 0)] * (sq.ndim - 2)
         padded = jnp.pad(sq, pads)
-        acc = sum(padded[:, i:i + x.shape[1]] for i in range(int(depth)))
-        return x / jnp.power(bias + alpha * acc, beta)
+        acc = sum(padded[:, i:i + c] for i in range(int(depth)))
+        return x / jnp.power(bias + alpha * jnp.moveaxis(acc, 1, ch_axis),
+                             beta)
     return f
 
 

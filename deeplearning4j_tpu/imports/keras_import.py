@@ -73,8 +73,30 @@ class _WeightStore:
         return out
 
 
+#: class_name -> factory(cfg) -> our Layer (or (layer, kind, out_channels))
+_CUSTOM_LAYERS: Dict[str, Any] = {}
+#: keras layer NAME -> our Layer (Lambda layers carry no portable code)
+_LAMBDA_LAYERS: Dict[str, Any] = {}
+
+
 class KerasModelImport:
     """Reference facade: KerasModelImport.importKerasSequentialModelAndWeights."""
+
+    @staticmethod
+    def registerCustomLayer(className: str, factory) -> None:
+        """Reference: ``KerasLayer.registerCustomLayer`` — map a custom
+        Keras layer class to a framework layer.  ``factory(cfg_dict)``
+        returns a Layer (treated as weight-less) or a full
+        ``(layer, kind, out_channels)`` mapping tuple."""
+        _CUSTOM_LAYERS[className] = factory
+
+    @staticmethod
+    def registerLambdaLayer(layerName: str, layer) -> None:
+        """Reference: ``KerasLayer.registerLambdaLayer`` — Keras Lambda
+        layers serialize no portable code, so the import substitutes a
+        pre-registered framework layer (e.g. a SameDiffLambdaLayer) by
+        the LAYER NAME."""
+        _LAMBDA_LAYERS[layerName] = layer
 
     @staticmethod
     def importKerasSequentialModelAndWeights(path: str,
@@ -214,6 +236,17 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
                                                    GlobalPoolingLayer,
                                                    OutputLayer,
                                                    SubsamplingLayer)
+    if cls in _CUSTOM_LAYERS:
+        out = _CUSTOM_LAYERS[cls](cfg)
+        return out if isinstance(out, tuple) else (out, "custom", None)
+    if cls == "Lambda":
+        name = cfg.get("name")
+        if name in _LAMBDA_LAYERS:
+            return _LAMBDA_LAYERS[name], "lambda", None
+        raise ValueError(
+            f"Keras import: Lambda layer {name!r} carries no portable "
+            "code; register a framework substitute first with "
+            "KerasModelImport.registerLambdaLayer(name, layer)")
     if cls == "Dropout":
         rate = float(cfg.get("rate", 0.5))
         return DropoutLayer(dropOut=1.0 - rate), "dropout", None

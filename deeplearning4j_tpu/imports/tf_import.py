@@ -536,3 +536,6 @@ def _as_graphdef(graph):
             gd.ParseFromString(f.read())
         return gd
     raise TypeError(f"Cannot import {type(graph)}")
+
+
+from deeplearning4j_tpu.imports import tf_import_ext  # noqa: E402,F401  isort:skip

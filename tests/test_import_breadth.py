@@ -1,0 +1,167 @@
+"""Round-3 importer breadth: new TF op mappings + Keras custom/Lambda
+registry (reference: samediff-import-tensorflow rule tables;
+KerasLayer.registerCustomLayer / registerLambdaLayer)."""
+import dataclasses
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tests.test_imports import freeze, import_and_compare  # noqa: E402
+
+
+class TestTFOpBreadth:
+    def _cmp(self, fn, specs, inputs, out_name, atol=1e-4):
+        frozen, gd = freeze(fn, *specs)
+        tf_out = frozen(*[tf.constant(v) for v in inputs])
+        tf_out = (tf_out[0] if isinstance(tf_out, (list, tuple))
+                  else tf_out).numpy()
+        phs = [n.name for n in gd.node if n.op == "Placeholder"]
+        import_and_compare(gd, dict(zip(phs, inputs)), tf_out, out_name,
+                           atol=atol)
+
+    def test_roll_reverse_mirrorpad(self):
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        self._cmp(lambda v: tf.identity(tf.roll(v, [2], [1]), name="o"),
+                  [tf.TensorSpec([3, 5], tf.float32)], [x], "o")
+        self._cmp(lambda v: tf.identity(tf.reverse(v, [1]), name="o"),
+                  [tf.TensorSpec([3, 5], tf.float32)], [x], "o")
+        self._cmp(lambda v: tf.identity(
+            tf.pad(v, [[1, 1], [2, 2]], mode="REFLECT"), name="o"),
+            [tf.TensorSpec([3, 5], tf.float32)], [x], "o")
+
+    def test_linalg_family(self):
+        rng = np.random.RandomState(1)
+        m = rng.randn(4, 4)
+        a = (m @ m.T + 4 * np.eye(4)).astype(np.float32)  # SPD
+        self._cmp(lambda v: tf.identity(
+            tf.linalg.det(v), name="o"),
+            [tf.TensorSpec([4, 4], tf.float32)], [a], "o", atol=1e-2)
+        self._cmp(lambda v: tf.identity(tf.linalg.inv(v), name="o"),
+                  [tf.TensorSpec([4, 4], tf.float32)], [a], "o", atol=1e-3)
+        self._cmp(lambda v: tf.identity(tf.linalg.cholesky(v), name="o"),
+                  [tf.TensorSpec([4, 4], tf.float32)], [a], "o", atol=1e-3)
+        self._cmp(lambda v: tf.identity(
+            tf.linalg.band_part(v, 1, 1), name="o"),
+            [tf.TensorSpec([4, 4], tf.float32)], [a], "o")
+
+    def test_bitwise_and_special(self):
+        xi = np.random.RandomState(2).randint(0, 1000, (3, 4)).astype(
+            np.int32)
+        yi = np.random.RandomState(3).randint(1, 1000, (3, 4)).astype(
+            np.int32)
+        self._cmp(lambda a, b: tf.identity(
+            tf.bitwise.bitwise_xor(a, b), name="o"),
+            [tf.TensorSpec([3, 4], tf.int32)] * 2, [xi, yi], "o")
+        self._cmp(lambda a, b: tf.identity(
+            tf.bitwise.left_shift(a, b % 8), name="o"),
+            [tf.TensorSpec([3, 4], tf.int32)] * 2, [xi, yi], "o")
+        xf = np.abs(np.random.RandomState(4).randn(3, 4)).astype(
+            np.float32) + 0.5
+        yf = np.abs(np.random.RandomState(5).randn(3, 4)).astype(
+            np.float32) + 0.5
+        self._cmp(lambda a, b: tf.identity(tf.math.igamma(a, b), name="o"),
+                  [tf.TensorSpec([3, 4], tf.float32)] * 2, [xf, yf], "o",
+                  atol=1e-3)
+        self._cmp(lambda a: tf.identity(tf.math.asinh(a), name="o"),
+                  [tf.TensorSpec([3, 4], tf.float32)], [xf], "o")
+
+    def test_topk_unique_segment(self):
+        x = np.random.RandomState(6).randn(4, 7).astype(np.float32)
+        self._cmp(lambda v: tf.identity(
+            tf.math.top_k(v, k=3).values, name="o"),
+            [tf.TensorSpec([4, 7], tf.float32)], [x], "o")
+        data = np.random.RandomState(7).randn(6, 3).astype(np.float32)
+        self._cmp(lambda v: tf.identity(tf.math.unsorted_segment_sum(
+            v, tf.constant([0, 1, 0, 2, 1, 0]), 3), name="o"),
+            [tf.TensorSpec([6, 3], tf.float32)], [data], "o")
+
+    def test_resize_and_lrn(self):
+        img = np.random.RandomState(8).rand(1, 6, 6, 2).astype(np.float32)
+        self._cmp(lambda v: tf.identity(tf.compat.v1.image.resize_bilinear(
+            v, [12, 12], align_corners=True), name="o"),
+            [tf.TensorSpec([1, 6, 6, 2], tf.float32)], [img], "o",
+            atol=1e-3)
+        xl = np.abs(np.random.RandomState(9).randn(2, 4, 4, 8)).astype(
+            np.float32)
+        self._cmp(lambda v: tf.identity(tf.nn.local_response_normalization(
+            v, depth_radius=2, bias=1.0, alpha=1e-3, beta=0.75), name="o"),
+            [tf.TensorSpec([2, 4, 4, 8], tf.float32)], [xl], "o",
+            atol=1e-4)
+
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(10).randn(8).astype(np.float32)
+        self._cmp(lambda v: tf.identity(tf.signal.irfft(
+            tf.signal.rfft(v)), name="o"),
+            [tf.TensorSpec([8], tf.float32)], [x], "o", atol=1e-3)
+
+
+class TestKerasCustomRegistry:
+    def test_lambda_layer_roundtrip(self):
+        from deeplearning4j_tpu.imports import KerasModelImport
+        from deeplearning4j_tpu.nn.conf import SameDiffLambdaLayer
+
+        @dataclasses.dataclass
+        class Doubler(SameDiffLambdaLayer):
+            def defineLayer(self, sd, layerInput):
+                return layerInput * 2.0
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.Dense(5, activation="relu"),
+            tf.keras.layers.Lambda(lambda t: t * 2.0, name="double_it"),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            # without registration: clear error naming the layer
+            with pytest.raises(ValueError, match="double_it"):
+                KerasModelImport.importKerasSequentialModelAndWeights(p)
+            KerasModelImport.registerLambdaLayer("double_it", Doubler())
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x).numpy(), keras_out,
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_custom_layer_class(self):
+        from deeplearning4j_tpu.imports import KerasModelImport
+        from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+
+        class Clipper(tf.keras.layers.Layer):
+            def call(self, t):
+                return tf.clip_by_value(t, -0.5, 0.5)
+
+            def get_config(self):
+                return super().get_config()
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.Dense(5, activation="tanh"),
+            Clipper(),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+        KerasModelImport.registerCustomLayer(
+            "Clipper", lambda cfg: ActivationLayer(
+                activation="hardtanh_half"))
+        # map the clip via a SameDiffLambdaLayer instead (exact semantics)
+        from deeplearning4j_tpu.nn.conf import SameDiffLambdaLayer
+        import dataclasses as _dc
+
+        @_dc.dataclass
+        class ClipLayer(SameDiffLambdaLayer):
+            def defineLayer(self, sd, layerInput):
+                return sd._op("clipByValue", [layerInput],
+                              {"clipValueMin": -0.5, "clipValueMax": 0.5})
+        KerasModelImport.registerCustomLayer(
+            "Clipper", lambda cfg: ClipLayer())
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            model.save(p)
+            net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        keras_out = model.predict(x, verbose=0)
+        np.testing.assert_allclose(net.output(x).numpy(), keras_out,
+                                   atol=1e-4, rtol=1e-3)
