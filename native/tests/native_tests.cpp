@@ -1,0 +1,173 @@
+/* Native test binary (reference: libnd4j/tests_cpu/layers_tests — gtest
+ * suites run by run_tests.sh; here a dependency-free assert runner wired
+ * into CTest, buildable with -DDL4J_SANITIZE=ON for the ASAN/UBSAN pass
+ * the reference's SD_SANITIZE option provides).
+ */
+#include "dl4j_native.h"
+
+#include <atomic>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+static int failures = 0;
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++failures;                                                          \
+    }                                                                      \
+  } while (0)
+
+static void test_abi() { CHECK(dl4j_abi_version() == DL4J_NATIVE_ABI_VERSION); }
+
+static void test_threads() {
+  CHECK(dl4j_num_threads() >= 1);
+  // parallel_for must cover [start, stop) exactly once
+  constexpr int64_t N = 100000;
+  std::vector<std::atomic<int>> hits(N);
+  for (auto &h : hits) h.store(0);
+  struct Ctx { std::atomic<int> *hits; } ctx{hits.data()};
+  dl4j_parallel_for(
+      [](int64_t lo, int64_t hi, void *arg) {
+        auto *c = static_cast<Ctx *>(arg);
+        for (int64_t i = lo; i < hi; ++i) c->hits[i].fetch_add(1);
+      },
+      &ctx, 0, N, 128);
+  int64_t bad = 0;
+  for (auto &h : hits) bad += (h.load() != 1);
+  CHECK(bad == 0);
+
+  // nested parallel_for must not deadlock (round-2 fix regression guard)
+  std::atomic<int64_t> total{0};
+  struct Ctx2 { std::atomic<int64_t> *total; } ctx2{&total};
+  dl4j_parallel_for(
+      [](int64_t lo, int64_t hi, void *arg) {
+        auto *c = static_cast<Ctx2 *>(arg);
+        for (int64_t i = lo; i < hi; ++i) {
+          dl4j_parallel_for(
+              [](int64_t l2, int64_t h2, void *a2) {
+                static_cast<Ctx2 *>(a2)->total->fetch_add(h2 - l2);
+              },
+              c, 0, 64, 16);
+        }
+      },
+      &ctx2, 0, 8, 1);
+  CHECK(total.load() == 8 * 64);
+}
+
+static void test_compression() {
+  constexpr int64_t N = 257;  // odd size exercises the bitmap tail word
+  std::vector<float> grad(N), orig(N), target(N, 0.0f);
+  for (int64_t i = 0; i < N; ++i)
+    grad[i] = orig[i] = 0.01f * static_cast<float>((i % 21) - 10);
+  const float thr = 0.05f;
+
+  const int64_t expect = dl4j_threshold_count(grad.data(), N, thr);
+  std::vector<int32_t> idx(static_cast<size_t>(expect) + 8, 0);
+  const int64_t wrote =
+      dl4j_threshold_encode(grad.data(), N, thr, idx.data(), expect + 8);
+  CHECK(wrote == expect);
+  dl4j_threshold_decode(idx.data(), wrote, thr, target.data(), N);
+  // residual semantics: decoded + residual == original, elementwise
+  for (int64_t i = 0; i < N; ++i)
+    CHECK(std::fabs(target[i] + grad[i] - orig[i]) < 1e-6f);
+
+  // bitmap round-trip with the same contract
+  for (int64_t i = 0; i < N; ++i) grad[i] = orig[i];
+  std::vector<uint32_t> bitmap((N + 15) / 16, 0u);
+  std::vector<float> target2(N, 0.0f);
+  const int64_t enc = dl4j_bitmap_encode(grad.data(), N, thr, bitmap.data());
+  CHECK(enc == expect);
+  dl4j_bitmap_decode(bitmap.data(), N, thr, target2.data());
+  for (int64_t i = 0; i < N; ++i)
+    CHECK(std::fabs(target2[i] + grad[i] - orig[i]) < 1e-6f);
+}
+
+static void test_random() {
+  constexpr int64_t N = 4096;
+  std::vector<float> a(N), b(N), c(N);
+  dl4j_philox_uniform(42, 0, a.data(), N);
+  dl4j_philox_uniform(42, 0, b.data(), N);
+  CHECK(std::memcmp(a.data(), b.data(), N * sizeof(float)) == 0);
+  dl4j_philox_uniform(43, 0, c.data(), N);
+  CHECK(std::memcmp(a.data(), c.data(), N * sizeof(float)) != 0);
+  double mean = 0.0;
+  for (float v : a) {
+    CHECK(v >= 0.0f && v < 1.0f);
+    mean += v;
+  }
+  mean /= N;
+  CHECK(std::fabs(mean - 0.5) < 0.03);
+
+  // counter addressing: offset counts Philox 4-lane BLOCKS, so resuming
+  // at element 32 means offset 32/4 = 8 — and then the values are
+  // identical to the corresponding slice of one full-range call
+  std::vector<float> whole(64), part(32);
+  dl4j_philox_uniform(7, 0, whole.data(), 64);
+  dl4j_philox_uniform(7, 8, part.data(), 32);
+  for (int i = 0; i < 32; ++i) CHECK(part[i] == whole[32 + i]);
+
+  std::vector<float> g(20000);
+  dl4j_philox_gaussian(11, 0, g.data(), static_cast<int64_t>(g.size()));
+  double gm = 0.0, gv = 0.0;
+  for (float v : g) gm += v;
+  gm /= static_cast<double>(g.size());
+  for (float v : g) gv += (v - gm) * (v - gm);
+  gv /= static_cast<double>(g.size());
+  CHECK(std::fabs(gm) < 0.05);
+  CHECK(std::fabs(gv - 1.0) < 0.05);
+}
+
+static void test_workspace() {
+  dl4j_workspace *ws = dl4j_workspace_create(1024);
+  void *p1 = dl4j_workspace_alloc(ws, 100);
+  void *p2 = dl4j_workspace_alloc(ws, 100);
+  CHECK(p1 != nullptr && p2 != nullptr && p1 != p2);
+  CHECK((reinterpret_cast<uintptr_t>(p1) & 63u) == 0);  // 64-byte aligned
+  CHECK(dl4j_workspace_used(ws) >= 200);
+  void *spill = dl4j_workspace_alloc(ws, 4096);  // beyond capacity: spills
+  CHECK(spill != nullptr);
+  CHECK(dl4j_workspace_spilled(ws) >= 4096);
+  dl4j_workspace_reset(ws);  // LEARNING policy: grows to fit last cycle
+  CHECK(dl4j_workspace_used(ws) == 0);
+  CHECK(dl4j_workspace_capacity(ws) >= 4096);
+  void *p3 = dl4j_workspace_alloc(ws, 4096);  // now fits in the arena
+  CHECK(p3 != nullptr);
+  CHECK(dl4j_workspace_spilled(ws) == 0);
+  dl4j_workspace_destroy(ws);
+}
+
+static void test_csv() {
+  const char *buf = "# header\n1.0,2.0,3.5\n4,5,-6e1\n\n7.25,8,9\n";
+  const int64_t len = static_cast<int64_t>(std::strlen(buf));
+  CHECK(dl4j_csv_count_rows(buf, len) == 4);
+  float out[16];
+  int32_t cols = 0;
+  const int64_t rows =
+      dl4j_csv_parse_f32(buf, len, ',', 1, out, 16, &cols);
+  CHECK(rows == 3 && cols == 3);
+  CHECK(out[0] == 1.0f && out[2] == 3.5f && out[5] == -60.0f &&
+        out[6] == 7.25f);
+  // ragged rows are a hard error, not a silent truncation
+  const char *bad = "1,2,3\n4,5\n";
+  CHECK(dl4j_csv_parse_f32(bad, static_cast<int64_t>(std::strlen(bad)), ',',
+                           0, out, 16, &cols) == -1);
+}
+
+int main() {
+  test_abi();
+  test_threads();
+  test_compression();
+  test_random();
+  test_workspace();
+  test_csv();
+  if (failures == 0) {
+    std::printf("ALL NATIVE TESTS PASSED\n");
+    return 0;
+  }
+  std::fprintf(stderr, "%d native test failure(s)\n", failures);
+  return 1;
+}

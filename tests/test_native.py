@@ -1,6 +1,7 @@
 """C++ native runtime tests (reference test analogue: libnd4j
 tests_cpu/layers_tests — NDArrayTest/RNGTests plus the threshold-encoding
 coverage in DeclarableOpsTests)."""
+import os
 import numpy as np
 import pytest
 
@@ -162,3 +163,23 @@ def test_parallel_for_during_resize_safe():
     for t in ts:
         t.join()
     assert not errs
+
+
+def test_native_cpp_test_binary_under_sanitizers(tmp_path):
+    """Build + run the C++ test binary with ASAN/UBSAN (reference:
+    libnd4j tests_cpu via CTest with the SD_SANITIZE option)."""
+    import shutil
+    import subprocess
+    if not (shutil.which("cmake") and shutil.which("ninja")):
+        pytest.skip("cmake/ninja unavailable")
+    src = os.path.join(os.path.dirname(__file__), "..", "native")
+    build = str(tmp_path / "build")
+    subprocess.run(["cmake", "-S", src, "-B", build, "-G", "Ninja",
+                    "-DDL4J_SANITIZE=ON"], check=True,
+                   capture_output=True)
+    subprocess.run(["cmake", "--build", build], check=True,
+                   capture_output=True)
+    r = subprocess.run([os.path.join(build, "dl4j_native_tests")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL NATIVE TESTS PASSED" in r.stdout
