@@ -17,7 +17,7 @@ from deeplearning4j_tpu.datavec.schema import (  # noqa: F401
 from deeplearning4j_tpu.datavec.transform import (  # noqa: F401
     CategoricalColumnCondition, ColumnCondition, ConditionFilter, ConditionOp,
     DoubleColumnCondition, IntegerColumnCondition, LocalTransformExecutor,
-    StringColumnCondition, TransformProcess)
+    SparkTransformExecutor, StringColumnCondition, TransformProcess)
 from deeplearning4j_tpu.datavec.image import (  # noqa: F401
     ColorConversionTransform, CropImageTransform, FlipImageTransform,
     ImageRecordReader, ImageTransform, NativeImageLoader,
@@ -26,6 +26,7 @@ from deeplearning4j_tpu.datavec.image import (  # noqa: F401
 from deeplearning4j_tpu.datavec.audio import (  # noqa: F401
     AudioFeatureRecordReader, WavFileRecordReader, mfcc, read_wav,
     spectrogram)
+from deeplearning4j_tpu.datavec.codec import CodecRecordReader  # noqa: F401
 from deeplearning4j_tpu.datavec.columnar import (  # noqa: F401
     ColumnarConverter, JDBCRecordReader)
 from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401

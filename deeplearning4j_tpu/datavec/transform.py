@@ -478,3 +478,20 @@ class LocalTransformExecutor:
         for lo in sorted(results):
             out.extend(results[lo])
         return out
+
+
+class SparkTransformExecutor:
+    """Reference: datavec-spark ``SparkTransformExecutor.execute(rdd, tp)``
+    — distributed TransformProcess execution.  The TPU-native stand-in
+    partitions over the native thread pool on one host (the cluster role
+    Spark played is taken by the data-parallel mesh for TRAINING; ETL
+    stays host-side — SURVEY.md §7.1).  API parity keeps migration
+    one-line."""
+
+    @staticmethod
+    def execute(records: List[Record], tp: TransformProcess,
+                numPartitions: int = 0) -> List[Record]:
+        chunk = max(1, len(records) // numPartitions) if numPartitions \
+            else 256
+        return LocalTransformExecutor.executeParallel(records, tp,
+                                                      minChunk=chunk)

@@ -1,4 +1,6 @@
 """Utilities: model serialization, misc helpers."""
 from deeplearning4j_tpu.utils.model_serializer import ModelSerializer  # noqa: F401
+from deeplearning4j_tpu.utils.resources import (  # noqa: F401
+    DL4JResources, Downloader, Resources)
 from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer  # noqa: F401,E501
 from deeplearning4j_tpu.utils.trees import snapshot_tree  # noqa: F401

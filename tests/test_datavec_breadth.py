@@ -4,6 +4,7 @@ parallel transform executor.
 Reference analogues: datavec-data-audio WavFileRecordReader tests,
 datavec-jdbc JDBCRecordReaderTest, datavec-arrow ArrowConverterTest,
 datavec-spark transform tests (SURVEY.md §2.4)."""
+import os
 import sqlite3
 import wave
 
@@ -145,3 +146,83 @@ class TestParallelTransform:
         assert len(seq) == len(par) == 491  # filter REMOVES x+10 > 500
         for a, b in zip(seq, par):
             assert [str(w) for w in a] == [str(w) for w in b]
+
+
+class TestCodecAndResources:
+    def test_codec_reads_gif_and_npy(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.datavec import CodecRecordReader, FileSplit
+        rng = np.random.RandomState(0)
+        frames = [Image.fromarray(
+            (rng.rand(8, 10, 3) * 255).astype(np.uint8)) for _ in range(5)]
+        frames[0].save(str(tmp_path / "clip.gif"), save_all=True,
+                       append_images=frames[1:], duration=40, loop=0)
+        np.save(str(tmp_path / "vol.npy"),
+                rng.rand(6, 4, 4).astype(np.float32))
+        rr = CodecRecordReader(startFrame=1, numFrames=3)
+        rr.initialize(FileSplit(str(tmp_path)))
+        seqs = []
+        while rr.hasNext():
+            seqs.append(rr.nextSequence())
+        shapes = sorted(s[0][0].value.shape for s in seqs)
+        # gif: 3 frames of (8, 10, 3); npy: 3 frames of (4, 4, 1)
+        assert shapes == [(4, 4, 1), (8, 10, 3)]
+        assert all(len(s) == 3 for s in seqs)
+
+    def test_codec_ravel_and_resize(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.datavec import CodecRecordReader, FileSplit
+        img = Image.fromarray(np.zeros((8, 8, 3), np.uint8))
+        img2 = Image.fromarray(np.full((8, 8, 3), 200, np.uint8))
+        img.save(str(tmp_path / "c.gif"), save_all=True,
+                 append_images=[img2], duration=40)
+        rr = CodecRecordReader(ravel=True, outputHW=(4, 4))
+        rr.initialize(FileSplit(str(tmp_path)))
+        seq = rr.nextSequence()
+        assert len(seq) == 2 and len(seq[0]) == 4 * 4 * 3
+
+    def test_resources_and_downloader(self, tmp_path, monkeypatch):
+        import hashlib
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        from deeplearning4j_tpu.utils import (DL4JResources, Downloader,
+                                              Resources)
+        d = DL4JResources.getDirectory("datasets", "mnist")
+        assert os.path.isdir(d) and str(tmp_path) in d
+        (tmp_path / "fixture.txt").write_text("hello")
+        Resources.registerDirectory(str(tmp_path))
+        assert Resources.asFile("fixture.txt").endswith("fixture.txt")
+        assert Resources.exists("fixture.txt")
+        assert not Resources.exists("nope.bin")
+        # downloader resolves from the local mirror with checksum check
+        mirror = tmp_path / "mirror"
+        mirror.mkdir()
+        payload = b"weights-blob"
+        (mirror / "vgg16.bin").write_bytes(payload)
+        md5 = hashlib.md5(payload).hexdigest()
+        target = str(tmp_path / "cache" / "vgg16.bin")
+        got = Downloader.download("vgg16", "http://x/y/vgg16.bin", target,
+                                  md5=md5)
+        assert open(got, "rb").read() == payload
+        # cached + checksum-verified on re-call
+        assert Downloader.download("vgg16", "http://x/y/vgg16.bin", target,
+                                   md5=md5) == target
+        with pytest.raises(FileNotFoundError, match="mirror"):
+            Downloader.download("absent", "http://x/absent.bin",
+                                str(tmp_path / "c2" / "absent.bin"))
+        with pytest.raises(IOError, match="checksum"):
+            Downloader.download("vgg16", "http://x/y/vgg16.bin",
+                                str(tmp_path / "c3" / "v.bin"),
+                                md5="0" * 32)
+
+    def test_spark_transform_executor_alias(self):
+        from deeplearning4j_tpu.datavec import (ColumnCondition,
+                                                ConditionOp, Schema,
+                                                SparkTransformExecutor,
+                                                TransformProcess)
+        schema = Schema.Builder().addColumnInteger("x").build()
+        tp = (TransformProcess.Builder(schema)
+              .integerMathOp("x", "Multiply", 3).build())
+        recs = [[i] for i in range(100)]
+        out = SparkTransformExecutor.execute(recs, tp, numPartitions=4)
+        assert [w.toInt() for r in out for w in r] == \
+            [3 * i for i in range(100)]
