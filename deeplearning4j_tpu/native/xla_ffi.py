@@ -1,0 +1,103 @@
+"""XLA FFI custom calls backed by the native runtime.
+
+Reference: the nd4j-tpu north star's "C++ XLA FFI custom-calls where
+native parity is required" (SURVEY.md §7.1) — the native kernels from
+``native/src`` surfaced INSIDE jitted XLA programs through the typed FFI,
+the modern form of the reference's JNI executioner boundary.
+
+Lazily compiles ``native/src/xla_ffi.cpp`` against jaxlib's header-only
+FFI API and registers the handlers on the CPU platform (host-side
+runtime; TPU device math stays XLA-compiled).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_lock = threading.Lock()
+_registered = False
+_lib: Optional[ctypes.CDLL] = None
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_OUT = _NATIVE_DIR / "build" / "libdl4j_xla_ffi.so"
+
+
+def _compile() -> Optional[Path]:
+    import jax
+    try:
+        inc = jax.ffi.include_dir()
+    except Exception:
+        return None
+    _OUT.parent.mkdir(parents=True, exist_ok=True)
+    src = _NATIVE_DIR / "src" / "xla_ffi.cpp"
+    dep = _NATIVE_DIR / "src" / "compression.cpp"
+    dep2 = _NATIVE_DIR / "src" / "random.cpp"
+    dep3 = _NATIVE_DIR / "src" / "threads.cpp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           f"-I{inc}", f"-I{_NATIVE_DIR / 'include'}",
+           str(src), str(dep), str(dep2), str(dep3),
+           "-o", str(_OUT), "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=240)
+    except Exception:
+        return None
+    return _OUT
+
+
+def register() -> bool:
+    """Compile (once) + register the FFI targets; False when unavailable
+    (no g++/headers — callers fall back to pure-XLA lowerings)."""
+    global _registered, _lib
+    with _lock:
+        if _registered:
+            return True
+        if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
+            return False
+        import jax
+        path = _OUT if _OUT.exists() else _compile()
+        if path is None or not path.exists():
+            return False
+        try:
+            _lib = ctypes.CDLL(str(path))
+            for name in ("dl4j_xla_threshold_count",
+                         "dl4j_xla_philox_uniform"):
+                sym = getattr(_lib, name)
+                jax.ffi.register_ffi_target(
+                    name, jax.ffi.pycapsule(sym), platform="cpu")
+            _registered = True
+        except Exception:
+            return False
+        return True
+
+
+def threshold_count(grad, threshold: float):
+    """Count of |grad| >= threshold as an XLA op (jit-able on CPU)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    if not register():
+        return jnp.sum(jnp.abs(grad) >= threshold).astype(jnp.int64)
+    # attrs decode by EXACT dtype; x64 mode would promote a python float
+    return jax.ffi.ffi_call(
+        "dl4j_xla_threshold_count",
+        jax.ShapeDtypeStruct((), jnp.int64))(
+        jnp.asarray(grad, jnp.float32), threshold=np.float32(threshold))
+
+
+def philox_uniform(seed: int, offset: int, n: int):
+    """U[0,1) draws from the native Philox stream, inside XLA; the same
+    (seed, offset) addressing as native.philox_uniform on the host."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    if not register():
+        raise RuntimeError("XLA FFI target unavailable "
+                           "(native toolchain/headers missing)")
+    return jax.ffi.ffi_call(
+        "dl4j_xla_philox_uniform",
+        jax.ShapeDtypeStruct((int(n),), jnp.float32))(
+        seed=np.int64(seed), offset=np.int64(offset))

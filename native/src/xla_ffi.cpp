@@ -1,0 +1,48 @@
+/* XLA FFI custom-call bridge (reference: the libnd4j C API consumed from
+ * the executioner — here the same native kernels surfaced INSIDE an XLA
+ * program via the typed FFI, closing the "C API -> PJRT custom-call" row
+ * of SURVEY §2.1).
+ *
+ * Built separately from libdl4j_native (needs jaxlib's header-only FFI
+ * API; include dir comes from jax.ffi.include_dir() at build time).
+ * Handlers registered on the CPU platform — host-side runtime kernels;
+ * TPU device math stays XLA-compiled.
+ */
+#include "dl4j_native.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+/* count of |grad[i]| >= threshold, as an XLA op (scalar s64 output) */
+static ffi::Error ThresholdCountImpl(ffi::Buffer<ffi::F32> grad,
+                                     float threshold,
+                                     ffi::ResultBuffer<ffi::S64> out) {
+  const int64_t n = static_cast<int64_t>(grad.element_count());
+  out->typed_data()[0] =
+      dl4j_threshold_count(grad.typed_data(), n, threshold);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    dl4j_xla_threshold_count, ThresholdCountImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Attr<float>("threshold")
+        .Ret<ffi::Buffer<ffi::S64>>());
+
+/* Philox U[0,1) fill as an XLA op: same counter addressing as the host
+ * API, so host- and graph-side draws from one (seed, offset) agree. */
+static ffi::Error PhiloxUniformImpl(int64_t seed, int64_t offset,
+                                    ffi::ResultBuffer<ffi::F32> out) {
+  dl4j_philox_uniform(static_cast<uint64_t>(seed),
+                      static_cast<uint64_t>(offset), out->typed_data(),
+                      static_cast<int64_t>(out->element_count()));
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    dl4j_xla_philox_uniform, PhiloxUniformImpl,
+    ffi::Ffi::Bind()
+        .Attr<int64_t>("seed")
+        .Attr<int64_t>("offset")
+        .Ret<ffi::Buffer<ffi::F32>>());

@@ -183,3 +183,30 @@ def test_native_cpp_test_binary_under_sanitizers(tmp_path):
                        capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ALL NATIVE TESTS PASSED" in r.stdout
+
+
+def test_xla_ffi_custom_calls():
+    """Native kernels surfaced INSIDE XLA programs via the typed FFI
+    (SURVEY §2.1 C-API row: the PJRT custom-call bridge)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.native import xla_ffi
+    if not xla_ffi.register():
+        pytest.skip("FFI toolchain/headers unavailable")
+    g = np.random.RandomState(0).randn(1000).astype(np.float32)
+    assert int(xla_ffi.threshold_count(g, 0.5)) == \
+        int((np.abs(g) >= 0.5).sum())
+    # participates in jit like any XLA op
+    assert int(jax.jit(
+        lambda x: xla_ffi.threshold_count(x, 0.5) * 2)(jnp.asarray(g))) \
+        == 2 * int((np.abs(g) >= 0.5).sum())
+    # graph-side Philox matches the host stream bit-exactly
+    u = np.asarray(xla_ffi.philox_uniform(42, 0, 64))
+    lib = native._load()
+    if lib is not None:
+        import ctypes
+        host = np.zeros(64, np.float32)
+        lib.dl4j_philox_uniform(
+            42, 0, host.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 64)
+        np.testing.assert_array_equal(u, host)
