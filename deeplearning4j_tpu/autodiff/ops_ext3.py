@@ -1,0 +1,221 @@
+"""Declarable-op breadth sprint 3: updater ops + remaining parity ops.
+
+Reference: libnd4j ``include/ops/declarable/generic/updaters/*.cpp`` —
+the reference exposes its optimizers AS declarable ops (sgdUpdater,
+adamUpdater, …) consumed by SameDiff training; here each wraps the
+corresponding ``learning/config`` transform so graph-side and
+model-side updater math share one implementation.  Plus stragglers:
+xlogy/xdivy, 1-D pooling, deconv3d, N-D space/batch, nthElement,
+clipByGlobalNorm, sufficientStatistics, logMatrixDeterminant, resizeArea.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.samediff import (OP_IMPLS, _simple,
+                                                  register_op)
+
+# ---------------------------------------------------------------------------
+# updater ops (reference: generic/updaters/**.cpp — the op form returns
+# (updated_param, *new_state); state layout matches learning/config)
+# ---------------------------------------------------------------------------
+def _updater_op(name, updater_cls, state_keys):
+    def factory(lr=None, iteration=0, **attrs):
+        import dataclasses as _dc
+        import inspect
+        known = {f.name for f in _dc.fields(updater_cls)}
+        up = updater_cls(**{k: v for k, v in attrs.items() if k in known})
+        step_lr = lr if lr is not None else up.learningRate
+
+        def f(param, grad, *state_vals):
+            state = dict(zip(state_keys, state_vals))
+            upd, new_state = up.apply(grad, state, step_lr,
+                                      int(iteration), 0, param=param)
+            return [param - upd] + [new_state[k] for k in state_keys]
+        return f
+    OP_IMPLS[name] = factory
+
+
+def _register_updater_ops():
+    from deeplearning4j_tpu.learning.config import (AMSGrad, AdaDelta,
+                                                    AdaGrad, AdaMax, Adam,
+                                                    Nadam, Nesterovs,
+                                                    RmsProp, Sgd)
+    _updater_op("sgdUpdater", Sgd, [])
+    _updater_op("adamUpdater", Adam, ["m", "v"])
+    _updater_op("adaMaxUpdater", AdaMax, ["m", "v"])
+    _updater_op("nadamUpdater", Nadam, ["m", "v"])
+    _updater_op("amsGradUpdater", AMSGrad, ["m", "v", "vHat"])
+    _updater_op("adaGradUpdater", AdaGrad, ["h"])
+    _updater_op("adaDeltaUpdater", AdaDelta, ["msg", "msdx"])
+    _updater_op("rmsPropUpdater", RmsProp, ["g"])
+    _updater_op("nesterovsUpdater", Nesterovs, ["v"])
+
+
+_register_updater_ops()
+
+# ---------------------------------------------------------------------------
+# elementwise stragglers
+# ---------------------------------------------------------------------------
+_simple("xlogy", lambda x, y: jnp.where(
+    x == 0, 0.0, x * jnp.log(jnp.where(x == 0, 1.0, y))))
+_simple("xdivy", lambda x, y: jnp.where(
+    x == 0, 0.0, x / jnp.where(x == 0, 1.0, y)))
+OP_IMPLS["floorMod"] = OP_IMPLS["mod"]
+
+
+@register_op("nthElement")
+def _nth_element(n=0, reverse=False, **_):
+    def f(x):
+        s = jnp.sort(x, axis=-1)
+        k = x.shape[-1] - 1 - int(n) if reverse else int(n)
+        return s[..., k]
+    return f
+
+
+@register_op("clipByGlobalNorm")
+def _clip_global_norm(clipNorm=1.0, **_):
+    def f(*tensors):
+        gnorm = jnp.sqrt(sum(jnp.sum(t.astype(jnp.float64) ** 2)
+                             for t in tensors))
+        scale = jnp.minimum(1.0, clipNorm / jnp.maximum(gnorm, 1e-12))
+        out = [t * scale.astype(t.dtype) for t in tensors]
+        return out if len(out) > 1 else out[0]
+    return f
+
+
+@register_op("sufficientStatistics")
+def _suff_stats(dims=None, **_):
+    ax = tuple(dims) if dims is not None else None
+
+    def f(x):
+        cnt = jnp.asarray(np.prod([x.shape[a] for a in ax])
+                          if ax else x.size, x.dtype)
+        return [cnt, jnp.sum(x, axis=ax), jnp.sum(x * x, axis=ax)]
+    return f
+
+
+@register_op("logMatrixDeterminant")
+def _log_det(**_):
+    def f(x):
+        sign, logdet = jnp.linalg.slogdet(x)
+        return [sign, logdet]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# 1-D / 3-D conv-family stragglers
+# ---------------------------------------------------------------------------
+def _pool1d(kind):
+    def factory(k=2, s=None, isSameMode=False, **_):
+        kk, ss = int(k), int(s or k)
+        pad = "SAME" if isSameMode else "VALID"
+
+        def f(x):   # (b, c, t)
+            if kind == "max":
+                return lax.reduce_window(x, -jnp.inf, lax.max,
+                                         (1, 1, kk), (1, 1, ss), pad)
+            tot = lax.reduce_window(x, 0.0, lax.add, (1, 1, kk),
+                                    (1, 1, ss), pad)
+            n = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                  (1, 1, kk), (1, 1, ss), pad)
+            return tot / n
+        return f
+    OP_IMPLS[f"{kind}Pooling1d"] = factory
+
+
+_pool1d("max")
+_pool1d("avg")
+
+
+@register_op("deconv3d")
+def _deconv3d(sD=1, sH=1, sW=1, isSameMode=False, **_):
+    def f(x, w, *bias):   # x (b,c,d,h,w); w (o,i,kd,kh,kw)
+        kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
+        if isSameMode:
+            pads = []
+            for dim, (kk, ss) in zip((2, 3, 4), ((kd, sD), (kh, sH),
+                                                 (kw, sW))):
+                out = x.shape[dim] * int(ss)
+                tot = (x.shape[dim] - 1) * int(ss) + kk - out
+                pads.append(((kk - 1) - tot // 2 - tot % 2,
+                             (kk - 1) - tot // 2))
+        else:
+            pads = [(kd - 1, kd - 1), (kh - 1, kh - 1), (kw - 1, kw - 1)]
+        y = lax.conv_general_dilated(
+            x, w[:, :, ::-1, ::-1, ::-1], (1, 1, 1), pads,
+            lhs_dilation=(int(sD), int(sH), int(sW)),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if bias:
+            y = y + bias[0].reshape(1, -1, 1, 1, 1)
+        return y
+    return f
+
+
+@register_op("spaceToBatchND")
+def _space_to_batch_nd(blockShape=(2, 2), paddings=((0, 0), (0, 0)), **_):
+    bs = [int(b) for b in blockShape]
+    pd = [(int(a), int(b)) for a, b in paddings]
+
+    def f(x):   # NHWC-style: batch, *spatial, channels
+        pads = [(0, 0)] + pd + [(0, 0)] * (x.ndim - 1 - len(pd))
+        x = jnp.pad(x, pads)
+        b = x.shape[0]
+        spatial = x.shape[1:1 + len(bs)]
+        rest = x.shape[1 + len(bs):]
+        shape = [b]
+        for s, blk in zip(spatial, bs):
+            shape += [s // blk, blk]
+        x = x.reshape(shape + list(rest))
+        nd = len(bs)
+        perm = [2 * i + 2 for i in range(nd)] + [0] + \
+            [2 * i + 1 for i in range(nd)] + \
+            list(range(1 + 2 * nd, x.ndim))
+        x = x.transpose(perm)
+        return x.reshape([b * int(np.prod(bs))] +
+                         [s // blk for s, blk in zip(spatial, bs)] +
+                         list(rest))
+    return f
+
+
+@register_op("batchToSpaceND")
+def _batch_to_space_nd(blockShape=(2, 2), crops=((0, 0), (0, 0)), **_):
+    bs = [int(b) for b in blockShape]
+    cr = [(int(a), int(b)) for a, b in crops]
+
+    def f(x):
+        nd = len(bs)
+        nblk = int(np.prod(bs))
+        b = x.shape[0] // nblk
+        spatial = x.shape[1:1 + nd]
+        rest = x.shape[1 + nd:]
+        x = x.reshape(bs + [b] + list(spatial) + list(rest))
+        perm = [nd]
+        for i in range(nd):
+            perm += [nd + 1 + i, i]
+        perm += list(range(2 * nd + 1, x.ndim))
+        x = x.transpose(perm)
+        x = x.reshape([b] + [s * blk for s, blk in zip(spatial, bs)] +
+                      list(rest))
+        for i, (lo, hi) in enumerate(cr):
+            idx = [slice(None)] * x.ndim
+            idx[1 + i] = slice(lo, x.shape[1 + i] - hi or None)
+            x = x[tuple(idx)]
+        return x
+    return f
+
+
+@register_op("resizeArea")
+def _resize_area(height=None, width=None, **_):
+    def f(x):   # NHWC; exact for integer downscale (mean pooling)
+        b, h, w, c = x.shape
+        oh, ow = int(height), int(width)
+        if h % oh == 0 and w % ow == 0:
+            fh, fw = h // oh, w // ow
+            return x.reshape(b, oh, fh, ow, fw, c).mean(axis=(2, 4))
+        return jax.image.resize(x, (b, oh, ow, c), method="linear")
+    return f

@@ -1835,3 +1835,4 @@ class History:
 # sibling module so this file stays the core graph machinery.
 from deeplearning4j_tpu.autodiff import ops_ext  # noqa: E402,F401  isort:skip
 from deeplearning4j_tpu.autodiff import ops_ext2  # noqa: E402,F401  isort:skip
+from deeplearning4j_tpu.autodiff import ops_ext3  # noqa: E402,F401  isort:skip
