@@ -165,6 +165,33 @@ class ComputationGraph:
                            for name, lp in params.items())
         return total + reg, (new_state, total)
 
+    def _runSolverStep(self, inputs, labels, masks, algo: str) -> None:
+        """Legacy line-search solvers for graph models (see
+        MultiLayerNetwork._runSolverStep / optimize/solvers.py)."""
+        from jax.flatten_util import ravel_pytree
+
+        from deeplearning4j_tpu.optimize.solvers import make_solver
+        flat, unravel = ravel_pytree(self.params_)
+        if getattr(self, "_solver", None) is None or \
+                self._solverAlgo != algo or self._solverSize != flat.size:
+            self._solver = make_solver(
+                algo, int(self.conf.globalConf.get(
+                    "maxNumLineSearchIterations") or 5))
+            self._solverAlgo, self._solverSize = algo, flat.size
+            key = jax.random.fold_in(self._fitKey, 0)
+            state = self.state_
+
+            def loss_flat(v, ins, labs, mks):
+                loss, _aux = self._lossFn(unravel(v), state, ins, labs,
+                                          mks, key)
+                return loss
+
+            self._solver.bind(loss_flat)
+        new_flat, f_new = self._solver.step(flat, inputs, labels, masks)
+        self.params_ = unravel(new_flat)
+        self._score = float(f_new)
+        self._scoreArr = None
+
     @functools.cached_property
     def _trainStep(self):
         def step(params, optState, state, inputs, labels, masks, key,
@@ -235,6 +262,14 @@ class ComputationGraph:
             masks = (pb(ds.labelsMask.jax),) \
                 if ds.labelsMask is not None else None
         self.lastBatchSize = int(inputs[0].shape[0])
+        algo = str(self.conf.globalConf.get("optimizationAlgo")
+                   or "STOCHASTIC_GRADIENT_DESCENT").upper()
+        if algo != "STOCHASTIC_GRADIENT_DESCENT":
+            self._runSolverStep(inputs, labels, masks, algo)
+            self.iterationCount += 1
+            for l in self._listeners:
+                l.iterationDone(self, self.iterationCount, self.epochCount)
+            return
         self._fitKey, key = jax.random.split(self._fitKey)
         self.params_, self.optState_, new_state, loss = self._trainStep(
             self.params_, self.optState_, self.state_, inputs, labels, masks,

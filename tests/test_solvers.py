@@ -93,3 +93,22 @@ class TestSolvers:
         net = _mlp_net("NEWTON_RAPHSON")
         with pytest.raises(ValueError, match="optimizationAlgo"):
             net.fit(ds)
+
+
+def test_lbfgs_on_computation_graph():
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    gb = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(1e-2))
+          .optimizationAlgo("LBFGS").graphBuilder())
+    gb.addInputs("in").setInputTypes(InputType.feedForward(8))
+    gb.addLayer("h", DenseLayer.builder().nOut(16).activation("tanh")
+                .build(), "in")
+    gb.addLayer("out", OutputLayer.builder("mse").nOut(3)
+                .activation("identity").build(), "h")
+    gb.setOutputs("out")
+    net = ComputationGraph(gb.build()).init()
+    ds = _linear_data()
+    net.fit(ds)
+    first = net.score()
+    for _ in range(40):
+        net.fit(ds)
+    assert net.score() < first * 0.05
