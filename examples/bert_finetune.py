@@ -5,6 +5,9 @@ tokenization -> BertIterator MLM batches -> Bert (SameDiff graph compiled to
 ONE XLA executable) -> fit.  Offline-friendly: builds a vocab from the tiny
 bundled corpus; bf16 reaches ~48k tokens/sec/chip at B=64 on v5e.
 """
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run as a script from anywhere
 import sys
 
 import numpy as np

@@ -15,6 +15,9 @@ fine-tuning the imported graph.  This entry point does exactly that:
 The sibling ``bert_finetune.py`` covers the natively-built Bert
 (``zoo/bert.py``) + BertIterator MLM path.
 """
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run as a script from anywhere
 import sys
 
 import numpy as np

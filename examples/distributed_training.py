@@ -12,6 +12,9 @@ Run multi-host with SharedTrainingMaster.connect(coordinator, rank, n).
 Single-process demo: set XLA_FLAGS=--xla_force_host_platform_device_count=8
 JAX_PLATFORMS=cpu for a virtual 8-device mesh.
 """
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run as a script from anywhere
 import sys
 
 import numpy as np

@@ -4,6 +4,9 @@ Shaped like dl4j-examples' LeNetMNIST: builder config -> fit -> evaluate.
 Runs on the TPU chip when present; MNIST falls back to a bundled synthetic
 glyph set offline (set $DL4J_TPU_DATA_DIR for the real idx files).
 """
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run as a script from anywhere
 import sys
 
 from deeplearning4j_tpu.datasets import MnistDataSetIterator

@@ -5,6 +5,9 @@ stacked GravesLSTM -> RnnOutputLayer, TBPTT training, then sampling with
 rnnTimeStep.  The recurrence compiles to lax.scan (reference:
 CudnnLSTMHelper -> XLA while_loop north star).
 """
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run as a script from anywhere
 import sys
 
 import numpy as np

@@ -6,6 +6,9 @@ ImageNet-shaped batches; point an ImageRecordReader at real data to swap in
 (see deeplearning4j_tpu.datavec).  bf16 mixed precision by default
 (~1300 images/sec/chip on v5e, `python bench.py`).
 """
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run as a script from anywhere
 import sys
 import time
 
