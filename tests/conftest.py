@@ -39,6 +39,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: smoke tests that need the real TPU chip "
         "(run with `pytest -m tpu`; skipped on the CPU mesh)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (multi-process spawns)")
 
 
 def pytest_collection_modifyitems(config, items):

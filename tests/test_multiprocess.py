@@ -1,0 +1,109 @@
+"""Multi-process distributed training on localhost (SURVEY §4's
+"distributed WITHOUT a cluster" pattern, §5.8 comm backend).
+
+Two OS processes join a ``jax.distributed`` cluster (Gloo-backed CPU
+collectives — the DCN stand-in), build the same model, and train through
+ParallelWrapper over a 2-process DeviceMesh: GSPMD's gradient psum now
+crosses PROCESS boundaries.  Both ranks must converge to bit-identical
+params, equal to a single-process run on the same total batch (sync DP ==
+large-batch SGD).
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+jax.distributed.initialize({addr!r}, num_processes=2, process_id=pid)
+import numpy as np
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+def build():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(1e-1))
+            .list()
+            .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+            .layer(OutputLayer.builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+rng = np.random.RandomState(0)
+x = rng.randn(16, 4).astype(np.float32)
+y = rng.randn(16, 2).astype(np.float32)
+net = build()
+mesh = DeviceMesh(data=2, devices=jax.devices())
+assert jax.device_count() == 2 and jax.process_count() == 2
+ParallelWrapper(net, mesh=mesh).fit(
+    ListDataSetIterator([DataSet(x, y)], batch=16), epochs=3)
+print("PARAMS", np.asarray(net.params().numpy()).tobytes().hex(),
+      flush=True)
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_training():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    addr = f"127.0.0.1:{_free_port()}"
+    code = _WORKER.format(root=root, addr=addr)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}     # no virtual 8-device split
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, out[-2000:]
+        outs.append(out)
+    hexes = [line.split()[1] for out in outs for line in out.splitlines()
+             if line.startswith("PARAMS")]
+    assert len(hexes) == 2
+    # both ranks end bit-identical (the psum crossed process boundaries)
+    assert hexes[0] == hexes[1]
+
+    # and equal to single-process training on the same total batch
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.learning import Sgd
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (InputType,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(1e-1))
+            .list()
+            .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+            .layer(OutputLayer.builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(4)).build())
+    ref = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = rng.randn(16, 2).astype(np.float32)
+    ref.fit(ListDataSetIterator([DataSet(x, y)], batch=16), epochs=3)
+    got = np.frombuffer(bytes.fromhex(hexes[0]), np.float32)
+    np.testing.assert_allclose(got, ref.params().numpy(), rtol=2e-4,
+                               atol=1e-6)
