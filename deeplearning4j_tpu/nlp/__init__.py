@@ -9,5 +9,5 @@ from deeplearning4j_tpu.nlp.tokenization import (BertWordPieceTokenizer,  # noqa
                                                  DefaultTokenizerFactory)
 from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import (  # noqa: F401
-    Glove, ParagraphVectors, VocabCache, Word2Vec, WordVectors,
+    FastText, Glove, ParagraphVectors, VocabCache, Word2Vec, WordVectors,
     WordVectorSerializer)

@@ -15,9 +15,9 @@ sigmoid-dot loss, scatter-add updates — so the MXU/VPU see thousands of
 pairs at once.  Negative sampling follows the reference's unigram^0.75
 table (drawn via a precomputed-cumsum searchsorted, O(log V) per draw);
 CBOW averages the window's vectors to predict the center, skip-gram
-predicts each context word from the center.  Hierarchical softmax is NOT
-implemented — negative sampling is the only objective (the reference
-defaults to HS; SGNS converges to comparable embeddings).
+predicts each context word from the center; hierarchical softmax
+(``useHierarchicSoftmax=True``) walks Huffman paths in one batched
+gather/einsum; FastText adds hashed-subword (character n-gram) rows.
 """
 from __future__ import annotations
 
@@ -257,6 +257,37 @@ class _EmbeddingTrainer:
             return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
 
         return jax.jit(step, donate_argnums=(0, 1))
+
+    @functools.cached_property
+    def _step_subword(self):
+        """fastText skip-gram: center = MEAN of subword rows (word +
+        hashed n-grams — fastText's Model::computeHidden divides by the
+        input count), SGNS objective against syn1."""
+        def step(syn0, syn1, sub, sub_mask, contexts, negatives, lr):
+            def loss_fn(s0, s1):
+                cnt = jnp.maximum(sub_mask.sum(-1, keepdims=True), 1.0)
+                c = (s0[sub] * sub_mask[..., None]).sum(1) / cnt  # (B, D)
+                o = s1[contexts]
+                n = s1[negatives]
+                pos = jnp.sum(c * o, axis=-1)
+                negd = jnp.einsum("bd,bkd->bk", c, n)
+                return -(-jax.nn.softplus(-pos)
+                         - jax.nn.softplus(negd).sum(-1)).sum()
+
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1)
+            return syn0 - lr * g0, syn1 - lr * g1, loss / sub.shape[0]
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_batch_subword(self, sub, sub_mask, contexts, negatives,
+                            lr=None):
+        self.syn0, self.syn1, loss = self._step_subword(
+            self.syn0, self.syn1, self._shard(sub),
+            self._shard(jnp.asarray(sub_mask, jnp.float32)),
+            self._shard(contexts), self._shard(negatives),
+            jnp.asarray(lr if lr is not None else self.lr, jnp.float32))
+        return float(loss)
 
     def train_batch_hs(self, centers, points, codes, mask, lr=None):
         self.syn0, self.syn1, loss = self._step_hs(
@@ -799,3 +830,108 @@ class WordVectorSerializer:
         return WordVectors(vocab, np.asarray(vecs, dtype=np.float32))
 
     loadTxtVectors = readWord2VecModel
+
+
+class FastText(Word2Vec):
+    """Subword (character n-gram) embeddings — fastText.
+
+    Reference: deeplearning4j-nlp ``models/fasttext/FastText.java`` (a
+    JFastText wrapper in the reference; native here).  A word's vector is
+    the MEAN of its own row and its hashed character-n-gram rows
+    (boundary-marked ``<word>``, fastText's computeHidden average), so
+    morphology is shared across the
+    vocabulary and **out-of-vocabulary words get vectors from their
+    n-grams alone** — the capability the reference wraps fastText for.
+
+    Training is skip-gram negative sampling where the center
+    representation is the subword sum; one jitted batch step (padded
+    subword-id gather + sum) instead of fastText's per-pair loop.
+    """
+
+    def __init__(self, sentences=None, minN: int = 3, maxN: int = 6,
+                 bucket: int = 20000, **kw):
+        super().__init__(sentences=sentences, **kw)
+        self.minN = int(minN)
+        self.maxN = int(maxN)
+        self.bucket = int(bucket)
+
+    def _ngrams(self, word: str) -> List[str]:
+        w = f"<{word}>"
+        out = []
+        for n in range(self.minN, self.maxN + 1):
+            for i in range(0, max(0, len(w) - n) + 1):
+                g = w[i:i + n]
+                if g != w:          # the full token has its own row
+                    out.append(g)
+        return out
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        # fastText's FNV-1a 32-bit
+        h = 2166136261
+        for ch in s.encode("utf-8"):
+            h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+        return h
+
+    def _subword_ids(self, word: str, word_idx: int, nW: int) -> List[int]:
+        return [word_idx] + [nW + (self._hash(g) % self.bucket)
+                             for g in self._ngrams(word)]
+
+    def fit(self) -> "FastText":
+        sents = self._tokenize()
+        vocab = _build_vocab(sents, self.minWordFrequency)
+        nW = vocab.numWords()
+        rng = np.random.RandomState(self.seed)
+        ids = [[vocab.indexOf(w) for w in s if vocab.containsWord(w)]
+               for s in sents]
+        ids = _subsample(ids, vocab, self.subsampling, rng)
+        sampler = _NegativeSampler(vocab)
+        trainer = _EmbeddingTrainer(nW, self.layerSize, self.seed,
+                                    self.learningRate, self.negativeSample,
+                                    extraRows=self.bucket)
+        sub = [self._subword_ids(vocab.wordAtIndex(i), i, nW)
+               for i in range(nW)]
+        L = max(len(s) for s in sub)
+        SUB = np.zeros((nW, L), np.int32)
+        SM = np.zeros((nW, L), np.float32)
+        for i, s in enumerate(sub):
+            SUB[i, :len(s)] = s
+            SM[i, :len(s)] = 1.0
+        pairs = self._pairs(ids, rng)
+        total = max(1, self.epochs * self.iterations *
+                    ((len(pairs) + self.batchSize - 1) // self.batchSize))
+        step = 0
+        for _ in range(self.epochs):
+            for _ in range(self.iterations):
+                rng.shuffle(pairs)
+                for i in range(0, len(pairs), self.batchSize):
+                    batch = pairs[i:i + self.batchSize]
+                    centers = np.array([p[0] for p in batch], np.int32)
+                    contexts = np.array([p[1] for p in batch], np.int32)
+                    negs = sampler.draw(rng,
+                                        (len(batch), self.negativeSample))
+                    trainer.train_batch_subword(
+                        SUB[centers], SM[centers], contexts, negs,
+                        self._decayed_lr(step, total))
+                    step += 1
+        table = np.asarray(trainer.syn0)
+        # combined per-word vectors (subword mean), like fastText's .vec
+        combined = (table[SUB] * SM[..., None]).sum(axis=1) \
+            / np.maximum(SM.sum(axis=1, keepdims=True), 1.0)
+        WordVectors.__init__(self, vocab, combined)
+        self.vocab = vocab
+        self._table = table
+        self._nW = nW
+        self._fitted = True
+        return self
+
+    def getWordVector(self, word: str):
+        v = super().getWordVector(word)
+        if v is not None:
+            return v
+        # OOV: n-gram rows alone (fastText's signature behavior)
+        gids = [self._nW + (self._hash(g) % self.bucket)
+                for g in self._ngrams(word)]
+        if not gids:
+            return None
+        return self._table[gids].mean(axis=0)

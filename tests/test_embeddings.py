@@ -290,3 +290,23 @@ def test_words_nearest_positional_n_regression():
     w2v = Word2Vec(sentences=_corpus(), layerSize=8, epochs=1, seed=1).fit()
     # old 2-positional call form: wordsNearest(word, n)
     assert len(w2v.wordsNearest("apple", 3)) == 3
+
+
+def test_fasttext_subword_and_oov():
+    """fastText: subword-sum vectors + OOV words from n-grams alone
+    (reference: models/fasttext/FastText.java via JFastText)."""
+    from deeplearning4j_tpu.nlp import FastText
+    ft = FastText(sentences=_corpus(), layerSize=32, minWordFrequency=1,
+                  windowSize=3, seed=7, epochs=10, learningRate=0.05,
+                  minN=3, maxN=5, bucket=5000)
+    ft.fit()
+    assert ft.similarity("apple", "banana") > ft.similarity("apple", "car")
+    # OOV gets a vector from its character n-grams
+    v = ft.getWordVector("applesauce")     # not in the corpus
+    assert v is not None and v.shape == (32,)
+    # ...and shares n-grams with 'apple', so it lands near the fruit side
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+    va = ft.getWordVector("apple")
+    vc = ft.getWordVector("car")
+    assert cos(v, va) > cos(v, vc)
