@@ -8,3 +8,4 @@ from deeplearning4j_tpu.rl.policy import Policy, softmax_sample  # noqa: F401
 from deeplearning4j_tpu.rl.a3c import (  # noqa: F401
     A3CConfiguration, A3CDiscreteDense, A3CDiscreteDenseAsync, ACPolicy,
     ActorCriticSeparate)
+from deeplearning4j_tpu.rl.gym import GymEnv  # noqa: F401

@@ -116,3 +116,70 @@ class TestBayesianArbiter:
                                       numInitialRandom=4)
         self._runner(gen, budget=12)
         assert len(gen._hist) == 12
+
+
+class TestGymAdapter:
+    """GymEnv adapter (reference: rl4j-gym) driven with a fake env that
+    speaks both the gymnasium 5-tuple and legacy 4-tuple protocols."""
+
+    class _FakeSpace:
+        def __init__(self, n=None, shape=None):
+            self.n = n
+            self.shape = shape
+
+    class _FakeEnv:
+        def __init__(self, five_tuple=True, horizon=4):
+            self.action_space = TestGymAdapter._FakeSpace(n=2)
+            self.observation_space = TestGymAdapter._FakeSpace(
+                shape=(3,))
+            self.five = five_tuple
+            self.horizon = horizon
+            self.t = 0
+            self.closed = False
+
+        def reset(self, seed=None):
+            self.t = 0
+            obs = np.zeros(3, np.float32)
+            return (obs, {}) if self.five else obs
+
+        def step(self, a):
+            self.t += 1
+            obs = np.full(3, self.t, np.float32)
+            done = self.t >= self.horizon
+            if self.five:
+                return obs, 1.0, done, False, {}
+            return obs, 1.0, done, {}
+
+        def close(self):
+            self.closed = True
+
+    def _check(self, five):
+        from deeplearning4j_tpu.rl import GymEnv
+        env = GymEnv(env=self._FakeEnv(five_tuple=five))
+        assert env.getActionSpace().getSize() == 2
+        assert env.getObservationSpace().shape == (3,)
+        obs = env.reset()
+        assert obs.shape == (3,) and not env.isDone()
+        total = 0.0
+        while not env.isDone():
+            reply = env.step(env.getActionSpace().randomAction())
+            total += reply.getReward()
+        assert total == 4.0 and env.isDone()
+        env.close()
+        assert env.env.closed
+
+    def test_gymnasium_protocol(self):
+        self._check(True)
+
+    def test_legacy_gym_protocol(self):
+        self._check(False)
+
+    def test_trains_policy_on_fake_env(self):
+        from deeplearning4j_tpu.rl import (GymEnv, QLConfiguration,
+                                           QLearningDiscreteDense)
+        conf = QLConfiguration(seed=1, maxStep=300, batchSize=8,
+                               epsilonNbStep=100, maxEpochStep=10)
+        dqn = QLearningDiscreteDense(GymEnv(env=self._FakeEnv()), conf,
+                                     hidden=(8,))
+        dqn.train()
+        assert dqn.stepCount >= 200
