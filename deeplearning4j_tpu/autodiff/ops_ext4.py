@@ -1,0 +1,176 @@
+"""Declarable-op breadth sprint 4: merge/condition/index-reduce families.
+
+Reference: libnd4j ``generic/parity_ops`` merge ops (mergeadd/mergeavg/
+mergemax/mergemaxindex), condition transforms (match_condition,
+replace_where, compare_and_set/replace), index-reduce legacy family
+(firstIndex/lastIndex/iamax/iamin), boolean reductions
+(is_non_decreasing, is_strictly_increasing, is_numeric_tensor), plus
+reference alias names that map onto existing lowerings (the reference
+registers several ops under two names too).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.samediff import (OP_IMPLS, _simple,
+                                                  register_op)
+
+# ---- n-ary merges --------------------------------------------------------
+_simple("mergeAdd", lambda *xs: sum(xs))
+_simple("mergeAvg", lambda *xs: sum(xs) / len(xs))
+
+
+@register_op("mergeMax")
+def _merge_max(**_):
+    def f(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out
+    return f
+
+
+@register_op("mergeMaxIndex")
+def _merge_max_index(**_):
+    def f(*xs):
+        return jnp.argmax(jnp.stack(xs), axis=0).astype(jnp.int32)
+    return f
+
+
+# ---- condition transforms (reference: ConditionOp enum kernels) ----------
+_COND = {
+    "EQ": lambda x, v: x == v, "NEQ": lambda x, v: x != v,
+    "LT": lambda x, v: x < v, "LTE": lambda x, v: x <= v,
+    "GT": lambda x, v: x > v, "GTE": lambda x, v: x >= v,
+    "ABS_GT": lambda x, v: jnp.abs(x) > v,
+    "ABS_LT": lambda x, v: jnp.abs(x) < v,
+    "IS_NAN": lambda x, v: jnp.isnan(x),
+    "IS_INF": lambda x, v: jnp.isinf(x),
+}
+
+
+def _cond(condition, value):
+    key = str(condition).upper().replace("LESSTHAN", "LT") \
+        .replace("GREATERTHAN", "GT").replace("EPSEQUALS", "EQ")
+    if key not in _COND:
+        raise ValueError(f"Unknown condition {condition!r}; "
+                         f"known: {sorted(_COND)}")
+    return lambda x: _COND[key](x, value)
+
+
+@register_op("matchCondition")
+def _match_condition(condition="GT", value=0.0, **_):
+    c = _cond(condition, value)
+    return lambda x: jnp.sum(c(x)).astype(jnp.int64)
+
+
+@register_op("matchConditionTransform")
+def _match_condition_transform(condition="GT", value=0.0, **_):
+    c = _cond(condition, value)
+    return lambda x: c(x).astype(jnp.float32)
+
+
+@register_op("replaceWhere")
+def _replace_where(condition="GT", value=0.0, **_):
+    c = _cond(condition, value)
+    return lambda x, repl: jnp.where(c(x), repl, x)
+
+
+@register_op("compareAndSet")
+def _compare_and_set(condition="EQ", value=0.0, setValue=0.0, **_):
+    c = _cond(condition, value)
+    return lambda x: jnp.where(c(x), setValue, x)
+
+
+@register_op("compareAndReplace")
+def _compare_and_replace(condition="GT", value=0.0, **_):
+    # where x satisfies the condition, take the replacement tensor's value
+    c = _cond(condition, value)
+    return lambda x, y: jnp.where(c(x), y, x)
+
+
+# ---- index-reduce legacy family (reference: indexreduce loops) -----------
+def _index_of(mask_fn):
+    def factory(condition="GT", value=0.0, dims=None, **_):
+        c = _cond(condition, value)
+
+        def f(x):
+            m = c(x)
+            ax = int(dims[0]) if isinstance(dims, (tuple, list)) and dims \
+                else -1
+            idx = jnp.arange(x.shape[ax])
+            shape = [1] * x.ndim
+            shape[ax] = x.shape[ax]
+            iota = idx.reshape(shape)
+            big = x.shape[ax] + 1
+            if mask_fn == "first":
+                cand = jnp.where(m, iota, big)
+                out = jnp.min(cand, axis=ax)
+                return jnp.where(out == big, -1, out).astype(jnp.int64)
+            cand = jnp.where(m, iota, -1)
+            return jnp.max(cand, axis=ax).astype(jnp.int64)
+        return f
+    return factory
+
+
+OP_IMPLS["firstIndex"] = _index_of("first")
+OP_IMPLS["lastIndex"] = _index_of("last")
+
+
+@register_op("iamax")
+def _iamax(dims=None, **_):
+    ax = int(dims[0]) if isinstance(dims, (tuple, list)) and dims else None
+    return lambda x: jnp.argmax(jnp.abs(x), axis=ax).astype(jnp.int64)
+
+
+@register_op("iamin")
+def _iamin(dims=None, **_):
+    ax = int(dims[0]) if isinstance(dims, (tuple, list)) and dims else None
+    return lambda x: jnp.argmin(jnp.abs(x), axis=ax).astype(jnp.int64)
+
+
+# ---- boolean reductions --------------------------------------------------
+_simple("isNonDecreasing",
+        lambda x: jnp.all(x.reshape(-1)[1:] >= x.reshape(-1)[:-1]))
+_simple("isStrictlyIncreasing",
+        lambda x: jnp.all(x.reshape(-1)[1:] > x.reshape(-1)[:-1]))
+_simple("isNumericTensor",
+        lambda x: jnp.asarray(jnp.issubdtype(x.dtype, jnp.number)))
+
+
+# ---- small generators / reductions ---------------------------------------
+@register_op("logspace")
+def _logspace(start=0.0, stop=1.0, num=10, base=10.0, **_):
+    return lambda: jnp.logspace(float(start), float(stop), int(num),
+                                base=float(base))
+
+
+@register_op("squaredNorm")
+def _squared_norm(dims=None, keepDims=False, **_):
+    ax = tuple(dims) if dims else None
+    return lambda x: jnp.sum(x * x, axis=ax, keepdims=bool(keepDims))
+
+
+@register_op("countZero")
+def _count_zero(dims=None, **_):
+    ax = tuple(dims) if dims else None
+    return lambda x: jnp.sum((x == 0).astype(jnp.int64), axis=ax)
+
+
+@register_op("upsampling1d")
+def _upsampling1d(scale=2, **_):
+    return lambda x: jnp.repeat(x, int(scale), axis=2)   # (b, c, t)
+
+
+# ---- reference alias names onto existing lowerings -----------------------
+for _alias, _target in [("setdiff1d", "listDiff"),
+                        ("divideNoNan", "divNoNan"),
+                        ("squaredSubtract", "squaredDifference"),
+                        ("softmaxCrossEntropyWithLogits",
+                         "softmaxCrossEntropy"),
+                        ("sigmoidCrossEntropyWithLogits",
+                         "sigmoidCrossEntropy"),
+                        ("iMax", "argmax"), ("iMin", "argmin")]:
+    OP_IMPLS[_alias] = OP_IMPLS[_target]

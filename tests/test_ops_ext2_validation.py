@@ -880,3 +880,103 @@ def test_sprint3_conv_and_space_ops():
                                 {"height": 4, "width": 4}, name="o"),
               big.reshape(1, 4, 2, 4, 2, 2).mean(axis=(2, 4)),
               {"x": big}, tol=1e-5)
+
+
+def test_sprint4_merge_condition_index_ops():
+    rng = _R(70)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    c = rng.randn(3, 4).astype(np.float32)
+    three = lambda sd: [sd.placeholder("a"), sd.placeholder("b"),
+                        sd.placeholder("c")]
+    _validate(lambda sd: sd._op("mergeAdd", three(sd), name="o"),
+              a + b + c, {"a": a, "b": b, "c": c}, tol=1e-5)
+    _validate(lambda sd: sd._op("mergeAvg", three(sd), name="o"),
+              (a + b + c) / 3, {"a": a, "b": b, "c": c}, tol=1e-5)
+    _validate(lambda sd: sd._op("mergeMax", three(sd), name="o"),
+              np.maximum(np.maximum(a, b), c), {"a": a, "b": b, "c": c})
+    _validate(lambda sd: sd._op("mergeMaxIndex", three(sd), name="o"),
+              np.argmax(np.stack([a, b, c]), 0).astype(np.int32),
+              {"a": a, "b": b, "c": c})
+    # condition transforms
+    [n] = _run(lambda sd: sd._op("matchCondition", [sd.placeholder("x")],
+                                 {"condition": "GT", "value": 0.0}),
+               {"x": a})
+    assert n == (a > 0).sum()
+    _validate(lambda sd: sd._op("matchConditionTransform",
+                                [sd.placeholder("x")],
+                                {"condition": "ABS_GT", "value": 0.5},
+                                name="o"),
+              (np.abs(a) > 0.5).astype(np.float32), {"x": a})
+    _validate(lambda sd: sd._op("replaceWhere", [sd.placeholder("x"),
+                                                 sd.placeholder("r")],
+                                {"condition": "LT", "value": 0.0},
+                                name="o"),
+              np.where(a < 0, b, a), {"x": a, "r": b})
+    _validate(lambda sd: sd._op("compareAndSet", [sd.placeholder("x")],
+                                {"condition": "GT", "value": 0.5,
+                                 "setValue": 9.0}, name="o"),
+              np.where(a > 0.5, 9.0, a), {"x": a})
+    _validate(lambda sd: sd._op("compareAndReplace",
+                                [sd.placeholder("x"), sd.placeholder("y")],
+                                {"condition": "GT", "value": 0.0},
+                                name="o"),
+              np.where(a > 0, b, a), {"x": a, "y": b})
+    # index reduces
+    x = np.array([[0.1, -2.0, 3.0, -0.5], [-1.0, -1.0, -1.0, 2.0]],
+                 np.float32)
+    [fi] = _run(lambda sd: sd._op("firstIndex", [sd.placeholder("x")],
+                                  {"condition": "GT", "value": 0.5}),
+                {"x": x})
+    np.testing.assert_array_equal(fi, [2, 3])
+    [li] = _run(lambda sd: sd._op("lastIndex", [sd.placeholder("x")],
+                                  {"condition": "LT", "value": 0.0}),
+                {"x": x})
+    np.testing.assert_array_equal(li, [3, 2])
+    [none_found] = _run(lambda sd: sd._op(
+        "firstIndex", [sd.placeholder("x")],
+        {"condition": "GT", "value": 99.0}), {"x": x})
+    np.testing.assert_array_equal(none_found, [-1, -1])
+    _validate(lambda sd: sd._op("iamax", [sd.placeholder("x")],
+                                {"dims": (1,)}, name="o"),
+              np.argmax(np.abs(x), 1).astype(np.int64), {"x": x})
+    _validate(lambda sd: sd._op("iamin", [sd.placeholder("x")],
+                                {"dims": (1,)}, name="o"),
+              np.argmin(np.abs(x), 1).astype(np.int64), {"x": x})
+    # boolean reductions + misc
+    inc = np.array([1.0, 2.0, 2.0, 3.0], np.float32)
+    [r] = _run(lambda sd: sd._op("isNonDecreasing",
+                                 [sd.placeholder("x")]), {"x": inc})
+    assert bool(r)
+    [r] = _run(lambda sd: sd._op("isStrictlyIncreasing",
+                                 [sd.placeholder("x")]), {"x": inc})
+    assert not bool(r)
+    [r] = _run(lambda sd: sd._op("isNumericTensor",
+                                 [sd.placeholder("x")]), {"x": inc})
+    assert bool(r)
+    _validate(lambda sd: sd._op("logspace", [], {"start": 0.0, "stop": 3.0,
+                                                 "num": 4}, name="o"),
+              np.logspace(0, 3, 4), tol=1e-3)
+    _validate(lambda sd: sd._op("squaredNorm", [sd.placeholder("x")],
+                                {"dims": (1,)}, name="o"),
+              (a * a).sum(1), {"x": a}, tol=1e-4)
+    z = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    _validate(lambda sd: sd._op("countZero", [sd.placeholder("x")],
+                                name="o"),
+              np.int64(3), {"x": z})
+    x1 = rng.randn(2, 3, 5).astype(np.float32)
+    _validate(lambda sd: sd._op("upsampling1d", [sd.placeholder("x")],
+                                {"scale": 2}, name="o"),
+              np.repeat(x1, 2, axis=2), {"x": x1})
+    # alias names resolve to the same lowerings
+    from deeplearning4j_tpu.autodiff.samediff import OP_IMPLS
+    for alias, target in [("setdiff1d", "listDiff"),
+                          ("divideNoNan", "divNoNan"),
+                          ("squaredSubtract", "squaredDifference"),
+                          ("iMax", "argmax"), ("iMin", "argmin"),
+                          ("softmaxCrossEntropyWithLogits",
+                           "softmaxCrossEntropy"),
+                          ("sigmoidCrossEntropyWithLogits",
+                           "sigmoidCrossEntropy")]:
+        assert OP_IMPLS[alias] is OP_IMPLS[target]
+        OpValidation.recordTested(alias)
