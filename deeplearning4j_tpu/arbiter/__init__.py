@@ -6,3 +6,5 @@ from deeplearning4j_tpu.arbiter.optimize import (  # noqa: F401
     IntegerParameterSpace, LocalOptimizationRunner, MaxCandidatesCondition,
     MaxTimeCondition, OptimizationConfiguration, OptimizationResult,
     RandomSearchGenerator)
+from deeplearning4j_tpu.arbiter.ui import (  # noqa: F401
+    ArbiterUIServer, StatsStorageCandidateListener)

@@ -334,6 +334,12 @@ class LocalOptimizationRunner:
     def __init__(self, config: OptimizationConfiguration):
         self.config = config
         self.results: List[OptimizationResult] = []
+        self._listeners: List = []
+
+    def addListener(self, listener) -> None:
+        """Listener with ``candidateScored(result)`` (reference: arbiter
+        StatusListener feeding the UI)."""
+        self._listeners.append(listener)
 
     def execute(self) -> OptimizationResult:
         cfg = self.config
@@ -350,6 +356,8 @@ class LocalOptimizationRunner:
             res = OptimizationResult(cand, float(score), model, i)
             self.results.append(res)
             cfg.generator.report(cand, float(score))
+            for li in self._listeners:
+                li.candidateScored(res)
             better = best is None or (
                 res.score < best.score if cfg.minimize
                 else res.score > best.score)

@@ -1,0 +1,116 @@
+"""Arbiter UI — hyperparameter-search dashboard.
+
+Reference: the arbiter UI module (``arbiter-ui`` — best-score curve +
+candidate table rendered in the DL4J UI server; SURVEY.md §2.7).  Here
+the runner streams every scored candidate into the SAME StatsStorage the
+training UI uses (one session per search), and a stdlib HTTP board
+renders best-score-so-far plus the ranked candidate table.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+__all__ = ["ArbiterUIServer", "StatsStorageCandidateListener"]
+
+
+class StatsStorageCandidateListener:
+    """Attach to LocalOptimizationRunner via ``runner.addListener``: every
+    scored candidate is recorded as an update in the storage session."""
+
+    def __init__(self, storage: StatsStorage, sessionId: str = "arbiter"):
+        self.storage = storage
+        self.sessionId = sessionId
+
+    def candidateScored(self, result) -> None:
+        self.storage.putUpdate(self.sessionId, {
+            "index": result.index,
+            "score": float(result.score),
+            "parameters": {k: (v if isinstance(v, (int, float, str, bool))
+                               else str(v))
+                           for k, v in result.parameters.items()},
+        })
+
+
+class ArbiterUIServer:
+    """GET / renders the board; GET /data returns the raw JSON."""
+
+    def __init__(self, storage: StatsStorage, port: int = 0,
+                 sessionId: str = "arbiter", minimize: bool = True):
+        self.storage = storage
+        self.port = port
+        self.sessionId = sessionId
+        self.minimize = minimize
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def _rows(self):
+        return self.storage.getUpdates(self.sessionId)
+
+    def _html(self) -> str:
+        rows = self._rows()
+        best = None
+        curve = []
+        for r in rows:
+            s = r["score"]
+            if best is None or (s < best if self.minimize else s > best):
+                best = s
+            curve.append(best)
+        ranked = sorted(rows, key=lambda r: r["score"],
+                        reverse=not self.minimize)[:50]
+        pts = ""
+        if curve:
+            w, h = 640, 200
+            lo, hi = min(curve), max(curve)
+            span = (hi - lo) or 1.0
+            pts = " ".join(
+                f"{int(i * w / max(len(curve) - 1, 1))},"
+                f"{int(h - (c - lo) / span * (h - 10)) - 5}"
+                for i, c in enumerate(curve))
+        trs = "".join(
+            f"<tr><td>{r['index']}</td><td>{r['score']:.6g}</td>"
+            f"<td><code>{json.dumps(r['parameters'])}</code></td></tr>"
+            for r in ranked)
+        return (
+            "<html><head><title>Arbiter</title></head><body>"
+            f"<h2>Arbiter — {len(rows)} candidates, best "
+            f"{best if best is not None else '—'}</h2>"
+            f"<svg width='640' height='200' style='border:1px solid #999'>"
+            f"<polyline fill='none' stroke='#06c' points='{pts}'/></svg>"
+            "<table border='1' cellpadding='4'><tr><th>#</th><th>score"
+            f"</th><th>parameters</th></tr>{trs}</table></body></html>")
+
+    def start(self) -> "ArbiterUIServer":
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/data"):
+                    body = json.dumps(srv._rows()).encode()
+                    ctype = "application/json"
+                else:
+                    body = srv._html().encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

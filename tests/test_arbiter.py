@@ -84,3 +84,43 @@ def test_runner_tunes_real_network():
     assert best.model is not None
     assert best.parameters["lr"] == 1e-2       # higher lr clearly wins in 8 epochs
     assert best.score < 0.5
+
+
+def test_arbiter_ui_board():
+    """Arbiter UI (reference: arbiter-ui): candidates stream into
+    StatsStorage; the board serves the best-score curve + ranked table."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.arbiter import (ArbiterUIServer,
+                                            ContinuousParameterSpace,
+                                            LocalOptimizationRunner,
+                                            MaxCandidatesCondition,
+                                            OptimizationConfiguration,
+                                            RandomSearchGenerator,
+                                            StatsStorageCandidateListener)
+    from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+
+    storage = InMemoryStatsStorage()
+    gen = RandomSearchGenerator(
+        {"x": ContinuousParameterSpace(0.0, 1.0)}, seed=3)
+    cfg = (OptimizationConfiguration.builder().candidateGenerator(gen)
+           .scoreFunction(lambda p: (p["x"] - 0.4) ** 2)
+           .terminationConditions(MaxCandidatesCondition(12))
+           .minimize(True).build())
+    runner = LocalOptimizationRunner(cfg)
+    runner.addListener(StatsStorageCandidateListener(storage))
+    runner.execute()
+    srv = ArbiterUIServer(storage).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/data") as r:
+            rows = json.loads(r.read())
+        assert len(rows) == 12
+        assert all("score" in r and "parameters" in r for r in rows)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/") as r:
+            html = r.read().decode()
+        assert "Arbiter" in html and "polyline" in html
+    finally:
+        srv.stop()
