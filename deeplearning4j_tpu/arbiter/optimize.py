@@ -357,7 +357,14 @@ class LocalOptimizationRunner:
             self.results.append(res)
             cfg.generator.report(cand, float(score))
             for li in self._listeners:
-                li.candidateScored(res)
+                try:
+                    li.candidateScored(res)
+                except Exception:   # noqa: BLE001
+                    # a MONITORING failure must never kill the search it
+                    # watches (same contract as ui/stats remote router)
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "arbiter listener failed", exc_info=True)
             better = best is None or (
                 res.score < best.score if cfg.minimize
                 else res.score > best.score)

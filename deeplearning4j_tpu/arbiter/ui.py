@@ -8,11 +8,14 @@ renders best-score-so-far plus the ranked candidate table.
 """
 from __future__ import annotations
 
+import html as _html
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from deeplearning4j_tpu.ui.server import _json_safe, _svg_score_chart
 from deeplearning4j_tpu.ui.stats import StatsStorage
 
 __all__ = ["ArbiterUIServer", "StatsStorageCandidateListener"]
@@ -52,34 +55,33 @@ class ArbiterUIServer:
 
     def _html(self) -> str:
         rows = self._rows()
+        # diverged candidates (NaN scores) must not blank the board
+        # monitoring exists to show — same contract as ui/server.py
         best = None
         curve = []
         for r in rows:
             s = r["score"]
+            if not math.isfinite(s):
+                continue
             if best is None or (s < best if self.minimize else s > best):
                 best = s
             curve.append(best)
-        ranked = sorted(rows, key=lambda r: r["score"],
+        finite = [r for r in rows if math.isfinite(r["score"])]
+        ranked = sorted(finite, key=lambda r: r["score"],
                         reverse=not self.minimize)[:50]
-        pts = ""
-        if curve:
-            w, h = 640, 200
-            lo, hi = min(curve), max(curve)
-            span = (hi - lo) or 1.0
-            pts = " ".join(
-                f"{int(i * w / max(len(curve) - 1, 1))},"
-                f"{int(h - (c - lo) / span * (h - 10)) - 5}"
-                for i, c in enumerate(curve))
+        # storage-sourced values render HTML-escaped (stored-XSS guard,
+        # like UIServer)
         trs = "".join(
-            f"<tr><td>{r['index']}</td><td>{r['score']:.6g}</td>"
-            f"<td><code>{json.dumps(r['parameters'])}</code></td></tr>"
+            f"<tr><td>{int(r['index'])}</td><td>{r['score']:.6g}</td>"
+            f"<td><code>{_html.escape(json.dumps(r['parameters']))}"
+            "</code></td></tr>"
             for r in ranked)
         return (
             "<html><head><title>Arbiter</title></head><body>"
-            f"<h2>Arbiter — {len(rows)} candidates, best "
+            f"<h2>Arbiter — {len(rows)} candidates "
+            f"({len(rows) - len(finite)} diverged), best "
             f"{best if best is not None else '—'}</h2>"
-            f"<svg width='640' height='200' style='border:1px solid #999'>"
-            f"<polyline fill='none' stroke='#06c' points='{pts}'/></svg>"
+            + _svg_score_chart(curve, 640, 200) +
             "<table border='1' cellpadding='4'><tr><th>#</th><th>score"
             f"</th><th>parameters</th></tr>{trs}</table></body></html>")
 
@@ -92,7 +94,7 @@ class ArbiterUIServer:
 
             def do_GET(self):
                 if self.path.startswith("/data"):
-                    body = json.dumps(srv._rows()).encode()
+                    body = json.dumps(_json_safe(srv._rows())).encode()
                     ctype = "application/json"
                 else:
                     body = srv._html().encode()

@@ -124,3 +124,54 @@ def test_arbiter_ui_board():
         assert "Arbiter" in html and "polyline" in html
     finally:
         srv.stop()
+
+
+def test_arbiter_ui_survives_nan_and_hostile_params():
+    """NaN scores must not blank the board or emit invalid JSON; params
+    render escaped; a crashing listener must not kill the search."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.arbiter import (ArbiterUIServer,
+                                            DiscreteParameterSpace,
+                                            LocalOptimizationRunner,
+                                            MaxCandidatesCondition,
+                                            OptimizationConfiguration,
+                                            RandomSearchGenerator,
+                                            StatsStorageCandidateListener)
+    from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+
+    storage = InMemoryStatsStorage()
+    gen = RandomSearchGenerator(
+        {"tag": DiscreteParameterSpace("<script>alert(1)</script>", "ok")},
+        seed=1)
+
+    def score(p):
+        return float("nan") if p["tag"] == "ok" else 1.0
+
+    class Crashy:
+        def candidateScored(self, result):
+            raise OSError("disk full")
+
+    cfg = (OptimizationConfiguration.builder().candidateGenerator(gen)
+           .scoreFunction(score)
+           .terminationConditions(MaxCandidatesCondition(8))
+           .minimize(True).build())
+    runner = LocalOptimizationRunner(cfg)
+    runner.addListener(StatsStorageCandidateListener(storage))
+    runner.addListener(Crashy())          # must not abort the search
+    best = runner.execute()
+    assert best is not None and runner.numCandidatesCompleted() == 8
+    srv = ArbiterUIServer(storage).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/") as r:
+            page = r.read().decode()
+        assert "<script>alert" not in page          # escaped
+        assert "diverged" in page
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/data") as r:
+            rows = json.loads(r.read())             # strict-parsable
+        assert any(r["score"] is None for r in rows)  # NaN -> null
+    finally:
+        srv.stop()
