@@ -265,10 +265,16 @@ def _slice(begin=(), size=(), **_):
 
 
 @register_op("stridedSlice")
-def _strided(begin=(), end=(), strides=None, **_):
+def _strided(begin=(), end=(), strides=None, axes=None, **_):
     def fn(x):
         st = strides or [1] * len(begin)
-        return x[tuple(slice(b, e, s) for b, e, s in zip(begin, end, st))]
+        ax = axes if axes is not None else list(range(len(begin)))
+        sl = [slice(None)] * x.ndim
+        for a, b, e, s_ in zip(ax, begin, end, st):
+            # ONNX-style INT64_MAX "to the end" sentinels clamp to the dim
+            e = min(int(e), x.shape[int(a)]) if int(e) >= 0 else int(e)
+            sl[int(a)] = slice(int(b), e, int(s_))
+        return x[tuple(sl)]
     return fn
 
 

@@ -439,3 +439,6 @@ class OnnxImporter:
 
 def importOnnxModel(path: str):
     return OnnxImporter.importModel(path)
+
+
+from deeplearning4j_tpu.imports import onnx_import_ext  # noqa: E402,F401  isort:skip

@@ -734,3 +734,127 @@ def test_env_flag_false_values():
         assert Environment().isDebug()
     finally:
         os.environ.pop("DL4J_TPU_DEBUG", None)
+
+
+class TestOnnxImportBreadth:
+    """Sprint-2 ONNX rule-table coverage (hand-encoded fixtures, NumPy
+    goldens — reuses TestOnnxImport's encoder helpers without inheriting
+    (and re-running) its tests)."""
+
+    _model = TestOnnxImport._model
+    _node = TestOnnxImport._node
+    _tensor = TestOnnxImport._tensor
+    _vinfo = TestOnnxImport._vinfo
+    _attr_i = TestOnnxImport._attr_i
+    _attr_f = TestOnnxImport._attr_f
+    _attr_ints = TestOnnxImport._attr_ints
+    _import = TestOnnxImport._import
+
+    def test_elementwise_and_clip(self):
+        rng = np.random.RandomState(10)
+        x = rng.randn(3, 4).astype(np.float32)
+        blob = self._model(
+            nodes=[
+                self._node("LeakyRelu", ["x"], ["l"],
+                           self._attr_f("alpha", 0.1)),
+                self._node("Clip", ["l"], ["c"],
+                           [self._attr_f("min", -0.3),
+                            self._attr_f("max", 0.6)]),
+                self._node("Floor", ["c"], ["f"]),
+                self._node("Sign", ["f"], ["y"]),
+            ],
+            inits=[], inputs=[self._vinfo("x", (3, 4))],
+            outputs=[self._vinfo("y", (3, 4))])
+        sd, ins, outs = self._import(blob)
+        got = sd.output({"x": x}, outs[0])[outs[0]].numpy()
+        want = np.sign(np.floor(np.clip(np.where(x > 0, x, 0.1 * x),
+                                        -0.3, 0.6)))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_reduce_and_where(self):
+        rng = np.random.RandomState(11)
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        y = rng.randn(3, 4, 5).astype(np.float32)
+        blob = self._model(
+            nodes=[
+                self._node("Greater", ["x", "y"], ["m"]),
+                self._node("Where", ["m", "x", "y"], ["w"]),
+                self._node("ReduceMean", ["w"], ["r"],
+                           [self._attr_ints("axes", [1]),
+                            self._attr_i("keepdims", 0)]),
+            ],
+            inits=[],
+            inputs=[self._vinfo("x", (3, 4, 5)),
+                    self._vinfo("y", (3, 4, 5))],
+            outputs=[self._vinfo("r", (3, 5))])
+        sd, ins, outs = self._import(blob)
+        got = sd.output({"x": x, "y": y}, outs[0])[outs[0]].numpy()
+        np.testing.assert_allclose(got, np.where(x > y, x, y).mean(1),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_slice_squeeze_unsqueeze_tile(self):
+        rng = np.random.RandomState(12)
+        x = rng.randn(4, 6).astype(np.float32)
+        blob = self._model(
+            nodes=[
+                self._node("Slice", ["x", "st", "en", "ax"], ["s"]),
+                self._node("Unsqueeze", ["s"], ["u"],
+                           self._attr_ints("axes", [0])),
+                self._node("Tile", ["u", "reps"], ["t"]),
+                self._node("Squeeze", ["t"], ["y"],
+                           self._attr_ints("axes", [0])),
+            ],
+            inits=[self._tensor("st", np.array([1], np.int64)),
+                   self._tensor("en", np.array([5], np.int64)),
+                   self._tensor("ax", np.array([1], np.int64)),
+                   self._tensor("reps", np.array([1, 2, 1], np.int64))],
+            inputs=[self._vinfo("x", (4, 6))],
+            outputs=[self._vinfo("y", (8, 4))])
+        sd, ins, outs = self._import(blob)
+        got = sd.output({"x": x}, outs[0])[outs[0]].numpy()
+        want = np.tile(x[:, 1:5][None], (1, 2, 1))[0]
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_layernorm_argmax_cast(self):
+        rng = np.random.RandomState(13)
+        x = rng.randn(4, 8).astype(np.float32)
+        g = rng.randn(8).astype(np.float32)
+        b = rng.randn(8).astype(np.float32)
+        blob = self._model(
+            nodes=[
+                self._node("LayerNormalization", ["x", "g", "b"], ["ln"],
+                           self._attr_f("epsilon", 1e-5)),
+                self._node("ArgMax", ["ln"], ["am"],
+                           [self._attr_i("axis", 1),
+                            self._attr_i("keepdims", 0)]),
+                self._node("Cast", ["am"], ["y"], self._attr_i("to", 1)),
+            ],
+            inits=[self._tensor("g", g), self._tensor("b", b)],
+            inputs=[self._vinfo("x", (4, 8))],
+            outputs=[self._vinfo("y", (4,))])
+        sd, ins, outs = self._import(blob)
+        got = sd.output({"x": x}, outs[0])[outs[0]].numpy()
+        mu = x.mean(-1, keepdims=True)
+        ln = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * g + b
+        np.testing.assert_allclose(got, ln.argmax(1).astype(np.float32))
+
+    def test_nary_minmax_mean_trilu(self):
+        rng = np.random.RandomState(14)
+        a = rng.randn(3, 3).astype(np.float32)
+        b = rng.randn(3, 3).astype(np.float32)
+        c = rng.randn(3, 3).astype(np.float32)
+        blob = self._model(
+            nodes=[
+                self._node("Max", ["a", "b", "c"], ["mx"]),
+                self._node("Mean", ["mx", "a"], ["mn"]),
+                self._node("Trilu", ["mn"], ["y"],
+                           self._attr_i("upper", 0)),
+            ],
+            inits=[],
+            inputs=[self._vinfo("a", (3, 3)), self._vinfo("b", (3, 3)),
+                    self._vinfo("c", (3, 3))],
+            outputs=[self._vinfo("y", (3, 3))])
+        sd, ins, outs = self._import(blob)
+        got = sd.output({"a": a, "b": b, "c": c}, outs[0])[outs[0]].numpy()
+        want = np.tril((np.maximum(np.maximum(a, b), c) + a) / 2)
+        np.testing.assert_allclose(got, want, atol=1e-5)
