@@ -271,9 +271,12 @@ def _strided(begin=(), end=(), strides=None, axes=None, **_):
         ax = axes if axes is not None else list(range(len(begin)))
         sl = [slice(None)] * x.ndim
         for a, b, e, s_ in zip(ax, begin, end, st):
-            # ONNX-style INT64_MAX "to the end" sentinels clamp to the dim
-            e = min(int(e), x.shape[int(a)]) if int(e) >= 0 else int(e)
-            sl[int(a)] = slice(int(b), e, int(s_))
+            # None = open end (TF mask semantics); non-negative ends clamp
+            # to the dim (ONNX INT64_MAX "to the end" sentinels)
+            if e is not None:
+                e = min(int(e), x.shape[int(a)]) if int(e) >= 0 else int(e)
+            b = None if b is None else int(b)
+            sl[int(a)] = slice(b, e, int(s_))
         return x[tuple(sl)]
     return fn
 
