@@ -980,3 +980,55 @@ def test_sprint4_merge_condition_index_ops():
                            "sigmoidCrossEntropy")]:
         assert OP_IMPLS[alias] is OP_IMPLS[target]
         OpValidation.recordTested(alias)
+
+
+def test_gradients_sprint34_families():
+    """Numeric-vs-analytic gradcheck for sprint-3/4 differentiable ops."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.autodiff.gradcheck import check_gradients
+    from deeplearning4j_tpu.autodiff.samediff import OP_IMPLS
+
+    rng = _R(80)
+
+    def check(name, build_loss, params):
+        r = check_gradients(build_loss, params)
+        assert r.passed, (name, r.failures[:3])
+
+    x1 = rng.randn(1, 2, 8) * 0.5
+    check("avgPooling1d",
+          lambda p: jnp.sum(jnp.sin(
+              OP_IMPLS["avgPooling1d"](k=3, s=2)(p["x"]))), {"x": x1})
+    check("maxPooling1d",
+          lambda p: jnp.sum(OP_IMPLS["maxPooling1d"](k=3, s=2)(p["x"])
+                            ** 2), {"x": x1})
+
+    xd = rng.randn(1, 2, 3, 3, 3) * 0.5
+    wd = rng.randn(2, 2, 2, 2, 2) * 0.5
+    check("deconv3d",
+          lambda p: jnp.sum(OP_IMPLS["deconv3d"](sD=2, sH=2, sW=2)(
+              p["x"], p["w"]) ** 2), {"x": xd, "w": wd})
+
+    xc = rng.randn(2, 5)
+    check("cumMax",
+          lambda p: jnp.sum(jnp.tanh(
+              OP_IMPLS["cumMax"](dims=1)(p["x"]))), {"x": xc})
+    check("clipByGlobalNorm",
+          lambda p: jnp.sum(OP_IMPLS["clipByGlobalNorm"](clipNorm=0.5)(
+              p["x"]) ** 2), {"x": xc})
+    check("mergeAvg",
+          lambda p: jnp.sum(jnp.sin(OP_IMPLS["mergeAvg"]()(
+              p["a"], p["b"], p["a"] * 2))),
+          {"a": rng.randn(3, 3) * 0.5, "b": rng.randn(3, 3) * 0.5})
+    check("replaceWhere",
+          lambda p: jnp.sum(OP_IMPLS["replaceWhere"](
+              condition="GT", value=0.0)(p["x"], p["y"]) ** 2),
+          {"x": rng.randn(3, 4) * 0.7, "y": rng.randn(3, 4) * 0.7})
+    check("xlogy",
+          lambda p: jnp.sum(OP_IMPLS["xlogy"]()(
+              jnp.abs(p["x"]) + 0.1, jnp.abs(p["y"]) + 0.1)),
+          {"x": rng.randn(3, 3), "y": rng.randn(3, 3)})
+    check("spaceToBatchND",
+          lambda p: jnp.sum(jnp.cos(OP_IMPLS["spaceToBatchND"](
+              blockShape=(2, 2))(p["x"]))),
+          {"x": rng.randn(2, 4, 4, 3) * 0.5})
