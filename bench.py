@@ -39,8 +39,8 @@ _TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.089e9
 def bench_bert(batch: int = 256, seq: int = 128, steps: int = 16):
     """BERT-base MLM train step (SameDiff graph path, bf16 compute) —
     BASELINE.json config #3.  Same chained-completion methodology.
-    Batch 256 measured round 3: 138.5k tok/s vs 71k at the old B=64
-    (throughput benchmark; batch is a tuning knob like ResNet's 256)."""
+    Driver-captured round 3 (BENCH_r03.json): 125,511 tok/s at B=256
+    (vs ~71k at the old B=64; batch is a tuning knob like ResNet's 256)."""
     from deeplearning4j_tpu.datasets.dataset import MultiDataSet
     from deeplearning4j_tpu.learning import Adam
     from deeplearning4j_tpu.zoo.bert import BertBase
