@@ -1,0 +1,896 @@
+"""Declarable-op breadth sprint 5: finishing the registry (405 -> 500+).
+
+Families the round-3 verdict probed absent (reference paths are the
+canonical-monorepo convention per SURVEY.md — the mount is empty):
+
+- recurrent variants: ``generic/nn/recurrent/{sru,sruCell,sru_bi,
+  lstmBlock,lstmBlockCell,dynamic_rnn,static_rnn,dynamic_bidirectional_rnn,
+  static_bidirectional_rnn}.cpp``
+- normalization: instance/group norm, renorm, fused_batch_norm
+- conv/pool: dilation2d, max_pool_with_argmax, pnormpool2d, pointwise conv
+- TF tensor_scatter_nd family, einsum, searchsorted/bucketize
+- losses: mean_pairwise_squared_error, log_poisson_loss
+- random: random_crop, alpha_dropout, random binomial
+- image: rgb<->yiq, image_resize dispatcher, draw_bounding_boxes,
+  non_max_suppression_overlaps, fake_quant_with_min_max_vars
+- tensor-list (TensorArray) ops as bounded functional semantics
+- t-SNE helpers (barnes_gains / barnes_edge_forces)
+- reference alias names registered as separate declarables upstream
+
+TPU-first notes: recurrences lower to ``lax.scan`` (compiler-friendly,
+no Python loop per step); compaction-style ops (choose, ctc decode)
+use the registry's bounded-dynamic-shape convention (pad + count, like
+``unique``/``listDiff``) because XLA requires static shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.samediff import (OP_IMPLS, _simple,
+                                                  register_op)
+
+# ---------------------------------------------------------------------------
+# recurrent variants (generic/nn/recurrent/*)
+# ---------------------------------------------------------------------------
+
+
+def _sru_step(xt, c, Wpack, b, nIn):
+    """One SRU step (Lei et al. 2017, reference sru.cpp): Wpack packs
+    [W | Wf | Wr] (nIn, 3*nIn); b packs [bf | br] (2*nIn)."""
+    z = xt @ Wpack
+    xh, f_in, r_in = z[..., :nIn], z[..., nIn:2 * nIn], z[..., 2 * nIn:]
+    f = jax.nn.sigmoid(f_in + b[:nIn])
+    r = jax.nn.sigmoid(r_in + b[nIn:])
+    c2 = f * c + (1.0 - f) * xh
+    h = r * jnp.tanh(c2) + (1.0 - r) * xt
+    return h, c2
+
+
+@register_op("sruCell")
+def _sru_cell(**_):
+    def f(xt, cLast, W, b):
+        h, c = _sru_step(xt, cLast, W, b, xt.shape[-1])
+        return [h, c]
+    return f
+
+
+@register_op("sru")
+def _sru(**_):
+    def f(x, W, b, c0, *mask):
+        # x: (t, b, nIn) time-major
+        nIn = x.shape[-1]
+
+        def stepfn(c, xt):
+            h, c2 = _sru_step(xt, c, W, b, nIn)
+            return c2, (h, c2)
+        # carry in x's dtype: gradcheck runs the graph in f64 while the
+        # stored init stays f32 — scan requires carry-in == carry-out
+        _, (hs, cs) = lax.scan(stepfn, c0.astype(x.dtype), x)
+        if mask:  # (t, b) — zero out padded steps
+            m = mask[0][..., None]
+            hs = hs * m
+        return [hs, cs]
+    return f
+
+
+@register_op("sruBI")
+def _sru_bi(**_):
+    def f(x, W, b, c0, *mask):
+        # W: (nIn, 6*nIn) fw|bw halves; b: (4*nIn); c0: (2, b, nIn)
+        nIn = x.shape[-1]
+        fw = _sru()(x, W[:, :3 * nIn], b[:2 * nIn], c0[0], *mask)
+        bwm = [jnp.flip(mask[0], 0)] if mask else []
+        bw = _sru()(jnp.flip(x, 0), W[:, 3 * nIn:], b[2 * nIn:], c0[1], *bwm)
+        hs = jnp.concatenate([fw[0], jnp.flip(bw[0], 0)], axis=-1)
+        cs = jnp.concatenate([fw[1], jnp.flip(bw[1], 0)], axis=-1)
+        return [hs, cs]
+    return f
+
+
+def _lstm_block_gates(xt, h, c, W, Wci, Wcf, Wco, b, forgetBias, peephole):
+    """TF BlockLSTMCell gate math (reference lstmBlockCell.cpp)."""
+    z = jnp.concatenate([xt, h], axis=-1) @ W + b
+    i_in, g_in, f_in, o_in = jnp.split(z, 4, axis=-1)
+    if peephole:
+        i = jax.nn.sigmoid(i_in + c * Wci)
+        f = jax.nn.sigmoid(f_in + forgetBias + c * Wcf)
+    else:
+        i = jax.nn.sigmoid(i_in)
+        f = jax.nn.sigmoid(f_in + forgetBias)
+    g = jnp.tanh(g_in)
+    c2 = f * c + i * g
+    o = jax.nn.sigmoid(o_in + (c2 * Wco if peephole else 0.0))
+    h2 = o * jnp.tanh(c2)
+    return i, c2, f, o, g, h2
+
+
+@register_op("lstmBlockCell")
+def _lstm_block_cell(forgetBias=1.0, peephole=False, **_):
+    def f(xt, cLast, hLast, W, Wci, Wcf, Wco, b):
+        i, c2, fg, o, g, h2 = _lstm_block_gates(
+            xt, hLast, cLast, W, Wci, Wcf, Wco, b, forgetBias, peephole)
+        # reference output order: [i, c, f, o, z(g), h(cell out), y(h)]
+        return [i, c2, fg, o, g, jnp.tanh(c2), h2]
+    return f
+
+
+@register_op("lstmBlock")
+def _lstm_block(forgetBias=1.0, peephole=False, **_):
+    def f(x, cLast, hLast, W, Wci, Wcf, Wco, b):
+        def stepfn(carry, xt):
+            h, c = carry
+            i, c2, fg, o, g, h2 = _lstm_block_gates(
+                xt, h, c, W, Wci, Wcf, Wco, b, forgetBias, peephole)
+            return (h2, c2), (i, c2, fg, o, g, jnp.tanh(c2), h2)
+        init = (hLast.astype(x.dtype), cLast.astype(x.dtype))
+        _, outs = lax.scan(stepfn, init, x)
+        return list(outs)
+    return f
+
+
+def _rnn_scan(x, Wx, Wh, b, h0):
+    def stepfn(h, xt):
+        h2 = jnp.tanh(xt @ Wx + h @ Wh + b)
+        return h2, h2
+    hT, hs = lax.scan(stepfn, h0, x)
+    return hs, hT
+
+
+@register_op("dynamicRnn")
+def _dynamic_rnn(**_):
+    def f(x, Wx, Wh, b, h0):
+        hs, hT = _rnn_scan(x, Wx, Wh, b, h0)
+        return [hs, hT]
+    return f
+
+
+@register_op("dynamicBidirectionalRnn")
+def _dynamic_bi_rnn(**_):
+    def f(x, WxF, WhF, bF, h0F, WxB, WhB, bB, h0B):
+        hsF, hTF = _rnn_scan(x, WxF, WhF, bF, h0F)
+        hsB, hTB = _rnn_scan(jnp.flip(x, 0), WxB, WhB, bB, h0B)
+        return [hsF, jnp.flip(hsB, 0), hTF, hTB]
+    return f
+
+
+# static_rnn/static_bidirectional_rnn: the reference's "static" variants
+# unroll at graph build; under XLA both forms compile to the same scan.
+OP_IMPLS["staticRnn"] = OP_IMPLS["dynamicRnn"]
+OP_IMPLS["staticBidirectionalRnn"] = OP_IMPLS["dynamicBidirectionalRnn"]
+
+
+# ---------------------------------------------------------------------------
+# normalization (generic/nn/{fusedBatchNorm,...}.cpp; torch-style renorm)
+# ---------------------------------------------------------------------------
+@register_op("instanceNorm")
+def _instance_norm(epsilon=1e-5, **_):
+    def f(x, gamma, beta):
+        # x: (b, c, *spatial) — normalize each (b, c) over spatial dims
+        ax = tuple(range(2, x.ndim))
+        mu = jnp.mean(x, axis=ax, keepdims=True)
+        var = jnp.var(x, axis=ax, keepdims=True)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mu) * lax.rsqrt(var + epsilon)
+                * gamma.reshape(shape) + beta.reshape(shape))
+    return f
+
+
+@register_op("groupNorm")
+def _group_norm(numGroups=2, epsilon=1e-5, **_):
+    def f(x, gamma, beta):
+        b, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        g = x.reshape((b, numGroups, c // numGroups) + spatial)
+        ax = tuple(range(2, g.ndim))
+        mu = jnp.mean(g, axis=ax, keepdims=True)
+        var = jnp.var(g, axis=ax, keepdims=True)
+        g = (g - mu) * lax.rsqrt(var + epsilon)
+        shape = (1, -1) + (1,) * len(spatial)
+        return g.reshape(x.shape) * gamma.reshape(shape) + beta.reshape(shape)
+    return f
+
+
+@register_op("renorm")
+def _renorm(p=2.0, dim=0, maxnorm=1.0, **_):
+    def f(x):
+        ax = tuple(i for i in range(x.ndim) if i != dim)
+        n = jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(n > maxnorm, maxnorm / jnp.maximum(n, 1e-12), 1.0)
+        return x * scale
+    return f
+
+
+@register_op("fusedBatchNorm")
+def _fused_batch_norm(epsilon=1e-3, dataFormat="NHWC", isTraining=True, **_):
+    def f(x, scale, offset, *running):
+        cax = 3 if dataFormat == "NHWC" else 1
+        ax = tuple(i for i in range(x.ndim) if i != cax)
+        if isTraining or not running:
+            mu = jnp.mean(x, axis=ax)
+            var = jnp.var(x, axis=ax)
+        else:
+            mu, var = running
+        shape = tuple(-1 if i == cax else 1 for i in range(x.ndim))
+        y = ((x - mu.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+             * scale.reshape(shape) + offset.reshape(shape))
+        return [y, mu, var]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# conv / pool extras
+# ---------------------------------------------------------------------------
+@register_op("dilation2d")
+def _dilation2d(strides=(1, 1), rates=(1, 1), isSameMode=True, **_):
+    sh, sw = (strides[1], strides[2]) if len(strides) == 4 else strides
+    rh, rw = (rates[1], rates[2]) if len(rates) == 4 else rates
+
+    def f(x, w):
+        # x: (b, h, w, c) NHWC, w: (kh, kw, c) — morphological dilation:
+        # out = max_{ij}(patch + w).  Kernel taps unroll statically (small
+        # kh*kw), each tap an XLA slice — no gather, MXU-free VPU max tree.
+        kh, kw, _ = w.shape
+        eh, ew = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        if isSameMode:
+            oh = -(-x.shape[1] // sh)
+            ow = -(-x.shape[2] // sw)
+            ph = max((oh - 1) * sh + eh - x.shape[1], 0)
+            pw = max((ow - 1) * sw + ew - x.shape[2], 0)
+            x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                            (pw // 2, pw - pw // 2), (0, 0)),
+                        constant_values=-jnp.inf)
+        else:
+            oh = (x.shape[1] - eh) // sh + 1
+            ow = (x.shape[2] - ew) // sw + 1
+        out = None
+        for i in range(kh):
+            for j in range(kw):
+                tap = x[:, i * rh:i * rh + (oh - 1) * sh + 1:sh,
+                        j * rw:j * rw + (ow - 1) * sw + 1:sw, :] + w[i, j]
+                out = tap if out is None else jnp.maximum(out, tap)
+        return out
+    return f
+
+
+@register_op("maxPoolWithArgmax")
+def _max_pool_with_argmax(kH=2, kW=2, sH=2, sW=2, isSameMode=False, **_):
+    def f(x):
+        # x: (b, h, w, c) NHWC; argmax indices are TF-convention flattened
+        # (h*w*c) positions.  Window taps unroll statically; the argmax is
+        # reconstructed arithmetically from the winning tap id (no index
+        # tensor through the pooling — avoids f32 precision limits).
+        b, h, w, c = x.shape
+        if isSameMode:
+            oh, ow = -(-h // sH), -(-w // sW)
+            ph = max((oh - 1) * sH + kH - h, 0)
+            pw = max((ow - 1) * sW + kW - w, 0)
+            pt, pl = ph // 2, pw // 2
+            xp = jnp.pad(x, ((0, 0), (pt, ph - pt), (pl, pw - pl), (0, 0)),
+                         constant_values=-jnp.inf)
+        else:
+            oh, ow = (h - kH) // sH + 1, (w - kW) // sW + 1
+            pt = pl = 0
+            xp = x
+        best = None
+        best_tap = None
+        for i in range(kH):
+            for j in range(kW):
+                tap = xp[:, i:i + (oh - 1) * sH + 1:sH,
+                         j:j + (ow - 1) * sW + 1:sW, :]
+                tid = i * kW + j
+                if best is None:
+                    best, best_tap = tap, jnp.full(tap.shape, tid, jnp.int32)
+                else:
+                    take = tap > best
+                    best = jnp.where(take, tap, best)
+                    best_tap = jnp.where(take, tid, best_tap)
+        ki = best_tap // kW
+        kj = best_tap % kW
+        rows = (jnp.arange(oh)[None, :, None, None] * sH - pt) + ki
+        cols = (jnp.arange(ow)[None, None, :, None] * sW - pl) + kj
+        chan = jnp.arange(c)[None, None, None, :]
+        idx = (rows * w + cols) * c + chan
+        return [best, idx.astype(jnp.int64)]
+    return f
+
+
+@register_op("pnormPool2d")
+def _pnorm_pool2d(kH=2, kW=2, sH=2, sW=2, pnorm=2, **_):
+    def f(x):
+        # x: (b, c, h, w) NCHW (DL4J PnormLayer convention)
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
+                              (1, 1, kH, kW), (1, 1, sH, sW), "VALID")
+        return s ** (1.0 / p)
+    return f
+
+
+@register_op("pointwiseConv2d")
+def _pointwise_conv2d(**_):
+    def f(x, w, *b):
+        # x: (b, h, w, cIn), w: (1, 1, cIn, cOut) or (cIn, cOut)
+        wm = w.reshape(w.shape[-2], w.shape[-1])
+        y = jnp.einsum("bhwc,cd->bhwd", x, wm)
+        return y + b[0] if b else y
+    return f
+
+
+# ---------------------------------------------------------------------------
+# TF tensor_scatter_nd_* (indices (..., K) into the first K dims)
+# ---------------------------------------------------------------------------
+def _tensor_scatter(mode):
+    def factory(**_):
+        def f(x, indices, updates):
+            idx = tuple(jnp.moveaxis(indices, -1, 0).astype(jnp.int32))
+            at = x.at[idx]
+            return getattr(at, mode)(updates)
+        return f
+    return factory
+
+
+# add/sub/update share the existing scatterNd* lowerings (identical
+# (ref, idx, upd) semantics — one copy to maintain); max/min are new
+OP_IMPLS["tensorScatterAdd"] = OP_IMPLS["scatterNdAdd"]
+OP_IMPLS["tensorScatterSub"] = OP_IMPLS["scatterNdSub"]
+OP_IMPLS["tensorScatterUpdate"] = OP_IMPLS["scatterNdUpdate"]
+OP_IMPLS["tensorScatterMax"] = _tensor_scatter("max")
+OP_IMPLS["tensorScatterMin"] = _tensor_scatter("min")
+
+
+# ---------------------------------------------------------------------------
+# einsum / searchsorted / bucketize / shape utilities
+# ---------------------------------------------------------------------------
+@register_op("einsum")
+def _einsum(equation="", **_):
+    return lambda *xs: jnp.einsum(equation, *xs)
+
+
+@register_op("searchsorted")
+def _searchsorted(right=False, **_):
+    side = "right" if right else "left"
+
+    def f(sorted_seq, values):
+        if sorted_seq.ndim == 1:
+            return jnp.searchsorted(sorted_seq, values,
+                                    side=side).astype(jnp.int32)
+        # batched: leading dims match; vmap the innermost search
+        fn = jnp.vectorize(
+            lambda s, v: jnp.searchsorted(s, v, side=side),
+            signature="(n),(m)->(m)")
+        return fn(sorted_seq, values).astype(jnp.int32)
+    return f
+
+
+@register_op("bucketize")
+def _bucketize(boundaries=(), **_):
+    bs = tuple(float(b) for b in boundaries)
+
+    def f(x):
+        out = jnp.zeros(x.shape, jnp.int32)
+        for b in bs:  # static, small
+            out = out + (x >= b).astype(jnp.int32)
+        return out
+    return f
+
+
+@register_op("unravelIndex")
+def _unravel_index(**_):
+    def f(indices, shape):
+        # shape must be a constant array in-graph (static semantics)
+        dims = tuple(int(s) for s in np.asarray(shape))
+        return jnp.stack(jnp.unravel_index(indices, dims),
+                         axis=-1).astype(jnp.int32)
+    return f
+
+
+@register_op("sparseToDense")
+def _sparse_to_dense(defaultValue=0.0, **_):
+    def f(indices, shape, values):
+        dims = tuple(int(s) for s in np.asarray(shape))
+        out = jnp.full(dims, jnp.asarray(defaultValue, values.dtype))
+        idx = tuple(jnp.moveaxis(indices, -1, 0).astype(jnp.int32))
+        return out.at[idx].set(values)
+    return f
+
+
+@register_op("broadcastDynamicShape")
+def _broadcast_dynamic_shape(**_):
+    def f(a, b):
+        n = max(a.shape[0], b.shape[0])
+        pa = jnp.concatenate([jnp.ones(n - a.shape[0], a.dtype), a])
+        pb = jnp.concatenate([jnp.ones(n - b.shape[0], b.dtype), b])
+        return jnp.where(pa == 1, pb, pa)
+    return f
+
+
+@register_op("reshapeAs")
+def _reshape_as(**_):
+    return lambda x, y: x.reshape(y.shape)
+
+
+@register_op("shapeN")
+def _shape_n(**_):
+    def f(*xs):
+        return [jnp.asarray(x.shape, jnp.int64) for x in xs]
+    return f
+
+
+@register_op("splitV")
+def _split_v(sizes=(), axis=0, **_):
+    sz = tuple(int(s) for s in sizes)
+
+    def f(x):
+        offs = np.cumsum((0,) + sz)
+        return [lax.slice_in_dim(x, int(offs[i]), int(offs[i + 1]),
+                                 axis=axis) for i in range(len(sz))]
+    return f
+
+
+_simple("parallelStack", lambda *xs: jnp.stack(xs, axis=0))
+
+
+@register_op("tear")
+def _tear(dimension=0, **_):
+    def f(x):
+        return [jnp.squeeze(s, axis=dimension)
+                for s in jnp.split(x, x.shape[dimension], axis=dimension)]
+    return f
+
+
+@register_op("choose")
+def _choose(mode="GT", scalar=0.0, **_):
+    # bounded-dynamic-shape semantics (cf. unique/listDiff): returns the
+    # selected values front-packed with zero padding, plus the count.
+    from deeplearning4j_tpu.autodiff.ops_ext4 import _cond
+
+    def f(x):
+        flat = x.reshape(-1)
+        keep = _cond(mode, scalar)(flat)
+        order = jnp.argsort(~keep, stable=True)
+        packed = jnp.where(jnp.arange(flat.size) < jnp.sum(keep),
+                           flat[order], 0)
+        return [packed, jnp.sum(keep).astype(jnp.int64)]
+    return f
+
+
+_simple("truncateDiv", lambda x, y: jnp.trunc(x / y))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+@register_op("meanPairwiseSquaredError")
+def _mpse(**_):
+    def f(predictions, labels, *w):
+        # TF mean_pairwise_squared_error: per sample over the last axis,
+        # sum_{i,j}(d_i-d_j)^2 = 2n*sum(d^2) - 2*(sum d)^2; normalized by
+        # n(n-1); weights are per-sample.
+        d = (predictions - labels).reshape(predictions.shape[0], -1)
+        n = d.shape[1]
+        per = (2.0 * (n * jnp.sum(d * d, -1) - jnp.sum(d, -1) ** 2)
+               / max(n * (n - 1), 1))
+        if w:
+            ww = w[0].reshape(-1)
+            return jnp.sum(per * ww) / jnp.maximum(
+                jnp.sum((ww != 0).astype(per.dtype)), 1.0)
+        return jnp.mean(per)
+    return f
+
+
+@register_op("logPoissonLoss")
+def _log_poisson(full=False, **_):
+    def f(logPredictions, labels, *w):
+        per = jnp.exp(logPredictions) - labels * logPredictions
+        if full:  # + Stirling approx of log(labels!), zeroed for t in
+            # [0, 1] where log(t!) = 0 exactly (TF convention)
+            stirling = (labels * jnp.log(jnp.maximum(labels, 1e-8))
+                        - labels
+                        + 0.5 * jnp.log(2.0 * np.pi
+                                        * jnp.maximum(labels, 1.0)))
+            per = per + jnp.where((labels >= 0) & (labels <= 1),
+                                  0.0, stirling)
+        if w:
+            per = per * w[0]
+        return jnp.mean(per)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# random extras
+# ---------------------------------------------------------------------------
+@register_op("randomCrop")
+def _random_crop(shape=(), seed=0, **_):
+    tgt = tuple(int(s) for s in shape)
+
+    def f(x):
+        key = jax.random.PRNGKey(seed)
+        starts = []
+        for i, (full, want) in enumerate(zip(x.shape, tgt)):
+            key, sub = jax.random.split(key)
+            starts.append(jax.random.randint(sub, (), 0, full - want + 1))
+        return lax.dynamic_slice(x, starts, tgt)
+    return f
+
+
+@register_op("alphaDropout")
+def _alpha_dropout(p=0.05, seed=0, **_):
+    # SELU-consistent dropout (Klambauer et al.): dropped units go to
+    # alpha' = -lambda*alpha; affine correction keeps mean/variance.
+    alpha_p = -1.7580993408473766
+
+    def f(x):
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(jax.random.PRNGKey(seed), keep, x.shape)
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        return a * jnp.where(mask, x, alpha_p) + b
+    return f
+
+
+@register_op("randomBinomial")
+def _random_binomial(trials=1, prob=0.5, shape=(), seed=0, **_):
+    def f():
+        return jax.random.binomial(jax.random.PRNGKey(seed), trials, prob,
+                                   tuple(shape)).astype(jnp.float32)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# image extras
+# ---------------------------------------------------------------------------
+_YIQ = np.array([[0.299, 0.587, 0.114],
+                 [0.5959, -0.2746, -0.3213],
+                 [0.2115, -0.5227, 0.3112]], np.float32)
+
+
+_simple("rgbToYiq", lambda x: x @ _YIQ.T)
+_simple("yiqToRgb", lambda x: x @ np.linalg.inv(_YIQ).T.astype(np.float32))
+
+
+@register_op("imageResize")
+def _image_resize(height=0, width=0, method="bilinear", **_):
+    table = {"bilinear": "linear", "bicubic": "cubic",
+             "nearest": "nearest",
+             "lanczos3": "lanczos3", "lanczos5": "lanczos5"}
+
+    def f(x):
+        h, w = int(height), int(width)
+        if str(method) == "area":
+            # true area averaging for integer downsample factors (the
+            # common case — TF's area kernel); non-integer ratios fall
+            # back to linear, which only approximates area weighting
+            ih, iw = x.shape[1], x.shape[2]
+            if ih % h == 0 and iw % w == 0 and ih >= h and iw >= w:
+                fh, fw = ih // h, iw // w
+                s = lax.reduce_window(x, 0.0, lax.add,
+                                      (1, fh, fw, 1), (1, fh, fw, 1),
+                                      "VALID")
+                return s / (fh * fw)
+            meth = "linear"
+        else:
+            meth = table[str(method)]
+        return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), meth)
+    return f
+
+
+@register_op("drawBoundingBoxes")
+def _draw_bounding_boxes(**_):
+    def f(images, boxes, colors):
+        # images (b,h,w,c), boxes (b,n,4) [ymin,xmin,ymax,xmax] normalized,
+        # colors (m,c).  n is static — unrolled mask per box.
+        b, h, w, c = images.shape
+        ys = jnp.arange(h, dtype=jnp.float32)[None, :, None] / max(h - 1, 1)
+        xs = jnp.arange(w, dtype=jnp.float32)[None, None, :] / max(w - 1, 1)
+        out = images
+        n = boxes.shape[1]
+        for i in range(n):
+            y0, x0, y1, x1 = (boxes[:, i, 0][:, None, None],
+                              boxes[:, i, 1][:, None, None],
+                              boxes[:, i, 2][:, None, None],
+                              boxes[:, i, 3][:, None, None])
+            inside = ((ys >= y0) & (ys <= y1) & (xs >= x0) & (xs <= x1))
+            t = 1.5 / max(h - 1, 1)
+            tx = 1.5 / max(w - 1, 1)
+            interior = ((ys >= y0 + t) & (ys <= y1 - t)
+                        & (xs >= x0 + tx) & (xs <= x1 - tx))
+            border = (inside & ~interior)[..., None]
+            color = colors[i % colors.shape[0]].reshape(1, 1, 1, c)
+            out = jnp.where(border, color, out)
+        return out
+    return f
+
+
+@register_op("nonMaxSuppressionOverlaps")
+def _nms_overlaps(maxOutputSize=10, overlapThreshold=0.5,
+                  scoreThreshold=-jnp.inf, **_):
+    def f(overlaps, scores):
+        n = scores.shape[0]
+        valid = scores > scoreThreshold
+
+        def body(banned, _):
+            masked = jnp.where(~banned, scores, -jnp.inf)
+            best = jnp.argmax(masked)
+            ok = masked[best] > -jnp.inf
+            banned = banned | (overlaps[best] > overlapThreshold) \
+                | (jnp.arange(n) == best)
+            return banned, jnp.where(ok, best, -1)
+
+        _, picks = lax.scan(body, ~valid, None,
+                            length=int(maxOutputSize))
+        return picks.astype(jnp.int32)
+    return f
+
+
+def _fake_quant(x, mn, mx, numBits, narrowRange):
+    qmin = 1.0 if narrowRange else 0.0
+    qmax = float(2 ** numBits - 1)
+    scale = (mx - mn) / (qmax - qmin)
+    zero = qmin - mn / scale
+    nudged_zero = jnp.clip(jnp.round(zero), qmin, qmax)
+    nudged_min = (qmin - nudged_zero) * scale
+    nudged_max = (qmax - nudged_zero) * scale
+    clamped = jnp.clip(x, nudged_min, nudged_max)
+    return (jnp.round((clamped - nudged_min) / scale) * scale + nudged_min)
+
+
+@register_op("fakeQuantWithMinMaxVars")
+def _fake_quant_op(numBits=8, narrowRange=False, **_):
+    return lambda x, mn, mx: _fake_quant(x, mn, mx, numBits, narrowRange)
+
+
+@register_op("fakeQuantWithMinMaxVarsPerChannel")
+def _fake_quant_pc(numBits=8, narrowRange=False, **_):
+    # min/max per last-dim channel — broadcast against x
+    return lambda x, mn, mx: _fake_quant(x, mn, mx, numBits, narrowRange)
+
+
+# ---------------------------------------------------------------------------
+# math / linalg extras
+# ---------------------------------------------------------------------------
+@register_op("axpy")
+def _axpy(alpha=1.0, **_):
+    return lambda x, y: alpha * x + y
+
+
+@register_op("norm")
+def _norm_op(p=2.0, dims=None, **_):
+    ax = tuple(dims) if dims is not None else None
+
+    def f(x):
+        if p == np.inf:
+            return jnp.max(jnp.abs(x), axis=ax)
+        if p == 1.0:
+            return jnp.sum(jnp.abs(x), axis=ax)
+        return jnp.sum(jnp.abs(x) ** p, axis=ax) ** (1.0 / p)
+    return f
+
+
+@register_op("bitcast")
+def _bitcast(dtype="int32", **_):
+    def f(x):
+        return lax.bitcast_convert_type(x, jnp.dtype(dtype))
+    return f
+
+
+@register_op("diagPart")
+def _diag_part(**_):
+    return lambda x: jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+@register_op("stabilize")
+def _stabilize(realMin=1e-5, **_):
+    def f(x):
+        return jnp.where(jnp.abs(x) < realMin,
+                         jnp.sign(x) * realMin + (x == 0) * realMin, x)
+    return f
+
+
+@register_op("hashCode")
+def _hash_code(**_):
+    def f(x):
+        # Java Arrays.hashCode-style polynomial over the exact bit
+        # pattern of x's own dtype (no lossy cast: f32->i32 bitcast,
+        # f64->i64 bitcast, integers widen losslessly)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            target = jnp.int32 if x.dtype.itemsize <= 4 else jnp.int64
+            bits = lax.bitcast_convert_type(x, target)
+        else:
+            bits = x
+        bits = bits.reshape(-1).astype(jnp.int64)
+
+        def body(h, v):
+            return h * jnp.int64(31) + v, None
+        h, _ = lax.scan(body, jnp.int64(1), bits)
+        return h
+    return f
+
+
+@register_op("biasAdd")
+def _bias_add(nchw=False, **_):
+    def f(x, b):
+        if nchw:
+            return x + b.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return x + b
+    return f
+
+
+@register_op("xwPlusB")
+def _xw_plus_b(transposeW=False, **_):
+    def f(x, w, b):
+        return x @ (w.T if transposeW else w) + b
+    return f
+
+
+# ---------------------------------------------------------------------------
+# debug ops
+# ---------------------------------------------------------------------------
+@register_op("printVariable")
+def _print_variable(message="", **_):
+    def f(x):
+        # message via a field, not spliced into the format string — a
+        # user '{' would otherwise crash str.format at trace time
+        jax.debug.print("{m}{x}", m=message, x=x)
+        return x
+    return f
+
+
+@register_op("Assert")
+def _assert_op(message="assertion failed", **_):
+    def f(cond):
+        # Host-side assertion is impossible inside a compiled XLA program;
+        # the reference executes Assert on the host executor.  Here it
+        # reports via debug callback and passes the condition through
+        # (checkNumerics covers the NaN/Inf panic path in-graph).
+        jax.debug.print("Assert: {ok} ({m})", ok=jnp.all(cond != 0),
+                        m=message)
+        return cond
+    return f
+
+
+# ---------------------------------------------------------------------------
+# dtype cast family (reference registers each as its own declarable)
+# ---------------------------------------------------------------------------
+for _name, _dt in [("toDouble", jnp.float64), ("toFloat16", jnp.float16),
+                   ("toFloat32", jnp.float32), ("toInt32", jnp.int32),
+                   ("toInt64", jnp.int64), ("toUint32", jnp.uint32),
+                   ("toUint64", jnp.uint64)]:
+    _simple(_name, (lambda dt: lambda x: x.astype(dt))(_dt))
+
+
+# ---------------------------------------------------------------------------
+# tensor-list (TensorArray) ops — bounded functional semantics: a "list"
+# is a stacked leading axis (reference: libnd4j list ops family; here the
+# stacked form IS the canonical representation, which keeps shapes static
+# for XLA).
+# ---------------------------------------------------------------------------
+_simple("stackList", lambda x: x)
+_simple("cloneList", lambda x: x)
+
+
+@register_op("unstackList")
+def _unstack_list(**_):
+    return lambda x: [x[i] for i in range(x.shape[0])]
+
+
+@register_op("readList")
+def _read_list(index=0, **_):
+    return lambda x: x[int(index)]
+
+
+@register_op("writeList")
+def _write_list(index=0, **_):
+    return lambda x, v: x.at[int(index)].set(v)
+
+
+@register_op("gatherList")
+def _gather_list(**_):
+    return lambda x, idx: jnp.take(x, idx.astype(jnp.int32), axis=0)
+
+
+@register_op("scatterList")
+def _scatter_list(**_):
+    def f(indices, values, shape0):
+        n = int(np.asarray(shape0))
+        out = jnp.zeros((n,) + values.shape[1:], values.dtype)
+        return out.at[indices.astype(jnp.int32)].set(values)
+    return f
+
+
+@register_op("sizeList")
+def _size_list(**_):
+    return lambda x: jnp.asarray(x.shape[0], jnp.int64)
+
+
+@register_op("splitList")
+def _split_list(sizes=(), **_):
+    sz = tuple(int(s) for s in sizes)
+
+    def f(x):
+        offs = np.cumsum((0,) + sz)
+        return [x[int(offs[i]):int(offs[i + 1])] for i in range(len(sz))]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# t-SNE helpers (reference: generic/parity_ops/barnes_*.cpp — used by
+# deeplearning4j-nearestneighbors' BarnesHutTsne)
+# ---------------------------------------------------------------------------
+@register_op("barnesGains")
+def _barnes_gains(**_):
+    def f(gains, gradient, yIncs):
+        same = jnp.sign(gradient) == jnp.sign(yIncs)
+        return jnp.maximum(jnp.where(same, gains * 0.8, gains + 0.2), 0.01)
+    return f
+
+
+@register_op("barnesEdgeForces")
+def _barnes_edge_forces(**_):
+    def f(rowP, colP, valP, y):
+        # CSR edges: rowP offsets (n+1,), colP targets (nnz,), valP (nnz,)
+        nnz = colP.shape[0]
+        rows = jnp.searchsorted(rowP.astype(jnp.int32),
+                                jnp.arange(nnz, dtype=jnp.int32),
+                                side="right") - 1
+        diff = y[rows] - y[colP.astype(jnp.int32)]
+        q = valP / (1.0 + jnp.sum(diff * diff, axis=-1))
+        forces = q[:, None] * diff
+        return jax.ops.segment_sum(forces, rows, num_segments=y.shape[0])
+    return f
+
+
+# ---------------------------------------------------------------------------
+# CTC greedy decoder (bounded semantics: decoded padded with -1)
+# ---------------------------------------------------------------------------
+@register_op("ctcGreedyDecoder")
+def _ctc_greedy(blankIndex=0, mergeRepeated=True, **_):
+    def f(logits):
+        # logits (b, t, c) -> [decoded (b, t) padded -1, lengths (b,)]
+        path = jnp.argmax(logits, axis=-1)
+        if mergeRepeated:
+            prev = jnp.concatenate(
+                [jnp.full_like(path[:, :1], -1), path[:, :-1]], axis=1)
+            keep = (path != blankIndex) & (path != prev)
+        else:
+            keep = path != blankIndex
+        t = path.shape[1]
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        packed = jnp.take_along_axis(path, order, axis=1)
+        counts = jnp.sum(keep, axis=1)
+        packed = jnp.where(jnp.arange(t)[None, :] < counts[:, None],
+                           packed, -1)
+        return [packed.astype(jnp.int32), counts.astype(jnp.int32)]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# reference alias names: the reference registers these as their own
+# declarables (alternate-name op classes); they share lowerings here.
+# ---------------------------------------------------------------------------
+for _alias, _base in [
+    ("randomGamma", "random_gamma"), ("randomPoisson", "random_poisson"),
+    ("randomExponential", "random_exponential"),
+    ("multinomial", "random_multinomial"),
+    ("randomShuffle", "random_shuffle"),
+    ("weightedCrossEntropy", "weightedCrossEntropyWithLogits"),
+    ("matmul", "mmul"), ("tensordot", "tensorMmul"),
+    ("minimum", "min_pairwise"), ("maximum", "max_pairwise"),
+    ("lrelu", "leakyRelu"), ("realDiv", "div"), ("mergeSum", "mergeAdd"),
+    ("adjustContrastV2", "adjustContrast"),
+    ("subtract", "sub"), ("multiply", "mul"), ("divide", "div"),
+    ("onesAs", "onesLike"), ("zerosAs", "zerosLike"),
+]:
+    OP_IMPLS[_alias] = OP_IMPLS[_base]
+
+
+@register_op("create")
+def _create(shape=(), dtype="float32", initValue=0.0, **_):
+    def f():
+        return jnp.full(tuple(int(s) for s in shape), initValue,
+                        jnp.dtype(dtype))
+    return f
+
+
+_simple("noOp", lambda *xs: xs[0] if xs else jnp.zeros(()))

@@ -1897,3 +1897,4 @@ from deeplearning4j_tpu.autodiff import ops_ext  # noqa: E402,F401  isort:skip
 from deeplearning4j_tpu.autodiff import ops_ext2  # noqa: E402,F401  isort:skip
 from deeplearning4j_tpu.autodiff import ops_ext3  # noqa: E402,F401  isort:skip
 from deeplearning4j_tpu.autodiff import ops_ext4  # noqa: E402,F401  isort:skip
+from deeplearning4j_tpu.autodiff import ops_ext5  # noqa: E402,F401  isort:skip

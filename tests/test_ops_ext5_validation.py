@@ -1,0 +1,803 @@
+"""Validation battery for the sprint-5 op families (ops_ext5).
+
+Same pattern as the earlier batteries (reference: nd4j OpValidation
+suites, SURVEY.md §4): golden-output TestCase per op with numpy/scipy/
+torch oracles; recurrent ops check against step-by-step numpy loops;
+bounded-dynamic-shape ops (choose, ctcGreedyDecoder) check pad+count
+semantics; gradient checks on representative differentiable ops.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.validation import OpValidation, TestCase
+
+_R = np.random.RandomState
+
+
+def _validate(build, expected, placeholders=None, tol=1e-4):
+    sd = SameDiff.create()
+    out = build(sd)
+    tc = TestCase(sd).expectedOutput(out, np.asarray(expected))
+    tc.expectedPrecision(tol)
+    for k, v in (placeholders or {}).items():
+        tc._placeholders[k] = np.asarray(v)
+    err = OpValidation.validate(tc)
+    assert err is None, err
+
+
+def _run(build, placeholders=None):
+    sd = SameDiff.create()
+    outs = build(sd)
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    names = [o.name() for o in outs]
+    res = sd.output(placeholders or {}, *names)
+    for node in sd._ops:
+        OpValidation.recordTested(node.op)
+    return [np.asarray(res[n].numpy()) for n in names]
+
+
+X = _R(0).randn(3, 4).astype(np.float32)
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+# ------------------------------------------------------------ recurrent ----
+def _np_sru(x, W, b, c0):
+    t, bsz, nIn = x.shape
+    hs, cs = [], []
+    c = c0
+    for ti in range(t):
+        z = x[ti] @ W
+        xh, f_in, r_in = z[:, :nIn], z[:, nIn:2 * nIn], z[:, 2 * nIn:]
+        f = _sigmoid(f_in + b[:nIn])
+        r = _sigmoid(r_in + b[nIn:])
+        c = f * c + (1 - f) * xh
+        h = r * np.tanh(c) + (1 - r) * x[ti]
+        hs.append(h)
+        cs.append(c)
+    return np.stack(hs), np.stack(cs)
+
+
+def test_sru_family():
+    rng = _R(1)
+    t, bsz, n = 5, 2, 3
+    x = rng.randn(t, bsz, n).astype(np.float32)
+    W = (rng.randn(n, 3 * n) * 0.4).astype(np.float32)
+    b = (rng.randn(2 * n) * 0.1).astype(np.float32)
+    c0 = np.zeros((bsz, n), np.float32)
+    hs_ref, cs_ref = _np_sru(x, W, b, c0)
+
+    hs, cs = _run(lambda sd: sd._op(
+        "sru", [sd.placeholder("x"), sd.constant(W), sd.constant(b),
+                sd.constant(c0)], n_out=2), {"x": x})
+    np.testing.assert_allclose(hs, hs_ref, atol=1e-5)
+    np.testing.assert_allclose(cs, cs_ref, atol=1e-5)
+
+    h1, c1 = _run(lambda sd: sd._op(
+        "sruCell", [sd.placeholder("x"), sd.constant(c0), sd.constant(W),
+                    sd.constant(b)], n_out=2), {"x": x[0]})
+    np.testing.assert_allclose(h1, hs_ref[0], atol=1e-5)
+    np.testing.assert_allclose(c1, cs_ref[0], atol=1e-5)
+
+    # bidirectional: fw half must equal the unidirectional run
+    Wbi = np.concatenate([W, W], axis=1)
+    bbi = np.concatenate([b, b])
+    c0bi = np.stack([c0, c0])
+    hsbi, _ = _run(lambda sd: sd._op(
+        "sruBI", [sd.placeholder("x"), sd.constant(Wbi), sd.constant(bbi),
+                  sd.constant(c0bi)], n_out=2), {"x": x})
+    np.testing.assert_allclose(hsbi[..., :n], hs_ref, atol=1e-5)
+
+
+def _np_lstm_block(x, c0, h0, W, b, forget_bias=1.0):
+    t = x.shape[0]
+    h, c = h0, c0
+    outs = []
+    for ti in range(t):
+        z = np.concatenate([x[ti], h], axis=-1) @ W + b
+        i_in, g_in, f_in, o_in = np.split(z, 4, axis=-1)
+        i = _sigmoid(i_in)
+        f = _sigmoid(f_in + forget_bias)
+        g = np.tanh(g_in)
+        c = f * c + i * g
+        o = _sigmoid(o_in)
+        h = o * np.tanh(c)
+        outs.append((i, c, f, o, g, np.tanh(c), h))
+    return [np.stack([o[k] for o in outs]) for k in range(7)]
+
+
+def test_lstm_block_family():
+    rng = _R(2)
+    t, bsz, nIn, nU = 4, 2, 3, 5
+    x = rng.randn(t, bsz, nIn).astype(np.float32)
+    W = (rng.randn(nIn + nU, 4 * nU) * 0.3).astype(np.float32)
+    b = np.zeros(4 * nU, np.float32)
+    zero = np.zeros((bsz, nU), np.float32)
+    zeroP = np.zeros(nU, np.float32)
+    refs = _np_lstm_block(x, zero, zero, W, b)
+
+    outs = _run(lambda sd: sd._op(
+        "lstmBlock", [sd.placeholder("x"), sd.constant(zero),
+                      sd.constant(zero), sd.constant(W), sd.constant(zeroP),
+                      sd.constant(zeroP), sd.constant(zeroP),
+                      sd.constant(b)], n_out=7), {"x": x})
+    for got, ref in zip(outs, refs):
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    outs1 = _run(lambda sd: sd._op(
+        "lstmBlockCell", [sd.placeholder("x"), sd.constant(zero),
+                          sd.constant(zero), sd.constant(W),
+                          sd.constant(zeroP), sd.constant(zeroP),
+                          sd.constant(zeroP), sd.constant(b)], n_out=7),
+        {"x": x[0]})
+    for got, ref in zip(outs1, refs):
+        np.testing.assert_allclose(got, ref[0], atol=1e-5)
+
+
+def test_rnn_variants():
+    rng = _R(3)
+    t, bsz, nIn, nU = 4, 2, 3, 5
+    x = rng.randn(t, bsz, nIn).astype(np.float32)
+    Wx = (rng.randn(nIn, nU) * 0.4).astype(np.float32)
+    Wh = (rng.randn(nU, nU) * 0.4).astype(np.float32)
+    b = np.zeros(nU, np.float32)
+    h0 = np.zeros((bsz, nU), np.float32)
+
+    ref = []
+    h = h0
+    for ti in range(t):
+        h = np.tanh(x[ti] @ Wx + h @ Wh + b)
+        ref.append(h)
+    ref = np.stack(ref)
+
+    for op in ("dynamicRnn", "staticRnn"):
+        hs, hT = _run(lambda sd, op=op: sd._op(
+            op, [sd.placeholder("x"), sd.constant(Wx), sd.constant(Wh),
+                 sd.constant(b), sd.constant(h0)], n_out=2), {"x": x})
+        np.testing.assert_allclose(hs, ref, atol=1e-5)
+        np.testing.assert_allclose(hT, ref[-1], atol=1e-5)
+
+    for op in ("dynamicBidirectionalRnn", "staticBidirectionalRnn"):
+        hsF, hsB, hTF, hTB = _run(lambda sd, op=op: sd._op(
+            op, [sd.placeholder("x"), sd.constant(Wx), sd.constant(Wh),
+                 sd.constant(b), sd.constant(h0), sd.constant(Wx),
+                 sd.constant(Wh), sd.constant(b), sd.constant(h0)],
+            n_out=4), {"x": x})
+        np.testing.assert_allclose(hsF, ref, atol=1e-5)
+        # bw half: run on reversed input, un-reversed output
+        refB = []
+        h = h0
+        for ti in reversed(range(t)):
+            h = np.tanh(x[ti] @ Wx + h @ Wh + b)
+            refB.append(h)
+        refB = np.stack(refB[::-1])
+        np.testing.assert_allclose(hsB, refB, atol=1e-5)
+
+
+# ---------------------------------------------------------------- norms ----
+def test_instance_group_norm_torch_oracle():
+    torch = pytest.importorskip("torch")
+    rng = _R(4)
+    x = rng.randn(2, 6, 5, 5).astype(np.float32)
+    g = rng.rand(6).astype(np.float32) + 0.5
+    b = rng.randn(6).astype(np.float32)
+
+    ref = torch.nn.functional.instance_norm(
+        torch.tensor(x), weight=torch.tensor(g), bias=torch.tensor(b),
+        eps=1e-5).numpy()
+    _validate(lambda sd: sd._op("instanceNorm", [sd.placeholder("x"),
+                                                 sd.constant(g),
+                                                 sd.constant(b)]),
+              ref, {"x": x}, tol=1e-3)
+
+    ref = torch.nn.functional.group_norm(
+        torch.tensor(x), 3, weight=torch.tensor(g), bias=torch.tensor(b),
+        eps=1e-5).numpy()
+    _validate(lambda sd: sd._op("groupNorm", [sd.placeholder("x"),
+                                              sd.constant(g),
+                                              sd.constant(b)],
+                                {"numGroups": 3}),
+              ref, {"x": x}, tol=1e-3)
+
+
+def test_renorm_torch_oracle():
+    torch = pytest.importorskip("torch")
+    rng = _R(5)
+    x = rng.randn(4, 6).astype(np.float32) * 3
+    ref = torch.renorm(torch.tensor(x), p=2, dim=0, maxnorm=1.5).numpy()
+    _validate(lambda sd: sd._op("renorm", [sd.placeholder("x")],
+                                {"p": 2.0, "dim": 0, "maxnorm": 1.5}),
+              ref, {"x": x}, tol=1e-4)
+
+
+def test_fused_batch_norm():
+    rng = _R(6)
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    sc = rng.rand(3).astype(np.float32) + 0.5
+    off = rng.randn(3).astype(np.float32)
+    mu = x.mean(axis=(0, 1, 2))
+    var = x.var(axis=(0, 1, 2))
+    ref = (x - mu) / np.sqrt(var + 1e-3) * sc + off
+    y, m, v = _run(lambda sd: sd._op(
+        "fusedBatchNorm", [sd.placeholder("x"), sd.constant(sc),
+                           sd.constant(off)], n_out=3), {"x": x})
+    np.testing.assert_allclose(y, ref, atol=1e-4)
+    np.testing.assert_allclose(m, mu, atol=1e-5)
+    np.testing.assert_allclose(v, var, atol=1e-5)
+
+
+# ------------------------------------------------------------ conv/pool ----
+def test_dilation2d():
+    rng = _R(7)
+    x = rng.randn(1, 6, 6, 2).astype(np.float32)
+    w = rng.randn(3, 3, 2).astype(np.float32)
+    # numpy oracle, VALID, stride 1, rate 1
+    ref = np.zeros((1, 4, 4, 2), np.float32)
+    for i in range(4):
+        for j in range(4):
+            ref[0, i, j] = (x[0, i:i + 3, j:j + 3] + w).max(axis=(0, 1))
+    _validate(lambda sd: sd._op("dilation2d",
+                                [sd.placeholder("x"), sd.constant(w)],
+                                {"isSameMode": False}),
+              ref, {"x": x}, tol=1e-5)
+
+
+def test_max_pool_with_argmax():
+    rng = _R(8)
+    x = rng.randn(1, 4, 4, 2).astype(np.float32)
+    vals, idx = _run(lambda sd: sd._op(
+        "maxPoolWithArgmax", [sd.placeholder("x")],
+        {"kH": 2, "kW": 2, "sH": 2, "sW": 2}, n_out=2), {"x": x})
+    # numpy oracle incl. TF flat index convention (h*w*c + w*c + c)
+    for oi in range(2):
+        for oj in range(2):
+            for c in range(2):
+                win = x[0, 2 * oi:2 * oi + 2, 2 * oj:2 * oj + 2, c]
+                assert vals[0, oi, oj, c] == win.max()
+                wi, wj = np.unravel_index(win.argmax(), (2, 2))
+                flat = ((2 * oi + wi) * 4 + (2 * oj + wj)) * 2 + c
+                assert idx[0, oi, oj, c] == flat
+
+
+def test_pnorm_pool_and_pointwise():
+    torch = pytest.importorskip("torch")
+    rng = _R(9)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    ref = torch.nn.functional.lp_pool2d(torch.tensor(x), 2, 2, 2).numpy()
+    _validate(lambda sd: sd._op("pnormPool2d", [sd.placeholder("x")],
+                                {"kH": 2, "kW": 2, "sH": 2, "sW": 2,
+                                 "pnorm": 2}),
+              ref, {"x": x}, tol=1e-4)
+
+    xh = rng.randn(1, 3, 3, 4).astype(np.float32)
+    w = rng.randn(1, 1, 4, 5).astype(np.float32)
+    ref = np.einsum("bhwc,cd->bhwd", xh, w[0, 0])
+    _validate(lambda sd: sd._op("pointwiseConv2d",
+                                [sd.placeholder("x"), sd.constant(w)]),
+              ref, {"x": xh}, tol=1e-5)
+
+
+# -------------------------------------------------------- tensorScatter ----
+def test_tensor_scatter_family():
+    base = np.zeros((4, 3), np.float32)
+    idx = np.array([[0], [2]], np.int32)
+    upd = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+    cases = {
+        "tensorScatterAdd": base.copy(),
+        "tensorScatterSub": base.copy(),
+        "tensorScatterMax": base.copy(),
+        "tensorScatterMin": base.copy(),
+        "tensorScatterUpdate": base.copy(),
+    }
+    cases["tensorScatterAdd"][[0, 2]] = upd
+    cases["tensorScatterSub"][[0, 2]] = -upd
+    cases["tensorScatterMax"][[0, 2]] = np.maximum(0, upd)
+    cases["tensorScatterMin"][[0, 2]] = np.minimum(0, upd)
+    cases["tensorScatterUpdate"][[0, 2]] = upd
+    for op, ref in cases.items():
+        _validate(lambda sd, op=op: sd._op(
+            op, [sd.placeholder("x"), sd.constant(idx), sd.constant(upd)]),
+            ref, {"x": base}, tol=1e-6)
+
+
+# -------------------------------------------------- einsum/search/shape ----
+def test_einsum_searchsorted_bucketize():
+    rng = _R(10)
+    a = rng.randn(3, 4).astype(np.float32)
+    bm = rng.randn(4, 5).astype(np.float32)
+    _validate(lambda sd: sd._op("einsum", [sd.placeholder("a"),
+                                           sd.constant(bm)],
+                                {"equation": "ij,jk->ik"}),
+              a @ bm, {"a": a}, tol=1e-5)
+
+    seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    v = np.array([0.5, 3.0, 8.0], np.float32)
+    _validate(lambda sd: sd._op("searchsorted", [sd.constant(seq),
+                                                 sd.placeholder("v")]),
+              np.searchsorted(seq, v).astype(np.int32), {"v": v}, tol=0)
+    # batched
+    seq2 = np.stack([seq, seq + 1])
+    v2 = np.stack([v, v])
+    got, = _run(lambda sd: sd._op("searchsorted", [sd.constant(seq2),
+                                                   sd.placeholder("v")]),
+                {"v": v2})
+    ref = np.stack([np.searchsorted(seq2[i], v2[i]) for i in range(2)])
+    np.testing.assert_array_equal(got, ref)
+
+    _validate(lambda sd: sd._op("bucketize", [sd.placeholder("v")],
+                                {"boundaries": [1.0, 4.0, 6.0]}),
+              np.digitize(v, [1.0, 4.0, 6.0], right=False).astype(np.int32),
+              {"v": v}, tol=0)
+
+
+def test_shape_utilities():
+    rng = _R(11)
+    x = rng.randn(2, 6).astype(np.float32)
+
+    _validate(lambda sd: sd._op("unravelIndex",
+                                [sd.placeholder("i"),
+                                 sd.constant(np.array([3, 4], np.int64))]),
+              np.stack(np.unravel_index([5, 11], (3, 4)), -1).astype(np.int32),
+              {"i": np.array([5, 11], np.int32)}, tol=0)
+
+    _validate(lambda sd: sd._op(
+        "sparseToDense",
+        [sd.constant(np.array([[0, 1], [2, 3]], np.int32)),
+         sd.constant(np.array([3, 4], np.int64)), sd.placeholder("v")]),
+        np.array([[0, 9, 0, 0], [0, 0, 0, 0], [0, 0, 0, 7]], np.float32),
+        {"v": np.array([9.0, 7.0], np.float32)}, tol=0)
+
+    _validate(lambda sd: sd._op(
+        "broadcastDynamicShape",
+        [sd.constant(np.array([2, 1, 3], np.int64)),
+         sd.constant(np.array([4, 1], np.int64))]),
+        np.array([2, 4, 3], np.int64), tol=0)
+
+    _validate(lambda sd: sd._op("reshapeAs", [sd.placeholder("x"),
+                                              sd.constant(np.zeros((3, 4)))]),
+              x.reshape(3, 4), {"x": x}, tol=0)
+
+    s1, s2 = _run(lambda sd: sd._op(
+        "shapeN", [sd.placeholder("x"), sd.constant(np.zeros((5, 1, 2)))],
+        n_out=2), {"x": x})
+    np.testing.assert_array_equal(s1, [2, 6])
+    np.testing.assert_array_equal(s2, [5, 1, 2])
+
+    a, b2 = _run(lambda sd: sd._op("splitV", [sd.placeholder("x")],
+                                   {"sizes": [2, 4], "axis": 1}, n_out=2),
+                 {"x": x})
+    np.testing.assert_array_equal(a, x[:, :2])
+    np.testing.assert_array_equal(b2, x[:, 2:])
+
+    _validate(lambda sd: sd._op("parallelStack",
+                                [sd.placeholder("x"), sd.constant(x + 1)]),
+              np.stack([x, x + 1]), {"x": x}, tol=0)
+
+    t0, t1 = _run(lambda sd: sd._op("tear", [sd.placeholder("x")],
+                                    {"dimension": 0}, n_out=2), {"x": x})
+    np.testing.assert_array_equal(t0, x[0])
+    np.testing.assert_array_equal(t1, x[1])
+
+    vals, cnt = _run(lambda sd: sd._op(
+        "choose", [sd.placeholder("x")], {"mode": "GT", "scalar": 0.0},
+        n_out=2), {"x": np.array([-1.0, 2.0, -3.0, 4.0], np.float32)})
+    assert cnt == 2
+    np.testing.assert_array_equal(vals[:2], [2.0, 4.0])
+    assert (vals[2:] == 0).all()
+
+    _validate(lambda sd: sd._op("truncateDiv", [sd.placeholder("x"),
+                                                sd.constant(
+                                                    np.float32(3.0))]),
+              np.trunc(np.array([7.0, -7.0], np.float32) / 3.0),
+              {"x": np.array([7.0, -7.0], np.float32)}, tol=0)
+
+
+# --------------------------------------------------------------- losses ----
+def test_pairwise_and_poisson_losses():
+    rng = _R(12)
+    p = rng.randn(3, 5).astype(np.float32)
+    l = rng.randn(3, 5).astype(np.float32)
+    d = p - l
+    n = 5
+    per = 2.0 * (n * (d * d).sum(-1) - d.sum(-1) ** 2) / (n * (n - 1))
+    _validate(lambda sd: sd._op("meanPairwiseSquaredError",
+                                [sd.placeholder("p"), sd.constant(l)]),
+              np.float32(per.mean()), {"p": p}, tol=1e-4)
+
+    logp = rng.randn(3, 4).astype(np.float32)
+    tgt = rng.poisson(2.0, (3, 4)).astype(np.float32)
+    ref = (np.exp(logp) - tgt * logp).mean()
+    _validate(lambda sd: sd._op("logPoissonLoss",
+                                [sd.placeholder("lp"), sd.constant(tgt)]),
+              np.float32(ref), {"lp": logp}, tol=1e-4)
+
+    # full=True zeroes the Stirling term for t in [0, 1] (TF convention):
+    # at t=0, lp=0 the loss is exactly exp(0) = 1
+    full, = _run(lambda sd: sd._op(
+        "logPoissonLoss", [sd.placeholder("lp"),
+                           sd.constant(np.zeros((1, 1), np.float32))],
+        {"full": True}), {"lp": np.zeros((1, 1), np.float32)})
+    np.testing.assert_allclose(full, 1.0, atol=1e-6)
+
+
+# --------------------------------------------------------------- random ----
+def test_random_extras():
+    rng = _R(13)
+    x = rng.randn(8, 8, 3).astype(np.float32)
+    crop, = _run(lambda sd: sd._op("randomCrop", [sd.placeholder("x")],
+                                   {"shape": [4, 4, 3], "seed": 7}),
+                 {"x": x})
+    assert crop.shape == (4, 4, 3)
+    # the crop must be a contiguous sub-block of x
+    found = any(np.allclose(crop, x[i:i + 4, j:j + 4])
+                for i in range(5) for j in range(5))
+    assert found
+
+    xs = rng.randn(1000).astype(np.float32)
+    ad, = _run(lambda sd: sd._op("alphaDropout", [sd.placeholder("x")],
+                                 {"p": 0.3, "seed": 3}), {"x": xs})
+    # SELU-consistent: mean/var approximately preserved
+    assert abs(ad.mean() - xs.mean()) < 0.3
+    assert abs(ad.std() - xs.std()) < 0.4
+
+    rb, = _run(lambda sd: sd._op("randomBinomial", [],
+                                 {"trials": 10, "prob": 0.5,
+                                  "shape": [2000], "seed": 5}))
+    assert rb.shape == (2000,)
+    assert 4.0 < rb.mean() < 6.0 and 0 <= rb.min() and rb.max() <= 10
+
+
+# ---------------------------------------------------------------- image ----
+def test_image_extras():
+    rng = _R(14)
+    x = rng.rand(2, 3).astype(np.float32)
+    yiq, = _run(lambda sd: sd._op("rgbToYiq", [sd.placeholder("x")]),
+                {"x": x})
+    back, = _run(lambda sd: sd._op("yiqToRgb", [sd.placeholder("x")]),
+                 {"x": yiq})
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+    img = rng.rand(1, 4, 4, 3).astype(np.float32)
+    up, = _run(lambda sd: sd._op("imageResize", [sd.placeholder("x")],
+                                 {"height": 8, "width": 8,
+                                  "method": "nearest"}), {"x": img})
+    assert up.shape == (1, 8, 8, 3)
+    np.testing.assert_allclose(up[0, ::2, ::2], img[0], atol=1e-6)
+
+    # area = true block averaging on integer downsample factors
+    grid = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    dn, = _run(lambda sd: sd._op("imageResize", [sd.placeholder("x")],
+                                 {"height": 2, "width": 2,
+                                  "method": "area"}), {"x": grid})
+    np.testing.assert_allclose(
+        dn[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]], atol=1e-5)
+
+    boxes = np.array([[[0.0, 0.0, 1.0, 1.0]]], np.float32)
+    colors = np.array([[9.0, 9.0, 9.0]], np.float32)
+    drawn, = _run(lambda sd: sd._op(
+        "drawBoundingBoxes", [sd.placeholder("x"), sd.constant(boxes),
+                              sd.constant(colors)]), {"x": img})
+    assert (drawn[0, 0, :, 0] == 9.0).all()          # top border painted
+    assert drawn.shape == img.shape
+
+    overlaps = np.array([[1.0, 0.9, 0.1],
+                         [0.9, 1.0, 0.2],
+                         [0.1, 0.2, 1.0]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    picks, = _run(lambda sd: sd._op(
+        "nonMaxSuppressionOverlaps", [sd.placeholder("o"),
+                                      sd.constant(scores)],
+        {"maxOutputSize": 3, "overlapThreshold": 0.5}), {"o": overlaps})
+    # box 1 suppressed by box 0 (overlap .9); box 2 survives
+    assert picks[0] == 0 and 2 in picks.tolist()
+
+    xq = np.array([-0.1, 0.0, 0.3, 0.9, 1.2], np.float32)
+    q, = _run(lambda sd: sd._op(
+        "fakeQuantWithMinMaxVars",
+        [sd.placeholder("x"), sd.constant(np.float32(0.0)),
+         sd.constant(np.float32(1.0))], {"numBits": 8}), {"x": xq})
+    assert q.min() >= -1e-6 and q.max() <= 1.0 + 1e-6
+    np.testing.assert_allclose(q[2], 0.3, atol=1.0 / 255)
+    qpc, = _run(lambda sd: sd._op(
+        "fakeQuantWithMinMaxVarsPerChannel",
+        [sd.placeholder("x"), sd.constant(np.zeros(5, np.float32)),
+         sd.constant(np.ones(5, np.float32))], {"numBits": 8}), {"x": xq})
+    np.testing.assert_allclose(qpc, q, atol=1e-6)
+
+
+# ---------------------------------------------------------- math extras ----
+def test_math_extras():
+    rng = _R(15)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+
+    _validate(lambda sd: sd._op("axpy", [sd.placeholder("x"),
+                                         sd.constant(y)], {"alpha": 2.5}),
+              2.5 * x + y, {"x": x}, tol=1e-5)
+    _validate(lambda sd: sd._op("norm", [sd.placeholder("x")], {"p": 2.0}),
+              np.float32(np.sqrt((x * x).sum())), {"x": x}, tol=1e-4)
+    _validate(lambda sd: sd._op("norm", [sd.placeholder("x")],
+                                {"p": 1.0, "dims": [1]}),
+              np.abs(x).sum(1), {"x": x}, tol=1e-4)
+
+    bc, = _run(lambda sd: sd._op("bitcast", [sd.placeholder("x")],
+                                 {"dtype": "int32"}), {"x": x})
+    np.testing.assert_array_equal(bc, x.view(np.int32))
+
+    m = rng.randn(3, 3).astype(np.float32)
+    _validate(lambda sd: sd._op("diagPart", [sd.placeholder("x")]),
+              np.diagonal(m), {"x": m}, tol=0)
+
+    st, = _run(lambda sd: sd._op("stabilize", [sd.placeholder("x")],
+                                 {"realMin": 0.5}),
+               {"x": np.array([0.1, -0.2, 3.0, 0.0], np.float32)})
+    assert (np.abs(st) >= 0.5).all()
+    assert st[2] == 3.0
+
+    h1, = _run(lambda sd: sd._op("hashCode", [sd.placeholder("x")]),
+               {"x": x})
+    h2, = _run(lambda sd: sd._op("hashCode", [sd.placeholder("x")]),
+               {"x": x})
+    h3, = _run(lambda sd: sd._op("hashCode", [sd.placeholder("x")]),
+               {"x": x + 1})
+    assert h1 == h2 and h1 != h3
+    # integer inputs hash their exact values, not a lossy f32 cast
+    ha, = _run(lambda sd: sd._op("hashCode", [sd.placeholder("x")]),
+               {"x": np.array([16777216], np.int64)})
+    hb, = _run(lambda sd: sd._op("hashCode", [sd.placeholder("x")]),
+               {"x": np.array([16777217], np.int64)})
+    assert ha != hb
+
+    b = rng.randn(4).astype(np.float32)
+    _validate(lambda sd: sd._op("biasAdd", [sd.placeholder("x"),
+                                            sd.constant(b)]),
+              x + b, {"x": x}, tol=1e-6)
+    xc = rng.randn(2, 4, 3, 3).astype(np.float32)
+    _validate(lambda sd: sd._op("biasAdd", [sd.placeholder("x"),
+                                            sd.constant(b)], {"nchw": True}),
+              xc + b.reshape(1, 4, 1, 1), {"x": xc}, tol=1e-6)
+
+    w = rng.randn(4, 2).astype(np.float32)
+    b2 = rng.randn(2).astype(np.float32)
+    _validate(lambda sd: sd._op("xwPlusB", [sd.placeholder("x"),
+                                            sd.constant(w),
+                                            sd.constant(b2)]),
+              x @ w + b2, {"x": x}, tol=1e-5)
+
+
+def test_debug_and_casts():
+    x = X
+    out, = _run(lambda sd: sd._op("printVariable", [sd.placeholder("x")],
+                                  {"message": "x{with braces}: "}),
+                {"x": x})
+    np.testing.assert_array_equal(out, x)
+    ok, = _run(lambda sd: sd._op("Assert", [sd.placeholder("c")]),
+               {"c": np.array([1, 1], np.int32)})
+    np.testing.assert_array_equal(ok, [1, 1])
+    _run(lambda sd: sd._op("noOp", [sd.placeholder("x")]), {"x": x})
+
+    for op, dt in [("toDouble", np.float64), ("toFloat16", np.float16),
+                   ("toFloat32", np.float32), ("toInt32", np.int32),
+                   ("toInt64", np.int64), ("toUint32", np.uint32),
+                   ("toUint64", np.uint64)]:
+        src = np.abs(X) if op.startswith("toUint") else X
+        got, = _run(lambda sd, op=op: sd._op(op, [sd.placeholder("x")]),
+                    {"x": src})
+        assert got.dtype == dt, (op, got.dtype)
+
+    c, = _run(lambda sd: sd._op("create", [], {"shape": [2, 3],
+                                               "dtype": "float32",
+                                               "initValue": 1.5}))
+    np.testing.assert_array_equal(c, np.full((2, 3), 1.5, np.float32))
+
+
+# ----------------------------------------------------------- list ops ----
+def test_tensor_list_ops():
+    rng = _R(16)
+    x = rng.randn(4, 3).astype(np.float32)
+
+    same, = _run(lambda sd: sd._op("stackList", [sd.placeholder("x")]),
+                 {"x": x})
+    np.testing.assert_array_equal(same, x)
+    same, = _run(lambda sd: sd._op("cloneList", [sd.placeholder("x")]),
+                 {"x": x})
+    np.testing.assert_array_equal(same, x)
+
+    parts = _run(lambda sd: sd._op("unstackList", [sd.placeholder("x")],
+                                   n_out=4), {"x": x})
+    for i in range(4):
+        np.testing.assert_array_equal(parts[i], x[i])
+
+    r, = _run(lambda sd: sd._op("readList", [sd.placeholder("x")],
+                                {"index": 2}), {"x": x})
+    np.testing.assert_array_equal(r, x[2])
+
+    v = np.ones(3, np.float32)
+    wr, = _run(lambda sd: sd._op("writeList", [sd.placeholder("x"),
+                                               sd.constant(v)],
+                                 {"index": 1}), {"x": x})
+    np.testing.assert_array_equal(wr[1], v)
+    np.testing.assert_array_equal(wr[0], x[0])
+
+    g, = _run(lambda sd: sd._op(
+        "gatherList", [sd.placeholder("x"),
+                       sd.constant(np.array([2, 0], np.int32))]), {"x": x})
+    np.testing.assert_array_equal(g, x[[2, 0]])
+
+    sc, = _run(lambda sd: sd._op(
+        "scatterList", [sd.constant(np.array([1, 3], np.int32)),
+                        sd.placeholder("v"),
+                        sd.constant(np.int64(5))]),
+        {"v": x[:2]})
+    assert sc.shape == (5, 3)
+    np.testing.assert_array_equal(sc[1], x[0])
+    np.testing.assert_array_equal(sc[3], x[1])
+    assert (sc[0] == 0).all()
+
+    n, = _run(lambda sd: sd._op("sizeList", [sd.placeholder("x")]),
+              {"x": x})
+    assert n == 4
+
+    a, b = _run(lambda sd: sd._op("splitList", [sd.placeholder("x")],
+                                  {"sizes": [1, 3]}, n_out=2), {"x": x})
+    np.testing.assert_array_equal(a, x[:1])
+    np.testing.assert_array_equal(b, x[1:])
+
+
+# ------------------------------------------------------------- t-SNE ----
+def test_barnes_hut_helpers():
+    gains = np.array([1.0, 1.0, 1.0], np.float32)
+    grad = np.array([0.5, -0.5, 0.5], np.float32)
+    incs = np.array([0.2, 0.2, -0.3], np.float32)
+    out, = _run(lambda sd: sd._op(
+        "barnesGains", [sd.placeholder("g"), sd.constant(grad),
+                        sd.constant(incs)]), {"g": gains})
+    # same sign -> *0.8; different sign -> +0.2
+    np.testing.assert_allclose(out, [0.8, 1.2, 1.2], atol=1e-6)
+
+    # 3-point graph, CSR: point0 -> {1, 2}, point1 -> {0}, point2 -> {}
+    rowP = np.array([0, 2, 3, 3], np.int32)
+    colP = np.array([1, 2, 0], np.int32)
+    valP = np.array([0.5, 0.3, 0.5], np.float32)
+    y = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 2.0]], np.float32)
+    f, = _run(lambda sd: sd._op(
+        "barnesEdgeForces", [sd.constant(rowP), sd.constant(colP),
+                             sd.constant(valP), sd.placeholder("y")]),
+        {"y": y})
+    ref = np.zeros_like(y)
+    for e, (r, c) in enumerate([(0, 1), (0, 2), (1, 0)]):
+        diff = y[r] - y[c]
+        ref[r] += valP[e] * diff / (1.0 + (diff * diff).sum())
+    np.testing.assert_allclose(f, ref, atol=1e-5)
+
+
+def test_ctc_greedy_decoder():
+    # blank=0; path [1,1,0,2,2,0,1] -> decoded [1,2,1]
+    t, c = 7, 3
+    path = [1, 1, 0, 2, 2, 0, 1]
+    logits = np.full((1, t, c), -5.0, np.float32)
+    for ti, cl in enumerate(path):
+        logits[0, ti, cl] = 5.0
+    dec, lens = _run(lambda sd: sd._op(
+        "ctcGreedyDecoder", [sd.placeholder("l")], n_out=2), {"l": logits})
+    assert lens[0] == 3
+    np.testing.assert_array_equal(dec[0, :3], [1, 2, 1])
+    assert (dec[0, 3:] == -1).all()
+
+
+# ------------------------------------------------------------- aliases ----
+def test_reference_alias_names():
+    rng = _R(17)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    _validate(lambda sd: sd._op("matmul", [sd.placeholder("a"),
+                                           sd.constant(b)]),
+              a @ b, {"a": a}, tol=1e-5)
+    c = rng.randn(3, 4).astype(np.float32)
+    pairs = [("minimum", np.minimum(a, c)), ("maximum", np.maximum(a, c)),
+             ("subtract", a - c), ("multiply", a * c),
+             ("divide", a / c), ("realDiv", a / c),
+             ("mergeSum", a + c), ("truncateDiv", np.trunc(a / c))]
+    for op, ref in pairs:
+        _validate(lambda sd, op=op: sd._op(op, [sd.placeholder("a"),
+                                                sd.constant(c)]),
+                  ref, {"a": a}, tol=1e-5)
+    _validate(lambda sd: sd._op("lrelu", [sd.placeholder("a")],
+                                {"alpha": 0.1}),
+              np.where(a > 0, a, 0.1 * a), {"a": a}, tol=1e-6)
+    _validate(lambda sd: sd._op("tensordot", [sd.placeholder("a"),
+                                              sd.constant(b)],
+                                {"dimensions": ([1], [0])}),
+              np.tensordot(a, b, axes=([1], [0])), {"a": a}, tol=1e-5)
+    _validate(lambda sd: sd._op("onesAs", [sd.placeholder("a")]),
+              np.ones_like(a), {"a": a}, tol=0)
+    _validate(lambda sd: sd._op("zerosAs", [sd.placeholder("a")]),
+              np.zeros_like(a), {"a": a}, tol=0)
+    _validate(lambda sd: sd._op("adjustContrastV2", [sd.placeholder("a")],
+                                {"factor": 1.0}),
+              a.reshape(3, 4, 1), {"a": a.reshape(3, 4, 1)}, tol=1e-4)
+
+    for op, kw in [("randomGamma", {"shape": [50], "alpha": 2.0, "seed": 1}),
+                   ("randomPoisson", {"shape": [50], "lam": 3.0, "seed": 1}),
+                   ("randomExponential", {"shape": [50], "lam": 1.5,
+                                          "seed": 1})]:
+        out, = _run(lambda sd, op=op, kw=kw: sd._op(op, [], kw))
+        assert out.shape == (50,)
+        assert np.isfinite(out).all()
+    sh, = _run(lambda sd: sd._op(
+        "randomShuffle", [sd.placeholder("x")], {"seed": 2}),
+        {"x": np.arange(10).astype(np.float32)})
+    assert sorted(sh.tolist()) == list(range(10))
+    mn, = _run(lambda sd: sd._op(
+        "multinomial", [sd.placeholder("logits")],
+        {"numSamples": 64, "seed": 3}),
+        {"logits": np.log(np.array([[0.8, 0.1, 0.1]], np.float32))})
+    assert (mn >= 0).all() and (mn <= 2).all()
+
+    wce, = _run(lambda sd: sd._op(
+        "weightedCrossEntropy",
+        [sd.placeholder("t"), sd.constant(a), sd.constant(np.float32(2.0))]),
+        {"t": (np.abs(c) < 1).astype(np.float32)})
+    assert np.isfinite(wce).all()
+
+
+# ------------------------------------------------------ gradient checks ----
+@pytest.mark.parametrize("opname,build,phs", [
+    ("sru", lambda sd: sd._op(
+        "sru", [sd.placeholder("x"),
+                sd.constant((_R(20).randn(3, 9) * 0.4).astype(np.float32)),
+                sd.constant(np.zeros(6, np.float32)),
+                sd.constant(np.zeros((2, 3), np.float32))], n_out=2)[0],
+        {"x": _R(21).randn(4, 2, 3).astype(np.float32)}),
+    ("instanceNorm", lambda sd: sd._op(
+        "instanceNorm", [sd.placeholder("x"),
+                         sd.constant(np.ones(3, np.float32)),
+                         sd.constant(np.zeros(3, np.float32))]),
+        {"x": _R(22).randn(2, 3, 4, 4).astype(np.float32)}),
+    ("groupNorm", lambda sd: sd._op(
+        "groupNorm", [sd.placeholder("x"),
+                      sd.constant(np.ones(4, np.float32)),
+                      sd.constant(np.zeros(4, np.float32))],
+        {"numGroups": 2}),
+        {"x": _R(23).randn(2, 4, 3, 3).astype(np.float32)}),
+    ("meanPairwiseSquaredError", lambda sd: sd._op(
+        "meanPairwiseSquaredError",
+        [sd.placeholder("x"),
+         sd.constant(_R(24).randn(3, 5).astype(np.float32))]),
+        {"x": _R(25).randn(3, 5).astype(np.float32)}),
+    ("logPoissonLoss", lambda sd: sd._op(
+        "logPoissonLoss",
+        [sd.placeholder("x"),
+         sd.constant(_R(26).poisson(2.0, (3, 4)).astype(np.float32))]),
+        {"x": _R(27).randn(3, 4).astype(np.float32)}),
+    ("dilation2d", lambda sd: sd._op(
+        "dilation2d", [sd.placeholder("x"),
+                       sd.constant(_R(28).randn(2, 2, 2).astype(np.float32))],
+        {"isSameMode": False}),
+        {"x": _R(29).randn(1, 4, 4, 2).astype(np.float32)}),
+    ("xwPlusB", lambda sd: sd._op(
+        "xwPlusB", [sd.placeholder("x"),
+                    sd.constant(_R(30).randn(4, 2).astype(np.float32)),
+                    sd.constant(_R(31).randn(2).astype(np.float32))]),
+        {"x": _R(32).randn(3, 4).astype(np.float32)}),
+    ("tensorScatterAdd", lambda sd: sd._op(
+        "tensorScatterAdd",
+        [sd.placeholder("x"), sd.constant(np.array([[0], [2]], np.int32)),
+         sd.constant(_R(33).randn(2, 3).astype(np.float32))]),
+        {"x": _R(34).randn(4, 3).astype(np.float32)}),
+])
+def test_gradients_ext5(opname, build, phs):
+    sd = SameDiff.create()
+    out = build(sd)
+    sd._op("sum", [out], name="loss_out")
+    sd.setLossVariables("loss_out")
+    tc = TestCase(sd).gradientCheck(True)
+    tc._placeholders.update({k: np.asarray(v) for k, v in phs.items()})
+    res = sd.output({k: np.asarray(v) for k, v in phs.items()}, "loss_out")
+    tc.expectedOutput(sd.getVariable("loss_out"),
+                      res["loss_out"].numpy())
+    err = OpValidation.validate(tc)
+    assert err is None, f"gradcheck {opname}: {err}"
