@@ -58,8 +58,12 @@ _simple("divNoNan", lambda x, y: jnp.where(y == 0, 0.0, x / y))
 _simple("safeDivide", lambda x, y: jnp.where(y == 0, 0.0, x / y))
 _simple("crelu", lambda x: jnp.concatenate(
     [jax.nn.relu(x), jax.nn.relu(-x)], axis=-1))
-_simple("l2Normalize", lambda x: x / jnp.maximum(
-    jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True)), 1e-12))
+@register_op("l2Normalize")
+def _l2_normalize(dims=None, **_):
+    # axis-aware (ONNX LpNormalization passes dims); default last axis
+    ax = tuple(dims) if dims is not None else (-1,)
+    return lambda x: x / jnp.maximum(
+        jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=True)), 1e-12)
 _simple("swishDerivative", lambda x: jax.grad(
     lambda v: jnp.sum(jax.nn.swish(v)))(x))
 

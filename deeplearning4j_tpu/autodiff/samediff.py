@@ -108,6 +108,14 @@ for _n, _f in [("neg", jnp.negative), ("exp", jnp.exp), ("log", jnp.log),
     _simple(_n, _f)
 
 
+@register_op("gelu")
+def _gelu_op(approximate=True, **_):
+    # overrides the _simple registration: ONNX opset-20 Gelu (and torch
+    # nn.GELU) default to the exact erf form — the attr must reach the
+    # kernel (default stays tanh-approx, the BERT/reference convention)
+    return lambda x: jax.nn.gelu(x, approximate=bool(approximate))
+
+
 @register_op("relu")
 def _relu(cutoff=0.0, **_):
     return lambda x: jnp.where(x > cutoff, x, 0.0)

@@ -190,8 +190,14 @@ class _Ctx:
     def get(self, name):
         if name not in self.vars:
             if name in self.consts:
-                self.vars[name] = self.sd.constant(self.consts[name],
-                                                   name=f"c_{name}")
+                val = self.consts[name]
+                if np.issubdtype(val.dtype, np.floating) and val.size > 1:
+                    # frozen weight -> trainable VARIABLE so the imported
+                    # graph fine-tunes (same rule as tf_import._const)
+                    self.vars[name] = self.sd.var(f"c_{name}", val)
+                else:
+                    self.vars[name] = self.sd.constant(val,
+                                                       name=f"c_{name}")
             else:
                 raise KeyError(f"undefined tensor {name!r}")
         return self.vars[name]
@@ -200,6 +206,13 @@ class _Ctx:
         if name in self.consts:
             return self.consts[name]
         raise ValueError(f"{name!r} must be a constant initializer")
+
+    def weight(self, name: str, arr: np.ndarray):
+        """Create a trainable VARIABLE for a layer weight (possibly
+        layout-transformed) so imported models fine-tune — used by the
+        Conv/Gemm/ConvTranspose/BatchNorm handlers, whose weights would
+        otherwise be frozen constants."""
+        return self.sd.var(name, np.asarray(arr))
 
 
 _ONNX_OPS: Dict[str, Any] = {}
@@ -267,7 +280,7 @@ def _gemm(ctx, node):
         a = a.transpose()
     alpha = float(node.attrs.get("alpha", 1.0))
     beta = float(node.attrs.get("beta", 1.0))
-    y = a.mmul(ctx.sd.constant(alpha * B, name=f"w_{node.name}"))
+    y = a.mmul(ctx.weight(f"w_{node.name}", alpha * B))
     if len(node.inputs) > 2 and beta != 0.0:
         c = ctx.get(node.inputs[2])
         if beta != 1.0:
@@ -348,9 +361,9 @@ def _conv(ctx, node):
                 "dataFormat": "NCHW"}
     # ONNX weights are OIHW; the SameDiff conv2d op takes HWIO
     ins = [ctx.get(node.inputs[0]),
-           ctx.sd.constant(W.transpose(2, 3, 1, 0), name=f"w_{node.name}")]
+           ctx.weight(f"w_{node.name}", W.transpose(2, 3, 1, 0))]
     if b is not None:
-        ins.append(ctx.sd.constant(b, name=f"b_{node.name}"))
+        ins.append(ctx.weight(f"b_{node.name}", b))
     return ctx.sd._op("conv2d", ins, kw_attrs)
 
 
@@ -395,8 +408,9 @@ def _gap_impl(**_):
 def _bn(ctx, node):
     x = ctx.get(node.inputs[0])
     sd = ctx.sd
-    g = sd.constant(ctx.const_val(node.inputs[1]), name=f"g_{node.name}")
-    b = sd.constant(ctx.const_val(node.inputs[2]), name=f"bb_{node.name}")
+    # gamma/beta fine-tune; running mean/var stay frozen statistics
+    g = ctx.weight(f"g_{node.name}", ctx.const_val(node.inputs[1]))
+    b = ctx.weight(f"bb_{node.name}", ctx.const_val(node.inputs[2]))
     m = sd.constant(ctx.const_val(node.inputs[3]), name=f"m_{node.name}")
     v = sd.constant(ctx.const_val(node.inputs[4]), name=f"v_{node.name}")
     eps = float(node.attrs.get("epsilon", 1e-5))
@@ -442,3 +456,4 @@ def importOnnxModel(path: str):
 
 
 from deeplearning4j_tpu.imports import onnx_import_ext  # noqa: E402,F401  isort:skip
+from deeplearning4j_tpu.imports import onnx_import_ext2  # noqa: E402,F401  isort:skip
