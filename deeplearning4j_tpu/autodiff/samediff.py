@@ -1303,21 +1303,112 @@ class SameDiff:
         reference's TF-style control-flow machinery: where AbstractSession
         interprets Enter/Exit/Switch/Merge/NextIteration frames op-by-op IN
         JAVA (SURVEY §3.3), the subgraph here compiles INTO the parent's
-        XLA executable as a lax control-flow region."""
+        XLA executable as a lax control-flow region.
+
+        Returns ``(staged, n_out, payload)`` — payload is the
+        JSON-serializable description of the sub-graph (the analogue of
+        the reference's FlatBuffers sub-graph regions,
+        ``graph/scheme/*.fbs``) from which ``_restage_payload`` rebuilds
+        the closure after ``SameDiff.load``."""
         sub = SameDiff()
         phs = [sub.placeholder(f"sub_in_{i}") for i in range(n_in)]
         outs = build(sub, phs)
         outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
         out_names = tuple(o.name() for o in outs)
-        subfn = sub._build_fn(out_names)
+        payload = {"n_in": n_in, "outputs": list(out_names),
+                   "graph": sub._graph_payload()}
+        return self._stage_from(sub, out_names), len(out_names), payload
+
+    @staticmethod
+    def _stage_from(sub: "SameDiff", out_names) -> Any:
+        subfn = sub._build_fn(tuple(out_names))
         var_vals = sub._var_values()
 
         def staged(*args):
             res = subfn({f"sub_in_{i}": a for i, a in enumerate(args)},
                         var_vals, 0)
             return [res[n] for n in out_names]
+        return staged
 
-        return staged, len(out_names)
+    def _graph_payload(self, include_arrays: bool = True) -> Dict:
+        """JSON-able description of this graph.  With ``include_arrays``
+        values are inlined (control-flow sub-graph regions — small loop
+        constants); ``save`` passes False and writes arrays.npz instead.
+        Guard (applies recursively through nested regions): a callable
+        attr is only serializable when it is a known control-flow fn key
+        whose paired ``_sub_*`` region is present."""
+        for n in self._ops:
+            pairs = dict(self._CF_SUBS.get(n.op, ()))
+            for k, a in n.attrs.items():
+                if callable(a) and (k not in pairs
+                                    or pairs[k] not in n.attrs):
+                    raise ValueError(
+                        f"cannot serialize op '{n.name}' ({n.op}): attr "
+                        f"{k!r} is a compile-time closure with no "
+                        "serialized sub-graph region")
+        payload = {
+            "variables": [
+                {"name": v.name(), "type": v.variableType,
+                 "shape": list(v.shape) if v.shape else None,
+                 "dtype": (np.dtype(v.dtype).name
+                           if v.dtype is not None else None)}
+                for v in self._vars.values()],
+            "ops": [{"op": n.op, "name": n.name, "inputs": n.inputs,
+                     "outputs": n.outputs,
+                     "attrs": {k: a for k, a in n.attrs.items()
+                               if not callable(a)}}
+                    for n in self._ops],
+            "lossVariables": list(self._loss_vars),
+        }
+        if include_arrays:
+            payload["arrays"] = {n: {"dtype": str(np.asarray(a).dtype),
+                                     "data": np.asarray(a).tolist()}
+                                 for n, a in self._arrays.items()}
+        return payload
+
+    def _apply_graph_payload(self, g: Dict) -> None:
+        """Reconstruct variables/ops/loss markers from a payload dict
+        (shared by ``load`` and sub-graph region restaging)."""
+        for v in g["variables"]:
+            dt = np.dtype(v["dtype"]) if v.get("dtype") else None
+            self._register(v["name"], v["type"], v.get("shape"), dt)
+        for o in g["ops"]:
+            node = _OpNode(o["op"], o["name"], o["inputs"], o["outputs"],
+                           o["attrs"])
+            self._ops.append(node)
+            for i, out in enumerate(node.outputs):
+                self._producer[out] = (node, i)
+        self._loss_vars = g.get("lossVariables", [])
+
+    #: control-flow ops: (callable attr -> serialized sub-graph attr)
+    _CF_SUBS = {
+        "while_loop": (("cond_fn", "_sub_cond"), ("body_fn", "_sub_body")),
+        "if_cond": (("cond_fn", "_sub_cond"), ("true_fn", "_sub_true"),
+                    ("false_fn", "_sub_false")),
+        "for_loop": (("body_fn", "_sub_body"),),
+    }
+
+    @staticmethod
+    def _restage_payload(payload: Dict) -> Any:
+        """Rebuild a staged sub-graph closure from its serialized form
+        (recursively — nested control flow restages its own regions)."""
+        g = payload["graph"]
+        sub = SameDiff()
+        sub._apply_graph_payload(g)
+        for n, spec in g["arrays"].items():
+            sub._arrays[n] = jnp.asarray(
+                np.asarray(spec["data"], dtype=np.dtype(spec["dtype"])))
+        sub._restage_controlflow()
+        return SameDiff._stage_from(sub, tuple(payload["outputs"]))
+
+    def _restage_controlflow(self) -> None:
+        """After load: re-create the compile-time closures of every
+        control-flow op from their serialized sub-graph regions."""
+        for n in self._ops:
+            for fn_key, sub_key in self._CF_SUBS.get(n.op, ()):
+                if not callable(n.attrs.get(fn_key)):
+                    n.attrs[fn_key] = self._restage_payload(
+                        n.attrs[sub_key])
 
     def whileLoop(self, loopVars: Sequence[SDVariable], cond, body,
                   name: str = None):
@@ -1332,14 +1423,15 @@ class SameDiff:
         the final loop variables.
         """
         n = len(loopVars)
-        cond_fn, n_c = self._stage_subgraph(n, cond)
+        cond_fn, n_c, cond_sub = self._stage_subgraph(n, cond)
         if n_c != 1:
             raise ValueError("cond must return exactly one scalar")
-        body_fn, n_b = self._stage_subgraph(n, body)
+        body_fn, n_b, body_sub = self._stage_subgraph(n, body)
         if n_b != n:
             raise ValueError(f"body returns {n_b} vars, expected {n}")
         out = self._op("while_loop", list(loopVars),
-                       {"cond_fn": cond_fn, "body_fn": body_fn, "n": n},
+                       {"cond_fn": cond_fn, "body_fn": body_fn, "n": n,
+                        "_sub_cond": cond_sub, "_sub_body": body_sub},
                        n_out=n, name=name or "while")
         return out if isinstance(out, list) else [out]
 
@@ -1350,16 +1442,18 @@ class SameDiff:
         ``f(sd, vars)`` lambdas; the two branches must return the same
         number (and shapes) of outputs."""
         n = len(inputs)
-        cond_fn, n_c = self._stage_subgraph(n, cond)
+        cond_fn, n_c, cond_sub = self._stage_subgraph(n, cond)
         if n_c != 1:
             raise ValueError("cond must return exactly one scalar")
-        t_fn, n_t = self._stage_subgraph(n, trueBody)
-        f_fn, n_f = self._stage_subgraph(n, falseBody)
+        t_fn, n_t, t_sub = self._stage_subgraph(n, trueBody)
+        f_fn, n_f, f_sub = self._stage_subgraph(n, falseBody)
         if n_t != n_f:
             raise ValueError(f"branches return {n_t} vs {n_f} outputs")
         out = self._op("if_cond", list(inputs),
                        {"cond_fn": cond_fn, "true_fn": t_fn,
-                        "false_fn": f_fn, "n_out": n_t},
+                        "false_fn": f_fn, "n_out": n_t,
+                        "_sub_cond": cond_sub, "_sub_true": t_sub,
+                        "_sub_false": f_sub},
                        n_out=n_t, name=name or "cond")
         return out if isinstance(out, list) else [out]
 
@@ -1369,12 +1463,13 @@ class SameDiff:
         TPU-native recurrence primitive; use instead of whileLoop when the
         trip count is static and gradients must flow)."""
         n = len(loopVars)
-        body_fn, n_b = self._stage_subgraph(n, body)
+        body_fn, n_b, body_sub = self._stage_subgraph(n, body)
         if n_b != n:
             raise ValueError(f"body returns {n_b} vars, expected {n}")
         out = self._op("for_loop", list(loopVars),
                        {"body_fn": body_fn, "n": n,
-                        "iterations": int(nIterations)},
+                        "iterations": int(nIterations),
+                        "_sub_body": body_sub},
                        n_out=n, name=name or "for")
         return out if isinstance(out, list) else [out]
 
@@ -1811,25 +1906,13 @@ class SameDiff:
     def save(self, path: str, saveUpdaterState: bool = False):
         """Zip with graph.json + npz arrays (reference: SameDiff.save →
         FlatBuffers, libnd4j graph/scheme/*.fbs; same content, JSON+npz
-        container)."""
-        for n in self._ops:
-            if any(callable(a) for a in n.attrs.values()):
-                raise ValueError(
-                    f"cannot serialize op '{n.name}' ({n.op}): staged "
-                    "control-flow subgraphs are compile-time closures — "
-                    "rebuild the graph from code after load instead")
-        graph = {
-            "variables": [
-                {"name": v.name(), "type": v.variableType,
-                 "shape": list(v.shape) if v.shape else None,
-                 "dtype": (np.dtype(v.dtype).name
-                           if v.dtype is not None else None)}
-                for v in self._vars.values()],
-            "ops": [{"op": n.op, "name": n.name, "inputs": n.inputs,
-                     "outputs": n.outputs, "attrs": n.attrs}
-                    for n in self._ops],
-            "lossVariables": self._loss_vars,
-        }
+        container).  Control-flow ops serialize their sub-graph regions
+        recursively (``_sub_*`` attrs — the FlatBuffers scheme stored
+        nested graphs the same way); the staged closures are dropped and
+        rebuilt on load.  An op with a callable attr but NO paired
+        serialized region (hand-registered, not framework-built) refuses
+        — the guard lives in ``_graph_payload``."""
+        graph = self._graph_payload(include_arrays=False)
         buf = io.BytesIO()
         np.savez(buf, **{n: np.asarray(a) for n, a in self._arrays.items()})
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
@@ -1851,19 +1934,10 @@ class SameDiff:
         with zipfile.ZipFile(path) as z:
             graph = json.loads(z.read("graph.json"))
             arrays = np.load(io.BytesIO(z.read("arrays.npz")))
-            for v in graph["variables"]:
-                dt = np.dtype(v["dtype"]) if v.get("dtype") else None
-                sd._register(v["name"], v["type"],
-                             v.get("shape"), dt)
+            sd._apply_graph_payload(graph)
             for n in arrays.files:
                 sd._arrays[n] = jnp.asarray(arrays[n])
-            for o in graph["ops"]:
-                node = _OpNode(o["op"], o["name"], o["inputs"], o["outputs"],
-                               o["attrs"])
-                sd._ops.append(node)
-                for i, out in enumerate(node.outputs):
-                    sd._producer[out] = (node, i)
-            sd._loss_vars = graph.get("lossVariables", [])
+            sd._restage_controlflow()
             if loadUpdaterState and "updater.npz" in z.namelist():
                 st = np.load(io.BytesIO(z.read("updater.npz")))
                 opt: Dict[str, Dict] = {}

@@ -7,7 +7,7 @@ Reference pattern (SURVEY.md §4): nd4j's OpValidation suites
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, TrainingConfig
 from deeplearning4j_tpu.autodiff.validation import OpValidation, TestCase
 
 
@@ -188,13 +188,99 @@ def test_for_loop_differentiable():
     OpValidation.recordTested("for_loop")
 
 
-def test_save_rejects_control_flow():
+def test_save_roundtrips_control_flow(tmp_path):
+    """VERDICT r3 ask #4: save/load round-trips while/if/for graphs by
+    serializing the sub-graph regions (the FlatBuffers-scheme analogue);
+    the old 'cannot serialize' raise is unreachable for framework-built
+    graphs."""
+    p = str(tmp_path / "cf.sd.zip")
+
+    # whileLoop: count up to 5
     sd = SameDiff.create()
-    x = sd.constant(np.float32(1.0), name="x")
-    sd.whileLoop([x], cond=lambda s, v: v[0].lt(s.constant(np.float32(2.0))),
-                 body=lambda s, v: [v[0].add(s.constant(np.float32(1.0)))])
-    with pytest.raises(ValueError, match="control-flow"):
-        sd.save("/tmp/cf.sd.zip")
+    x = sd.placeholder("x")
+    [out] = sd.whileLoop(
+        [x], cond=lambda s, v: v[0].lt(s.constant(np.float32(5.0))),
+        body=lambda s, v: [v[0].add(s.constant(np.float32(1.0)))])
+    ref = sd.output({"x": np.float32(1.0)}, out.name())[out.name()].numpy()
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    got = sd2.output({"x": np.float32(1.0)}, out.name())[out.name()].numpy()
+    np.testing.assert_allclose(got, ref)
+    assert float(got) == 5.0
+
+    # ifCond nested inside forLoop: serde recursion over regions
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+
+    def body(s, v):
+        return s.ifCond(
+            v, cond=lambda s2, w: w[0].lt(s2.constant(np.float32(10.0))),
+            trueBody=lambda s2, w: [w[0].mul(s2.constant(np.float32(2.0)))],
+            falseBody=lambda s2, w: [w[0]])
+    [out] = sd.forLoop(4, [x], body=body)
+    ref = sd.output({"x": np.float32(1.0)}, out.name())[out.name()].numpy()
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    got = sd2.output({"x": np.float32(1.0)}, out.name())[out.name()].numpy()
+    np.testing.assert_allclose(got, ref)
+    assert float(got) == 16.0   # doubles until >= 10, then holds
+
+
+def test_save_refuses_closure_without_region(tmp_path):
+    """A hand-registered control-flow node carrying a closure but no
+    serialized sub-graph region must refuse at save (not write a zip
+    that can never load)."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    sd._op("while_loop", [x],
+           {"cond_fn": lambda *a: [a[0] < 2], "body_fn": lambda *a: [a[0]],
+            "n": 1}, n_out=1)
+    with pytest.raises(ValueError, match="no.*serialized sub-graph"):
+        sd.save(str(tmp_path / "bad.sd.zip"))
+
+
+def test_control_flow_training_resumes(tmp_path):
+    """A trainable graph whose forward uses a forLoop region checkpoints
+    and resumes: save -> load -> identical outputs AND continued fit."""
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.learning import Sgd
+
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("x")
+        y = sd.placeholder("y")
+        W = sd.var("W", np.full((3, 1), 0.1, np.float32))
+        h = x.mmul(W)
+        [acc] = sd.forLoop(2, [h], body=lambda s, v: [
+            v[0].mul(s.constant(np.float32(0.5)))])
+        sd.loss().meanSquaredError(acc.rename("pred"), y, name="loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Sgd(0.1), dataSetFeatureMapping=["x"],
+            dataSetLabelMapping=["y"]))
+        return sd
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 3).astype(np.float32)
+    ys = (xs @ np.array([[1.0], [2.0], [-1.0]], np.float32)) * 0.25
+    ds = DataSet(xs, ys)
+
+    sd = build()
+    sd.fit(ds, epochs=3)
+    p = str(tmp_path / "cftrain.sd.zip")
+    sd.save(p, saveUpdaterState=True)
+
+    sd2 = SameDiff.load(p, loadUpdaterState=True)
+    o1 = sd.output({"x": xs}, "pred")["pred"].numpy()
+    o2 = sd2.output({"x": xs}, "pred")["pred"].numpy()
+    np.testing.assert_allclose(o1, o2, atol=1e-6)
+
+    # resumed training continues to reduce the loss
+    sd2.setTrainingConfig(TrainingConfig(
+        updater=Sgd(0.1), dataSetFeatureMapping=["x"],
+        dataSetLabelMapping=["y"]))
+    h1 = sd2.fit(ds, epochs=1).lossCurve()[0]
+    h2 = sd2.fit(ds, epochs=6).lossCurve()[-1]
+    assert h2 < h1
 
 
 # -------------------------------------------------------- coverage gate ----
