@@ -401,7 +401,19 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def _ensure_trace_mesh(self) -> None:
+        """Drop step executables compiled under a ParallelWrapper mesh
+        when this net is used OUTSIDE any wrapper (the mesh routing —
+        e.g. ring attention — is baked into the trace)."""
+        from deeplearning4j_tpu.parallel.mesh import active_mesh
+        if getattr(self, "_meshTrace", None) is not None \
+                and active_mesh() is None:
+            for k in ("_trainStep", "_outputFn", "_scoreFn"):
+                self.__dict__.pop(k, None)
+            self._meshTrace = None
+
     def fit(self, data, labels=None, epochs: int = 1) -> None:
+        self._ensure_trace_mesh()
         if self.params_ is None:
             self.init()
         if isinstance(data, DataSet):
@@ -530,6 +542,7 @@ class MultiLayerNetwork:
         return out or None
 
     def output(self, x, train: bool = False, featuresMask=None) -> NDArray:
+        self._ensure_trace_mesh()
         xv = x.jax if isinstance(x, NDArray) else jnp.asarray(x)
         fm = None
         if featuresMask is not None:
@@ -605,6 +618,7 @@ class MultiLayerNetwork:
                 self._score = float(self._scoreArr)
                 self._scoreArr = None
             return self._score
+        self._ensure_trace_mesh()
         fmask = ds.featuresMask.jax if ds.featuresMask is not None else None
         lmask = ds.labelsMask.jax if ds.labelsMask is not None else None
         return float(self._scoreFn(self.params_, self.state_,

@@ -141,6 +141,16 @@ class ListBuilder:
         self._validate = bool(v)
         return self
 
+    def pipelineStages(self, n: int):
+        """Train the hidden stack GPipe-pipelined over ``n`` mesh stages
+        (NEW capability vs the reference — SURVEY §2.6).  The hidden
+        layers must form ``n`` structurally identical contiguous
+        segments (the transformer regime); wrap the built net in
+        ``ParallelWrapper(net, mesh=DeviceMesh(stage=n, ...))`` to
+        train.  See ``parallel/pipeline_model.py``."""
+        self._g["pipelineStages"] = int(n)
+        return self
+
     def build(self) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration(
             layers=self._layers, globalConf=self._g, inputType=self._inputType,

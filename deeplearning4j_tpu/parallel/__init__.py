@@ -1,5 +1,7 @@
 """Parallelism over the TPU mesh (replaces reference L5 — SURVEY.md §2.6)."""
-from deeplearning4j_tpu.parallel.mesh import DeviceMesh, P, shard_params  # noqa: F401
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    DeviceMesh, P, activate_mesh, active_mesh, shard_params)
+from deeplearning4j_tpu.parallel.pipeline_model import PipelinedTrainer  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode  # noqa: F401
 from deeplearning4j_tpu.parallel.sharedtraining import (  # noqa: F401
     AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm,

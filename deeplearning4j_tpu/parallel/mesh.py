@@ -81,6 +81,37 @@ class DeviceMesh:
                 f"devices={self.numDevices()})")
 
 
+#: the mesh a ParallelWrapper.fit is currently compiling against —
+#: trace-time routing signal for layers (sequence-parallel attention).
+_ACTIVE_MESH: Optional["DeviceMesh"] = None
+
+
+def active_mesh() -> Optional["DeviceMesh"]:
+    """The DeviceMesh of the enclosing ParallelWrapper.fit, if any.
+    Layers consult this at TRACE time (one jit compilation per fit run)
+    to route to mesh-aware lowerings — e.g. the attention layers route
+    to ring/context-parallel attention when the mesh has a seq axis."""
+    return _ACTIVE_MESH
+
+
+class activate_mesh:
+    """Context manager marking ``mesh`` active for layer routing."""
+
+    def __init__(self, mesh: Optional["DeviceMesh"]):
+        self.mesh = mesh
+
+    def __enter__(self):
+        global _ACTIVE_MESH
+        self._prev = _ACTIVE_MESH
+        _ACTIVE_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _ACTIVE_MESH
+        _ACTIVE_MESH = self._prev
+        return False
+
+
 def _dense_tp_spec(name: str, shape: Tuple[int, ...], modelAxis: str
                    ) -> P:
     """Default tensor-parallel rule: column-shard 2D weights, shard the

@@ -42,9 +42,14 @@ def pipeline_apply(mesh, block_fn: Callable, stacked_params, x,
     jmesh = getattr(mesh, "mesh", mesh)
     S = jmesh.shape[axis_name]
     M = n_microbatches
-    if x.shape[0] % M:
+    # batch dim shards over the mesh's data axis (if present) so the
+    # declared data parallelism does real work; each data shard runs its
+    # own microbatch schedule
+    D = jmesh.shape.get("data", 1)
+    data_axis = "data" if D > 1 else None
+    if x.shape[0] % (M * D):
         raise ValueError(f"batch {x.shape[0]} not divisible by "
-                         f"microbatches {M}")
+                         f"microbatches*data = {M}*{D}")
 
     def per_stage(params_local, x_local):
         # params_local: (1, ...) this stage's slice; x_local: full batch
@@ -91,8 +96,9 @@ def pipeline_apply(mesh, block_fn: Callable, stacked_params, x,
         return outs.reshape(x_local.shape)
 
     pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    xspec = P(data_axis) if data_axis else P()
     fn = jax.shard_map(per_stage, mesh=jmesh,
-                       in_specs=(pspec, P()), out_specs=P())
+                       in_specs=(pspec, xspec), out_specs=xspec)
     return fn(stacked_params, x)
 
 

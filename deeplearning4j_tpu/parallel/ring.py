@@ -346,16 +346,34 @@ def dot_product_attention(qh, kh, vh, mask=None, causal: bool = False,
     """Dispatch point used by the attention layers (``nn/conf/attention.py``).
 
     impl: "dense" (materialised softmax — reference semantics,
-    ``multi_head_dot_product_attention``), "blockwise", "flash", or "auto"
-    (flash on TPU for long sequences, dense otherwise — XLA fuses the small
-    case fine).
+    ``multi_head_dot_product_attention``), "blockwise", "flash", "ring"
+    (sequence-parallel over the active mesh's seq axis), or "auto"
+    (ring when a ParallelWrapper fit is compiling against a mesh with a
+    seq axis; flash on TPU for long sequences; dense otherwise — XLA
+    fuses the small case fine).
     """
     if impl == "auto":
-        # The flash kernel does not take a key mask — masked batches route
-        # to blockwise/dense, which honor it exactly.
-        long_seq = qh.shape[2] >= 1024
-        on_tpu = any(d.platform == "tpu" for d in jax.devices())
-        impl = "flash" if (long_seq and on_tpu and mask is None) else "dense"
+        from deeplearning4j_tpu.parallel.mesh import active_mesh
+        am = active_mesh()
+        if am is not None and getattr(am, "seqSize", 1) > 1 \
+                and qh.shape[2] % am.seqSize == 0 \
+                and kh.shape[2] % am.seqSize == 0:
+            impl = "ring"
+        else:
+            # The flash kernel does not take a key mask — masked batches
+            # route to blockwise/dense, which honor it exactly.
+            long_seq = qh.shape[2] >= 1024
+            on_tpu = any(d.platform == "tpu" for d in jax.devices())
+            impl = "flash" if (long_seq and on_tpu and mask is None) \
+                else "dense"
+    if impl == "ring":
+        from deeplearning4j_tpu.parallel.mesh import active_mesh
+        am = active_mesh()
+        if am is None:
+            raise ValueError("impl='ring' needs an active mesh "
+                             "(ParallelWrapper.fit with a seq axis)")
+        return context_parallel_attention(am, qh, kh, vh, mask=mask,
+                                          causal=causal)
     if impl == "flash":
         if mask is not None:
             return blockwise_attention(qh, kh, vh, mask=mask, causal=causal)

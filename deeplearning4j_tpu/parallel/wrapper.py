@@ -88,7 +88,45 @@ class ParallelWrapper:
         ``setBatchSharding`` places every incoming batch with the mesh's
         data-axis NamedSharding, and GSPMD specializes the already-fused
         train step with the psum all-reduce inside — no wrapper-side
-        monkey-patching or NDArray mutation."""
+        monkey-patching or NDArray mutation.
+
+        Mesh axes beyond data/model route automatically: a ``stage`` axis
+        trains the model's pipelineStages segments GPipe-scheduled
+        (``pipeline_model.PipelinedTrainer``); a ``seq`` axis makes the
+        attention layers compile ring (context-parallel) attention —
+        both through the dl4j-shaped model config, no user JAX."""
+        from deeplearning4j_tpu.parallel.mesh import activate_mesh
+        net = self.model
+        if self.mesh.stageSize > 1:
+            from deeplearning4j_tpu.parallel.pipeline_model import \
+                PipelinedTrainer
+            if getattr(self, "_pipeline", None) is None:
+                self._pipeline = PipelinedTrainer(net, self.mesh)
+            self._pipeline.fit(iterator, epochs=epochs)
+            return
+        if self.mesh.seqSize > 1:
+            # the routing decision is baked in at trace time: drop steps
+            # compiled under a DIFFERENT (or no) mesh, then keep this
+            # mesh's executables cached across repeated wrapper fits.
+            # The net itself drops mesh-bound traces when later used
+            # outside any wrapper (MultiLayerNetwork._ensure_trace_mesh).
+            if getattr(net, "_meshTrace", None) is not self.mesh:
+                for k in ("_trainStep", "_outputFn", "_scoreFn"):
+                    net.__dict__.pop(k, None)
+                net._meshTrace = self.mesh
+            try:
+                with activate_mesh(self.mesh):
+                    self._fit_dp(iterator, epochs)
+            except BaseException:
+                # don't leave half-compiled mesh-bound traces behind
+                for k in ("_trainStep", "_outputFn", "_scoreFn"):
+                    net.__dict__.pop(k, None)
+                net._meshTrace = None
+                raise
+            return
+        self._fit_dp(iterator, epochs)
+
+    def _fit_dp(self, iterator, epochs: int) -> None:
         net = self.model
         if net.params_ is None:
             net.init()

@@ -1,0 +1,134 @@
+"""PP/SP through the model config DSL (VERDICT r3 ask #5).
+
+The pipeline and sequence-parallel axes must be reachable from the
+dl4j-shaped config API — no user-written JAX.  Runs on the virtual
+8-device CPU mesh (conftest).
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+
+requires8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                               reason="needs 8 virtual devices")
+
+
+def _mlp_conf(stages=0, width=16, seed=7):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.05))
+         .list())
+    for _ in range(4):                      # 4 identical hidden segments
+        b.layer(DenseLayer.builder().nOut(width).activation("tanh").build())
+    b.layer(OutputLayer.builder("mse").nOut(4).activation("identity")
+            .build())
+    if stages:
+        b.pipelineStages(stages)
+    return b.setInputType(InputType.feedForward(width)).build()
+
+
+def _data(width=16, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch, width).astype(np.float32)
+    y = rng.randn(batch, 4).astype(np.float32)
+    return DataSet(x, y)
+
+
+@requires8
+def test_pipeline_stages_via_config_matches_single_device():
+    """pipelineStages(4) + stage-axis mesh trains through the DSL and the
+    trained params match the identical un-pipelined net (GPipe is exact
+    for stateless stacks: microbatching commutes with the batch mean)."""
+    ds = _data()
+    it = ListDataSetIterator([ds])
+
+    ref = MultiLayerNetwork(_mlp_conf()).init()
+    for _ in range(3):
+        ref.fit(ds)
+
+    net = MultiLayerNetwork(_mlp_conf(stages=4)).init()
+    mesh = DeviceMesh(data=2, stage=4, devices=jax.devices()[:8])
+    pw = ParallelWrapper(net, mesh=mesh)
+    for _ in range(3):
+        pw.fit(it, epochs=1)
+
+    for li in map(str, range(5)):
+        for k in ref.params_[li]:
+            np.testing.assert_allclose(
+                np.asarray(net.params_[li][k]),
+                np.asarray(ref.params_[li][k]), atol=2e-5,
+                err_msg=f"layer {li} param {k}")
+
+
+@requires8
+def test_pipeline_stages_validation_errors():
+    ds = _data()
+    net = MultiLayerNetwork(_mlp_conf(stages=4)).init()
+    # mesh stage axis must match the config
+    mesh = DeviceMesh(data=4, stage=2, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="pipelineStages"):
+        ParallelWrapper(net, mesh=mesh).fit(ListDataSetIterator([ds]))
+
+    # non-identical segments refuse with a clear message
+    b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05)).list()
+         .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
+         .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
+         .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+         .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+         .layer(OutputLayer.builder("mse").nOut(4).activation("identity")
+                .build()))
+    conf = b.setInputType(InputType.feedForward(16)).build()
+    conf.globalConf["pipelineStages"] = 4
+    net2 = MultiLayerNetwork(conf).init()
+    mesh4 = DeviceMesh(data=2, stage=4, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="identical"):
+        ParallelWrapper(net2, mesh=mesh4).fit(ListDataSetIterator([ds]))
+
+
+def _attn_conf(seed=3):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(SelfAttentionLayer.builder().nHeads(2).headSize(4)
+                   .build()
+                   if hasattr(SelfAttentionLayer, "builder")
+                   else SelfAttentionLayer(nHeads=2, headSize=4))
+            .layer(RnnOutputLayer.builder("mse").nOut(3)
+                   .activation("identity").build())
+            .setInputType(InputType.recurrent(8, 8)).build())
+
+
+@requires8
+def test_seq_parallel_attention_via_wrapper_matches_dense():
+    """A seq-axis mesh makes the attention layer compile ring attention
+    inside the wrapper's fit; outputs match the single-device net."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8, 8).astype(np.float32)   # (b, nIn, t)
+    y = rng.randn(4, 3, 8).astype(np.float32)
+    ds = DataSet(x, y)
+
+    ref = MultiLayerNetwork(_attn_conf()).init()
+    ref.fit(ds)
+    ref_params = jax.tree.map(np.asarray, ref.params_)
+
+    net = MultiLayerNetwork(_attn_conf()).init()
+    mesh = DeviceMesh(data=2, seq=4, devices=jax.devices()[:8])
+    pw = ParallelWrapper(net, mesh=mesh)
+    pw.fit(ListDataSetIterator([ds]), epochs=1)
+
+    for li in ref_params:
+        for k in ref_params[li]:
+            np.testing.assert_allclose(
+                np.asarray(net.params_[li][k]), ref_params[li][k],
+                atol=5e-4, err_msg=f"layer {li} param {k}")
+
+    # and the post-fit output path (mesh deactivated) matches too
+    o1 = ref.output(x)
+    o2 = net.output(x)
+    np.testing.assert_allclose(np.asarray(o2.numpy()),
+                               np.asarray(o1.numpy()), atol=5e-3)
