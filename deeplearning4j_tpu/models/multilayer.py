@@ -445,6 +445,7 @@ class MultiLayerNetwork:
         lmask = self._place_batch(
             ds.labelsMask.jax if ds.labelsMask is not None else None)
         self.lastBatchSize = int(x.shape[0])
+        self._lastInput = x      # device ref for StatsListener activations
 
         algo = str(self.conf.globalConf.get("optimizationAlgo")
                    or "STOCHASTIC_GRADIENT_DESCENT").upper()

@@ -53,6 +53,33 @@ class PipelinedTrainer:
         k = len(hidden) // S
         self.k = k
         self.segments = [hidden[s * k:(s + 1) * k] for s in range(S)]
+        # identical LAYER CONFIGS, not just param shapes: _block_fn runs
+        # segment 0's layer objects on every stage, so a differing
+        # activation/layer type would silently train the wrong function
+        import dataclasses as _dc
+
+        def _sig(l):
+            if _dc.is_dataclass(l):
+                return (type(l).__name__,
+                        tuple((f.name, repr(getattr(l, f.name)))
+                              for f in _dc.fields(l) if f.name != "name"))
+            return (type(l).__name__, repr(l))
+        ref_sig = [_sig(l) for l in self.segments[0]]
+        for s, seg in enumerate(self.segments[1:], 1):
+            if [_sig(l) for l in seg] != ref_sig:
+                raise ValueError(
+                    f"pipeline segments are not identical: segment {s} "
+                    f"layers {[type(l).__name__ for l in seg]} differ "
+                    "from segment 0 (layer type/activation/config must "
+                    "match)")
+        if conf.preProcessors:
+            raise ValueError("input preprocessors are unsupported under "
+                             "pipelineStages (the pipelined forward does "
+                             "not apply them)")
+        if mesh.seqSize > 1:
+            raise ValueError("a mesh with both stage and seq axes is "
+                             "unsupported: pipelineStages does not route "
+                             "sequence-parallel attention")
         for key in ("l1", "l2", "weightDecay"):
             if conf.globalConf.get(key):
                 raise ValueError(f"pipelineStages does not support global "
@@ -137,10 +164,10 @@ class PipelinedTrainer:
             out, _ = out_layer.forward(out_p, h, True, None, {})
             return jnp.mean(out_layer.computeScore(y, out, None))
 
-        def step(stacked, out_p, opt, x, y, it):
+        def step(stacked, out_p, opt, x, y, it, ep):
             loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                 stacked, out_p, x, y)
-            lr = updater.currentLr(it, 0)
+            lr = updater.currentLr(it, ep)
             trees = []
             for tree, g, tag in ((stacked, grads[0], "p"),
                                  (out_p, grads[1], "o")):
@@ -148,7 +175,8 @@ class PipelinedTrainer:
                 gleaves = jax.tree_util.tree_leaves(g)
                 nl, no = [], []
                 for p_, g_, o_ in zip(leaves, gleaves, opt[tag]):
-                    upd, st = updater.apply(g_, o_, lr, it, param=p_)
+                    upd, st = updater.apply(g_, o_, lr, it, epoch=ep,
+                                            param=p_)
                     nl.append(p_ - upd)
                     no.append(st)
                 trees.append((jax.tree_util.tree_unflatten(treedef, nl), no))
@@ -166,7 +194,8 @@ class PipelinedTrainer:
                 "o": [self.updater.init(l)
                       for l in jax.tree_util.tree_leaves(self.out_params)]}
         loss = None
-        for _ in range(int(epochs)):
+        net = self.net
+        for ep in range(int(epochs)):
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
@@ -186,9 +215,15 @@ class PipelinedTrainer:
                 self.stacked, self.out_params, self._opt, loss = \
                     self._step(self.stacked, self.out_params, self._opt,
                                x, y, jnp.asarray(self.iterationCount,
-                                                 jnp.int32))
+                                                 jnp.int32),
+                               jnp.asarray(net.epochCount + ep, jnp.int32))
                 self.iterationCount += 1
-                self.net.iterationCount += 1
+                net.iterationCount += 1
+                net._scoreArr = loss
+                for l in getattr(net, "_listeners", []):
+                    l.iterationDone(net, net.iterationCount,
+                                    net.epochCount + ep)
+        net.epochCount += int(epochs)
         self.lastLoss = float(loss) if loss is not None else float("nan")
         self.net._scoreArr = None
         self.net._score = self.lastLoss   # net.score() reflects this fit

@@ -100,8 +100,13 @@ class ParallelWrapper:
         if self.mesh.stageSize > 1:
             from deeplearning4j_tpu.parallel.pipeline_model import \
                 PipelinedTrainer
-            if getattr(self, "_pipeline", None) is None:
+            # rebuild when the net's params dict was REPLACED (net.init()
+            # or a loaded checkpoint) — the trainer's stacked copy would
+            # otherwise silently overwrite the new weights on write-back
+            if getattr(self, "_pipeline", None) is None or \
+                    self._pipeline_src is not net.params_:
                 self._pipeline = PipelinedTrainer(net, self.mesh)
+                self._pipeline_src = net.params_
             self._pipeline.fit(iterator, epochs=epochs)
             return
         if self.mesh.seqSize > 1:

@@ -31,6 +31,29 @@ def _json_safe(obj):
     return obj
 
 
+def _svg_histogram(counts, lo: float, hi: float, w: int = 220,
+                   h: int = 48) -> str:
+    """Inline bar-chart for a fixed-bin histogram (sanitized: counts are
+    coerced to non-negative floats; anything else renders empty)."""
+    try:
+        vals = [max(0.0, float(c)) for c in counts]
+    except (TypeError, ValueError):
+        return ""
+    if not vals or max(vals) <= 0:
+        return ""
+    top = max(vals)
+    bw = w / len(vals)
+    bars = "".join(
+        f'<rect x="{i * bw:.1f}" y="{h - v / top * h:.1f}" '
+        f'width="{max(bw - 1, 1):.1f}" height="{v / top * h:.1f}" '
+        'fill="#4878a8"/>' for i, v in enumerate(vals))
+    return (f'<svg width="{w}" height="{h + 14}" '
+            'style="vertical-align:middle">'
+            f'{bars}<text x="0" y="{h + 12}" font-size="10">{lo:.3g}</text>'
+            f'<text x="{w - 40}" y="{h + 12}" font-size="10">{hi:.3g}'
+            '</text></svg>')
+
+
 def _svg_score_chart(scores: List[float], w: int = 640, h: int = 240) -> str:
     scores = [s for s in scores if math.isfinite(s)]  # a NaN score (diverged
     # run) must not blank the chart monitoring exists to show
@@ -162,6 +185,42 @@ class UIServer:
                         f"{_num(last.get('score', float('nan'))):.5f}; "
                         f"it/s: {_num(last.get('iterationsPerSecond', 0), 0.0):.2f}"
                         "</p>" + _svg_score_chart(scores))
+                    mem = last.get("memory") or {}
+                    if isinstance(mem, dict) and mem:
+                        bits = []
+                        if "deviceBytesInUse" in mem:
+                            bits.append(
+                                f"device {_num(mem['deviceBytesInUse'], 0) / 1e9:.2f}"
+                                f"/{_num(mem.get('deviceBytesLimit', 0), 0) / 1e9:.2f} GB")
+                        if "hostRssBytes" in mem:
+                            bits.append(
+                                f"host rss {_num(mem['hostRssBytes'], 0) / 1e9:.2f} GB")
+                        bits.append(f"{html.escape(str(mem.get('deviceCount', '?')))}x "
+                                    f"{html.escape(str(mem.get('platform', '?')))}")
+                        parts.append("<p>memory/hw: " + "; ".join(bits)
+                                     + "</p>")
+                    for section, title in (("paramStats", "parameters"),
+                                           ("updateStats", "updates"),
+                                           ("activationStats",
+                                            "activations")):
+                        stats = last.get(section) or {}
+                        if not isinstance(stats, dict) or not stats:
+                            continue
+                        parts.append(f"<h4>{title} (last iteration)</h4>")
+                        for name, s in sorted(stats.items()):
+                            if not isinstance(s, dict):
+                                continue
+                            hist = s.get("hist")
+                            parts.append(
+                                f"<div><tt>{html.escape(str(name))}</tt> "
+                                f"norm {_num(s.get('norm'), 0):.4g}, "
+                                f"mean {_num(s.get('mean'), 0):.4g}, "
+                                f"stdev {_num(s.get('stdev'), 0):.4g} "
+                                + (_svg_histogram(hist,
+                                                  _num(s.get('min'), 0),
+                                                  _num(s.get('max'), 0))
+                                   if isinstance(hist, list) else "")
+                                + "</div>")
                 parts.append("</body></html>")
                 self._send("".join(parts))
 

@@ -75,48 +75,158 @@ class FileStatsStorage(StatsStorage):
         return list(self._cache[sessionId])
 
 
+#: histogram bin count (reference StatsListener default resolution)
+_NBINS = 20
+
+
+def _leaf_stats(leaf):
+    """Per-tensor summary + fixed-bin histogram, all device-side."""
+    import jax.numpy as jnp
+    flat = leaf.ravel().astype(jnp.float32)
+    lo, hi = jnp.min(flat), jnp.max(flat)
+    span = jnp.where(hi > lo, hi - lo, 1.0)
+    idx = jnp.clip(((flat - lo) / span * _NBINS).astype(jnp.int32),
+                   0, _NBINS - 1)
+    hist = jnp.zeros((_NBINS,), jnp.int32).at[idx].add(1)
+    return {"norm": jnp.linalg.norm(flat), "mean": jnp.mean(flat),
+            "stdev": jnp.std(flat), "min": lo, "max": hi, "hist": hist}
+
+
+def _flatten_stats(tree) -> Dict[str, dict]:
+    import jax
+    out = {}
+    for li, lp in tree.items():
+        for path, leaf in jax.tree_util.tree_flatten_with_path(lp)[0]:
+            name = "_".join(str(getattr(k, "key", k)) for k in path)
+            out[f"{li}.{name}"] = leaf
+    return out
+
+
+def _to_host(stats_tree) -> Dict[str, dict]:
+    """ONE host pull for the whole stats tree, then plain python."""
+    import jax
+    host = jax.device_get(stats_tree)
+    out = {}
+    for name, st in host.items():
+        out[name] = {k: (np.asarray(v).tolist() if k == "hist"
+                         else float(v)) for k, v in st.items()}
+    return out
+
+
 class StatsListener(TrainingListener):
-    """Per-iteration stats → storage (reference: StatsListener.java)."""
+    """Per-iteration stats → storage (reference: StatsListener.java).
+
+    Collected (parity with the reference's update contents, SURVEY §5.5):
+    score, param stats (norm/mean/stdev/min/max + 20-bin histogram),
+    UPDATE stats (the param delta since the previous recorded iteration,
+    same summaries), per-layer ACTIVATION stats on the current batch
+    (``collectActivations``, via ``model.feedForward`` on the stashed
+    last input), iterations/sec, and a memory/hardware section (device
+    bytes in use/limit where the backend reports them, host RSS,
+    device count/platform).  All tensor stats are computed DEVICE-side
+    in one jitted pass and fetched with ONE host pull per recorded
+    iteration."""
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
-                 sessionId: Optional[str] = None):
+                 sessionId: Optional[str] = None,
+                 collectActivations: bool = False):
+        # collectActivations re-runs a full (eager) forward per recorded
+        # iteration — opt-in, like the reference gates histogram
+        # collection behind StatsUpdateConfiguration
         self.storage = storage
         self.frequency = max(1, frequency)
         self.sessionId = sessionId or f"session_{int(time.time())}"
+        self.collectActivations = collectActivations
         self._last_time = None
+        self._prev_params = None
 
-    def _norms(self, model) -> Dict[str, float]:
-        """ALL norms in one jitted reduction → ONE host pull (per-leaf
-        float() syncs would add a device round trip per tensor per
-        iteration)."""
+    def _tensor_stats(self, model):
         import jax
-        import jax.numpy as jnp
         params = getattr(model, "params_", None) or {}
         if not params:
+            return {}, {}
+        if not hasattr(self, "_stats_fn"):
+            def fn(tree):
+                return {n: _leaf_stats(l)
+                        for n, l in _flatten_stats(tree).items()}
+            self._stats_fn = jax.jit(fn)
+
+            def delta_fn(tree, prev):
+                # the APPLIED update: new = prev - upd  =>  upd = prev - new
+                # (sign matters: the reference's update stats report the
+                # update itself, not the raw param delta)
+                flat, pflat = _flatten_stats(tree), _flatten_stats(prev)
+                return {n: _leaf_stats(pflat[n] - flat[n]) for n in flat}
+            self._delta_fn = jax.jit(delta_fn)
+        pstats = _to_host(self._stats_fn(params))
+        ustats = {}
+        if self._prev_params is not None:
+            try:
+                ustats = _to_host(self._delta_fn(params, self._prev_params))
+            except Exception:   # layer set changed mid-run
+                ustats = {}
+        # keep OWN buffers: the model's fused step donates its param
+        # arrays, so holding the tree itself would leave deleted buffers
+        import jax.numpy as jnp
+        self._prev_params = jax.tree.map(jnp.copy, params)
+        return pstats, ustats
+
+    def _activation_stats(self, model):
+        import jax
+        x = getattr(model, "_lastInput", None)
+        if x is None or not hasattr(model, "feedForward"):
             return {}
-        if not hasattr(self, "_norm_fn"):
-            self._norm_fn = jax.jit(lambda tree: jax.tree.map(
-                lambda leaf: jnp.linalg.norm(leaf.ravel()), tree))
-        norm_tree = jax.device_get(self._norm_fn(params))
-        out = {}
-        for li, lp in norm_tree.items():
-            for path, leaf in jax.tree_util.tree_flatten_with_path(lp)[0]:
-                name = "_".join(str(getattr(k, "key", k)) for k in path)
-                out[f"{li}.{name}"] = float(leaf)
+        try:
+            acts = model.feedForward(x)
+            tree = {str(i): {"act": a.jax if hasattr(a, "jax") else a}
+                    for i, a in enumerate(acts)}
+            if not hasattr(self, "_act_fn"):
+                self._act_fn = jax.jit(lambda t: {
+                    n: _leaf_stats(l)
+                    for n, l in _flatten_stats(t).items()})
+            return _to_host(self._act_fn(tree))
+        except Exception:
+            return {}           # monitoring must never kill the run
+
+    @staticmethod
+    def _memory_section() -> dict:
+        import jax
+        out: dict = {"deviceCount": len(jax.devices()),
+                     "platform": jax.devices()[0].platform}
+        try:
+            ms = jax.devices()[0].memory_stats()
+            if ms:
+                out["deviceBytesInUse"] = int(ms.get("bytes_in_use", 0))
+                out["deviceBytesLimit"] = int(ms.get("bytes_limit", 0))
+        except Exception:
+            pass                # CPU backends report none
+        try:
+            import resource
+            out["hostRssBytes"] = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            pass
         return out
 
     def iterationDone(self, model, iteration, epoch):
         if iteration % self.frequency:
             return
         now = time.time()
+        pstats, ustats = self._tensor_stats(model)
         update = {
             "iteration": iteration,
             "epoch": epoch,
             "timestamp": now,
             "score": float(model.score()),
             "batchSize": getattr(model, "lastBatchSize", 0),
-            "paramNorms": self._norms(model),
+            "paramStats": pstats,
+            "updateStats": ustats,
+            # back-compat: plain norms view consumed by older dashboards
+            "paramNorms": {n: s["norm"] for n, s in pstats.items()},
+            "memory": self._memory_section(),
         }
+        if self.collectActivations:
+            update["activationStats"] = self._activation_stats(model)
         if self._last_time is not None:
             dt = now - self._last_time
             if dt > 0:

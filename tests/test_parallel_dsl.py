@@ -90,6 +90,20 @@ def test_pipeline_stages_validation_errors():
     with pytest.raises(ValueError, match="identical"):
         ParallelWrapper(net2, mesh=mesh4).fit(ListDataSetIterator([ds]))
 
+    # same param SHAPES but differing activation must also refuse —
+    # _block_fn runs segment 0's layers on every stage
+    b2 = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05)).list()
+          .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
+          .layer(DenseLayer.builder().nOut(16).activation("relu").build())
+          .layer(OutputLayer.builder("mse").nOut(4).activation("identity")
+                 .build()))
+    conf2 = b2.setInputType(InputType.feedForward(16)).build()
+    conf2.globalConf["pipelineStages"] = 2
+    net3 = MultiLayerNetwork(conf2).init()
+    mesh2 = DeviceMesh(data=4, stage=2, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="identical"):
+        ParallelWrapper(net3, mesh=mesh2).fit(ListDataSetIterator([ds]))
+
 
 def _attn_conf(seed=3):
     return (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
