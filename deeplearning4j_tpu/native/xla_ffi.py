@@ -48,9 +48,20 @@ def _compile() -> Optional[Path]:
     return _OUT
 
 
+def _stale() -> bool:
+    try:
+        src_m = max((_NATIVE_DIR / "src" / f).stat().st_mtime
+                    for f in ("xla_ffi.cpp", "compression.cpp",
+                              "random.cpp", "threads.cpp"))
+        return _OUT.stat().st_mtime < src_m
+    except OSError:
+        return True
+
+
 def register() -> bool:
-    """Compile (once) + register the FFI targets; False when unavailable
-    (no g++/headers — callers fall back to pure-XLA lowerings)."""
+    """Compile (once, rebuilt when sources changed) + register the FFI
+    targets; False when unavailable (no g++/headers — callers fall back
+    to pure-XLA lowerings)."""
     global _registered, _lib
     with _lock:
         if _registered:
@@ -58,13 +69,15 @@ def register() -> bool:
         if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
             return False
         import jax
-        path = _OUT if _OUT.exists() else _compile()
+        path = _OUT if _OUT.exists() and not _stale() else _compile()
         if path is None or not path.exists():
             return False
         try:
             _lib = ctypes.CDLL(str(path))
             for name in ("dl4j_xla_threshold_count",
-                         "dl4j_xla_philox_uniform"):
+                         "dl4j_xla_philox_uniform",
+                         "dl4j_xla_bitmap_encode",
+                         "dl4j_xla_bitmap_decode"):
                 sym = getattr(_lib, name)
                 jax.ffi.register_ffi_target(
                     name, jax.ffi.pycapsule(sym), platform="cpu")
@@ -86,6 +99,63 @@ def threshold_count(grad, threshold: float):
         "dl4j_xla_threshold_count",
         jax.ShapeDtypeStruct((), jnp.int64))(
         jnp.asarray(grad, jnp.float32), threshold=np.float32(threshold))
+
+
+def _words(n: int) -> int:
+    return (int(n) + 15) // 16
+
+
+def bitmap_encode(residual, threshold: float):
+    """Threshold+bitmap encode INSIDE XLA (jit-able): residual f32[n] ->
+    (new_residual f32[n], bitmap u32[ceil(n/16)], count s64).  The
+    reference 2-bit scheme (00 skip, 01 +tau, 10 -tau) with residual
+    semantics — native kernel on CPU, pure-XLA lowering elsewhere."""
+    import jax
+    import jax.numpy as jnp
+    n = residual.shape[0]
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu and register():
+        # threshold is a scalar BUFFER (not an attr): the adaptive
+        # controller changes tau per step; a buffer keeps ONE executable
+        return jax.ffi.ffi_call(
+            "dl4j_xla_bitmap_encode",
+            (jax.ShapeDtypeStruct((n,), jnp.float32),
+             jax.ShapeDtypeStruct((_words(n),), jnp.uint32),
+             jax.ShapeDtypeStruct((), jnp.int64)))(
+            jnp.asarray(residual, jnp.float32),
+            jnp.asarray(threshold, jnp.float32).reshape(1))
+    # pure-XLA fallback with IDENTICAL semantics
+    r = jnp.asarray(residual, jnp.float32)
+    tau = jnp.asarray(threshold, jnp.float32)
+    pos = r >= tau
+    neg = r <= -tau
+    codes = jnp.where(pos, 1, jnp.where(neg, 2, 0)).astype(jnp.uint32)
+    new_r = r - jnp.where(pos, tau, 0.0) + jnp.where(neg, tau, 0.0)
+    pad = _words(n) * 16 - n
+    cp = jnp.pad(codes, (0, pad)).reshape(_words(n), 16)
+    shifts = (2 * jnp.arange(16, dtype=jnp.uint32))
+    bitmap = jnp.sum(cp << shifts, axis=1, dtype=jnp.uint32)
+    count = jnp.sum(pos | neg).astype(jnp.int64)
+    return new_r, bitmap, count
+
+
+def bitmap_decode(bitmap, threshold: float, n: int):
+    """Dense sparse-delta decode INSIDE XLA: bitmap words -> f32[n] with
+    +/-threshold at coded positions."""
+    import jax
+    import jax.numpy as jnp
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu and register():
+        return jax.ffi.ffi_call(
+            "dl4j_xla_bitmap_decode",
+            jax.ShapeDtypeStruct((int(n),), jnp.float32))(
+            jnp.asarray(bitmap, jnp.uint32),
+            jnp.asarray(threshold, jnp.float32).reshape(1))
+    w = jnp.asarray(bitmap, jnp.uint32)
+    shifts = (2 * jnp.arange(16, dtype=jnp.uint32))
+    codes = ((w[:, None] >> shifts) & 3).reshape(-1)[:int(n)]
+    tau = jnp.asarray(threshold, jnp.float32)
+    return jnp.where(codes == 1, tau, jnp.where(codes == 2, -tau, 0.0))
 
 
 def philox_uniform(seed: int, offset: int, n: int):

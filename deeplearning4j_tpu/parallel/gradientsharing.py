@@ -130,8 +130,45 @@ class EncodedGradientsAccumulator:
             self.residualPostProcessor.process(step, tau, residual)
         return {"indices": msg, "threshold": tau, "worker": worker}
 
+    def encodeBitmap(self, worker: int, grad) -> dict:
+        """Encode INSIDE a jitted XLA program (round 4 — the load-bearing
+        FFI path): residual update + 2-bit bitmap packing run as ONE
+        compiled computation whose encode kernel is the native C++
+        handler via ``jax.ffi.ffi_call`` on CPU (pure-XLA lowering on
+        other platforms).  Same residual semantics as ``encode``; the
+        message carries the dense bitmap words instead of indices."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.native import xla_ffi
+        step = self._steps[worker] = self._steps[worker] + 1
+        residual = self._residuals[worker]
+        tau = float(self.thresholdAlgorithm.threshold(
+            step, residual + np.asarray(grad, np.float32).ravel()))
+        if not hasattr(self, "_encode_jit"):
+            @jax.jit
+            def _enc(res, g, t):
+                return xla_ffi.bitmap_encode(res + g.ravel(), t)
+            self._encode_jit = _enc
+        new_r, words, count = self._encode_jit(
+            jnp.asarray(residual), jnp.asarray(grad, jnp.float32),
+            jnp.asarray(tau, jnp.float32))
+        self._residuals[worker] = np.asarray(new_r)
+        self.thresholdAlgorithm.update(int(count), residual.size)
+        if self.residualPostProcessor is not None:
+            self.residualPostProcessor.process(step, tau,
+                                               self._residuals[worker])
+        return {"bitmap": np.asarray(words), "threshold": tau,
+                "worker": worker, "count": int(count)}
+
     @staticmethod
     def apply(message: dict, target: np.ndarray) -> np.ndarray:
+        if "bitmap" in message:
+            from deeplearning4j_tpu.native import xla_ffi
+            delta = np.asarray(xla_ffi.bitmap_decode(
+                message["bitmap"], message["threshold"], target.size))
+            target += delta.reshape(target.shape)
+            return target
         return native.threshold_decode(message["indices"],
                                        message["threshold"], target)
 

@@ -46,3 +46,61 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Attr<int64_t>("seed")
         .Attr<int64_t>("offset")
         .Ret<ffi::Buffer<ffi::F32>>());
+
+/* Bitmap threshold-encode INSIDE an XLA program (round 4 — the
+ * load-bearing form of the bridge): residual in -> (new residual,
+ * 2-bit bitmap words, encoded count).  Args are immutable in XLA, so
+ * the residual is copied into its output buffer and the in-place
+ * kernel runs on the copy. */
+static ffi::Error BitmapEncodeImpl(ffi::Buffer<ffi::F32> residual,
+                                   ffi::Buffer<ffi::F32> threshold_buf,
+                                   ffi::ResultBuffer<ffi::F32> new_residual,
+                                   ffi::ResultBuffer<ffi::U32> bitmap,
+                                   ffi::ResultBuffer<ffi::S64> count) {
+  /* threshold arrives as a scalar BUFFER (not an attr): the adaptive
+   * controller changes tau every step, and attrs are compile-time
+   * constants — a buffer keeps one executable for all taus. */
+  const float threshold = threshold_buf.typed_data()[0];
+  const int64_t n = static_cast<int64_t>(residual.element_count());
+  const int64_t words = static_cast<int64_t>(bitmap->element_count());
+  if (words * 16 < n)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "bitmap buffer too small");
+  float *res = new_residual->typed_data();
+  for (int64_t i = 0; i < n; ++i) res[i] = residual.typed_data()[i];
+  count->typed_data()[0] =
+      dl4j_bitmap_encode(res, n, threshold, bitmap->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    dl4j_xla_bitmap_encode, BitmapEncodeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::U32>>()
+        .Ret<ffi::Buffer<ffi::S64>>());
+
+/* Bitmap decode as an XLA op: the sparse delta (+/-threshold at coded
+ * positions, zero elsewhere) as a dense f32 vector. */
+static ffi::Error BitmapDecodeImpl(ffi::Buffer<ffi::U32> bitmap,
+                                   ffi::Buffer<ffi::F32> threshold_buf,
+                                   ffi::ResultBuffer<ffi::F32> out) {
+  const float threshold = threshold_buf.typed_data()[0];
+  const int64_t n = static_cast<int64_t>(out->element_count());
+  if (static_cast<int64_t>(bitmap.element_count()) * 16 < n)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "bitmap buffer too small");
+  float *o = out->typed_data();
+  for (int64_t i = 0; i < n; ++i) o[i] = 0.0f;
+  dl4j_bitmap_decode(bitmap.typed_data(), n, threshold, o);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    dl4j_xla_bitmap_decode, BitmapDecodeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
