@@ -9,3 +9,6 @@ from deeplearning4j_tpu.rl.a3c import (  # noqa: F401
     A3CConfiguration, A3CDiscreteDense, A3CDiscreteDenseAsync, ACPolicy,
     ActorCriticSeparate)
 from deeplearning4j_tpu.rl.gym import GymEnv  # noqa: F401
+from deeplearning4j_tpu.rl.async_nstep_q import (  # noqa: F401
+    AsyncNStepQLearningDiscrete, AsyncQLearningConfiguration, HistoryMDP,
+    HistoryProcessor, HistoryProcessorConfiguration, PixelCartPole)

@@ -1,0 +1,355 @@
+"""Async n-step Q-learning + HistoryProcessor (VERDICT r3 ask #8).
+
+Reference: rl4j ``AsyncNStepQLearningDiscrete(Dense)`` — worker threads
+roll out n steps under epsilon-greedy on a shared Q-network, bootstrap
+the n-step return from a periodically-synced TARGET network, and apply
+their gradients Hogwild-style to the shared params — and rl4j
+``HistoryProcessor`` — the Atari-class image-observation pipeline
+(grayscale downscale + skip-frame + history stacking) that turns a
+pixel env into a (history, h, w) tensor observation (SURVEY.md §2.7).
+
+TPU-first notes: the n-step TD update is ONE jitted computation
+(forward + bwd + Adam over the rollout batch); worker threads exist to
+pipeline env/device round-trip LATENCY (the measured economics of the
+Hogwild A3C in a3c.py), not compute.  HistoryProcessor's resize is a
+jitted area-average (exact for integer factors).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.rl.a3c import _init_mlp, _mlp
+from deeplearning4j_tpu.rl.mdp import (MDP, DiscreteSpace, ObservationSpace,
+                                       StepReply)
+from deeplearning4j_tpu.rl.qlearning import EpsGreedy
+
+__all__ = ["AsyncQLearningConfiguration", "AsyncNStepQLearningDiscrete",
+           "HistoryProcessor", "HistoryProcessorConfiguration",
+           "HistoryMDP", "PixelCartPole"]
+
+
+@dataclasses.dataclass
+class AsyncQLearningConfiguration:
+    """Reference: AsyncQLearningConfiguration fields."""
+    seed: int = 123
+    maxEpochStep: int = 200
+    maxStep: int = 20000
+    numThread: int = 4
+    nstep: int = 5
+    gamma: float = 0.99
+    learningRate: float = 1e-3
+    minEpsilon: float = 0.05
+    epsilonNbStep: int = 5000
+    targetDqnUpdateFreq: int = 100   # updates between target syncs
+
+
+class AsyncNStepQLearningDiscrete:
+    """Hogwild n-step Q-learning over a dense (or history-stacked,
+    flattened) observation MDP."""
+
+    def __init__(self, mdp_factory, conf: Optional[
+            AsyncQLearningConfiguration] = None, hidden=(64,)):
+        self.conf = conf or AsyncQLearningConfiguration()
+        c = self.conf
+        self.mdps: List[MDP] = [mdp_factory(i) for i in range(c.numThread)]
+        shape = self.mdps[0].getObservationSpace().shape
+        self.nIn = int(np.prod(shape))
+        self.nOut = self.mdps[0].getActionSpace().getSize()
+        key = jax.random.PRNGKey(c.seed)
+        self.params = _init_mlp(key, (self.nIn,) + tuple(hidden)
+                                + (self.nOut,))
+        self.target_params = jax.tree.map(lambda a: a, self.params)
+        self._optState = jax.tree.map(
+            lambda a: {"m": jnp.zeros_like(a), "v": jnp.zeros_like(a)},
+            self.params)
+        self.stepCount = 0
+        self._updates = 0
+        self._make_update()
+
+    # ------------------------------------------------------------------
+    def _make_update(self):
+        c = self.conf
+
+        def loss_fn(params, obs, acts, targets):
+            q = _mlp(params, obs)                       # (b, nOut)
+            qa = jnp.take_along_axis(q, acts[:, None], 1)[:, 0]
+            return jnp.mean((qa - targets) ** 2)
+
+        def update(params, opt, obs, acts, targets, it):
+            loss, g = jax.value_and_grad(loss_fn)(params, obs, acts,
+                                                  targets)
+            t = it.astype(jnp.float32) + 1.0
+            b1, b2, eps = 0.9, 0.999, 1e-8
+
+            def leaf(p, gg, st):
+                m = b1 * st["m"] + (1 - b1) * gg
+                v = b2 * st["v"] + (1 - b2) * gg * gg
+                mh = m / (1 - b1 ** t)
+                vh = v / (1 - b2 ** t)
+                return (p - c.learningRate * mh / (jnp.sqrt(vh) + eps),
+                        {"m": m, "v": v})
+
+            flat_p, tdef = jax.tree_util.tree_flatten(
+                params, is_leaf=lambda x: isinstance(x, jnp.ndarray))
+            flat_g = jax.tree_util.tree_leaves(g)
+            flat_s = jax.tree_util.tree_leaves(
+                opt, is_leaf=lambda x: isinstance(x, dict)
+                and set(x) == {"m", "v"})
+            outs = [leaf(p, gg, st)
+                    for p, gg, st in zip(flat_p, flat_g, flat_s)]
+            newp = jax.tree_util.tree_unflatten(tdef, [o[0] for o in outs])
+            news = jax.tree_util.tree_unflatten(tdef, [o[1] for o in outs])
+            return newp, news, loss
+
+        self._update = jax.jit(update)
+        self._qvals = jax.jit(lambda p, o: _mlp(p, o))
+
+    # ------------------------------------------------------------------
+    def train(self) -> None:
+        c = self.conf
+        lock = threading.Lock()
+        eps = EpsGreedy(c.minEpsilon, c.epsilonNbStep, seed=c.seed)
+
+        def worker(widx: int):
+            env = self.mdps[widx]
+            rng = np.random.RandomState(c.seed + 1000 * widx)
+            obs = np.asarray(env.reset(), np.float32).ravel()
+            ep_steps = 0
+            while True:
+                with lock:
+                    if self.stepCount >= c.maxStep:
+                        return
+                    params = self.params          # stale Hogwild snapshot
+                    tparams = self.target_params
+                    step_now = self.stepCount
+                o_l, a_l, r_l = [], [], []
+                done = False
+                for _ in range(c.nstep):
+                    q = np.asarray(self._qvals(
+                        params, jnp.asarray(obs[None])))[0]
+                    if rng.rand() < eps.epsilon(step_now + len(o_l)):
+                        a = int(rng.randint(self.nOut))
+                    else:
+                        a = int(np.argmax(q))
+                    reply = env.step(a)
+                    o_l.append(obs)
+                    a_l.append(a)
+                    r_l.append(float(reply.getReward()))
+                    obs = np.asarray(reply.getObservation(),
+                                     np.float32).ravel()
+                    ep_steps += 1
+                    if reply.isDone() or ep_steps >= c.maxEpochStep:
+                        done = True
+                        break
+                if done:
+                    R = 0.0
+                else:
+                    # bootstrap from the TARGET network (rl4j semantics)
+                    R = float(np.max(np.asarray(self._qvals(
+                        tparams, jnp.asarray(obs[None])))[0]))
+                targets = []
+                for rr in reversed(r_l):
+                    R = rr + c.gamma * R
+                    targets.append(R)
+                targets.reverse()
+                with lock:
+                    self.params, self._optState, _ = self._update(
+                        self.params, self._optState,
+                        jnp.asarray(np.stack(o_l)),
+                        jnp.asarray(a_l, jnp.int32),
+                        jnp.asarray(targets, jnp.float32),
+                        jnp.asarray(self._updates, jnp.int32))
+                    self._updates += 1
+                    self.stepCount += len(o_l)
+                    if self._updates % c.targetDqnUpdateFreq == 0:
+                        self.target_params = jax.tree.map(
+                            lambda a: a, self.params)
+                if done:
+                    obs = np.asarray(env.reset(), np.float32).ravel()
+                    ep_steps = 0
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(len(self.mdps))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # ------------------------------------------------------------------
+    def qValues(self, obs) -> np.ndarray:
+        return np.asarray(self._qvals(
+            self.params,
+            jnp.asarray(np.asarray(obs, np.float32).ravel()[None])))[0]
+
+    def play(self, env: MDP, max_steps: int = 500) -> float:
+        """Greedy rollout; returns the episode reward."""
+        obs = np.asarray(env.reset(), np.float32).ravel()
+        total = 0.0
+        for _ in range(max_steps):
+            a = int(np.argmax(self.qValues(obs)))
+            reply = env.step(a)
+            total += float(reply.getReward())
+            obs = np.asarray(reply.getObservation(), np.float32).ravel()
+            if reply.isDone():
+                break
+        return total
+
+
+# ---------------------------------------------------------------------------
+# HistoryProcessor — the Atari-class image pipeline
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class HistoryProcessorConfiguration:
+    """Reference: HistoryProcessor.Configuration (historyLength,
+    rescaledWidth/Height, cropping, skipFrame)."""
+    historyLength: int = 4
+    rescaledWidth: int = 16
+    rescaledHeight: int = 16
+    croppingWidth: int = 0      # 0 = no crop
+    croppingHeight: int = 0
+    offsetX: int = 0
+    offsetY: int = 0
+    skipFrame: int = 2
+
+
+class HistoryProcessor:
+    """Grayscale-downscale + skip-frame + stack (reference semantics:
+    ``record`` every frame, ``add`` every skipFrame-th; ``getHistory``
+    is the (historyLength, h, w) observation)."""
+
+    def __init__(self, conf: Optional[HistoryProcessorConfiguration] = None):
+        self.conf = conf or HistoryProcessorConfiguration()
+        self._frames: deque = deque(maxlen=self.conf.historyLength)
+        self._recorded = 0
+
+        c = self.conf
+
+        @jax.jit
+        def scale(img):
+            x = jnp.asarray(img, jnp.float32)
+            if x.ndim == 3:                      # (h, w, c) -> grayscale
+                x = jnp.mean(x, axis=-1)
+            if c.croppingWidth and c.croppingHeight:
+                x = x[c.offsetY:c.offsetY + c.croppingHeight,
+                      c.offsetX:c.offsetX + c.croppingWidth]
+            h, w = x.shape
+            if h % c.rescaledHeight == 0 and w % c.rescaledWidth == 0:
+                fh, fw = h // c.rescaledHeight, w // c.rescaledWidth
+                x = x.reshape(c.rescaledHeight, fh,
+                              c.rescaledWidth, fw).mean(axis=(1, 3))
+            else:
+                x = jax.image.resize(
+                    x, (c.rescaledHeight, c.rescaledWidth), "linear")
+            return x
+        self._scale = scale
+
+    def record(self, frame) -> bool:
+        """Feed one raw frame; returns True when it entered the history
+        (every ``skipFrame``-th frame, reference convention)."""
+        take = self._recorded % max(self.conf.skipFrame, 1) == 0
+        self._recorded += 1
+        if take:
+            self._frames.append(np.asarray(self._scale(frame)))
+        return take
+
+    def startEpisode(self, frame) -> None:
+        """Reset history to `historyLength` copies of the first frame."""
+        self._frames.clear()
+        self._recorded = 0
+        f = np.asarray(self._scale(frame))
+        for _ in range(self.conf.historyLength):
+            self._frames.append(f)
+        self._recorded = 1
+
+    def getHistory(self) -> np.ndarray:
+        return np.stack(self._frames)            # (len, h, w)
+
+
+class HistoryMDP(MDP):
+    """Wrap a pixel-observation MDP with a HistoryProcessor: observations
+    become (historyLength, h, w) stacks; env steps during skipped frames
+    repeat the chosen action (reference skip-frame semantics)."""
+
+    def __init__(self, inner: MDP,
+                 conf: Optional[HistoryProcessorConfiguration] = None):
+        self.inner = inner
+        self.hp = HistoryProcessor(conf)
+        c = self.hp.conf
+        self._space = ObservationSpace(
+            (c.historyLength, c.rescaledHeight, c.rescaledWidth))
+
+    def getObservationSpace(self):
+        return self._space
+
+    def getActionSpace(self):
+        return self.inner.getActionSpace()
+
+    def reset(self):
+        self.hp.startEpisode(self.inner.reset())
+        return self.hp.getHistory()
+
+    def step(self, action) -> StepReply:
+        c = self.hp.conf
+        total = 0.0
+        done = False
+        for _ in range(max(c.skipFrame, 1)):
+            reply = self.inner.step(action)
+            total += float(reply.getReward())
+            frame = reply.getObservation()
+            done = reply.isDone()
+            if done:
+                break
+        self.hp._frames.append(np.asarray(self.hp._scale(frame)))
+        return StepReply(self.hp.getHistory(), total, done)
+
+    def isDone(self):
+        return self.inner.isDone()
+
+
+class PixelCartPole(MDP):
+    """CartPole rendered as a synthetic grayscale image — the
+    Atari-shaped stand-in used to exercise the HistoryProcessor pipeline
+    offline (reference tests use ALE; no ROMs in this image)."""
+
+    def __init__(self, seed: int = 0, size: Tuple[int, int] = (32, 32)):
+        from deeplearning4j_tpu.rl.mdp import CartPole
+        self.inner = CartPole(seed=seed)
+        self.h, self.w = size
+
+    def getObservationSpace(self):
+        return ObservationSpace((self.h, self.w))
+
+    def getActionSpace(self) -> DiscreteSpace:
+        return self.inner.getActionSpace()
+
+    def _render(self, state) -> np.ndarray:
+        x, _xdot, theta, _thdot = [float(v) for v in np.asarray(state)]
+        img = np.zeros((self.h, self.w), np.float32)
+        cx = int(np.clip((x / 2.4 + 1.0) / 2.0 * (self.w - 1), 0,
+                         self.w - 1))
+        base = self.h - 4
+        img[base:base + 3, max(cx - 2, 0):cx + 3] = 1.0   # cart
+        # pole: line from cart at angle theta
+        for i in range(self.h // 2):
+            px = int(np.clip(cx + np.sin(theta) * i, 0, self.w - 1))
+            py = int(np.clip(base - np.cos(theta) * i, 0, self.h - 1))
+            img[py, px] = 0.7
+        return img
+
+    def reset(self):
+        return self._render(self.inner.reset())
+
+    def step(self, action) -> StepReply:
+        reply = self.inner.step(action)
+        return StepReply(self._render(reply.getObservation()),
+                         reply.getReward(), reply.isDone())
+
+    def isDone(self):
+        return self.inner.isDone()

