@@ -27,6 +27,11 @@ from deeplearning4j_tpu.datavec.audio import (  # noqa: F401
     AudioFeatureRecordReader, WavFileRecordReader, mfcc, read_wav,
     spectrogram)
 from deeplearning4j_tpu.datavec.codec import CodecRecordReader  # noqa: F401
+try:  # arrow adapter needs pyarrow (present in-image; optional elsewhere)
+    from deeplearning4j_tpu.datavec.arrow import (  # noqa: F401
+        ArrowConverter, ArrowRecordReader)
+except ImportError:  # pragma: no cover
+    pass
 from deeplearning4j_tpu.datavec.columnar import (  # noqa: F401
     ColumnarConverter, JDBCRecordReader)
 from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401

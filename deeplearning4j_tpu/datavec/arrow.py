@@ -1,0 +1,159 @@
+"""Arrow format adapter (VERDICT r3 ask #9 / missing #6).
+
+Reference: ``datavec-arrow`` ``ArrowConverter.java`` /
+``ArrowRecordReader`` — records <-> Arrow columnar batches, plus
+feather/IPC file round trips.  Built on pyarrow (in-image); importing
+this module without pyarrow raises with a clear message.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+try:
+    import pyarrow as pa
+    import pyarrow.feather as feather
+    import pyarrow.ipc as ipc
+except ImportError as _e:  # pragma: no cover
+    raise ImportError(
+        "datavec.arrow requires pyarrow (absent in this environment)"
+    ) from _e
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.schema import (ColumnMetaData, ColumnType,
+                                               Schema)
+from deeplearning4j_tpu.datavec.writable import (DoubleWritable,
+                                                 FloatWritable, IntWritable,
+                                                 LongWritable, Text,
+                                                 Writable)
+
+__all__ = ["ArrowConverter", "ArrowRecordReader"]
+
+_TO_ARROW = {
+    ColumnType.Integer: pa.int32(),
+    ColumnType.Long: pa.int64(),
+    ColumnType.Double: pa.float64(),
+    ColumnType.Float: pa.float32(),
+    ColumnType.String: pa.string(),
+    ColumnType.Categorical: pa.string(),
+    ColumnType.Boolean: pa.bool_(),
+    ColumnType.Time: pa.int64(),
+}
+
+
+def _writable_for(arrow_type, value) -> Writable:
+    if value is None:
+        return Text("")
+    if pa.types.is_integer(arrow_type):
+        return LongWritable(int(value)) if pa.types.is_int64(arrow_type) \
+            else IntWritable(int(value))
+    if pa.types.is_float32(arrow_type):
+        return FloatWritable(float(value))
+    if pa.types.is_floating(arrow_type):
+        return DoubleWritable(float(value))
+    if pa.types.is_boolean(arrow_type):
+        return IntWritable(int(bool(value)))
+    return Text(str(value))
+
+
+class ArrowConverter:
+    """records <-> pyarrow Table, feather/IPC files (reference:
+    ArrowConverter.toArrowColumns / readFromFile / writeRecordBatchTo)."""
+
+    @staticmethod
+    def toTable(records: List[List[Writable]], schema: Schema) -> pa.Table:
+        cols = {}
+        for i, c in enumerate(schema.columns):
+            at = _TO_ARROW.get(c.columnType, pa.string())
+            vals = []
+            for r in records:
+                w = r[i]
+                if at == pa.string():
+                    vals.append(str(w.value))
+                elif pa.types.is_integer(at):
+                    vals.append(w.toLong())
+                elif pa.types.is_boolean(at):
+                    vals.append(bool(w.toInt()))
+                else:
+                    vals.append(w.toDouble())
+            cols[c.name] = pa.array(vals, type=at)
+        return pa.table(cols)
+
+    @staticmethod
+    def fromTable(table: pa.Table) -> List[List[Writable]]:
+        out: List[List[Writable]] = []
+        arrays = [(col.type, col.to_pylist()) for col in table.columns]
+        for ri in range(table.num_rows):
+            out.append([_writable_for(t, vals[ri]) for t, vals in arrays])
+        return out
+
+    @staticmethod
+    def schemaFromTable(table: pa.Table) -> Schema:
+        cols = []
+        for f in table.schema:
+            if pa.types.is_int64(f.type):
+                ct = ColumnType.Long
+            elif pa.types.is_integer(f.type):
+                ct = ColumnType.Integer
+            elif pa.types.is_float32(f.type):
+                ct = ColumnType.Float
+            elif pa.types.is_floating(f.type):
+                ct = ColumnType.Double
+            elif pa.types.is_boolean(f.type):
+                ct = ColumnType.Boolean
+            else:
+                ct = ColumnType.String
+            cols.append(ColumnMetaData(f.name, ct))
+        return Schema(cols)
+
+    # -- files ----------------------------------------------------------
+    @staticmethod
+    def writeFeather(records, schema: Schema, path: str) -> None:
+        feather.write_feather(ArrowConverter.toTable(records, schema), path)
+
+    @staticmethod
+    def readFeather(path: str):
+        table = feather.read_table(path)
+        return (ArrowConverter.fromTable(table),
+                ArrowConverter.schemaFromTable(table))
+
+    @staticmethod
+    def writeIpcStream(records, schema: Schema, path: str) -> None:
+        table = ArrowConverter.toTable(records, schema)
+        with ipc.new_stream(path, table.schema) as w:
+            w.write_table(table)
+
+    @staticmethod
+    def readIpcStream(path: str):
+        with ipc.open_stream(path) as r:
+            table = r.read_all()
+        return (ArrowConverter.fromTable(table),
+                ArrowConverter.schemaFromTable(table))
+
+
+class ArrowRecordReader(RecordReader):
+    """Iterate records out of a feather/IPC file (reference:
+    ArrowRecordReader)."""
+
+    def __init__(self):
+        self._records: List[List[Writable]] = []
+        self._i = 0
+        self.schema: Optional[Schema] = None
+
+    def initialize(self, path: str) -> "ArrowRecordReader":
+        try:
+            self._records, self.schema = ArrowConverter.readFeather(path)
+        except pa.ArrowInvalid:
+            self._records, self.schema = ArrowConverter.readIpcStream(path)
+        self._i = 0
+        return self
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._records)
+
+    def next(self) -> List[Writable]:
+        r = self._records[self._i]
+        self._i += 1
+        return r
+
+    def reset(self) -> None:
+        self._i = 0

@@ -375,6 +375,12 @@ class TransformProcess:
             s = st.out_schema(s)
         return records
 
+    def hasFilters(self) -> bool:
+        """True when any step can DROP rows (row counts then aren't
+        partition-additive — the distributed count check skips)."""
+        return any(type(st).__name__ in ("_Filter", "_RemoveInvalid")
+                   for st in self.steps)
+
     def toJson(self) -> str:
         return json.dumps({
             "initialSchema": json.loads(self.initialSchema.toJson()),
@@ -495,3 +501,39 @@ class SparkTransformExecutor:
             else 256
         return LocalTransformExecutor.executeParallel(records, tp,
                                                       minChunk=chunk)
+
+    @staticmethod
+    def executeDistributed(records: List[Record],
+                           tp: TransformProcess) -> List[Record]:
+        """Distributed TransformProcess over a ``jax.distributed``
+        cluster (round 4 — the multi-host capability, not just the API):
+        each PROCESS transforms its round-robin partition of the input
+        (Spark ``mapPartitions`` semantics — results stay distributed;
+        concatenating every rank's return equals the single-host
+        ``execute``), and a cross-process ``psum`` verifies the global
+        row count so a silently-dead rank cannot fake completion.
+        Single-process callers degrade to the local parallel executor
+        over the full input."""
+        import jax
+
+        nproc = jax.process_count()
+        if nproc <= 1:
+            return SparkTransformExecutor.execute(records, tp)
+        rank = jax.process_index()
+        shard = records[rank::nproc]
+        out = LocalTransformExecutor.executeParallel(shard, tp)
+
+        # global row-count check across ranks (Gloo/ICI collective over
+        # one device per process)
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        counts = multihost_utils.process_allgather(
+            _np.asarray([len(out)], _np.int32))
+        expected = sum(len(records[r::nproc]) for r in range(nproc))
+        got = int(_np.asarray(counts).sum())
+        if got != expected and not tp.hasFilters():
+            raise RuntimeError(
+                f"distributed transform row-count mismatch: {got} != "
+                f"{expected}")
+        return out

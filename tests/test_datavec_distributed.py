@@ -1,0 +1,141 @@
+"""Arrow adapter + distributed TransformProcess (VERDICT r3 ask #9).
+
+The multi-host ETL demo reuses the test_multiprocess harness: two OS
+processes join a ``jax.distributed`` cluster and run ONE
+TransformProcess via ``SparkTransformExecutor.executeDistributed`` —
+each rank transforms its partition (Spark mapPartitions semantics) and
+a cross-process psum validates the global row count.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (DoubleWritable, IntWritable, Schema,
+                                        Text, TransformProcess)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _schema():
+    return (Schema.Builder().addColumnInteger("a").addColumnDouble("b")
+            .addColumnString("s").build())
+
+
+def _records(n=20):
+    return [[IntWritable(i), DoubleWritable(i * 0.5), Text(f"r{i}")]
+            for i in range(n)]
+
+
+# ------------------------------------------------------------------ arrow --
+def test_arrow_roundtrip_feather_and_ipc(tmp_path):
+    pytest.importorskip("pyarrow")
+    from deeplearning4j_tpu.datavec import ArrowConverter, ArrowRecordReader
+    recs, schema = _records(), _schema()
+
+    f = str(tmp_path / "t.feather")
+    ArrowConverter.writeFeather(recs, schema, f)
+    back, schema2 = ArrowConverter.readFeather(f)
+    assert schema2.getColumnNames() == ["a", "b", "s"]
+    assert len(back) == len(recs)
+    assert back[3][0].toInt() == 3
+    assert back[3][1].toDouble() == pytest.approx(1.5)
+    assert back[3][2].value == "r3"
+
+    s = str(tmp_path / "t.arrow")
+    ArrowConverter.writeIpcStream(recs, schema, s)
+    back2, _ = ArrowConverter.readIpcStream(s)
+    assert [w.value for w in back2[7]] == [r.value if hasattr(r, "value")
+                                           else r for r in
+                                           [7, 3.5, "r7"]]
+
+    rr = ArrowRecordReader().initialize(f)
+    seen = 0
+    while rr.hasNext():
+        rec = rr.next()
+        assert rec[0].toInt() == seen
+        seen += 1
+    assert seen == len(recs)
+
+
+def test_arrow_table_schema_inference():
+    pytest.importorskip("pyarrow")
+    import pyarrow as pa
+
+    from deeplearning4j_tpu.datavec import ArrowConverter
+    table = pa.table({"x": pa.array([1, 2], pa.int64()),
+                      "y": pa.array([0.5, 1.5], pa.float32()),
+                      "ok": pa.array([True, False])})
+    schema = ArrowConverter.schemaFromTable(table)
+    assert [c.columnType for c in schema.columns] == \
+        ["Long", "Float", "Boolean"]
+    recs = ArrowConverter.fromTable(table)
+    assert recs[0][0].toLong() == 1 and recs[1][2].toInt() == 0
+
+
+# ------------------------------------------------- distributed transform --
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+_WORKER = textwrap.dedent("""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+jax.distributed.initialize({addr!r}, num_processes=2, process_id=pid)
+from deeplearning4j_tpu.datavec import (DoubleWritable, IntWritable, Schema,
+                                        Text, TransformProcess)
+from deeplearning4j_tpu.datavec.transform import SparkTransformExecutor
+
+schema = (Schema.Builder().addColumnInteger("a").addColumnDouble("b")
+          .addColumnString("s").build())
+records = [[IntWritable(i), DoubleWritable(i * 0.5), Text("r%d" % i)]
+           for i in range(20)]
+tp = (TransformProcess.Builder(schema)
+      .integerMathOp("a", "Add", 100)
+      .removeColumns("s").build())
+out = SparkTransformExecutor.executeDistributed(records, tp)
+rows = [[w.value for w in r] for r in out]
+print("SHARD", json.dumps(rows), flush=True)
+""")
+
+
+def test_distributed_transform_two_processes(tmp_path):
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    script = _WORKER.format(root=_ROOT, addr=addr)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for pid in range(2)]
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=300)
+        assert p.returncode == 0, stderr[-2000:]
+        line = next(l for l in stdout.splitlines() if l.startswith("SHARD"))
+        outs.append(json.loads(line[len("SHARD "):]))
+
+    # union of the two ranks' partitions == the single-process result
+    tp = (TransformProcess.Builder(_schema())
+          .integerMathOp("a", "Add", 100).removeColumns("s").build())
+    expected = [[w.value for w in r] for r in tp.execute(_records())]
+    merged = []
+    for i in range(len(expected)):
+        rank, off = i % 2, i // 2
+        merged.append(outs[rank][off])
+    assert merged == expected
+    assert len(outs[0]) == 10 and len(outs[1]) == 10
