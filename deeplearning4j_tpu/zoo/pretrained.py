@@ -55,27 +55,56 @@ def transplant(src, dst, strict: bool = False) -> List[str]:
     """Copy parameters from ``src`` into ``dst`` by layer position + shape.
 
     The workhorse of the h5→zoo path: ``src`` is typically a net produced
-    by ``KerasModelImport`` and ``dst`` a zoo architecture.  Layers are
-    paired in network order; every param whose name and shape match is
-    copied.  Mismatched layers (e.g. a replaced classifier head, or a
-    conv-only h5 against a net with dense layers) are skipped unless
-    ``strict``.  Returns the list of dst layer keys that received weights.
+    by ``KerasModelImport`` and ``dst`` a zoo architecture.  Pairing
+    rules (round 4 — the greedy scan could silently mis-map when the
+    source had an EXTRA layer with the same shapes as a dst layer):
+
+    - equal parameterized-layer counts: strict POSITIONAL pairing
+      (index i <-> index i) — an extra same-shaped layer cannot shift
+      the mapping;
+    - differing counts: forward shape-scan as before, but any dst layer
+      with MULTIPLE consecutive same-shaped source candidates logs a
+      mis-mapping warning (and raises under ``strict``).
+
+    Mismatched layers are skipped unless ``strict``.  Returns the list
+    of dst layer keys that received weights.
     """
+    import logging
     src_layers = _weighty_layers(src)
     dst_layers = _weighty_layers(dst)
+    positional = len(src_layers) == len(dst_layers)
     loaded: List[str] = []
     si = 0
-    for dk, dp in dst_layers:
-        # find the next src layer that matches this dst layer's shapes
+    for di, (dk, dp) in enumerate(dst_layers):
         matched = None
-        for j in range(si, len(src_layers)):
-            sp = src_layers[j][1]
+        if positional:
+            sp = src_layers[di][1]
             common = [k for k in dp if k in sp]
-            if common and all(
-                    tuple(sp[k].shape) == tuple(dp[k].shape)
-                    for k in common):
-                matched = j
-                break
+            if common and all(tuple(sp[k].shape) == tuple(dp[k].shape)
+                              for k in common):
+                matched = di
+        else:
+            # find the next src layer that matches this dst layer's shapes
+            candidates = []
+            for j in range(si, len(src_layers)):
+                sp = src_layers[j][1]
+                common = [k for k in dp if k in sp]
+                if common and all(
+                        tuple(sp[k].shape) == tuple(dp[k].shape)
+                        for k in common):
+                    candidates.append(j)
+                    if len(candidates) > 1:
+                        break
+            if len(candidates) > 1:
+                msg = (f"transplant: dst layer {dk} has multiple "
+                       f"same-shaped source candidates (layers "
+                       f"{[src_layers[j][0] for j in candidates]}) — "
+                       "positional mapping may be wrong; pass strict=True "
+                       "to refuse, or align the architectures")
+                if strict:
+                    raise ValueError(msg)
+                logging.getLogger("deeplearning4j_tpu").warning(msg)
+            matched = candidates[0] if candidates else None
         if matched is None:
             if strict:
                 raise ValueError(
