@@ -32,6 +32,8 @@ try:  # arrow adapter needs pyarrow (present in-image; optional elsewhere)
         ArrowConverter, ArrowRecordReader)
 except ImportError:  # pragma: no cover
     pass
+from deeplearning4j_tpu.datavec.excel import (  # noqa: F401
+    ExcelRecordReader, writeXlsx)
 from deeplearning4j_tpu.datavec.columnar import (  # noqa: F401
     ColumnarConverter, JDBCRecordReader)
 from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401
