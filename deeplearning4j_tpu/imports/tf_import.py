@@ -539,3 +539,4 @@ def _as_graphdef(graph):
 
 
 from deeplearning4j_tpu.imports import tf_import_ext  # noqa: E402,F401  isort:skip
+from deeplearning4j_tpu.imports import tf_import_ext2  # noqa: E402,F401  isort:skip

@@ -339,6 +339,70 @@ def _mlp():
         [_spec(4, 6)], {"x": _x(4, 6, seed=54)})
 
 
+
+# ------------------------------------------------- round-4 rule additions
+@corpus("shape_size_rank")
+def _shape_meta():
+    return (lambda x: tf.cast(tf.shape(x)[0] * tf.size(x) * tf.rank(x),
+                              tf.float32) + 0.0 * tf.reduce_sum(x),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=60)})
+
+
+@corpus("einsum_matmul")
+def _einsum():
+    w = tf.Variable(_x(4, 5, seed=61, scale=0.5))
+    return (lambda x: tf.einsum("ij,jk->ik", x, w),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=62)})
+
+
+@corpus("tensor_scatter_add")
+def _tscatter():
+    idx = tf.constant([[0], [2]], tf.int32)
+    upd = tf.constant(_x(2, 4, seed=63))
+    return (lambda x: tf.tensor_scatter_nd_add(x, idx, upd),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=64)})
+
+
+@corpus("cumsum_axis1")
+def _cumsum():
+    return (lambda x: tf.cumsum(x, axis=1), [_spec(3, 4)],
+            {"x": _x(3, 4, seed=65)})
+
+
+@corpus("broadcast_to")
+def _broadcast_to():
+    return (lambda x: tf.broadcast_to(x, [3, 4]) * 1.0,
+            [_spec(1, 4)], {"x": _x(1, 4, seed=66)})
+
+
+@corpus("space_depth_roundtrip")
+def _space_depth():
+    return (lambda x: tf.nn.depth_to_space(
+        tf.nn.space_to_depth(x, 2), 2), [_spec(1, 4, 4, 3)],
+        {"x": _x(1, 4, 4, 3, seed=71)})
+
+
+@corpus("clip_by_value")
+def _clip():
+    return (lambda x: tf.clip_by_value(x, -0.5, 0.5),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=72)})
+
+
+@corpus("sparse_softmax_ce")
+def _sparse_ce():
+    labels = tf.constant([0, 2, 1], tf.int32)
+    return (lambda x: tf.nn.sparse_softmax_cross_entropy_with_logits(
+        labels=labels, logits=x), [_spec(3, 4)],
+        {"x": _x(3, 4, seed=73)})
+
+
+@corpus("xdivy_xlogy")
+def _xdivy():
+    y = tf.constant(_x(3, 4, seed=74, pos=True))
+    return (lambda x: tf.math.xdivy(x, y) + tf.math.xlogy(x, y),
+            [_spec(3, 4)], {"x": _x(3, 4, seed=75)})
+
+
 # ----------------------------------------------------------------- the tests
 def _freeze(fn, specs):
     from tensorflow.python.framework.convert_to_constants import (
