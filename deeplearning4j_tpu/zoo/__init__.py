@@ -5,6 +5,8 @@ from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertConfig  # noqa: F401
 from deeplearning4j_tpu.zoo.models2 import (  # noqa: F401
     C3D, Darknet19, InceptionResNetV1, SqueezeNet, TinyYOLO, UNet, VGG19,
     Xception)
+from deeplearning4j_tpu.zoo.models4 import (  # noqa: F401
+    FaceNetNN4Small2, TextGenerationLSTM, YOLO2)
 from deeplearning4j_tpu.zoo.models3 import (  # noqa: F401
     NASNet, PixelShuffleLayer, SRGAN)
 from deeplearning4j_tpu.zoo.pretrained import (  # noqa: F401

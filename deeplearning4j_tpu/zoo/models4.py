@@ -1,0 +1,215 @@
+"""Zoo architectures, part 4: the last reference-zoo members.
+
+Reference: deeplearning4j-zoo ``org/deeplearning4j/zoo/model/
+{TextGenerationLSTM,FaceNetNN4Small2,YOLO2}.java`` (SURVEY.md §2.5 zoo
+row).
+
+TPU notes: TextGenerationLSTM's stacked recurrence is two ``lax.scan``
+regions inside the one fused step (TBPTT-ready); FaceNetNN4Small2's
+inception branches are fusion-friendly concat DAGs with an
+L2-normalized embedding vertex; YOLO2's passthrough/reorg route is a
+``SpaceToDepthLayer`` + skip-concat — the same depth-space primitive
+SRGAN dogfoods in reverse.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.learning.config import Adam, RmsProp
+from deeplearning4j_tpu.models.graph import ComputationGraph
+from deeplearning4j_tpu.models.graph_conf import (L2NormalizeVertex,
+                                                  MergeVertex)
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.convolutional import (SpaceToDepthLayer,
+                                                      Yolo2OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer,
+                                               ConvolutionMode, DenseLayer,
+                                               GlobalPoolingLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.zoo.models import ZooModel
+
+__all__ = ["TextGenerationLSTM", "FaceNetNN4Small2", "YOLO2"]
+
+
+@dataclasses.dataclass
+class TextGenerationLSTM(ZooModel):
+    """Reference: zoo/model/TextGenerationLSTM.java — char-level
+    generator: two GravesLSTM(256) over one-hot characters + mcxent
+    per-timestep head, TBPTT 50 (the classic char-rnn)."""
+    numClasses: int = 77                 # totalUniqueCharacters default
+    hiddenSize: int = 256
+    tbpttLength: int = 50
+
+    def init(self) -> MultiLayerNetwork:
+        n = self.numClasses
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(RmsProp(1e-3)).list()
+             .layer(GravesLSTM.builder().nIn(n).nOut(self.hiddenSize)
+                    .activation("tanh").build())
+             .layer(GravesLSTM.builder().nOut(self.hiddenSize)
+                    .activation("tanh").build())
+             .layer(RnnOutputLayer.builder("mcxent").nOut(n)
+                    .activation("softmax").build())
+             .backpropType("TruncatedBPTT")
+             .tBPTTLength(self.tbpttLength)
+             .setInputType(InputType.recurrent(n)))
+        net = MultiLayerNetwork(b.build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class FaceNetNN4Small2(ZooModel):
+    """Reference: zoo/model/FaceNetNN4Small2.java (+ FaceNetHelper
+    inception modules) — the OpenFace nn4.small2 variant: stem, 3a/3b/3c
+    + 4a/4e + 5a/5b inception modules (3x3 + 5x5 + pool-proj branches,
+    reduced widths), average pool, and an L2-NORMALIZED 128-d embedding
+    (triplet-training geometry preserved by the norm vertex)."""
+    numClasses: int = 128                # embeddingSize
+    inputShape: Tuple[int, int, int] = (3, 96, 96)
+
+    def graphBuilder(self):
+        gb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(Adam(1e-3)).weightInit("RELU")
+              .convolutionMode(ConvolutionMode.Same).graphBuilder())
+        gb.addInputs("input").setInputTypes(self._it())
+
+        def conv_bn(name, inp, n, k, s=1):
+            gb.addLayer(name, ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(k, k).stride(s, s).hasBias(False)
+                        .build(), inp)
+            gb.addLayer(name + "_bn", BatchNormalization.builder()
+                        .activation("relu").build(), name)
+            return name + "_bn"
+
+        def inception(name, inp, n1, r3, n3, r5, n5, npool, pool="MAX",
+                      stride=1):
+            """3x3 + 5x5 reduce-expand branches, pool-proj, optional 1x1
+            (n1=0 skips it — the reference's 3c/4e shapes)."""
+            branches = []
+            if n1:
+                branches.append(conv_bn(name + "_1x1", inp, n1, 1, stride))
+            b3 = conv_bn(name + "_3x3r", inp, r3, 1)
+            branches.append(conv_bn(name + "_3x3", b3, n3, 3, stride))
+            if r5:
+                b5 = conv_bn(name + "_5x5r", inp, r5, 1)
+                branches.append(conv_bn(name + "_5x5", b5, n5, 5, stride))
+            gb.addLayer(name + "_pool", SubsamplingLayer.builder()
+                        .poolingType(pool).kernelSize(3, 3)
+                        .stride(stride, stride).build(), inp)
+            if npool:
+                branches.append(conv_bn(name + "_poolp", name + "_pool",
+                                        npool, 1))
+            else:
+                branches.append(name + "_pool")
+            gb.addVertex(name, MergeVertex(), *branches)
+            return name
+
+        x = conv_bn("stem1", "input", 64, 7, 2)         # 48x48
+        gb.addLayer("stem_pool", SubsamplingLayer.builder()
+                    .poolingType("MAX").kernelSize(3, 3).stride(2, 2)
+                    .build(), x)                         # 24x24
+        x = conv_bn("stem2r", "stem_pool", 64, 1)
+        x = conv_bn("stem2", x, 192, 3)
+        gb.addLayer("stem_pool2", SubsamplingLayer.builder()
+                    .poolingType("MAX").kernelSize(3, 3).stride(2, 2)
+                    .build(), x)                         # 12x12
+        x = inception("3a", "stem_pool2", 64, 96, 128, 16, 32, 32)
+        x = inception("3b", x, 64, 96, 128, 32, 64, 64, pool="AVG")
+        x = inception("3c", x, 0, 128, 256, 32, 64, 0, stride=2)  # 6x6
+        x = inception("4a", x, 256, 96, 192, 32, 64, 128, pool="AVG")
+        x = inception("4e", x, 0, 160, 256, 64, 128, 0, stride=2)  # 3x3
+        x = inception("5a", x, 256, 96, 384, 0, 0, 96, pool="AVG")
+        x = inception("5b", x, 256, 96, 384, 0, 0, 96)
+        gb.addLayer("avgpool", GlobalPoolingLayer.builder()
+                    .poolingType("AVG").build(), x)
+        gb.addLayer("bottleneck", DenseLayer.builder()
+                    .nOut(self.numClasses).activation("identity").build(),
+                    "avgpool")
+        gb.addVertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        gb.setOutputs("embeddings")
+        return gb
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.graphBuilder().build())
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class YOLO2(ZooModel):
+    """Reference: zoo/model/YOLO2.java — full Darknet-19 detector:
+    backbone to 13x13, a 26x26 passthrough route reorganized with
+    space-to-depth (block 2) and concatenated before the final 1x1 +
+    Yolo2OutputLayer (5 anchors, the reference's COCO priors)."""
+    numClasses: int = 80
+    inputShape: Tuple[int, int, int] = (3, 416, 416)
+    boundingBoxes: Tuple = ((0.57273, 0.677385), (1.87446, 2.06253),
+                            (3.33843, 5.47434), (7.88282, 3.52778),
+                            (9.77052, 9.16828))
+
+    def graphBuilder(self):
+        nB = len(self.boundingBoxes)
+        gb = (NeuralNetConfiguration.builder().seed(self.seed)
+              .updater(Adam(1e-3)).weightInit("RELU")
+              .convolutionMode(ConvolutionMode.Same).graphBuilder())
+        gb.addInputs("input").setInputTypes(self._it())
+
+        def conv_bn(name, inp, n, k):
+            gb.addLayer(name, ConvolutionLayer.builder().nOut(n)
+                        .kernelSize(k, k).hasBias(False).build(), inp)
+            gb.addLayer(name + "_bn", BatchNormalization.builder()
+                        .activation("leakyrelu").build(), name)
+            return name + "_bn"
+
+        def pool(name, inp):
+            gb.addLayer(name, SubsamplingLayer.builder().poolingType("MAX")
+                        .kernelSize(2, 2).stride(2, 2).build(), inp)
+            return name
+
+        x = pool("p1", conv_bn("c1", "input", 32, 3))          # 208
+        x = pool("p2", conv_bn("c2", x, 64, 3))                # 104
+        x = conv_bn("c3", x, 128, 3)
+        x = conv_bn("c4", x, 64, 1)
+        x = pool("p3", conv_bn("c5", x, 128, 3))               # 52
+        x = conv_bn("c6", x, 256, 3)
+        x = conv_bn("c7", x, 128, 1)
+        x = pool("p4", conv_bn("c8", x, 256, 3))               # 26
+        x = conv_bn("c9", x, 512, 3)
+        x = conv_bn("c10", x, 256, 1)
+        x = conv_bn("c11", x, 512, 3)
+        x = conv_bn("c12", x, 256, 1)
+        route = conv_bn("c13", x, 512, 3)                      # 26x26x512
+        x = pool("p5", route)                                  # 13
+        x = conv_bn("c14", x, 1024, 3)
+        x = conv_bn("c15", x, 512, 1)
+        x = conv_bn("c16", x, 1024, 3)
+        x = conv_bn("c17", x, 512, 1)
+        x = conv_bn("c18", x, 1024, 3)
+        x = conv_bn("c19", x, 1024, 3)
+        x = conv_bn("c20", x, 1024, 3)
+        # passthrough: 26x26x64 -> space-to-depth(2) -> 13x13x256
+        r = conv_bn("route_r", route, 64, 1)
+        gb.addLayer("reorg", SpaceToDepthLayer.builder().blockSize(2)
+                    .build(), r)
+        gb.addVertex("concat", MergeVertex(), "reorg", x)
+        x = conv_bn("c21", "concat", 1024, 3)
+        gb.addLayer("pred", ConvolutionLayer.builder()
+                    .nOut(nB * (5 + self.numClasses)).kernelSize(1, 1)
+                    .build(), x)
+        gb.addLayer("yolo", Yolo2OutputLayer.builder()
+                    .boundingBoxes(np.asarray(self.boundingBoxes)).build(),
+                    "pred")
+        gb.setOutputs("yolo")
+        return gb
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.graphBuilder().build())
+        net.init()
+        return net

@@ -98,3 +98,42 @@ class TestHeavyModelTrainingSteps:
         x = rng.rand(2, 3, 8, 28, 28).astype(np.float32)
         net.fit(DataSet(x, _onehot(2, 4)))
         assert np.isfinite(net.score())
+
+
+# --------------------------------------------------- round-4 zoo members --
+def test_text_generation_lstm_trains_tbptt():
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+    net = TextGenerationLSTM(numClasses=12, hiddenSize=16,
+                             tbpttLength=8).init()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 12, (4, 20))
+    x = np.eye(12, dtype=np.float32)[ids].transpose(0, 2, 1)
+    y = np.eye(12, dtype=np.float32)[np.roll(ids, -1, 1)].transpose(0, 2, 1)
+    net.fit(DataSet(x, y))
+    s1 = net.score()
+    for _ in range(6):
+        net.fit(DataSet(x, y))
+    assert net.score() < s1
+    assert net.output(x).shape == (4, 12, 20)
+
+
+def test_facenet_nn4small2_unit_embeddings():
+    from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+    net = FaceNetNN4Small2(inputShape=(3, 32, 32)).init()
+    out = net.output(np.random.RandomState(1).randn(3, 3, 32, 32)
+                     .astype(np.float32))
+    emb = np.asarray((out[0] if isinstance(out, list) else out).numpy())
+    assert emb.shape == (3, 128)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-4)
+
+
+def test_yolo2_passthrough_shapes():
+    from deeplearning4j_tpu.zoo import YOLO2
+    net = YOLO2(inputShape=(3, 64, 64), numClasses=4).init()
+    out = net.output(np.random.RandomState(2).randn(1, 3, 64, 64)
+                     .astype(np.float32))
+    out = np.asarray((out[0] if isinstance(out, list) else out).numpy())
+    # 5 anchors * (5 + 4 classes) at stride-32 grid
+    assert out.shape == (1, 45, 2, 2)
+    assert np.isfinite(out).all()
