@@ -12,3 +12,4 @@ from deeplearning4j_tpu.rl.gym import GymEnv  # noqa: F401
 from deeplearning4j_tpu.rl.async_nstep_q import (  # noqa: F401
     AsyncNStepQLearningDiscrete, AsyncQLearningConfiguration, HistoryMDP,
     HistoryProcessor, HistoryProcessorConfiguration, PixelCartPole)
+from deeplearning4j_tpu.rl.envs import MalmoEnv, VizdoomEnv  # noqa: F401

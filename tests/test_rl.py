@@ -67,3 +67,78 @@ def test_a2c_improves_on_chain():
     a3c.train()
     reward = a3c.getPolicy(greedy=True).play(ChainMDP(n=5, maxSteps=20))
     assert reward == pytest.approx(10.0)
+
+
+def test_malmo_and_vizdoom_protocol_adapters():
+    """rl4j-malmo / rl4j-doom shaped adapters drive protocol fakes (the
+    platforms need game processes; a real AgentHost/DoomGame plugs in
+    unchanged) — and compose with the learners via the MDP SPI."""
+    from deeplearning4j_tpu.rl import MalmoEnv, VizdoomEnv
+
+    class FakeWorldState:
+        def __init__(self, obs, rewards, running):
+            self.observations = obs
+            self.rewards = rewards
+            self.is_mission_running = running
+
+    class FakeAgentHost:
+        def __init__(self):
+            self.pos = 0
+            self.commands = []
+
+        def startMission(self):
+            self.pos = 0
+
+        def sendCommand(self, cmd):
+            self.commands.append(cmd)
+            self.pos += 1 if cmd == "movenorth 1" else -1
+
+        def getWorldState(self):
+            return FakeWorldState([float(self.pos)] * 4,
+                                  [1.0 if self.pos > 0 else 0.0],
+                                  self.pos < 3)
+
+    env = MalmoEnv(FakeAgentHost(), ["movenorth 1", "movesouth 1"],
+                   obs_shape=(4,))
+    obs = env.reset()
+    assert obs.shape == (4,) and not env.isDone()
+    r = env.step(0)
+    assert r.getReward() == 1.0
+    assert env.agent.commands == ["movenorth 1"]
+    env.step(0)
+    r = env.step(0)                     # pos 3 -> mission over
+    assert r.isDone() and env.isDone()
+
+    class FakeState:
+        def __init__(self, buf):
+            self.screen_buffer = buf
+
+    class FakeDoomGame:
+        def __init__(self):
+            self.t = 0
+
+        def new_episode(self):
+            self.t = 0
+
+        def get_state(self):
+            if self.t >= 3:
+                return None
+            return FakeState(np.full((6, 8), self.t, np.float32))
+
+        def make_action(self, buttons):
+            assert sum(buttons) == 1 and len(buttons) == 3
+            self.t += 1
+            return float(buttons[0])    # reward for button 0
+
+        def is_episode_finished(self):
+            return self.t >= 3
+
+    denv = VizdoomEnv(FakeDoomGame(), num_buttons=3, screen_shape=(6, 8))
+    s = denv.reset()
+    assert s.shape == (6, 8)
+    total = 0.0
+    while not denv.isDone():
+        total += denv.step(0).getReward()
+    assert total == 3.0
+    # terminal state has no screen buffer -> blank observation
+    assert (denv._screen() == 0).all()
