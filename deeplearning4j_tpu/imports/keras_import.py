@@ -247,12 +247,22 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
             f"Keras import: Lambda layer {name!r} carries no portable "
             "code; register a framework substitute first with "
             "KerasModelImport.registerLambdaLayer(name, layer)")
-    if cls == "Dropout":
+    if cls in ("Dropout", "SpatialDropout2D", "SpatialDropout1D"):
         rate = float(cfg.get("rate", 0.5))
         return DropoutLayer(dropOut=1.0 - rate), "dropout", None
     if cls == "Activation":
         return (ActivationLayer(activation=_act(cfg.get("activation"))),
                 "activation", None)
+    if cls == "LeakyReLU":
+        from deeplearning4j_tpu.nn.conf.layers import LeakyReLULayer
+        # keras stores the slope as alpha (newer: negative_slope)
+        a = float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))
+        return LeakyReLULayer(alpha=a), "activation", None
+    if cls == "ELU":
+        return ActivationLayer(activation="elu"), "activation", None
+    if cls == "ReLU" and not cfg.get("max_value") \
+            and not cfg.get("threshold"):
+        return ActivationLayer(activation="relu"), "activation", None
     if cls == "Dense":
         units = int(cfg["units"])
         act = _act(cfg.get("activation"))

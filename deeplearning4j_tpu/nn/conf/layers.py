@@ -438,6 +438,18 @@ class ActivationLayer(BaseLayer):
 
 
 @dataclasses.dataclass
+class LeakyReLULayer(ActivationLayer):
+    """Parameterized leaky ReLU (reference: ActivationLayer with an
+    ActivationLReLU(alpha) — the keras LeakyReLU import target; the
+    string activation table is fixed at alpha 0.01)."""
+    alpha: float = 0.3
+
+    def forward(self, params, x, train, key, state):
+        import jax
+        return jax.nn.leaky_relu(x, self.alpha), state
+
+
+@dataclasses.dataclass
 class DropoutLayer(BaseLayer):
     def __post_init__(self):
         if self.dropOut is None:

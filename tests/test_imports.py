@@ -286,6 +286,33 @@ class TestImportEdgeCases:
         x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
         self._kroundtrip(model, x, atol=1e-3)
 
+    def test_keras_activation_layer_classes(self):
+        """Round 4: LeakyReLU (with its stored alpha), ELU, ReLU and
+        SpatialDropout layer classes import with keras-oracle parity."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.LeakyReLU(negative_slope=0.2)
+            if hasattr(tf.keras.layers.LeakyReLU(), "negative_slope")
+            else tf.keras.layers.LeakyReLU(alpha=0.2),
+            tf.keras.layers.Dense(5),
+            tf.keras.layers.ELU(),
+            tf.keras.layers.Dense(4),
+            tf.keras.layers.ReLU(),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(5).randn(4, 6).astype(np.float32)
+        self._kroundtrip(model, x, atol=1e-4)
+
+    def test_keras_spatial_dropout_imports_as_dropout(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(8, 8, 3)),
+            tf.keras.layers.Conv2D(4, 3, padding="same"),
+            tf.keras.layers.SpatialDropout2D(0.4),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(2, activation="softmax")])
+        x = np.random.RandomState(6).rand(2, 8, 8, 3).astype(np.float32)
+        self._kroundtrip(model, x, atol=1e-4)   # inference: dropout = id
+
     def test_keras_lstm_last_step(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(5, 8)),
