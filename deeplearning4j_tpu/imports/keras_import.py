@@ -213,7 +213,7 @@ def _input_type(cfg: Dict, InputType):
 
 
 #: kinds that carry weights (their keras name is kept for the weight store)
-_WEIGHTY = {"dense", "conv", "bn", "lstm", "embedding", "sepconv", "dwconv",
+_WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "embedding", "sepconv", "dwconv",
             "deconv", "simplernn", "gru"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
@@ -301,6 +301,35 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
     if cls == "BatchNormalization":
         return (BatchNormalization(eps=float(cfg.get("epsilon", 1e-3))),
                 "bn", None)
+    if cls == "Conv1D":
+        from deeplearning4j_tpu.nn.conf.convolutional import \
+            Convolution1DLayer
+        k = cfg.get("kernel_size", [3])
+        st = cfg.get("strides", [1])
+        d = cfg.get("dilation_rate", [1])
+        same = cfg.get("padding", "valid") == "same"
+        lay = Convolution1DLayer(
+            nOut=int(cfg["filters"]), kernelSize=int(k[0]),
+            stride=int(st[0]), dilation=int(d[0]),
+            convolutionMode="Same" if same else "Truncate",
+            activation=_act(cfg.get("activation")),
+            hasBias=bool(cfg.get("use_bias", True)))
+        return lay, "conv1d", None
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        from deeplearning4j_tpu.nn.conf.convolutional import \
+            Subsampling1DLayer
+        k = cfg.get("pool_size", [2])
+        st = cfg.get("strides") or k
+        lay = Subsampling1DLayer(
+            poolingType="MAX" if cls == "MaxPooling1D" else "AVG",
+            kernelSize=int(k[0] if isinstance(k, (list, tuple)) else k),
+            stride=int(st[0] if isinstance(st, (list, tuple)) else st))
+        return lay, "pool", None
+    if cls in ("GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        return (GlobalPoolingLayer(
+            poolingType="MAX" if "Max" in cls else "AVG"),
+            "globalpool", None)
     if cls == "LSTM":
         from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
         lstm = LSTM(nOut=int(cfg["units"]),
@@ -484,6 +513,11 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
     elif kind == "conv":
         kern = ws[0]                      # HWIO
         p["W"] = jnp.asarray(kern.transpose(3, 2, 0, 1))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1])
+    elif kind == "conv1d":
+        kern = ws[0]                      # keras (k, in, out) -> (O, I, k)
+        p["W"] = jnp.asarray(kern.transpose(2, 1, 0))
         if len(ws) > 1 and "b" in p:
             p["b"] = jnp.asarray(ws[1])
     elif kind == "bn":

@@ -313,6 +313,28 @@ class TestImportEdgeCases:
         x = np.random.RandomState(6).rand(2, 8, 8, 3).astype(np.float32)
         self._kroundtrip(model, x, atol=1e-4)   # inference: dropout = id
 
+    def test_keras_conv1d_stack(self):
+        """Round 4: Conv1D/MaxPooling1D/GlobalAveragePooling1D import.
+        Keras feeds (b, t, c); our recurrent format is (b, c, t)."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(12, 5)),
+            tf.keras.layers.Conv1D(8, 3, padding="same",
+                                   activation="relu"),
+            tf.keras.layers.MaxPooling1D(2),
+            tf.keras.layers.Conv1D(6, 3, padding="same"),
+            tf.keras.layers.GlobalAveragePooling1D(),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        x = np.random.RandomState(9).randn(4, 12, 5).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            pth = os.path.join(d, "m.h5")
+            model.save(pth)
+            net = KerasModelImport.importKerasSequentialModelAndWeights(pth)
+        keras_out = model.predict(x, verbose=0)
+        ours = net.output(np.transpose(x, (0, 2, 1))).numpy()
+        np.testing.assert_allclose(ours, keras_out, atol=1e-4, rtol=1e-3)
+
     def test_keras_lstm_last_step(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(5, 8)),
