@@ -213,7 +213,7 @@ def _input_type(cfg: Dict, InputType):
 
 
 #: kinds that carry weights (their keras name is kept for the weight store)
-_WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "embedding", "sepconv", "dwconv",
+_WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding", "sepconv", "dwconv",
             "deconv", "simplernn", "gru"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
@@ -330,6 +330,33 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         return (GlobalPoolingLayer(
             poolingType="MAX" if "Max" in cls else "AVG"),
             "globalpool", None)
+    if cls == "Bidirectional":
+        from deeplearning4j_tpu.nn.conf.recurrent import (LSTM,
+                                                          Bidirectional,
+                                                          LastTimeStep)
+        inner_cfg = cfg.get("layer", {})
+        if inner_cfg.get("class_name") != "LSTM":
+            raise ValueError("Keras import: Bidirectional supports LSTM "
+                             "wrapped layers only")
+        icfg = inner_cfg.get("config", {})
+        merge = cfg.get("merge_mode", "concat")
+        mode = {"concat": "CONCAT", "sum": "ADD", "ave": "AVERAGE",
+                "mul": "MUL"}.get(merge)
+        if mode is None:
+            raise ValueError(f"Bidirectional merge_mode {merge!r} "
+                             "unsupported")
+        if not icfg.get("return_sequences", False):
+            # keras merges fwd[T-1] with the BACKWARD scan's own last
+            # output (input position 0); a merged-sequence LastTimeStep
+            # would silently compute fwd[T-1] (+) bwd[T-1] instead
+            raise ValueError(
+                "Keras import: Bidirectional(return_sequences=False) has "
+                "keras-specific last-step semantics (fwd last + backward "
+                "scan last); re-export with return_sequences=True and "
+                "select steps downstream")
+        lstm = LSTM(nOut=int(icfg["units"]),
+                    activation=_act(icfg.get("activation", "tanh")))
+        return Bidirectional(mode, lstm), "bilstm", None
     if cls == "LSTM":
         from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
         lstm = LSTM(nOut=int(cfg["units"]),
@@ -542,6 +569,22 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
         p["RW"] = jnp.asarray(reorder(rec))
         if bias is not None:
             p["b"] = jnp.asarray(reorder(bias))
+    elif kind == "bilstm":
+        # keras weight order: forward [kern, rec, bias], backward [...]
+        tgt = p.get("fwd") is not None and p or None
+        def lstm_into(sub, kern, rec, bias):
+            u = rec.shape[0]
+            def reorder(m):
+                i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
+                                  m[..., 2*u:3*u], m[..., 3*u:4*u])
+                return np.concatenate([i_, f_, o_, g_], axis=-1)
+            sub["W"] = jnp.asarray(reorder(kern))
+            sub["RW"] = jnp.asarray(reorder(rec))
+            if bias is not None:
+                sub["b"] = jnp.asarray(reorder(bias))
+        half = len(ws) // 2
+        lstm_into(p["fwd"], *(list(ws[:half]) + [None] * (3 - half)))
+        lstm_into(p["bwd"], *(list(ws[half:]) + [None] * (3 - half)))
     elif kind == "embedding":
         p["W"] = jnp.asarray(ws[0])
     elif kind in ("sepconv", "dwconv"):

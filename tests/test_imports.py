@@ -335,6 +335,39 @@ class TestImportEdgeCases:
         ours = net.output(np.transpose(x, (0, 2, 1))).numpy()
         np.testing.assert_allclose(ours, keras_out, atol=1e-4, rtol=1e-3)
 
+    def test_keras_bidirectional_lstm(self):
+        """Round 4: Bidirectional(LSTM) import (concat + sum merges),
+        keras-oracle parity in both return_sequences modes."""
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        for merge in ("concat", "sum"):
+            model = tf.keras.Sequential([
+                tf.keras.layers.Input(shape=(7, 5)),
+                tf.keras.layers.Bidirectional(
+                    tf.keras.layers.LSTM(6, return_sequences=True),
+                    merge_mode=merge)])
+            x = np.random.RandomState(11).randn(4, 7, 5).astype(np.float32)
+            with tempfile.TemporaryDirectory() as d:
+                pth = os.path.join(d, "m.h5")
+                model.save(pth)
+                net = KerasModelImport \
+                    .importKerasSequentialModelAndWeights(pth)
+            keras_out = model.predict(x, verbose=0)
+            ours = net.output(np.transpose(x, (0, 2, 1))).numpy()
+            ours = np.transpose(ours, (0, 2, 1))   # (b,n,t) -> (b,t,n)
+            np.testing.assert_allclose(ours, keras_out, atol=1e-4,
+                                       rtol=1e-3, err_msg=merge)
+
+        # return_sequences=False refuses with the semantic explanation
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(7, 5)),
+            tf.keras.layers.Bidirectional(tf.keras.layers.LSTM(6))])
+        with tempfile.TemporaryDirectory() as d:
+            pth = os.path.join(d, "m.h5")
+            model.save(pth)
+            with pytest.raises(ValueError, match="return_sequences"):
+                KerasModelImport.importKerasSequentialModelAndWeights(pth)
+
     def test_keras_lstm_last_step(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(5, 8)),
