@@ -248,6 +248,9 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
             "code; register a framework substitute first with "
             "KerasModelImport.registerLambdaLayer(name, layer)")
     if cls in ("Dropout", "SpatialDropout2D", "SpatialDropout1D"):
+        # SpatialDropout imports as element-wise dropout: inference is
+        # identical (identity); FINE-TUNING regularization differs from
+        # keras's whole-channel dropping
         rate = float(cfg.get("rate", 0.5))
         return DropoutLayer(dropOut=1.0 - rate), "dropout", None
     if cls == "Activation":
@@ -259,9 +262,15 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         a = float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))
         return LeakyReLULayer(alpha=a), "activation", None
     if cls == "ELU":
-        return ActivationLayer(activation="elu"), "activation", None
+        from deeplearning4j_tpu.nn.conf.layers import ELULayer
+        return (ELULayer(alpha=float(cfg.get("alpha", 1.0))),
+                "activation", None)
     if cls == "ReLU" and not cfg.get("max_value") \
             and not cfg.get("threshold"):
+        slope = float(cfg.get("negative_slope", 0.0) or 0.0)
+        if slope:
+            from deeplearning4j_tpu.nn.conf.layers import LeakyReLULayer
+            return LeakyReLULayer(alpha=slope), "activation", None
         return ActivationLayer(activation="relu"), "activation", None
     if cls == "Dense":
         units = int(cfg["units"])
@@ -468,6 +477,7 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
     cur_conv_shape: Optional[Tuple[int, int, int]] = None  # (h, w, c) Keras
 
     n_layers = len(layers_cfg)
+    cur_rnn = False
     for li, lk in enumerate(layers_cfg):
         cls = lk["class_name"]
         cfg = _cfg(lk)
@@ -480,9 +490,20 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
                 input_type = it
                 if it.kind == "CNN":
                     cur_conv_shape = (it.height, it.width, it.channels)
+                elif it.kind == "RNN":
+                    cur_rnn = True
         if cls == "InputLayer":
             continue
         if cls == "Flatten":
+            if cur_conv_shape is None and cur_rnn:
+                # keras flattens (t, c); our recurrent format is (c, t) —
+                # the Dense-kernel row permutation for the 1-D case is
+                # not implemented, and a silent pass would compute wrong
+                # contractions (or crash at inference)
+                raise ValueError(
+                    "Keras import: Flatten after 1-D/recurrent features "
+                    "is unsupported; use GlobalMaxPooling1D/"
+                    "GlobalAveragePooling1D heads instead")
             if cur_conv_shape is not None:
                 pending_flatten[len(our_layers)] = cur_conv_shape
             continue
@@ -571,7 +592,6 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
             p["b"] = jnp.asarray(reorder(bias))
     elif kind == "bilstm":
         # keras weight order: forward [kern, rec, bias], backward [...]
-        tgt = p.get("fwd") is not None and p or None
         def lstm_into(sub, kern, rec, bias):
             u = rec.shape[0]
             def reorder(m):

@@ -438,6 +438,17 @@ class ActivationLayer(BaseLayer):
 
 
 @dataclasses.dataclass
+class ELULayer(ActivationLayer):
+    """Parameterized ELU (keras ELU(alpha) import target; the string
+    activation table is fixed at alpha 1.0)."""
+    alpha: float = 1.0
+
+    def forward(self, params, x, train, key, state):
+        import jax
+        return jax.nn.elu(x, self.alpha), state
+
+
+@dataclasses.dataclass
 class LeakyReLULayer(ActivationLayer):
     """Parameterized leaky ReLU (reference: ActivationLayer with an
     ActivationLReLU(alpha) — the keras LeakyReLU import target; the

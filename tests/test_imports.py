@@ -368,6 +368,32 @@ class TestImportEdgeCases:
             with pytest.raises(ValueError, match="return_sequences"):
                 KerasModelImport.importKerasSequentialModelAndWeights(pth)
 
+    def test_keras_activation_params_and_1d_flatten_guard(self):
+        """Review round 4: ELU(alpha) and ReLU(negative_slope) carry
+        their parameters; Flatten after 1-D features refuses."""
+        import tempfile
+        from deeplearning4j_tpu.imports import KerasModelImport
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.ELU(alpha=0.4),
+            tf.keras.layers.Dense(5),
+            tf.keras.layers.ReLU(negative_slope=0.2),
+            tf.keras.layers.Dense(3)])
+        x = np.random.RandomState(7).randn(4, 6).astype(np.float32)
+        self._kroundtrip(model, x, atol=1e-4)
+
+        bad = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(12, 5)),
+            tf.keras.layers.Conv1D(8, 3, padding="same"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(3)])
+        with tempfile.TemporaryDirectory() as d:
+            pth = os.path.join(d, "m.h5")
+            bad.save(pth)
+            with pytest.raises(ValueError, match="1-D/recurrent"):
+                KerasModelImport.importKerasSequentialModelAndWeights(pth)
+
     def test_keras_lstm_last_step(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(5, 8)),
