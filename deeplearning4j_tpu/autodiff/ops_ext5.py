@@ -894,3 +894,55 @@ def _create(shape=(), dtype="float32", initValue=0.0, **_):
 
 
 _simple("noOp", lambda *xs: xs[0] if xs else jnp.zeros(()))
+
+
+@register_op("barnesSymmetrized")
+def _barnes_symmetrized(**_):
+    def f(rowP, colP, valP):
+        # symmetrize the sparse affinity matrix: P_sym = (P + P^T) / 2
+        # (reference: generic/parity_ops/barnes_symmetrized.cpp).
+        # Bounded-dynamic-shape convention: output edges are the DENSE
+        # matrix re-extracted in row-major order, front-packed to the
+        # 2*nnz bound with a count (t-SNE N is modest; the reference
+        # builds the same symmetrized structure host-side).
+        n = rowP.shape[0] - 1
+        nnz = colP.shape[0]
+        rows = jnp.searchsorted(rowP.astype(jnp.int32),
+                                jnp.arange(nnz, dtype=jnp.int32),
+                                side="right") - 1
+        dense = jnp.zeros((n, n), valP.dtype).at[
+            rows, colP.astype(jnp.int32)].add(valP)
+        sym = (dense + dense.T) * 0.5
+        flat = sym.reshape(-1)
+        keep = flat != 0
+        order = jnp.argsort(~keep, stable=True)
+        bound = min(2 * nnz, n * n)
+        idx = order[:bound]
+        count = jnp.sum(keep).astype(jnp.int64)
+        valid = jnp.arange(bound) < count
+        out_rows = jnp.where(valid, idx // n, 0).astype(jnp.int32)
+        out_cols = jnp.where(valid, idx % n, 0).astype(jnp.int32)
+        out_vals = jnp.where(valid, flat[idx], 0.0)
+        return [out_rows, out_cols, out_vals, count]
+    return f
+
+
+@register_op("knnMindistance")
+def _knn_mindistance(**_):
+    def f(point, lowest, highest):
+        # min distance from a point to an axis-aligned cell (reference:
+        # generic/parity_ops/knn_mindistance.cpp — VPTree/KDTree prune)
+        clamped = jnp.clip(point, lowest, highest)
+        d = point - clamped
+        return jnp.sqrt(jnp.sum(d * d))
+    return f
+
+
+@register_op("cellContains")
+def _cell_contains(**_):
+    def f(corner, width, point):
+        # (reference: generic/parity_ops/cell_contains.cpp — barnes-hut
+        # quad-tree membership): |point - corner| <= width/2 per dim
+        half = width * 0.5
+        return jnp.all(jnp.abs(point - corner) <= half).astype(jnp.bool_)
+    return f

@@ -801,3 +801,51 @@ def test_gradients_ext5(opname, build, phs):
                       res["loss_out"].numpy())
     err = OpValidation.validate(tc)
     assert err is None, f"gradcheck {opname}: {err}"
+
+
+def test_barnes_symmetrized_and_clustering_ops():
+    """Round-4 additions: barnesSymmetrized (bounded CSR symmetrize),
+    knnMindistance (point-to-cell distance), cellContains."""
+    rowP = np.array([0, 2, 3, 3], np.int32)   # 0->{1,2}, 1->{0}
+    colP = np.array([1, 2, 0], np.int32)
+    valP = np.array([0.4, 0.2, 0.8], np.float32)
+    rows, cols, vals, count = _run(lambda sd: sd._op(
+        "barnesSymmetrized", [sd.constant(rowP), sd.constant(colP),
+                              sd.constant(valP)], n_out=4))
+    dense = np.zeros((3, 3), np.float32)
+    dense[0, 1], dense[0, 2], dense[1, 0] = 0.4, 0.2, 0.8
+    ref = (dense + dense.T) / 2
+    got = np.zeros((3, 3), np.float32)
+    for r, c, v in zip(rows[:int(count)], cols[:int(count)],
+                       vals[:int(count)]):
+        got[r, c] = v
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    assert int(count) == 4     # (0,1),(1,0),(0,2),(2,0)
+
+    d, = _run(lambda sd: sd._op(
+        "knnMindistance",
+        [sd.placeholder("p"), sd.constant(np.zeros(2, np.float32)),
+         sd.constant(np.ones(2, np.float32))]),
+        {"p": np.array([2.0, 0.5], np.float32)})
+    assert float(d) == pytest.approx(1.0)     # outside by 1 on axis 0
+    d0, = _run(lambda sd: sd._op(
+        "knnMindistance",
+        [sd.placeholder("p"), sd.constant(np.zeros(2, np.float32)),
+         sd.constant(np.ones(2, np.float32))]),
+        {"p": np.array([0.5, 0.5], np.float32)})
+    assert float(d0) == 0.0                   # inside
+
+    inside, = _run(lambda sd: sd._op(
+        "cellContains",
+        [sd.constant(np.zeros(2, np.float32)),
+         sd.constant(np.full(2, 2.0, np.float32)),
+         sd.placeholder("p")]),
+        {"p": np.array([0.9, -0.9], np.float32)})
+    assert bool(inside)
+    outside, = _run(lambda sd: sd._op(
+        "cellContains",
+        [sd.constant(np.zeros(2, np.float32)),
+         sd.constant(np.full(2, 2.0, np.float32)),
+         sd.placeholder("p")]),
+        {"p": np.array([1.5, 0.0], np.float32)})
+    assert not bool(outside)
