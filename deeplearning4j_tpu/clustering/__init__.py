@@ -3,3 +3,4 @@ org/deeplearning4j/clustering/vptree/VPTree.java, kdtree/KDTree.java)."""
 from deeplearning4j_tpu.clustering.trees import KDTree, VPTree  # noqa: F401
 from deeplearning4j_tpu.clustering.server import (  # noqa: F401
     NearestNeighborsClient, NearestNeighborsServer)
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne  # noqa: F401

@@ -1,0 +1,144 @@
+"""t-SNE (reference: deeplearning4j-core ``org/deeplearning4j/plot/
+BarnesHutTsne.java`` — SURVEY.md §2.5 nearest-neighbors/plot family).
+
+TPU-native design: the reference approximates the N-body repulsion with
+a Barnes-Hut quad-tree (theta) because its gradient loop is scalar
+CPU/JNI code; on TPU the DENSE (N, N) formulation is a pair of
+matmul-shaped reductions that XLA fuses into ONE executable per
+iteration — exact (theta = 0 semantics), and faster than tree walks for
+the N this class targets (thousands).  The ``theta`` knob is accepted
+for API parity and documented as exact-dense.  The gains/momentum
+update follows the reference rule exactly (the ``barnesGains`` op is
+its registry form).
+
+P-matrix construction (perplexity binary search) runs host-side in
+numpy — same as the reference, which builds P once before iterating.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["BarnesHutTsne"]
+
+
+def _conditional_p(D: np.ndarray, perplexity: float,
+                   tol: float = 1e-5, max_tries: int = 50) -> np.ndarray:
+    """Row-wise beta binary search to the target perplexity (reference:
+    BarnesHutTsne.computeGaussianPerplexity)."""
+    n = D.shape[0]
+    P = np.zeros((n, n), np.float64)
+    logU = np.log(perplexity)
+    for i in range(n):
+        beta, lo, hi = 1.0, -np.inf, np.inf
+        Di = np.delete(D[i], i)
+        for _ in range(max_tries):
+            Pi = np.exp(-Di * beta)
+            sumP = max(Pi.sum(), 1e-12)
+            H = np.log(sumP) + beta * float((Di * Pi).sum()) / sumP
+            diff = H - logU
+            if abs(diff) < tol:
+                break
+            if diff > 0:
+                lo = beta
+                beta = beta * 2 if hi == np.inf else (beta + hi) / 2
+            else:
+                hi = beta
+                beta = beta / 2 if lo == -np.inf else (beta + lo) / 2
+        Pi = np.exp(-Di * beta)
+        Pi /= max(Pi.sum(), 1e-12)
+        P[i, np.arange(n) != i] = Pi
+    return P
+
+
+class BarnesHutTsne:
+    """Reference-shaped builder-free config; ``fit(X)`` returns and
+    stores the (N, numDimension) embedding."""
+
+    def __init__(self, numDimension: int = 2, perplexity: float = 30.0,
+                 theta: float = 0.5, learningRate: float = 200.0,
+                 maxIter: int = 500, momentum: float = 0.5,
+                 finalMomentum: float = 0.8, switchMomentumIteration: int = 250,
+                 stopLyingIteration: int = 100, exaggeration: float = 12.0,
+                 seed: int = 42):
+        self.numDimension = numDimension
+        self.perplexity = perplexity
+        self.theta = theta          # accepted for parity; dense-exact here
+        self.learningRate = learningRate
+        self.maxIter = maxIter
+        self.momentum = momentum
+        self.finalMomentum = finalMomentum
+        self.switchMomentumIteration = switchMomentumIteration
+        self.stopLyingIteration = stopLyingIteration
+        self.exaggeration = exaggeration
+        self.seed = seed
+        self.Y: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def fit(self, X) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        if self.perplexity * 3 > n - 1:
+            raise ValueError(f"perplexity {self.perplexity} too large for "
+                             f"{n} samples (needs 3*perplexity < n)")
+        D = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        P = _conditional_p(D, self.perplexity)
+        P = (P + P.T) / (2.0 * n)                   # symmetrize (joint)
+        P = np.maximum(P, 1e-12)
+
+        key = jax.random.PRNGKey(self.seed)
+        Y = 1e-4 * jax.random.normal(key, (n, self.numDimension),
+                                     jnp.float32)
+        inc = jnp.zeros_like(Y)
+        gains = jnp.ones_like(Y)
+        Pj = jnp.asarray(P, jnp.float32)
+        eye = jnp.eye(n, dtype=bool)
+
+        @jax.jit
+        def step(Y, inc, gains, P_eff, mom):
+            # q_ij and the exact gradient — two matmul-shaped reductions
+            sq = jnp.sum(Y * Y, axis=1)
+            D2 = sq[:, None] + sq[None, :] - 2.0 * (Y @ Y.T)
+            num = jnp.where(eye, 0.0, 1.0 / (1.0 + D2))
+            Q = jnp.maximum(num / jnp.sum(num), 1e-12)
+            W = (P_eff - Q) * num                   # (n, n)
+            grad = 4.0 * ((jnp.diag(jnp.sum(W, axis=1)) - W) @ Y)
+            # reference gains rule (the barnesGains op)
+            same = jnp.sign(grad) == jnp.sign(inc)
+            gains = jnp.maximum(
+                jnp.where(same, gains * 0.8, gains + 0.2), 0.01)
+            inc = mom * inc - self.learningRate * gains * grad
+            Y = Y + inc
+            Y = Y - jnp.mean(Y, axis=0)             # recentre
+            kl = jnp.sum(P_eff * jnp.log(P_eff / Q))
+            return Y, inc, gains, kl
+
+        kl = None
+        for it in range(self.maxIter):
+            lying = it < self.stopLyingIteration
+            P_eff = Pj * self.exaggeration if lying else Pj
+            mom = self.momentum if it < self.switchMomentumIteration \
+                else self.finalMomentum
+            Y, inc, gains, kl = step(Y, inc, gains, P_eff,
+                                     jnp.float32(mom))
+        self.klDivergence = float(kl) if kl is not None else float("nan")
+        self.Y = np.asarray(Y)
+        return self.Y
+
+    def getData(self) -> np.ndarray:
+        if self.Y is None:
+            raise ValueError("fit first")
+        return self.Y
+
+    def saveAsFile(self, labels, path: str) -> None:
+        """Reference: BarnesHutTsne.saveAsFile — tab-separated
+        ``y0 y1 ... label`` rows."""
+        Y = self.getData()
+        with open(path, "w", encoding="utf-8") as f:
+            for row, lab in zip(Y, labels):
+                f.write("\t".join(f"{v:.6f}" for v in row)
+                        + f"\t{lab}\n")

@@ -59,3 +59,40 @@ def test_iris_trains_to_high_accuracy():
     net.fit(it, epochs=60)
     it.reset()
     assert net.evaluate(it).accuracy() > 0.93
+
+
+def test_barnes_hut_tsne_separates_clusters(tmp_path):
+    """Reference: deeplearning4j-core BarnesHutTsne — three well-separated
+    Gaussian blobs stay separated in the 2-D embedding, KL is finite,
+    and saveAsFile writes the reference's tab format."""
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering import BarnesHutTsne
+
+    rng = np.random.RandomState(0)
+    centers = np.array([[0, 0, 0, 0], [10, 10, 0, 0], [0, 10, 10, 10]],
+                      np.float64)
+    X = np.concatenate([rng.randn(20, 4) * 0.3 + c for c in centers])
+    labels = np.repeat([0, 1, 2], 20)
+
+    ts = BarnesHutTsne(perplexity=10.0, maxIter=250, seed=3)
+    Y = ts.fit(X)
+    assert Y.shape == (60, 2)
+    assert np.isfinite(ts.klDivergence)
+
+    # intra-cluster spread << inter-cluster separation
+    cents = np.stack([Y[labels == k].mean(0) for k in range(3)])
+    intra = max(np.linalg.norm(Y[labels == k] - cents[k], axis=1).mean()
+                for k in range(3))
+    inter = min(np.linalg.norm(cents[i] - cents[j])
+                for i in range(3) for j in range(i + 1, 3))
+    assert inter > 2.0 * intra, (intra, inter)
+
+    p = tmp_path / "tsne.tsv"
+    ts.saveAsFile(labels, str(p))
+    rows = p.read_text().strip().splitlines()
+    assert len(rows) == 60 and rows[0].count("\t") == 2
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="perplexity"):
+        BarnesHutTsne(perplexity=30.0).fit(X[:10])
