@@ -4,3 +4,5 @@ from deeplearning4j_tpu.clustering.trees import KDTree, VPTree  # noqa: F401
 from deeplearning4j_tpu.clustering.server import (  # noqa: F401
     NearestNeighborsClient, NearestNeighborsServer)
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne  # noqa: F401
+from deeplearning4j_tpu.clustering.kmeans import (  # noqa: F401
+    ClusterSet, KMeansClustering)

@@ -1,0 +1,111 @@
+"""K-means clustering (reference: nearestneighbor-core
+``org/deeplearning4j/clustering/kmeans/KMeansClustering.java`` +
+``cluster/ClusterSet`` — SURVEY.md §2.5 nearest-neighbors family).
+
+TPU-native design: the reference iterates point-by-point over cluster
+assignments in Java; here one Lloyd iteration (assign + recentre) is a
+single jitted computation over the full (N, D) matrix — the assignment
+is a matmul-shaped pairwise-distance reduce, the update a segment-sum.
+k-means++ seeding matches the reference's ``useKMeansPlusPlus`` flag.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["KMeansClustering", "ClusterSet"]
+
+
+class ClusterSet:
+    """Reference-shaped result: centers + assignments."""
+
+    def __init__(self, centers: np.ndarray, assignments: np.ndarray,
+                 inertia: float):
+        self.centers = centers
+        self.assignments = assignments
+        self.inertia = inertia
+
+    def getClusterCount(self) -> int:
+        return int(self.centers.shape[0])
+
+    def getCenters(self) -> np.ndarray:
+        return self.centers
+
+    def classifyPoint(self, point) -> int:
+        d = ((self.centers - np.asarray(point)[None, :]) ** 2).sum(-1)
+        return int(np.argmin(d))
+
+
+class KMeansClustering:
+    """``KMeansClustering.setup(k, maxIter, 'euclidean')`` then
+    ``applyTo(points)`` (reference API shape)."""
+
+    def __init__(self, k: int, maxIterations: int = 100,
+                 distanceFunction: str = "euclidean",
+                 useKMeansPlusPlus: bool = True, seed: int = 0,
+                 tol: float = 1e-6):
+        if distanceFunction not in ("euclidean",):
+            raise ValueError("only euclidean k-means is supported "
+                             "(the reference's default)")
+        self.k = int(k)
+        self.maxIterations = int(maxIterations)
+        self.useKMeansPlusPlus = useKMeansPlusPlus
+        self.seed = seed
+        self.tol = tol
+
+    @staticmethod
+    def setup(k: int, maxIterations: int = 100,
+              distanceFunction: str = "euclidean",
+              useKMeansPlusPlus: bool = True,
+              seed: int = 0) -> "KMeansClustering":
+        return KMeansClustering(k, maxIterations, distanceFunction,
+                                useKMeansPlusPlus, seed)
+
+    # ------------------------------------------------------------------
+    def _init_centers(self, X: np.ndarray) -> np.ndarray:
+        rng = np.random.RandomState(self.seed)
+        n = X.shape[0]
+        if not self.useKMeansPlusPlus:
+            return X[rng.choice(n, self.k, replace=False)].copy()
+        centers = [X[rng.randint(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(((X[:, None, :] - np.stack(centers)[None]) ** 2)
+                        .sum(-1), axis=1)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(X[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def applyTo(self, points) -> ClusterSet:
+        import jax
+        import jax.numpy as jnp
+
+        X = np.asarray(points, np.float32)
+        if X.shape[0] < self.k:
+            raise ValueError(f"{X.shape[0]} points < k={self.k}")
+        Xj = jnp.asarray(X)
+        centers = jnp.asarray(self._init_centers(X), jnp.float32)
+
+        @jax.jit
+        def lloyd(centers):
+            d2 = (jnp.sum(Xj * Xj, 1)[:, None]
+                  + jnp.sum(centers * centers, 1)[None, :]
+                  - 2.0 * Xj @ centers.T)
+            assign = jnp.argmin(d2, axis=1)
+            onehot = jax.nn.one_hot(assign, self.k, dtype=jnp.float32)
+            counts = jnp.sum(onehot, axis=0)
+            sums = onehot.T @ Xj
+            new = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts[:, None], 1.0),
+                            centers)      # empty cluster keeps its center
+            inertia = jnp.sum(jnp.min(d2, axis=1))
+            shift = jnp.max(jnp.sum((new - centers) ** 2, axis=1))
+            return new, assign, inertia, shift
+
+        assign = inertia = None
+        for _ in range(self.maxIterations):
+            centers, assign, inertia, shift = lloyd(centers)
+            if float(shift) < self.tol:
+                break
+        return ClusterSet(np.asarray(centers), np.asarray(assign),
+                          float(inertia))

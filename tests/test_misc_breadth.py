@@ -96,3 +96,34 @@ def test_barnes_hut_tsne_separates_clusters(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="perplexity"):
         BarnesHutTsne(perplexity=30.0).fit(X[:10])
+
+
+def test_kmeans_clustering_recovers_blobs():
+    """Reference: clustering.kmeans.KMeansClustering — one jitted Lloyd
+    iteration; k-means++ seeding; recovered centers match blob means."""
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering import KMeansClustering
+
+    rng = np.random.RandomState(1)
+    true_centers = np.array([[0.0, 0.0], [8.0, 0.0], [0.0, 8.0]])
+    X = np.concatenate([rng.randn(40, 2) * 0.4 + c for c in true_centers])
+    km = KMeansClustering.setup(3, maxIterations=50, seed=5)
+    cs = km.applyTo(X)
+    assert cs.getClusterCount() == 3
+    # each true center is ~matched by a learned center
+    for c in true_centers:
+        d = np.linalg.norm(cs.getCenters() - c[None], axis=1).min()
+        assert d < 0.5, (c, cs.getCenters())
+    # assignments are pure within each blob
+    for b in range(3):
+        seg = cs.assignments[b * 40:(b + 1) * 40]
+        assert (seg == np.bincount(seg).argmax()).mean() > 0.95
+    assert cs.classifyPoint([7.5, 0.2]) == cs.classifyPoint([8.2, -0.3])
+    assert np.isfinite(cs.inertia)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="points < k"):
+        km.applyTo(X[:2])
+    with _pytest.raises(ValueError, match="euclidean"):
+        KMeansClustering(3, distanceFunction="cosine")
