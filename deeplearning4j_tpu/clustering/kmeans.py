@@ -72,8 +72,11 @@ class KMeansClustering:
         for _ in range(1, self.k):
             d2 = np.min(((X[:, None, :] - np.stack(centers)[None]) ** 2)
                         .sum(-1), axis=1)
-            probs = d2 / max(d2.sum(), 1e-12)
-            centers.append(X[rng.choice(n, p=probs)])
+            total = d2.sum()
+            if total <= 0:           # duplicates: any point is as good
+                centers.append(X[rng.randint(n)])
+                continue
+            centers.append(X[rng.choice(n, p=d2 / total)])
         return np.stack(centers)
 
     def applyTo(self, points) -> ClusterSet:
@@ -102,10 +105,12 @@ class KMeansClustering:
             shift = jnp.max(jnp.sum((new - centers) ** 2, axis=1))
             return new, assign, inertia, shift
 
-        assign = inertia = None
         for _ in range(self.maxIterations):
-            centers, assign, inertia, shift = lloyd(centers)
+            centers, _assign, _inertia, shift = lloyd(centers)
             if float(shift) < self.tol:
                 break
+        # final consistent view: assignments/inertia AGAINST the returned
+        # centers (the loop's values lag one update behind)
+        _new, assign, inertia, _ = lloyd(centers)
         return ClusterSet(np.asarray(centers), np.asarray(assign),
                           float(inertia))

@@ -85,7 +85,10 @@ class BarnesHutTsne:
         if self.perplexity * 3 > n - 1:
             raise ValueError(f"perplexity {self.perplexity} too large for "
                              f"{n} samples (needs 3*perplexity < n)")
-        D = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        # ||x||^2 + ||y||^2 - 2XY^T form: the broadcasted (n, n, d)
+        # difference tensor would be O(n^2 d) host memory
+        sq = (X * X).sum(1)
+        D = np.maximum(sq[:, None] + sq[None, :] - 2.0 * (X @ X.T), 0.0)
         P = _conditional_p(D, self.perplexity)
         P = (P + P.T) / (2.0 * n)                   # symmetrize (joint)
         P = np.maximum(P, 1e-12)
@@ -100,6 +103,8 @@ class BarnesHutTsne:
 
         @jax.jit
         def step(Y, inc, gains, P_eff, mom):
+            # (KL is reported against the TRUE P, not the exaggerated
+            # P_eff the gradient uses during early lying iterations)
             # q_ij and the exact gradient — two matmul-shaped reductions
             sq = jnp.sum(Y * Y, axis=1)
             D2 = sq[:, None] + sq[None, :] - 2.0 * (Y @ Y.T)
@@ -114,7 +119,7 @@ class BarnesHutTsne:
             inc = mom * inc - self.learningRate * gains * grad
             Y = Y + inc
             Y = Y - jnp.mean(Y, axis=0)             # recentre
-            kl = jnp.sum(P_eff * jnp.log(P_eff / Q))
+            kl = jnp.sum(Pj * jnp.log(Pj / Q))
             return Y, inc, gains, kl
 
         kl = None

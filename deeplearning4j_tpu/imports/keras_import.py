@@ -512,6 +512,14 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
             raise ValueError(f"Keras import: unsupported layer {cls}")
         lay, kind, out_c = mapped
         our_layers.append((lay, kname if kind in _WEIGHTY else None, kind))
+        # track whether the CURRENT feature map is recurrent-shaped: a
+        # last-step RNN, dense or global-pool head reduces to FF (the
+        # graph path tracks the same via its rnn set)
+        if kind in ("dense", "globalpool") \
+                or type(lay).__name__ == "LastTimeStep":
+            cur_rnn = False
+        elif kind in ("lstm", "bilstm"):
+            cur_rnn = True
         if kind in ("dense", "globalpool"):
             cur_conv_shape = None
         elif kind in _CNN_KINDS and cur_conv_shape is not None:
