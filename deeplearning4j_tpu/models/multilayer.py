@@ -613,6 +613,59 @@ class MultiLayerNetwork:
         axis = 1 if out.ndim == 3 else -1
         return np.asarray(jnp.argmax(out, axis=axis))
 
+    def pretrain(self, iterator, epochs: int = 1) -> None:
+        """Layerwise unsupervised pretraining (reference:
+        ``MultiLayerNetwork.pretrain(DataSetIterator)``): every layer
+        with ``isPretrainLayer`` (VariationalAutoencoder) trains its own
+        ``pretrainLoss`` on the activations feeding it, one fused jitted
+        step per layer (fwd-to-layer + ELBO + bwd + updater)."""
+        from deeplearning4j_tpu.learning.config import Sgd
+        if self.params_ is None:
+            self.init()
+        updater = self.conf.globalConf.get("updater") or Sgd(1e-2)
+        for li, layer in enumerate(self.conf.layers):
+            if not getattr(layer, "isPretrainLayer", False):
+                continue
+            key = str(li)
+            params = self.params_[key]
+            opt = {n: updater.init(v) for n, v in params.items()}
+
+            def step(params, opt, x, it, skey, _li=li, _layer=layer):
+                def loss_fn(p):
+                    h = x
+                    for j in range(_li):     # frozen upstream, inference
+                        jl = self.conf.layers[j]
+                        if j in self.conf.preProcessors:
+                            h = self.conf.preProcessors[j].preProcess(
+                                h, h.shape[0])
+                        h, _ = jl.forward(self.params_[str(j)], h, False,
+                                          None, self.state_.get(str(j),
+                                                                {}))
+                    return _layer.pretrainLoss(p, h, skey)
+                loss, g = jax.value_and_grad(loss_fn)(params)
+                newp, newo = {}, {}
+                lr = updater.currentLr(it, 0)
+                for n, gv in g.items():
+                    upd, st = updater.apply(gv, opt[n], lr, it,
+                                            param=params[n])
+                    newp[n] = params[n] - upd
+                    newo[n] = st
+                return newp, newo, loss
+            jstep = jax.jit(step)
+
+            it_count = 0
+            for _ in range(int(epochs)):
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                for ds in iterator:
+                    x = ds.features.jax.astype(self._dtype)
+                    params, opt, loss = jstep(
+                        params, opt, x, jnp.asarray(it_count, jnp.int32),
+                        jax.random.fold_in(self._fitKey, it_count))
+                    it_count += 1
+            self.params_[key] = params
+            self._scoreArr = loss
+
     def score(self, ds: Optional[DataSet] = None) -> float:
         if ds is None:
             if self._scoreArr is not None:

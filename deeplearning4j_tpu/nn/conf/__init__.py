@@ -270,3 +270,4 @@ def _json_default(o):
         return dataclasses.asdict(o)
     return str(o)
 from deeplearning4j_tpu.nn.conf import attention  # noqa: F401  (registers attention layers)
+from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder  # noqa: F401,E402

@@ -1,0 +1,169 @@
+"""Variational autoencoder layer (reference: deeplearning4j-nn
+``org/deeplearning4j/nn/conf/layers/variational/VariationalAutoencoder``
++ ``layers/variational/VariationalAutoencoder.java`` — the unsupervised
+pretrain layer behind the reference's anomaly-detection workflow).
+
+Semantics follow the reference: encoder MLP -> (mean, logvar) of
+q(z|x); the supervised forward pass outputs the MEAN of q(z|x) (the
+reference's activate()); ``pretrainLoss`` is the negative ELBO with the
+reparameterization trick; ``reconstructionLogProbability`` is the
+importance-sampling estimate used for anomaly scoring;
+``generateAtMeanGivenZ`` decodes a latent point.
+
+TPU-first: the whole ELBO (encoder + sampling + decoder + KL) is one
+fused computation inside MultiLayerNetwork.pretrain's jitted step —
+the reference runs encoder/decoder as separate JNI op chains.
+
+Reconstruction distributions: "gaussian" (decoder emits mean + logvar
+per feature) and "bernoulli" (decoder emits logits).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["VariationalAutoencoder"]
+
+_LOG2PI = 1.8378770664093453
+
+
+@dataclasses.dataclass
+class VariationalAutoencoder(BaseLayer):
+    nIn: int = 0
+    nOut: int = 0                                   # latent size
+    encoderLayerSizes: Tuple[int, ...] = (100,)
+    decoderLayerSizes: Tuple[int, ...] = (100,)
+    reconstructionDistribution: str = "gaussian"    # | "bernoulli"
+    numSamples: int = 1
+
+    isPretrainLayer = True
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+    def weightParamKeys(self):
+        return tuple(k for k in self._param_shapes() if k.startswith("W"))
+
+    # ------------------------------------------------------------------
+    def _param_shapes(self):
+        shapes = {}
+        prev = self.nIn
+        for i, h in enumerate(self.encoderLayerSizes):
+            shapes[f"We{i}"] = (prev, h)
+            shapes[f"be{i}"] = (h,)
+            prev = h
+        shapes["Wmean"] = (prev, self.nOut)
+        shapes["bmean"] = (self.nOut,)
+        shapes["Wlogvar"] = (prev, self.nOut)
+        shapes["blogvar"] = (self.nOut,)
+        prev = self.nOut
+        for i, h in enumerate(self.decoderLayerSizes):
+            shapes[f"Wd{i}"] = (prev, h)
+            shapes[f"bd{i}"] = (h,)
+            prev = h
+        outw = 2 * self.nIn if self.reconstructionDistribution == \
+            "gaussian" else self.nIn
+        shapes["Wout"] = (prev, outw)
+        shapes["bout"] = (outw,)
+        return shapes
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        params = {}
+        wi = self.weightInit or "XAVIER"
+        for name, shape in self._param_shapes().items():
+            key, sub = jax.random.split(key)
+            if name.startswith("W"):
+                params[name] = init_weight(sub, shape, shape[0], shape[-1],
+                                           wi, dtype)
+            else:
+                params[name] = jnp.zeros(shape, dtype)
+        return params
+
+    # ------------------------------------------------------------------
+    def _encode(self, p, x):
+        act = get_activation(self.activation or "relu")
+        h = x
+        for i in range(len(self.encoderLayerSizes)):
+            h = act(h @ p[f"We{i}"] + p[f"be{i}"])
+        mean = h @ p["Wmean"] + p["bmean"]
+        logvar = h @ p["Wlogvar"] + p["blogvar"]
+        return mean, logvar
+
+    def _decode(self, p, z):
+        act = get_activation(self.activation or "relu")
+        h = z
+        for i in range(len(self.decoderLayerSizes)):
+            h = act(h @ p[f"Wd{i}"] + p[f"bd{i}"])
+        return h @ p["Wout"] + p["bout"]
+
+    def _recon_logprob(self, dec_out, x):
+        if self.reconstructionDistribution == "bernoulli":
+            logits = dec_out
+            return jnp.sum(x * jax.nn.log_sigmoid(logits)
+                           + (1 - x) * jax.nn.log_sigmoid(-logits), -1)
+        mean, logvar = jnp.split(dec_out, 2, axis=-1)
+        logvar = jnp.clip(logvar, -10.0, 10.0)
+        return jnp.sum(-0.5 * (_LOG2PI + logvar
+                               + (x - mean) ** 2 / jnp.exp(logvar)), -1)
+
+    def forward(self, params, x, train, key, state):
+        # supervised mode: the activation is the MEAN of q(z|x)
+        # (reference VariationalAutoencoder.activate)
+        x = self._dropin(x, train, key)
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    # ------------------------------------------------------------------
+    def pretrainLoss(self, params, x, key):
+        """Negative ELBO (mean over batch), reparameterized —
+        the quantity MultiLayerNetwork.pretrain minimizes."""
+        mean, logvar = self._encode(params, x)
+        total = 0.0
+        for s in range(max(1, self.numSamples)):
+            eps = jax.random.normal(jax.random.fold_in(key, s),
+                                    mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            total = total + self._recon_logprob(self._decode(params, z), x)
+        recon = total / max(1, self.numSamples)
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + mean ** 2 - 1.0 - logvar, -1)
+        return jnp.mean(kl - recon)
+
+    def reconstructionLogProbability(self, params, x, numSamples: int = 16,
+                                     key=None):
+        """Importance-sampling estimate of log p(x) (reference API — the
+        anomaly-detection score; higher = more 'normal')."""
+        key = key if key is not None else jax.random.PRNGKey(0)
+        x = jnp.asarray(x)
+        mean, logvar = self._encode(params, x)
+        comps = []
+        for s in range(numSamples):
+            eps = jax.random.normal(jax.random.fold_in(key, s),
+                                    mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            log_px_z = self._recon_logprob(self._decode(params, z), x)
+            log_pz = jnp.sum(-0.5 * (_LOG2PI + z ** 2), -1)
+            log_qz = jnp.sum(-0.5 * (_LOG2PI + logvar + eps ** 2), -1)
+            comps.append(log_px_z + log_pz - log_qz)
+        stacked = jnp.stack(comps)
+        return jax.nn.logsumexp(stacked, axis=0) - jnp.log(
+            jnp.asarray(float(numSamples), stacked.dtype))
+
+    def generateAtMeanGivenZ(self, params, z):
+        """Decode latent points to the reconstruction-distribution mean."""
+        dec = self._decode(params, jnp.asarray(z))
+        if self.reconstructionDistribution == "bernoulli":
+            return jax.nn.sigmoid(dec)
+        mean, _ = jnp.split(dec, 2, axis=-1)
+        return mean

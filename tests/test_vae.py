@@ -1,0 +1,78 @@
+"""VariationalAutoencoder layer + MultiLayerNetwork.pretrain
+(reference: deeplearning4j-nn layers/variational — the anomaly-detection
+workflow)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (InputType, NeuralNetConfiguration,
+                                        VariationalAutoencoder)
+from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+
+
+def _net(dist="gaussian", latent=2):
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(VariationalAutoencoder(
+                nOut=latent, encoderLayerSizes=(16,),
+                decoderLayerSizes=(16,), activation="tanh",
+                reconstructionDistribution=dist))
+            .layer(OutputLayer.builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _blobs(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    c = rng.randint(0, 2, n)
+    return (rng.randn(n, 6) * 0.3 + c[:, None] * 2.0).astype(np.float32)
+
+
+def test_vae_pretrain_improves_elbo_and_scores_anomalies():
+    import jax
+    net = _net()
+    layer = net.conf.layers[0]
+    X = _blobs()
+    it = ListDataSetIterator([DataSet(X, np.zeros((128, 2), np.float32))],
+                             batch=128)
+    p0 = net.params_["0"]
+    elbo_before = float(layer.pretrainLoss(p0, X, jax.random.PRNGKey(1)))
+    net.pretrain(it, epochs=60)
+    p1 = net.params_["0"]
+    elbo_after = float(layer.pretrainLoss(p1, X, jax.random.PRNGKey(1)))
+    assert elbo_after < elbo_before - 1.0, (elbo_before, elbo_after)
+
+    # anomaly scoring: in-distribution points score higher log p(x)
+    inliers = np.asarray(layer.reconstructionLogProbability(p1, X[:32]))
+    outliers = np.asarray(layer.reconstructionLogProbability(
+        p1, np.full((32, 6), 8.0, np.float32)))
+    assert inliers.mean() > outliers.mean() + 5.0
+
+    # supervised forward: VAE outputs the latent MEAN (b, nOut)
+    out = net.output(X[:4])
+    assert np.asarray(out.numpy()).shape == (4, 2)
+
+    # decode latent points
+    gen = np.asarray(layer.generateAtMeanGivenZ(
+        p1, np.zeros((3, 2), np.float32)))
+    assert gen.shape == (3, 6) and np.isfinite(gen).all()
+
+
+def test_vae_bernoulli_distribution():
+    import jax
+    net = _net(dist="bernoulli")
+    layer = net.conf.layers[0]
+    rng = np.random.RandomState(3)
+    X = (rng.rand(64, 6) < 0.3).astype(np.float32)
+    it = ListDataSetIterator([DataSet(X, np.zeros((64, 2), np.float32))],
+                             batch=64)
+    net.pretrain(it, epochs=30)
+    p = net.params_["0"]
+    probs = np.asarray(layer.generateAtMeanGivenZ(
+        p, np.zeros((2, 2), np.float32)))
+    assert ((probs >= 0) & (probs <= 1)).all()
+    lp = np.asarray(layer.reconstructionLogProbability(p, X[:8]))
+    assert np.isfinite(lp).all()
