@@ -1,0 +1,193 @@
+"""Small utility/misc layers completing the deeplearning4j-nn layer set.
+
+Reference: deeplearning4j-nn ``conf/layers/{util/MaskLayer,
+misc/ElementWiseMultiplicationLayer, misc/RepeatVector,
+convolutional/{Cropping1D,ZeroPadding1DLayer},
+objdetect-adjacent OCNNOutputLayer}`` (SURVEY.md §2.5 layer-impls row).
+
+TPU notes: all are single fused elementwise/pad/slice ops inside the
+one-executable train step; OCNN's quantile ``r`` follows the
+reference's per-iteration update as layer STATE (like BN's running
+stats), so the hinge objective stays a pure function of params.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["MaskLayer", "RepeatVector", "ElementWiseMultiplicationLayer",
+           "Cropping1D", "ZeroPadding1DLayer", "OCNNOutputLayer"]
+
+
+@dataclasses.dataclass
+class MaskLayer(BaseLayer):
+    """Zeroes masked timesteps (reference: util/MaskLayer — forces
+    downstream layers to see exact zeros at padded positions)."""
+    acceptsMask = True
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def forward(self, params, x, train, key, state, mask=None):
+        if mask is None:
+            return x, state
+        return x * mask[:, None, :].astype(x.dtype), state
+
+
+@dataclasses.dataclass
+class RepeatVector(BaseLayer):
+    """(b, n) -> (b, n, t): repeat a feed-forward vector across time
+    (reference: misc/RepeatVector)."""
+    repetitionFactor: int = 1
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(inputType.size, self.repetitionFactor)
+
+    def forward(self, params, x, train, key, state):
+        return jnp.repeat(x[:, :, None], self.repetitionFactor, axis=2), \
+            state
+
+
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(BaseLayer):
+    """out = activation(x * w + b) with a PER-FEATURE weight vector
+    (reference: misc/ElementWiseMultiplicationLayer)."""
+    nIn: int = 0
+    nOut: int = 0
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+        self.nOut = self.nIn
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nIn)
+
+    def weightParamKeys(self):
+        return ("W",)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        return {"W": jnp.ones((self.nIn,), dtype),
+                "b": jnp.zeros((self.nIn,), dtype)}
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y = x * params["W"] + params["b"]
+        return get_activation(self.activation or "identity")(y), state
+
+
+@dataclasses.dataclass
+class Cropping1D(BaseLayer):
+    """Crop the time dim of (b, c, t) (reference: Cropping1D)."""
+    cropping: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        c = tuple(self.cropping) if isinstance(self.cropping,
+                                               (tuple, list)) \
+            else (int(self.cropping),) * 2
+        self.cropping = c
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def getOutputType(self, inputType):
+        t = inputType.timeSeriesLength
+        if t and t > 0:
+            t = t - self.cropping[0] - self.cropping[1]
+        return InputType.recurrent(inputType.size, t)
+
+    def forward(self, params, x, train, key, state):
+        a, b = self.cropping
+        return x[:, :, a:x.shape[2] - b], state
+
+
+@dataclasses.dataclass
+class ZeroPadding1DLayer(BaseLayer):
+    """Zero-pad the time dim of (b, c, t) (reference:
+    ZeroPadding1DLayer)."""
+    padding: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        p = tuple(self.padding) if isinstance(self.padding, (tuple, list)) \
+            else (int(self.padding),) * 2
+        self.padding = p
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def getOutputType(self, inputType):
+        t = inputType.timeSeriesLength
+        if t and t > 0:
+            t = t + self.padding[0] + self.padding[1]
+        return InputType.recurrent(inputType.size, t)
+
+    def forward(self, params, x, train, key, state):
+        return jnp.pad(x, ((0, 0), (0, 0), self.padding)), state
+
+
+@dataclasses.dataclass
+class OCNNOutputLayer(BaseLayer):
+    """One-class neural network output (reference: OCNNOutputLayer.java,
+    Chalapathy et al.): score = w . sigmoid(V x); objective
+    0.5||V||^2 + 0.5||w||^2 + (1/nu) mean(relu(r - score)) - r with the
+    bias ``r`` tracked as the running nu-quantile of scores (layer
+    state, reference's per-iteration rUpdate)."""
+    nIn: int = 0
+    hiddenSize: int = 10
+    nu: float = 0.04
+    windowSize: int = 10000          # accepted for parity (r is EMA here)
+    initialRValue: float = 0.1
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(1)
+
+    def weightParamKeys(self):
+        return ("V", "w")
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kv, kw = jax.random.split(key)
+        return {"V": init_weight(kv, (self.nIn, self.hiddenSize), self.nIn,
+                                 self.hiddenSize, self.weightInit
+                                 or "XAVIER", dtype),
+                "w": init_weight(kw, (self.hiddenSize,), self.hiddenSize,
+                                 1, self.weightInit or "XAVIER", dtype)}
+
+    def initState(self, inputType, dtype=jnp.float32):
+        return {"r": jnp.asarray(self.initialRValue, dtype)}
+
+    def _score(self, params, x):
+        return jax.nn.sigmoid(x @ params["V"]) @ params["w"]
+
+    def forward(self, params, x, train, key, state):
+        s = self._score(params, x)
+        r = state.get("r", jnp.asarray(self.initialRValue, s.dtype))
+        if train:
+            # running nu-quantile of raw scores -> r (reference rUpdate);
+            # r is STATE (stop-gradient), like BN's running stats
+            q = jnp.quantile(s, jnp.asarray(self.nu, s.dtype))
+            r = 0.9 * r + 0.1 * q
+            state = dict(state, r=jax.lax.stop_gradient(r))
+        # decision function: score - r (reference sign convention:
+        # negative = anomaly)
+        return (s - jax.lax.stop_gradient(r))[:, None], state
+
+    def hasLoss(self) -> bool:
+        return True
+
+    def computeScore(self, labels, output, mask=None):
+        """Per-example one-class hinge on the (score - r) decision value
+        (labels unused).  The ||V||^2/||w||^2 terms ride the config's l2
+        machinery, as in the reference."""
+        return jax.nn.relu(-output[:, 0]) / self.nu

@@ -1,0 +1,100 @@
+"""Utility/misc layers (nn/conf/misc.py): MaskLayer, RepeatVector,
+ElementWiseMultiplication, Cropping1D/ZeroPadding1D, OCNNOutputLayer."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.misc import (Cropping1D,
+                                             ElementWiseMultiplicationLayer,
+                                             MaskLayer, OCNNOutputLayer,
+                                             RepeatVector,
+                                             ZeroPadding1DLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+
+
+def test_mask_crop_pad_repeat_shapes_and_semantics():
+    rng = np.random.RandomState(0)
+
+    # MaskLayer zeroes padded steps inside an RNN stack
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(MaskLayer())
+            .layer(RnnOutputLayer.builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.recurrent(3, 6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.randn(2, 3, 6).astype(np.float32)
+    mask = np.ones((2, 6), np.float32)
+    mask[:, 4:] = 0.0
+    # direct layer semantics
+    y, _ = conf.layers[0].forward({}, x, False, None, {}, mask=mask)
+    assert (np.asarray(y)[:, :, 4:] == 0).all()
+    assert np.allclose(np.asarray(y)[:, :, :4], x[:, :, :4])
+
+    # Cropping1D + ZeroPadding1D round-trip the time dim
+    conf2 = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+             .list()
+             .layer(ZeroPadding1DLayer(padding=(1, 2)))
+             .layer(Cropping1D(cropping=(1, 2)))
+             .layer(RnnOutputLayer.builder("mse").nOut(3)
+                    .activation("identity").build())
+             .setInputType(InputType.recurrent(3, 6)).build())
+    net2 = MultiLayerNetwork(conf2).init()
+    z, _ = conf2.layers[0].forward({}, x, False, None, {})
+    assert z.shape == (2, 3, 9)
+    z2, _ = conf2.layers[1].forward({}, z, False, None, {})
+    assert np.allclose(np.asarray(z2), x)
+    assert net2.output(x).shape == (2, 3, 6)
+
+    # RepeatVector: (b, n) -> (b, n, t)
+    rv = RepeatVector(repetitionFactor=4)
+    v = rng.randn(2, 5).astype(np.float32)
+    out, _ = rv.forward({}, v, False, None, {})
+    assert out.shape == (2, 5, 4)
+    assert np.allclose(np.asarray(out)[:, :, 0], v)
+
+
+def test_elementwise_multiplication_trains():
+    rng = np.random.RandomState(1)
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-2))
+            .list()
+            .layer(ElementWiseMultiplicationLayer())
+            .layer(OutputLayer.builder("mse").nOut(4)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x * np.array([2.0, -1.0, 0.5, 3.0])).astype(np.float32)
+    ds = DataSet(x, y)
+    net.fit(ds)
+    s0 = net.score()
+    for _ in range(60):
+        net.fit(ds)
+    assert net.score() < s0 * 0.2
+    # the learned scaling should approach the target diagonal
+    W = np.asarray(net.params_["0"]["W"])
+    assert W.shape == (4,)
+
+
+def test_ocnn_output_layer_separates_outliers():
+    rng = np.random.RandomState(5)
+    X = (rng.randn(256, 6) * 0.5).astype(np.float32)   # one-class data
+    conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+            .layer(OCNNOutputLayer(hiddenSize=6, nu=0.1))
+            .setInputType(InputType.feedForward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(X, np.zeros((256, 1), np.float32))    # labels unused
+    for _ in range(40):
+        net.fit(ds)
+    inlier = np.asarray(net.output(X[:64]).numpy())[:, 0]
+    outlier = np.asarray(net.output(
+        np.full((64, 6), 6.0, np.float32)).numpy())[:, 0]
+    # decision value (score - r): inliers sit above outliers
+    assert inlier.mean() > outlier.mean()
+    assert np.isfinite(net.score())
