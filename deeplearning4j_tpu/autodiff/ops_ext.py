@@ -406,6 +406,23 @@ def _take_along_axis(axis=-1, **_):
                                             axis=int(axis))
 
 
+@register_op("putAlongAxis")
+def _put_along_axis(axis=-1, reduction="none", **_):
+    """Element-wise scatter (np.put_along_axis / ONNX ScatterElements):
+    out[..., idx[i,j], ...] = upd[i,j] along ``axis``, any rank."""
+    def f(x, idx, upd):
+        ax = int(axis) % x.ndim
+        grids = list(jnp.indices(idx.shape, dtype=jnp.int32))
+        grids[ax] = idx.astype(jnp.int32)
+        at = x.at[tuple(grids)]
+        if reduction == "add":
+            return at.add(upd)
+        if reduction == "mul":
+            return at.multiply(upd)
+        return at.set(upd)
+    return f
+
+
 @register_op("split")
 def _split(numSplit=2, dimension=0, **_):
     def f(x):

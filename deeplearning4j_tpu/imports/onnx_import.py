@@ -181,18 +181,68 @@ def _parse_model(data: bytes):
 # op mapping rules (reference: OpMappingRegistry)
 # ---------------------------------------------------------------------------
 
+# op types whose float initializer inputs are genuine layer weights; other
+# initializers (normalization tables, anchor boxes, masks) stay frozen
+_WEIGHT_BEARING_OPS = frozenset({
+    "MatMul", "Gemm", "Conv", "ConvTranspose", "BatchNormalization",
+    "InstanceNormalization", "LayerNormalization", "GroupNormalization",
+    "LSTM", "GRU", "RNN", "Einsum", "PRelu"})
+
+# layout/dtype ops that hand a tensor through unchanged for the purpose of
+# deciding whether an initializer is a layer weight
+_PASSTHROUGH_OPS = frozenset({
+    "Transpose", "Reshape", "Identity", "Squeeze", "Unsqueeze", "Cast",
+    "Flatten"})
+
+
 class _Ctx:
-    def __init__(self, sd: SameDiff, consts: Dict[str, np.ndarray]):
+    def __init__(self, sd: SameDiff, consts: Dict[str, np.ndarray],
+                 nodes=()):
         self.sd = sd
         self.vars: Dict[str, Any] = {}
         self.consts = dict(consts)
+        # Only initializers consumed by weight-bearing ops — or by the
+        # bias pattern Add/Sum(weight_op_output, init) — fine-tune; blanket
+        # promotion silently trained constant tables (advisor r4).
+        # A backward sweep traces through layout pass-throughs so a kernel
+        # feeding Transpose→MatMul still counts as a weight.
+        consumed: set = set()
+
+        def _trace_back(seeds_only=False):
+            for n in reversed(nodes):
+                if not seeds_only and n.op_type in _WEIGHT_BEARING_OPS:
+                    consumed.update(n.inputs)
+                elif not seeds_only and n.op_type == "Gather":
+                    consumed.update(n.inputs[:1])  # embedding table
+                elif n.op_type in _PASSTHROUGH_OPS and \
+                        any(o in consumed for o in n.outputs):
+                    consumed.update(n.inputs[:1])  # the data input only
+
+        _trace_back()
+        weight_outs: set = set()
+        for n in nodes:
+            if n.op_type in _WEIGHT_BEARING_OPS:
+                weight_outs.update(n.outputs)
+            elif n.op_type in _PASSTHROUGH_OPS and \
+                    any(i in weight_outs for i in n.inputs[:1]):
+                weight_outs.update(n.outputs)
+            elif n.op_type in ("Add", "Sum") and \
+                    any(i in weight_outs for i in n.inputs):
+                weight_outs.update(n.outputs)
+                consumed.update(n.inputs)
+        # biases wrapped in a layout op (Add(mm, Unsqueeze(b))) trace back
+        # to their initializer in a second passthrough-only sweep
+        _trace_back(seeds_only=True)
+        self.trainable: set = {i for i in consumed if i in self.consts}
 
     def get(self, name):
         if name not in self.vars:
             if name in self.consts:
                 val = self.consts[name]
-                if np.issubdtype(val.dtype, np.floating) and val.size > 1:
-                    # frozen weight -> trainable VARIABLE so the imported
+                if name in self.trainable and \
+                        np.issubdtype(val.dtype, np.floating) and \
+                        val.size > 1:
+                    # layer weight -> trainable VARIABLE so the imported
                     # graph fine-tunes (same rule as tf_import._const)
                     self.vars[name] = self.sd.var(f"c_{name}", val)
                 else:
@@ -429,7 +479,7 @@ class OnnxImporter:
             data = f.read()
         nodes, inits, inputs, outputs = _parse_model(data)
         sd = SameDiff.create()
-        ctx = _Ctx(sd, inits)
+        ctx = _Ctx(sd, inits, nodes)
         in_names = []
         for name, _shape in inputs:
             if name in inits:

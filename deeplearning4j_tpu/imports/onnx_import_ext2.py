@@ -73,11 +73,17 @@ def _hardmax(ctx, node):
 # ---- reductions -----------------------------------------------------------
 def _reduce(our):
     def fn(ctx, node):
-        # opset >=18 passes axes as a second input; earlier as an attr
-        if len(node.inputs) > 1:
+        # opset >=18 passes axes as a second input; earlier as an attr.
+        # An absent optional input (name "") or an empty axes tensor means
+        # reduce over ALL axes unless noop_with_empty_axes=1 (identity).
+        if len(node.inputs) > 1 and node.inputs[1]:
             axes = [int(v) for v in ctx.const_val(node.inputs[1])]
         else:
             axes = node.attrs.get("axes")
+        if axes is None or len(axes) == 0:
+            if node.attrs.get("noop_with_empty_axes", 0):
+                return ctx.get(node.inputs[0])
+            axes = None
         attrs = {"keepDims": bool(node.attrs.get("keepdims", 1))}
         if axes is not None:
             attrs["dims"] = list(axes)
@@ -140,27 +146,44 @@ def _scatter_nd(ctx, node):
 
 @_op("ScatterElements")
 def _scatter_elements(ctx, node):
+    # Element-wise semantics (output[indices[i][j]][j] = updates[i][j] for
+    # axis=0), NOT whole-row scatter — mapped to putAlongAxis (advisor r4).
     axis = int(node.attrs.get("axis", 0))
     red = node.attrs.get("reduction", "none")
-    our = {"none": "scatterUpdate", "add": "scatterAdd",
-           "mul": "scatterMul"}.get(red)
-    if our is None or axis != 0:
-        raise ValueError(f"ScatterElements axis={axis} reduction={red!r} "
-                         "unsupported")
-    return ctx.sd._op(our, [ctx.get(node.inputs[0]),
-                            ctx.get(node.inputs[1]),
-                            ctx.get(node.inputs[2])])
+    if red not in ("none", "add", "mul"):
+        raise ValueError(f"ScatterElements reduction={red!r} unsupported")
+    return ctx.sd._op("putAlongAxis", [ctx.get(node.inputs[0]),
+                                       ctx.get(node.inputs[1]),
+                                       ctx.get(node.inputs[2])],
+                      {"axis": axis, "reduction": red})
 
 
 _ONNX_OPS["Scatter"] = _scatter_elements          # deprecated alias
+
+
+@register_op("onnx_topk")
+def _onnx_topk_impl(k=1, axis=-1, largest=1, sorted=True, **_):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(x):
+        ax = int(axis) % x.ndim
+        moved = jnp.moveaxis(x, ax, -1)
+        v, i = lax.top_k(moved if largest else -moved, int(k))
+        if not largest:
+            v = -v
+        return [jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)]
+    return fn
 
 
 @_op("TopK")
 def _topk(ctx, node):
     k = int(ctx.const_val(node.inputs[1])) if len(node.inputs) > 1 \
         else int(node.attrs.get("k", 1))
-    outs = ctx.sd._op("topK", [ctx.get(node.inputs[0])],
-                      {"k": k, "sorted": bool(node.attrs.get("sorted", 1))},
+    outs = ctx.sd._op("onnx_topk", [ctx.get(node.inputs[0])],
+                      {"k": k, "axis": int(node.attrs.get("axis", -1)),
+                       "largest": int(node.attrs.get("largest", 1)),
+                       "sorted": bool(node.attrs.get("sorted", 1))},
                       n_out=2)
     if len(node.outputs) > 1:
         ctx.vars[node.outputs[1]] = outs[1]

@@ -641,6 +641,9 @@ class MultiLayerNetwork:
                         h, _ = jl.forward(self.params_[str(j)], h, False,
                                           None, self.state_.get(str(j),
                                                                 {}))
+                    if _li in self.conf.preProcessors:
+                        h = self.conf.preProcessors[_li].preProcess(
+                            h, h.shape[0])
                     return _layer.pretrainLoss(p, h, skey)
                 loss, g = jax.value_and_grad(loss_fn)(params)
                 newp, newo = {}, {}
@@ -654,6 +657,7 @@ class MultiLayerNetwork:
             jstep = jax.jit(step)
 
             it_count = 0
+            loss = None
             for _ in range(int(epochs)):
                 if hasattr(iterator, "reset"):
                     iterator.reset()
@@ -664,7 +668,8 @@ class MultiLayerNetwork:
                         jax.random.fold_in(self._fitKey, it_count))
                     it_count += 1
             self.params_[key] = params
-            self._scoreArr = loss
+            if loss is not None:
+                self._scoreArr = loss
 
     def score(self, ds: Optional[DataSet] = None) -> float:
         if ds is None:

@@ -46,6 +46,11 @@ class VariationalAutoencoder(BaseLayer):
 
     isPretrainLayer = True
 
+    def preferredFormat(self):
+        # a FeedForwardLayer in the reference: CNN input auto-inserts
+        # CnnToFeedForward (BasePretrainNetwork extends FeedForwardLayer)
+        return "FF"
+
     def inferNIn(self, inputType):
         if not self.nIn:
             self.nIn = inputType.size

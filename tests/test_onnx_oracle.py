@@ -82,6 +82,33 @@ def test_new_rules_registered(name):
     assert name in _ONNX_OPS
 
 
+def test_trainable_initializer_classification():
+    """Only initializers consumed (possibly through layout ops) by
+    weight-bearing ops fine-tune; constant tables stay frozen (advisor
+    r4 — blanket promotion trained anchor boxes and norm tables)."""
+    from types import SimpleNamespace as N
+
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    from deeplearning4j_tpu.imports.onnx_import import _Ctx
+
+    consts = {k: np.ones((4, 4), np.float32) for k in
+              ["w_direct", "w_transposed", "bias_wrapped", "emb_table",
+               "anchor_table"]}
+    nodes = [
+        N(op_type="Transpose", inputs=["w_transposed"], outputs=["wt"]),
+        N(op_type="MatMul", inputs=["x", "wt"], outputs=["mm"]),
+        N(op_type="Unsqueeze", inputs=["bias_wrapped"], outputs=["bu"]),
+        N(op_type="Add", inputs=["mm", "bu"], outputs=["y"]),
+        N(op_type="Gemm", inputs=["y", "w_direct"], outputs=["g"]),
+        N(op_type="Gather", inputs=["emb_table", "ids"], outputs=["e"]),
+        # anchor_table only feeds a Mul — a constant, not a weight
+        N(op_type="Mul", inputs=["g", "anchor_table"], outputs=["z"]),
+    ]
+    ctx = _Ctx(SameDiff.create(), consts, nodes)
+    assert ctx.trainable == {"w_direct", "w_transposed", "bias_wrapped",
+                             "emb_table"}
+
+
 def test_importer_helper_ops():
     """Golden checks for the helper ops the new rules register
     (onnx_hardmax / onnx_resize / onnx_bernoulli / onnx_q(d)qlinear)
@@ -104,8 +131,25 @@ def test_importer_helper_ops():
     hm = run("onnx_hardmax", [x], {"axis": -1})
     np.testing.assert_array_equal(hm, [[0, 1, 0], [1, 0, 0]])  # first max
 
-    img = np.arange(16, np.float32).reshape(1, 1, 4, 4) \
-        if False else np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    def run2(op, ins_np, attrs):
+        sd = SameDiff.create()
+        ins = [sd.placeholder(f"i{k}") for k in range(len(ins_np))]
+        o1, o2 = sd._op(op, ins, attrs, n_out=2, name="o")
+        res = sd.output({f"i{k}": v for k, v in enumerate(ins_np)},
+                        o1.name(), o2.name())
+        for node in sd._ops:
+            OpValidation.recordTested(node.op)
+        return (np.asarray(res[o1.name()].numpy()),
+                np.asarray(res[o2.name()].numpy()))
+
+    # onnx_topk honors largest=0 (smallest-k) and a non-default axis
+    tv, ti = run2("onnx_topk", [x], {"k": 2, "axis": -1, "largest": 0})
+    np.testing.assert_array_equal(tv, [[1.0, 2.0], [4.0, 4.0]])
+    np.testing.assert_array_equal(ti, [[0, 2], [1, 2]])
+    tv0, _ = run2("onnx_topk", [x], {"k": 1, "axis": 0, "largest": 1})
+    np.testing.assert_array_equal(tv0, [[5.0, 4.0, 4.0]])
+
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
     up = run("onnx_resize", [img], {"scaleH": 2.0, "scaleW": 2.0,
                                     "method": "nearest"})
     assert up.shape == (1, 1, 8, 8)

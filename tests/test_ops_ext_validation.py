@@ -353,6 +353,24 @@ def test_counting_sorting():
                                 [sd.placeholder("x"), sd.placeholder("i")],
                                 {"axis": 1}, name="o"),
               np.take_along_axis(x, i, axis=1), {"x": x, "i": i})
+    # putAlongAxis: element-wise scatter (ONNX ScatterElements semantics)
+    u = _R(14).randn(3, 2).astype(np.float32)
+    want = x.copy()
+    np.put_along_axis(want, i, u, axis=1)
+    _validate(lambda sd: sd._op("putAlongAxis",
+                                [sd.placeholder("x"), sd.placeholder("i"),
+                                 sd.placeholder("u")],
+                                {"axis": 1}, name="o"),
+              want, {"x": x, "i": i, "u": u})
+    want_add = x.copy()
+    ii0 = np.array([[0, 2], [1, 0], [2, 1]], np.int32)
+    uu = np.ones((3, 2), np.float32)
+    np.add.at(want_add, (ii0, np.indices(ii0.shape)[1]), uu)
+    _validate(lambda sd: sd._op("putAlongAxis",
+                                [sd.placeholder("x"), sd.placeholder("i"),
+                                 sd.placeholder("u")],
+                                {"axis": 0, "reduction": "add"}, name="o"),
+              want_add, {"x": x, "i": ii0, "u": uu})
 
 
 def test_topk_split_meshgrid():

@@ -61,6 +61,38 @@ def test_vae_pretrain_improves_elbo_and_scores_anomalies():
     assert gen.shape == (3, 6) and np.isfinite(gen).all()
 
 
+def test_vae_pretrain_applies_own_preprocessor():
+    """A preprocessor feeding the pretrain layer itself must be applied
+    (advisor r4: pretrain skipped preProcessors[li]) — here a
+    CnnToFeedForward flattens (b,1,2,3) conv activations into the VAE."""
+    from deeplearning4j_tpu.nn.conf import CnnToFeedForwardPreProcessor
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer.builder().nIn(1).nOut(1).kernelSize(1, 1)
+                   .activation("identity").build())
+            .layer(VariationalAutoencoder(
+                nOut=2, encoderLayerSizes=(8,), decoderLayerSizes=(8,),
+                activation="tanh", reconstructionDistribution="gaussian"))
+            .layer(OutputLayer.builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.convolutional(2, 3, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert 1 in net.conf.preProcessors     # CnnToFeedForward feeds the VAE
+    assert isinstance(net.conf.preProcessors[1], CnnToFeedForwardPreProcessor)
+    X = np.random.RandomState(0).randn(16, 1, 2, 3).astype(np.float32)
+    it = ListDataSetIterator([DataSet(X, np.zeros((16, 2), np.float32))],
+                             batch=16)
+    net.pretrain(it, epochs=2)             # raised mis-shaped input before
+    assert np.isfinite(net.score())
+
+
+def test_pretrain_empty_iterator_keeps_score():
+    net = _net()
+    net.pretrain(ListDataSetIterator([], batch=8), epochs=1)  # no batches
+    assert net._scoreArr is None           # loss never bound — no crash
+
+
 def test_vae_bernoulli_distribution():
     import jax
     net = _net(dist="bernoulli")
