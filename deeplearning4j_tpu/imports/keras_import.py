@@ -5,9 +5,15 @@ keras/KerasModelImport.java`` + per-layer mapping classes
 (``KerasDense``, ``KerasConvolution2D``, ``KerasBatchNormalization``, … —
 SURVEY.md §2.5).
 
-Scope (like the reference's Sequential path): Dense, Conv2D, MaxPooling2D,
-AveragePooling2D, Flatten, Dropout, Activation, BatchNormalization, LSTM,
-Embedding.  h5py reads the file; weights are re-laid-out to this framework's
+Scope (like the reference's near-complete coverage): Dense, Conv1D/2D/3D
+(+Separable/Depthwise/Transpose), pooling (1D/2D/3D/global), Flatten (2D/3D
+feature maps and static-length 1-D), Reshape/Permute (keras channels-last
+semantics), Dropout (+Spatial/Gaussian/Alpha variants), GaussianNoise,
+Activation (+parameterized classes), BatchNormalization, LayerNormalization,
+MultiHeadAttention (self-attention), TimeDistributed (Dense and CNN inner
+layers, incl. Flatten), LSTM/GRU/SimpleRNN, Bidirectional (both
+return_sequences modes), Embedding, Upsampling/ZeroPadding/Cropping.
+h5py reads the file; weights are re-laid-out to this framework's
 conventions:
 
 - Conv2D kernels: Keras HWIO → OIHW.
@@ -208,16 +214,25 @@ def _input_type(cfg: Dict, InputType):
         return InputType.convolutional(int(h), int(w), int(c))
     if len(dims) == 2:          # (t, features) -> our recurrent (n, t)
         t, n = dims
-        return InputType.recurrent(int(n), int(t) if t else None)
+        return InputType.recurrent(int(n), int(t) if t else -1)
+    if len(dims) == 4:          # (t_or_d, h, w, c) -> NCDHW (depth = time)
+        d, h, w, c = dims
+        return InputType.convolutional3D(int(d), int(h), int(w), int(c))
     raise ValueError(f"Unsupported input shape {shape}")
 
 
 #: kinds that carry weights (their keras name is kept for the weight store)
-_WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding", "sepconv", "dwconv",
-            "deconv", "simplernn", "gru"}
+_WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding",
+            "sepconv", "dwconv", "deconv", "simplernn", "gru", "ln", "mha",
+            "conv3d"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
               "dwconv", "deconv"}
+
+
+def _is_weighty(kind: str) -> bool:
+    return kind in _WEIGHTY or \
+        (kind.startswith("td") and kind[2:] in _WEIGHTY)
 
 
 def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
@@ -354,18 +369,14 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         if mode is None:
             raise ValueError(f"Bidirectional merge_mode {merge!r} "
                              "unsupported")
-        if not icfg.get("return_sequences", False):
-            # keras merges fwd[T-1] with the BACKWARD scan's own last
-            # output (input position 0); a merged-sequence LastTimeStep
-            # would silently compute fwd[T-1] (+) bwd[T-1] instead
-            raise ValueError(
-                "Keras import: Bidirectional(return_sequences=False) has "
-                "keras-specific last-step semantics (fwd last + backward "
-                "scan last); re-export with return_sequences=True and "
-                "select steps downstream")
         lstm = LSTM(nOut=int(icfg["units"]),
                     activation=_act(icfg.get("activation", "tanh")))
-        return Bidirectional(mode, lstm), "bilstm", None
+        # keras return_sequences=False merges fwd[T-1] with the BACKWARD
+        # scan's own last output (original position 0) — Bidirectional
+        # implements exactly that via returnSequences=False
+        rs = bool(icfg.get("return_sequences", False))
+        return (Bidirectional(mode, lstm, returnSequences=rs),
+                "bilstm", None)
     if cls == "LSTM":
         from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
         lstm = LSTM(nOut=int(cfg["units"]),
@@ -458,6 +469,103 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         lay = gru if cfg.get("return_sequences", False) \
             else LastTimeStep(gru)
         return lay, "gru", None
+    if cls == "LayerNormalization":
+        from deeplearning4j_tpu.nn.conf.misc import LayerNormalization
+        axis = cfg.get("axis", -1)
+        ax_list = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+        if len(ax_list) != 1:
+            raise ValueError(f"Keras import: LayerNormalization axis="
+                             f"{axis} unsupported (single trailing axis "
+                             "only)")
+        # a positive trailing axis is validated against the input rank in
+        # LayerNormalization.getOutputType (rank is unknown here)
+        return (LayerNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                                   axis=int(ax_list[0])), "ln", None)
+    if cls == "MultiHeadAttention":
+        from deeplearning4j_tpu.nn.conf.attention import \
+            KerasMultiHeadAttention
+        out_shape = cfg.get("output_shape")
+        n_out = 0
+        if out_shape is not None:
+            if isinstance(out_shape, (list, tuple)):
+                if len(out_shape) != 1:
+                    raise ValueError("Keras import: MultiHeadAttention "
+                                     f"output_shape={out_shape} unsupported")
+                n_out = int(out_shape[0])
+            else:
+                n_out = int(out_shape)
+        lay = KerasMultiHeadAttention(
+            nHeads=int(cfg["num_heads"]), keyDim=int(cfg["key_dim"]),
+            valueDim=int(cfg.get("value_dim") or cfg["key_dim"]),
+            nOut=n_out, hasBias=bool(cfg.get("use_bias", True)))
+        return lay, "mha", None
+    if cls == "GaussianNoise":
+        from deeplearning4j_tpu.nn.conf.misc import GaussianNoiseLayer
+        return (GaussianNoiseLayer(stddev=float(cfg.get("stddev", 0.1))),
+                "noise", None)
+    if cls == "GaussianDropout":
+        from deeplearning4j_tpu.nn.conf.misc import GaussianDropoutLayer
+        return (GaussianDropoutLayer(rate=float(cfg.get("rate", 0.5))),
+                "noise", None)
+    if cls == "AlphaDropout":
+        from deeplearning4j_tpu.nn.conf.misc import AlphaDropoutLayer
+        return (AlphaDropoutLayer(rate=float(cfg.get("rate", 0.1))),
+                "noise", None)
+    if cls == "Reshape":
+        from deeplearning4j_tpu.nn.conf.misc import ReshapeLayer
+        return (ReshapeLayer(targetShape=tuple(
+            int(v) for v in cfg["target_shape"])), "reshape", None)
+    if cls == "Permute":
+        from deeplearning4j_tpu.nn.conf.misc import PermuteLayer
+        return (PermuteLayer(dims=tuple(int(v) for v in cfg["dims"])),
+                "reshape", None)
+    if cls == "Conv3D":
+        from deeplearning4j_tpu.nn.conf.convolutional3d import Convolution3D
+        if cfg.get("data_format") == "channels_first":
+            raise ValueError("Keras import: channels_first Conv3D is "
+                             "not supported (save as channels_last)")
+        k = cfg.get("kernel_size", [3, 3, 3])
+        s = cfg.get("strides", [1, 1, 1])
+        d = cfg.get("dilation_rate", [1, 1, 1])
+        same = cfg.get("padding", "valid") == "same"
+        lay = Convolution3D(
+            nOut=int(cfg["filters"]), kernelSize=tuple(int(x) for x in k),
+            stride=tuple(int(x) for x in s),
+            dilation=tuple(int(x) for x in d),
+            convolutionMode="Same" if same else "Truncate",
+            activation=_act(cfg.get("activation")),
+            hasBias=bool(cfg.get("use_bias", True)))
+        return lay, "conv3d", int(cfg["filters"])
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        from deeplearning4j_tpu.nn.conf.convolutional3d import \
+            Subsampling3DLayer
+        k = cfg.get("pool_size", [2, 2, 2])
+        s = cfg.get("strides") or k
+        same = cfg.get("padding", "valid") == "same"
+        lay = Subsampling3DLayer(
+            kernelSize=tuple(int(x) for x in k),
+            stride=tuple(int(x) for x in s),
+            convolutionMode="Same" if same else "Truncate",
+            poolingType="MAX" if cls == "MaxPooling3D" else "AVG")
+        return lay, "pool3d", None
+    if cls == "TimeDistributed":
+        from deeplearning4j_tpu.nn.conf.recurrent import (
+            TimeDistributed, TimeDistributedFlatten)
+        inner = cfg.get("layer", {})
+        inner_cls = inner.get("class_name")
+        if inner_cls == "Flatten":
+            return TimeDistributedFlatten(), "tdflatten", None
+        mapped = _map_keras_layer(inner_cls, inner.get("config", {}))
+        if mapped is None:
+            raise ValueError(f"Keras import: TimeDistributed({inner_cls}) "
+                             "unsupported")
+        ilay, ikind, out_c = mapped
+        if ikind not in ("dense", "conv", "pool", "bn", "activation",
+                         "dropout", "sepconv", "dwconv", "deconv", "ln",
+                         "noise"):
+            raise ValueError(f"Keras import: TimeDistributed({inner_cls}) "
+                             "unsupported")
+        return TimeDistributed(ilay), "td" + ikind, out_c
     return None
 
 
@@ -478,6 +586,9 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
 
     n_layers = len(layers_cfg)
     cur_rnn = False
+    cur_seq: Optional[Tuple[int, int]] = None    # (features, t) RNN shape
+    cur_3d = None                                # InputType CNN3D tracking
+    cur_ff: Optional[int] = None                 # FF feature count
     for li, lk in enumerate(layers_cfg):
         cls = lk["class_name"]
         cfg = _cfg(lk)
@@ -492,39 +603,127 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
                     cur_conv_shape = (it.height, it.width, it.channels)
                 elif it.kind == "RNN":
                     cur_rnn = True
+                    cur_seq = (it.size, it.timeSeriesLength)
+                elif it.kind == "CNN3D":
+                    cur_3d = it
+                elif it.kind == "FF":
+                    cur_ff = it.size
         if cls == "InputLayer":
             continue
         if cls == "Flatten":
-            if cur_conv_shape is None and cur_rnn:
-                # keras flattens (t, c); our recurrent format is (c, t) —
-                # the Dense-kernel row permutation for the 1-D case is
-                # not implemented, and a silent pass would compute wrong
-                # contractions (or crash at inference)
-                raise ValueError(
-                    "Keras import: Flatten after 1-D/recurrent features "
-                    "is unsupported; use GlobalMaxPooling1D/"
-                    "GlobalAveragePooling1D heads instead")
             if cur_conv_shape is not None:
                 pending_flatten[len(our_layers)] = cur_conv_shape
+                continue
+            if cur_3d is not None:
+                # keras flattens (d, h, w, c); ours (c, d, h, w) — 4-tuple
+                # marks the 3D kernel-row permutation for the next Dense
+                pending_flatten[len(our_layers)] = (
+                    cur_3d.depth, cur_3d.height, cur_3d.width,
+                    cur_3d.channels)
+                cur_3d = None
+                continue
+            if cur_rnn and cur_seq is not None and cur_seq[1] \
+                    and cur_seq[1] > 0:
+                # keras flattens (t, c): emit a keras-order ReshapeLayer so
+                # downstream Dense kernels line up without permutation
+                from deeplearning4j_tpu.nn.conf.misc import ReshapeLayer
+                f, t = cur_seq
+                our_layers.append((ReshapeLayer(
+                    targetShape=(int(t) * int(f),)), None, "reshape"))
+                cur_rnn, cur_seq = False, None
+                continue
+            if cur_rnn:
+                raise ValueError(
+                    "Keras import: Flatten after 1-D/recurrent features "
+                    "needs a statically-known sequence length (set the "
+                    "Input shape) — or use GlobalMaxPooling1D/"
+                    "GlobalAveragePooling1D heads")
             continue
         mapped = _map_keras_layer(cls, cfg, is_last=(li == n_layers - 1))
         if mapped is None:
             raise ValueError(f"Keras import: unsupported layer {cls}")
         lay, kind, out_c = mapped
-        our_layers.append((lay, kname if kind in _WEIGHTY else None, kind))
+        if kind == "embedding" and getattr(lay, "inputLength", 0) < 0 \
+                and cur_ff:
+            # a 1-D integer Input: its size IS the sequence length
+            lay.inputLength = int(cur_ff)
+        our_layers.append((lay, kname if _is_weighty(kind) else None, kind))
         # track whether the CURRENT feature map is recurrent-shaped: a
         # last-step RNN, dense or global-pool head reduces to FF (the
         # graph path tracks the same via its rnn set)
         if kind in ("dense", "globalpool") \
-                or type(lay).__name__ == "LastTimeStep":
+                or type(lay).__name__ == "LastTimeStep" \
+                or (kind == "bilstm"
+                    and not getattr(lay, "returnSequences", True)):
             cur_rnn = False
-        elif kind in ("lstm", "bilstm"):
+            cur_seq = None
+        elif kind in ("lstm", "bilstm", "simplernn", "gru", "embedding"):
             cur_rnn = True
+            if cur_seq is not None or kind == "embedding":
+                t = cur_seq[1] if cur_seq is not None else -1
+                out_t = lay.getOutputType(
+                    InputType.recurrent(cur_seq[0] if cur_seq else 0, t))
+                cur_seq = (out_t.size, out_t.timeSeriesLength) \
+                    if out_t.kind == "RNN" else None
         if kind in ("dense", "globalpool"):
             cur_conv_shape = None
         elif kind in _CNN_KINDS and cur_conv_shape is not None:
             cur_conv_shape = _track_shape(
                 cur_conv_shape, lay, _out_channels(out_c, cur_conv_shape))
+        if kind in ("conv1d", "pool") and cur_seq is not None \
+                and cur_conv_shape is None:
+            out_t = lay.getOutputType(InputType.recurrent(*cur_seq))
+            cur_seq = (out_t.size, out_t.timeSeriesLength) \
+                if out_t.kind == "RNN" else None
+        if (kind in ("conv3d", "pool3d") or kind.startswith("td")) \
+                and cur_3d is not None:
+            out_t = lay.getOutputType(cur_3d)
+            if out_t.kind == "CNN3D":
+                cur_3d = out_t
+            elif out_t.kind == "RNN":      # tdflatten / tddense
+                cur_3d = None
+                cur_rnn = True
+                cur_seq = (out_t.size, out_t.timeSeriesLength)
+        elif (kind.startswith("td") or kind == "mha") \
+                and cur_seq is not None:
+            # TimeDistributed / MHA over (b, f, t): features may change
+            out_t = lay.getOutputType(InputType.recurrent(*cur_seq))
+            cur_rnn = True
+            cur_seq = (out_t.size, out_t.timeSeriesLength)
+        if kind == "dense":
+            cur_ff = getattr(lay, "nOut", None)
+        elif kind not in ("noise", "activation", "dropout", "ln", "bn"):
+            cur_ff = None
+        if kind == "reshape":
+            cur_in = None
+            if cur_conv_shape is not None:
+                cur_in = InputType.convolutional(*cur_conv_shape)
+            elif cur_seq is not None:
+                cur_in = InputType.recurrent(*cur_seq)
+            elif cur_3d is not None:
+                cur_in = cur_3d
+            if cur_in is None and cls != "Flatten":
+                # FF input: output type derivable from the target alone
+                from deeplearning4j_tpu.nn.conf.misc import \
+                    _type_from_keras_dims
+                tgt = getattr(lay, "targetShape", None)
+                if tgt is None or -1 in tgt:
+                    raise ValueError(
+                        f"Keras import: {cls} needs statically-known "
+                        "input dims here")
+                out_t = _type_from_keras_dims(tgt)
+            else:
+                out_t = lay.getOutputType(cur_in)
+            cur_conv_shape, cur_seq, cur_3d = None, None, None
+            cur_rnn = False
+            if out_t.kind == "CNN":
+                # keras-side (h, w, c) == our-side dims
+                cur_conv_shape = (out_t.height, out_t.width, out_t.channels)
+            elif out_t.kind == "RNN":
+                cur_rnn = True
+                cur_seq = (out_t.size, out_t.timeSeriesLength)
+            elif out_t.kind == "CNN3D":
+                cur_3d = out_t
 
     for lay, _k, _kind in our_layers:
         builder = builder.layer(lay)
@@ -556,9 +755,19 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
     import jax.numpy as jnp
     if p is None:
         return
+    if kind.startswith("td") and kind != "tdflatten":
+        # TimeDistributed wrapper: params ARE the inner layer's params;
+        # the keras h5 group likewise stores the inner layer's weights
+        kind = kind[2:]
+        kcfg = kcfg.get("layer", {}).get("config", kcfg)
     if kind == "dense":
         kern, bias = ws[0], (ws[1] if len(ws) > 1 else None)
-        if flatten_shape is not None:
+        if flatten_shape is not None and len(flatten_shape) == 4:
+            d, h, w, c = flatten_shape
+            # rows are (d, h, w, c)-ordered; ours expect (c, d, h, w)
+            kern = kern.reshape(d, h, w, c, -1).transpose(3, 0, 1, 2, 4) \
+                .reshape(d * h * w * c, -1)
+        elif flatten_shape is not None:
             h, w, c = flatten_shape
             # rows are (h, w, c)-ordered; ours expect (c, h, w)
             kern = kern.reshape(h, w, c, -1).transpose(2, 0, 1, 3) \
@@ -638,6 +847,27 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
         p["RW"] = jnp.asarray(ws[1])
         if len(ws) > 2:
             p["b"] = jnp.asarray(ws[2])
+    elif kind == "ln":
+        idx = 0
+        if kcfg.get("scale", True):
+            p["gamma"] = jnp.asarray(ws[idx])
+            idx += 1
+        if kcfg.get("center", True):
+            p["beta"] = jnp.asarray(ws[idx])
+    elif kind == "mha":
+        # keras order: query/kernel+bias, key/..., value/...,
+        # attention_output/kernel+bias — shapes match our params directly
+        if len(ws) == 8:
+            (p["Wq"], p["bq"], p["Wk"], p["bk"], p["Wv"], p["bv"],
+             p["Wo"], p["bo"]) = (jnp.asarray(w) for w in ws)
+        else:
+            p["Wq"], p["Wk"], p["Wv"], p["Wo"] = (jnp.asarray(w)
+                                                  for w in ws)
+    elif kind == "conv3d":
+        # keras (kd, kh, kw, in, out) -> ours OIDHW
+        p["W"] = jnp.asarray(ws[0].transpose(4, 3, 0, 1, 2))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1])
     elif kind == "gru":
         # Keras gate order (z, r, h) -> ours (r, u=z, c=h)
         u = ws[1].shape[0]
@@ -796,6 +1026,16 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
         if mapped is None:
             raise ValueError(f"Keras import: unsupported layer {cls}")
         lay, kind, out_c = mapped
+        if kind == "mha":
+            # keras calls MHA with (query, value[, key]); self-attention
+            # repeats one source — the only form a single-input layer node
+            # can represent
+            if len(set(srcs)) != 1:
+                raise ValueError(
+                    "Keras import: MultiHeadAttention with distinct "
+                    "query/value sources (cross-attention) is unsupported; "
+                    "self-attention (mha(x, x)) imports")
+            srcs = srcs[:1]
         if flat_src is not None:
             if kind == "dense":
                 # (h, w, c)->(c, h, w) kernel-row permutation
@@ -807,22 +1047,32 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                     f"Keras import: {cls} consuming a Flatten of a conv "
                     "map is unsupported (flatten-order mismatch would "
                     "silently mis-order features)")
+        if kind == "dense" and srcs[0] in rnn:
+            # keras Dense on (b, t, f) applies per step; wrapping in
+            # TimeDistributed keeps the RNN format through the vertex (a
+            # bare Dense would round-trip (b*t, f) preprocessors and break
+            # downstream merges)
+            from deeplearning4j_tpu.nn.conf.recurrent import TimeDistributed
+            lay, kind = TimeDistributed(lay), "tddense"
         gb.addLayer(name, lay, *srcs)
-        if kind in _WEIGHTY:
+        if _is_weighty(kind):
             weighty.append((name, kind))
-        if kind in ("lstm", "simplernn", "gru"):
+        if kind == "tddense":
+            shapes[name] = None
+            rnn.add(name)
+        elif kind in ("lstm", "simplernn", "gru"):
             shapes[name] = None
             if cfg.get("return_sequences", False):
                 rnn.add(name)
-        elif kind == "embedding":
+        elif kind in ("embedding", "mha"):
             shapes[name] = None
-            rnn.add(name)                      # sequence embedding: (b,t,f)
+            rnn.add(name)                      # sequence output: (b,t,f)
         elif kind in ("dense", "globalpool"):
             shapes[name] = None
         elif kind in _CNN_KINDS:
             cur = shapes.get(srcs[0])
             shapes[name] = _track_shape(cur, lay, _out_channels(out_c, cur))
-        else:                               # bn / activation / dropout
+        else:                               # bn / ln / activation / dropout
             shapes[name] = shapes.get(srcs[0])
             if srcs[0] in rnn:
                 rnn.add(name)

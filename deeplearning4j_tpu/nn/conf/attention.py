@@ -24,7 +24,7 @@ from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
 from deeplearning4j_tpu.nn.weights import init_weight
 
 __all__ = ["SelfAttentionLayer", "LearnedSelfAttentionLayer",
-           "RecurrentAttentionLayer"]
+           "RecurrentAttentionLayer", "KerasMultiHeadAttention"]
 
 
 def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None, impl="auto"):
@@ -254,6 +254,82 @@ class RecurrentAttentionLayer(BaseLayer):
         return y, state
 
 
+@dataclasses.dataclass
+class KerasMultiHeadAttention(BaseLayer):
+    """Keras-``MultiHeadAttention``-shaped self-attention: per-head q/k/v
+    projections with biases and a combining output projection, parameters
+    laid out exactly as keras stores them — query/key kernels
+    ``(nIn, h, keyDim)``, value ``(nIn, h, valueDim)``, output
+    ``(h, valueDim, nOut)`` — so imported weights copy in directly
+    (``imports/keras_import.py``).  Input/output follow the DL4J RNN
+    convention (b, n, t); the score chain dispatches through
+    ``parallel.ring.dot_product_attention`` (flash on TPU for long T).
+    """
+    nIn: int = 0
+    nHeads: int = 1
+    keyDim: int = 0
+    valueDim: int = 0          # 0 -> keyDim
+    nOut: int = 0              # 0 -> nIn
+    hasBias: bool = True
+
+    acceptsMask = True
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+        if not self.valueDim:
+            self.valueDim = self.keyDim
+        if not self.nOut:
+            self.nOut = self.nIn
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut or self.nIn,
+                                   inputType.timeSeriesLength)
+
+    def weightParamKeys(self):
+        return ("Wq", "Wk", "Wv", "Wo")
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        h, dk, dv = self.nHeads, self.keyDim, self.valueDim or self.keyDim
+        wi = self.weightInit or "XAVIER"
+        ks = jax.random.split(key, 4)
+        p = {"Wq": init_weight(ks[0], (self.nIn, h, dk), self.nIn, h * dk,
+                               wi, dtype),
+             "Wk": init_weight(ks[1], (self.nIn, h, dk), self.nIn, h * dk,
+                               wi, dtype),
+             "Wv": init_weight(ks[2], (self.nIn, h, dv), self.nIn, h * dv,
+                               wi, dtype),
+             "Wo": init_weight(ks[3], (h, dv, self.nOut), h * dv, self.nOut,
+                               wi, dtype)}
+        if self.hasBias:
+            p["bq"] = jnp.zeros((h, dk), dtype)
+            p["bk"] = jnp.zeros((h, dk), dtype)
+            p["bv"] = jnp.zeros((h, dv), dtype)
+            p["bo"] = jnp.zeros((self.nOut,), dtype)
+        return p
+
+    def forward(self, params, x, train, key, state, mask=None):
+        from deeplearning4j_tpu.parallel.ring import dot_product_attention
+        x = self._dropin(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))                   # (b, t, nIn)
+        q = jnp.einsum("btf,fhk->bthk", xt, params["Wq"])
+        k = jnp.einsum("btf,fhk->bthk", xt, params["Wk"])
+        v = jnp.einsum("btf,fhv->bthv", xt, params["Wv"])
+        if self.hasBias:
+            q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        # (b, t, h, d) -> (b, h, t, d) for the shared dispatch point
+        ctx = dot_product_attention(q.transpose(0, 2, 1, 3),
+                                    k.transpose(0, 2, 1, 3),
+                                    v.transpose(0, 2, 1, 3), mask=mask)
+        y = jnp.einsum("bhtv,hvo->bto", ctx, params["Wo"])
+        if self.hasBias:
+            y = y + params["bo"]
+        return jnp.transpose(y, (0, 2, 1)), state
+
+
 for _c in [SelfAttentionLayer, LearnedSelfAttentionLayer,
-           RecurrentAttentionLayer]:
+           RecurrentAttentionLayer, KerasMultiHeadAttention]:
     register_layer(_c)

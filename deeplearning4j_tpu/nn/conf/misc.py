@@ -20,11 +20,14 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.activations import get_activation
 from deeplearning4j_tpu.nn.conf.inputs import InputType
-from deeplearning4j_tpu.nn.conf.layers import BaseLayer
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
 from deeplearning4j_tpu.nn.weights import init_weight
 
 __all__ = ["MaskLayer", "RepeatVector", "ElementWiseMultiplicationLayer",
-           "Cropping1D", "ZeroPadding1DLayer", "OCNNOutputLayer"]
+           "Cropping1D", "ZeroPadding1DLayer", "OCNNOutputLayer",
+           "LayerNormalization", "GaussianNoiseLayer",
+           "GaussianDropoutLayer", "AlphaDropoutLayer", "ReshapeLayer",
+           "PermuteLayer"]
 
 
 @dataclasses.dataclass
@@ -134,6 +137,212 @@ class ZeroPadding1DLayer(BaseLayer):
 
 
 @dataclasses.dataclass
+class LayerNormalization(BaseLayer):
+    """Per-example normalization over the feature/channel axis with learned
+    gamma/beta.  The reference exposes layer norm as ``hasLayerNorm`` on
+    dense/recurrent layers (SameDiff ``standardize``); the standalone layer
+    exists for Keras ``LayerNormalization`` import parity.  Feature axis in
+    this framework's formats: FF ``(b, n)`` → axis 1; RNN ``(b, n, t)`` /
+    CNN ``(b, c, h, w)`` → axis 1 (keras's trailing axis in channels-last).
+    """
+    nIn: int = 0
+    eps: float = 1e-3
+    axis: int = -1       # keras channels-last axis; must be the trailing one
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size if inputType.kind in ("FF", "RNN") \
+                else inputType.channels
+
+    def getOutputType(self, inputType):
+        if self.axis != -1:
+            # rank known here: a positive axis is fine iff it IS trailing
+            rank = len(_keras_dims_of(inputType)) + 1   # + batch
+            if self.axis != rank - 1:
+                raise ValueError(
+                    f"LayerNormalization axis={self.axis} unsupported "
+                    "(only the trailing feature axis)")
+        return inputType
+
+    def weightParamKeys(self):
+        return ()
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        return {"gamma": jnp.ones((self.nIn,), dtype),
+                "beta": jnp.zeros((self.nIn,), dtype)}
+
+    def forward(self, params, x, train, key, state):
+        ax = 1 if x.ndim > 2 else -1
+        mu = jnp.mean(x, axis=ax, keepdims=True)
+        var = jnp.var(x, axis=ax, keepdims=True)
+        xn = (x - mu) / jnp.sqrt(var + self.eps)
+        shape = [1] * x.ndim
+        shape[ax] = -1
+        g = params["gamma"].reshape(shape)
+        b = params["beta"].reshape(shape)
+        return xn * g + b, state
+
+
+@dataclasses.dataclass
+class GaussianNoiseLayer(BaseLayer):
+    """Additive zero-mean Gaussian noise at train time, identity at
+    inference (Keras ``GaussianNoise`` parity)."""
+    stddev: float = 0.1
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def forward(self, params, x, train, key, state):
+        if train and key is not None and self.stddev > 0:
+            x = x + self.stddev * jax.random.normal(key, x.shape, x.dtype)
+        return x, state
+
+
+@dataclasses.dataclass
+class GaussianDropoutLayer(BaseLayer):
+    """Multiplicative 1-mean Gaussian noise (Keras ``GaussianDropout``):
+    train-time x * N(1, sqrt(rate/(1-rate))); identity at inference."""
+    rate: float = 0.5
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def forward(self, params, x, train, key, state):
+        if train and key is not None and 0.0 < self.rate < 1.0:
+            sd = (self.rate / (1.0 - self.rate)) ** 0.5
+            x = x * (1.0 + sd * jax.random.normal(key, x.shape, x.dtype))
+        return x, state
+
+
+@dataclasses.dataclass
+class AlphaDropoutLayer(BaseLayer):
+    """SELU-preserving dropout (Keras ``AlphaDropout``): dropped units are
+    set to alpha' with an affine correction keeping mean/variance — keeps
+    self-normalizing nets self-normalizing."""
+    rate: float = 0.1
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def forward(self, params, x, train, key, state):
+        if not (train and key is not None and 0.0 < self.rate < 1.0):
+            return x, state
+        alpha_p = -1.7580993408473766     # -alpha*scale of SELU
+        keep = 1.0 - self.rate
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return a * jnp.where(mask, x, alpha_p) + b, state
+
+
+# our-layout <-> keras channels-last layout (batch axis excluded)
+_TO_KERAS_PERM = {3: (0, 2, 1),          # (b,f,t)   -> (b,t,f)
+                  4: (0, 2, 3, 1),       # (b,c,h,w) -> (b,h,w,c)
+                  5: (0, 2, 3, 4, 1)}    # (b,c,d,h,w)->(b,d,h,w,c)
+_FROM_KERAS_PERM = {3: (0, 2, 1),
+                    4: (0, 3, 1, 2),
+                    5: (0, 4, 1, 2, 3)}
+
+
+def _keras_dims_of(inputType):
+    """InputType -> its keras channels-last per-example dims tuple."""
+    k = inputType.kind
+    if k == "FF":
+        return (inputType.size,)
+    if k == "RNN":
+        return (inputType.timeSeriesLength, inputType.size)
+    if k == "CNN":
+        return (inputType.height, inputType.width, inputType.channels)
+    if k == "CNN3D":
+        return (inputType.depth, inputType.height, inputType.width,
+                inputType.channels)
+    raise ValueError(f"unsupported input kind {k}")
+
+
+def _type_from_keras_dims(dims):
+    if len(dims) == 1:
+        return InputType.feedForward(dims[0])
+    if len(dims) == 2:                    # (t, f)
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:                    # (h, w, c)
+        return InputType.convolutional(*dims)
+    if len(dims) == 4:                    # (d, h, w, c)
+        return InputType.convolutional3D(*dims)
+    raise ValueError(f"unsupported target rank {len(dims)}")
+
+
+@dataclasses.dataclass
+class ReshapeLayer(BaseLayer):
+    """Reshape with KERAS channels-last semantics: the input is viewed in
+    keras layout, reshaped to ``targetShape`` (keras dims, -1 allowed),
+    and the result converted back to this framework's layout.  Exists for
+    Keras ``Reshape``/``Flatten`` import parity (reference:
+    modelimport ``KerasReshape``)."""
+    targetShape: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        self.targetShape = tuple(int(v) for v in self.targetShape)
+
+    def getOutputType(self, inputType):
+        dims = list(self.targetShape)
+        n_in = 1
+        for d in _keras_dims_of(inputType):
+            if d and d > 0:
+                n_in *= d
+            else:
+                raise ValueError(
+                    "ReshapeLayer requires statically-known input dims "
+                    f"(got {inputType})")
+        if -1 in dims:
+            known = 1
+            for d in dims:
+                if d != -1:
+                    known *= d
+            dims[dims.index(-1)] = n_in // known
+        n_out = 1
+        for d in dims:
+            n_out *= d
+        if n_out != n_in:
+            raise ValueError(f"ReshapeLayer: cannot reshape {n_in} elements "
+                             f"to {tuple(dims)}")
+        return _type_from_keras_dims(dims)
+
+    def forward(self, params, x, train, key, state):
+        if x.ndim > 2:
+            x = x.transpose(_TO_KERAS_PERM[x.ndim])
+        y = x.reshape((x.shape[0],) + self.targetShape)
+        if y.ndim > 2:
+            y = y.transpose(_FROM_KERAS_PERM[y.ndim])
+        return y, state
+
+
+@dataclasses.dataclass
+class PermuteLayer(BaseLayer):
+    """Permute the per-example axes with KERAS semantics: ``dims`` is
+    1-indexed over the keras channels-last layout (Keras ``Permute``
+    parity; reference: modelimport ``KerasPermute``)."""
+    dims: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        self.dims = tuple(int(v) for v in self.dims)
+
+    def getOutputType(self, inputType):
+        kdims = _keras_dims_of(inputType)
+        if len(self.dims) != len(kdims):
+            raise ValueError(f"PermuteLayer dims {self.dims} rank-mismatch "
+                             f"input {inputType}")
+        return _type_from_keras_dims([kdims[d - 1] for d in self.dims])
+
+    def forward(self, params, x, train, key, state):
+        if x.ndim > 2:
+            x = x.transpose(_TO_KERAS_PERM[x.ndim])
+        y = x.transpose((0,) + tuple(d for d in self.dims))
+        if y.ndim > 2:
+            y = y.transpose(_FROM_KERAS_PERM[y.ndim])
+        return y, state
+
+
+@dataclasses.dataclass
 class OCNNOutputLayer(BaseLayer):
     """One-class neural network output (reference: OCNNOutputLayer.java,
     Chalapathy et al.): score = w . sigmoid(V x); objective
@@ -191,3 +400,10 @@ class OCNNOutputLayer(BaseLayer):
         (labels unused).  The ||V||^2/||w||^2 terms ride the config's l2
         machinery, as in the reference."""
         return jax.nn.relu(-output[:, 0]) / self.nu
+
+
+for _c in [MaskLayer, RepeatVector, ElementWiseMultiplicationLayer,
+           Cropping1D, ZeroPadding1DLayer, OCNNOutputLayer,
+           LayerNormalization, GaussianNoiseLayer, GaussianDropoutLayer,
+           AlphaDropoutLayer, ReshapeLayer, PermuteLayer]:
+    register_layer(_c)

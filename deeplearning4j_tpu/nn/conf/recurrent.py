@@ -40,7 +40,8 @@ from deeplearning4j_tpu.nn.conf.layers import (BaseLayer, DenseLayer,
 from deeplearning4j_tpu.nn.weights import init_weight
 
 __all__ = ["BaseRecurrentLayer", "SimpleRnn", "LSTM", "GravesLSTM", "GRU",
-           "Bidirectional", "LastTimeStep", "RnnOutputLayer", "RnnLossLayer"]
+           "Bidirectional", "LastTimeStep", "RnnOutputLayer", "RnnLossLayer",
+           "TimeDistributed", "TimeDistributedFlatten"]
 
 
 def _masked_scan(cell, p, x_btn, mask, carry0):
@@ -328,7 +329,8 @@ class Bidirectional(Layer):
             else:
                 b._kw["fwd"] = a
 
-    def __init__(self, *args, name=None, fwd=None, mode=None, **kw):
+    def __init__(self, *args, name=None, fwd=None, mode=None,
+                 returnSequences=True, **kw):
         # accept Bidirectional(LSTM(...)), Bidirectional("ADD", LSTM(...))
         super().__init__(name=name)
         self.mode = mode or BidirectionalMode.CONCAT
@@ -340,6 +342,11 @@ class Bidirectional(Layer):
                 self.fwd = a
         if self.fwd is None:
             raise ValueError("Bidirectional requires a wrapped RNN layer")
+        # returnSequences=False: keras last-step semantics — merge the
+        # forward scan's LAST valid output with the backward scan's OWN
+        # last output (original position 0), emitting FF (not a sequence)
+        self.returnSequences = bool(returnSequences)
+        self.isRNN = self.returnSequences
         self._bwd = dataclasses.replace(self.fwd)
 
     def __getattr__(self, name):
@@ -363,6 +370,10 @@ class Bidirectional(Layer):
 
     def getOutputType(self, inputType):
         base = self.fwd.getOutputType(inputType)
+        n = 2 * base.size if self.mode == BidirectionalMode.CONCAT \
+            else base.size
+        if not self.returnSequences:
+            return InputType.feedForward(n)
         if self.mode == BidirectionalMode.CONCAT:
             return InputType.recurrent(2 * base.size, base.timeSeriesLength)
         return base
@@ -413,20 +424,52 @@ class Bidirectional(Layer):
             y = jnp.concatenate([yf, yb], axis=1)
         return y, {"fwd": cf, "bwd": cb}
 
-    def forward(self, params, x, train, key, state):
-        y, _ = self.scanSeq(params, x, train, key,
-                            self.initialCarry(x.shape[0], x.dtype))
-        return y, state
+    @staticmethod
+    def _last_valid(y, mask):
+        """(b, n, t) -> (b, n) at each sequence's last valid step."""
+        if mask is None:
+            return y[:, :, -1]
+        idx = jnp.clip(jnp.sum(mask, axis=1).astype(jnp.int32) - 1,
+                       0, y.shape[2] - 1)
+        return jnp.take_along_axis(y, idx[:, None, None], axis=2)[:, :, 0]
+
+    def forward(self, params, x, train, key, state, mask=None):
+        if self.returnSequences:
+            y, _ = self.scanSeq(params, x, train, key,
+                                self.initialCarry(x.shape[0], x.dtype),
+                                mask)
+            return y, state
+        # keras Bidirectional(return_sequences=False): fwd last valid step
+        # merged with the backward scan's own last output
+        kf = kb = None
+        if key is not None:
+            kf, kb = jax.random.split(key)
+        carry = self.initialCarry(x.shape[0], x.dtype)
+        yf, _ = self.fwd.scanSeq(params["fwd"], x, train, kf,
+                                 carry["fwd"], mask)
+        yb_r, _ = self._bwd.scanSeq(params["bwd"], self._reverse(x, mask),
+                                    train, kb, carry["bwd"], mask)
+        hf = self._last_valid(yf, mask)
+        hb = self._last_valid(yb_r, mask)
+        if self.mode == BidirectionalMode.ADD:
+            return hf + hb, state
+        if self.mode == BidirectionalMode.MUL:
+            return hf * hb, state
+        if self.mode == BidirectionalMode.AVERAGE:
+            return 0.5 * (hf + hb), state
+        return jnp.concatenate([hf, hb], axis=1), state
 
     def toJson(self) -> dict:
         return {"@class": "Bidirectional", "name": self.name,
-                "mode": self.mode, "fwd": self.fwd.toJson()}
+                "mode": self.mode, "fwd": self.fwd.toJson(),
+                "returnSequences": self.returnSequences}
 
     @classmethod
     def _fromJsonDict(cls, d: dict) -> "Bidirectional":
         from deeplearning4j_tpu.nn.conf.layers import layer_from_json
         return cls(fwd=layer_from_json(d["fwd"]), mode=d.get("mode"),
-                   name=d.get("name"))
+                   name=d.get("name"),
+                   returnSequences=d.get("returnSequences", True))
 
 
 @dataclasses.dataclass
@@ -558,6 +601,117 @@ class RnnLossLayer(LossLayer):
         return y, state
 
 
+@dataclasses.dataclass
+class TimeDistributed(Layer):
+    """Apply a wrapped layer independently at every time step.
+    Reference: ``conf/layers/recurrent/TimeDistributed.java`` (FF layer
+    over ``(b, n, t)``); extended here to sequences of images: a CNN layer
+    over ``(b, c, d, h, w)`` (NCDHW, depth = time) is ``jax.vmap``-ed over
+    the depth axis — the Keras ``TimeDistributed(Conv2D)`` import path.
+    """
+    underlying: Optional[Layer] = None
+
+    def __init__(self, underlying=None, name=None):
+        super().__init__(name=name)
+        if underlying is None:
+            raise ValueError("TimeDistributed requires an underlying layer")
+        self.underlying = underlying
+
+    def __getattr__(self, name):
+        if name in _DELEGATED_HYPERPARAMS:
+            inner = self.__dict__.get("underlying")
+            return getattr(inner, name, None) if inner is not None else None
+        raise AttributeError(name)
+
+    def applyGlobalDefaults(self, g):
+        self.underlying.applyGlobalDefaults(g)
+
+    def _step_type(self, inputType):
+        if inputType.kind == "RNN":
+            return InputType.feedForward(inputType.size)
+        if inputType.kind == "CNN3D":
+            return InputType.convolutional(inputType.height, inputType.width,
+                                           inputType.channels)
+        raise ValueError(
+            f"TimeDistributed requires RNN or CNN3D input, got {inputType}")
+
+    def inferNIn(self, inputType):
+        self.underlying.inferNIn(self._step_type(inputType))
+
+    def getOutputType(self, inputType):
+        out = self.underlying.getOutputType(self._step_type(inputType))
+        t = inputType.timeSeriesLength if inputType.kind == "RNN" \
+            else inputType.depth
+        if out.kind == "FF":
+            return InputType.recurrent(out.size, t)
+        if out.kind == "CNN":
+            return InputType.convolutional3D(t, out.height, out.width,
+                                             out.channels)
+        raise ValueError(f"TimeDistributed: unsupported inner output {out}")
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        return self.underlying.initParams(key, self._step_type(inputType),
+                                          dtype)
+
+    def initState(self, inputType, dtype=jnp.float32):
+        init = getattr(self.underlying, "initState", None)
+        return init(self._step_type(inputType), dtype) if init else {}
+
+    def weightParamKeys(self):
+        return self.underlying.weightParamKeys()
+
+    def forward(self, params, x, train, key, state):
+        if x.ndim == 3:                       # (b, n, t): per-step FF
+            b, n, t = x.shape
+            flat = x.transpose(0, 2, 1).reshape(b * t, n)
+            y, st = self.underlying.forward(params, flat, train, key, state)
+            return (y.reshape(b, t, -1).transpose(0, 2, 1), st)
+        # (b, c, d, h, w): vmap the inner CNN layer over depth.  The inner
+        # state (e.g. BN running stats) is shared across steps like keras:
+        # read-only per step, discarded updates under vmap.
+        def step(xt, k):
+            y, _ = self.underlying.forward(params, xt, train, k, state)
+            return y
+        if key is not None and train:
+            # independent noise per frame (keras draws per (b*t) row)
+            keys = jax.random.split(key, x.shape[2])
+            return jax.vmap(step, in_axes=(2, 0), out_axes=2)(x, keys), \
+                state
+        return jax.vmap(lambda xt: step(xt, None),
+                        in_axes=2, out_axes=2)(x), state
+
+    def toJson(self) -> dict:
+        return {"@class": "TimeDistributed", "name": self.name,
+                "underlying": self.underlying.toJson()}
+
+    @classmethod
+    def _fromJsonDict(cls, d: dict) -> "TimeDistributed":
+        from deeplearning4j_tpu.nn.conf.layers import layer_from_json
+        return cls(underlying=layer_from_json(d["underlying"]),
+                   name=d.get("name"))
+
+
+@dataclasses.dataclass
+class TimeDistributedFlatten(Layer):
+    """Flatten each frame of an NCDHW sequence to features, producing RNN
+    ``(b, h*w*c, d)`` with KERAS (h, w, c) feature order — so an imported
+    downstream LSTM kernel's rows line up without permutation (the Keras
+    ``TimeDistributed(Flatten())`` import path)."""
+
+    def getOutputType(self, inputType):
+        if inputType.kind != "CNN3D":
+            raise ValueError("TimeDistributedFlatten requires CNN3D input")
+        return InputType.recurrent(
+            inputType.height * inputType.width * inputType.channels,
+            inputType.depth)
+
+    def forward(self, params, x, train, key, state):
+        b, c, d, h, w = x.shape
+        y = x.transpose(0, 2, 3, 4, 1).reshape(b, d, h * w * c)
+        return y.transpose(0, 2, 1), state
+
+
 for _c in [SimpleRnn, LSTM, GravesLSTM, GRU, RnnOutputLayer, RnnLossLayer,
-           Bidirectional, LastTimeStep]:
+           Bidirectional, LastTimeStep, TimeDistributed,
+           TimeDistributedFlatten]:
     register_layer(_c)

@@ -358,15 +358,8 @@ class TestImportEdgeCases:
             np.testing.assert_allclose(ours, keras_out, atol=1e-4,
                                        rtol=1e-3, err_msg=merge)
 
-        # return_sequences=False refuses with the semantic explanation
-        model = tf.keras.Sequential([
-            tf.keras.layers.Input(shape=(7, 5)),
-            tf.keras.layers.Bidirectional(tf.keras.layers.LSTM(6))])
-        with tempfile.TemporaryDirectory() as d:
-            pth = os.path.join(d, "m.h5")
-            model.save(pth)
-            with pytest.raises(ValueError, match="return_sequences"):
-                KerasModelImport.importKerasSequentialModelAndWeights(pth)
+        # return_sequences=False: keras last-step semantics (fwd last +
+        # backward scan's own last) — parity-tested in test_keras_breadth
 
     def test_keras_activation_params_and_1d_flatten_guard(self):
         """Review round 4: ELU(alpha) and ReLU(negative_slope) carry
@@ -383,16 +376,8 @@ class TestImportEdgeCases:
         x = np.random.RandomState(7).randn(4, 6).astype(np.float32)
         self._kroundtrip(model, x, atol=1e-4)
 
-        bad = tf.keras.Sequential([
-            tf.keras.layers.Input(shape=(12, 5)),
-            tf.keras.layers.Conv1D(8, 3, padding="same"),
-            tf.keras.layers.Flatten(),
-            tf.keras.layers.Dense(3)])
-        with tempfile.TemporaryDirectory() as d:
-            pth = os.path.join(d, "m.h5")
-            bad.save(pth)
-            with pytest.raises(ValueError, match="1-D/recurrent"):
-                KerasModelImport.importKerasSequentialModelAndWeights(pth)
+        # Flatten after 1-D convs with a static length now imports via a
+        # keras-order ReshapeLayer — parity-tested in test_keras_breadth
 
     def test_keras_lstm_last_step(self):
         model = tf.keras.Sequential([
