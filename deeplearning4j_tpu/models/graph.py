@@ -106,30 +106,61 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _forward(self, params, state, inputs: Sequence, train: bool, key,
-                 mask=None):
+                 mask=None, carries=None):
         """Forward over the cached topological order (reference:
-        ``topologicalSortOrder()`` + per-vertex ``doForward``)."""
+        ``topologicalSortOrder()`` + per-vertex ``doForward``).
+
+        ``mask`` is a tuple of per-INPUT (b, t) feature/timestep masks
+        aligned with ``conf.inputs`` (or None) and flows through the DAG
+        like the reference's ``feedForwardMaskArrays``: each vertex sees
+        its first masked input's mask, and the mask dies wherever the
+        (statically known) output format leaves RNN.  ``carries`` maps RNN
+        vertex name -> initial carry (None = zeros, fresh sequences) — the
+        reference CG's rnn ``stateMap`` (``ComputationGraph.rnnTimeStep`` /
+        ``rnnActivateUsingStoredState``)."""
         acts: Dict[str, Any] = {}
         miniBatch = inputs[0].shape[0]
+        mmap: Dict[str, Any] = {}
         for i, name in enumerate(self.conf.inputs):
             acts[name] = inputs[i]
+            if mask is not None and i < len(mask):
+                mmap[name] = mask[i]
+        out_types = self.conf.vertexOutputTypes
         new_state: Dict[str, Dict] = {}
+        new_carries: Dict[str, Any] = {}
         for idx, name in enumerate(self.conf.topoOrder):
             node, ins = self.conf.nodes[name]
             xs = [acts[i] for i in ins]
+            m = next((mmap[i] for i in ins if mmap.get(i) is not None), None)
             if isinstance(node, Layer):
                 x = xs[0]
                 if name in self.conf.preProcessors:
                     x = self.conf.preProcessors[name].preProcess(x, miniBatch)
                 lkey = jax.random.fold_in(key, idx) if key is not None else None
-                y, st2 = node.forward(params.get(name, {}), x, train, lkey,
-                                      state.get(name, {}))
+                if getattr(node, "isRNN", False):
+                    c0 = (carries or {}).get(name)
+                    if c0 is None:
+                        c0 = node.initialCarry(x.shape[0], x.dtype)
+                    y, cfin = node.scanSeq(params.get(name, {}), x, train,
+                                           lkey, c0, m)
+                    new_carries[name] = cfin
+                    st2 = {}
+                elif getattr(node, "acceptsMask", False):
+                    y, st2 = node.forward(params.get(name, {}), x, train,
+                                          lkey, state.get(name, {}),
+                                          mask=m)
+                else:
+                    y, st2 = node.forward(params.get(name, {}), x, train,
+                                          lkey, state.get(name, {}))
                 if st2:
                     new_state[name] = st2
                 acts[name] = y
             else:
                 acts[name] = node.forward(*xs)
-        return acts, new_state
+            ot = out_types.get(name)
+            if m is not None and (ot is None or ot.kind == "RNN"):
+                mmap[name] = m
+        return acts, new_state, new_carries
 
     def _sumLosses(self, acts, labels, masks):
         """Accumulate every output layer's loss — THE loss semantics, shared
@@ -152,20 +183,23 @@ class ComputationGraph:
             lambda a: a.astype(cd) if hasattr(a, "dtype")
             and a.dtype == jnp.float32 else a, tree)
 
-    def _lossFn(self, params, state, inputs, labels, masks, key):
+    def _lossFn(self, params, state, inputs, labels, masks, key,
+                fmask=None, carries=None):
         # state stays f32 (see MultiLayerNetwork._lossFn note)
-        acts, new_state = self._forward(
+        acts, new_state, new_carries = self._forward(
             self._cast_compute(params), state,
-            self._cast_compute(inputs), True, key)
+            self._cast_compute(inputs), True, key, fmask,
+            self._cast_compute(carries))
         if self._computeDtype != jnp.float32:   # losses evaluate in f32
             acts = {n: (a.astype(jnp.float32) if hasattr(a, "astype") else a)
                     for n, a in acts.items()}
         total = self._sumLosses(acts, labels, masks)
         reg = _reg_penalty((self.conf.nodes[name][0], lp)
                            for name, lp in params.items())
-        return total + reg, (new_state, total)
+        return total + reg, (new_state, total, new_carries)
 
-    def _runSolverStep(self, inputs, labels, masks, algo: str) -> None:
+    def _runSolverStep(self, inputs, labels, masks, fmask,
+                       algo: str) -> None:
         """Legacy line-search solvers for graph models (see
         MultiLayerNetwork._runSolverStep / optimize/solvers.py)."""
         from jax.flatten_util import ravel_pytree
@@ -181,13 +215,14 @@ class ComputationGraph:
             key = jax.random.fold_in(self._fitKey, 0)
             state = self.state_
 
-            def loss_flat(v, ins, labs, mks):
+            def loss_flat(v, ins, labs, mks, fm):
                 loss, _aux = self._lossFn(unravel(v), state, ins, labs,
-                                          mks, key)
+                                          mks, key, fm)
                 return loss
 
             self._solver.bind(loss_flat)
-        new_flat, f_new = self._solver.step(flat, inputs, labels, masks)
+        new_flat, f_new = self._solver.step(flat, inputs, labels, masks,
+                                            fmask)
         self.params_ = unravel(new_flat)
         self._score = float(f_new)
         self._scoreArr = None
@@ -195,28 +230,29 @@ class ComputationGraph:
     @functools.cached_property
     def _trainStep(self):
         def step(params, optState, state, inputs, labels, masks, key,
-                 iteration, epoch):
+                 iteration, epoch, fmask, carries):
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
-            (loss, (new_state, data_loss)), grads = grad_fn(
-                params, state, inputs, labels, masks, key)
+            (loss, (new_state, data_loss, new_carries)), grads = grad_fn(
+                params, state, inputs, labels, masks, key, fmask, carries)
             new_params, new_opt = _apply_updates(
                 ((name, self.conf.nodes[name][0]) for name in params),
                 self.conf.globalConf, params, grads, optState, iteration,
                 epoch)
-            return new_params, new_opt, new_state, loss
+            return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _outputFn(self):
-        def run(params, state, inputs):
-            acts, _ = self._forward(
+        def run(params, state, inputs, fmask, carries):
+            acts, _, new_carries = self._forward(
                 self._cast_compute(params), state,
-                self._cast_compute(inputs), False, None)
+                self._cast_compute(inputs), False, None, fmask,
+                self._cast_compute(carries))
+            outs = tuple(acts[n] for n in self.conf.outputs)
             if self._computeDtype != jnp.float32:
-                return tuple(acts[n].astype(jnp.float32)
-                             for n in self.conf.outputs)
-            return tuple(acts[n] for n in self.conf.outputs)
+                outs = tuple(o.astype(jnp.float32) for o in outs)
+            return outs, new_carries
         return jax.jit(run)
 
     # ------------------------------------------------------------------
@@ -250,31 +286,53 @@ class ComputationGraph:
 
     def _fitBatch(self, ds) -> None:
         pb = self._place_batch
+        fmask = None
         if isinstance(ds, MultiDataSet):
             inputs = tuple(pb(f.jax.astype(self._dtype))
                            for f in ds.features)
             labels = tuple(pb(l.jax) for l in ds.labels)
             masks = tuple(pb(m.jax) for m in ds.labelsMasks) \
                 if ds.labelsMasks else None
+            if getattr(ds, "featuresMasks", None):
+                fmask = tuple(pb(m.jax) if m is not None else None
+                              for m in ds.featuresMasks)
         else:
             inputs = (pb(ds.features.jax.astype(self._dtype)),)
             labels = (pb(ds.labels.jax),)
             masks = (pb(ds.labelsMask.jax),) \
                 if ds.labelsMask is not None else None
+            if ds.featuresMask is not None:
+                fmask = (pb(ds.featuresMask.jax),)
         self.lastBatchSize = int(inputs[0].shape[0])
         algo = str(self.conf.globalConf.get("optimizationAlgo")
                    or "STOCHASTIC_GRADIENT_DESCENT").upper()
         if algo != "STOCHASTIC_GRADIENT_DESCENT":
-            self._runSolverStep(inputs, labels, masks, algo)
+            self._runSolverStep(inputs, labels, masks, fmask, algo)
             self.iterationCount += 1
             for l in self._listeners:
                 l.iterationDone(self, self.iterationCount, self.epochCount)
             return
+        from deeplearning4j_tpu.nn.conf import BackpropType
+        # TBPTT needs per-timestep (rank-3) labels on every output
+        # (reference: ComputationGraph.doTruncatedBPTT)
+        if self.conf.backpropType == BackpropType.TruncatedBPTT \
+                and all(i.ndim == 3 for i in inputs) \
+                and all(l.ndim == 3 for l in labels) \
+                and inputs[0].shape[2] > self.conf.tbpttFwdLength:
+            self._fitTbptt(inputs, labels, masks, fmask)
+        else:
+            self._runTrainStep(inputs, labels, masks, fmask, carries=None)
+        self.iterationCount += 1
+        for l in self._listeners:
+            l.iterationDone(self, self.iterationCount, self.epochCount)
+
+    def _runTrainStep(self, inputs, labels, masks, fmask, carries):
         self._fitKey, key = jax.random.split(self._fitKey)
-        self.params_, self.optState_, new_state, loss = self._trainStep(
+        (self.params_, self.optState_, new_state, loss,
+         new_carries) = self._trainStep(
             self.params_, self.optState_, self.state_, inputs, labels, masks,
             key, jnp.asarray(self.iterationCount),
-            jnp.asarray(self.epochCount))
+            jnp.asarray(self.epochCount), fmask, carries)
         if new_state:
             self.state_.update(new_state)
         # Async device scalar; score() materializes lazily (see multilayer).
@@ -284,14 +342,48 @@ class ComputationGraph:
             self._score = float(loss)
             self._scoreArr = None
             check_panic(self._score)
-        self.iterationCount += 1
-        for l in self._listeners:
-            l.iterationDone(self, self.iterationCount, self.epochCount)
+        return new_carries
 
-    def output(self, *inputs):
+    def _fitTbptt(self, inputs, labels, masks, fmask) -> None:
+        """Truncated BPTT over the DAG: chunk the time axis, carry RNN
+        vertex state (detached) across chunks.  Reference:
+        ``ComputationGraph.doTruncatedBPTT`` +
+        ``rnnActivateUsingStoredState``."""
+        t = inputs[0].shape[2]
+        L = self.conf.tbpttFwdLength
+        carries = self._zeroCarries(int(inputs[0].shape[0]))
+        for start in range(0, t, L):
+            end = min(start + L, t)
+            ic = tuple(x[:, :, start:end] for x in inputs)
+            lc = tuple(y[:, :, start:end] if y.ndim == 3 else y
+                       for y in labels)
+            mc = tuple(m[:, start:end] for m in masks) \
+                if masks is not None else None
+            fc = tuple(m[:, start:end] if m is not None else None
+                       for m in fmask) if fmask is not None else None
+            carries = self._runTrainStep(ic, lc, mc, fc, carries)
+
+    def _zeroCarries(self, batch: int):
+        """Fresh-sequence carries for every recurrent vertex (concrete
+        zeros keep the jit pytree structure stable vs passing None)."""
+        out = {}
+        for name in self.conf.topoOrder:
+            node = self.conf.nodes[name][0]
+            if getattr(node, "isRNN", False):
+                out[name] = node.initialCarry(batch, self._dtype)
+        return out or None
+
+    def output(self, *inputs, featuresMask=None):
         xs = tuple((x.jax if isinstance(x, NDArray) else jnp.asarray(x))
                    .astype(self._dtype) for x in inputs)
-        outs = self._outputFn(self.params_, self.state_, xs)
+        fm = None
+        if featuresMask is not None:
+            if not isinstance(featuresMask, (tuple, list)):
+                featuresMask = (featuresMask,)
+            fm = tuple(
+                (m.jax if isinstance(m, NDArray) else jnp.asarray(m))
+                if m is not None else None for m in featuresMask)
+        outs, _ = self._outputFn(self.params_, self.state_, xs, fm, None)
         res = [NDArray(o) for o in outs]
         return res[0] if len(res) == 1 else res
 
@@ -299,12 +391,58 @@ class ComputationGraph:
         out = self.output(*inputs)
         return out[0] if isinstance(out, list) else out
 
+    # ------------------------------------------------------------------
+    # stateful RNN inference (reference: ComputationGraph.rnnTimeStep /
+    # rnnClearPreviousState / rnnGetPreviousState — the vertex stateMap)
+    # ------------------------------------------------------------------
+    _rnnCarries = None
+
+    def rnnTimeStep(self, *inputs):
+        """Feed one or more timesteps, carrying RNN vertex state across
+        calls.  2d inputs (b, nIn) = single step -> (b, nOut); 3d
+        (b, nIn, t) -> (b, nOut, t)."""
+        for name in self.conf.topoOrder:
+            node = self.conf.nodes[name][0]
+            if type(node).__name__ == "Bidirectional":
+                # streaming one step at a time cannot see the future the
+                # backward half needs (the reference throws here too)
+                raise ValueError("rnnTimeStep is not supported for "
+                                 "bidirectional networks")
+        xs = []
+        single = False
+        for x in inputs:
+            xv = x.jax if isinstance(x, NDArray) else jnp.asarray(x)
+            if xv.ndim == 2:
+                single = True
+                xv = xv[:, :, None]
+            xs.append(xv.astype(self._dtype))
+        if self._rnnCarries is None:
+            self._rnnCarries = self._zeroCarries(int(xs[0].shape[0]))
+        outs, self._rnnCarries = self._outputFn(
+            self.params_, self.state_, tuple(xs), None, self._rnnCarries)
+        res = [NDArray(o[:, :, -1] if single and o.ndim == 3 else o)
+               for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def rnnClearPreviousState(self) -> None:
+        self._rnnCarries = None
+
+    def rnnGetPreviousState(self, vertexName: str):
+        if self._rnnCarries is None:
+            return None
+        return self._rnnCarries.get(vertexName)
+
+    def rnnSetPreviousState(self, vertexName: str, state) -> None:
+        if self._rnnCarries is None:
+            self._rnnCarries = {}
+        self._rnnCarries[vertexName] = state
+
     @functools.cached_property
     def _scoreFn(self):
-        def run(params, state, inputs, labels, masks):
-            acts, _ = self._forward(
+        def run(params, state, inputs, labels, masks, fmask):
+            acts, _, _ = self._forward(
                 self._cast_compute(params), state,
-                self._cast_compute(inputs), False, None)
+                self._cast_compute(inputs), False, None, fmask)
             if self._computeDtype != jnp.float32:
                 acts = {n: (a.astype(jnp.float32)
                             if hasattr(a, "astype") else a)
@@ -321,17 +459,23 @@ class ComputationGraph:
                 self._score = float(self._scoreArr)
                 self._scoreArr = None
             return self._score
+        fmask = None
         if isinstance(ds, MultiDataSet):
             inputs = tuple(f.jax.astype(self._dtype) for f in ds.features)
             labels = tuple(l.jax for l in ds.labels)
             masks = tuple(m.jax for m in ds.labelsMasks) \
                 if ds.labelsMasks else None
+            if getattr(ds, "featuresMasks", None):
+                fmask = tuple(m.jax if m is not None else None
+                              for m in ds.featuresMasks)
         else:
             inputs = (ds.features.jax.astype(self._dtype),)
             labels = (ds.labels.jax,)
             masks = (ds.labelsMask.jax,) if ds.labelsMask is not None else None
+            if ds.featuresMask is not None:
+                fmask = (ds.featuresMask.jax,)
         return float(self._scoreFn(self.params_, self.state_, inputs, labels,
-                                   masks))
+                                   masks, fmask))
 
     def evaluate(self, it: DataSetIterator) -> Evaluation:
         ev = Evaluation()

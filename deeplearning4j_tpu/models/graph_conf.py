@@ -191,6 +191,25 @@ class GraphBuilder:
         self._nodes: Dict[str, Tuple[Any, List[str]]] = {}  # name -> (layer|vertex, inputs)
         self._outputs: List[str] = []
         self._preprocs: Dict[str, InputPreProcessor] = {}
+        self._backpropType = "Standard"
+        self._tbpttFwd = 20
+        self._tbpttBack = 20
+
+    def backpropType(self, bt: str):
+        """Reference: ``GraphBuilder.backpropType(BackpropType.TruncatedBPTT)``."""
+        self._backpropType = bt
+        return self
+
+    def tBPTTForwardLength(self, n: int):
+        self._tbpttFwd = int(n)
+        return self
+
+    def tBPTTBackwardLength(self, n: int):
+        self._tbpttBack = int(n)
+        return self
+
+    def tBPTTLength(self, n: int):
+        return self.tBPTTForwardLength(n).tBPTTBackwardLength(n)
 
     def addInputs(self, *names: str):
         self._inputs.extend(names)
@@ -225,20 +244,27 @@ class GraphBuilder:
         return ComputationGraphConfiguration(
             inputs=self._inputs, inputTypes=self._inputTypes,
             nodes=self._nodes, outputs=self._outputs,
-            preProcessors=self._preprocs, globalConf=self._g)
+            preProcessors=self._preprocs, globalConf=self._g,
+            backpropType=self._backpropType, tbpttFwdLength=self._tbpttFwd,
+            tbpttBackLength=self._tbpttBack)
 
 
 class ComputationGraphConfiguration:
     def __init__(self, inputs: List[str], inputTypes: List[InputType],
                  nodes: Dict[str, Tuple[Any, List[str]]], outputs: List[str],
                  preProcessors: Dict[str, InputPreProcessor],
-                 globalConf: Dict[str, Any]):
+                 globalConf: Dict[str, Any],
+                 backpropType: str = "Standard",
+                 tbpttFwdLength: int = 20, tbpttBackLength: int = 20):
         self.inputs = inputs
         self.inputTypes = inputTypes
         self.nodes = nodes
         self.outputs = outputs
         self.preProcessors = preProcessors
         self.globalConf = globalConf
+        self.backpropType = backpropType
+        self.tbpttFwdLength = tbpttFwdLength
+        self.tbpttBackLength = tbpttBackLength
         self.topoOrder: List[str] = []
         self.vertexInputTypes: Dict[str, InputType] = {}
         self._resolve()
@@ -269,7 +295,8 @@ class ComputationGraphConfiguration:
         self.topoOrder = order
 
         # shape inference
-        types: Dict[str, InputType] = {}
+        types: Dict[str, Optional[InputType]] = {}
+        self.vertexOutputTypes = types   # name -> output InputType (shared)
         for i, name in enumerate(self.inputs):
             if i < len(self.inputTypes):
                 types[name] = self.inputTypes[i]
@@ -303,6 +330,9 @@ class ComputationGraphConfiguration:
             "inputs": self.inputs,
             "inputTypes": [t.toJson() for t in self.inputTypes],
             "outputs": self.outputs,
+            "backpropType": self.backpropType,
+            "tbpttFwdLength": self.tbpttFwdLength,
+            "tbpttBackLength": self.tbpttBackLength,
             "nodes": {name: {"node": node.toJson(), "inputs": ins,
                              "kind": "layer" if isinstance(node, Layer) else "vertex"}
                       for name, (node, ins) in self.nodes.items()},
@@ -327,4 +357,7 @@ class ComputationGraphConfiguration:
             nodes=nodes, outputs=list(d["outputs"]),
             preProcessors={k: InputPreProcessor.fromJson(v)
                            for k, v in (d.get("preProcessors") or {}).items()},
-            globalConf=g)
+            globalConf=g,
+            backpropType=d.get("backpropType", "Standard"),
+            tbpttFwdLength=int(d.get("tbpttFwdLength", 20)),
+            tbpttBackLength=int(d.get("tbpttBackLength", 20)))
