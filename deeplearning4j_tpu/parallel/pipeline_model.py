@@ -1,33 +1,47 @@
-"""GPipe pipeline training through the model DSL (VERDICT r3 ask #5).
+"""GPipe pipeline training through the model DSL.
 
-``NeuralNetConfiguration...list()...pipelineStages(S)`` marks an MLN's
-hidden stack as S contiguous, structurally identical segments;
+``NeuralNetConfiguration...list()...pipelineStages(S)`` partitions an
+MLN's hidden stack into S contiguous segments;
 ``ParallelWrapper(net, mesh=DeviceMesh(stage=S, ...))`` then trains it
-through :class:`PipelinedTrainer`: segment params stack on a leading
-stage axis (sharded over the mesh's ``stage`` axis), the forward runs
-the existing ``pipeline_apply`` microbatch schedule (scan + ppermute
-inside shard_map — ONE XLA executable), the output layer computes the
-loss replicated, and the updater from the net's own config applies the
-update — all without the user writing any JAX.
+through :class:`PipelinedTrainer`.
+
+Round-5 design (VERDICT r4 ask 3 — segments may differ structurally):
+each stage's param tree is raveled to a flat vector, zero-padded to the
+widest stage, and stacked into ONE (S, L) array sharded over the mesh's
+``stage`` axis — so each device group holds only its own stage's
+weights.  Inside the microbatch schedule every device applies ITS stage's
+layers via ``lax.switch`` on the stage index (XLA ``Conditional``), and
+activations cross stage boundaries as flat zero-padded buffers sized to
+the largest boundary, so a conv stem can feed a dense trunk.  Per-layer
+updaters, gradient normalization, weight decay, and global L1/L2 all
+apply per stage through the same ``_apply_updates`` leaf machinery the
+sequential path uses, with the optimizer state raveled/padded/stacked
+exactly like the params.  The whole schedule (forward + backward + loss +
+regularization + update) stays ONE jitted XLA executable.
 
 Reference: ABSENT in the reference (SURVEY.md §2.6 — DL4J has no
 pipeline parallelism); this is the beyond-reference capability surfaced
 through the dl4j-shaped config API.
 
-Constraints (validated, with clear errors): the hidden layers must
-split into S segments with identical param tree structure/shapes; no
-stateful (BatchNormalization EMA), recurrent, or dropout layers inside
-the pipelined segments (their per-microbatch semantics differ); the
-last layer must be the loss layer.
+Still refused (with clear errors): stateful layers (BatchNormalization's
+EMA and dropout draw per-microbatch semantics that diverge from the
+full-batch run), recurrent layers (per-microbatch carries), masked
+DataSets, and meshes with both stage and seq axes.
 """
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.models.multilayer import (_apply_updates,
+                                                  _iter_leaf_params,
+                                                  _updater_for)
 from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
 
 __all__ = ["PipelinedTrainer"]
@@ -46,104 +60,142 @@ class PipelinedTrainer:
         layers = conf.layers
         if not layers[-1].hasLoss():
             raise ValueError("last layer must be an output/loss layer")
-        hidden = layers[:-1]
-        if len(hidden) % S:
-            raise ValueError(f"{len(hidden)} hidden layers do not split "
-                             f"into {S} equal segments")
-        k = len(hidden) // S
-        self.k = k
-        self.segments = [hidden[s * k:(s + 1) * k] for s in range(S)]
-        # identical LAYER CONFIGS, not just param shapes: _block_fn runs
-        # segment 0's layer objects on every stage, so a differing
-        # activation/layer type would silently train the wrong function
-        import dataclasses as _dc
-
-        def _sig(l):
-            if _dc.is_dataclass(l):
-                return (type(l).__name__,
-                        tuple((f.name, repr(getattr(l, f.name)))
-                              for f in _dc.fields(l) if f.name != "name"))
-            return (type(l).__name__, repr(l))
-        ref_sig = [_sig(l) for l in self.segments[0]]
-        for s, seg in enumerate(self.segments[1:], 1):
-            if [_sig(l) for l in seg] != ref_sig:
-                raise ValueError(
-                    f"pipeline segments are not identical: segment {s} "
-                    f"layers {[type(l).__name__ for l in seg]} differ "
-                    "from segment 0 (layer type/activation/config must "
-                    "match)")
-        if conf.preProcessors:
-            raise ValueError("input preprocessors are unsupported under "
-                             "pipelineStages (the pipelined forward does "
-                             "not apply them)")
         if mesh.seqSize > 1:
             raise ValueError("a mesh with both stage and seq axes is "
                              "unsupported: pipelineStages does not route "
                              "sequence-parallel attention")
-        for key in ("l1", "l2", "weightDecay"):
-            if conf.globalConf.get(key):
-                raise ValueError(f"pipelineStages does not support global "
-                                 f"{key} regularization yet")
-        for seg in self.segments:
-            for l in seg:
+        hidden = list(enumerate(layers[:-1]))   # (global idx, layer)
+        if len(hidden) < S:
+            raise ValueError(f"{len(hidden)} hidden layers cannot fill "
+                             f"{S} pipeline stages")
+        # near-equal contiguous split; the first (len % S) stages get one
+        # extra layer
+        k, r = divmod(len(hidden), S)
+        self.segments = []
+        pos = 0
+        for s in range(S):
+            n = k + (1 if s < r else 0)
+            self.segments.append(hidden[pos:pos + n])
+            pos += n
+        for s, seg in enumerate(self.segments):
+            for _i, l in seg:
                 if getattr(l, "isRNN", False):
                     raise ValueError(
                         f"recurrent layer {type(l).__name__} cannot be "
                         "pipelined (per-microbatch carries)")
-                if getattr(l, "dropOut", 0):
+                if getattr(l, "dropOut", 0) and \
+                        0.0 < float(l.dropOut) < 1.0:
                     raise ValueError("dropout inside pipelined segments "
-                                     "is unsupported")
-                for attr in ("updater", "biasUpdater", "l1", "l2",
-                             "weightDecay", "gradientNormalization",
-                             "frozen"):
-                    val = getattr(l, attr, None)
-                    # layers inherit global settings at build; only a
-                    # genuine per-layer OVERRIDE is unsupported
-                    if val and val is not conf.globalConf.get(attr):
-                        raise ValueError(
-                            f"per-layer {attr} override on "
-                            f"{type(l).__name__} is unsupported under "
-                            "pipelineStages (one global updater applies)")
+                                     "is unsupported (per-microbatch "
+                                     "draws diverge from the full-batch "
+                                     "semantics)")
         if net.params_ is None:
             net.init()
-        if any(net.state_.get(str(i)) for i in range(len(hidden))):
+        if any(net.state_.get(str(i)) for i, _ in hidden):
             raise ValueError("stateful layers (BatchNormalization) cannot "
                              "be pipelined: per-microbatch statistics "
                              "diverge from the full-batch semantics")
-
-        seg_params = [{str(j): net.params_[str(s * k + j)]
-                       for j in range(k)} for s in range(S)]
-        specs = [jax.tree.map(lambda a: (a.shape, a.dtype), sp)
-                 for sp in seg_params]
-        if any(s != specs[0] for s in specs[1:]):
+        if conf.inputType is None:
+            raise ValueError("pipelineStages requires setInputType(...) "
+                             "(stage boundary shapes must be static)")
+        if getattr(net, "_computeDtype", jnp.float32) != jnp.float32:
             raise ValueError(
-                "pipeline segments are not structurally identical: "
-                f"{specs[0]} vs first mismatch "
-                f"{next(s for s in specs[1:] if s != specs[0])}")
+                "dataType(BFLOAT16/HALF) is unsupported under "
+                "pipelineStages: the pipelined step computes in f32 and "
+                "would silently diverge from the sequential bf16 run")
 
+        # ---- static boundary shapes (per-example, our formats) --------
+        out_types = [layers[i].getOutputType(conf.layerInputTypes[i])
+                     for i, _ in hidden]
+        for t in out_types:
+            if t.kind == "RNN" and t.timeSeriesLength <= 0:
+                raise ValueError("pipelineStages needs static sequence "
+                                 "lengths at stage boundaries")
+        # boundary ENTERING stage s (s>=1) = output of stage s-1's last
+        # layer, PRE-preprocessor (preprocessors run inside the stage)
+        self.in_shapes = [None] + [
+            tuple(out_types[seg[-1][0]].getShape(-1)[1:])
+            for seg in self.segments[:-1]]
+        self.out_shape = tuple(out_types[hidden[-1][0]].getShape(-1)[1:])
+
+        # ---- flat per-stage params + opt state ------------------------
+        seg_params = [{str(i): net.params_[str(i)] for i, _ in seg
+                       if str(i) in net.params_}
+                      for seg in self.segments]
+        seg_opt = []
+        for seg, sp in zip(self.segments, seg_params):
+            o = {}
+            for key, lp in sp.items():
+                layer = layers[int(key)]
+                o[key] = {path: _updater_for(conf.globalConf, layer,
+                                             pname).init(leaf)
+                          for path, pname, leaf in _iter_leaf_params(lp)}
+            seg_opt.append(o)
+        p_flats, self._p_unravel = [], []
+        o_flats, self._o_unravel = [], []
+        for sp, so in zip(seg_params, seg_opt):
+            pf, pu = ravel_pytree(sp)
+            of, ou = ravel_pytree(so)
+            p_flats.append(pf)
+            self._p_unravel.append(pu)
+            o_flats.append(of)
+            self._o_unravel.append(ou)
+        self._p_sizes = [int(f.size) for f in p_flats]
+        self._o_sizes = [int(f.size) for f in o_flats]
+        self.Lp = max(self._p_sizes)
+        self.Lo = max(max(self._o_sizes), 1)
         jmesh = mesh.mesh
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *seg_params)
-        self.stacked = jax.device_put(
-            stacked, jax.tree.map(
-                lambda _: NamedSharding(jmesh, P("stage")), stacked))
+
+        def stack_pad(flats, L):
+            rows = [jnp.pad(f.astype(jnp.float32), (0, L - f.size))
+                    for f in flats]
+            arr = jnp.stack(rows)
+            return jax.device_put(arr, NamedSharding(jmesh, P("stage")))
+
+        self.stacked = stack_pad(p_flats, self.Lp)
+        self.opt_stacked = stack_pad(o_flats, self.Lo)
+
         self.out_layer = layers[-1]
         out_idx = str(len(layers) - 1)
         self.out_params = jax.device_put(
             net.params_[out_idx],
             jax.tree.map(lambda _: NamedSharding(jmesh, P()),
                          net.params_[out_idx]))
-        self.updater = conf.globalConf.get("updater")
+        g = conf.globalConf
+        self._out_opt = {
+            path: _updater_for(g, self.out_layer, pname).init(leaf)
+            for path, pname, leaf in _iter_leaf_params(net.params_[out_idx])}
         self.M = int(n_microbatches) if n_microbatches else None
-        self._opt = None
         self.iterationCount = 0
         self._step = None   # built on the first batch (M adapts to it)
 
     # ------------------------------------------------------------------
-    def _block_fn(self, p_seg, h):
-        for j, layer in enumerate(self.segments[0]):
-            h, st = layer.forward(p_seg[str(j)], h, True, None, {})
+    def _seg_forward(self, s: int, p_dict, h):
+        conf = self.net.conf
+        for i, layer in self.segments[s]:
+            if i in conf.preProcessors:
+                h = conf.preProcessors[i].preProcess(h, h.shape[0])
+            h, st = layer.forward(p_dict.get(str(i), {}), h, True, None, {})
             assert not st, "stateful layer slipped through validation"
         return h
+
+    def _seg_reg(self, s: int, p_dict):
+        """Per-stage L1/L2 penalty (the sequential path's _reg_penalty,
+        over this stage's layers only)."""
+        total = jnp.float32(0.0)
+        for i, layer in self.segments[s]:
+            l1 = getattr(layer, "l1", None)
+            l2 = getattr(layer, "l2", None)
+            if not l1 and not l2:
+                continue
+            wkeys = layer.weightParamKeys()
+            for _path, pname, w in _iter_leaf_params(p_dict.get(str(i), {})):
+                if pname in wkeys:
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(w * w)
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(w))
+        return total
 
     def _resolve_microbatches(self, batch: int) -> None:
         """Default M: up to 2*S (the GPipe bubble-amortizing choice),
@@ -155,44 +207,135 @@ class PipelinedTrainer:
                 m -= 1
             self.M = m
 
+    # ------------------------------------------------------------------
+    def _pipeline_forward(self, stacked, x):
+        """Heterogeneous stages through the SHARED GPipe schedule
+        (``pipeline_apply``): activations cross stage boundaries as flat
+        zero-padded (b, A) buffers, and the block_fn dispatches to THIS
+        device's stage via lax.switch (XLA Conditional) — so a conv stem
+        can feed a dense trunk while the scan/ppermute schedule stays the
+        single shared implementation."""
+        S = len(self.segments)
+        in0_shape = tuple(x.shape[1:])
+        sizes_in = [int(math.prod(in0_shape))] + \
+            [int(math.prod(sh)) for sh in self.in_shapes[1:]]
+        size_out = int(math.prod(self.out_shape))
+        A = max(sizes_in + [size_out])
+        shapes_in = [in0_shape] + list(self.in_shapes[1:])
+
+        def block_fn(p_row, h_flat):
+            sid = lax.axis_index("stage")
+            mb_n = h_flat.shape[0]
+
+            def branch(s):
+                def run(ops):
+                    p_flat, hf = ops
+                    p_dict = self._p_unravel[s](p_flat[:self._p_sizes[s]])
+                    h = hf[:, :sizes_in[s]].reshape((mb_n,) + shapes_in[s])
+                    y = self._seg_forward(s, p_dict, h)
+                    yf = y.reshape(mb_n, -1)
+                    return jnp.pad(yf, ((0, 0), (0, A - yf.shape[-1])))
+                return run
+
+            return lax.switch(sid, [branch(s) for s in range(S)],
+                              (p_row, h_flat))
+
+        xf = x.reshape(x.shape[0], -1)
+        xf = jnp.pad(xf, ((0, 0), (0, A - xf.shape[1])))
+        out = pipeline_apply(self.mesh, block_fn, stacked, xf, self.M)
+        return out[:, :size_out].reshape((x.shape[0],) + self.out_shape)
+
+    def _stage_reg_total(self, stacked):
+        """Sum of per-stage L1/L2 penalties — one shard_map round."""
+        S = len(self.segments)
+        if not any(getattr(l, "l1", None) or getattr(l, "l2", None)
+                   for seg in self.segments for _i, l in seg):
+            return jnp.float32(0.0)
+
+        def per_stage(p_local):
+            sid = lax.axis_index("stage")
+            branches = [
+                (lambda s: lambda p_row: self._seg_reg(
+                    s, self._p_unravel[s](p_row[:self._p_sizes[s]]))
+                    + p_row[0] * 0)(s)   # keep stage-varying type uniform
+                for s in range(S)]
+            local = lax.switch(sid, branches, p_local[0])
+            return lax.psum(local, "stage")
+
+        fn = jax.shard_map(per_stage, mesh=self.mesh.mesh,
+                           in_specs=(P("stage"),),
+                           out_specs=P())
+        return fn(stacked)
+
     def _make_step(self):
-        mesh, M = self.mesh, self.M
-        out_layer, updater = self.out_layer, self.updater
+        mesh = self.mesh
+        out_layer = self.out_layer
+        conf = self.net.conf
+        S = len(self.segments)
+        g = conf.globalConf
+        out_key = str(len(conf.layers) - 1)
+
+        out_pre = conf.preProcessors.get(len(conf.layers) - 1)
 
         def loss_fn(stacked, out_p, x, y):
-            h = pipeline_apply(mesh, self._block_fn, stacked, x, M)
+            h = self._pipeline_forward(stacked, x)
+            if out_pre is not None:      # e.g. CnnToFF feeding the head
+                h = out_pre.preProcess(h, h.shape[0])
             out, _ = out_layer.forward(out_p, h, True, None, {})
-            return jnp.mean(out_layer.computeScore(y, out, None))
+            data = jnp.mean(out_layer.computeScore(y, out, None))
+            reg = self._stage_reg_total(stacked)
+            # the out layer's own L1/L2 rides the sequential helper
+            from deeplearning4j_tpu.models.multilayer import _reg_penalty
+            return data + reg + _reg_penalty([(out_layer, out_p)])
 
-        def step(stacked, out_p, opt, x, y, it, ep):
+        def update_stage(p_row, g_row, o_row, it, ep):
+            """One stage's update via the sequential leaf machinery."""
+            sid = lax.axis_index("stage")
+
+            def branch(s):
+                def run(ops):
+                    pf, gf, of = ops
+                    np_, no_ = self._p_sizes[s], self._o_sizes[s]
+                    p_dict = self._p_unravel[s](pf[:np_])
+                    g_dict = self._p_unravel[s](gf[:np_])
+                    o_dict = self._o_unravel[s](of[:no_])
+                    units = [(str(i), l) for i, l in self.segments[s]]
+                    new_p, new_o = _apply_updates(units, g, p_dict, g_dict,
+                                                  o_dict, it, ep)
+                    pf2, _ = ravel_pytree(new_p)
+                    of2, _ = ravel_pytree(new_o)
+                    # + pf*0 / of*0: a params-free stage (e.g. pooling
+                    # only) would otherwise emit non-stage-varying
+                    # constants and break the switch's type agreement
+                    return (jnp.pad(pf2, (0, self.Lp - pf2.size)) + pf * 0,
+                            jnp.pad(of2, (0, self.Lo - of2.size)) + of * 0)
+                return run
+
+            pf2, of2 = lax.switch(sid, [branch(s) for s in range(S)],
+                                  (p_row[0], g_row[0], o_row[0]))
+            # keep the leading singleton stage axis for the P("stage")
+            # out_spec (per-device block shape (1, L))
+            return pf2[None], of2[None]
+
+        def step(stacked, out_p, opt_stacked, out_opt, x, y, it, ep):
             loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                 stacked, out_p, x, y)
-            lr = updater.currentLr(it, ep)
-            trees = []
-            for tree, g, tag in ((stacked, grads[0], "p"),
-                                 (out_p, grads[1], "o")):
-                leaves, treedef = jax.tree_util.tree_flatten(tree)
-                gleaves = jax.tree_util.tree_leaves(g)
-                nl, no = [], []
-                for p_, g_, o_ in zip(leaves, gleaves, opt[tag]):
-                    upd, st = updater.apply(g_, o_, lr, it, epoch=ep,
-                                            param=p_)
-                    nl.append(p_ - upd)
-                    no.append(st)
-                trees.append((jax.tree_util.tree_unflatten(treedef, nl), no))
-            (new_stacked, nso), (new_out, noo) = trees
-            return new_stacked, new_out, {"p": nso, "o": noo}, loss
+            upd = jax.shard_map(
+                lambda p, gr, o: update_stage(p, gr, o, it, ep),
+                mesh=mesh.mesh,
+                in_specs=(P("stage"), P("stage"), P("stage")),
+                out_specs=(P("stage"), P("stage")))
+            new_stacked, new_opt = upd(stacked, grads[0], opt_stacked)
+            new_out, new_oopt = _apply_updates(
+                [(out_key, out_layer)], g, {out_key: out_p},
+                {out_key: grads[1]}, {out_key: out_opt}, it, ep)
+            return (new_stacked, new_out[out_key], new_opt,
+                    new_oopt[out_key], loss)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
     def fit(self, iterator, epochs: int = 1) -> float:
-        if self._opt is None:
-            self._opt = {
-                "p": [self.updater.init(l)
-                      for l in jax.tree_util.tree_leaves(self.stacked)],
-                "o": [self.updater.init(l)
-                      for l in jax.tree_util.tree_leaves(self.out_params)]}
         loss = None
         net = self.net
         for ep in range(int(epochs)):
@@ -212,11 +355,12 @@ class PipelinedTrainer:
                 if self._step is None:
                     self._resolve_microbatches(int(x.shape[0]))
                     self._step = self._make_step()
-                self.stacked, self.out_params, self._opt, loss = \
-                    self._step(self.stacked, self.out_params, self._opt,
-                               x, y, jnp.asarray(self.iterationCount,
-                                                 jnp.int32),
-                               jnp.asarray(net.epochCount + ep, jnp.int32))
+                (self.stacked, self.out_params, self.opt_stacked,
+                 self._out_opt, loss) = self._step(
+                    self.stacked, self.out_params, self.opt_stacked,
+                    self._out_opt, x, y,
+                    jnp.asarray(self.iterationCount, jnp.int32),
+                    jnp.asarray(net.epochCount + ep, jnp.int32))
                 self.iterationCount += 1
                 net.iterationCount += 1
                 net._scoreArr = loss
@@ -231,11 +375,13 @@ class PipelinedTrainer:
         return self.lastLoss
 
     def _write_back(self) -> None:
-        """Unstack the trained segment params back into the net's
+        """Unravel the trained per-stage rows back into the net's
         per-layer dict so output()/save() reflect the pipeline run."""
-        net, k = self.net, self.k
+        net = self.net
+        rows = jax.device_get(self.stacked)
         for s in range(len(self.segments)):
-            for j in range(k):
-                net.params_[str(s * k + j)] = jax.tree.map(
-                    lambda a: a[s], self.stacked[str(j)])
+            sp = self._p_unravel[s](jnp.asarray(rows[s][:self._p_sizes[s]]))
+            for key, lp in sp.items():
+                net.params_[key] = lp
         net.params_[str(len(net.conf.layers) - 1)] = self.out_params
+

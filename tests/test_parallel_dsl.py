@@ -75,34 +75,178 @@ def test_pipeline_stages_validation_errors():
     with pytest.raises(ValueError, match="pipelineStages"):
         ParallelWrapper(net, mesh=mesh).fit(ListDataSetIterator([ds]))
 
-    # non-identical segments refuse with a clear message
+    # recurrent layers still refuse (per-microbatch carries)
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM
     b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05)).list()
-         .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
-         .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
-         .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
-         .layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+         .layer(LSTM.builder().nOut(8).build())
+         .layer(LSTM.builder().nOut(8).build())
+         .layer(RnnOutputLayer.builder("mse").nOut(4)
+                .activation("identity").build()))
+    conf = b.setInputType(InputType.recurrent(6, 5)).build()
+    conf.globalConf["pipelineStages"] = 2
+    net2 = MultiLayerNetwork(conf).init()
+    mesh2 = DeviceMesh(data=4, stage=2, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="recurrent"):
+        ParallelWrapper(net2, mesh=mesh2).fit(ListDataSetIterator([ds]))
+
+
+def _hetero_conf(stages=0, seed=7, l2=0.0, per_layer_updater=False):
+    """4 structurally DIFFERENT stages: conv stem -> wide dense ->
+    narrow dense -> output projection (VERDICT r4 ask 3)."""
+    from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                   SubsamplingLayer)
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.05)))
+    if l2:
+        b = b.l2(l2)
+    b = (b.list()
+         .layer(ConvolutionLayer.builder().nOut(4).kernelSize(3, 3)
+                .activation("relu").build())
+         .layer(SubsamplingLayer.builder().kernelSize(2, 2).stride(2, 2)
+                .build())
+         .layer(DenseLayer.builder().nOut(32).activation("tanh")
+                .updater(Adam(1e-2) if per_layer_updater else None)
+                .build())
+         .layer(DenseLayer.builder().nOut(12).activation("tanh").build())
          .layer(OutputLayer.builder("mse").nOut(4).activation("identity")
                 .build()))
-    conf = b.setInputType(InputType.feedForward(16)).build()
-    conf.globalConf["pipelineStages"] = 4
-    net2 = MultiLayerNetwork(conf).init()
-    mesh4 = DeviceMesh(data=2, stage=4, devices=jax.devices()[:8])
-    with pytest.raises(ValueError, match="identical"):
-        ParallelWrapper(net2, mesh=mesh4).fit(ListDataSetIterator([ds]))
+    if stages:
+        b.pipelineStages(stages)
+    return b.setInputType(InputType.convolutional(10, 10, 1)).build()
 
-    # same param SHAPES but differing activation must also refuse —
-    # _block_fn runs segment 0's layers on every stage
-    b2 = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05)).list()
-          .layer(DenseLayer.builder().nOut(16).activation("tanh").build())
-          .layer(DenseLayer.builder().nOut(16).activation("relu").build())
-          .layer(OutputLayer.builder("mse").nOut(4).activation("identity")
-                 .build()))
-    conf2 = b2.setInputType(InputType.feedForward(16)).build()
-    conf2.globalConf["pipelineStages"] = 2
-    net3 = MultiLayerNetwork(conf2).init()
-    mesh2 = DeviceMesh(data=4, stage=2, devices=jax.devices()[:8])
-    with pytest.raises(ValueError, match="identical"):
-        ParallelWrapper(net3, mesh=mesh2).fit(ListDataSetIterator([ds]))
+
+def _img_data(batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch, 1, 10, 10).astype(np.float32)
+    y = rng.randn(batch, 4).astype(np.float32)
+    return DataSet(x, y)
+
+
+@requires8
+@pytest.mark.parametrize("l2,plu", [(0.0, False), (1e-3, True)])
+def test_pipeline_hetero_stages_match_single_device(l2, plu):
+    """Round 5: structurally DIFFERENT segments (conv stem + pool +
+    dense trunk + projection) pipeline through the DSL — with global L2
+    and a per-layer updater override — and the trained params match the
+    unpipelined run (GPipe is exact for stateless stacks)."""
+    ds = _img_data()
+    it = ListDataSetIterator([ds])
+
+    ref = MultiLayerNetwork(_hetero_conf(l2=l2, per_layer_updater=plu)) \
+        .init()
+    for _ in range(3):
+        ref.fit(ds)
+
+    net = MultiLayerNetwork(_hetero_conf(stages=4, l2=l2,
+                                         per_layer_updater=plu)).init()
+    mesh = DeviceMesh(data=2, stage=4, devices=jax.devices()[:8])
+    pw = ParallelWrapper(net, mesh=mesh)
+    for _ in range(3):
+        pw.fit(it, epochs=1)
+
+    for li in map(str, range(5)):
+        for k in ref.params_.get(li, {}):
+            np.testing.assert_allclose(
+                np.asarray(net.params_[li][k]),
+                np.asarray(ref.params_[li][k]), atol=5e-5,
+                err_msg=f"layer {li} param {k} (l2={l2} plu={plu})")
+
+
+@requires8
+def test_pipeline_output_layer_preprocessor():
+    """Review r5: the auto-inserted CnnToFeedForward feeding the OUTPUT
+    layer must be applied by the pipelined loss too."""
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+
+    def conf(stages=0):
+        b = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.05))
+             .list()
+             .layer(ConvolutionLayer.builder().nOut(3).kernelSize(3, 3)
+                    .activation("relu").build())
+             .layer(ConvolutionLayer.builder().nOut(4).kernelSize(3, 3)
+                    .activation("relu").build())
+             .layer(OutputLayer.builder("mse").nOut(4)
+                    .activation("identity").build()))
+        if stages:
+            b.pipelineStages(stages)
+        return b.setInputType(InputType.convolutional(10, 10, 1)).build()
+
+    ds = _img_data()
+    ref = MultiLayerNetwork(conf()).init()
+    for _ in range(2):
+        ref.fit(ds)
+    net = MultiLayerNetwork(conf(stages=2)).init()
+    mesh = DeviceMesh(data=4, stage=2, devices=jax.devices()[:8])
+    pw = ParallelWrapper(net, mesh=mesh)
+    for _ in range(2):
+        pw.fit(ListDataSetIterator([ds]), epochs=1)
+    for li in map(str, range(3)):
+        for k in ref.params_.get(li, {}):
+            np.testing.assert_allclose(
+                np.asarray(net.params_[li][k]),
+                np.asarray(ref.params_[li][k]), atol=5e-5,
+                err_msg=f"layer {li} param {k}")
+
+
+@requires8
+def test_pipeline_bf16_refuses():
+    """Review r5: dataType(BFLOAT16) under pipelineStages refuses rather
+    than silently training f32."""
+    b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05))
+         .dataType("BFLOAT16").list())
+    for _ in range(2):
+        b.layer(DenseLayer.builder().nOut(8).activation("tanh").build())
+    b.layer(OutputLayer.builder("mse").nOut(2).activation("identity")
+            .build())
+    b.pipelineStages(2)
+    conf = b.setInputType(InputType.feedForward(8)).build()
+    net = MultiLayerNetwork(conf).init()
+    mesh = DeviceMesh(data=4, stage=2, devices=jax.devices()[:8])
+    rng = np.random.RandomState(0)
+    ds = DataSet(rng.randn(8, 8).astype(np.float32),
+                 rng.randn(8, 2).astype(np.float32))
+    with pytest.raises(ValueError, match="BFLOAT16"):
+        ParallelWrapper(net, mesh=mesh).fit(ListDataSetIterator([ds]))
+
+
+@requires8
+def test_pipeline_transformer_encoder_stack():
+    """Round 5: a BERT-style encoder stack (attention + LayerNorm + FF
+    per block) pipelines; loss matches the unpipelined run."""
+    from deeplearning4j_tpu.nn.conf.misc import LayerNormalization
+
+    def conf(stages=0):
+        b = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.03))
+             .list())
+        for _ in range(4):                 # 4 encoder blocks = 4 stages
+            b.layer(SelfAttentionLayer(nHeads=2, headSize=4, nOut=8))
+            b.layer(LayerNormalization())
+        b.layer(RnnOutputLayer.builder("mse").nOut(3)
+                .activation("identity").build())
+        if stages:
+            b.pipelineStages(stages)
+        return b.setInputType(InputType.recurrent(8, 8)).build()
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, 8, 8).astype(np.float32)
+    y = rng.randn(8, 3, 8).astype(np.float32)
+    ds = DataSet(x, y)
+
+    ref = MultiLayerNetwork(conf()).init()
+    for _ in range(2):
+        ref.fit(ds)
+
+    net = MultiLayerNetwork(conf(stages=4)).init()
+    mesh = DeviceMesh(data=2, stage=4, devices=jax.devices()[:8])
+    pw = ParallelWrapper(net, mesh=mesh)
+    for _ in range(2):
+        pw.fit(ListDataSetIterator([ds]), epochs=1)
+
+    for li in map(str, range(9)):
+        for k in ref.params_.get(li, {}):
+            np.testing.assert_allclose(
+                np.asarray(net.params_[li][k]),
+                np.asarray(ref.params_[li][k]), atol=1e-4,
+                err_msg=f"layer {li} param {k}")
 
 
 def _attn_conf(seed=3):
