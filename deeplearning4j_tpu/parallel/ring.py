@@ -57,8 +57,9 @@ def _block_update(q, k, v, o, l, m, bias):
     p = jnp.exp(s - m_new)
     corr = jnp.exp(m - m_new)
     l = l * corr + p.sum(axis=-1, keepdims=True)
-    o = o * corr + jnp.einsum("...qk,...kd->...qd", p,
-                              v.astype(jnp.float32),
+    # p in the storage dtype keeps the second matmul on the full-rate MXU
+    # path (f32 operands quarter the systolic-array throughput)
+    o = o * corr + jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v,
                               preferred_element_type=jnp.float32)
     return o, l, m_new
 
@@ -123,8 +124,9 @@ def blockwise_attention(q, k, v, mask=None, causal: bool = False,
 # Pallas flash-attention kernel (TPU)
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  causal: bool, block_q: int, block_k: int, nk: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, causal: bool, block_q: int, block_k: int,
+                  nk: int):
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -136,9 +138,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     qi = pl.program_id(1)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)           # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
+        # keep q/k/v in their storage dtype (bf16) for the MXU dots —
+        # f32 operands would run the systolic array at quarter rate; the
+        # products still accumulate in f32 via preferred_element_type
+        q = q_ref[0]                               # (block_q, d)
+        k = k_ref[0]                               # (block_k, d)
+        v = v_ref[0]
         # f32 literals throughout — the package enables x64, so a bare python
         # float would be f64 in-kernel, which Mosaic cannot legalize
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -156,8 +161,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[...] = jnp.broadcast_to(
             l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True), l_ref.shape)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        # p cast to the storage dtype for the second MXU dot (standard
+        # flash practice; the f32 accumulator keeps the precision)
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         # Skip fully-future k blocks: no query row in this q block can see
@@ -168,9 +176,99 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(ki == nk - 1)
     def _fin():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[:, :1],
-                                jnp.float32(1e-30))).astype(o_ref.dtype)
+        l_fin = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
+        # per-row logsumexp banked for the flash backward's p recompute
+        # (lane-replicated to 128 — Mosaic block shapes need the trailing
+        # dim divisible by 128, same layout as jax's shipped TPU kernel)
+        lse_ref[0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l_fin),
+                                      lse_ref[0].shape)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, causal: bool, block_q: int,
+                         block_k: int, nk: int, scale: float):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * jnp.float32(scale)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, jnp.float32(_NEG))
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * jnp.float32(scale)
+        dq_acc[...] = dq_acc[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                          block_q: int, block_k: int, nq: int, scale: float):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * jnp.float32(scale)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, jnp.float32(_NEG))
+        p = jnp.exp(s - lse_ref[0][:, :1])            # (bq, bk)
+        pt = p.astype(do.dtype)
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * jnp.float32(scale)
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 try:  # pallas import is cheap; kernels only compile when called
@@ -181,46 +279,58 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
-                    block_k: int = 256, interpret: bool = False):
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 1024,
+                    block_k: int = 1024, interpret: bool = False):
     """Pallas TPU flash attention.  q/k/v: (b, h, t, d).
 
     Grid (b·h, q-blocks, k-blocks); the k dimension is sequential so the
     online-softmax accumulators live in VMEM scratch across k steps.  Off
     TPU (and not ``interpret``) falls back to :func:`blockwise_attention`.
+    1024-wide blocks measured fastest on v5e (5.7 ms vs 13.5 ms at 256²
+    for b=4 h=12 t=4096 d=64 causal bf16 — PROFILE_r05.md).
 
-    Differentiable: the forward runs the Pallas kernel; the backward
-    rematerialises through :func:`blockwise_attention`'s VJP (flash-style
-    recompute — no O(T²) residuals are ever stored).
+    Differentiable with FLASH backward kernels: the forward also banks the
+    per-row logsumexp; the backward recomputes p block-by-block in two
+    Pallas passes (dk/dv with the q-axis sequential, dq with the k-axis
+    sequential) — no O(T²) residuals are ever stored.
     """
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     if not _HAVE_PALLAS or (not on_tpu and not interpret):
-        return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_k=min(block_k, 512))
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     if tq % block_q or tk % block_k:
-        return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_k=min(block_k, 512))
     return _flash(q, k, v, causal, block_q, block_k, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               block_k=block_k), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    b, h, tq, d = q.shape
+    # delta_i = rowsum(dO ⊙ O): one fused elementwise+reduce in XLA,
+    # lane-replicated to the same (b·h, tq, 128) layout as lse
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1).reshape(b * h, tq)[:, :, None], (b * h, tq, 128))
+    dq, dk, dv = _flash_backward(q, k, v, g, lse, delta, causal,
+                                 block_q, block_k, interpret)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -242,7 +352,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     # cannot legalize (and index maps may not capture array constants) —
     # ``ki * 0`` stays i32 because program ids are i32 and the weak python
     # int does not promote.
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -250,9 +360,15 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, qi * 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, qi * 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, ki * 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, ki * 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bh, qi, ki: (bh, qi, ki * 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
@@ -262,7 +378,67 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq, d), lse
+
+
+def _flash_backward(q, k, v, g, lse, delta, causal, block_q, block_k,
+                    interpret):
+    """Two-pass Pallas flash backward: dq with the k axis sequential;
+    dk/dv with the q axis sequential.  p is recomputed per block from the
+    banked logsumexp — no O(T²) residuals."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    scale = 1.0 / (d ** 0.5)
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.astype(q.dtype).reshape(b * h, tq, d)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, ki * 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, qi * 0))
+    r_spec = pl.BlockSpec((1, block_q, 128),
+                          lambda bh, qi, ki: (bh, qi, ki * 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          scale=scale),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    # dk/dv: k blocks parallel, q axis sequential (grid order bh, ki, qi)
+    q_spec2 = pl.BlockSpec((1, block_q, d),
+                           lambda bh, ki, qi: (bh, qi, ki * 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d),
+                           lambda bh, ki, qi: (bh, ki, qi * 0))
+    r_spec2 = pl.BlockSpec((1, block_q, 128),
+                           lambda bh, ki, qi: (bh, qi, ki * 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          scale=scale),
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 # ---------------------------------------------------------------------------

@@ -74,6 +74,30 @@ class TestFlashInterpret:
         np.testing.assert_allclose(out, _dense(q, k, v, causal=True),
                                    atol=1e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward_matches_dense(self, causal):
+        """The hand-written Pallas backward (dq/dkv kernels with lse/delta
+        recompute) must match dense-attention gradients — review r5: the
+        custom VJP replaced the autodiff-derived backward and needs its
+        own coverage (asymmetric block sizes included)."""
+        q, k, v = _rand(b=2, h=2, t=64, d=16, seed=11)
+        w = jnp.cos(jnp.arange(16, dtype=jnp.float32))
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           block_q=16, block_k=32,
+                                           interpret=True) * w)
+
+        def f_dense(q, k, v):
+            return jnp.sum(jnp.asarray(_dense(q, k, v, causal=causal)) * w)
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3,
+                                       err_msg=f"d{name} causal={causal}")
+
 
 class TestRing:
     def test_matches_dense(self):
