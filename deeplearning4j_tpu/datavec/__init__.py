@@ -6,18 +6,21 @@ iterator glue, rebuilt host-side with the C++ CSV fast path
 """
 from deeplearning4j_tpu.datavec.writable import (  # noqa: F401
     BooleanWritable, DoubleWritable, FloatWritable, IntWritable, LongWritable,
-    NDArrayWritable, Text, Writable, writable)
+    NDArrayWritable, NullWritable, Text, Writable, writable)
 from deeplearning4j_tpu.datavec.records import (  # noqa: F401
     CollectionRecordReader, CollectionSequenceRecordReader, CSVRecordReader,
     CSVSequenceRecordReader, FileSplit, InputSplit, LineRecordReader,
     NumberedFileInputSplit, RecordReader, RegexLineRecordReader,
     SequenceRecordReader, StringSplit, SVMLightRecordReader)
 from deeplearning4j_tpu.datavec.schema import (  # noqa: F401
-    ColumnMetaData, ColumnType, Schema)
+    ColumnMetaData, ColumnType, Schema, SequenceSchema)
 from deeplearning4j_tpu.datavec.transform import (  # noqa: F401
     CategoricalColumnCondition, ColumnCondition, ConditionFilter, ConditionOp,
     DoubleColumnCondition, IntegerColumnCondition, LocalTransformExecutor,
-    SparkTransformExecutor, StringColumnCondition, TransformProcess)
+    NumericalColumnComparator, SparkTransformExecutor, StringColumnCondition,
+    TransformProcess)
+from deeplearning4j_tpu.datavec.join import Join, JoinType  # noqa: F401
+from deeplearning4j_tpu.datavec.reduce import ReduceOp, Reducer  # noqa: F401
 from deeplearning4j_tpu.datavec.image import (  # noqa: F401
     ColorConversionTransform, CropImageTransform, FlipImageTransform,
     ImageRecordReader, ImageTransform, NativeImageLoader,

@@ -141,3 +141,9 @@ class Schema:
     @staticmethod
     def builder() -> "Schema.Builder":
         return Schema.Builder()
+
+
+class SequenceSchema(Schema):
+    """Same columns, sequence semantics: records are List[List[Record]]
+    (reference: ``schema/SequenceSchema.java``; produced by
+    ``TransformProcess.Builder.convertToSequence``)."""

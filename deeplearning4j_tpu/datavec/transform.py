@@ -96,6 +96,10 @@ class ConditionFilter:
 class _Step:
     """One pipeline stage: schema evolution + record mapping."""
 
+    #: row-wise steps commute with partitioning (parallel/distributed
+    #: executors); global steps (reduce, convertToSequence) do not
+    row_wise = True
+
     def out_schema(self, schema: Schema) -> Schema:
         return schema
 
@@ -104,6 +108,14 @@ class _Step:
 
     def describe(self) -> dict:
         return {"op": type(self).__name__}
+
+    def mutatedColumns(self) -> set:
+        """Columns whose VALUES this step may change (conservative:
+        steps with unknown effects report {"*"})."""
+        for attr in ("name", "column"):
+            if hasattr(self, attr):
+                return {getattr(self, attr)}
+        return set()
 
 
 class _RemoveColumns(_Step):
@@ -343,6 +355,9 @@ class _StringMap(_Step):
 class _Lambda(_Step):
     """Escape hatch: arbitrary (schema, records)->records callable."""
 
+    def mutatedColumns(self) -> set:
+        return {"*"}
+
     def __init__(self, fn: Callable[[Schema, List[Record]], List[Record]],
                  schema_fn: Optional[Callable[[Schema], Schema]] = None):
         self.fn = fn
@@ -353,6 +368,93 @@ class _Lambda(_Step):
 
     def apply(self, schema, records):
         return self.fn(schema, records)
+
+
+def _group_by_key(schema, keys, records):
+    """Bucket records by their key-column value tuple (insertion order).
+    THE grouping implementation — Reducer, convertToSequence, and the
+    distributed key partitioner must agree on key semantics."""
+    kidx = [schema.getIndexOfColumn(k) for k in keys]
+    groups = {}
+    for r in records:
+        groups.setdefault(tuple(r[i].value for i in kidx), []).append(r)
+    return groups
+
+
+class NumericalColumnComparator:
+    """Sequence step ordering (reference:
+    ``transform/sequence/comparator/NumericalColumnComparator.java``)."""
+
+    def __init__(self, column: str, ascending: bool = True):
+        self.column = column
+        self.ascending = ascending
+
+    def sortKey(self, schema: Schema):
+        idx = schema.getIndexOfColumn(self.column)
+        return lambda rec: rec[idx].toDouble()
+
+
+class StringComparator(NumericalColumnComparator):
+    """Lexicographic sequence ordering on a string column."""
+
+    def sortKey(self, schema: Schema):
+        idx = schema.getIndexOfColumn(self.column)
+        return lambda rec: rec[idx].toString() \
+            if hasattr(rec[idx], "toString") else str(rec[idx].value)
+
+
+class _Reduce(_Step):
+    """GroupBy + aggregate (reference: TransformProcess.Builder.reduce)."""
+    row_wise = False
+
+    def __init__(self, reducer):
+        self.reducer = reducer
+
+    def out_schema(self, schema):
+        return self.reducer.outSchema(schema)
+
+    def apply(self, schema, records):
+        return self.reducer.reduce(schema, records)
+
+    def keyColumns(self):
+        return list(self.reducer.keys)
+
+    def describe(self):
+        return {"op": "_Reduce", "keys": self.reducer.keys,
+                "default": self.reducer.defaultOp,
+                "colOps": self.reducer.colOps}
+
+
+class _ConvertToSequence(_Step):
+    """Group rows by key into time-ordered sequences (reference:
+    ``TransformProcess.Builder.convertToSequence(keyColumns,
+    comparator)`` + ``ConvertToSequence.java``)."""
+    row_wise = False
+
+    def __init__(self, keys, comparator):
+        self.keys = list(keys)
+        self.comparator = comparator
+
+    def out_schema(self, schema):
+        from deeplearning4j_tpu.datavec.schema import SequenceSchema
+        return SequenceSchema(schema.columns)
+
+    def apply(self, schema, records):
+        groups = _group_by_key(schema, self.keys, records)
+        key_fn = self.comparator.sortKey(schema) if self.comparator else None
+        out = []
+        for _key, rows in groups.items():          # insertion order
+            if key_fn is not None:
+                rows = sorted(rows, key=key_fn,
+                              reverse=not self.comparator.ascending)
+            out.append(rows)
+        return out
+
+    def keyColumns(self):
+        return list(self.keys)
+
+    def describe(self):
+        return {"op": "_ConvertToSequence", "keys": self.keys}
 
 
 # -------------------------------------------------------------- process ----
@@ -370,16 +472,48 @@ class TransformProcess:
 
     def execute(self, records: List[Record]) -> List[Record]:
         s = self.initialSchema
+        sequence_mode = False
         for st in self.steps:
-            records = st.apply(s, records)
+            if sequence_mode and st.row_wise:
+                # after convertToSequence, row-wise steps apply WITHIN
+                # each sequence (the reference's sequence-transform
+                # semantics); filters drop steps inside a sequence
+                records = [st.apply(s, seq) for seq in records]
+            else:
+                records = st.apply(s, records)
             s = st.out_schema(s)
+            if isinstance(st, _ConvertToSequence):
+                sequence_mode = True
         return records
 
     def hasFilters(self) -> bool:
         """True when any step can DROP rows (row counts then aren't
         partition-additive — the distributed count check skips)."""
         return any(type(st).__name__ in ("_Filter", "_RemoveInvalid")
-                   for st in self.steps)
+                   for st in self.steps) or not self.isRowWise()
+
+    def isRowWise(self) -> bool:
+        """False when the process contains a global (group-by) step."""
+        return all(st.row_wise for st in self.steps)
+
+    def firstGlobalKeyColumns(self) -> Optional[List[str]]:
+        """Key columns of the first global step, IF they exist in the
+        initial schema AND no earlier step can change their values (the
+        distributed executor partitions input rows by them, so a mutated
+        key would split groups across ranks)."""
+        mutated: set = set()
+        for st in self.steps:
+            if not st.row_wise:
+                keys = st.keyColumns()
+                if all(self.initialSchema.hasColumn(k) for k in keys) \
+                        and not (mutated & set(keys)) and \
+                        mutated != {"*"}:
+                    return keys
+                return None
+            mutated |= st.mutatedColumns()
+            if "*" in mutated:
+                mutated = {"*"}
+        return None
 
     def toJson(self) -> str:
         return json.dumps({
@@ -393,6 +527,13 @@ class TransformProcess:
             self._steps: List[_Step] = []
 
         def _add(self, step: _Step) -> "TransformProcess.Builder":
+            from deeplearning4j_tpu.datavec.schema import SequenceSchema
+            if not step.row_wise and isinstance(self._schema,
+                                                SequenceSchema):
+                raise ValueError(
+                    f"{type(step).__name__.lstrip('_')} after "
+                    "convertToSequence is unsupported (sequences cannot "
+                    "be re-grouped)")
             self._steps.append(step)
             self._schema = step.out_schema(self._schema)
             return self
@@ -447,12 +588,44 @@ class TransformProcess:
         def transform(self, fn, schema_fn=None):
             return self._add(_Lambda(fn, schema_fn))
 
+        def reduce(self, reducer) -> "TransformProcess.Builder":
+            """GroupBy + aggregate (reference:
+            ``TransformProcess.Builder.reduce(IAssociativeReducer)``)."""
+            return self._add(_Reduce(reducer))
+
+        def convertToSequence(self, keyColumns, comparator=None
+                              ) -> "TransformProcess.Builder":
+            """Group rows into per-key sequences ordered by
+            ``comparator`` (reference: ``convertToSequence``)."""
+            if isinstance(keyColumns, str):
+                keyColumns = [keyColumns]
+            return self._add(_ConvertToSequence(keyColumns, comparator))
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self._schema0, self._steps)
 
     @staticmethod
     def builder(initialSchema: Schema) -> "TransformProcess.Builder":
         return TransformProcess.Builder(initialSchema)
+
+
+def _key_norm(v) -> str:
+    """Normalize a key value so equal keys of different numeric types
+    (3, 3.0, True) hash identically — matching dict-equality grouping."""
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, float) and v.is_integer():
+        v = int(v)
+    return str(v)
+
+
+def _key_hash(record, kidx) -> int:
+    """Deterministic (cross-process) hash of a record's key values."""
+    import zlib
+    s = "\x1f".join(_key_norm(record[i].value
+                              if hasattr(record[i], "value")
+                              else record[i]) for i in kidx)
+    return zlib.crc32(s.encode())
 
 
 class LocalTransformExecutor:
@@ -463,15 +636,27 @@ class LocalTransformExecutor:
         return tp.execute([[writable(v) for v in r] for r in records])
 
     @staticmethod
+    def executeJoin(join, left: List[Record],
+                    right: List[Record]) -> List[Record]:
+        """Reference: datavec-local/spark ``executeJoin(Join, left,
+        right)``."""
+        return join.executeJoin(
+            [[writable(v) for v in r] for r in left],
+            [[writable(v) for v in r] for r in right])
+
+    @staticmethod
     def executeParallel(records: List[Record], tp: TransformProcess,
                         minChunk: int = 256) -> List[Record]:
         """Partitioned TransformProcess execution over the native
         work-stealing pool (reference: datavec-spark
         ``SparkTransformExecutor`` mapPartitions — here the partitions run
         on ``native/src/threads.cpp``'s parallel_for instead of a
-        cluster).  Every built-in step is row-wise, so chunked execution
-        is exactly sequential execution; chunk results are concatenated
-        in order (filters may shrink chunks independently)."""
+        cluster).  Row-wise steps commute with chunking; a process with a
+        GLOBAL step (reduce/convertToSequence) would split groups across
+        chunks, so it runs unchunked (the distributed executor instead
+        partitions BY KEY — see executeDistributed)."""
+        if not tp.isRowWise():
+            return LocalTransformExecutor.execute(records, tp)
         from deeplearning4j_tpu import native
         recs = [[writable(v) for v in r] for r in records]
         results: dict = {}
@@ -503,6 +688,33 @@ class SparkTransformExecutor:
                                                       minChunk=chunk)
 
     @staticmethod
+    def executeJoin(join, left: List[Record],
+                    right: List[Record]) -> List[Record]:
+        """Reference: ``SparkTransformExecutor.executeJoin``."""
+        return LocalTransformExecutor.executeJoin(join, left, right)
+
+    @staticmethod
+    def executeJoinDistributed(join, left: List[Record],
+                               right: List[Record]) -> List[Record]:
+        """Distributed join over a ``jax.distributed`` cluster: BOTH
+        sides hash-partition by the join key, each rank joins its
+        partition (Spark's shuffle-join semantics — the union of every
+        rank's return equals the single-host join)."""
+        import jax
+
+        nproc = jax.process_count()
+        if nproc <= 1:
+            return LocalTransformExecutor.executeJoin(join, left, right)
+        rank = jax.process_index()
+        li = [join.leftSchema.getIndexOfColumn(k) for k in join.keysLeft]
+        ri = [join.rightSchema.getIndexOfColumn(k) for k in join.keysRight]
+        lw = [[writable(v) for v in r] for r in left]
+        rw = [[writable(v) for v in r] for r in right]
+        return join.executeJoin(
+            [r for r in lw if _key_hash(r, li) % nproc == rank],
+            [r for r in rw if _key_hash(r, ri) % nproc == rank])
+
+    @staticmethod
     def executeDistributed(records: List[Record],
                            tp: TransformProcess) -> List[Record]:
         """Distributed TransformProcess over a ``jax.distributed``
@@ -520,7 +732,20 @@ class SparkTransformExecutor:
         if nproc <= 1:
             return SparkTransformExecutor.execute(records, tp)
         rank = jax.process_index()
-        shard = records[rank::nproc]
+        if tp.isRowWise():
+            shard = records[rank::nproc]
+        else:
+            # global (group-by) steps: partition BY KEY HASH so every
+            # group lands whole on one rank (Spark's shuffle semantics)
+            keys = tp.firstGlobalKeyColumns()
+            if keys is None:
+                raise ValueError(
+                    "executeDistributed: the first reduce/"
+                    "convertToSequence key columns must exist in the "
+                    "initial schema so rows can be key-partitioned")
+            kidx = [tp.initialSchema.getIndexOfColumn(k) for k in keys]
+            shard = [r for r in records
+                     if _key_hash(r, kidx) % nproc == rank]
         out = LocalTransformExecutor.executeParallel(shard, tp)
 
         # global row-count check across ranks (Gloo/ICI collective over

@@ -77,6 +77,18 @@ class Text(Writable):
         return self.value
 
 
+class NullWritable(Writable):
+    """Missing value (reference: NullWritable — outer-join fill)."""
+    def __init__(self):
+        self.value = None
+
+    def toDouble(self):
+        raise ValueError("NullWritable has no numeric value")
+
+    def __repr__(self):
+        return "NullWritable()"
+
+
 class NDArrayWritable(Writable):
     def __init__(self, value):
         self.value = np.asarray(value)
@@ -103,6 +115,8 @@ def writable(v) -> Writable:
     """Coerce a python value to the narrowest Writable."""
     if isinstance(v, Writable):
         return v
+    if v is None:
+        return NullWritable()   # outer-join fill round-trips as null
     if isinstance(v, (bool, np.bool_)):
         return BooleanWritable(bool(v))
     if isinstance(v, (int, np.integer)):

@@ -141,6 +141,79 @@ def test_distributed_transform_two_processes(tmp_path):
     assert len(outs[0]) == 10 and len(outs[1]) == 10
 
 
+_WORKER_JOINREDUCE = textwrap.dedent("""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+jax.distributed.initialize({addr!r}, num_processes=2, process_id=pid)
+from deeplearning4j_tpu.datavec import (DoubleWritable, IntWritable, Join,
+                                        JoinType, ReduceOp, Reducer, Schema,
+                                        SparkTransformExecutor, Text,
+                                        TransformProcess)
+from deeplearning4j_tpu.datavec.transform import LocalTransformExecutor
+
+ls = Schema.Builder().addColumnInteger("id").addColumnString("n").build()
+rs = Schema.Builder().addColumnInteger("id").addColumnDouble("v").build()
+left = [[i % 7, "n%d" % i] for i in range(21)]
+right = [[i % 7, i * 0.5] for i in range(14)]
+j = (Join.Builder(JoinType.Inner).setJoinColumns("id")
+     .setSchemas(ls, rs).build())
+joined = SparkTransformExecutor.executeJoinDistributed(j, left, right)
+
+tp = (TransformProcess.Builder(j.getOutputSchema())
+      .reduce(Reducer.Builder(ReduceOp.TakeFirst).keyColumns("id")
+              .sumColumns("v").countColumns("n").build()).build())
+reduced = SparkTransformExecutor.executeDistributed(
+    [[w.value for w in r] for r in joined], tp)
+rows = [[w.value for w in r] for r in reduced]
+print("SHARD", json.dumps(rows), flush=True)
+""")
+
+
+def test_distributed_join_reduce_two_processes():
+    """Round 5 (VERDICT r4 ask 5): a two-reader JOIN + grouped REDUCE
+    over two jax.distributed processes — both sides key-hash-partition,
+    each rank joins and reduces whole groups; the union of rank outputs
+    equals the single-process result."""
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    script = _WORKER_JOINREDUCE.format(root=_ROOT, addr=addr)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for pid in range(2)]
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=300)
+        assert p.returncode == 0, stderr[-2000:]
+        line = next(l for l in stdout.splitlines() if l.startswith("SHARD"))
+        outs.append(json.loads(line[len("SHARD "):]))
+
+    # single-process reference
+    from deeplearning4j_tpu.datavec import (Join, JoinType, ReduceOp,
+                                            Reducer)
+    from deeplearning4j_tpu.datavec.transform import LocalTransformExecutor
+    ls = Schema.Builder().addColumnInteger("id").addColumnString("n").build()
+    rs = Schema.Builder().addColumnInteger("id").addColumnDouble("v").build()
+    left = [[i % 7, f"n{i}"] for i in range(21)]
+    right = [[i % 7, i * 0.5] for i in range(14)]
+    j = (Join.Builder(JoinType.Inner).setJoinColumns("id")
+         .setSchemas(ls, rs).build())
+    joined = LocalTransformExecutor.executeJoin(j, left, right)
+    tp = (TransformProcess.Builder(j.getOutputSchema())
+          .reduce(Reducer.Builder(ReduceOp.TakeFirst).keyColumns("id")
+                  .sumColumns("v").countColumns("n").build()).build())
+    expected = sorted([[w.value for w in r] for r in tp.execute(joined)])
+    got = sorted(outs[0] + outs[1])
+    assert got == expected
+    assert outs[0] and outs[1]      # both ranks did real work
+
+
 # ------------------------------------------------------------------ excel --
 def test_excel_record_reader_roundtrip(tmp_path):
     """datavec-excel parity: from-scratch stdlib .xlsx reader/writer."""

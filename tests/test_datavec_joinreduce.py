@@ -1,0 +1,246 @@
+"""TransformProcess Join / Reducer / ConvertToSequence (VERDICT r4 ask 5).
+
+Reference: datavec-api ``transform/join/Join.java``,
+``transform/reduce/Reducer.java``, ``TransformProcess.convertToSequence``
+— executed identically under the local, parallel, and distributed
+executors (the distributed leg lives in test_datavec_distributed.py).
+"""
+import pytest
+
+from deeplearning4j_tpu.datavec import (DoubleWritable, IntWritable, Join,
+                                        JoinType, LocalTransformExecutor,
+                                        NullWritable,
+                                        NumericalColumnComparator, ReduceOp,
+                                        Reducer, Schema, SequenceSchema,
+                                        SparkTransformExecutor, Text,
+                                        TransformProcess)
+
+
+def _left_schema():
+    return (Schema.Builder().addColumnInteger("id")
+            .addColumnString("name").build())
+
+
+def _right_schema():
+    return (Schema.Builder().addColumnInteger("id")
+            .addColumnDouble("score").build())
+
+
+LEFT = [[1, "a"], [2, "b"], [3, "c"], [2, "b2"]]
+RIGHT = [[2, 0.5], [3, 1.5], [3, 2.5], [4, 9.0]]
+
+
+class TestJoin:
+    def _join(self, jt):
+        j = (Join.Builder(jt).setJoinColumns("id")
+             .setSchemas(_left_schema(), _right_schema()).build())
+        out = LocalTransformExecutor.executeJoin(j, LEFT, RIGHT)
+        return j, [[w.value for w in r] for r in out]
+
+    def test_output_schema(self):
+        j = (Join.Builder(JoinType.Inner).setJoinColumns("id")
+             .setSchemas(_left_schema(), _right_schema()).build())
+        assert j.getOutputSchema().getColumnNames() == \
+            ["id", "name", "score"]
+
+    def test_inner(self):
+        _, rows = self._join(JoinType.Inner)
+        assert sorted(rows) == [[2, "b", 0.5], [2, "b2", 0.5],
+                                [3, "c", 1.5], [3, "c", 2.5]]
+
+    def test_left_outer(self):
+        _, rows = self._join(JoinType.LeftOuter)
+        assert [1, "a", None] in rows
+        assert len(rows) == 5
+
+    def test_right_outer(self):
+        _, rows = self._join(JoinType.RightOuter)
+        # unmatched right row surfaces its key in the left key slot
+        assert [4, None, 9.0] in rows
+        assert len(rows) == 5
+
+    def test_full_outer(self):
+        _, rows = self._join(JoinType.FullOuter)
+        assert [1, "a", None] in rows and [4, None, 9.0] in rows
+        assert len(rows) == 6
+
+    def test_duplicate_nonkey_column_renames(self):
+        r2 = (Schema.Builder().addColumnInteger("id")
+              .addColumnString("name").build())
+        j = (Join.Builder(JoinType.Inner).setJoinColumns("id")
+             .setSchemas(_left_schema(), r2).build())
+        assert j.getOutputSchema().getColumnNames() == \
+            ["id", "name", "right_name"]
+
+
+def _sales_schema():
+    return (Schema.Builder().addColumnString("store")
+            .addColumnInteger("qty").addColumnDouble("price").build())
+
+
+SALES = [["east", 3, 10.0], ["west", 1, 5.0], ["east", 2, 20.0],
+         ["west", 4, 2.5], ["east", 5, 30.0]]
+
+
+class TestReducer:
+    def _tp(self):
+        red = (Reducer.Builder(ReduceOp.TakeFirst).keyColumns("store")
+               .sumColumns("qty").meanColumns("price").build())
+        return (TransformProcess.Builder(_sales_schema())
+                .reduce(red).build())
+
+    def test_schema_names_and_types(self):
+        s = self._tp().getFinalSchema()
+        assert s.getColumnNames() == ["store", "sum(qty)", "mean(price)"]
+        assert s.getType("sum(qty)") == "Long"
+        assert s.getType("mean(price)") == "Double"
+
+    def test_values(self):
+        out = LocalTransformExecutor.execute(SALES, self._tp())
+        rows = {r[0].value: (r[1].value, r[2].value) for r in out}
+        assert rows["east"] == (10, pytest.approx(20.0))
+        assert rows["west"] == (5, pytest.approx(3.75))
+
+    def test_more_ops(self):
+        red = (Reducer.Builder(ReduceOp.TakeFirst).keyColumns("store")
+               .minColumns("qty").maxColumns("price")
+               .build())
+        tp = (TransformProcess.Builder(_sales_schema())
+              .duplicateColumn("qty", "qty2")
+              .reduce(red).build())
+        # the duplicated column falls under the DEFAULT TakeFirst op
+        s = tp.getFinalSchema()
+        assert s.getColumnNames() == ["store", "min(qty)", "max(price)",
+                                      "qty2"]
+        out = LocalTransformExecutor.execute(SALES, tp)
+        rows = {r[0].value: [w.value for w in r[1:]] for r in out}
+        assert rows["east"] == [2, 30.0, 3]
+        assert rows["west"] == [1, 5.0, 1]
+
+    def test_stdev_count_unique(self):
+        red = (Reducer.Builder(ReduceOp.TakeFirst).keyColumns("store")
+               .stdevColumns("price").countUniqueColumns("qty").build())
+        tp = TransformProcess.Builder(_sales_schema()).reduce(red).build()
+        out = LocalTransformExecutor.execute(SALES, tp)
+        rows = {r[0].value: [w.value for w in r[1:]] for r in out}
+        assert rows["east"][0] == 3     # countUnique(qty) over {3,2,5}
+        assert rows["east"][1] == pytest.approx(10.0)   # stdev(price)
+
+    def test_parallel_executor_matches(self):
+        tp = self._tp()
+        a = LocalTransformExecutor.execute(SALES, tp)
+        b = LocalTransformExecutor.executeParallel(SALES, tp, minChunk=2)
+        c = SparkTransformExecutor.execute(SALES, tp, numPartitions=3)
+        va = [[w.value for w in r] for r in a]
+        assert va == [[w.value for w in r] for r in b]
+        assert va == [[w.value for w in r] for r in c]
+
+
+class TestConvertToSequence:
+    def _tp(self):
+        return (TransformProcess.Builder(_sales_schema())
+                .convertToSequence(
+                    "store", NumericalColumnComparator("qty"))
+                .doubleMathOp("price", "Multiply", 2.0)
+                .build())
+
+    def test_sequence_schema_and_grouping(self):
+        tp = self._tp()
+        assert isinstance(tp.getFinalSchema(), SequenceSchema)
+        seqs = LocalTransformExecutor.execute(SALES, tp)
+        assert len(seqs) == 2
+        by_store = {seq[0][0].value: seq for seq in seqs}
+        east = by_store["east"]
+        # ordered by qty ascending: 2, 3, 5 — and the post-sequence
+        # row-wise step applied WITHIN each sequence (price doubled)
+        assert [r[1].value for r in east] == [2, 3, 5]
+        assert [r[2].value for r in east] == [40.0, 20.0, 60.0]
+
+    def test_descending(self):
+        tp = (TransformProcess.Builder(_sales_schema())
+              .convertToSequence(
+                  ["store"], NumericalColumnComparator("qty",
+                                                       ascending=False))
+              .build())
+        seqs = LocalTransformExecutor.execute(SALES, tp)
+        east = {s[0][0].value: s for s in seqs}["east"]
+        assert [r[1].value for r in east] == [5, 3, 2]
+
+    def test_executors_match(self):
+        tp = self._tp()
+        a = LocalTransformExecutor.execute(SALES, tp)
+        b = LocalTransformExecutor.executeParallel(SALES, tp)
+        flat = lambda seqs: [[[w.value for w in r] for r in s]  # noqa: E731
+                             for s in seqs]
+        assert flat(a) == flat(b)
+
+
+class TestReviewRegressions:
+    def test_null_value_roundtrips(self):
+        from deeplearning4j_tpu.datavec.writable import writable
+        w = writable(None)
+        assert isinstance(w, NullWritable) and w.value is None
+
+    def test_string_comparator_sorts_lexicographically(self):
+        from deeplearning4j_tpu.datavec.transform import StringComparator
+        tp = (TransformProcess.Builder(_sales_schema())
+              .duplicateColumn("price", "tag")
+              .transform(lambda s, rs: [
+                  r[:3] + [Text(f"t{int(r[1].value)}")] for r in rs])
+              .convertToSequence(["store"], StringComparator("tag"))
+              .build())
+        seqs = tp.execute([[Text(a), IntWritable(b), DoubleWritable(c)]
+                           for a, b, c in SALES])
+        east = {s[0][0].value: s for s in seqs}["east"]
+        assert [r[3].value for r in east] == ["t2", "t3", "t5"]
+
+    def test_global_step_after_sequence_refuses(self):
+        red = (Reducer.Builder().keyColumns("store")
+               .sumColumns("qty").build())
+        b = (TransformProcess.Builder(_sales_schema())
+             .convertToSequence(["store"]))
+        with pytest.raises(ValueError, match="convertToSequence"):
+            b.reduce(red)
+
+    def test_distributed_key_partition_refuses_mutated_keys(self):
+        """A row-wise step changing the key column's VALUES before the
+        reduce makes key-hash partitioning unsound — the tp must report
+        no partitionable key (executeDistributed then refuses)."""
+        red = (Reducer.Builder().keyColumns("qty")
+               .meanColumns("price").build())
+        tp = (TransformProcess.Builder(_sales_schema())
+              .integerMathOp("qty", "Modulus", 2)
+              .reduce(red).build())
+        assert tp.firstGlobalKeyColumns() is None
+        tp_ok = (TransformProcess.Builder(_sales_schema())
+                 .doubleMathOp("price", "Multiply", 2.0)
+                 .reduce(red).build())
+        assert tp_ok.firstGlobalKeyColumns() == ["qty"]
+
+    def test_key_hash_normalizes_numeric_types(self):
+        from deeplearning4j_tpu.datavec.transform import _key_hash
+        a = _key_hash([IntWritable(3)], [0])
+        b = _key_hash([DoubleWritable(3.0)], [0])
+        assert a == b
+
+
+def test_join_reduce_sequence_pipeline():
+    """The VERDICT done-criterion composition: two-reader join ->
+    grouped aggregation -> sequence conversion."""
+    j = (Join.Builder(JoinType.Inner).setJoinColumns("id")
+         .setSchemas(_left_schema(), _right_schema()).build())
+    joined = LocalTransformExecutor.executeJoin(j, LEFT, RIGHT)
+    tp = (TransformProcess.Builder(j.getOutputSchema())
+          .reduce(Reducer.Builder(ReduceOp.TakeFirst).keyColumns("id")
+                  .sumColumns("score").countColumns("name").build())
+          .build())
+    reduced = tp.execute(joined)
+    rows = {r[0].value: [w.value for w in r[1:]] for r in reduced}
+    assert rows[2] == [2, 1.0]      # two joined rows, scores 0.5+0.5
+    assert rows[3] == [2, 4.0]      # two joined rows, scores 1.5+2.5
+
+    tp2 = (TransformProcess.Builder(j.getOutputSchema())
+           .convertToSequence(["id"], NumericalColumnComparator("score"))
+           .build())
+    seqs = tp2.execute(joined)
+    assert {s[0][0].value for s in seqs} == {2, 3}
