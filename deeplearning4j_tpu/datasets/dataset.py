@@ -57,25 +57,47 @@ class DataSet:
         self.features = NDArray(self.features.numpy()[perm])
         if self.labels is not None:
             self.labels = NDArray(self.labels.numpy()[perm])
+        if self.featuresMask is not None:
+            self.featuresMask = NDArray(self.featuresMask.numpy()[perm])
+        if self.labelsMask is not None:
+            self.labelsMask = NDArray(self.labelsMask.numpy()[perm])
 
     def batchBy(self, batchSize: int) -> List["DataSet"]:
         n = self.numExamples()
         out = []
         f, l = self.features.numpy(), self.labels.numpy()
+        fm = self.featuresMask.numpy() if self.featuresMask is not None \
+            else None
+        lm = self.labelsMask.numpy() if self.labelsMask is not None else None
         for i in range(0, n, batchSize):
-            out.append(DataSet(f[i:i + batchSize], l[i:i + batchSize]))
+            s = slice(i, i + batchSize)
+            out.append(DataSet(f[s], l[s],
+                               featuresMask=fm[s] if fm is not None
+                               else None,
+                               labelsMask=lm[s] if lm is not None
+                               else None))
         return out
 
     def sample(self, n: int, seed: Optional[int] = None) -> "DataSet":
         rng = np.random.RandomState(seed)
         idx = rng.choice(self.numExamples(), size=n, replace=False)
-        return DataSet(self.features.numpy()[idx], self.labels.numpy()[idx])
+        return DataSet(
+            self.features.numpy()[idx], self.labels.numpy()[idx],
+            featuresMask=self.featuresMask.numpy()[idx]
+            if self.featuresMask is not None else None,
+            labelsMask=self.labelsMask.numpy()[idx]
+            if self.labelsMask is not None else None)
 
     @staticmethod
     def merge(datasets: Sequence["DataSet"]) -> "DataSet":
         f = np.concatenate([d.features.numpy() for d in datasets])
         l = np.concatenate([d.labels.numpy() for d in datasets])
-        return DataSet(f, l)
+        fm = lm = None
+        if all(d.featuresMask is not None for d in datasets):
+            fm = np.concatenate([d.featuresMask.numpy() for d in datasets])
+        if all(d.labelsMask is not None for d in datasets):
+            lm = np.concatenate([d.labelsMask.numpy() for d in datasets])
+        return DataSet(f, l, featuresMask=fm, labelsMask=lm)
 
     def asList(self) -> List["DataSet"]:
         return self.batchBy(1)

@@ -222,6 +222,11 @@ class _Ctx:
         weight_outs: set = set()
         for n in nodes:
             if n.op_type in _WEIGHT_BEARING_OPS:
+                # NOTE: Gather outputs deliberately do NOT propagate —
+                # Add(embedding_out, table) cannot be told apart from a
+                # fixed sinusoidal/anchor table, so such tables stay
+                # frozen (learned positions included; the conservative
+                # choice keeps the frozen-initializer invariant)
                 weight_outs.update(n.outputs)
             elif n.op_type in _PASSTHROUGH_OPS and \
                     any(i in weight_outs for i in n.inputs[:1]):
@@ -386,8 +391,10 @@ def _concat(ctx, node):
 
 @_op("Gather")
 def _gather(ctx, node):
-    idx = ctx.const_val(node.inputs[1])
-    return ctx.sd.gather(ctx.get(node.inputs[0]), idx.astype(np.int32),
+    # sd.gather takes constant arrays AND SDVariable indices (the
+    # dynamic embedding-lookup case: token ids are a placeholder)
+    return ctx.sd.gather(ctx.get(node.inputs[0]),
+                         ctx.get(node.inputs[1]),
                          axis=int(node.attrs.get("axis", 0)))
 
 

@@ -272,3 +272,4 @@ def _json_default(o):
     return str(o)
 from deeplearning4j_tpu.nn.conf import attention  # noqa: F401  (registers attention layers)
 from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf.autoencoder import AutoEncoder  # noqa: F401,E402

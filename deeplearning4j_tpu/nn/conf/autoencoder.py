@@ -1,0 +1,106 @@
+"""Plain (denoising) autoencoder layer.
+
+Reference: deeplearning4j-nn ``org/deeplearning4j/nn/conf/layers/
+AutoEncoder.java`` + ``layers/feedforward/autoencoder/AutoEncoder.java``
+(BasePretrainNetwork): tied-weight encode/decode with a visible bias and
+input corruption — wired into ``MultiLayerNetwork.pretrain`` exactly
+like the VariationalAutoencoder (``isPretrainLayer``).
+
+Semantics follow the reference: encode h = act(x·W + b); decode
+x' = act(h·Wᵀ + vb) (tied weights, separate visible bias);
+``corruptionLevel`` zeroes that fraction of inputs during pretraining
+(denoising-autoencoder corruption); ``pretrainLoss`` applies the
+configured loss function between the clean input and the
+reconstruction.  The supervised forward is the encoder alone.
+
+TPU-first: the whole corrupt→encode→decode→loss chain is one fused
+computation inside the pretrain jitted step.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["AutoEncoder"]
+
+
+@dataclasses.dataclass
+class AutoEncoder(BaseLayer):
+    nIn: int = 0
+    nOut: int = 0                      # hidden (code) size
+    corruptionLevel: float = 0.3       # fraction of inputs zeroed
+    sparsity: float = 0.0              # accepted for parity (unused)
+    lossFunction: str = "mse"          # | "xent" (binary cross-entropy)
+
+    isPretrainLayer = True
+
+    def preferredFormat(self):
+        # a FeedForwardLayer in the reference (BasePretrainNetwork)
+        return "FF"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+    def weightParamKeys(self):
+        return ("W",)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        return {"W": init_weight(kW, (self.nIn, self.nOut), self.nIn,
+                                 self.nOut, self.weightInit or "XAVIER",
+                                 dtype),
+                "b": jnp.zeros((self.nOut,), dtype),
+                "vb": jnp.zeros((self.nIn,), dtype)}
+
+    # ------------------------------------------------------------------
+    def _act(self):
+        return get_activation(self.activation or "sigmoid")
+
+    def encode(self, params, x):
+        return self._act()(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self._act()(h @ params["W"].T + params["vb"])
+
+    def forward(self, params, x, train, key, state):
+        # supervised mode: the encoder activation (reference activate())
+        x = self._dropin(x, train, key)
+        return self.encode(params, x), state
+
+    # ------------------------------------------------------------------
+    def pretrainLoss(self, params, x, key):
+        """Reconstruction loss of the (corrupted-input) autoencoder —
+        the quantity MultiLayerNetwork.pretrain minimizes."""
+        xc = x
+        if 0.0 < self.corruptionLevel < 1.0 and key is not None:
+            mask = jax.random.bernoulli(key, 1.0 - self.corruptionLevel,
+                                        x.shape)
+            xc = jnp.where(mask, x, 0.0)
+        xr = self.decode(params, self.encode(params, xc))
+        if self.lossFunction == "xent":
+            eps = 1e-7
+            xr = jnp.clip(xr, eps, 1.0 - eps)
+            per = -jnp.sum(x * jnp.log(xr) + (1 - x) * jnp.log(1 - xr),
+                           axis=-1)
+        else:
+            per = jnp.sum((x - xr) ** 2, axis=-1)
+        return jnp.mean(per)
+
+    def reconstructionError(self, params, x):
+        """Per-example clean reconstruction error (anomaly scoring)."""
+        xr = self.decode(params, self.encode(params, x))
+        return jnp.sum((x - xr) ** 2, axis=-1)
+
+
+register_layer(AutoEncoder)

@@ -93,6 +93,73 @@ def test_pretrain_empty_iterator_keeps_score():
     assert net._scoreArr is None           # loss never bound — no crash
 
 
+class TestPlainAutoEncoder:
+    """Plain (denoising) AutoEncoder layer + pretrain (VERDICT r4 ask 8;
+    reference: conf/layers/AutoEncoder.java)."""
+
+    def _net(self, corruption=0.3, loss="mse"):
+        from deeplearning4j_tpu.nn.conf import AutoEncoder
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(AutoEncoder(nOut=4, corruptionLevel=corruption,
+                                   lossFunction=loss, activation="sigmoid"))
+                .layer(OutputLayer.builder("mse").nOut(2)
+                       .activation("identity").build())
+                .setInputType(InputType.feedForward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_pretrain_reduces_reconstruction_error(self):
+        net = self._net()
+        layer = net.conf.layers[0]
+        # sigmoid decoder: data must live in (0, 1)
+        X = np.clip(_blobs() * 0.2 + 0.3, 0.0, 1.0).astype(np.float32)
+        it = ListDataSetIterator([DataSet(X, np.zeros((128, 2), np.float32))],
+                                 batch=128)
+        e0 = float(np.mean(np.asarray(
+            layer.reconstructionError(net.params_["0"], X))))
+        net.pretrain(it, epochs=80)
+        e1 = float(np.mean(np.asarray(
+            layer.reconstructionError(net.params_["0"], X))))
+        assert e1 < e0 * 0.5, (e0, e1)
+        # anomaly scoring: outliers reconstruct worse
+        out = np.full((32, 6), 0.99, np.float32)
+        r_in = np.asarray(layer.reconstructionError(net.params_["0"],
+                                                    X[:32]))
+        r_out = np.asarray(layer.reconstructionError(net.params_["0"], out))
+        assert r_out.mean() > r_in.mean()
+
+    def test_xent_loss_and_tied_weights(self):
+        import jax
+        net = self._net(loss="xent")
+        layer = net.conf.layers[0]
+        p = net.params_["0"]
+        assert set(p) == {"W", "b", "vb"}      # tied weights: no W2
+        rng = np.random.RandomState(5)
+        X = (rng.rand(32, 6) < 0.4).astype(np.float32)
+        l = float(layer.pretrainLoss(p, X, jax.random.PRNGKey(0)))
+        assert np.isfinite(l) and l > 0
+
+    def test_supervised_forward_is_encoder(self):
+        net = self._net(corruption=0.0)
+        X = _blobs(n=8)
+        out = np.asarray(net.output(X).numpy())
+        assert out.shape == (8, 2)             # AE code (4) -> dense head
+
+    def test_serde_roundtrip(self):
+        from deeplearning4j_tpu.utils.model_serializer import ModelSerializer
+        import os
+        import tempfile
+        net = self._net()
+        X = _blobs(n=8)
+        want = np.asarray(net.output(X).numpy())
+        with tempfile.TemporaryDirectory() as d:
+            pth = os.path.join(d, "ae.zip")
+            ModelSerializer.writeModel(net, pth, saveUpdater=False)
+            net2 = ModelSerializer.restoreMultiLayerNetwork(pth)
+        np.testing.assert_allclose(np.asarray(net2.output(X).numpy()),
+                                   want, atol=1e-6)
+
+
 def test_vae_bernoulli_distribution():
     import jax
     net = _net(dist="bernoulli")

@@ -78,6 +78,59 @@ class TinyMlp(nn.Module):
         return self.fc3(c)
 
 
+class MiniAttention(nn.Module):
+    def __init__(self, d, h):
+        super().__init__()
+        self.h, self.dh = h, d // h
+        self.q = nn.Linear(d, d)
+        self.k = nn.Linear(d, d)
+        self.v = nn.Linear(d, d)
+        self.o = nn.Linear(d, d)
+
+    def forward(self, x):
+        b, t, d = x.shape
+        def heads(m):
+            return m(x).reshape(b, t, self.h, self.dh).transpose(1, 2)
+        q, k, v = heads(self.q), heads(self.k), heads(self.v)
+        s = q @ k.transpose(-1, -2) / (self.dh ** 0.5)
+        y = (torch.softmax(s, dim=-1) @ v).transpose(1, 2) \
+            .reshape(b, t, d)
+        return self.o(y)
+
+
+class MiniBlock(nn.Module):
+    def __init__(self, d, h, ff):
+        super().__init__()
+        self.attn = MiniAttention(d, h)
+        self.ln1 = nn.LayerNorm(d)
+        self.fc1 = nn.Linear(d, ff)
+        self.fc2 = nn.Linear(ff, d)
+        self.ln2 = nn.LayerNorm(d)
+
+    def forward(self, x):
+        x = self.ln1(x + self.attn(x))
+        return self.ln2(x + self.fc2(torch.relu(self.fc1(x))))
+
+
+class TinyBert(nn.Module):
+    """Embedding + learned positions + 2 transformer encoder blocks +
+    mean-pool + classifier — the BERT op vocabulary at mini scale
+    (VERDICT r4 ask 9: a real-architecture ONNX golden)."""
+
+    def __init__(self, vocab=100, t=12, d=16, h=4, ff=32, classes=3):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, d)
+        self.pos = nn.Parameter(torch.randn(1, t, d) * 0.02)
+        self.blocks = nn.ModuleList([MiniBlock(d, h, ff) for _ in range(2)])
+        self.head = nn.Linear(d, classes)
+
+    def forward(self, ids):
+        x = self.emb(ids) + self.pos
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(x.mean(dim=1))
+
+
 def export(model, x, stem):
     model.eval()
     with torch.no_grad():
@@ -94,3 +147,4 @@ if __name__ == "__main__":
     torch.manual_seed(1234)
     export(TinyCnn(), torch.randn(2, 3, 16, 16), "torch_tiny_cnn")
     export(TinyMlp(), torch.randn(4, 12), "torch_tiny_mlp")
+    export(TinyBert(), torch.randint(0, 100, (2, 12)), "torch_bert_mini")
