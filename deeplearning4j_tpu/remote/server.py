@@ -4,9 +4,10 @@ Reference: deeplearning4j-remote ``JsonModelServer`` (serve an MLN/CG/
 SameDiff model on a port; POST JSON features → JSON predictions) and the
 ``JsonRemoteInference`` client (SURVEY.md §3.5).
 
-Serving goes through :class:`~deeplearning4j_tpu.parallel.inference.
-ParallelInference`-style batching only if the caller wraps the model; this
-server itself is intentionally thin — stdlib HTTP, one POST endpoint.
+``parallelInference=True`` serves through
+:class:`~deeplearning4j_tpu.parallel.inference.ParallelInference`: the
+threaded HTTP server's concurrent requests coalesce into batched device
+calls (the reference serves through ParallelInference the same way).
 """
 from __future__ import annotations
 
@@ -19,16 +20,38 @@ import numpy as np
 
 
 class JsonModelServer:
-    """POST /v1/serving -> {"output": [...]} (reference endpoint shape)."""
+    """POST /v1/serving -> {"output": [...]} (reference endpoint shape).
 
-    def __init__(self, model, port: int = 0, outputNames=None):
+    ``parallelInference=True`` serves through
+    :class:`~deeplearning4j_tpu.parallel.inference.ParallelInference`
+    (the reference's serving path, SURVEY.md §3.5): concurrent HTTP
+    requests coalesce into batched device calls up to ``batchLimit``."""
+
+    def __init__(self, model, port: int = 0, outputNames=None,
+                 parallelInference: bool = False, batchLimit: int = 32):
         self.model = model
         self.port = port
         # restrict ComputationGraph responses to these named outputs
         self.outputNames = list(outputNames) if outputNames else None
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._parallelInference = bool(parallelInference)
+        self._batchLimit = int(batchLimit)
+        self._pi = None
+        if parallelInference:
+            # validate eagerly (construction-time error), build lazily in
+            # start() so a failed construction leaves no worker thread
+            conf = getattr(model, "conf", None)
+            n_outs = len(getattr(conf, "outputs", None) or [1])
+            if n_outs > 1:
+                raise ValueError(
+                    "parallelInference serving supports single-output "
+                    "models (batch splitting of multi-output graphs is "
+                    "ambiguous)")
 
     def _run(self, x: np.ndarray) -> dict:
+        if self._pi is not None:
+            return {"output": np.asarray(
+                self._pi.output(x).numpy()).tolist()}
         out = self.model.output(x)
         if isinstance(out, list):
             names = list(getattr(self.model.conf, "outputs", None) or
@@ -45,6 +68,12 @@ class JsonModelServer:
         return {"output": np.asarray(out).tolist()}
 
     def start(self) -> "JsonModelServer":
+        if self._parallelInference and self._pi is None:
+            # (re)built per start so stop()/start() cycles serve again
+            from deeplearning4j_tpu.parallel.inference import \
+                ParallelInference
+            self._pi = ParallelInference.Builder(self.model) \
+                .batchLimit(self._batchLimit).build()
         # fail fast on static misconfiguration — a bad outputNames list is
         # not a per-request 500, it's a server-construction error
         if self.outputNames is not None:
@@ -93,6 +122,9 @@ class JsonModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._pi is not None:
+            self._pi.shutdown()
+            self._pi = None      # rebuilt on the next start()
 
 
 SameDiffJsonModelServer = JsonModelServer

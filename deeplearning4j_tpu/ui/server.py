@@ -160,14 +160,52 @@ class UIServer:
                         _json_safe(st.getUpdates(sid) if st else []),
                         allow_nan=False), "application/json")
                     return
-                # overview page
-                parts = ["<html><head><title>DL4J-TPU Training UI</title>"
-                         "</head><body><h2>Training overview</h2>"]
                 def _num(v, default=float("nan")):
                     try:
                         return float(v)
                     except (TypeError, ValueError):
                         return default
+
+                if self.path == "/train/system":
+                    # system/hardware tab (reference: the UI's System tab)
+                    parts = ["<html><head><title>System</title></head>"
+                             "<body><h2>System / hardware</h2>"]
+                    for sid, st in sessions.items():
+                        ups = st.getUpdates(sid)
+                        mems = [u.get("memory") for u in ups
+                                if isinstance(u.get("memory"), dict)]
+                        if not mems:
+                            continue
+                        last = mems[-1]
+                        parts.append(
+                            f"<h3>{html.escape(str(sid))}</h3>"
+                            f"<p>{html.escape(str(last.get('deviceCount', '?')))}x "
+                            f"{html.escape(str(last.get('platform', '?')))}; "
+                            f"device {_num(last.get('deviceBytesInUse', 0), 0) / 1e9:.2f}"
+                            f"/{_num(last.get('deviceBytesLimit', 0), 0) / 1e9:.2f} GB; "
+                            f"host rss {_num(last.get('hostRssBytes', 0), 0) / 1e9:.2f} GB"
+                            "</p>")
+                        dev = [m for m in (_num(u.get("deviceBytesInUse"))
+                                           for u in mems)
+                               if not math.isnan(m)]
+                        if dev:
+                            parts.append("<h4>device memory over time</h4>"
+                                         + _svg_score_chart(dev))
+                        rss = [m for m in (_num(u.get("hostRssBytes"))
+                                           for u in mems)
+                               if not math.isnan(m)]
+                        if rss:
+                            parts.append("<h4>host RSS over time</h4>"
+                                         + _svg_score_chart(rss))
+                    parts.append("</body></html>")
+                    self._send("".join(parts))
+                    return
+
+                # overview page
+                parts = ["<html><head><title>DL4J-TPU Training UI</title>"
+                         "</head><body><h2>Training overview</h2>"
+                         "<p><a href=\"/train/system\">system/hardware "
+                         "tab</a></p>"]
 
                 for sid, st in sessions.items():
                     ups = st.getUpdates(sid)
