@@ -5,6 +5,7 @@ Reference: nd4j samediff-import (Kotlin rule-based framework; legacy facade
 (``KerasModelImport``) — SURVEY.md §2.3, §2.5.
 """
 from deeplearning4j_tpu.imports.tf_import import TFGraphMapper  # noqa: F401
+from deeplearning4j_tpu.imports.graphrunner import GraphRunner  # noqa: F401
 from deeplearning4j_tpu.imports.keras_import import KerasModelImport  # noqa: F401
 from deeplearning4j_tpu.imports.onnx_import import (  # noqa: F401
     OnnxImporter, importOnnxModel)

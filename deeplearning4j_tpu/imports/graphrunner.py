@@ -1,0 +1,83 @@
+"""GraphRunner — execute a frozen TF graph in-process.
+
+Reference: ``nd4j-tensorflow`` ``org/nd4j/tensorflow/conversion/graphrunner/
+GraphRunner.java`` (SURVEY.md §2.3): run a TensorFlow GraphDef natively for
+hybrid pipelines (the reference goes through libtensorflow's C API; here the
+installed tensorflow package executes the graph — this framework's arrays in,
+this framework's arrays out).
+
+Two modes:
+
+- ``GraphRunner(path_or_graphdef)`` — TF executes the frozen graph (the
+  reference's semantics: a TF runtime embedded in the pipeline).
+- ``GraphRunner(..., backend="samediff")`` — the graph is IMPORTED through
+  :class:`TFGraphMapper` and executed by this framework on the TPU; useful
+  to migrate a hybrid pipeline off the TF runtime without touching callers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["GraphRunner"]
+
+
+class GraphRunner:
+    def __init__(self, graph, inputNames: Optional[Sequence[str]] = None,
+                 outputNames: Optional[Sequence[str]] = None,
+                 backend: str = "tensorflow"):
+        from deeplearning4j_tpu.imports.tf_import import _as_graphdef
+        self._gd = _as_graphdef(graph)
+        self.backend = backend
+        self.inputNames = list(inputNames) if inputNames else \
+            [n.name for n in self._gd.node if n.op == "Placeholder"]
+        self.outputNames = list(outputNames) if outputNames else \
+            [[n.name for n in self._gd.node][-1]]
+        if backend == "samediff":
+            from deeplearning4j_tpu.imports.tf_import import TFGraphMapper
+            self._sd = TFGraphMapper.importGraph(self._gd)
+            self._fn = None
+        elif backend == "tensorflow":
+            import tensorflow as tf
+            gd = self._gd
+
+            def _imported():
+                tf.graph_util.import_graph_def(gd, name="")
+
+            wrapped = tf.compat.v1.wrap_function(_imported, [])
+            g = wrapped.graph
+            ins = [g.get_tensor_by_name(f"{n}:0") for n in self.inputNames]
+            outs = [g.get_tensor_by_name(f"{n}:0")
+                    for n in self.outputNames]
+            self._fn = wrapped.prune(ins, outs)
+            self._sd = None
+        else:
+            raise ValueError(f"unknown GraphRunner backend {backend!r}")
+
+    def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Feed {input name: array}, get {output name: array}."""
+        feeds = [np.asarray(inputs[n]) for n in self.inputNames]
+        if self._sd is not None:
+            res = self._sd.output(dict(zip(self.inputNames, feeds)),
+                                  *self.outputNames)
+            return {n: np.asarray(res[n].numpy()) for n in self.outputNames}
+        import tensorflow as tf
+        outs = self._fn(*[tf.constant(f) for f in feeds])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return {n: np.asarray(o) for n, o in zip(self.outputNames, outs)}
+
+    # reference naming
+    def runTensorflowGraph(self, inputs):
+        return self.run(inputs)
+
+    def getInputNames(self) -> List[str]:
+        return list(self.inputNames)
+
+    def getOutputNames(self) -> List[str]:
+        return list(self.outputNames)
+
+    def close(self) -> None:
+        self._fn = None
+        self._sd = None

@@ -951,3 +951,33 @@ class TestOnnxImportBreadth:
         got = sd.output({"a": a, "b": b, "c": c}, outs[0])[outs[0]].numpy()
         want = np.tril((np.maximum(np.maximum(a, b), c) + a) / 2)
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestGraphRunner:
+    """nd4j-tensorflow GraphRunner parity (SURVEY.md §2.3): run a frozen
+    TF graph standalone — TF backend executes natively; the samediff
+    backend executes the IMPORTED graph on this framework."""
+
+    def _frozen(self):
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+        w = tf.constant(np.random.RandomState(0).randn(4, 3)
+                        .astype(np.float32))
+        fn = tf.function(lambda x: tf.nn.softmax(tf.matmul(x, w)))
+        cf = fn.get_concrete_function(tf.TensorSpec([None, 4], tf.float32))
+        return convert_variables_to_constants_v2(cf).graph.as_graph_def()
+
+    def test_backends_agree(self):
+        from deeplearning4j_tpu.imports import GraphRunner
+        gd = self._frozen()
+        x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+        ph = [n.name for n in gd.node if n.op == "Placeholder"]
+        out = [n.name for n in gd.node if n.op == "Identity"][-1:]
+        tf_r = GraphRunner(gd, ph, out)                      # TF executes
+        sd_r = GraphRunner(gd, ph, out, backend="samediff")  # we execute
+        a = tf_r.run({ph[0]: x})[out[0]]
+        b = sd_r.run({ph[0]: x})[out[0]]
+        np.testing.assert_allclose(b, a, atol=1e-5)
+        assert tf_r.getInputNames() == ph
+        tf_r.close()
+        sd_r.close()
