@@ -167,6 +167,20 @@ def _inbound_edges(layers_cfg: List[Dict]) -> Dict[str, List[str]]:
                 for entry in node:
                     if entry and isinstance(entry, (list, tuple)):
                         srcs.append(entry[0])
+                        # keras2 records extra call-arg tensors (e.g. the
+                        # MultiHeadAttention value/key) in the call-kwargs
+                        # slot as ["layer", node_idx, tensor_idx]
+                        if len(entry) > 3 and isinstance(entry[3], dict):
+                            def walk2(kw):
+                                if isinstance(kw, (list, tuple)):
+                                    if len(kw) >= 3 and \
+                                            isinstance(kw[0], str):
+                                        srcs.append(kw[0])
+                                    else:   # e.g. initial_state=[h, c]
+                                        for sub in kw:
+                                            walk2(sub)
+                            for kw in entry[3].values():
+                                walk2(kw)
         inbound[name] = srcs
     return inbound
 
@@ -186,6 +200,10 @@ def _linearize_functional(layers_cfg: List[Dict]) -> Optional[List[Dict]]:
     while cur is not None:
         order.append(by_name[cur])
         cur = succ.get(cur)
+    if len(order) != len(by_name):
+        # fan-out with no merge (multi-head outputs): succ kept only one
+        # consumer per source — not a chain; import as a graph instead
+        return None
     return order
 
 

@@ -94,6 +94,41 @@ class TestKerasBreadth:
         np.testing.assert_allclose(
             wq, model.get_layer("mha").get_weights()[0], atol=1e-6)
 
+    def test_inbound_edges_keras2_call_kwargs(self):
+        """Keras 2 (tf_keras — active whenever transformers loads first,
+        as in the full suite) records MHA's value/key tensors in the
+        call-kwargs slot; the edge parser must surface them so the
+        cross-attention refusal still fires."""
+        from deeplearning4j_tpu.imports.keras_import import _inbound_edges
+        layers = [
+            {"class_name": "InputLayer", "config": {"name": "in"},
+             "inbound_nodes": []},
+            {"class_name": "Dense", "config": {"name": "d"},
+             "inbound_nodes": [[["in", 0, 0, {}]]]},
+            {"class_name": "MultiHeadAttention", "config": {"name": "mha"},
+             "inbound_nodes": [[["in", 0, 0, {"value": ["d", 0, 0]}]]]},
+        ]
+        assert _inbound_edges(layers)["mha"] == ["in", "d"]
+
+    def test_multi_head_output_model_imports_as_graph(self):
+        """Review r5: a fan-out model with two heads and NO merge layer
+        must NOT linearize (the old chain walk silently dropped one
+        head) — it imports as a ComputationGraph with both outputs."""
+        inp = tf.keras.Input(shape=(6,))
+        a = tf.keras.layers.Dense(3, name="head_a")(inp)
+        b = tf.keras.layers.Dense(2, name="head_b")(inp)
+        model = tf.keras.Model(inp, [a, b])
+        net = _import(model)
+        from deeplearning4j_tpu.models.graph import ComputationGraph
+        assert isinstance(net, ComputationGraph)
+        x = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+        outs = net.output(x)
+        ka, kb = model.predict(x, verbose=0)
+        np.testing.assert_allclose(np.asarray(outs[0].numpy()), ka,
+                                   atol=1e-4, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(outs[1].numpy()), kb,
+                                   atol=1e-4, rtol=1e-3)
+
     def test_mha_cross_attention_refuses(self):
         inp = tf.keras.Input(shape=(6, 8))
         other = tf.keras.layers.Dense(8)(inp)
