@@ -291,12 +291,14 @@ def main() -> None:
     images_per_sec = batch * steps / dt
     mfu = images_per_sec * _TRAIN_FLOPS_PER_IMAGE / _V5E_PEAK_FLOPS
 
+    bert_err = None
     try:
         bert_tps, bert_mfu = bench_bert()
         bert_tps = round(bert_tps, 1)
         bert_mfu = round(bert_mfu, 4) if bert_mfu is not None else None
-    except Exception:
+    except Exception as e:
         bert_tps = bert_mfu = None
+        bert_err = f"{type(e).__name__}: {e}"
 
     attn = {}
     for t_attn in (1024, 4096):
@@ -304,12 +306,14 @@ def main() -> None:
             times = bench_attention(t_attn)
             attn[f"attn_flash_vs_dense_speedup_t{t_attn}"] = round(
                 times["dense"] / times["flash"], 3)
-        except Exception:
+        except Exception as e:      # surface WHY, not a bare null
             attn[f"attn_flash_vs_dense_speedup_t{t_attn}"] = None
+            attn[f"attn_bench_error_t{t_attn}"] = f"{type(e).__name__}: {e}"
     try:
         attn["longctx_tokens_per_sec"] = round(bench_long_context(), 1)
-    except Exception:
+    except Exception as e:
         attn["longctx_tokens_per_sec"] = None
+        attn["longctx_bench_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -327,6 +331,7 @@ def main() -> None:
         "ondevice_pipeline_images_per_sec": round(ondev_ips, 1),
         "bert_tokens_per_sec": bert_tps,
         "bert_mfu": bert_mfu,
+        **({"bert_bench_error": bert_err} if bert_err else {}),
         # >2 means one window hit transient relay contention; the best
         # window is the reported number (PROFILE_r04.md measurement note)
         "timing_spread": round(timing_spread, 3),
