@@ -377,9 +377,10 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
                                                           Bidirectional,
                                                           LastTimeStep)
         inner_cfg = cfg.get("layer", {})
-        if inner_cfg.get("class_name") != "LSTM":
-            raise ValueError("Keras import: Bidirectional supports LSTM "
-                             "wrapped layers only")
+        inner_cls = inner_cfg.get("class_name")
+        if inner_cls not in ("LSTM", "GRU", "SimpleRNN"):
+            raise ValueError("Keras import: Bidirectional supports "
+                             "LSTM/GRU/SimpleRNN wrapped layers only")
         icfg = inner_cfg.get("config", {})
         merge = cfg.get("merge_mode", "concat")
         mode = {"concat": "CONCAT", "sum": "ADD", "ave": "AVERAGE",
@@ -387,13 +388,24 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         if mode is None:
             raise ValueError(f"Bidirectional merge_mode {merge!r} "
                              "unsupported")
-        lstm = LSTM(nOut=int(icfg["units"]),
-                    activation=_act(icfg.get("activation", "tanh")))
+        if inner_cls == "LSTM":
+            inner = LSTM(nOut=int(icfg["units"]),
+                         activation=_act(icfg.get("activation", "tanh")))
+        elif inner_cls == "GRU":
+            from deeplearning4j_tpu.nn.conf.recurrent import GRU as OurGRU
+            inner = OurGRU(nOut=int(icfg["units"]),
+                           activation=_act(icfg.get("activation", "tanh")),
+                           resetAfter=bool(icfg.get("reset_after", True)))
+        else:
+            from deeplearning4j_tpu.nn.conf.recurrent import SimpleRnn
+            inner = SimpleRnn(nOut=int(icfg["units"]),
+                              activation=_act(icfg.get("activation",
+                                                       "tanh")))
         # keras return_sequences=False merges fwd[T-1] with the BACKWARD
         # scan's own last output (original position 0) — Bidirectional
         # implements exactly that via returnSequences=False
         rs = bool(icfg.get("return_sequences", False))
-        return (Bidirectional(mode, lstm, returnSequences=rs),
+        return (Bidirectional(mode, inner, returnSequences=rs),
                 "bilstm", None)
     if cls == "LSTM":
         from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
@@ -665,6 +677,19 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
                 and cur_ff:
             # a 1-D integer Input: its size IS the sequence length
             lay.inputLength = int(cur_ff)
+        if kind == "dense" and cur_rnn:
+            # keras Dense on (b, t, f) applies per step.  A FINAL softmax
+            # Dense becomes RnnOutputLayer (per-step softmax + loss, so
+            # fit() still works); any other Dense wraps in TimeDistributed
+            # so the output STAYS a sequence — same rules as the graph path
+            from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+            from deeplearning4j_tpu.nn.conf.recurrent import (
+                RnnOutputLayer, TimeDistributed)
+            if isinstance(lay, OutputLayer):
+                lay = RnnOutputLayer(lossFunction="mcxent", nOut=lay.nOut,
+                                     activation="softmax")
+            else:
+                lay, kind = TimeDistributed(lay), "tddense"
         our_layers.append((lay, kname if _is_weighty(kind) else None, kind))
         # track whether the CURRENT feature map is recurrent-shaped: a
         # last-step RNN, dense or global-pool head reduces to FF (the
@@ -765,6 +790,51 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
     return net
 
 
+def _lstm_weights_into(sub, kern, rec, bias):
+    """Keras LSTM gate order (i, f, g, o) -> ours (i, f, o, g)."""
+    import jax.numpy as jnp
+    u = rec.shape[0]
+
+    def reorder(m):
+        i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
+                          m[..., 2*u:3*u], m[..., 3*u:4*u])
+        return np.concatenate([i_, f_, o_, g_], axis=-1)
+    sub["W"] = jnp.asarray(reorder(kern))
+    sub["RW"] = jnp.asarray(reorder(rec))
+    if bias is not None:
+        sub["b"] = jnp.asarray(reorder(bias))
+
+
+def _gru_weights_into(sub, kern, rec, bias):
+    """Keras GRU gate order (z, r, h) -> ours (r, u=z, c=h)."""
+    import jax.numpy as jnp
+    u = rec.shape[0]
+
+    def reorder(m):
+        z_, r_, h_ = (m[..., 0*u:1*u], m[..., 1*u:2*u], m[..., 2*u:3*u])
+        return np.concatenate([r_, z_, h_], axis=-1)
+    sub["W"] = jnp.asarray(reorder(kern))
+    sub["RW"] = jnp.asarray(reorder(rec))
+    if bias is not None:
+        if bias.ndim == 2:   # reset_after: (2, 3u) input/recurrent biases
+            sub["b"] = jnp.asarray(reorder(bias[0]))
+            sub["b2"] = jnp.asarray(reorder(bias[1]))
+        else:
+            sub["b"] = jnp.asarray(reorder(bias))
+
+
+def _simplernn_weights_into(sub, kern, rec, bias):
+    import jax.numpy as jnp
+    sub["W"] = jnp.asarray(kern)
+    sub["RW"] = jnp.asarray(rec)
+    if bias is not None:
+        sub["b"] = jnp.asarray(bias)
+
+
+_RNN_LOADERS = {"LSTM": _lstm_weights_into, "GRU": _gru_weights_into,
+                "SimpleRNN": _simplernn_weights_into}
+
+
 def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
     """Write one Keras layer's weight list into this framework's param/state
     dicts (mutated in place), re-laid-out per the module docstring.  Shared
@@ -815,31 +885,14 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
         s["mean"] = jnp.asarray(ws[idx])
         s["var"] = jnp.asarray(ws[idx + 1])
     elif kind == "lstm":
-        kern, rec, bias = ws[0], ws[1], (ws[2] if len(ws) > 2 else None)
-        u = rec.shape[0]
-        def reorder(m):
-            i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
-                              m[..., 2*u:3*u], m[..., 3*u:4*u])
-            return np.concatenate([i_, f_, o_, g_], axis=-1)
-        p["W"] = jnp.asarray(reorder(kern))
-        p["RW"] = jnp.asarray(reorder(rec))
-        if bias is not None:
-            p["b"] = jnp.asarray(reorder(bias))
+        _lstm_weights_into(p, ws[0], ws[1], ws[2] if len(ws) > 2 else None)
     elif kind == "bilstm":
-        # keras weight order: forward [kern, rec, bias], backward [...]
-        def lstm_into(sub, kern, rec, bias):
-            u = rec.shape[0]
-            def reorder(m):
-                i_, f_, g_, o_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
-                                  m[..., 2*u:3*u], m[..., 3*u:4*u])
-                return np.concatenate([i_, f_, o_, g_], axis=-1)
-            sub["W"] = jnp.asarray(reorder(kern))
-            sub["RW"] = jnp.asarray(reorder(rec))
-            if bias is not None:
-                sub["b"] = jnp.asarray(reorder(bias))
+        # keras weight order: forward [kern, rec, (bias)], backward [...]
+        inner_cls = (kcfg.get("layer") or {}).get("class_name", "LSTM")
+        into = _RNN_LOADERS[inner_cls]
         half = len(ws) // 2
-        lstm_into(p["fwd"], *(list(ws[:half]) + [None] * (3 - half)))
-        lstm_into(p["bwd"], *(list(ws[half:]) + [None] * (3 - half)))
+        into(p["fwd"], *(list(ws[:half]) + [None] * (3 - half)))
+        into(p["bwd"], *(list(ws[half:]) + [None] * (3 - half)))
     elif kind == "embedding":
         p["W"] = jnp.asarray(ws[0])
     elif kind in ("sepconv", "dwconv"):
@@ -861,10 +914,8 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
         if len(ws) > 1 and "b" in p:
             p["b"] = jnp.asarray(ws[1])
     elif kind == "simplernn":
-        p["W"] = jnp.asarray(ws[0])
-        p["RW"] = jnp.asarray(ws[1])
-        if len(ws) > 2:
-            p["b"] = jnp.asarray(ws[2])
+        _simplernn_weights_into(p, ws[0], ws[1],
+                                ws[2] if len(ws) > 2 else None)
     elif kind == "ln":
         idx = 0
         if kcfg.get("scale", True):
@@ -887,21 +938,7 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
         if len(ws) > 1 and "b" in p:
             p["b"] = jnp.asarray(ws[1])
     elif kind == "gru":
-        # Keras gate order (z, r, h) -> ours (r, u=z, c=h)
-        u = ws[1].shape[0]
-        def gru_reorder(m):
-            z_, r_, h_ = (m[..., 0*u:1*u], m[..., 1*u:2*u],
-                          m[..., 2*u:3*u])
-            return np.concatenate([r_, z_, h_], axis=-1)
-        p["W"] = jnp.asarray(gru_reorder(ws[0]))
-        p["RW"] = jnp.asarray(gru_reorder(ws[1]))
-        if len(ws) > 2:
-            bias = ws[2]
-            if bias.ndim == 2:   # reset_after: (2, 3u) in/rec biases
-                p["b"] = jnp.asarray(gru_reorder(bias[0]))
-                p["b2"] = jnp.asarray(gru_reorder(bias[1]))
-            else:
-                p["b"] = jnp.asarray(gru_reorder(bias))
+        _gru_weights_into(p, ws[0], ws[1], ws[2] if len(ws) > 2 else None)
 
 
 #: Keras merge-layer class -> graph vertex construction
@@ -1066,12 +1103,19 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                     "map is unsupported (flatten-order mismatch would "
                     "silently mis-order features)")
         if kind == "dense" and srcs[0] in rnn:
-            # keras Dense on (b, t, f) applies per step; wrapping in
-            # TimeDistributed keeps the RNN format through the vertex (a
-            # bare Dense would round-trip (b*t, f) preprocessors and break
-            # downstream merges)
-            from deeplearning4j_tpu.nn.conf.recurrent import TimeDistributed
-            lay, kind = TimeDistributed(lay), "tddense"
+            # keras Dense on (b, t, f) applies per step; a FINAL softmax
+            # Dense becomes RnnOutputLayer (keeps a loss layer for fit);
+            # others wrap in TimeDistributed so the RNN format survives
+            # the vertex (a bare Dense would round-trip (b*t, f)
+            # preprocessors and break downstream merges)
+            from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+            from deeplearning4j_tpu.nn.conf.recurrent import (
+                RnnOutputLayer, TimeDistributed)
+            if isinstance(lay, OutputLayer):
+                lay = RnnOutputLayer(lossFunction="mcxent", nOut=lay.nOut,
+                                     activation="softmax")
+            else:
+                lay, kind = TimeDistributed(lay), "tddense"
         gb.addLayer(name, lay, *srcs)
         if _is_weighty(kind):
             weighty.append((name, kind))

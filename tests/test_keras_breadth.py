@@ -195,6 +195,43 @@ class TestKerasBreadth:
         x = np.random.RandomState(7).randn(5, 7).astype(np.float32)
         _parity(model, x)
 
+    @pytest.mark.parametrize("cell", ["GRU", "SimpleRNN"])
+    @pytest.mark.parametrize("rs", [True, False])
+    def test_bidirectional_gru_simplernn(self, cell, rs):
+        """Round 5+: Bidirectional over GRU/SimpleRNN inners, both
+        return modes, keras-oracle parity."""
+        inner = (tf.keras.layers.GRU if cell == "GRU"
+                 else tf.keras.layers.SimpleRNN)(6, return_sequences=rs)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(7, 5)),
+            tf.keras.layers.Bidirectional(inner),
+            tf.keras.layers.Dense(3)])
+        x = np.random.RandomState(14).randn(4, 7, 5).astype(np.float32)
+        _parity(model, x, atol=2e-3)
+
+    def test_sequence_labeling_head_fits(self):
+        """Review r5: a final per-step softmax Dense maps to
+        RnnOutputLayer — sequence-shaped outputs AND a loss layer, so
+        the imported model still fit()s."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(7, 5)),
+            tf.keras.layers.LSTM(6, return_sequences=True),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(15).randn(4, 7, 5).astype(np.float32)
+        net = _parity(model, x)
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.learning import Adam
+        rng = np.random.RandomState(16)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (4, 7))] \
+            .transpose(0, 2, 1).copy()           # (b, C, t)
+        net.conf.globalConf["updater"] = Adam(1e-2)
+        ds = DataSet(np.transpose(x, (0, 2, 1)).copy(), y)
+        net.fit(ds)
+        s0 = net.score(ds)
+        for _ in range(10):
+            net.fit(ds)
+        assert net.score(ds) < s0
+
     @pytest.mark.parametrize("merge", ["concat", "sum"])
     def test_bidirectional_last_step(self, merge):
         """keras return_sequences=False semantics: fwd last step merged
