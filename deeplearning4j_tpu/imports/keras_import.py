@@ -578,6 +578,15 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
             convolutionMode="Same" if same else "Truncate",
             poolingType="MAX" if cls == "MaxPooling3D" else "AVG")
         return lay, "pool3d", None
+    if cls == "Cropping1D":
+        from deeplearning4j_tpu.nn.conf.misc import Cropping1D
+        # the layer's __post_init__ normalizes int/tuple forms
+        return (Cropping1D(cropping=cfg.get("cropping", (1, 1))),
+                "crop1d", None)
+    if cls == "ZeroPadding1D":
+        from deeplearning4j_tpu.nn.conf.misc import ZeroPadding1DLayer
+        return (ZeroPadding1DLayer(padding=cfg.get("padding", 1)),
+                "pad1d", None)
     if cls == "TimeDistributed":
         from deeplearning4j_tpu.nn.conf.recurrent import (
             TimeDistributed, TimeDistributedFlatten)
@@ -713,8 +722,8 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         elif kind in _CNN_KINDS and cur_conv_shape is not None:
             cur_conv_shape = _track_shape(
                 cur_conv_shape, lay, _out_channels(out_c, cur_conv_shape))
-        if kind in ("conv1d", "pool") and cur_seq is not None \
-                and cur_conv_shape is None:
+        if kind in ("conv1d", "pool", "crop1d", "pad1d") \
+                and cur_seq is not None and cur_conv_shape is None:
             out_t = lay.getOutputType(InputType.recurrent(*cur_seq))
             cur_seq = (out_t.size, out_t.timeSeriesLength) \
                 if out_t.kind == "RNN" else None

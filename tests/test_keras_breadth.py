@@ -244,6 +244,17 @@ class TestKerasBreadth:
         x = np.random.RandomState(8).randn(4, 7, 5).astype(np.float32)
         _parity(model, x, atol=1e-3)
 
+    def test_crop_pad_1d(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(12, 5)),
+            tf.keras.layers.ZeroPadding1D(2),
+            tf.keras.layers.Conv1D(6, 3, activation="relu"),
+            tf.keras.layers.Cropping1D((1, 2)),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(3)])
+        x = np.random.RandomState(17).randn(4, 12, 5).astype(np.float32)
+        _parity(model, x)
+
     def test_flatten_after_conv1d(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(12, 5)),
