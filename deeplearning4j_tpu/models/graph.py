@@ -482,7 +482,9 @@ class ComputationGraph:
         it.reset()
         while it.hasNext():
             ds = it.next()
-            out = self.outputSingle(ds.features)
+            out = self.output(ds.features, featuresMask=ds.featuresMask)
+            if isinstance(out, list):
+                out = out[0]
             ev.eval(ds.labels.numpy(), out.numpy(),
                     ds.labelsMask.numpy() if getattr(ds, "labelsMask", None)
                     is not None else None)

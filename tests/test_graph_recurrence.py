@@ -211,6 +211,27 @@ class TestGraphRnnTimeStep:
         with pytest.raises(ValueError, match="bidirectional"):
             net.rnnTimeStep(np.zeros((1, 3), np.float32))
 
+    def test_masked_evaluate_end_to_end(self):
+        """CG.evaluate must route the features mask into the forward
+        (review r5 follow-up: it previously evaluated padded steps)."""
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        net = _char_graph(t=6)
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((4, 5, 6)).astype(np.float32)
+        idx = rng.integers(0, 5, (4, 6))
+        y = np.zeros((4, 5, 6), np.float32)
+        for i in range(4):
+            y[i, idx[i], np.arange(6)] = 1.0
+        mask = np.ones((4, 6), np.float32)
+        mask[:, 4:] = 0.0
+        ds = DataSet(x, y, featuresMask=mask, labelsMask=mask)
+        ev = net.evaluate(ListDataSetIterator([ds], batch=4))
+        out = np.asarray(net.output(x, featuresMask=(mask,)).numpy())
+        pred = out.argmax(axis=1)[:, :4]
+        lab = y.argmax(axis=1)[:, :4]
+        assert ev.accuracy() == pytest.approx(
+            float((pred == lab).mean()))
+
     def test_cg_json_roundtrip_keeps_tbptt(self):
         from deeplearning4j_tpu.models.graph_conf import \
             ComputationGraphConfiguration
