@@ -125,23 +125,86 @@ class KerasModelImport:
             if isinstance(layers_cfg, dict):
                 layers_cfg = layers_cfg.get("layers", [])
             store = _WeightStore(f)
+            updater = _training_config_updater(f, enforceTrainingConfig)
             if cls in ("Functional", "Model"):
                 chain = _linearize_functional(layers_cfg)
                 if chain is None:   # branching -> ComputationGraph
                     full = model_cfg["config"] \
                         if isinstance(model_cfg["config"], dict) else {}
-                    return _build_graph(full, layers_cfg, store)
+                    net = _build_graph(full, layers_cfg, store)
+                    if updater is not None:
+                        net.conf.globalConf["updater"] = updater
+                        net._initOptState()   # rebuild for the new updater
+                    return net
                 layers_cfg = chain
             elif cls != "Sequential":
                 raise ValueError(f"Unsupported Keras model class: {cls}")
-            return _build_sequential(layers_cfg, store, InputType,
-                                     NeuralNetConfiguration,
-                                     MultiLayerNetwork)
+            net = _build_sequential(layers_cfg, store, InputType,
+                                    NeuralNetConfiguration,
+                                    MultiLayerNetwork)
+            if updater is not None:
+                net.conf.globalConf["updater"] = updater
+                net._initOptState()   # rebuild for the new updater
+            return net
 
     # parity name (reference: KerasModelImport.importKerasModelAndWeights):
     # linear Functional chains come back as MultiLayerNetwork, branching
     # topologies (merge/residual) as ComputationGraph — like the reference.
     importKerasModelAndWeights = importKerasSequentialModelAndWeights
+
+
+def _training_config_updater(f, enforce: bool):
+    """Map the h5's ``training_config`` (keras ``model.compile`` state) to
+    this framework's updater, so a fine-tune continues with the source
+    model's optimizer and learning rate.  Reference:
+    ``KerasModelImport.importKerasSequentialModelAndWeights(path,
+    enforceTrainingConfig)`` — enforce=True errors when the model was
+    never compiled."""
+    raw = f.attrs.get("training_config")
+    if raw is None:
+        if enforce:
+            raise ValueError(
+                "enforceTrainingConfig=True but the h5 carries no "
+                "training_config (model was saved uncompiled)")
+        return None
+    if isinstance(raw, bytes):
+        raw = raw.decode()
+    opt = (json.loads(raw).get("optimizer_config") or {})
+    ocls = opt.get("class_name", "")
+    ocfg = opt.get("config", {})
+    lr = ocfg.get("learning_rate", 1e-3)
+    if not isinstance(lr, (int, float)):    # LR schedules: use the base LR
+        lr = (lr.get("config", {}) or {}).get("initial_learning_rate", 1e-3)
+    from deeplearning4j_tpu import learning as L
+    if ocls in ("Adam", "AdamW"):
+        kw = dict(beta1=ocfg.get("beta_1", 0.9),
+                  beta2=ocfg.get("beta_2", 0.999),
+                  epsilon=ocfg.get("epsilon", 1e-8))
+        if ocls == "AdamW":
+            return L.AdamW(float(lr),
+                           weightDecay=float(ocfg.get("weight_decay")
+                                             or 0.0), **kw)
+        if ocfg.get("amsgrad"):
+            return L.AMSGrad(float(lr), **kw)
+        return L.Adam(float(lr), **kw)
+    if ocls == "Nadam":
+        return L.Nadam(float(lr), beta1=ocfg.get("beta_1", 0.9),
+                       beta2=ocfg.get("beta_2", 0.999))
+    if ocls == "SGD":
+        mom = float(ocfg.get("momentum", 0.0) or 0.0)
+        if mom:   # DL4J parity: all momentum SGD maps to Nesterovs
+            return L.Nesterovs(float(lr), momentum=mom)
+        return L.Sgd(float(lr))
+    if ocls == "RMSprop":
+        return L.RmsProp(float(lr), rmsDecay=ocfg.get("rho", 0.9))
+    if ocls == "Adagrad":
+        return L.AdaGrad(float(lr))
+    if ocls == "Adadelta":
+        return L.AdaDelta(rho=ocfg.get("rho", 0.95))
+    if enforce:
+        raise ValueError(f"Keras import: optimizer {ocls!r} has no "
+                         "updater mapping")
+    return None
 
 
 def _inbound_edges(layers_cfg: List[Dict]) -> Dict[str, List[str]]:

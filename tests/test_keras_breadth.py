@@ -244,6 +244,51 @@ class TestKerasBreadth:
         x = np.random.RandomState(8).randn(4, 7, 5).astype(np.float32)
         _parity(model, x, atol=1e-3)
 
+    def test_training_config_imports_optimizer(self):
+        """model.compile state maps to this framework's updater so a
+        fine-tune continues with the source optimizer/LR (reference:
+        enforceTrainingConfig on KerasModelImport)."""
+        from deeplearning4j_tpu.learning import Adam, Nesterovs
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4,)),
+            tf.keras.layers.Dense(2, activation="softmax")])
+        m.compile(optimizer=tf.keras.optimizers.Adam(learning_rate=3e-3),
+                  loss="categorical_crossentropy")
+        net = _import(m)
+        up = net.conf.globalConf["updater"]
+        assert isinstance(up, Adam)
+        assert up.learningRate == pytest.approx(3e-3)
+        # review r5: fit must work — the optimizer STATE is rebuilt for
+        # the imported updater (Adam needs m/v slots, not Sgd's empty {})
+        from deeplearning4j_tpu.datasets import DataSet
+        rng = np.random.RandomState(20)
+        xd = rng.randn(8, 4).astype(np.float32)
+        yd = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        net.fit(DataSet(xd, yd))
+        assert np.isfinite(net.score())
+
+        m2 = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4,)),
+            tf.keras.layers.Dense(2)])
+        m2.compile(optimizer=tf.keras.optimizers.SGD(
+            learning_rate=0.05, momentum=0.9, nesterov=True), loss="mse")
+        net2 = _import(m2)
+        up2 = net2.conf.globalConf["updater"]
+        assert isinstance(up2, Nesterovs)
+        assert up2.momentum == pytest.approx(0.9)
+
+        # uncompiled + enforce -> clear error; without enforce -> fine
+        m3 = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4,)),
+            tf.keras.layers.Dense(2)])
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            m3.save(p)
+            KerasModelImport.importKerasSequentialModelAndWeights(p)
+            with pytest.raises(ValueError, match="training_config"):
+                KerasModelImport.importKerasSequentialModelAndWeights(
+                    p, enforceTrainingConfig=True)
+
     def test_crop_pad_1d(self):
         model = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(12, 5)),
