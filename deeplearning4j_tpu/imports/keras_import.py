@@ -170,7 +170,8 @@ def _training_config_updater(f, enforce: bool):
     if isinstance(raw, bytes):
         raw = raw.decode()
     opt = (json.loads(raw).get("optimizer_config") or {})
-    ocls = opt.get("class_name", "")
+    # tf_keras (legacy keras 2) prefixes registered classes: "Custom>Adam"
+    ocls = opt.get("class_name", "").split(">")[-1]
     ocfg = opt.get("config", {})
     lr = ocfg.get("learning_rate", 1e-3)
     if not isinstance(lr, (int, float)):    # LR schedules: use the base LR
@@ -305,7 +306,7 @@ def _input_type(cfg: Dict, InputType):
 #: kinds that carry weights (their keras name is kept for the weight store)
 _WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding",
             "sepconv", "dwconv", "deconv", "simplernn", "gru", "ln", "mha",
-            "conv3d"}
+            "conv3d", "prelu", "deconv3d"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
               "dwconv", "deconv"}
@@ -314,6 +315,35 @@ _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
 def _is_weighty(kind: str) -> bool:
     return kind in _WEIGHTY or \
         (kind.startswith("td") and kind[2:] in _WEIGHTY)
+
+
+def _pad3_spec(p):
+    """keras 3D padding/cropping spec -> ((d0,d1),(h0,h1),(w0,w1))."""
+    if isinstance(p, int):
+        return ((p, p), (p, p), (p, p))
+    out = []
+    for v in p:
+        out.append((int(v), int(v)) if isinstance(v, int)
+                   else (int(v[0]), int(v[1])))
+    return tuple(out)
+
+
+def _fix_prelu_axes(lay, ctx: str) -> None:
+    """Convert keras PReLU ``shared_axes`` (1-based, channels-last
+    per-example layout) to this framework's channels-first layout."""
+    ka = getattr(lay, "_kerasSharedAxes", ())
+    if not ka:
+        lay.sharedAxes = ()
+        return
+    m = {"cnn": {1: 2, 2: 3, 3: 1},          # (h, w, c) -> (c, h, w)
+         "cnn3d": {1: 2, 2: 3, 3: 4, 4: 1},  # (d, h, w, c) -> (c, d, h, w)
+         "rnn": {1: 2, 2: 1},                # (t, f) -> (f, t)
+         "ff": {1: 1}}[ctx]
+    try:
+        lay.sharedAxes = tuple(sorted(m[a] for a in ka))
+    except KeyError:
+        raise ValueError(f"Keras import: PReLU shared_axes={ka} invalid "
+                         f"for a rank-{len(m)} input")
 
 
 def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
@@ -650,6 +680,74 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         from deeplearning4j_tpu.nn.conf.misc import ZeroPadding1DLayer
         return (ZeroPadding1DLayer(padding=cfg.get("padding", 1)),
                 "pad1d", None)
+    if cls == "Softmax":
+        axis = cfg.get("axis", -1)
+        ax_list = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+        if ax_list != [-1]:
+            raise ValueError(f"Keras import: Softmax axis={axis} "
+                             "unsupported (last axis only)")
+        # keras axis -1 is the feature/channel axis (channels-last); in
+        # this framework's channel-first layouts that is axis 1 for any
+        # rank>2 input — the builder paths patch the activation to
+        # "softmax:1" when the input is a sequence / feature map
+        return ActivationLayer(activation="softmax"), "softmaxfix", None
+    if cls == "ThresholdedReLU":
+        theta = float(cfg.get("theta", 1.0))
+        name = "thresholdedrelu" if theta == 1.0 \
+            else f"thresholdedrelu:{theta}"
+        return ActivationLayer(activation=name), "activation", None
+    if cls == "PReLU":
+        from deeplearning4j_tpu.nn.conf.convolutional3d import PReLULayer
+        sa = cfg.get("shared_axes") or ()
+        if isinstance(sa, int):
+            sa = (sa,)
+        lay = PReLULayer()
+        # keras-layout 1-based axes; converted to ours once the input
+        # rank is known (_fix_prelu_axes in the builder paths)
+        lay._kerasSharedAxes = tuple(int(a) for a in sa)
+        return lay, "prelu", None
+    if cls == "RepeatVector":
+        from deeplearning4j_tpu.nn.conf.misc import RepeatVector
+        return (RepeatVector(repetitionFactor=int(cfg["n"])),
+                "repeat", None)
+    if cls == "Masking":
+        from deeplearning4j_tpu.nn.conf.misc import MaskingLayer
+        return (MaskingLayer(maskValue=float(cfg.get("mask_value", 0.0))),
+                "masking", None)
+    if cls == "UpSampling1D":
+        from deeplearning4j_tpu.nn.conf.convolutional import Upsampling1D
+        return Upsampling1D(size=cfg.get("size", 2)), "upsample1d", None
+    if cls == "UpSampling3D":
+        from deeplearning4j_tpu.nn.conf.convolutional3d import Upsampling3D
+        sz = cfg.get("size", [2, 2, 2])
+        return (Upsampling3D(size=tuple(int(x) for x in sz)),
+                "upsample3d", None)
+    if cls == "ZeroPadding3D":
+        from deeplearning4j_tpu.nn.conf.convolutional3d import \
+            ZeroPadding3DLayer
+        p = _pad3_spec(cfg.get("padding", 1))
+        return (ZeroPadding3DLayer(padDepth=p[0], padHeight=p[1],
+                                   padWidth=p[2]), "pad3d", None)
+    if cls == "Cropping3D":
+        from deeplearning4j_tpu.nn.conf.convolutional3d import Cropping3D
+        p = _pad3_spec(cfg.get("cropping", 1))
+        return (Cropping3D(cropDepth=p[0], cropHeight=p[1], cropWidth=p[2]),
+                "crop3d", None)
+    if cls == "Conv3DTranspose":
+        from deeplearning4j_tpu.nn.conf.convolutional3d import Deconvolution3D
+        if cfg.get("data_format") == "channels_first":
+            raise ValueError("Keras import: channels_first Conv3DTranspose "
+                             "is not supported (save as channels_last)")
+        k = cfg.get("kernel_size", [2, 2, 2])
+        s = cfg.get("strides", [2, 2, 2])
+        same = cfg.get("padding", "valid") == "same"
+        lay = Deconvolution3D(
+            nOut=int(cfg["filters"]), kernelSize=tuple(int(x) for x in k),
+            stride=tuple(int(x) for x in s),
+            convolutionMode="Same" if same else "Truncate",
+            activation=_act(cfg.get("activation")),
+            hasBias=bool(cfg.get("use_bias", True)))
+        return lay, "deconv3d", int(cfg["filters"])
     if cls == "TimeDistributed":
         from deeplearning4j_tpu.nn.conf.recurrent import (
             TimeDistributed, TimeDistributedFlatten)
@@ -745,6 +843,14 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         if mapped is None:
             raise ValueError(f"Keras import: unsupported layer {cls}")
         lay, kind, out_c = mapped
+        if kind == "prelu":
+            _fix_prelu_axes(lay, "cnn" if cur_conv_shape is not None
+                            else "cnn3d" if cur_3d is not None
+                            else "rnn" if cur_rnn else "ff")
+        if kind == "softmaxfix":
+            if cur_conv_shape is not None or cur_3d is not None or cur_rnn:
+                lay.activation = "softmax:1"   # channel-first feature axis
+            kind = "activation"
         if kind == "embedding" and getattr(lay, "inputLength", 0) < 0 \
                 and cur_ff:
             # a 1-D integer Input: its size IS the sequence length
@@ -780,17 +886,21 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
                     InputType.recurrent(cur_seq[0] if cur_seq else 0, t))
                 cur_seq = (out_t.size, out_t.timeSeriesLength) \
                     if out_t.kind == "RNN" else None
+        elif kind == "repeat":
+            cur_rnn = True
+            cur_seq = (int(cur_ff), lay.repetitionFactor) if cur_ff else None
         if kind in ("dense", "globalpool"):
             cur_conv_shape = None
         elif kind in _CNN_KINDS and cur_conv_shape is not None:
             cur_conv_shape = _track_shape(
                 cur_conv_shape, lay, _out_channels(out_c, cur_conv_shape))
-        if kind in ("conv1d", "pool", "crop1d", "pad1d") \
+        if kind in ("conv1d", "pool", "crop1d", "pad1d", "upsample1d") \
                 and cur_seq is not None and cur_conv_shape is None:
             out_t = lay.getOutputType(InputType.recurrent(*cur_seq))
             cur_seq = (out_t.size, out_t.timeSeriesLength) \
                 if out_t.kind == "RNN" else None
-        if (kind in ("conv3d", "pool3d") or kind.startswith("td")) \
+        if (kind in ("conv3d", "pool3d", "pad3d", "crop3d", "deconv3d",
+                     "upsample3d") or kind.startswith("td")) \
                 and cur_3d is not None:
             out_t = lay.getOutputType(cur_3d)
             if out_t.kind == "CNN3D":
@@ -807,7 +917,8 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
             cur_seq = (out_t.size, out_t.timeSeriesLength)
         if kind == "dense":
             cur_ff = getattr(lay, "nOut", None)
-        elif kind not in ("noise", "activation", "dropout", "ln", "bn"):
+        elif kind not in ("noise", "activation", "dropout", "ln", "bn",
+                          "prelu", "masking"):
             cur_ff = None
         if kind == "reshape":
             cur_in = None
@@ -1011,12 +1122,26 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
             p["b"] = jnp.asarray(ws[1])
     elif kind == "gru":
         _gru_weights_into(p, ws[0], ws[1], ws[2] if len(ws) > 2 else None)
+    elif kind == "prelu":
+        a = ws[0]                         # keras channels-last alpha
+        if a.ndim == 4:                   # (d, h, w, c) -> (c, d, h, w)
+            a = a.transpose(3, 0, 1, 2)
+        elif a.ndim == 3:                 # (h, w, c) -> (c, h, w)
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 2:                 # (t, f) -> (f, t)
+            a = a.transpose(1, 0)
+        p["alpha"] = jnp.asarray(a)
+    elif kind == "deconv3d":
+        # keras (kd, kh, kw, out, in) -> ours (O, I, kd, kh, kw)
+        p["W"] = jnp.asarray(ws[0].transpose(3, 4, 0, 1, 2))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1])
 
 
 #: Keras merge-layer class -> graph vertex construction
 _MERGE_CLASSES = {"Add": "Add", "Subtract": "Subtract",
                   "Multiply": "Product", "Average": "Average",
-                  "Maximum": "Max", "Concatenate": None}
+                  "Maximum": "Max", "Minimum": "Min", "Concatenate": None}
 
 
 def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
@@ -1075,6 +1200,7 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
     alias: Dict[str, str] = {}          # skipped node -> effective source
     shapes: Dict[str, Optional[Tuple[int, int, int]]] = {}  # keras (h,w,c)
     rnn: set = set()                    # nodes with 3D (b, t, f) output
+    vol: set = set()                    # nodes with CNN3D (NCDHW) output
     flat_of: Dict[str, Tuple[int, int, int]] = {}  # node -> conv shape its
     # flattened output came from (propagated through layout-preserving nodes)
     weighty: List[Tuple[str, str]] = []  # (node name, kind)
@@ -1104,6 +1230,8 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                 shapes[name] = None
                 if it.kind == "RNN":
                     rnn.add(name)
+                elif it.kind == "CNN3D":
+                    vol.add(name)
             continue
         if cls == "Flatten":
             alias[name] = srcs[0]
@@ -1148,11 +1276,22 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                 shapes[name] = shapes.get(srcs[0])
             if any(s in rnn for s in srcs):
                 rnn.add(name)
+            if any(s in vol for s in srcs):
+                vol.add(name)
             continue
         mapped = _map_keras_layer(cls, cfg, is_last=(name in outputs))
         if mapped is None:
             raise ValueError(f"Keras import: unsupported layer {cls}")
         lay, kind, out_c = mapped
+        if kind == "prelu":
+            _fix_prelu_axes(lay, "cnn" if shapes.get(srcs[0]) is not None
+                            else "cnn3d" if srcs[0] in vol
+                            else "rnn" if srcs[0] in rnn else "ff")
+        if kind == "softmaxfix":
+            if shapes.get(srcs[0]) is not None or srcs[0] in rnn \
+                    or srcs[0] in vol:
+                lay.activation = "softmax:1"   # channel-first feature axis
+            kind = "activation"
         if kind == "mha":
             # keras calls MHA with (query, value[, key]); self-attention
             # repeats one source — the only form a single-input layer node
@@ -1198,7 +1337,7 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
             shapes[name] = None
             if cfg.get("return_sequences", False):
                 rnn.add(name)
-        elif kind in ("embedding", "mha"):
+        elif kind in ("embedding", "mha", "repeat"):
             shapes[name] = None
             rnn.add(name)                      # sequence output: (b,t,f)
         elif kind in ("dense", "globalpool"):
@@ -1206,10 +1345,16 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
         elif kind in _CNN_KINDS:
             cur = shapes.get(srcs[0])
             shapes[name] = _track_shape(cur, lay, _out_channels(out_c, cur))
+        elif kind in ("conv3d", "pool3d", "pad3d", "crop3d", "deconv3d",
+                      "upsample3d"):
+            shapes[name] = None
+            vol.add(name)
         else:                               # bn / ln / activation / dropout
             shapes[name] = shapes.get(srcs[0])
             if srcs[0] in rnn:
                 rnn.add(name)
+            if srcs[0] in vol:
+                vol.add(name)
 
     gb.setInputTypes(*input_types)
     gb.setOutputs(*[alias.get(o, o) for o in outputs])

@@ -136,6 +136,11 @@ class ComputationGraph:
                 x = xs[0]
                 if name in self.conf.preProcessors:
                     x = self.conf.preProcessors[name].preProcess(x, miniBatch)
+                if getattr(node, "producesMask", False):
+                    # e.g. MaskingLayer: derive the timestep mask from the
+                    # data; downstream vertices see the new mask
+                    m = node.computeMask(x, m)
+                    mmap[name] = m
                 lkey = jax.random.fold_in(key, idx) if key is not None else None
                 if getattr(node, "isRNN", False):
                     c0 = (carries or {}).get(name)

@@ -63,7 +63,7 @@ class MergeVertex(GraphVertex):
 
 @dataclasses.dataclass
 class ElementWiseVertex(GraphVertex):
-    op: str = "Add"  # Add | Subtract | Product | Average | Max
+    op: str = "Add"  # Add | Subtract | Product | Average | Max | Min
 
     def forward(self, *inputs):
         op = self.op.lower()
@@ -85,6 +85,11 @@ class ElementWiseVertex(GraphVertex):
             out = inputs[0]
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
+            return out
+        if op == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
             return out
         raise ValueError(f"Unknown ElementWiseVertex op {self.op}")
 

@@ -315,6 +315,10 @@ class MultiLayerNetwork:
             lkey = jax.random.fold_in(key, i) if key is not None else None
             st = state.get(str(i), {})
             p = params.get(str(i), {})
+            if getattr(layer, "producesMask", False):
+                # e.g. MaskingLayer: derives the timestep mask from the
+                # data; downstream mask-aware layers see the new mask
+                mask = layer.computeMask(x, mask)
             if getattr(layer, "isRNN", False):
                 c0 = (carries or {}).get(str(i))
                 if c0 is None:

@@ -97,10 +97,27 @@ class Activation:
     THRESHOLDEDRELU = "thresholdedrelu"
 
 
+#: activations that accept one parameter via the string form "name:value"
+#: (keeps parameterized activations JSON-serializable in the config DSL,
+#: like the reference's ActivationThresholdedReLU(theta) / LReLU(alpha))
+_PARAMETERIZED: Dict[str, Callable] = {
+    "thresholdedrelu": lambda th: (lambda x: jnp.where(x > th, x, 0.0)),
+    "leakyrelu": lambda a: (lambda x: jax.nn.leaky_relu(x, a)),
+    "elu": lambda a: (lambda x: jnp.where(x > 0, x, a * jnp.expm1(x))),
+    # "softmax:1" = softmax over the channel/feature axis of (b, f, t) /
+    # NCHW / NCDHW tensors (axis -1 would be time/width)
+    "softmax": lambda ax: (lambda x: jax.nn.softmax(x, axis=int(ax))),
+}
+
+
 def get_activation(name) -> Callable:
     if callable(name):
         return name
     key = str(name).lower().replace("_", "")
+    if ":" in key:
+        base, _, arg = key.partition(":")
+        if base in _PARAMETERIZED:
+            return _PARAMETERIZED[base](float(arg))
     try:
         return _REGISTRY[key]
     except KeyError:

@@ -58,6 +58,28 @@ class Upsampling2D(BaseLayer):
 
 
 @dataclasses.dataclass
+class Upsampling1D(BaseLayer):
+    """Repeat each timestep of a (b, f, t) sequence ``size`` times
+    (reference: Upsampling1D.java)."""
+    size: int = 2
+
+    def __post_init__(self):
+        if isinstance(self.size, (tuple, list)):
+            self.size = int(self.size[0])
+
+    def preferredFormat(self):
+        return "RNN"
+
+    def getOutputType(self, inputType):
+        t = inputType.timeSeriesLength
+        return InputType.recurrent(
+            inputType.size, t * self.size if t and t > 0 else -1)
+
+    def forward(self, params, x, train, key, state):
+        return jnp.repeat(x, self.size, axis=2), state
+
+
+@dataclasses.dataclass
 class ZeroPaddingLayer(BaseLayer):
     """Zero padding (reference: ZeroPaddingLayer.java) —
     padding = (top, bottom, left, right) or a (h, w) pair."""
@@ -545,7 +567,8 @@ class Yolo2OutputLayer(BaseLayer):
         return loss_pos + loss_conf + loss_cls
 
 
-for _c in [Upsampling2D, ZeroPaddingLayer, Cropping2D, Deconvolution2D,
+for _c in [Upsampling2D, Upsampling1D, ZeroPaddingLayer, Cropping2D,
+           Deconvolution2D,
            DepthwiseConvolution2D, SeparableConvolution2D, Convolution1DLayer,
            Subsampling1DLayer, SpaceToDepthLayer, CnnLossLayer,
            Yolo2OutputLayer]:

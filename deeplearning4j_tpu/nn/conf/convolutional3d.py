@@ -208,6 +208,32 @@ class Cropping3D(BaseLayer):
 
 
 @dataclasses.dataclass
+class ZeroPadding3DLayer(BaseLayer):
+    """Zero-pad NCDHW spatial dims (reference: ZeroPadding3DLayer.java)."""
+    padDepth: Tuple[int, int] = (0, 0)
+    padHeight: Tuple[int, int] = (0, 0)
+    padWidth: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        self.padDepth = tuple(self.padDepth)
+        self.padHeight = tuple(self.padHeight)
+        self.padWidth = tuple(self.padWidth)
+
+    def preferredFormat(self):
+        return "CNN3D"
+
+    def getOutputType(self, inputType):
+        return InputType.convolutional3D(
+            inputType.depth + sum(self.padDepth),
+            inputType.height + sum(self.padHeight),
+            inputType.width + sum(self.padWidth), inputType.channels)
+
+    def forward(self, params, x, train, key, state):
+        return jnp.pad(x, ((0, 0), (0, 0), self.padDepth, self.padHeight,
+                           self.padWidth)), state
+
+
+@dataclasses.dataclass
 class Deconvolution3D(BaseLayer):
     """Transposed 3D conv (reference: Deconvolution3D.java, deconv3d.cpp):
     flipped-kernel conv with ``lhs_dilation`` = stride."""
@@ -452,6 +478,7 @@ class LocallyConnected1D(_LocallyConnectedBase):
 
 
 for _c in [Convolution3D, Subsampling3DLayer, Upsampling3D, Cropping3D,
+           ZeroPadding3DLayer,
            Deconvolution3D, PReLULayer, LocallyConnected1D,
            LocallyConnected2D]:
     register_layer(_c)

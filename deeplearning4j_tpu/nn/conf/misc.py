@@ -23,7 +23,8 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
 from deeplearning4j_tpu.nn.weights import init_weight
 
-__all__ = ["MaskLayer", "RepeatVector", "ElementWiseMultiplicationLayer",
+__all__ = ["MaskLayer", "MaskingLayer", "RepeatVector",
+           "ElementWiseMultiplicationLayer",
            "Cropping1D", "ZeroPadding1DLayer", "OCNNOutputLayer",
            "LayerNormalization", "GaussianNoiseLayer",
            "GaussianDropoutLayer", "AlphaDropoutLayer", "ReshapeLayer",
@@ -43,6 +44,35 @@ class MaskLayer(BaseLayer):
         if mask is None:
             return x, state
         return x * mask[:, None, :].astype(x.dtype), state
+
+
+@dataclasses.dataclass
+class MaskingLayer(BaseLayer):
+    """Computes a timestep mask FROM the data: a step whose features all
+    equal ``maskValue`` is masked for every downstream mask-aware layer
+    (recurrent scans hold their carry, LastTimeStep picks the last valid
+    step).  Values pass through unchanged — keras ``Masking`` semantics
+    (reference: modelimport ``KerasMasking`` -> ``MaskZeroLayer``, which
+    DL4J wires around the consuming RNN; here the mask rides the forward's
+    existing mask channel instead)."""
+    maskValue: float = 0.0
+
+    #: _forward replaces the active mask with computeMask's result
+    producesMask = True
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def computeMask(self, x, mask):
+        # x: (b, f, t) — a step is valid if ANY feature differs from the
+        # sentinel; combine with an incoming mask (keras: masks AND up)
+        m = jnp.any(x != self.maskValue, axis=1).astype(jnp.float32)
+        if mask is not None:
+            m = m * mask.astype(m.dtype)
+        return m
+
+    def forward(self, params, x, train, key, state):
+        return x, state
 
 
 @dataclasses.dataclass
@@ -402,7 +432,8 @@ class OCNNOutputLayer(BaseLayer):
         return jax.nn.relu(-output[:, 0]) / self.nu
 
 
-for _c in [MaskLayer, RepeatVector, ElementWiseMultiplicationLayer,
+for _c in [MaskLayer, MaskingLayer, RepeatVector,
+           ElementWiseMultiplicationLayer,
            Cropping1D, ZeroPadding1DLayer, OCNNOutputLayer,
            LayerNormalization, GaussianNoiseLayer, GaussianDropoutLayer,
            AlphaDropoutLayer, ReshapeLayer, PermuteLayer]:

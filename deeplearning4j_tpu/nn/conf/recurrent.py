@@ -518,9 +518,11 @@ class LastTimeStep(Layer):
         if mask is None:
             return y[:, :, -1], state
         # last VALID step per sequence (reference: LastTimeStepLayer's
-        # mask-aware indexing)
-        idx = (jnp.sum(mask, axis=1).astype(jnp.int32) - 1)     # (b,)
-        idx = jnp.clip(idx, 0, y.shape[2] - 1)
+        # mask-aware indexing).  argmax-of-last-set handles masks with
+        # interior holes (e.g. data-derived Masking), not just padded tails
+        pos = jnp.arange(1, y.shape[2] + 1, dtype=jnp.float32)
+        idx = jnp.argmax(mask.astype(jnp.float32) * pos[None, :],
+                         axis=1).astype(jnp.int32)              # (b,)
         h = jnp.take_along_axis(y, idx[:, None, None], axis=2)[:, :, 0]
         return h, state
 

@@ -1,0 +1,283 @@
+"""Keras import breadth, round-5 batch 2: Softmax/ThresholdedReLU/PReLU
+activation layers, RepeatVector, Masking (data-derived timestep masks),
+Minimum merge, UpSampling1D/3D, ZeroPadding3D/Cropping3D, Conv3DTranspose.
+
+Reference: deeplearning4j-modelimport ``.../keras/layers/**``
+(KerasPReLU, KerasMasking, KerasRepeatVector, KerasUpsampling1D/3D,
+KerasZeroPadding3D, KerasCropping3D — SURVEY.md §2.5); goldens built
+in-process with the installed keras (the ``test_tfgraph_corpus.py``
+oracle pattern).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.imports import KerasModelImport  # noqa: E402
+
+
+def _import(model):
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.h5")
+        model.save(p)
+        return KerasModelImport.importKerasModelAndWeights(p)
+
+
+def _to_ours(x):
+    if x.ndim == 3:                       # (b, t, f)   -> (b, f, t)
+        return np.transpose(x, (0, 2, 1))
+    if x.ndim == 4:                       # NHWC        -> NCHW
+        return np.transpose(x, (0, 3, 1, 2))
+    if x.ndim == 5:                       # (b,d,h,w,c) -> NCDHW
+        return np.transpose(x, (0, 4, 1, 2, 3))
+    return x
+
+
+def _to_keras(y):
+    y = np.asarray(y)
+    if y.ndim == 3:
+        return np.transpose(y, (0, 2, 1))
+    if y.ndim == 4:
+        return np.transpose(y, (0, 2, 3, 1))
+    if y.ndim == 5:
+        return np.transpose(y, (0, 2, 3, 4, 1))
+    return y
+
+
+def _parity(model, x, atol=1e-4, rtol=1e-3):
+    net = _import(model)
+    keras_out = model.predict(x, verbose=0)
+    ours = net.output(_to_ours(x))
+    if isinstance(ours, dict):
+        ours = list(ours.values())[0]
+    np.testing.assert_allclose(_to_keras(ours.numpy()), keras_out,
+                               atol=atol, rtol=rtol)
+    return net
+
+
+class TestActivationLayers:
+    def test_softmax_layer(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.Dense(4),
+            tf.keras.layers.Softmax()])
+        x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+        _parity(model, x)
+
+    def test_softmax_layer_on_sequence(self):
+        """review r5: keras Softmax axis=-1 is the FEATURE axis; in this
+        framework's (b, f, t) layout that is axis 1, not -1 (time)."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5, 3)),
+            tf.keras.layers.SimpleRNN(4, return_sequences=True),
+            tf.keras.layers.Softmax()])
+        x = np.random.RandomState(14).randn(2, 5, 3).astype(np.float32)
+        net = _parity(model, x, atol=3e-4)
+        # feature-axis sums must be 1 at every timestep
+        y = np.asarray(net.output(_to_ours(x)).numpy())     # (b, f, t)
+        np.testing.assert_allclose(y.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_softmax_layer_on_conv_map(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 4, 3)),
+            tf.keras.layers.Conv2D(5, 2),
+            tf.keras.layers.Softmax()])
+        x = np.random.RandomState(15).randn(2, 4, 4, 3).astype(np.float32)
+        _parity(model, x, atol=3e-4)
+
+    def test_thresholded_relu_default_and_custom_theta(self):
+        for theta in (1.0, 0.6):
+            model = tf.keras.Sequential([
+                tf.keras.layers.Input(shape=(8,)),
+                tf.keras.layers.Dense(6),
+                tf.keras.layers.ThresholdedReLU(theta=theta)])
+            x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+            _parity(model, x)
+
+    def test_prelu_dense(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5,)),
+            tf.keras.layers.Dense(7),
+            tf.keras.layers.PReLU()])
+        # keras inits alpha to zeros (== plain relu); set a real slope so
+        # the test exercises the negative branch
+        rng = np.random.RandomState(2)
+        model.layers[-1].set_weights([rng.rand(7).astype(np.float32)])
+        x = rng.randn(4, 5).astype(np.float32)
+        _parity(model, x)
+
+    def test_prelu_conv_shared_spatial_axes(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6, 6, 3)),
+            tf.keras.layers.Conv2D(4, 3),
+            tf.keras.layers.PReLU(shared_axes=[1, 2])])
+        rng = np.random.RandomState(3)
+        model.layers[-1].set_weights(
+            [rng.rand(1, 1, 4).astype(np.float32)])
+        x = rng.randn(2, 6, 6, 3).astype(np.float32)
+        _parity(model, x)
+
+
+class TestStructuralLayers:
+    def test_repeat_vector_to_lstm(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5,)),
+            tf.keras.layers.Dense(4),
+            tf.keras.layers.RepeatVector(6),
+            tf.keras.layers.LSTM(3, return_sequences=True)])
+        x = np.random.RandomState(4).randn(2, 5).astype(np.float32)
+        _parity(model, x)
+
+    def test_upsampling1d(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5, 3)),
+            tf.keras.layers.Conv1D(4, 2),
+            tf.keras.layers.UpSampling1D(size=3)])
+        x = np.random.RandomState(5).randn(2, 5, 3).astype(np.float32)
+        _parity(model, x)
+
+    def test_minimum_merge_functional(self):
+        inp = tf.keras.Input(shape=(6,))
+        a = tf.keras.layers.Dense(4, name="a")(inp)
+        b = tf.keras.layers.Dense(4, name="b")(inp)
+        out = tf.keras.layers.Minimum()([a, b])
+        model = tf.keras.Model(inp, out)
+        x = np.random.RandomState(6).randn(3, 6).astype(np.float32)
+        _parity(model, x)
+
+
+class Test3DLayers:
+    def test_zeropadding3d_conv3d_cropping3d(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 5, 5, 2)),
+            tf.keras.layers.ZeroPadding3D(padding=(1, 1, 1)),
+            tf.keras.layers.Conv3D(3, 2),
+            tf.keras.layers.Cropping3D(cropping=((1, 0), (0, 1), (1, 1)))])
+        x = np.random.RandomState(7).randn(2, 4, 5, 5, 2).astype(np.float32)
+        _parity(model, x)
+
+    def test_upsampling3d(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(3, 3, 3, 2)),
+            tf.keras.layers.Conv3D(2, 2),
+            tf.keras.layers.UpSampling3D(size=(2, 1, 2))])
+        x = np.random.RandomState(8).randn(2, 3, 3, 3, 2).astype(np.float32)
+        _parity(model, x)
+
+    def test_functional_conv3d_prelu_shared_axes(self):
+        """review r5: the graph path must resolve PReLU axes in a CNN3D
+        context (keras (d,h,w,c) -> ours (c,d,h,w))."""
+        inp = tf.keras.Input(shape=(3, 4, 4, 2))
+        a = tf.keras.layers.Conv3D(3, 2, name="c3a")(inp)
+        b = tf.keras.layers.Conv3D(3, 2, name="c3b")(inp)
+        s = tf.keras.layers.Add()([a, b])
+        out = tf.keras.layers.PReLU(shared_axes=[1, 2, 3], name="pr")(s)
+        model = tf.keras.Model(inp, out)
+        rng = np.random.RandomState(16)
+        model.get_layer("pr").set_weights(
+            [rng.rand(1, 1, 1, 3).astype(np.float32)])
+        x = rng.randn(2, 3, 4, 4, 2).astype(np.float32)
+        _parity(model, x, atol=3e-4)
+
+    def test_conv3d_transpose(self):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(3, 4, 4, 2)),
+            tf.keras.layers.Conv3DTranspose(3, 2, strides=(2, 2, 2))])
+        x = np.random.RandomState(9).randn(2, 3, 4, 4, 2).astype(np.float32)
+        _parity(model, x)
+
+
+class TestMasking:
+    def _masked_batch(self, rng, b=3, t=6, f=4, masked_steps=((1, 4), (2, 5))):
+        x = rng.randn(b, t, f).astype(np.float32)
+        # zero out (== mask_value) whole timesteps per example
+        for bi, ti in masked_steps:
+            x[bi % b, ti] = 0.0
+        return x
+
+    def test_masking_lstm_last_step(self):
+        """keras Masking + LSTM(return_sequences=False): the output is
+        the state at the last VALID step — parity requires the imported
+        net to derive the mask from the data and pick the same step."""
+        rng = np.random.RandomState(10)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6, 4)),
+            tf.keras.layers.Masking(mask_value=0.0),
+            tf.keras.layers.LSTM(5)])
+        # mask the TAIL steps so last-valid != last
+        x = rng.randn(3, 6, 4).astype(np.float32)
+        x[0, 4:] = 0.0
+        x[1, 5:] = 0.0
+        _parity(model, x, atol=3e-4)
+
+    def test_masking_lstm_sequences_valid_positions(self):
+        """return_sequences=True: compare outputs at VALID timesteps (the
+        frameworks differ in what they emit at masked positions: ours
+        zeros, keras repeats state)."""
+        rng = np.random.RandomState(11)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6, 4)),
+            tf.keras.layers.Masking(mask_value=0.0),
+            tf.keras.layers.LSTM(5, return_sequences=True)])
+        x = rng.randn(2, 6, 4).astype(np.float32)
+        x[0, 2] = 0.0
+        x[1, 4:] = 0.0
+        net = _import(model)
+        keras_out = model.predict(x, verbose=0)        # (b, t, u)
+        ours = _to_keras(net.output(_to_ours(x)).numpy())
+        valid = np.any(x != 0.0, axis=-1)              # (b, t)
+        np.testing.assert_allclose(ours[valid], keras_out[valid],
+                                   atol=3e-4, rtol=1e-3)
+
+    def test_masking_holds_carry_through_masked_steps(self):
+        """The step AFTER a masked step must see the pre-mask carry (keras
+        skips the step entirely); this catches a zero-the-input-only
+        implementation, where the LSTM would still update state on zeros."""
+        rng = np.random.RandomState(12)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5, 3)),
+            tf.keras.layers.Masking(mask_value=0.0),
+            tf.keras.layers.LSTM(4)])
+        x = rng.randn(1, 5, 3).astype(np.float32)
+        x[0, 2] = 0.0                                  # mask a MIDDLE step
+        _parity(model, x, atol=3e-4)
+
+    def test_nonzero_mask_value(self):
+        rng = np.random.RandomState(13)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 3)),
+            tf.keras.layers.Masking(mask_value=7.0),
+            tf.keras.layers.LSTM(4)])
+        x = rng.randn(2, 4, 3).astype(np.float32)
+        x[0, 3] = 7.0
+        x[1, 1] = 7.0
+        _parity(model, x, atol=3e-4)
+
+
+class TestNewLayerSerde:
+    def test_new_layers_json_roundtrip(self):
+        """review r5: the new layer classes must be in the layer registry
+        so a saved configuration reloads (layer_from_json)."""
+        from deeplearning4j_tpu.nn.conf.convolutional import Upsampling1D
+        from deeplearning4j_tpu.nn.conf.convolutional3d import \
+            ZeroPadding3DLayer
+        from deeplearning4j_tpu.nn.conf.layers import layer_from_json
+        from deeplearning4j_tpu.nn.conf.misc import MaskingLayer
+        for lay in (MaskingLayer(maskValue=3.0), Upsampling1D(size=4),
+                    ZeroPadding3DLayer(padDepth=(1, 2), padHeight=(0, 1),
+                                       padWidth=(2, 0))):
+            back = layer_from_json(lay.toJson())
+            assert type(back) is type(lay)
+            assert back.toJson() == lay.toJson()
+
+
+class TestParameterizedActivation:
+    def test_thresholdedrelu_string_param(self):
+        from deeplearning4j_tpu.nn.activations import get_activation
+        import jax.numpy as jnp
+        f = get_activation("thresholdedrelu:0.5")
+        out = np.asarray(f(jnp.asarray([-1.0, 0.3, 0.5, 0.7])))
+        np.testing.assert_allclose(out, [0.0, 0.0, 0.0, 0.7])
