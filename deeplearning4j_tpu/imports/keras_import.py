@@ -79,6 +79,80 @@ class _WeightStore:
         return out
 
 
+def _to_snake_case(name: str) -> str:
+    """keras.src.utils.naming.to_snake_case — checkpoint group names in the
+    ``.keras`` weights file derive from CLASS names, not layer names."""
+    import re
+    name = re.sub(r"\W+", "", name)
+    name = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)
+    name = re.sub("([a-z])([A-Z])", r"\1_\2", name).lower()
+    return name
+
+
+#: sub-layer visit order inside one checkpoint group, so collected arrays
+#: line up with keras ``get_weights()`` order (alphabetical would put
+#: backward before forward and key before query)
+_V3_CHILD_ORDER = {"forward_layer": 0, "backward_layer": 1,
+                   "query_dense": 0, "key_dense": 1, "value_dense": 2,
+                   "output_dense": 3}
+
+
+class _WeightStoreV3:
+    """Weights from a keras-3 ``.keras`` archive (``model.weights.h5``).
+
+    Checkpoint groups are STRUCTURE-based: ``snake_case(class_name)``
+    uniquified by a per-name counter over the top-level layers in config
+    order (``layers/dense``, ``layers/dense_1``, …) — layer NAMES do not
+    appear, so the group map is reconstructed from the config."""
+
+    def __init__(self, h5file, layers_cfg: List[Dict]):
+        self.f = h5file
+        self.root = h5file["layers"] if "layers" in h5file else h5file
+        self._group: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        for lk in layers_cfg:
+            base = _to_snake_case(lk["class_name"])
+            n = counts.get(base, 0)
+            counts[base] = n + 1
+            name = lk.get("config", {}).get("name", lk.get("name"))
+            self._group[name] = base if n == 0 else f"{base}_{n}"
+        if layers_cfg and len(self.root.keys()) \
+                and not any(g in self.root for g in self._group.values()):
+            raise ValueError(
+                "Unrecognized .keras weights layout (keras-2-saved "
+                "archives store by layer name; only keras-3 archives "
+                "are supported — re-save with keras 3 or export h5)")
+
+    def get(self, layer_name: str) -> List[np.ndarray]:
+        import h5py
+        g = self._group.get(layer_name)
+        if g is None or g not in self.root:
+            # v3 group names are deterministic; a missing group for a
+            # weight-carrying layer means the layout was not produced by
+            # keras 3 — importing with init weights would be silently wrong
+            raise ValueError(
+                f"Keras import: no checkpoint group {g!r} for layer "
+                f"{layer_name!r} in the .keras weights file (keras-2-saved "
+                "archive? re-save with keras 3 or export h5)")
+        out: List[np.ndarray] = []
+
+        def key(k):
+            return (_V3_CHILD_ORDER.get(k, 50),
+                    int(k) if k.isdigit() else -1, k)
+
+        def collect(grp):
+            for k in sorted(grp.keys(), key=key):
+                if k == "seed_generator":   # RNG state, not a weight
+                    continue
+                obj = grp[k]
+                if isinstance(obj, h5py.Dataset):
+                    out.append(np.asarray(obj))
+                else:
+                    collect(obj)
+        collect(self.root[g])
+        return out
+
+
 #: class_name -> factory(cfg) -> our Layer (or (layer, kind, out_channels))
 _CUSTOM_LAYERS: Dict[str, Any] = {}
 #: keras layer NAME -> our Layer (Lambda layers carry no portable code)
@@ -107,12 +181,13 @@ class KerasModelImport:
     @staticmethod
     def importKerasSequentialModelAndWeights(path: str,
                                              enforceTrainingConfig: bool = False):
+        import zipfile
+
         import h5py
 
-        from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
-        from deeplearning4j_tpu.nn.conf import (InputType,
-                                                NeuralNetConfiguration)
-
+        if zipfile.is_zipfile(path):   # keras-3 native ".keras" archive
+            return KerasModelImport._importKerasV3(path,
+                                                   enforceTrainingConfig)
         with h5py.File(path, "r") as f:
             raw = f.attrs.get("model_config")
             if raw is None:
@@ -126,26 +201,40 @@ class KerasModelImport:
                 layers_cfg = layers_cfg.get("layers", [])
             store = _WeightStore(f)
             updater = _training_config_updater(f, enforceTrainingConfig)
-            if cls in ("Functional", "Model"):
-                chain = _linearize_functional(layers_cfg)
-                if chain is None:   # branching -> ComputationGraph
-                    full = model_cfg["config"] \
-                        if isinstance(model_cfg["config"], dict) else {}
-                    net = _build_graph(full, layers_cfg, store)
-                    if updater is not None:
-                        net.conf.globalConf["updater"] = updater
-                        net._initOptState()   # rebuild for the new updater
-                    return net
-                layers_cfg = chain
-            elif cls != "Sequential":
-                raise ValueError(f"Unsupported Keras model class: {cls}")
-            net = _build_sequential(layers_cfg, store, InputType,
-                                    NeuralNetConfiguration,
-                                    MultiLayerNetwork)
-            if updater is not None:
-                net.conf.globalConf["updater"] = updater
-                net._initOptState()   # rebuild for the new updater
-            return net
+            return _build_net(cls, model_cfg["config"], layers_cfg, store,
+                              updater)
+
+    @staticmethod
+    def _importKerasV3(path: str, enforceTrainingConfig: bool = False):
+        """The keras-3 ``.keras`` zip (config.json + model.weights.h5) —
+        beyond the reference's Keras 1.x/2.x h5 coverage (SURVEY §2.5):
+        keras 3 saves this format by default, so "any stock Keras model
+        imports" requires it."""
+        import io
+        import zipfile
+
+        import h5py
+
+        with zipfile.ZipFile(path) as z:
+            top = json.loads(z.read("config.json"))
+            weights_raw = z.read("model.weights.h5")
+        cls = top.get("class_name")
+        model_cfg = top.get("config", {})
+        layers_cfg = model_cfg.get("layers", []) \
+            if isinstance(model_cfg, dict) else model_cfg
+        compile_cfg = top.get("compile_config") or None   # uncompiled: {}
+        if compile_cfg is None and enforceTrainingConfig:
+            raise ValueError(
+                "enforceTrainingConfig=True but the .keras archive carries "
+                "no compile_config (model was saved uncompiled)")
+        updater = None
+        if compile_cfg:
+            updater = _updater_from_optimizer_cfg(
+                compile_cfg.get("optimizer") or {}, enforceTrainingConfig)
+
+        with h5py.File(io.BytesIO(weights_raw), "r") as wf:
+            store = _WeightStoreV3(wf, layers_cfg)
+            return _build_net(cls, model_cfg, layers_cfg, store, updater)
 
     # parity name (reference: KerasModelImport.importKerasModelAndWeights):
     # linear Functional chains come back as MultiLayerNetwork, branching
@@ -170,6 +259,12 @@ def _training_config_updater(f, enforce: bool):
     if isinstance(raw, bytes):
         raw = raw.decode()
     opt = (json.loads(raw).get("optimizer_config") or {})
+    return _updater_from_optimizer_cfg(opt, enforce)
+
+
+def _updater_from_optimizer_cfg(opt: Dict, enforce: bool):
+    """keras optimizer {class_name, config} -> framework updater; shared by
+    the h5 ``training_config`` and the ``.keras`` ``compile_config``."""
     # tf_keras (legacy keras 2) prefixes registered classes: "Custom>Adam"
     ocls = opt.get("class_name", "").split(">")[-1]
     ocfg = opt.get("config", {})
@@ -206,6 +301,34 @@ def _training_config_updater(f, enforce: bool):
         raise ValueError(f"Keras import: optimizer {ocls!r} has no "
                          "updater mapping")
     return None
+
+
+def _build_net(cls: Optional[str], model_cfg, layers_cfg: List[Dict],
+               store, updater):
+    """Shared model-class dispatch + updater wiring for the h5 and
+    ``.keras`` import paths: Sequential / linear-Functional ->
+    MultiLayerNetwork, branching Functional -> ComputationGraph."""
+    from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+
+    if cls in ("Functional", "Model"):
+        chain = _linearize_functional(layers_cfg)
+        if chain is None:   # branching -> ComputationGraph
+            full = model_cfg if isinstance(model_cfg, dict) else {}
+            net = _build_graph(full, layers_cfg, store)
+        else:
+            net = _build_sequential(chain, store, InputType,
+                                    NeuralNetConfiguration,
+                                    MultiLayerNetwork)
+    elif cls == "Sequential":
+        net = _build_sequential(layers_cfg, store, InputType,
+                                NeuralNetConfiguration, MultiLayerNetwork)
+    else:
+        raise ValueError(f"Unsupported Keras model class: {cls}")
+    if updater is not None:
+        net.conf.globalConf["updater"] = updater
+        net._initOptState()   # rebuild for the new updater
+    return net
 
 
 def _inbound_edges(layers_cfg: List[Dict]) -> Dict[str, List[str]]:
@@ -843,6 +966,21 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         if mapped is None:
             raise ValueError(f"Keras import: unsupported layer {cls}")
         lay, kind, out_c = mapped
+        # a pending Flatten kernel-row permutation is keyed to THIS index:
+        # only a Dense can absorb it; elementwise layers propagate it to
+        # the next index (they run on the unflattened map, which is
+        # numerically identical for elementwise ops); anything else would
+        # silently mis-order features — refuse, like the graph path
+        if len(our_layers) in pending_flatten and kind != "dense":
+            if kind in ("dropout", "activation", "noise") \
+                    and "softmax" not in str(getattr(lay, "activation", "")):
+                pending_flatten[len(our_layers) + 1] = \
+                    pending_flatten.pop(len(our_layers))
+            else:
+                raise ValueError(
+                    f"Keras import: {cls} between Flatten and Dense is "
+                    "unsupported (keras (h,w,c) vs our (c,h,w) flatten "
+                    "order would silently mis-order features)")
         if kind == "prelu":
             _fix_prelu_axes(lay, "cnn" if cur_conv_shape is not None
                             else "cnn3d" if cur_3d is not None

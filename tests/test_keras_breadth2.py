@@ -257,6 +257,44 @@ class TestMasking:
         _parity(model, x, atol=3e-4)
 
 
+class TestFlattenInterveners:
+    def test_flatten_then_relu_then_dense_parity(self):
+        """review r5: an elementwise layer between Flatten and Dense must
+        PROPAGATE the kernel-row permutation (it used to be dropped,
+        silently mis-ordering the Dense weights)."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 4, 3)),
+            tf.keras.layers.Conv2D(5, 2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.ReLU(),
+            tf.keras.layers.Dense(3)])
+        x = np.random.RandomState(17).randn(2, 4, 4, 3).astype(np.float32)
+        _parity(model, x, atol=3e-4)
+
+    def test_flatten_then_prelu_refuses(self):
+        """PReLU carries per-position params whose flat order differs —
+        must refuse, not crash or mis-import."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 4, 3)),
+            tf.keras.layers.Conv2D(5, 2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.PReLU(),
+            tf.keras.layers.Dense(3)])
+        with pytest.raises(ValueError, match="Flatten"):
+            _import(model)
+
+    def test_flatten_then_softmax_refuses(self):
+        """keras Softmax over the flat vector is not channel softmax."""
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 4, 3)),
+            tf.keras.layers.Conv2D(5, 2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Softmax(),
+            tf.keras.layers.Dense(3)])
+        with pytest.raises(ValueError, match="Flatten"):
+            _import(model)
+
+
 class TestNewLayerSerde:
     def test_new_layers_json_roundtrip(self):
         """review r5: the new layer classes must be in the layer registry

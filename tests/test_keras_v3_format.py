@@ -1,0 +1,159 @@
+"""Keras-3 native ``.keras`` archive import (config.json +
+model.weights.h5 zip).  Beyond the reference's Keras 1.x/2.x h5 coverage
+(deeplearning4j-modelimport, SURVEY.md §2.5): keras 3 saves ``.keras`` by
+default, so "any stock Keras model imports" requires the format.
+
+Checkpoint groups in the archive are STRUCTURE-based (snake_case class
+names uniquified in layer order, ``layers/dense_1/vars/0``) — these tests
+pin the group-map reconstruction and the sub-layer collect order
+(forward/backward, query/key/value/output).
+
+Uses the standalone ``keras`` package (always keras 3) rather than
+``tf.keras`` so results don't depend on the suite's TF_USE_LEGACY_KERAS
+state.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+if int(keras.__version__.split(".")[0]) < 3:
+    pytest.skip("needs keras 3", allow_module_level=True)
+
+from deeplearning4j_tpu.imports import KerasModelImport  # noqa: E402
+
+
+def _import(model):
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.keras")
+        model.save(p)
+        return KerasModelImport.importKerasModelAndWeights(p)
+
+
+def _to_ours(x):
+    if x.ndim == 3:
+        return np.transpose(x, (0, 2, 1))
+    if x.ndim == 4:
+        return np.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+def _to_keras(y):
+    y = np.asarray(y)
+    if y.ndim == 3:
+        return np.transpose(y, (0, 2, 1))
+    if y.ndim == 4:
+        return np.transpose(y, (0, 2, 3, 1))
+    return y
+
+
+def _parity(model, x, atol=1e-4, rtol=1e-3):
+    net = _import(model)
+    keras_out = np.asarray(model(x))
+    ours = net.output(_to_ours(x))
+    if isinstance(ours, dict):
+        ours = list(ours.values())[0]
+    np.testing.assert_allclose(_to_keras(ours.numpy()), keras_out,
+                               atol=atol, rtol=rtol)
+    return net
+
+
+class TestKerasV3Archive:
+    def test_sequential_dense_stack(self):
+        m = keras.Sequential([
+            keras.layers.Input(shape=(10,)),
+            keras.layers.Dense(16, activation="relu", name="h"),
+            keras.layers.Dense(4, name="out")])
+        x = np.random.RandomState(0).randn(5, 10).astype(np.float32)
+        _parity(m, x)
+
+    def test_sequential_conv_flatten_dense(self):
+        """Two Dense layers -> dense + dense_1 group uniquification, plus
+        the Flatten kernel-row permutation on the v3 path."""
+        m = keras.Sequential([
+            keras.layers.Input(shape=(8, 8, 3)),
+            keras.layers.Conv2D(4, 3, activation="relu"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(10, activation="relu"),
+            keras.layers.Dense(2)])
+        x = np.random.RandomState(1).randn(2, 8, 8, 3).astype(np.float32)
+        _parity(m, x)
+
+    def test_lstm_gru_stack(self):
+        m = keras.Sequential([
+            keras.layers.Input(shape=(6, 4)),
+            keras.layers.LSTM(5, return_sequences=True),
+            keras.layers.GRU(3)])
+        x = np.random.RandomState(2).randn(3, 6, 4).astype(np.float32)
+        _parity(m, x, atol=3e-4)
+
+    def test_bidirectional_collect_order(self):
+        """forward_layer must be collected before backward_layer
+        (alphabetical order would swap the weight halves)."""
+        m = keras.Sequential([
+            keras.layers.Input(shape=(5, 3)),
+            keras.layers.Bidirectional(
+                keras.layers.LSTM(4, return_sequences=True))])
+        x = np.random.RandomState(3).randn(2, 5, 3).astype(np.float32)
+        _parity(m, x, atol=3e-4)
+
+    def test_timedistributed_nested_group(self):
+        m = keras.Sequential([
+            keras.layers.Input(shape=(4, 6)),
+            keras.layers.TimeDistributed(keras.layers.Dense(3))])
+        x = np.random.RandomState(4).randn(2, 4, 6).astype(np.float32)
+        _parity(m, x)
+
+    def test_functional_transformer_block(self):
+        """MHA sub-layer collect order: query, key, value, output (not
+        alphabetical); plus branching -> ComputationGraph on the v3 path."""
+        d_model = 8
+        inp = keras.Input(shape=(6, d_model))
+        att = keras.layers.MultiHeadAttention(
+            num_heads=2, key_dim=4, name="mha")(inp, inp)
+        x = keras.layers.Add()([inp, att])
+        out = keras.layers.LayerNormalization()(x)
+        m = keras.Model(inp, out)
+        x = np.random.RandomState(5).randn(2, 6, d_model).astype(np.float32)
+        net = _parity(m, x, atol=3e-4)
+        wq = np.asarray(net.params_["mha"]["Wq"])
+        np.testing.assert_allclose(wq, m.get_layer("mha").get_weights()[0],
+                                   atol=1e-6)
+
+    def test_batchnorm_running_stats(self):
+        m = keras.Sequential([
+            keras.layers.Input(shape=(6,)),
+            keras.layers.Dense(4),
+            keras.layers.BatchNormalization()])
+        # train a little so mean/var are not at init
+        m.compile(optimizer="adam", loss="mse")
+        rng = np.random.RandomState(6)
+        m.fit(rng.randn(32, 6).astype(np.float32),
+              rng.randn(32, 4).astype(np.float32), epochs=2, verbose=0)
+        x = rng.randn(4, 6).astype(np.float32)
+        _parity(m, x, atol=3e-4)
+
+    def test_compile_config_maps_updater(self):
+        from deeplearning4j_tpu.learning import Adam
+        m = keras.Sequential([
+            keras.layers.Input(shape=(4,)),
+            keras.layers.Dense(2)])
+        m.compile(optimizer=keras.optimizers.Adam(learning_rate=3e-3),
+                  loss="mse")
+        net = _import(m)
+        up = net.conf.globalConf["updater"]
+        assert isinstance(up, Adam)
+        assert up.learningRate == pytest.approx(3e-3, rel=1e-4)
+
+    def test_uncompiled_enforce_raises(self):
+        m = keras.Sequential([
+            keras.layers.Input(shape=(4,)),
+            keras.layers.Dense(2)])
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.keras")
+            m.save(p)
+            with pytest.raises(ValueError, match="compile_config"):
+                KerasModelImport.importKerasModelAndWeights(
+                    p, enforceTrainingConfig=True)
