@@ -429,10 +429,10 @@ def _input_type(cfg: Dict, InputType):
 #: kinds that carry weights (their keras name is kept for the weight store)
 _WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding",
             "sepconv", "dwconv", "deconv", "simplernn", "gru", "ln", "mha",
-            "conv3d", "prelu", "deconv3d"}
+            "conv3d", "prelu", "deconv3d", "lc2d", "lc1d"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
-              "dwconv", "deconv"}
+              "dwconv", "deconv", "lc2d"}
 
 
 def _is_weighty(kind: str) -> bool:
@@ -871,6 +871,34 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
             activation=_act(cfg.get("activation")),
             hasBias=bool(cfg.get("use_bias", True)))
         return lay, "deconv3d", int(cfg["filters"])
+    if cls in ("LocallyConnected2D", "LocallyConnected1D"):
+        from deeplearning4j_tpu.nn.conf.convolutional3d import (
+            LocallyConnected1D, LocallyConnected2D)
+        if cfg.get("implementation", 1) != 1:
+            raise ValueError("Keras import: LocallyConnected implementation"
+                             f"={cfg.get('implementation')} unsupported "
+                             "(dense per-position kernels only, impl 1)")
+        if cfg.get("padding", "valid") != "valid":
+            raise ValueError("Keras import: LocallyConnected padding="
+                             f"{cfg.get('padding')!r} unsupported")
+        if cfg.get("data_format") == "channels_first":
+            raise ValueError("Keras import: channels_first LocallyConnected"
+                             " is not supported (save as channels_last)")
+        common = dict(nOut=int(cfg["filters"]),
+                      activation=_act(cfg.get("activation")),
+                      hasBias=bool(cfg.get("use_bias", True)))
+        if cls == "LocallyConnected2D":
+            k = cfg.get("kernel_size", [3, 3])
+            s = cfg.get("strides", [1, 1])
+            lay = LocallyConnected2D(
+                kernelSize=tuple(int(x) for x in k),
+                stride=tuple(int(x) for x in s), **common)
+            return lay, "lc2d", int(cfg["filters"])
+        k = cfg.get("kernel_size", [3])
+        s = cfg.get("strides", [1])
+        lay = LocallyConnected1D(kernelSize=int(k[0]), stride=int(s[0]),
+                                 **common)
+        return lay, "lc1d", None
     if cls == "TimeDistributed":
         from deeplearning4j_tpu.nn.conf.recurrent import (
             TimeDistributed, TimeDistributedFlatten)
@@ -1032,7 +1060,8 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         elif kind in _CNN_KINDS and cur_conv_shape is not None:
             cur_conv_shape = _track_shape(
                 cur_conv_shape, lay, _out_channels(out_c, cur_conv_shape))
-        if kind in ("conv1d", "pool", "crop1d", "pad1d", "upsample1d") \
+        if kind in ("conv1d", "pool", "crop1d", "pad1d", "upsample1d",
+                    "lc1d") \
                 and cur_seq is not None and cur_conv_shape is None:
             out_t = lay.getOutputType(InputType.recurrent(*cur_seq))
             cur_seq = (out_t.size, out_t.timeSeriesLength) \
@@ -1274,6 +1303,30 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
         p["W"] = jnp.asarray(ws[0].transpose(3, 4, 0, 1, 2))
         if len(ws) > 1 and "b" in p:
             p["b"] = jnp.asarray(ws[1])
+    elif kind == "lc2d":
+        # keras (P, kh*kw*c, f) patch order (kh, kw, c) -> ours (c, kh, kw);
+        # keras bias is PER-POSITION (oh, ow, f) — ours broadcasts (P, f)
+        kern = ws[0]
+        kh, kw = (int(v) for v in kcfg.get("kernel_size", [3, 3]))
+        P, kkc, f_ = kern.shape
+        c = kkc // (kh * kw)
+        p["W"] = jnp.asarray(
+            kern.reshape(P, kh, kw, c, f_).transpose(0, 3, 1, 2, 4)
+            .reshape(P, c * kh * kw, f_))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1].reshape(P, f_))
+    elif kind == "lc1d":
+        # keras (ot, k*c, f) patch order (k, c) -> ours (c, k)
+        kern = ws[0]
+        ksz = kcfg.get("kernel_size", [3])
+        k = int(ksz[0] if isinstance(ksz, (list, tuple)) else ksz)
+        ot, kc, f_ = kern.shape
+        c = kc // k
+        p["W"] = jnp.asarray(
+            kern.reshape(ot, k, c, f_).transpose(0, 2, 1, 3)
+            .reshape(ot, c * k, f_))
+        if len(ws) > 1 and "b" in p:
+            p["b"] = jnp.asarray(ws[1].reshape(ot, f_))
 
 
 #: Keras merge-layer class -> graph vertex construction

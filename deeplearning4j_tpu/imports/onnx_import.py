@@ -113,7 +113,12 @@ def _tensor(buf: bytes) -> Tuple[str, np.ndarray]:
         arr = np.asarray(_unpack_varints(f[7]), dtype=np.int64)
     else:
         arr = np.zeros(0, dtype=dtype)
-    return name, arr.reshape(dims) if dims else arr
+    if dims:
+        arr = arr.reshape(dims)
+    elif arr.size == 1:
+        arr = arr.reshape(())   # empty dims = rank 0 (scalar fidelity
+        #                         matters for Gather->Unsqueeze shape math)
+    return name, arr
 
 
 def _attr(buf: bytes) -> Tuple[str, Any]:
@@ -372,9 +377,24 @@ def _onnx_flatten_impl(axis=1, **_):
 
 @_op("Reshape")
 def _reshape(ctx, node):
-    shape = tuple(int(v) for v in ctx.const_val(node.inputs[1]))
+    shape = tuple(int(v) for v in
+                  ctx.const_val(node.inputs[1]).reshape(-1))
+    if 0 in shape and not int(node.attrs.get("allowzero", 0)):
+        # ONNX: a 0 target dim copies the input dim (torch RNN exports
+        # reshape bidirectional outputs with [0, 0, -1])
+        return ctx.sd._op("onnx_reshape0", [ctx.get(node.inputs[0])],
+                          {"shape": shape})
     return ctx.sd._op("reshape", [ctx.get(node.inputs[0])],
                       {"shape": shape})
+
+
+@register_op("onnx_reshape0")
+def _onnx_reshape0_impl(shape=(), **_):
+    def fn(x):
+        resolved = tuple(x.shape[i] if d == 0 else d
+                         for i, d in enumerate(shape))
+        return x.reshape(resolved)
+    return fn
 
 
 @_op("Transpose")
@@ -476,6 +496,165 @@ def _bn(ctx, node):
 
 # ---------------------------------------------------------------------------
 
+def _fold_constants(nodes, consts: Dict[str, np.ndarray],
+                    input_shapes: Dict[str, Optional[List[int]]],
+                    trainable: frozenset = frozenset()) -> set:
+    """Constant-fold shape subgraphs before graph construction.
+
+    torch exports initial RNN states and reshape targets as
+    ``Shape→Gather→Unsqueeze→Concat→ConstantOfShape/Expand`` chains; with
+    static value-info shapes these reduce to initializers.  Mirrors the
+    TF importer's symbolic folding (tf_import.py) on the ONNX side —
+    reference: the Kotlin import framework's full-graph evaluation
+    (SURVEY.md §2.3).  Folded values land in ``consts``; returns the set
+    of node names whose EVERY output folded (skipped at emission)."""
+    folded_nodes: set = set()
+    # statically-known tensor shapes: value-info inputs + initializers,
+    # propagated through the layout/recurrent ops that shape chains span
+    shapes: Dict[str, List[int]] = {
+        n: list(s) for n, s in input_shapes.items()
+        if s is not None and all(d is not None and d >= 0 for d in s)}
+    for n_, v_ in consts.items():
+        shapes[n_] = list(v_.shape)
+
+    def _propagate(node) -> None:
+        op, ins, at = node.op_type, node.inputs, node.attrs
+        s0 = shapes.get(ins[0]) if ins else None
+        if s0 is None:
+            return
+        out = None
+        if op == "Transpose":
+            perm = at.get("perm") or list(range(len(s0)))[::-1]
+            out = [s0[int(p)] for p in perm]
+        elif op == "Reshape" and len(ins) > 1 and ins[1] in consts:
+            tgt = [int(v) for v in consts[ins[1]].reshape(-1)]
+            size = int(np.prod(s0)) if s0 else 1
+            out = [s0[i] if d == 0 and i < len(s0) else d
+                   for i, d in enumerate(tgt)]
+            if out.count(-1) == 1:
+                rest = int(np.prod([d for d in out if d != -1])) or 1
+                out[out.index(-1)] = size // rest
+            elif -1 in out:
+                out = None
+        elif op in ("Squeeze", "Unsqueeze"):
+            axes = None
+            if len(ins) > 1 and ins[1] in consts:
+                axes = [int(v) for v in consts[ins[1]].reshape(-1)]
+            elif at.get("axes") is not None:
+                axes = [int(v) for v in np.asarray(at["axes"]).reshape(-1)]
+            if axes is None and op == "Squeeze":
+                out = [d for d in s0 if d != 1]
+            elif axes is not None:
+                r = len(s0) + (len(axes) if op == "Unsqueeze" else 0)
+                axes = [a % r for a in axes]
+                if op == "Squeeze":
+                    out = [d for i, d in enumerate(s0) if i not in axes]
+                else:
+                    out = list(s0)
+                    for a in sorted(axes):
+                        out.insert(a, 1)
+        elif op in ("LSTM", "GRU", "RNN") and len(s0) == 3:
+            nd = 2 if _bdecode(at.get("direction")) == "bidirectional" \
+                else 1
+            h = int(at.get("hidden_size", 0))
+            t, b = s0[0], s0[1]
+            shapes[node.outputs[0]] = [t, nd, b, h]
+            for o in node.outputs[1:]:
+                if o:
+                    shapes[o] = [nd, b, h]
+            return
+        elif op in ("Relu", "Sigmoid", "Tanh", "Elu", "Selu", "Softmax",
+                    "Softplus", "Identity", "Dropout", "Cast", "Neg",
+                    "Abs", "LeakyRelu", "Erf", "Exp", "Log", "Sqrt"):
+            out = list(s0)
+        if out is not None and node.outputs and node.outputs[0]:
+            shapes[node.outputs[0]] = out
+
+    def fold(node) -> Optional[List[np.ndarray]]:
+        op, ins, at = node.op_type, node.inputs, node.attrs
+        if op == "Shape":
+            if ins[0] in shapes:
+                return [np.asarray(shapes[ins[0]], np.int64)]
+            return None
+        if op == "Constant":
+            v = at.get("value")
+            return None if v is None else [np.asarray(v)]
+        if not all(i == "" or i in consts for i in ins):
+            return None
+        vals = [consts[i] if i else None for i in ins]
+        if op == "ConstantOfShape":
+            fill = np.asarray(at.get("value", np.float32(0.0))).reshape(-1)
+            return [np.full([int(d) for d in vals[0]], fill[0],
+                            dtype=fill.dtype)]
+        if op == "Gather":
+            return [np.take(vals[0], vals[1].astype(np.int64),
+                            axis=int(at.get("axis", 0)))]
+        if op == "Concat":
+            arrs = [np.atleast_1d(v) for v in vals]
+            if len({a.ndim for a in arrs}) != 1:
+                return None           # not a shape-vector concat
+            return [np.concatenate(arrs, axis=int(at.get("axis", 0)))]
+        if op == "Unsqueeze":
+            axes = vals[1].reshape(-1).astype(int) if len(vals) > 1 \
+                else np.asarray(at.get("axes", [0]), int)
+            out = vals[0]
+            for ax in sorted(axes):
+                out = np.expand_dims(out, int(ax))
+            return [out]
+        if op == "Squeeze":
+            axes = vals[1].reshape(-1).astype(int) if len(vals) > 1 and \
+                vals[1] is not None else None
+            return [np.squeeze(vals[0], tuple(axes) if axes is not None
+                               else None)]
+        if op == "Cast":
+            to = _DTYPES.get(int(at.get("to", 0)))
+            return None if to is None else [vals[0].astype(to)]
+        if op == "Expand":
+            return [vals[0] * np.ones([int(d) for d in vals[1]],
+                                      dtype=vals[0].dtype)]
+        if op in ("Add", "Sub", "Mul", "Div"):
+            f = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                 "Div": lambda a, b: a // b
+                 if np.issubdtype(a.dtype, np.integer) else a / b}[op]
+            return [np.asarray(f(vals[0], vals[1]))]
+        if op == "Slice" and len(vals) >= 3:
+            starts = vals[1].reshape(-1).astype(int)
+            ends = vals[2].reshape(-1).astype(int)
+            axes = vals[3].reshape(-1).astype(int) if len(vals) > 3 and \
+                vals[3] is not None else np.arange(len(starts))
+            steps = vals[4].reshape(-1).astype(int) if len(vals) > 4 and \
+                vals[4] is not None else np.ones(len(starts), int)
+            out = vals[0]
+            sl = [slice(None)] * out.ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(s), int(e), int(st))
+            return [out[tuple(sl)]]
+        return None
+
+    for node in nodes:
+        # only fold small integer/shape-ish tensors — real compute (conv
+        # outputs etc.) must stay in the graph even if inputs are consts.
+        # never fold through a TRAINABLE initializer: the folded const
+        # would silently freeze a fine-tunable weight
+        res = None if any(i in trainable for i in node.inputs) \
+            else fold(node)
+        if res is None or sum(v.size for v in res) > 4096:
+            _propagate(node)
+            continue
+        for name, val in zip(node.outputs, res):
+            if name:
+                consts[name] = val
+                shapes[name] = list(val.shape)
+        folded_nodes.add(id(node))
+    return folded_nodes
+
+
+def _bdecode(v, default="forward"):
+    if v is None:
+        return default
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+
 class OnnxImporter:
     """Reference facade: OnnxImporter.runImport → SameDiff."""
 
@@ -493,7 +672,11 @@ class OnnxImporter:
                 continue        # initializers may appear as graph inputs
             ctx.vars[name] = sd.placeholder(name)
             in_names.append(name)
+        folded = _fold_constants(nodes, ctx.consts, dict(inputs),
+                                 frozenset(ctx.trainable))
         for node in nodes:
+            if id(node) in folded:
+                continue        # reduced to an initializer (shape math)
             if node.op_type not in _ONNX_OPS:
                 raise ValueError(f"ONNX import: unsupported op "
                                  f"{node.op_type!r} (node {node.name!r})")
@@ -514,3 +697,4 @@ def importOnnxModel(path: str):
 
 from deeplearning4j_tpu.imports import onnx_import_ext  # noqa: E402,F401  isort:skip
 from deeplearning4j_tpu.imports import onnx_import_ext2  # noqa: E402,F401  isort:skip
+from deeplearning4j_tpu.imports import onnx_import_ext3  # noqa: E402,F401  isort:skip

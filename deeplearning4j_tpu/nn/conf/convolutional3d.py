@@ -376,15 +376,18 @@ class LocallyConnected2D(_LocallyConnectedBase):
         if not self.inputSize:
             self.inputSize = (inputType.height, inputType.width)
 
-    def _outSpatial(self):
-        (h, w) = self.inputSize
+    def _outSpatial(self, size=None):
+        (h, w) = size or self.inputSize
         kh, kw = self.kernelSize
         sh, sw = self.stride
         ph, pw = self.padding
         return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
 
     def getOutputType(self, inputType):
-        oh, ow = self._outSpatial()
+        # pre-build shape queries (importers) fall back to the passed
+        # type WITHOUT binding it — inferNIn owns the binding
+        oh, ow = self._outSpatial(
+            self.inputSize or (inputType.height, inputType.width))
         return InputType.convolutional(oh, ow, self.nOut)
 
     def initParams(self, key, inputType, dtype=jnp.float32):
@@ -446,12 +449,13 @@ class LocallyConnected1D(_LocallyConnectedBase):
         if not self.inputSize:
             self.inputSize = inputType.timeSeriesLength
 
-    def _outT(self):
-        return (self.inputSize + 2 * self.padding - self.kernelSize) \
-            // self.stride + 1
+    def _outT(self, size=None):
+        return ((size or self.inputSize) + 2 * self.padding
+                - self.kernelSize) // self.stride + 1
 
     def getOutputType(self, inputType):
-        return InputType.recurrent(self.nOut, self._outT())
+        return InputType.recurrent(self.nOut, self._outT(
+            self.inputSize or inputType.timeSeriesLength))
 
     def initParams(self, key, inputType, dtype=jnp.float32):
         k = self.kernelSize

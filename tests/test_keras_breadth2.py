@@ -257,6 +257,30 @@ class TestMasking:
         _parity(model, x, atol=3e-4)
 
 
+class TestLocallyConnected:
+    """keras 3 removed LocallyConnected; the oracle uses the installed
+    legacy tf_keras (keras 2), whose h5 format the importer reads."""
+
+    def test_locally_connected_2d(self):
+        tfk = pytest.importorskip("tf_keras")
+        model = tfk.Sequential([
+            tfk.layers.Input(shape=(6, 6, 3)),
+            tfk.layers.LocallyConnected2D(4, (2, 2), strides=(2, 2),
+                                          activation="relu"),
+            tfk.layers.Flatten(),
+            tfk.layers.Dense(3)])
+        x = np.random.RandomState(20).randn(2, 6, 6, 3).astype(np.float32)
+        _parity(model, x, atol=3e-4)
+
+    def test_locally_connected_1d(self):
+        tfk = pytest.importorskip("tf_keras")
+        model = tfk.Sequential([
+            tfk.layers.Input(shape=(7, 3)),
+            tfk.layers.LocallyConnected1D(4, 2, activation="tanh")])
+        x = np.random.RandomState(21).randn(2, 7, 3).astype(np.float32)
+        _parity(model, x, atol=3e-4)
+
+
 class TestFlattenInterveners:
     def test_flatten_then_relu_then_dense_parity(self):
         """review r5: an elementwise layer between Flatten and Dense must

@@ -40,6 +40,31 @@ def test_torch_mlp_parity():
     _roundtrip("torch_tiny_mlp", 1e-4)
 
 
+def test_torch_rnn_parity():
+    """Round 5: ONNX LSTM (bidirectional) -> GRU -> RNN sequence ops —
+    one lax.scan per direction, torch gate-order re-layout."""
+    _roundtrip("torch_tiny_rnn", 1e-4)
+
+
+def test_torch_rnn_fine_tunes():
+    """Recurrent weights import as trainable variables (they are listed
+    in _WEIGHT_BEARING_OPS) so an imported RNN fine-tunes."""
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.learning import Adam
+
+    sd, ins, outs, io = _roundtrip("torch_tiny_rnn", 1e-4)
+    y = sd.placeholder("target")
+    sd.loss().meanSquaredError(sd.getVariable(outs[0]), y, name="loss")
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Adam(1e-2), dataSetFeatureMapping=[ins[0]],
+        dataSetLabelMapping=["target"]))
+    tgt = np.zeros_like(io["y"])
+    hist = sd.fit(DataSet(io["x"], tgt), epochs=12)
+    curve = hist.lossCurve()
+    assert curve[-1] < curve[0] * 0.9
+
+
 def test_imported_model_trains():
     """The imported graph is a live SameDiff: attach a loss and fit."""
     from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
@@ -119,9 +144,57 @@ def test_fixture_bytes_are_foreign():
     "DequantizeLinear", "RandomNormal", "Bernoulli", "Einsum",
     "ScatterND", "GatherND", "NonMaxSuppression", "ConvTranspose",
     "DepthToSpace", "BitShift", "EyeLike", "Det", "LpPool",
-    "MeanVarianceNormalization", "ReverseSequence"])
+    "MeanVarianceNormalization", "ReverseSequence",
+    "LSTM", "GRU", "RNN", "OneHot", "Shrink"])
 def test_new_rules_registered(name):
     assert name in _ONNX_OPS
+
+
+def test_onehot_and_shrink_impls():
+    from deeplearning4j_tpu.imports.onnx_import_ext3 import (
+        _onnx_onehot_impl, _onnx_shrink_impl)
+    oh = _onnx_onehot_impl(depth=4, off=-1.0, on=2.0, axis=-1)
+    out = np.asarray(oh(np.array([0, 3])))
+    np.testing.assert_allclose(out, [[2, -1, -1, -1], [-1, -1, -1, 2]])
+    sh = _onnx_shrink_impl(lambd=1.5, bias=0.5)
+    out = np.asarray(sh(np.array([-2.0, -1.0, 0.0, 1.0, 2.0])))
+    np.testing.assert_allclose(out, [-1.5, 0.0, 0.0, 0.0, 1.5])
+
+
+def test_onnx_gru_linear_before_reset_variants():
+    """The two ONNX GRU candidate-gate formulas differ when Rbh != 0 —
+    pin both against a NumPy reference."""
+    from deeplearning4j_tpu.imports.onnx_import_ext3 import _onnx_gru_impl
+    rng = np.random.RandomState(0)
+    t, b, i, h = 3, 2, 4, 5
+    x = rng.randn(t, b, i).astype(np.float32)
+    W = rng.randn(1, 3 * h, i).astype(np.float32)
+    R = rng.randn(1, 3 * h, h).astype(np.float32)
+    B = rng.randn(1, 6 * h).astype(np.float32)
+
+    def ref(linear_before_reset):
+        hh = np.zeros((b, h), np.float32)
+        wb, rb = B[0][:3 * h], B[0][3 * h:]
+        ys = []
+        for step in range(t):
+            gx = x[step] @ W[0].T + wb
+            gz, gr, gh = np.split(gx, 3, axis=-1)
+            z = 1 / (1 + np.exp(-(gz + hh @ R[0][:h].T + rb[:h])))
+            r = 1 / (1 + np.exp(-(gr + hh @ R[0][h:2 * h].T
+                                  + rb[h:2 * h])))
+            if linear_before_reset:
+                hc = np.tanh(gh + r * (hh @ R[0][2 * h:].T + rb[2 * h:]))
+            else:
+                hc = np.tanh(gh + (r * hh) @ R[0][2 * h:].T + rb[2 * h:])
+            hh = z * hh + (1 - z) * hc
+            ys.append(hh)
+        return np.stack(ys)[:, None]
+    for lbr in (0, 1):
+        fn = _onnx_gru_impl(hidden=h, has_b=True,
+                            linear_before_reset=lbr)
+        got = np.asarray(fn(x, W, R, B)[0])
+        np.testing.assert_allclose(got, ref(lbr), atol=1e-5)
+    assert not np.allclose(ref(0), ref(1))   # the variants must differ
 
 
 def test_trainable_initializer_classification():

@@ -131,6 +131,25 @@ class TinyBert(nn.Module):
         return self.head(x.mean(dim=1))
 
 
+class TinyRnn(nn.Module):
+    """Bidirectional LSTM -> GRU -> RNN -> Linear: the ONNX recurrent
+    operator vocabulary (round-5: LSTM/GRU/RNN sequence ops import as one
+    lax.scan per direction)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lstm = nn.LSTM(6, 8, bidirectional=True)
+        self.gru = nn.GRU(16, 5)
+        self.rnn = nn.RNN(5, 4)
+        self.head = nn.Linear(4, 3)
+
+    def forward(self, x):                       # (t, b, 6) time-major
+        y, _ = self.lstm(x)
+        y, _ = self.gru(y)
+        y, hT = self.rnn(y)
+        return self.head(hT[0])
+
+
 def export(model, x, stem):
     model.eval()
     with torch.no_grad():
@@ -148,3 +167,4 @@ if __name__ == "__main__":
     export(TinyCnn(), torch.randn(2, 3, 16, 16), "torch_tiny_cnn")
     export(TinyMlp(), torch.randn(4, 12), "torch_tiny_mlp")
     export(TinyBert(), torch.randint(0, 100, (2, 12)), "torch_bert_mini")
+    export(TinyRnn(), torch.randn(7, 2, 6), "torch_tiny_rnn")
