@@ -1,0 +1,104 @@
+"""Round-5 model-import tour: every stock-model path into the framework.
+
+Shaped like dl4j-examples' modelimport samples (reference:
+``deeplearning4j-modelimport`` — SURVEY.md §2.5):
+
+1. a Keras model saved as a native keras-3 ``.keras`` archive imports
+   (structure-based checkpoint groups) and keeps its compiled optimizer;
+2. a Keras Masking+LSTM model imports with DATA-DERIVED timestep masks;
+3. a torch-exported ONNX recurrent stack (BiLSTM->GRU->RNN) imports and
+   fine-tunes through the imported weights.
+
+Bare ``python examples/model_import_tour.py`` runs on the TPU chip.
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))     # run as a script from anywhere
+
+import numpy as np
+
+
+def keras_v3_archive():
+    import keras
+
+    from deeplearning4j_tpu.imports import KerasModelImport
+
+    inp = keras.Input(shape=(6, 8))
+    att = keras.layers.MultiHeadAttention(num_heads=2, key_dim=4,
+                                          name="mha")(inp, inp)
+    x = keras.layers.Add()([inp, att])
+    out = keras.layers.LayerNormalization()(x)
+    m = keras.Model(inp, out)
+    m.compile(optimizer=keras.optimizers.Adam(learning_rate=2e-3),
+              loss="mse")
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "encoder.keras")
+        m.save(p)
+        net = KerasModelImport.importKerasModelAndWeights(p)
+    xv = np.random.RandomState(0).randn(3, 6, 8).astype(np.float32)
+    ours = net.output(np.transpose(xv, (0, 2, 1)))
+    if isinstance(ours, dict):
+        ours = list(ours.values())[0]
+    ref = np.asarray(m(xv))
+    diff = float(np.abs(np.transpose(np.asarray(ours.numpy()),
+                                     (0, 2, 1)) - ref).max())
+    up = type(net.conf.globalConf["updater"]).__name__
+    print(f"1. .keras transformer block: max|Δ| vs keras = {diff:.2e}, "
+          f"updater from compile_config = {up}")
+
+
+def keras_masking_lstm():
+    import keras
+
+    from deeplearning4j_tpu.imports import KerasModelImport
+
+    m = keras.Sequential([
+        keras.layers.Input(shape=(6, 4)),
+        keras.layers.Masking(mask_value=0.0),
+        keras.layers.LSTM(5)])
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 6, 4).astype(np.float32)
+    x[0, 4:] = 0.0              # padded tail
+    x[1, 2] = 0.0               # interior hole
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "masked.h5")
+        m.save(p)
+        net = KerasModelImport.importKerasModelAndWeights(p)
+    ours = np.asarray(net.output(np.transpose(x, (0, 2, 1))).numpy())
+    ref = np.asarray(m(x))
+    print(f"2. Masking+LSTM (masks derived from the data): "
+          f"max|Δ| vs keras = {float(np.abs(ours - ref).max()):.2e}")
+
+
+def onnx_rnn_finetune():
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.imports.onnx_import import OnnxImporter
+    from deeplearning4j_tpu.learning import Adam
+
+    fix = os.path.join(os.path.dirname(__file__), "..", "tests",
+                       "fixtures")
+    io = np.load(os.path.join(fix, "torch_tiny_rnn_io.npz"))
+    sd, ins, outs = OnnxImporter.importModel(
+        os.path.join(fix, "torch_tiny_rnn.onnx"))
+    got = np.asarray(sd.output({ins[0]: io["x"]}, outs[0])[outs[0]]
+                     .numpy())
+    diff = float(np.abs(got - io["y"]).max())
+    y = sd.placeholder("target")
+    sd.loss().meanSquaredError(sd.getVariable(outs[0]), y, name="loss")
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Adam(1e-2), dataSetFeatureMapping=[ins[0]],
+        dataSetLabelMapping=["target"]))
+    hist = sd.fit(DataSet(io["x"], np.zeros_like(io["y"])), epochs=8)
+    curve = hist.lossCurve()
+    print(f"3. torch ONNX BiLSTM->GRU->RNN: max|Δ| vs torch = {diff:.2e}; "
+          f"fine-tune loss {curve[0]:.4f} -> {curve[-1]:.4f}")
+
+
+if __name__ == "__main__":
+    keras_v3_archive()
+    keras_masking_lstm()
+    onnx_rnn_finetune()
