@@ -429,7 +429,7 @@ def _input_type(cfg: Dict, InputType):
 #: kinds that carry weights (their keras name is kept for the weight store)
 _WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding",
             "sepconv", "dwconv", "deconv", "simplernn", "gru", "ln", "mha",
-            "conv3d", "prelu", "deconv3d", "lc2d", "lc1d"}
+            "conv3d", "prelu", "deconv3d", "lc2d", "lc1d", "staticnorm"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
               "dwconv", "deconv", "lc2d"}
@@ -449,6 +449,17 @@ def _pad3_spec(p):
         out.append((int(v), int(v)) if isinstance(v, int)
                    else (int(v[0]), int(v[1])))
     return tuple(out)
+
+
+def _check_norm_axis(lay, rank: int) -> None:
+    """keras Normalization normalizes the axis it was adapted over; only
+    the trailing (channels-last) axis maps onto this framework's
+    channel-first layouts."""
+    ax = getattr(lay, "_kerasAxis", -1)
+    if ax not in (-1, rank - 1):
+        raise ValueError(
+            f"Keras import: Normalization axis={ax} on a rank-{rank} "
+            "input is unsupported (channels-last axis only)")
 
 
 def _fix_prelu_axes(lay, ctx: str) -> None:
@@ -514,13 +525,18 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         from deeplearning4j_tpu.nn.conf.layers import ELULayer
         return (ELULayer(alpha=float(cfg.get("alpha", 1.0))),
                 "activation", None)
-    if cls == "ReLU" and not cfg.get("max_value") \
-            and not cfg.get("threshold"):
+    if cls == "ReLU" and not cfg.get("threshold"):
         slope = float(cfg.get("negative_slope", 0.0) or 0.0)
-        if slope:
+        mv = cfg.get("max_value")
+        if mv is not None and not slope:    # MobileNet-style capped relu
+            mv = float(mv)
+            act = "relu6" if mv == 6.0 else f"clippedrelu:{mv}"
+            return ActivationLayer(activation=act), "activation", None
+        if slope and mv is None:
             from deeplearning4j_tpu.nn.conf.layers import LeakyReLULayer
             return LeakyReLULayer(alpha=slope), "activation", None
-        return ActivationLayer(activation="relu"), "activation", None
+        if mv is None:
+            return ActivationLayer(activation="relu"), "activation", None
     if cls == "Dense":
         units = int(cfg["units"])
         act = _act(cfg.get("activation"))
@@ -899,6 +915,36 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         lay = LocallyConnected1D(kernelSize=int(k[0]), stride=int(s[0]),
                                  **common)
         return lay, "lc1d", None
+    if cls == "Rescaling":
+        from deeplearning4j_tpu.nn.conf.misc import RescaleLayer
+        scale, offset = cfg.get("scale", 1.0), cfg.get("offset", 0.0)
+        if isinstance(scale, (list, tuple)) \
+                or isinstance(offset, (list, tuple)):
+            raise ValueError("Keras import: per-channel Rescaling is "
+                             "unsupported (scalar scale/offset only)")
+        return (RescaleLayer(scale=float(scale), offset=float(offset)),
+                "activation", None)
+    if cls == "Normalization":
+        from deeplearning4j_tpu.nn.conf.misc import StaticNormalizationLayer
+        if cfg.get("invert"):
+            raise ValueError("Keras import: Normalization(invert=True) "
+                             "(denormalization) is unsupported")
+        axis = cfg.get("axis", -1)
+        ax_list = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+        if len(ax_list) != 1:
+            raise ValueError(f"Keras import: Normalization axis={axis} "
+                             "unsupported (single channels-last axis)")
+        mv = cfg.get("mean")      # constructor-supplied stats live in the
+        vv = cfg.get("variance")  # CONFIG (no weight variables created)
+        lay = StaticNormalizationLayer(
+            mean=tuple(np.asarray(mv if mv is not None else ())
+                       .reshape(-1).tolist()),
+            variance=tuple(np.asarray(vv if vv is not None else ())
+                           .reshape(-1).tolist()))
+        # positive axes are validated against the input rank by the
+        # builder paths (only the trailing/channel axis is representable)
+        lay._kerasAxis = int(ax_list[0])
+        return lay, "staticnorm", None
     if cls == "TimeDistributed":
         from deeplearning4j_tpu.nn.conf.recurrent import (
             TimeDistributed, TimeDistributedFlatten)
@@ -1017,6 +1063,10 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
             if cur_conv_shape is not None or cur_3d is not None or cur_rnn:
                 lay.activation = "softmax:1"   # channel-first feature axis
             kind = "activation"
+        if kind == "staticnorm":
+            rank = 4 if cur_conv_shape is not None else \
+                5 if cur_3d is not None else 3 if cur_rnn else 2
+            _check_norm_axis(lay, rank)
         if kind == "embedding" and getattr(lay, "inputLength", 0) < 0 \
                 and cur_ff:
             # a 1-D integer Input: its size IS the sequence length
@@ -1085,7 +1135,7 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         if kind == "dense":
             cur_ff = getattr(lay, "nOut", None)
         elif kind not in ("noise", "activation", "dropout", "ln", "bn",
-                          "prelu", "masking"):
+                          "prelu", "masking", "staticnorm"):
             cur_ff = None
         if kind == "reshape":
             cur_in = None
@@ -1191,8 +1241,9 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
     by the Sequential and ComputationGraph import paths (the reference's
     per-layer ``KerasLayer.setWeights`` — SURVEY §2.5)."""
     import jax.numpy as jnp
-    if p is None:
+    if p is None and s is None:
         return
+    p = {} if p is None else p
     if kind.startswith("td") and kind != "tdflatten":
         # TimeDistributed wrapper: params ARE the inner layer's params;
         # the keras h5 group likewise stores the inner layer's weights
@@ -1315,6 +1366,11 @@ def _load_layer_weights(p, s, kind, ws, kcfg, flatten_shape=None):
             .reshape(P, c * kh * kw, f_))
         if len(ws) > 1 and "b" in p:
             p["b"] = jnp.asarray(ws[1].reshape(P, f_))
+    elif kind == "staticnorm":
+        # keras Normalization weights: mean, variance[, count] — adapt()
+        # statistics, held as STATE (never trained)
+        s["mean"] = jnp.asarray(np.asarray(ws[0]).reshape(-1))
+        s["var"] = jnp.asarray(np.asarray(ws[1]).reshape(-1))
     elif kind == "lc1d":
         # keras (ot, k*c, f) patch order (k, c) -> ours (c, k)
         kern = ws[0]
@@ -1483,6 +1539,10 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                     or srcs[0] in vol:
                 lay.activation = "softmax:1"   # channel-first feature axis
             kind = "activation"
+        if kind == "staticnorm":
+            rank = 4 if shapes.get(srcs[0]) is not None else \
+                5 if srcs[0] in vol else 3 if srcs[0] in rnn else 2
+            _check_norm_axis(lay, rank)
         if kind == "mha":
             # keras calls MHA with (query, value[, key]); self-attention
             # repeats one source — the only form a single-input layer node

@@ -67,6 +67,7 @@ _REGISTRY: Dict[str, Callable] = {
     "softplus": jax.nn.softplus,
     "softsign": jax.nn.soft_sign,
     "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
     "mish": _mish,
     "cube": _cube,
     "thresholdedrelu": _threshrelu,
@@ -107,6 +108,7 @@ _PARAMETERIZED: Dict[str, Callable] = {
     # "softmax:1" = softmax over the channel/feature axis of (b, f, t) /
     # NCHW / NCDHW tensors (axis -1 would be time/width)
     "softmax": lambda ax: (lambda x: jax.nn.softmax(x, axis=int(ax))),
+    "clippedrelu": lambda m: (lambda x: jnp.clip(jax.nn.relu(x), 0.0, m)),
 }
 
 

@@ -23,7 +23,8 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
 from deeplearning4j_tpu.nn.weights import init_weight
 
-__all__ = ["MaskLayer", "MaskingLayer", "RepeatVector",
+__all__ = ["MaskLayer", "MaskingLayer", "RescaleLayer",
+           "StaticNormalizationLayer", "RepeatVector",
            "ElementWiseMultiplicationLayer",
            "Cropping1D", "ZeroPadding1DLayer", "OCNNOutputLayer",
            "LayerNormalization", "GaussianNoiseLayer",
@@ -73,6 +74,59 @@ class MaskingLayer(BaseLayer):
 
     def forward(self, params, x, train, key, state):
         return x, state
+
+
+@dataclasses.dataclass
+class RescaleLayer(BaseLayer):
+    """``x * scale + offset`` — keras preprocessing ``Rescaling`` (the
+    stock-architecture input scaler, e.g. EfficientNet's 1/255)."""
+    scale: float = 1.0
+    offset: float = 0.0
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def forward(self, params, x, train, key, state):
+        return x * self.scale + self.offset, state
+
+
+@dataclasses.dataclass
+class StaticNormalizationLayer(BaseLayer):
+    """Per-channel ``(x - mean) / sqrt(var)`` with fixed statistics held
+    in STATE, never trained — keras preprocessing ``Normalization``
+    (EfficientNet bakes ImageNet feature statistics this way).  ``mean``/
+    ``variance`` seed the state for constructor-supplied stats; keras
+    adapt()-time stats arrive via the weight store instead."""
+    nIn: int = 0
+    mean: Tuple[float, ...] = ()
+    variance: Tuple[float, ...] = ()
+
+    def __post_init__(self):
+        self.mean = tuple(float(v) for v in (self.mean or ()))
+        self.variance = tuple(float(v) for v in (self.variance or ()))
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = getattr(inputType, "channels", None) \
+                or inputType.size
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def initState(self, inputType, dtype=jnp.float32):
+        n = int(self.nIn)
+        mean = jnp.asarray(self.mean, dtype) if self.mean \
+            else jnp.zeros((n,), dtype)
+        var = jnp.asarray(self.variance, dtype) if self.variance \
+            else jnp.ones((n,), dtype)
+        return {"mean": jnp.broadcast_to(mean, (n,)),
+                "var": jnp.broadcast_to(var, (n,))}
+
+    def forward(self, params, x, train, key, state):
+        shape = (1, -1) + (1,) * (x.ndim - 2)   # channel-first broadcast
+        mean = state["mean"].reshape(shape)
+        var = state["var"].reshape(shape)
+        return (x - mean) / jnp.sqrt(jnp.maximum(var, 1e-12)), state
 
 
 @dataclasses.dataclass
@@ -432,7 +486,8 @@ class OCNNOutputLayer(BaseLayer):
         return jax.nn.relu(-output[:, 0]) / self.nu
 
 
-for _c in [MaskLayer, MaskingLayer, RepeatVector,
+for _c in [MaskLayer, MaskingLayer, RescaleLayer, StaticNormalizationLayer,
+           RepeatVector,
            ElementWiseMultiplicationLayer,
            Cropping1D, ZeroPadding1DLayer, OCNNOutputLayer,
            LayerNormalization, GaussianNoiseLayer, GaussianDropoutLayer,
