@@ -328,3 +328,121 @@ def _tf_cumulative(our):
 
 register_tf_op("Cumsum")(_tf_cumulative("cumsum"))
 register_tf_op("Cumprod")(_tf_cumulative("cumprod"))
+
+
+# ---- round-5 conv family additions ---------------------------------------
+from deeplearning4j_tpu.autodiff.samediff import register_op  # noqa: E402
+
+from deeplearning4j_tpu.imports.tf_import import TF_OPS  # noqa: E402
+
+TF_OPS["BatchMatMulV3"] = TF_OPS["BatchMatMulV2"]
+
+
+@register_tf_op("DepthwiseConv2dNative")
+def _tf_depthwise_conv2d(ctx, node):
+    x, w = _data_inputs(node)[:2]
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    fmt = _attr(node, "data_format", "NHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    dil = _attr(node, "dilations", [1, 1, 1, 1])
+    if fmt == "NHWC":
+        sH, sW, dH, dW = strides[1], strides[2], dil[1], dil[2]
+    else:
+        sH, sW, dH, dW = strides[2], strides[3], dil[2], dil[3]
+    pad = _attr(node, "padding", b"VALID")
+    pad = pad.decode() if isinstance(pad, bytes) else pad
+    if pad not in ("SAME", "VALID"):
+        raise NotImplementedError(
+            f"DepthwiseConv2dNative padding={pad!r} unsupported")
+    ctx.put(node.name, ctx.sd._op(
+        "tf_depthwiseConv2d", [ctx.get(x), ctx.get(w)],
+        {"sH": int(sH), "sW": int(sW), "dH": int(dH), "dW": int(dW),
+         "isSameMode": pad == "SAME", "dataFormat": fmt},
+        name=node.name))
+
+
+@register_op("tf_depthwiseConv2d")
+def _tf_depthwise2d_impl(sH=1, sW=1, dH=1, dW=1, isSameMode=False,
+                         dataFormat="NHWC", **_):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(x, w):
+        # TF kernel (kh, kw, c, m) -> grouped-OIHW (c*m, 1, kh, kw)
+        kh, kw, c, m = w.shape
+        wk = jnp.transpose(w, (2, 3, 0, 1)).reshape(c * m, 1, kh, kw)
+        if dataFormat == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        y = lax.conv_general_dilated(
+            x, wk, (int(sH), int(sW)),
+            "SAME" if isSameMode else "VALID",
+            rhs_dilation=(int(dH), int(dW)), feature_group_count=c,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if dataFormat == "NHWC":
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return y
+    return f
+
+
+@register_tf_op("Conv2DBackpropInput")
+def _tf_conv2d_backprop_input(ctx, node):
+    """The deconvolution/generator pattern: inputs are
+    (input_sizes, filter, out_backprop) — input_sizes must be constant."""
+    ins = _data_inputs(node)
+    sizes = [int(v) for v in np.atleast_1d(ctx.const(ins[0])).reshape(-1)]
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    if any(int(d) != 1 for d in _attr(node, "dilations", [1, 1, 1, 1])):
+        raise NotImplementedError(
+            "Conv2DBackpropInput dilations != 1 unsupported")
+    fmt = _attr(node, "data_format", "NHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    if fmt == "NHWC":
+        sH, sW = strides[1], strides[2]
+        oh, ow = sizes[1], sizes[2]
+    else:
+        sH, sW = strides[2], strides[3]
+        oh, ow = sizes[2], sizes[3]
+    pad = _attr(node, "padding", b"VALID")
+    pad = pad.decode() if isinstance(pad, bytes) else pad
+    if pad not in ("SAME", "VALID"):
+        raise NotImplementedError(
+            f"Conv2DBackpropInput padding={pad!r} unsupported")
+    ctx.put(node.name, ctx.sd._op(
+        "tf_conv2dBackpropInput", [ctx.get(ins[1]), ctx.get(ins[2])],
+        {"sH": int(sH), "sW": int(sW), "isSameMode": pad == "SAME",
+         "dataFormat": fmt, "oH": int(oh), "oW": int(ow)},
+        name=node.name))
+
+
+@register_op("tf_conv2dBackpropInput")
+def _tf_conv2d_backprop_input_impl(sH=1, sW=1, isSameMode=False,
+                                   dataFormat="NHWC", oH=0, oW=0, **_):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(w, dy):
+        # TF filter (kh, kw, in, out); dy carries OUT channels; the
+        # transposed conv contracts over out and emits IN channels
+        kh, kw = w.shape[0], w.shape[1]
+        wk = jnp.transpose(w, (2, 3, 0, 1))           # (in, out, kh, kw)
+        if dataFormat == "NHWC":
+            dy = jnp.transpose(dy, (0, 3, 1, 2))
+        ih, iw = dy.shape[2], dy.shape[3]
+        # out = (in-1)*s + 1 + lo + hi - (k-1) must equal oH/oW; the low
+        # pad comes from the forward conv's top pad (TF SAME: the smaller
+        # half, clamped at 0 — kernel < stride pads nothing), the high
+        # side absorbs the remainder
+        def grad_pads(k, s, i, o):
+            pt = max((i - 1) * s + k - o, 0) // 2 if isSameMode else 0
+            lo = k - 1 - pt
+            return (lo, (o + k - 2 - (i - 1) * s) - lo)
+        pads = [grad_pads(kh, int(sH), ih, int(oH)),
+                grad_pads(kw, int(sW), iw, int(oW))]
+        y = lax.conv_general_dilated(
+            dy, wk[:, :, ::-1, ::-1], (1, 1), pads,
+            lhs_dilation=(int(sH), int(sW)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if dataFormat == "NHWC":
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return y
+    return f

@@ -249,6 +249,52 @@ def _bmm_adj():
             {"a": _x(2, 3, 4, seed=30), "b": _x(2, 5, 4, seed=31)})
 
 
+@corpus("depthwise_conv2d_same")
+def _dwconv_same():
+    w = tf.Variable(_x(3, 3, 4, 2, seed=130, scale=0.3))
+    return (lambda x: tf.nn.depthwise_conv2d(
+        x, w, strides=[1, 2, 2, 1], padding="SAME"),
+        [_spec(2, 8, 8, 4)], {"x": _x(2, 8, 8, 4, seed=131)})
+
+
+@corpus("depthwise_conv2d_valid")
+def _dwconv_valid():
+    w = tf.Variable(_x(2, 2, 3, 1, seed=132, scale=0.3))
+    return (lambda x: tf.nn.depthwise_conv2d(
+        x, w, strides=[1, 1, 1, 1], padding="VALID"),
+        [_spec(2, 6, 6, 3)], {"x": _x(2, 6, 6, 3, seed=133)})
+
+
+@corpus("conv2d_transpose_same")
+def _deconv_same():
+    w = tf.Variable(_x(3, 3, 5, 4, seed=134, scale=0.3))   # (kh,kw,out,in)
+    return (lambda x: tf.nn.conv2d_transpose(
+        x, w, output_shape=[2, 8, 8, 5], strides=[1, 2, 2, 1],
+        padding="SAME"),
+        [_spec(2, 4, 4, 4)], {"x": _x(2, 4, 4, 4, seed=135)})
+
+
+@corpus("conv2d_transpose_valid")
+def _deconv_valid():
+    w = tf.Variable(_x(2, 2, 3, 4, seed=136, scale=0.3))
+    return (lambda x: tf.nn.conv2d_transpose(
+        x, w, output_shape=[2, 8, 8, 3], strides=[1, 2, 2, 1],
+        padding="VALID"),
+        [_spec(2, 4, 4, 4)], {"x": _x(2, 4, 4, 4, seed=137)})
+
+
+@corpus("conv2d_transpose_1x1_stride2")
+def _deconv_1x1():
+    """review r5: kernel < stride in SAME mode — the forward conv had NO
+    padding, so the grad-pad total must clamp at 0 (unclamped math
+    shifts every output pixel by one)."""
+    w = tf.Variable(_x(1, 1, 3, 4, seed=138, scale=0.5))
+    return (lambda x: tf.nn.conv2d_transpose(
+        x, w, output_shape=[2, 8, 8, 3], strides=[1, 2, 2, 1],
+        padding="SAME"),
+        [_spec(2, 4, 4, 4)], {"x": _x(2, 4, 4, 4, seed=139)})
+
+
 @corpus("bias_add_nhwc")
 def _bias():
     b = tf.Variable(_x(5, seed=32))
