@@ -40,13 +40,21 @@ def _cfg(layer: Dict) -> Dict:
 _ACT = {"relu": "relu", "softmax": "softmax", "sigmoid": "sigmoid",
         "tanh": "tanh", "linear": "identity", "elu": "elu", "selu": "selu",
         "softplus": "softplus", "softsign": "softsign", "swish": "swish",
-        "gelu": "gelu", "hard_sigmoid": "hardsigmoid",
+        "gelu": "gelu",
+        "hard_silu": "hardswish", "hard_swish": "hardswish",
         "leaky_relu": "leakyrelu", "relu6": "relu6", "exponential": "exp"}
+
+#: keras 2 defines hard_sigmoid as clip(0.2x+0.5) (the framework's native
+#: "hardsigmoid"); keras 3 redefined it as relu6(x+3)/6.  Set per import
+#: from the file's keras_version (h5 attr; ".keras" archives are keras 3).
+_KERAS2_SEMANTICS = False
 
 
 def _act(name: Optional[str]) -> str:
     if not name:
         return "identity"
+    if name == "hard_sigmoid":
+        return "hardsigmoid" if _KERAS2_SEMANTICS else "hardsigmoid6"
     return _ACT.get(name, name)
 
 
@@ -372,11 +380,38 @@ def _inbound_edges(layers_cfg: List[Dict]) -> Dict[str, List[str]]:
     return inbound
 
 
+def _inbound_scalars(layers_cfg: List[Dict]) -> Dict[str, List[Tuple[int,
+                                                                     float]]]:
+    """keras-3 functional configs can pass plain python scalars to merge
+    layers (``x + 3.0`` → Add with a literal in args).  Returns
+    layer name -> [(arg position, value)], so the importer can fold them
+    instead of silently dropping them."""
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    for lk in layers_cfg:
+        name = _cfg(lk).get("name", lk.get("name"))
+        lits: List[Tuple[int, float]] = []
+        for node in lk.get("inbound_nodes", []):
+            if not isinstance(node, dict):
+                continue
+            args = node.get("args", [])
+            flat = list(args[0]) if len(args) == 1 and \
+                isinstance(args[0], (list, tuple)) else list(args)
+            for i, a in enumerate(flat):
+                if isinstance(a, (int, float)) and not isinstance(a, bool):
+                    lits.append((i, float(a)))
+        if lits:
+            out[name] = lits
+    return out
+
+
 def _linearize_functional(layers_cfg: List[Dict]) -> Optional[List[Dict]]:
     """Order a Functional model's layers as a linear chain via inbound_nodes;
     returns None on branching topologies (those import as ComputationGraph)."""
     inbound = _inbound_edges(layers_cfg)
     if any(len(s) > 1 for s in inbound.values()):
+        return None
+    # scalar-operand merges (x + 3.0) only the graph path can fold
+    if any(lk["class_name"] in _MERGE_CLASSES for lk in layers_cfg):
         return None
     by_name = {_cfg(lk).get("name", lk.get("name")): lk for lk in layers_cfg}
     succ = {s[0]: n for n, s in inbound.items() if s}
@@ -432,7 +467,7 @@ _WEIGHTY = {"dense", "conv", "conv1d", "bn", "lstm", "bilstm", "embedding",
             "conv3d", "prelu", "deconv3d", "lc2d", "lc1d", "staticnorm"}
 #: kinds whose output stays in CNN format (conv-shape tracking continues)
 _CNN_KINDS = {"conv", "pool", "upsample", "zeropad", "crop", "sepconv",
-              "dwconv", "deconv", "lc2d"}
+              "dwconv", "deconv", "lc2d", "globalpoolkeep"}
 
 
 def _is_weighty(kind: str) -> bool:
@@ -601,6 +636,9 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         return lay, "pool", None
     if cls in ("GlobalMaxPooling1D", "GlobalAveragePooling1D"):
         from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        if cfg.get("keepdims"):
+            raise ValueError(f"Keras import: {cls} keepdims=True is "
+                             "unsupported on sequences")
         return (GlobalPoolingLayer(
             poolingType="MAX" if "Max" in cls else "AVG"),
             "globalpool", None)
@@ -680,9 +718,14 @@ def _map_keras_layer(cls: str, cfg: Dict, is_last: bool = False):
         return Cropping2D(cropping=crop), "crop", None
     if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
         from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
-        return (GlobalPoolingLayer(
-            poolingType="AVG" if "Average" in cls else "MAX"),
-            "globalpool", None)
+        pt = "AVG" if "Average" in cls else "MAX"
+        if cfg.get("keepdims"):
+            # keras keepdims == reference collapseDimensions=false: the
+            # (b, c, 1, 1) map feeds SE-style broadcast multiplies
+            return (GlobalPoolingLayer(poolingType=pt,
+                                       collapseDimensions=False),
+                    "globalpoolkeep", None)
+        return GlobalPoolingLayer(poolingType=pt), "globalpool", None
     if cls in ("SeparableConv2D", "DepthwiseConv2D"):
         from deeplearning4j_tpu.nn.conf.convolutional import (
             DepthwiseConvolution2D, SeparableConvolution2D)
@@ -1008,6 +1051,17 @@ def _build_sequential(layers_cfg, store, InputType, NeuralNetConfiguration,
         if cls == "InputLayer":
             continue
         if cls == "Flatten":
+            if cur_conv_shape is not None \
+                    and cur_conv_shape[0] * cur_conv_shape[1] == 1:
+                # (b, c, 1, 1) -> (b, c): a pure squeeze — safe for ANY
+                # consumer, no kernel-row permutation needed
+                from deeplearning4j_tpu.nn.conf.misc import ReshapeLayer
+                c = cur_conv_shape[2]
+                our_layers.append((ReshapeLayer(targetShape=(int(c),)),
+                                   None, "reshape"))
+                cur_conv_shape = None
+                cur_ff = int(c)
+                continue
             if cur_conv_shape is not None:
                 pending_flatten[len(our_layers)] = cur_conv_shape
                 continue
@@ -1407,6 +1461,7 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
     from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
 
     inbound = _inbound_edges(layers_cfg)
+    scalars = _inbound_scalars(layers_cfg)
     by_name: Dict[str, Dict] = {}
     for lk in layers_cfg:
         by_name[_cfg(lk).get("name", lk.get("name"))] = lk
@@ -1481,9 +1536,18 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                     vol.add(name)
             continue
         if cls == "Flatten":
+            s0 = shapes.get(srcs[0])
+            if s0 is not None and s0[0] * s0[1] == 1:
+                # (b, c, 1, 1) -> (b, c): a pure squeeze — safe for ANY
+                # consumer (no (h,w,c)-order permutation involved)
+                from deeplearning4j_tpu.nn.conf.misc import ReshapeLayer
+                gb.addLayer(name, ReshapeLayer(targetShape=(s0[2],)),
+                            srcs[0])
+                shapes[name] = None
+                continue
             alias[name] = srcs[0]
-            if shapes.get(srcs[0]) is not None:
-                flat_of[name] = shapes[srcs[0]]
+            if s0 is not None:
+                flat_of[name] = s0
             shapes[name] = None
             continue
         # Keras flattens (h, w, c)-order; our CnnToFF flattens (c, h, w).
@@ -1497,6 +1561,34 @@ def _build_graph(full_cfg: Dict, layers_cfg: List[Dict], store):
                     f"Keras import: {cls} over a Flatten of a conv map is "
                     "unsupported (keras (h,w,c) vs our (c,h,w) flatten "
                     "order would silently mis-order features)")
+            lits = scalars.get(name)
+            if lits:
+                # keras-3 scalar operands (x + 3.0, x * (1/6) — the
+                # MobileNetV3 hard-sigmoid pattern) fold into an affine
+                # layer; dropping them would silently change the model
+                from deeplearning4j_tpu.nn.conf.misc import RescaleLayer
+                vals = [v for _i, v in lits]
+                if len(srcs) != 1:
+                    raise ValueError(
+                        f"Keras import: {cls} mixing scalar and multiple "
+                        "tensor operands is unsupported")
+                if cls == "Add":
+                    lay = RescaleLayer(scale=1.0, offset=float(sum(vals)))
+                elif cls == "Multiply":
+                    lay = RescaleLayer(scale=float(np.prod(vals)))
+                elif cls == "Subtract" and lits[0][0] != 0:
+                    lay = RescaleLayer(scale=1.0, offset=-float(vals[0]))
+                else:
+                    raise ValueError(
+                        f"Keras import: {cls} with scalar operands "
+                        f"{vals} is unsupported")
+                gb.addLayer(name, lay, srcs[0])
+                shapes[name] = shapes.get(srcs[0])
+                if srcs[0] in rnn:
+                    rnn.add(name)
+                if srcs[0] in vol:
+                    vol.add(name)
+                continue
             op = _MERGE_CLASSES[cls]
             if op is None:
                 axis = cfg.get("axis", -1)

@@ -68,6 +68,10 @@ _REGISTRY: Dict[str, Callable] = {
     "softsign": jax.nn.soft_sign,
     "swish": jax.nn.silu,
     "silu": jax.nn.silu,
+    # keras/MobileNetV3 piecewise-linear family: relu6(x+3)/6-based
+    # ("hardsigmoid" above keeps the reference's 0.2x+0.5 definition)
+    "hardsigmoid6": lambda x: jax.nn.relu6(x + 3.0) / 6.0,
+    "hardswish": lambda x: x * jax.nn.relu6(x + 3.0) / 6.0,
     "mish": _mish,
     "cube": _cube,
     "thresholdedrelu": _threshrelu,

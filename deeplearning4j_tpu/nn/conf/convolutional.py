@@ -413,7 +413,11 @@ class Subsampling1DLayer(BaseLayer):
         else:
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
             if self.poolingType.upper() == PoolingType.AVG:
-                y = y / k
+                if p:   # border windows average over VALID cells only
+                    y = y / lax.reduce_window(jnp.ones_like(x), 0.0,
+                                              lax.add, dims, strides, pads)
+                else:
+                    y = y / k
         return y, state
 
 

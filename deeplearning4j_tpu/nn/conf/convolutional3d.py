@@ -150,7 +150,12 @@ class Subsampling3DLayer(BaseLayer):
                                   pads)
         else:
             y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
-            y = y / float(np.prod(self.kernelSize))
+            if same or any(self.padding):
+                # border windows average over VALID cells only
+                y = y / lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                          window, strides, pads)
+            else:
+                y = y / float(np.prod(self.kernelSize))
         return y, state
 
 

@@ -304,6 +304,10 @@ class SubsamplingLayer(BaseLayer):
     padding: Tuple[int, int] = (0, 0)
     convolutionMode: Optional[str] = None
     pnorm: int = 2
+    #: reference SubsamplingLayer.avgPoolIncludePadInDivisor — False
+    #: (default, matching keras/TF) divides border windows by the VALID
+    #: cell count only
+    avgPoolIncludePadInDivisor: bool = False
 
     def __post_init__(self):
         self.kernelSize = _pair(self.kernelSize)
@@ -345,7 +349,15 @@ class SubsamplingLayer(BaseLayer):
         elif pt == PoolingType.SUM:
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
         elif pt == PoolingType.AVG:
-            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads) / (kh * kw)
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            if self.avgPoolIncludePadInDivisor or \
+                    all(p == (0, 0) for p in pads):
+                y = y / (kh * kw)
+            else:
+                # border windows average over VALID cells only (XLA folds
+                # the count window into a constant tensor)
+                y = y / lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                          dims, strides, pads)
         elif pt == PoolingType.PNORM:
             p = float(self.pnorm)
             y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims,
@@ -545,12 +557,22 @@ class GlobalPoolingLayer(BaseLayer):
 
     def getOutputType(self, inputType):
         if inputType.kind == "CNN":
+            if not self.collapseDimensions:   # keep (b, c, 1, 1)
+                return InputType.convolutional(1, 1, inputType.channels)
             return InputType.feedForward(inputType.channels)
         if inputType.kind == "RNN":
+            if not self.collapseDimensions:   # keep (b, f, 1)
+                return InputType.recurrent(inputType.size, 1)
             return InputType.feedForward(inputType.size)
         return inputType
 
     def forward(self, params, x, train, key, state, mask=None):
+        if not self.collapseDimensions:
+            y, state = GlobalPoolingLayer(
+                poolingType=self.poolingType, pnorm=self.pnorm,
+                collapseDimensions=True).forward(params, x, train, key,
+                                                 state, mask=mask)
+            return y.reshape(y.shape + (1,) * (x.ndim - y.ndim)), state
         if x.ndim == 4:
             axes = (2, 3)
         elif x.ndim == 3:

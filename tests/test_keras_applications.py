@@ -50,6 +50,15 @@ def test_efficientnet_b0():
         weights=None, input_shape=(64, 64, 3), classes=10))
 
 
+def test_mobilenet_v3_small():
+    """keras-3 scalar merge operands (x+3, x*1/6 hard-sigmoid pattern),
+    GlobalAveragePooling2D(keepdims=True) SE blocks, hard_swish, and the
+    (b,c,1,1) squeeze-Flatten head."""
+    _parity(keras.applications.MobileNetV3Small(
+        weights=None, input_shape=(64, 64, 3), classes=10,
+        include_preprocessing=False))
+
+
 def test_normalization_constructor_stats():
     """review r5: constructor-supplied mean/variance live in the keras
     CONFIG (no weight variables) — they must seed the state."""

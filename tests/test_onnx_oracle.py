@@ -150,6 +150,61 @@ def test_new_rules_registered(name):
     assert name in _ONNX_OPS
 
 
+def test_round5_helper_op_coverage():
+    """Run the round-5 importer helper ops through SameDiff and record
+    their validation coverage (the 100% registered-op gate in
+    test_samediff_validation counts them)."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    from deeplearning4j_tpu.autodiff.validation import OpValidation
+
+    def run(op, ins_np, attrs, n_out=1):
+        sd = SameDiff.create()
+        ins = [sd.placeholder(f"i{k}") for k in range(len(ins_np))]
+        outs = sd._op(op, ins, attrs, n_out=n_out, name="o")
+        first = outs[0] if isinstance(outs, list) else outs
+        res = sd.output({f"i{k}": v for k, v in enumerate(ins_np)},
+                        first.name())
+        for node in sd._ops:
+            OpValidation.recordTested(node.op)
+        return np.asarray(res[first.name()].numpy())
+
+    rng = np.random.RandomState(0)
+    t, b, i, h = 3, 2, 4, 5
+    x = rng.randn(t, b, i).astype(np.float32)
+    y = run("onnx_lstm", [x, rng.randn(1, 4 * h, i).astype(np.float32),
+                          rng.randn(1, 4 * h, h).astype(np.float32)],
+            {"hidden": h, "direction": "forward"}, n_out=3)
+    assert y.shape == (t, 1, b, h)
+    y = run("onnx_gru", [x, rng.randn(1, 3 * h, i).astype(np.float32),
+                         rng.randn(1, 3 * h, h).astype(np.float32)],
+            {"hidden": h, "direction": "bidirectional",
+             "linear_before_reset": 1}, n_out=2)
+    assert y.shape == (t, 2, b, h)
+    y = run("onnx_rnn", [x, rng.randn(1, h, i).astype(np.float32),
+                         rng.randn(1, h, h).astype(np.float32)],
+            {"hidden": h, "direction": "reverse"}, n_out=2)
+    assert y.shape == (t, 1, b, h)
+    y = run("onnx_onehot", [np.array([1, 3])], {"depth": 4})
+    np.testing.assert_allclose(y, [[0, 1, 0, 0], [0, 0, 0, 1]])
+    y = run("onnx_shrink", [np.array([-2.0, 0.0, 2.0], np.float32)],
+            {"lambd": 1.0, "bias": 0.5})
+    np.testing.assert_allclose(y, [-1.5, 0.0, 1.5])
+    y = run("onnx_reshape0", [rng.randn(2, 3, 4).astype(np.float32)],
+            {"shape": (0, 12)})
+    assert y.shape == (2, 12)
+    xi = rng.randn(2, 6, 6, 3).astype(np.float32)
+    wk = rng.randn(2, 2, 3, 2).astype(np.float32)
+    y = run("tf_depthwiseConv2d", [xi, wk],
+            {"sH": 2, "sW": 2, "isSameMode": True, "dataFormat": "NHWC"})
+    assert y.shape == (2, 3, 3, 6)
+    dy = rng.randn(2, 3, 3, 4).astype(np.float32)
+    wd = rng.randn(2, 2, 5, 4).astype(np.float32)
+    y = run("tf_conv2dBackpropInput", [wd, dy],
+            {"sH": 2, "sW": 2, "isSameMode": True, "dataFormat": "NHWC",
+             "oH": 6, "oW": 6})
+    assert y.shape == (2, 6, 6, 5)
+
+
 def test_onehot_and_shrink_impls():
     from deeplearning4j_tpu.imports.onnx_import_ext3 import (
         _onnx_onehot_impl, _onnx_shrink_impl)
