@@ -11,19 +11,51 @@ in (the MNIST iterator set this pattern — check ``isSynthetic``).
 """
 from __future__ import annotations
 
+import logging
 import os
+import time
 from pathlib import Path
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 
+log = logging.getLogger(__name__)
+
 
 def _data_dir() -> Optional[Path]:
     d = os.environ.get("DL4J_TPU_DATA_DIR")
     return Path(d) if d else None
+
+
+def _fetch_with_retries(what: str, loader: Callable[[], Optional[Tuple]],
+                        attempts: int = 3, baseDelay: float = 0.02,
+                        maxDelay: float = 0.1) -> Optional[Tuple]:
+    """Bounded-retry wrapper around a real-data loader.
+
+    A flaky disk/NFS/object-store read gets ``attempts`` tries with a short
+    exponential backoff; when they all fail the fetcher falls back to the
+    synthetic set with a logged warning instead of raising mid-iteration —
+    a training job must not die because a MIRROR of public data hiccuped.
+    The :mod:`deeplearning4j_tpu.fault.injection` harness hooks in here
+    (``check_fetch_fault``) so the retry/fallback path is deterministic
+    under test.
+    """
+    from deeplearning4j_tpu.fault.injection import check_fetch_fault
+    for attempt in range(attempts):
+        try:
+            check_fetch_fault(what)
+            return loader()
+        except Exception as e:
+            log.warning("%s: real-data load failed (attempt %d/%d): %s: %s",
+                        what, attempt + 1, attempts, type(e).__name__, e)
+            if attempt + 1 < attempts:
+                time.sleep(min(baseDelay * (2 ** attempt), maxDelay))
+    log.warning("%s: real-data load failed after %d attempts; "
+                "falling back to the synthetic set", what, attempts)
+    return None
 
 
 class _ArrayIterator(DataSetIterator):
@@ -76,7 +108,8 @@ class Cifar10DataSetIterator(_ArrayIterator):
 
     def __init__(self, batchSize: int, train: bool = True, seed: int = 123,
                  numExamples: int = 10000):
-        data = self._load_real(train, numExamples)
+        data = _fetch_with_retries(
+            "cifar10", lambda: self._load_real(train, numExamples))
         self.isSynthetic = data is None
         if data is None:
             x, y = _synthetic_images(numExamples, 3, 32, 32, 10, seed)
@@ -118,7 +151,9 @@ class EmnistDataSetIterator(_ArrayIterator):
                  seed: int = 123, numExamples: int = 10000):
         self.dataSetName = dataSet.upper()
         classes = self.SETS[self.dataSetName]
-        data = self._load_real(self.dataSetName, train, numExamples)
+        data = _fetch_with_retries(
+            "emnist", lambda: self._load_real(self.dataSetName, train,
+                                              numExamples))
         self.isSynthetic = data is None
         if data is None:
             x, y = _synthetic_images(numExamples, 1, 28, 28, classes, seed)

@@ -1,0 +1,13 @@
+"""Fault tolerance: training supervisor + deterministic fault injection.
+
+See :mod:`deeplearning4j_tpu.fault.supervisor` for the recovery semantics
+and :mod:`deeplearning4j_tpu.fault.injection` for the test harness that
+exercises every path (NaN at step k, simulated preemption, checkpoint
+corruption, device OOM, slow/failing data fetches).
+"""
+from deeplearning4j_tpu.fault.injection import (  # noqa: F401
+    CorruptCheckpointAtStep, FailingFetch, Fault, FaultInjector, InjectedOOM,
+    NaNAtStep, OOMAtStep, PreemptAtStep, SimulatedPreemption, SlowFetch,
+    clear_injector, corrupt_checkpoint, get_injector, inject, set_injector)
+from deeplearning4j_tpu.fault.supervisor import (  # noqa: F401
+    FaultTolerantTrainer, TrainingDivergedError, is_oom_error)

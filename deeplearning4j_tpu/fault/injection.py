@@ -1,0 +1,260 @@
+"""Deterministic fault injection for the training supervisor.
+
+Every recovery path in :mod:`deeplearning4j_tpu.fault.supervisor` is
+exercised by tests through this harness, not just claimed: faults fire at
+exact step numbers (or attempt counts), never at random, so a failing
+recovery test replays bit-for-bit.
+
+Injection sites:
+
+- ``before_step`` — consulted by :class:`FaultTolerantTrainer` right before
+  each train step.  A fault may poison the batch (:class:`NaNAtStep`),
+  raise a process-fatal :class:`SimulatedPreemption` (:class:`PreemptAtStep`)
+  or a device-OOM-shaped :class:`InjectedOOM` (:class:`OOMAtStep`).
+- ``after_checkpoint`` — fired with the just-written step directory;
+  :class:`CorruptCheckpointAtStep` flips bytes in the newest checkpoint so
+  the checksum-manifest fallback path is exercised.
+- ``fetch`` — consulted by the dataset fetchers' bounded-retry loader
+  (:class:`FailingFetch`, :class:`SlowFetch`).
+
+Activate with the :func:`inject` context manager (or ``set_injector``)::
+
+    with inject(NaNAtStep(5), PreemptAtStep(12)):
+        trainer.fit(iterator, epochs=2)
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = [
+    "SimulatedPreemption", "InjectedOOM", "Fault", "NaNAtStep",
+    "PreemptAtStep", "OOMAtStep", "CorruptCheckpointAtStep", "FailingFetch",
+    "SlowFetch", "FaultInjector", "set_injector", "get_injector",
+    "clear_injector", "inject", "corrupt_checkpoint",
+]
+
+
+class SimulatedPreemption(BaseException):
+    """Process-fatal by design: derives from BaseException so no recovery
+    layer (``except Exception``) can accidentally swallow it — exactly like
+    a real SIGKILL'd preemption, the only thing that survives is what the
+    checkpointer already put on disk."""
+
+
+class InjectedOOM(RuntimeError):
+    """Shaped like XLA's device-OOM error so the supervisor's matcher
+    (``RESOURCE_EXHAUSTED``) treats it exactly like the real thing."""
+
+    def __init__(self, note: str = "injected"):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            f"device buffer ({note})")
+
+
+class Fault:
+    """Base fault: subclasses override the site hooks they participate in."""
+
+    def before_step(self, step: int, net, ds):
+        """May return a replacement DataSet (None = leave unchanged) or
+        raise.  ``step`` is the net's iteration count BEFORE the step."""
+        return None
+
+    def after_checkpoint(self, step: int, step_path: str) -> None:
+        pass
+
+    def on_fetch(self, what: str) -> None:
+        pass
+
+
+class NaNAtStep(Fault):
+    """Poison the features of the batch entering step ``step`` with NaN —
+    the loss (and, untreated, the params) go NaN that step.
+
+    One-shot by default (``times=1``): the retry after rollback sees the
+    clean batch again and recovers.  ``step=None`` fires at every step and
+    ``times=None`` never exhausts — together they model a PERSISTENT
+    divergence no backoff can fix (the supervisor must eventually raise
+    ``TrainingDivergedError`` instead of looping forever)."""
+
+    def __init__(self, step: Optional[int] = None, times: Optional[int] = 1):
+        self.step = None if step is None else int(step)
+        self.times = times
+
+    def before_step(self, step, net, ds):
+        if self.step is not None and step != self.step:
+            return None
+        if self.times is not None:
+            if self.times <= 0:
+                return None
+            self.times -= 1
+        f = np.array(ds.features.numpy(), copy=True)
+        f.reshape(-1)[0] = np.nan
+        cls = type(ds)
+        return cls(f, ds.labels, ds.featuresMask, ds.labelsMask)
+
+
+class PreemptAtStep(Fault):
+    """Simulate preemption right before step ``step``: raises
+    :class:`SimulatedPreemption`, which nothing below the test harness
+    catches."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+
+    def before_step(self, step, net, ds):
+        if step == self.step:
+            raise SimulatedPreemption(f"preempted before step {step}")
+
+
+class OOMAtStep(Fault):
+    """Raise a device-OOM-shaped error for the first ``times`` attempts at
+    step ``step`` — the supervisor responds by splitting the micro-batch."""
+
+    def __init__(self, step: int, times: int = 1):
+        self.step = int(step)
+        self.times = int(times)
+
+    def before_step(self, step, net, ds):
+        if step == self.step and self.times > 0:
+            self.times -= 1
+            raise InjectedOOM(f"step {step}")
+
+
+class CorruptCheckpointAtStep(Fault):
+    """Corrupt the checkpoint written for step ``step`` right after the
+    manifest is sealed — restore must detect the checksum mismatch and fall
+    back to the previous valid step."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+
+    def after_checkpoint(self, step, step_path):
+        if step == self.step:
+            _corrupt_tree(step_path)
+
+
+class FailingFetch(Fault):
+    """Fail the first ``times`` real-data fetch attempts for dataset
+    ``what`` (None = any) — exercises the fetchers' bounded retry and
+    synthetic fallback."""
+
+    def __init__(self, what: Optional[str] = None, times: int = 2,
+                 exc: type = ConnectionError):
+        self.what = what
+        self.times = int(times)
+        self.exc = exc
+        self.attempts = 0
+
+    def on_fetch(self, what):
+        if self.what is not None and what != self.what:
+            return
+        self.attempts += 1
+        if self.times > 0:
+            self.times -= 1
+            raise self.exc(f"injected fetch failure for {what}")
+
+
+class SlowFetch(Fault):
+    """Delay each fetch attempt by ``delay`` seconds (keep it well under
+    100ms in tests) — a slow-network stand-in that must NOT fail the run."""
+
+    def __init__(self, what: Optional[str] = None, delay: float = 0.05):
+        self.what = what
+        self.delay = float(delay)
+
+    def on_fetch(self, what):
+        if self.what is None or what == self.what:
+            time.sleep(self.delay)
+
+
+class FaultInjector:
+    """An ordered collection of faults consulted at each injection site."""
+
+    def __init__(self, *faults: Fault):
+        self.faults: List[Fault] = list(faults)
+
+    def add(self, fault: Fault) -> "FaultInjector":
+        self.faults.append(fault)
+        return self
+
+    def before_step(self, step: int, net, ds):
+        for f in self.faults:
+            out = f.before_step(step, net, ds)
+            if out is not None:
+                ds = out
+        return ds
+
+    def after_checkpoint(self, step: int, step_path: str) -> None:
+        for f in self.faults:
+            f.after_checkpoint(step, step_path)
+
+    def on_fetch(self, what: str) -> None:
+        for f in self.faults:
+            f.on_fetch(what)
+
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def set_injector(injector: Optional[FaultInjector]) -> None:
+    global _ACTIVE
+    _ACTIVE = injector
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def clear_injector() -> None:
+    set_injector(None)
+
+
+@contextlib.contextmanager
+def inject(*faults: Fault):
+    """Activate an injector for the duration of a with-block."""
+    prev = get_injector()
+    set_injector(FaultInjector(*faults))
+    try:
+        yield get_injector()
+    finally:
+        set_injector(prev)
+
+
+def check_fetch_fault(what: str) -> None:
+    """Injection point for the dataset fetchers (no-op without an active
+    injector)."""
+    inj = get_injector()
+    if inj is not None:
+        inj.on_fetch(what)
+
+
+def _corrupt_tree(path: str) -> None:
+    """Flip bytes in the middle of the largest file under ``path`` (size
+    preserved — corruption a length check would NOT catch, only a
+    checksum will)."""
+    largest, size = None, -1
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            fp = os.path.join(root, fn)
+            s = os.path.getsize(fp)
+            if s > size:
+                largest, size = fp, s
+    if largest is None or size == 0:
+        raise FileNotFoundError(f"nothing to corrupt under {path}")
+    with open(largest, "r+b") as fh:
+        fh.seek(size // 2)
+        chunk = fh.read(min(64, size - size // 2))
+        fh.seek(size // 2)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def corrupt_checkpoint(directory: str, step: int) -> None:
+    """Corrupt the on-disk checkpoint for ``step`` under a
+    :class:`~deeplearning4j_tpu.utils.sharded_checkpoint.ShardedCheckpointer`
+    directory (test hook for the checksum-fallback path)."""
+    _corrupt_tree(os.path.join(os.path.abspath(directory), str(step)))

@@ -1,0 +1,294 @@
+"""Fault-tolerant training supervisor.
+
+At pod scale, preemption, NaN blow-ups, corrupt checkpoints and device OOM
+are routine (SURVEY.md §5.4 — the reference's multi-slice failure story is
+"checkpoint-restore by step number").  :class:`FaultTolerantTrainer` wraps
+``MultiLayerNetwork``/``ComputationGraph`` (or a ``ParallelWrapper`` around
+one) and makes ``fit`` survive the failures we can enumerate:
+
+- **atomic checkpointing** — every ``checkpointEveryN`` steps through
+  :class:`~deeplearning4j_tpu.utils.sharded_checkpoint.ShardedCheckpointer`
+  with a sha256 manifest sealed only after the write is durable; restore
+  skips a corrupt newest step and falls back to the last sealed one.
+- **divergence sentinel** — the per-step loss is synced and checked for
+  NaN/Inf (and an optional ceiling); on divergence the model rolls back to
+  the last good checkpoint with learning-rate backoff and retries (the
+  reference's ``InvalidStepException`` semantics, upgraded from
+  abort-the-step to rewind-and-anneal).
+- **crash/preemption auto-resume** — re-running the same entrypoint picks
+  up from the latest valid step: params/opt-state/counters AND the training
+  RNG key + TBPTT carries come back from the checkpoint tree, the
+  within-epoch position and LR backoff from the manifest metadata.
+- **OOM step retry** — a step that dies with ``RESOURCE_EXHAUSTED`` is
+  retried as micro-batches (recursive halving up to
+  ``maxMicroBatchSplits``), with step counters kept consistent.
+
+Every path is exercised deterministically through
+:mod:`deeplearning4j_tpu.fault.injection` (see tests/test_fault_tolerance.py).
+
+Usage::
+
+    trainer = FaultTolerantTrainer(net, "/ckpts/run1", checkpointEveryN=50)
+    trainer.fit(iterator, epochs=10)    # re-run after a kill: auto-resumes
+
+Not covered (ROADMAP "Open items"): elastic re-mesh on permanent device
+loss — a dead chip still needs an operator/scheduler to replace the slice;
+we only guarantee the restarted job resumes losslessly.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer
+
+__all__ = ["FaultTolerantTrainer", "TrainingDivergedError", "is_oom_error"]
+
+log = logging.getLogger(__name__)
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised when rollback + LR backoff could not restore a finite loss
+    within ``maxRollbacks`` attempts."""
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Device out-of-memory, by shape: XLA surfaces it as RESOURCE_EXHAUSTED
+    (jaxlib XlaRuntimeError has no stable class hierarchy to catch)."""
+    msg = f"{type(e).__name__}: {e}"
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def _split_dataset(ds):
+    """Halve a DataSet/MultiDataSet along the batch axis (micro-batch OOM
+    retry).  Returns a list of two smaller batches."""
+    import numpy as np
+
+    def half(arr, lo, hi):
+        if arr is None:
+            return None
+        return np.asarray(arr.numpy())[lo:hi]
+
+    if hasattr(ds, "features") and not isinstance(ds.features, (tuple, list)):
+        n = ds.features.shape[0]
+        mid = n // 2
+        cls = type(ds)
+        return [cls(half(ds.features, lo, hi), half(ds.labels, lo, hi),
+                    half(ds.featuresMask, lo, hi),
+                    half(ds.labelsMask, lo, hi))
+                for lo, hi in ((0, mid), (mid, n))]
+    # MultiDataSet: tuples of features/labels (+ per-array masks)
+    n = ds.features[0].shape[0]
+    mid = n // 2
+    cls = type(ds)
+
+    def halves(arrs, lo, hi):
+        if not arrs:
+            return None
+        return tuple(half(a, lo, hi) if a is not None else None
+                     for a in arrs)
+
+    return [cls(halves(ds.features, lo, hi), halves(ds.labels, lo, hi),
+                halves(getattr(ds, "featuresMasks", None) or (), lo, hi),
+                halves(getattr(ds, "labelsMasks", None) or (), lo, hi))
+            for lo, hi in ((0, mid), (mid, n))]
+
+
+class FaultTolerantTrainer:
+    """Supervised training loop with checkpoint/rollback/resume semantics.
+
+    ``model`` is a MultiLayerNetwork, ComputationGraph, or ParallelWrapper
+    (anything exposing ``.model`` is unwrapped for counters/checkpointing
+    while its own per-batch fit path is used for the actual step).
+    """
+
+    def __init__(self, model, checkpointDir: str, *,
+                 checkpointEveryN: int = 25, keepLast: int = 3,
+                 lrBackoff: float = 0.5, maxRollbacks: int = 3,
+                 divergenceThreshold: Optional[float] = None,
+                 maxMicroBatchSplits: int = 2, resume: bool = True,
+                 injector: Optional["_inj.FaultInjector"] = None):
+        self.wrapper = model if hasattr(model, "model") else None
+        self.net = model.model if self.wrapper is not None else model
+        self.ckpt = ShardedCheckpointer(checkpointDir, keepLast=keepLast)
+        self.checkpointEveryN = max(1, int(checkpointEveryN))
+        self.lrBackoff = float(lrBackoff)
+        self.maxRollbacks = int(maxRollbacks)
+        self.divergenceThreshold = divergenceThreshold
+        self.maxMicroBatchSplits = int(maxMicroBatchSplits)
+        self.resume = bool(resume)
+        self._injector = injector
+        self.lastLoss: Optional[float] = None
+        self.stats: Dict[str, Any] = {"rollbacks": 0, "oomSplits": 0,
+                                      "resumedFromStep": None,
+                                      "checkpoints": 0}
+
+    # -- injection ------------------------------------------------------
+    @property
+    def injector(self) -> Optional["_inj.FaultInjector"]:
+        return self._injector or _inj.get_injector()
+
+    # -- checkpointing --------------------------------------------------
+    def _lrScale(self) -> float:
+        return float(getattr(self.net, "_lrScale", 1.0))
+
+    def _checkpoint(self, stepInEpoch: int) -> None:
+        step = self.ckpt.saveWithManifest(
+            self.net, metadata={"stepInEpoch": int(stepInEpoch),
+                                "epoch": int(self.net.epochCount),
+                                "lrScale": self._lrScale()})
+        self.stats["checkpoints"] += 1
+        inj = self.injector
+        if inj is not None:
+            inj.after_checkpoint(step, self.ckpt.stepPath(step))
+
+    def _restoreLastGood(self) -> int:
+        step = self.ckpt.latestValidStep()
+        if step is None:
+            raise TrainingDivergedError(
+                "divergence before any checkpoint existed — nothing to "
+                "roll back to")
+        self.ckpt.restore(self.net, step=step)
+        return step
+
+    # -- the supervised loop --------------------------------------------
+    def fit(self, iterator, epochs: int = 1) -> None:
+        net = self.net
+        if net.params_ is None:
+            net.init()
+        skip = 0
+        step = None
+        if self.resume:
+            step = self.ckpt.restoreLatestValid(net)
+            if step is not None:
+                meta = self.ckpt.readMetadata(step)
+                skip = int(meta.get("stepInEpoch", 0))
+                if hasattr(net, "setLrScale"):
+                    net.setLrScale(float(meta.get("lrScale", 1.0)))
+                self.stats["resumedFromStep"] = step
+                log.info("resumed from checkpoint step %d "
+                         "(epoch %d, stepInEpoch %d)", step,
+                         net.epochCount, skip)
+        else:
+            stale = self.ckpt.allSteps()
+            if stale:
+                # a fresh start must not keep another run's steps around:
+                # the first rollback would restore THAT run's params
+                log.warning("resume=False: clearing %d stale checkpoint "
+                            "step(s) in %s", len(stale),
+                            self.ckpt.directory)
+                self.ckpt.clear()
+        if step is None:
+            # guarantee a rollback target before the first optimizer step
+            self._checkpoint(stepInEpoch=0)
+        while net.epochCount < int(epochs):
+            for l in net.getListeners():
+                l.onEpochStart(net)
+            iterator.reset()
+            stepInEpoch = 0
+            while iterator.hasNext():
+                ds = iterator.next()
+                if skip > 0:
+                    # fast-forward a mid-epoch resume to the stored
+                    # position (counters/RNG came from the checkpoint,
+                    # the data stream must line up with them)
+                    skip -= 1
+                    stepInEpoch += 1
+                    continue
+                self._superviseStep(ds)
+                stepInEpoch += 1
+                if net.iterationCount % self.checkpointEveryN == 0:
+                    self._checkpoint(stepInEpoch)
+            skip = 0
+            net.epochCount += 1
+            for l in net.getListeners():
+                l.onEpochEnd(net)
+        self._checkpoint(stepInEpoch=0)
+        self.ckpt.waitUntilFinished()
+
+    # -- one supervised step --------------------------------------------
+    def _superviseStep(self, ds) -> None:
+        net = self.net
+        rollbacks = 0
+        while True:
+            diverged = None
+            try:
+                self._stepOnce(ds)
+                loss = float(net.score())
+                if math.isnan(loss) or math.isinf(loss):
+                    diverged = f"non-finite loss {loss}"
+                elif self.divergenceThreshold is not None \
+                        and loss > self.divergenceThreshold:
+                    diverged = (f"loss {loss} above divergence threshold "
+                                f"{self.divergenceThreshold}")
+            except FloatingPointError as e:
+                diverged = f"NAN/INF panic: {e}"     # profiler panic mode
+            except Exception as e:
+                from deeplearning4j_tpu.optimize.solvers import \
+                    InvalidStepException
+                if not isinstance(e, InvalidStepException):
+                    raise
+                diverged = f"solver: {e}"
+            if diverged is None:
+                self.lastLoss = loss
+                return
+            rollbacks += 1
+            self.stats["rollbacks"] += 1
+            if rollbacks > self.maxRollbacks:
+                raise TrainingDivergedError(
+                    f"still diverging after {self.maxRollbacks} rollbacks "
+                    f"({diverged})")
+            epoch_now = net.epochCount
+            step = self._restoreLastGood()
+            # rollback rewinds the STEP counter/params/opt-state, not the
+            # epoch loop position: the iterator hasn't moved, so a restore
+            # from a previous epoch's checkpoint must not make the epoch
+            # loop re-run a whole extra epoch
+            net.epochCount = epoch_now
+            if hasattr(net, "setLrScale"):
+                net.setLrScale(self._lrScale() * self.lrBackoff)
+            log.warning(
+                "divergence (%s): rolled back to checkpoint step %d, "
+                "lrScale now %.4g (rollback %d/%d)", diverged, step,
+                self._lrScale(), rollbacks, self.maxRollbacks)
+
+    def _stepOnce(self, ds, depth: int = 0) -> None:
+        """One train step with OOM micro-batch retry.  Injection happens
+        inside the try so an injected OOM takes the same split path a real
+        RESOURCE_EXHAUSTED would."""
+        net = self.net
+        it0 = net.iterationCount
+        try:
+            inj = self.injector
+            if inj is not None:
+                ds = inj.before_step(it0, net, ds)
+            self._fitOne(ds)
+        except Exception as e:
+            if not is_oom_error(e) or depth >= self.maxMicroBatchSplits \
+                    or ds.numExamples() < 2:
+                raise
+            self.stats["oomSplits"] += 1
+            log.warning(
+                "device OOM at step %d (%s); retrying as %d-example "
+                "micro-batches", it0, type(e).__name__,
+                ds.numExamples() // 2)
+            for half in _split_dataset(ds):
+                # every micro-batch updates at the SAME schedule position:
+                # without the reset, half 2 would consume iteration it0+1
+                # and the next real batch would repeat it (double-stepping
+                # any iteration-keyed LR schedule)
+                net.iterationCount = it0
+                self._stepOnce(half, depth + 1)
+            # the outside world saw ONE logical step
+            net.iterationCount = it0 + 1
+
+    def _fitOne(self, ds) -> None:
+        if self.wrapper is not None:
+            self.wrapper.fitDataSet(ds)
+        else:
+            self.net.fit(ds)
+
+    def close(self) -> None:
+        self.ckpt.close()

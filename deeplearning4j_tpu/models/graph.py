@@ -55,9 +55,18 @@ class ComputationGraph:
             if dt in ("BFLOAT16", "HALF", "FLOAT16") else jnp.float32
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x6EED)
         self._batchSharding = None  # set by ParallelWrapper (DP over mesh)
+        self._lrScale = 1.0  # FaultTolerantTrainer's divergence backoff
         self._lossNodes = [n for n in conf.outputs
                            if isinstance(conf.nodes[n][0], Layer)
                            and conf.nodes[n][0].hasLoss()]
+
+    def setLrScale(self, scale: float) -> None:
+        """See MultiLayerNetwork.setLrScale — the fault supervisor's
+        rollback backoff; traced data, changing it never retraces."""
+        self._lrScale = float(scale)
+
+    def getLrScale(self) -> float:
+        return self._lrScale
 
     # ------------------------------------------------------------------
     def init(self, params: Optional[Dict] = None) -> "ComputationGraph":
@@ -235,14 +244,14 @@ class ComputationGraph:
     @functools.cached_property
     def _trainStep(self):
         def step(params, optState, state, inputs, labels, masks, key,
-                 iteration, epoch, fmask, carries):
+                 iteration, epoch, fmask, carries, lrScale):
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
             (loss, (new_state, data_loss, new_carries)), grads = grad_fn(
                 params, state, inputs, labels, masks, key, fmask, carries)
             new_params, new_opt = _apply_updates(
                 ((name, self.conf.nodes[name][0]) for name in params),
                 self.conf.globalConf, params, grads, optState, iteration,
-                epoch)
+                epoch, lrScale=lrScale)
             return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -337,7 +346,8 @@ class ComputationGraph:
          new_carries) = self._trainStep(
             self.params_, self.optState_, self.state_, inputs, labels, masks,
             key, jnp.asarray(self.iterationCount),
-            jnp.asarray(self.epochCount), fmask, carries)
+            jnp.asarray(self.epochCount), fmask, carries,
+            jnp.asarray(self._lrScale, jnp.float32))
         if new_state:
             self.state_.update(new_state)
         # Async device scalar; score() materializes lazily (see multilayer).
@@ -501,6 +511,16 @@ class ComputationGraph:
         if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
             listeners = tuple(listeners[0])
         self._listeners = list(listeners)
+
+    def addListeners(self, *listeners) -> None:
+        self._listeners.extend(listeners)
+
+    def getListeners(self) -> List:
+        return self._listeners
+
+    def removeListener(self, listener) -> None:
+        if listener in self._listeners:
+            self._listeners.remove(listener)
 
     def params(self) -> NDArray:
         chunks = []

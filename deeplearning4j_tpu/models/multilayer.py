@@ -121,7 +121,7 @@ def _updater_for(globalConf, layer, pname: str):
 
 
 def _apply_updates(units, globalConf, params, grads, optState, iteration,
-                   epoch):
+                   epoch, lrScale=None):
     """Apply updaters over all trainable leaves (per-leaf math).
 
     ``units`` is an iterable of ``(key, layer)`` — MLN layer indices or
@@ -161,6 +161,10 @@ def _apply_updates(units, globalConf, params, grads, optState, iteration,
             wd = getattr(layer, "weightDecay", None)
             if wd and pname in layer.weightParamKeys():
                 update = WeightDecay(coeff=wd).apply(pval, update, lr)
+            if lrScale is not None:
+                # global LR multiplier (fault supervisor's rollback
+                # backoff) — traced data, so changing it never recompiles
+                update = update * lrScale
             _set_leaf(new_params[key], path, pval - update)
             new_opt[key][path] = ostate
     return new_params, new_opt
@@ -208,6 +212,17 @@ class MultiLayerNetwork:
         self._fitKey = jax.random.PRNGKey(self._rngSeed ^ 0x5EED)
         self._rnnCarries = None  # rnnTimeStep stateMap (per RNN layer idx)
         self._batchSharding = None  # set by ParallelWrapper (DP over mesh)
+        self._lrScale = 1.0  # FaultTolerantTrainer's divergence backoff
+
+    def setLrScale(self, scale: float) -> None:
+        """Global multiplier on every updater's step size (the fault
+        supervisor's rollback backoff knob).  Enters the compiled step as
+        traced data — changing it does NOT retrace.  No effect on the
+        legacy line-search solvers (they pick their own step length)."""
+        self._lrScale = float(scale)
+
+    def getLrScale(self) -> float:
+        return self._lrScale
 
     def setBatchSharding(self, sharding) -> None:
         """Shard incoming batches over a device mesh: batch arrays are
@@ -366,14 +381,14 @@ class MultiLayerNetwork:
         layers = self.conf.layers
 
         def step(params, optState, state, x, y, fmask, lmask, key,
-                 iteration, epoch, carries):
+                 iteration, epoch, carries, lrScale):
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
             (loss, (new_state, new_carries, data_loss)), grads = grad_fn(
                 params, state, x, y, fmask, lmask, key, carries)
             new_params, new_opt = _apply_updates(
                 ((str(i), layer) for i, layer in enumerate(layers)),
                 self.conf.globalConf, params, grads, optState, iteration,
-                epoch)
+                epoch, lrScale=lrScale)
             return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -503,7 +518,8 @@ class MultiLayerNetwork:
          new_carries) = self._trainStep(
             self.params_, self.optState_, self.state_, x, y, fmask, lmask,
             key, jnp.asarray(self.iterationCount),
-            jnp.asarray(self.epochCount), carries)
+            jnp.asarray(self.epochCount), carries,
+            jnp.asarray(self._lrScale, jnp.float32))
         if new_state:
             self.state_.update(new_state)
         # Keep the loss as an async device scalar: syncing it here would

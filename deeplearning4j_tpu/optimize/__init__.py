@@ -7,6 +7,8 @@ from deeplearning4j_tpu.optimize.earlystopping import (  # noqa: F401
     BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
     DataSetLossCalculator, EarlyStoppingConfiguration,
     EarlyStoppingGraphTrainer, EarlyStoppingResult, EarlyStoppingTrainer,
-    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
     MaxScoreIterationTerminationCondition, MaxTimeIterationTerminationCondition,
     ScoreImprovementEpochTerminationCondition, TerminationReason)
+from deeplearning4j_tpu.optimize.solvers import InvalidStepException  # noqa: F401,E501

@@ -124,6 +124,21 @@ class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
         return f"MaxScoreIterationTerminationCondition({self.maxScore})"
 
 
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Terminate on NaN/Inf minibatch score (reference:
+    ``termination/InvalidScoreIterationTerminationCondition.java``).
+    Always part of the trainer's default checks — a diverged run burning
+    the rest of its epoch budget on NaN steps helps nobody."""
+
+    def terminate(self, lastMiniBatchScore):
+        import math
+        return math.isnan(lastMiniBatchScore) or \
+            math.isinf(lastMiniBatchScore)
+
+    def __str__(self):
+        return "InvalidScoreIterationTerminationCondition()"
+
+
 # ------------------------------------------------------ score calculators ----
 
 class ScoreCalculator:
@@ -341,7 +356,13 @@ class EarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.esConfig
-        for c in cfg.epochConds + cfg.iterConds:
+        iterConds = list(cfg.iterConds)
+        if not any(isinstance(c, InvalidScoreIterationTerminationCondition)
+                   for c in iterConds):
+            # default check (reference parity): a NaN/Inf minibatch score
+            # always terminates, whether or not the user listed conditions
+            iterConds.append(InvalidScoreIterationTerminationCondition())
+        for c in cfg.epochConds + iterConds:
             c.initialize()
         calc = cfg.scoreCalculator
         minimize = calc.minimizeScore if calc else True
@@ -358,7 +379,7 @@ class EarlyStoppingTrainer:
 
             def iterationDone(self, model, iteration, ep):
                 s = model.score()
-                for c in cfg.iterConds:
+                for c in iterConds:
                     if c.terminate(s):
                         _IterCheck.stop = str(c)
                         raise _StopTraining()

@@ -26,7 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["BackTrackLineSearch", "LBFGS", "ConjugateGradient",
-           "LineGradientDescent", "make_solver"]
+           "LineGradientDescent", "make_solver", "InvalidStepException"]
+
+
+class InvalidStepException(ArithmeticError):
+    """Reference: ``org.deeplearning4j.exception.InvalidStepException`` —
+    the solver's loss went NaN/Inf, so no line search can make progress.
+    The fault supervisor treats this as a divergence signal (rollback to
+    the last good checkpoint + LR backoff) instead of a hard abort."""
 
 
 class BackTrackLineSearch:
@@ -77,6 +84,16 @@ class _FlatSolver:
         self._valgrad = lambda v: self._valgrad_raw(v, *batch)
         return self._step(x)
 
+    def _checked_valgrad(self, x):
+        """Loss+grad at the step's entry point, with the reference's
+        InvalidStepException semantics on non-finite loss."""
+        f0, g = self._valgrad(x)
+        f0 = float(f0)
+        if not np.isfinite(f0):
+            raise InvalidStepException(
+                f"non-finite loss ({f0}) entering solver step")
+        return f0, g
+
     def _step(self, x: jnp.ndarray) -> tuple:
         raise NotImplementedError
 
@@ -86,7 +103,7 @@ class LineGradientDescent(_FlatSolver):
     LineGradientDescent.java)."""
 
     def _step(self, x):
-        f0, g = self._valgrad(x)
+        f0, g = self._checked_valgrad(x)
         _, x_new, f_new = self.lineSearch.search(self._loss, x, float(f0),
                                                  g, -g)
         return x_new, float(f_new)
@@ -102,7 +119,7 @@ class ConjugateGradient(_FlatSolver):
         self._d_prev: Optional[jnp.ndarray] = None
 
     def _step(self, x):
-        f0, g = self._valgrad(x)
+        f0, g = self._checked_valgrad(x)
         if self._g_prev is None:
             d = -g
         else:
@@ -153,7 +170,7 @@ class LBFGS(_FlatSolver):
         return -q
 
     def _step(self, x):
-        f0, g = self._valgrad(x)
+        f0, g = self._checked_valgrad(x)
         if self._g_prev is not None:
             s = x - self._x_prev
             y = g - self._g_prev

@@ -85,9 +85,22 @@ class _TrainingMaster:
                                    num_processes=num_processes)
 
     # -- training --------------------------------------------------------
-    def fitMultiLayerNetwork(self, net, iterator, epochs: int = 1):
+    def fitMultiLayerNetwork(self, net, iterator, epochs: int = 1,
+                             faultConfig: Optional[dict] = None):
+        """``faultConfig`` (optional) supervises the run through
+        :class:`~deeplearning4j_tpu.fault.FaultTolerantTrainer` — at
+        cluster scale preemption/divergence handling is the launcher's
+        job, so it plugs in here: pass the trainer's kwargs, e.g.
+        ``{"checkpointDir": "/ckpts/run1", "checkpointEveryN": 50}``, and
+        a re-launched job auto-resumes from the latest valid step."""
         mesh = self.mesh or DeviceMesh()
-        ParallelWrapper(net, mesh=mesh).fit(iterator, epochs=epochs)
+        wrapper = ParallelWrapper(net, mesh=mesh)
+        if faultConfig is not None:
+            from deeplearning4j_tpu.fault import FaultTolerantTrainer
+            FaultTolerantTrainer(wrapper, **faultConfig).fit(
+                iterator, epochs=epochs)
+            return net
+        wrapper.fit(iterator, epochs=epochs)
         return net
 
     executeTraining = fitMultiLayerNetwork

@@ -131,7 +131,49 @@ class ParallelWrapper:
             return
         self._fit_dp(iterator, epochs)
 
-    def _fit_dp(self, iterator, epochs: int) -> None:
+    def fitDataSet(self, ds) -> None:
+        """One data-parallel train step on a single batch — the
+        FaultTolerantTrainer's per-batch entry point (it owns the epoch
+        loop, checkpoint cadence, and rollback, so it needs step-level
+        granularity the iterator-driven ``fit`` can't give it).
+
+        Placement is re-asserted per call (cheap no-op when params already
+        carry this mesh's sharding — and after a checkpoint rollback the
+        restored trees get re-placed exactly as ``fit`` would).  Stage/seq
+        meshes are not supported here yet (ROADMAP open item: supervised
+        pipeline/ring training)."""
+        if self.mesh.stageSize > 1 or self.mesh.seqSize > 1:
+            raise NotImplementedError(
+                "fitDataSet (fault-supervised stepping) supports data/"
+                "tensor-parallel meshes; pipeline/sequence axes are an "
+                "open item")
+        net = self.model
+        if self._needs_place():
+            self._dp_place()
+        else:
+            net.setBatchSharding(self.mesh.dataSharding())
+        try:
+            net.fit(ds)
+        finally:
+            net.setBatchSharding(None)
+
+    def _needs_place(self) -> bool:
+        """Params already living on this mesh (the steady state: the jitted
+        DP step returns mesh-sharded trees) skip the O(leaves) placement
+        walk — it only needs to re-run after init or a checkpoint restore
+        dropped arrays somewhere else."""
+        net = self.model
+        if net.params_ is None:
+            return True
+        leaves = jax.tree_util.tree_leaves(net.params_)
+        if not leaves:
+            return True
+        leaf = leaves[0]
+        return not (hasattr(leaf, "sharding") and
+                    set(leaf.sharding.device_set) ==
+                    set(self.mesh.mesh.devices.flat))
+
+    def _dp_place(self) -> None:
         net = self.model
         if net.params_ is None:
             net.init()
@@ -151,6 +193,10 @@ class ParallelWrapper:
 
             net.optState_ = jax.tree.map(place, net.optState_)
         net.setBatchSharding(self.mesh.dataSharding())
+
+    def _fit_dp(self, iterator, epochs: int) -> None:
+        net = self.model
+        self._dp_place()
         try:
             net.fit(iterator, epochs=epochs)
         finally:

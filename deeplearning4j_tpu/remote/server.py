@@ -11,8 +11,13 @@ calls (the reference serves through ParallelInference the same way).
 """
 from __future__ import annotations
 
+import concurrent.futures
 import json
+import random
 import threading
+import time
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -28,7 +33,8 @@ class JsonModelServer:
     requests coalesce into batched device calls up to ``batchLimit``."""
 
     def __init__(self, model, port: int = 0, outputNames=None,
-                 parallelInference: bool = False, batchLimit: int = 32):
+                 parallelInference: bool = False, batchLimit: int = 32,
+                 requestTimeout: Optional[float] = None):
         self.model = model
         self.port = port
         # restrict ComputationGraph responses to these named outputs
@@ -37,6 +43,12 @@ class JsonModelServer:
         self._parallelInference = bool(parallelInference)
         self._batchLimit = int(batchLimit)
         self._pi = None
+        # per-request wall-clock budget (seconds); a blown budget answers
+        # 504 instead of hanging the client's connection.  The stuck model
+        # call keeps running on its pool thread — HTTP can't cancel device
+        # work, it can only stop waiting for it.
+        self.requestTimeout = requestTimeout
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         if parallelInference:
             # validate eagerly (construction-time error), build lazily in
             # start() so a failed construction leaves no worker thread
@@ -67,7 +79,26 @@ class JsonModelServer:
             return {"outputs": sel}
         return {"output": np.asarray(out).tolist()}
 
+    def _run_with_timeout(self, x: np.ndarray) -> dict:
+        # the pool is created in start() (single-threaded), never lazily
+        # here: concurrent first requests would race the None check and
+        # leak an executor
+        if self._pool is None:
+            return self._run(x)
+        fut = self._pool.submit(self._run, x)
+        try:
+            return fut.result(timeout=self.requestTimeout)
+        except concurrent.futures.TimeoutError:
+            # reap queued-but-unstarted work (a running model call can't
+            # be interrupted, but zombies waiting behind it can)
+            fut.cancel()
+            raise
+
     def start(self) -> "JsonModelServer":
+        if self.requestTimeout and self._pool is None:
+            # rebuilt per start so stop()/start() cycles serve again
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="json-model-server")
         if self._parallelInference and self._pi is None:
             # (re)built per start so stop()/start() cycles serve again
             from deeplearning4j_tpu.parallel.inference import \
@@ -91,7 +122,9 @@ class JsonModelServer:
 
             def do_POST(self):
                 # payload faults are the CLIENT's (400); model-execution
-                # faults are OURS (500) — retry/alerting logic keys on this
+                # faults are OURS (500); a blown time budget is 504 —
+                # retry/alerting logic keys on this split, and the client
+                # below only retries the 5xx class
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -100,7 +133,17 @@ class JsonModelServer:
                     body, code = {"error": f"{type(e).__name__}: {e}"}, 400
                 else:
                     try:
-                        body, code = model._run(x), 200
+                        body, code = model._run_with_timeout(x), 200
+                    except concurrent.futures.TimeoutError:
+                        body = {"error": "TimeoutError: request exceeded "
+                                f"{model.requestTimeout}s budget"}
+                        code = 504
+                    except (ValueError, TypeError) as e:
+                        # shape/rank mismatch with the model's input —
+                        # XLA surfaces these as ValueError/TypeError, and
+                        # they are the caller's payload, not our bug
+                        body = {"error": f"{type(e).__name__}: {e}"}
+                        code = 400
                     except Exception as e:
                         body = {"error": f"{type(e).__name__}: {e}"}
                         code = 500
@@ -125,28 +168,73 @@ class JsonModelServer:
         if self._pi is not None:
             self._pi.shutdown()
             self._pi = None      # rebuilt on the next start()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
 
 SameDiffJsonModelServer = JsonModelServer
 
 
 class JsonRemoteInference:
-    """Client (reference: JsonRemoteInference.java)."""
+    """Client (reference: JsonRemoteInference.java).
+
+    Transient faults — connection errors and 5xx responses — are retried
+    ``retries`` times with exponential backoff + jitter (jitter decorrelates
+    a thundering herd of clients re-hitting a recovering server at the same
+    instant).  4xx responses are the CALLER's fault and raise immediately:
+    re-sending a malformed payload can never succeed.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 endpoint: str = "/v1/serving"):
+                 endpoint: str = "/v1/serving", timeout: float = 30.0,
+                 retries: int = 3, backoff: float = 0.05,
+                 maxBackoff: float = 2.0, jitter: float = 0.5,
+                 seed: Optional[int] = None):
         self.url = f"http://{host}:{port}{endpoint}"
+        self.timeout = float(timeout)
+        self.retries = max(0, int(retries))
+        self.backoff = float(backoff)
+        self.maxBackoff = float(maxBackoff)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def _sleep(self, attempt: int) -> None:
+        delay = min(self.backoff * (2 ** attempt), self.maxBackoff)
+        time.sleep(delay * (1.0 + self.jitter * self._rng.random()))
 
     def predict(self, features):
         """Single-output models return an ndarray; multi-output graphs a
         {name: ndarray} dict (mirroring the server's response shape)."""
-        import urllib.request
         data = json.dumps({"features": np.asarray(features).tolist()}
                           ).encode("utf-8")
-        req = urllib.request.Request(
-            self.url, data=data, headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            body = json.loads(resp.read())
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                self.url, data=data,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    body = json.loads(resp.read())
+                break
+            except urllib.error.HTTPError as e:
+                try:
+                    msg = json.loads(e.read()).get("error", str(e))
+                except Exception:
+                    msg = str(e)
+                err = RuntimeError(f"HTTP {e.code}: {msg}")
+                if e.code < 500:
+                    raise err from None     # caller's payload; no retry
+                last_err = err
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last_err = e                # server down/unreachable: retry
+            if attempt < self.retries:
+                self._sleep(attempt)
+        else:
+            raise RuntimeError(
+                f"request failed after {self.retries + 1} attempts: "
+                f"{last_err}") from last_err
         if "error" in body:
             raise RuntimeError(body["error"])
         if "output" in body:

@@ -151,7 +151,7 @@ class QLearningDiscreteDense:
         net, c = self.net, self.conf
 
         def run(params, target, optState, state, obs, acts, rews, nxt,
-                done, key, it, ep):
+                done, key, it, ep, lrScale):
             import jax.numpy as jnp
             n = obs.shape[0]
             q_cur, _, _ = net._forward(params, state, obs, False, None)
@@ -167,7 +167,7 @@ class QLearningDiscreteDense:
                 td = jnp.clip(td, -c.errorClamp, c.errorClamp)
             y = q_cur.at[jnp.arange(n), acts].add(td)
             return net._trainStep(params, optState, state, obs, y, None,
-                                  None, key, it, ep, None)
+                                  None, key, it, ep, None, lrScale)
 
         import jax
         return jax.jit(run)
@@ -186,7 +186,8 @@ class QLearningDiscreteDense:
          _) = self._bellman_step(
             net.params_, self._target, net.optState_, net.state_, obs, acts,
             rews, nxt, done, key, np.int64(net.iterationCount),
-            np.int64(net.epochCount))
+            np.int64(net.epochCount),
+            np.float32(getattr(net, "_lrScale", 1.0)))
         if new_state:
             net.state_.update(new_state)
         net._score = float(loss)

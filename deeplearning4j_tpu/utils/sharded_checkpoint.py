@@ -9,10 +9,15 @@ story) is checkpoint-restore by step number.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 from typing import Any, Dict, Optional
 
 __all__ = ["ShardedCheckpointer"]
+
+log = logging.getLogger(__name__)
 
 
 class ShardedCheckpointer:
@@ -128,3 +133,139 @@ class ShardedCheckpointer:
 
     def close(self):
         self._mgr.close()    # joins outstanding writes itself
+
+    # ------------------------------------------------------------------
+    # checksum manifests (fault tolerance: FaultTolerantTrainer)
+    # ------------------------------------------------------------------
+    # A manifest seals a step: sha256 + size of every file under the step
+    # directory, plus supervisor metadata (stepInEpoch, lrScale, ...).  It
+    # is written ATOMICALLY (tmp + os.replace) only AFTER the async orbax
+    # write has been joined, so a crash mid-save leaves a step with no
+    # manifest — which restore treats exactly like a corrupt step: skipped,
+    # fall back to the previous sealed one.
+
+    def stepPath(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _manifestPath(self, step: int) -> str:
+        return os.path.join(self.directory, "manifests",
+                            f"step-{int(step)}.json")
+
+    @staticmethod
+    def _sha256(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def _walkFiles(self, step: int):
+        spath = self.stepPath(step)
+        for root, _dirs, files in os.walk(spath):
+            for fn in sorted(files):
+                fp = os.path.join(root, fn)
+                yield os.path.relpath(fp, spath), fp
+
+    def saveWithManifest(self, net, step: Optional[int] = None,
+                         metadata: Optional[Dict[str, Any]] = None) -> int:
+        """Synchronous sealed save: orbax save -> join the async write ->
+        checksum every file -> atomically publish the manifest.  Unlike the
+        bare async ``save``, this blocks until the step is durable (the
+        supervisor's checkpoint cadence amortizes the stall).
+
+        Re-saving an existing step (training rolled back past it and
+        re-reached it) refreshes it: the stale step + manifest are deleted
+        first so orbax doesn't skip the write.
+        """
+        step = int(net.iterationCount if step is None else step)
+        if step in set(self._mgr.all_steps()):
+            self._mgr.delete(step)
+            try:
+                os.remove(self._manifestPath(step))
+            except FileNotFoundError:
+                pass
+        self.save(net, step)
+        self.waitUntilFinished()
+        files = {rel: {"sha256": self._sha256(fp),
+                       "bytes": os.path.getsize(fp)}
+                 for rel, fp in self._walkFiles(step)}
+        manifest = {"step": step, "files": files,
+                    "metadata": dict(metadata or {})}
+        mpath = self._manifestPath(step)
+        os.makedirs(os.path.dirname(mpath), exist_ok=True)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, mpath)
+        self._pruneManifests()
+        return step
+
+    def _pruneManifests(self) -> None:
+        """Drop manifests whose step orbax already garbage-collected
+        (max_to_keep)."""
+        mdir = os.path.join(self.directory, "manifests")
+        if not os.path.isdir(mdir):
+            return
+        live = {str(s) for s in self._mgr.all_steps()}
+        for fn in os.listdir(mdir):
+            if fn.startswith("step-") and fn.endswith(".json") \
+                    and fn[5:-5] not in live:
+                try:
+                    os.remove(os.path.join(mdir, fn))
+                except FileNotFoundError:
+                    pass
+
+    def verifyStep(self, step: int) -> bool:
+        """True iff the step's manifest exists and every file matches its
+        recorded sha256/size (unsealed or tampered steps fail)."""
+        try:
+            with open(self._manifestPath(step)) as fh:
+                manifest = json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return False
+        on_disk = dict(self._walkFiles(step))
+        recorded = manifest.get("files", {})
+        if set(on_disk) != set(recorded):
+            return False
+        for rel, info in recorded.items():
+            fp = on_disk[rel]
+            if os.path.getsize(fp) != info["bytes"] \
+                    or self._sha256(fp) != info["sha256"]:
+                return False
+        return True
+
+    def readMetadata(self, step: int) -> Dict[str, Any]:
+        with open(self._manifestPath(step)) as fh:
+            return json.load(fh).get("metadata", {})
+
+    def latestValidStep(self) -> Optional[int]:
+        """Newest step whose checksum manifest verifies; corrupt/unsealed
+        newer steps are skipped with a warning (the restore-fallback
+        contract of SURVEY.md §5.4's checkpoint-restore story)."""
+        self.waitUntilFinished()
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if self.verifyStep(step):
+                return int(step)
+            log.warning(
+                "checkpoint step %d failed checksum verification; "
+                "falling back to an earlier step", step)
+        return None
+
+    def restoreLatestValid(self, net):
+        """Restore the newest VERIFIED step in place; returns the step
+        number, or None when no valid checkpoint exists (fresh run)."""
+        step = self.latestValidStep()
+        if step is None:
+            return None
+        self.restore(net, step=step)
+        return step
+
+    def clear(self) -> None:
+        """Delete every step and manifest — a ``resume=False`` fresh start
+        must not leave stale steps around as rollback targets."""
+        self.waitUntilFinished()
+        for step in list(self._mgr.all_steps()):
+            self._mgr.delete(int(step))
+        self._pruneManifests()

@@ -41,6 +41,9 @@ def pytest_configure(config):
         "(run with `pytest -m tpu`; skipped on the CPU mesh)")
     config.addinivalue_line(
         "markers", "slow: long-running tests (multi-process spawns)")
+    config.addinivalue_line(
+        "markers", "fault: fault-tolerance tests (supervisor recovery "
+        "paths driven by the deterministic injection harness)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,441 @@
+"""Fault tolerance: supervisor recovery paths, checkpoint manifests,
+server retry/timeout, fetcher fallback — all driven by the deterministic
+injection harness (deeplearning4j_tpu/fault/injection.py), no real faults
+and no sleeps beyond ~100ms.
+"""
+import json
+import logging
+import math
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (FaultTolerantTrainer, NaNAtStep,
+                                      OOMAtStep, PreemptAtStep,
+                                      SimulatedPreemption,
+                                      TrainingDivergedError,
+                                      corrupt_checkpoint, inject)
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer
+
+pytestmark = pytest.mark.fault
+
+
+def _conf(seed=42, lr=0.01):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer.builder().nIn(4).nOut(8)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4)).build())
+
+
+def _net(seed=42):
+    return MultiLayerNetwork(_conf(seed)).init()
+
+
+def _toy(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    cls = np.clip((x.sum(1) > 0).astype(int) + (x[:, 0] > 1).astype(int),
+                  0, 2)
+    return x, np.eye(3, dtype=np.float32)[cls]
+
+
+def _iterator(batch=32):
+    x, y = _toy()
+    return ListDataSetIterator([DataSet(x, y)], batch=batch)
+
+
+def _trainer(net, ckdir, **kw):
+    kw.setdefault("checkpointEveryN", 2)
+    kw.setdefault("keepLast", 10)
+    return FaultTolerantTrainer(net, str(ckdir), **kw)
+
+
+class TestKillAndResume:
+    def test_preempt_then_resume_matches_uninterrupted(self, tmp_path):
+        # uninterrupted baseline: 2 epochs x 4 batches = 8 steps
+        base = _net()
+        tb = _trainer(base, tmp_path / "base")
+        tb.fit(_iterator(), epochs=2)
+        assert base.iterationCount == 8
+
+        # killed mid-epoch-1: SimulatedPreemption is BaseException — no
+        # recovery layer may swallow it
+        killed = _net()
+        tk = _trainer(killed, tmp_path / "run")
+        with inject(PreemptAtStep(5)):
+            with pytest.raises(SimulatedPreemption):
+                tk.fit(_iterator(), epochs=2)
+        assert killed.iterationCount < 8
+
+        # same entrypoint re-run: picks up from the latest valid step
+        # (step 4 checkpoint), replays the tail, and lands on the SAME
+        # final loss — counters AND the training RNG key are restored
+        resumed = _net()
+        tr = _trainer(resumed, tmp_path / "run")
+        tr.fit(_iterator(), epochs=2)
+        assert tr.stats["resumedFromStep"] == 4
+        assert resumed.iterationCount == 8
+        assert tr.lastLoss == pytest.approx(tb.lastLoss, abs=1e-5)
+
+    def test_refit_on_finished_run_is_noop_resume(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck")
+        t.fit(_iterator(), epochs=1)
+        steps_done = net.iterationCount
+        net2 = _net()
+        t2 = _trainer(net2, tmp_path / "ck")
+        t2.fit(_iterator(), epochs=1)   # epochCount already == epochs
+        assert t2.stats["resumedFromStep"] == steps_done
+        assert net2.iterationCount == steps_done
+
+
+class TestNaNRollback:
+    def test_nan_at_step_rolls_back_with_lr_backoff(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck", lrBackoff=0.5)
+        with inject(NaNAtStep(3)):
+            t.fit(_iterator(), epochs=1)
+        # rolled back from step 3 to the step-2 checkpoint, halved the LR,
+        # and the run still completed with a finite loss
+        assert t.stats["rollbacks"] == 1
+        assert net.getLrScale() == pytest.approx(0.5)
+        assert math.isfinite(t.lastLoss)
+        # counters rewound by the rollback: epoch ends 1 step short
+        assert net.iterationCount == 3
+        assert net.epochCount == 1
+
+    def test_lr_scale_survives_resume(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck")
+        with inject(NaNAtStep(3)):
+            t.fit(_iterator(), epochs=1)
+        net2 = _net()
+        t2 = _trainer(net2, tmp_path / "ck")
+        t2.fit(_iterator(), epochs=1)   # no-op resume (epochs done)
+        assert net2.getLrScale() == pytest.approx(0.5)
+
+    def test_rollback_across_epoch_boundary_keeps_epoch_position(
+            self, tmp_path):
+        # NaN in epoch 1 rolls back to a checkpoint taken in epoch 0: the
+        # restored epoch counter must NOT rewind the epoch loop (that
+        # would re-train a whole extra epoch on top of the retry)
+        net = _net()
+        t = _trainer(net, tmp_path / "ck")
+        with inject(NaNAtStep(5)):
+            t.fit(_iterator(), epochs=2)
+        assert t.stats["rollbacks"] == 1
+        assert net.epochCount == 2
+        assert net.iterationCount == 7      # 8 steps - 1 rolled back
+
+    def test_persistent_nan_raises_diverged(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck", maxRollbacks=2)
+        # poison EVERY attempt: backoff can't help, supervisor must give
+        # up after maxRollbacks instead of looping forever
+        with inject(NaNAtStep(times=None)):
+            with pytest.raises(TrainingDivergedError):
+                t.fit(_iterator(), epochs=1)
+        assert t.stats["rollbacks"] == 3    # maxRollbacks + the final one
+
+
+class TestFreshStart:
+    def test_resume_false_clears_stale_checkpoints(self, tmp_path):
+        # run A leaves checkpoints behind; run B with resume=False must
+        # NOT be able to roll back into run A's params — the stale steps
+        # are cleared and a fresh step-0 anchor is written
+        netA = _net()
+        _trainer(netA, tmp_path / "ck").fit(_iterator(), epochs=1)
+        netB = _net(seed=7)
+        tB = _trainer(netB, tmp_path / "ck", resume=False)
+        with inject(NaNAtStep(1)):
+            tB.fit(_iterator(), epochs=1)
+        assert tB.stats["resumedFromStep"] is None
+        assert tB.stats["rollbacks"] == 1
+        # rollback landed on run B's own fresh step-0 anchor (ending the
+        # epoch one step short), not on run A's tail (which would have
+        # jumped the counter to A's step numbers)
+        assert netB.iterationCount == 3
+        assert netB.epochCount == 1
+
+
+class TestComputationGraphSupervised:
+    def test_graph_nan_rollback(self, tmp_path):
+        from deeplearning4j_tpu.models import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+                .graphBuilder()
+                .addInputs("in")
+                .setInputTypes(InputType.feedForward(4))
+                .addLayer("d", DenseLayer.builder().nOut(8)
+                          .activation("relu").build(), "in")
+                .addLayer("out", OutputLayer.builder("mcxent").nOut(3)
+                          .activation("softmax").build(), "d")
+                .setOutputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        t = _trainer(net, tmp_path / "ck")
+        with inject(NaNAtStep(3)):
+            t.fit(_iterator(), epochs=1)
+        assert t.stats["rollbacks"] == 1
+        assert net.getLrScale() == pytest.approx(0.5)
+        assert math.isfinite(t.lastLoss)
+
+
+class TestCorruptCheckpoint:
+    def test_checksum_detects_corruption_and_falls_back(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck", checkpointEveryN=2)
+        t.fit(_iterator(), epochs=1)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), keepLast=10)
+        newest = max(ck.allSteps())
+        assert ck.verifyStep(newest)
+        corrupt_checkpoint(str(tmp_path / "ck"), newest)
+        assert not ck.verifyStep(newest)
+        prev = ck.latestValidStep()
+        assert prev is not None and prev < newest
+
+        restored = _net()
+        assert ck.restoreLatestValid(restored) == prev
+        assert restored.iterationCount == prev
+
+    def test_supervisor_resumes_past_corrupt_newest(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck", checkpointEveryN=2)
+        t.fit(_iterator(), epochs=1)
+        newest = max(ShardedCheckpointer(str(tmp_path / "ck"),
+                                         keepLast=10).allSteps())
+        corrupt_checkpoint(str(tmp_path / "ck"), newest)
+        net2 = _net()
+        t2 = _trainer(net2, tmp_path / "ck", checkpointEveryN=2)
+        t2.fit(_iterator(), epochs=1)
+        assert t2.stats["resumedFromStep"] < newest
+        assert net2.iterationCount == 4     # replayed the corrupt tail
+
+    def test_manifest_metadata_roundtrip(self, tmp_path):
+        net = _net()
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), keepLast=3)
+        step = ck.saveWithManifest(net, metadata={"stepInEpoch": 7,
+                                                  "lrScale": 0.25})
+        assert ck.verifyStep(step)
+        assert ck.readMetadata(step) == {"stepInEpoch": 7, "lrScale": 0.25}
+
+
+class TestOOMRetry:
+    def test_oom_step_splits_into_micro_batches(self, tmp_path):
+        net = _net()
+        t = _trainer(net, tmp_path / "ck")
+        with inject(OOMAtStep(2)):
+            t.fit(_iterator(), epochs=1)
+        # the split halves each stepped, but the world saw ONE step 2
+        assert t.stats["oomSplits"] == 1
+        assert net.iterationCount == 4
+        assert math.isfinite(t.lastLoss)
+
+    def test_unsplittable_oom_propagates(self, tmp_path):
+        x, y = _toy(n=8)
+        it = ListDataSetIterator([DataSet(x, y)], batch=1)  # 1-example batches
+        net = _net()
+        t = _trainer(net, tmp_path / "ck")
+        from deeplearning4j_tpu.fault import InjectedOOM
+        with inject(OOMAtStep(2, times=10)):
+            with pytest.raises(InjectedOOM):
+                t.fit(it, epochs=1)
+
+
+class TestParallelWrapperSupervised:
+    def test_supervisor_over_wrapper_recovers_from_nan(self, tmp_path):
+        from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+        net = _net()
+        wrapper = ParallelWrapper(net, mesh=DeviceMesh(data=8))
+        t = _trainer(wrapper, tmp_path / "ck")
+        with inject(NaNAtStep(2)):
+            t.fit(_iterator(), epochs=1)
+        assert t.stats["rollbacks"] == 1
+        assert net.getLrScale() == pytest.approx(0.5)
+        assert math.isfinite(t.lastLoss)
+
+
+class TestInvalidScoreTermination:
+    def test_condition_semantics(self):
+        from deeplearning4j_tpu.optimize import \
+            InvalidScoreIterationTerminationCondition
+        c = InvalidScoreIterationTerminationCondition()
+        assert c.terminate(float("nan"))
+        assert c.terminate(float("inf"))
+        assert not c.terminate(1.0)
+
+    def test_default_wiring_stops_nan_run(self):
+        # poisoned params -> NaN minibatch score on the first iteration;
+        # the trainer must stop via its DEFAULT checks (none configured)
+        from deeplearning4j_tpu.optimize import (EarlyStoppingConfiguration,
+                                                 MaxEpochsTerminationCondition,
+                                                 TerminationReason)
+        from deeplearning4j_tpu.optimize.earlystopping import \
+            EarlyStoppingTrainer
+        net = _net()
+        key = next(iter(net.params_))
+        import jax.numpy as jnp
+        net.params_[key]["W"] = net.params_[key]["W"] * jnp.nan
+        cfg = (EarlyStoppingConfiguration.builder()
+               .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, _iterator()).fit()
+        assert result.terminationReason == \
+            TerminationReason.IterationTerminationCondition
+        assert "InvalidScore" in result.terminationDetails
+
+    def test_solver_raises_invalid_step_on_nan(self):
+        from deeplearning4j_tpu.optimize import InvalidStepException
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(1e-2))
+                .optimizationAlgo("LBFGS").list()
+                .layer(OutputLayer.builder("mse").nOut(3)
+                       .activation("identity").build())
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        import jax.numpy as jnp
+        net.params_["0"]["W"] = net.params_["0"]["W"] * jnp.nan
+        x, y = _toy(n=32)
+        with pytest.raises(InvalidStepException):
+            net.fit(DataSet(x, y[:, :3]))
+
+
+# ---------------------------------------------------------------- server ----
+
+class _FlakyModel:
+    """output() fails the first ``failures`` calls with a 5xx-mapped
+    error, then serves."""
+
+    def __init__(self, failures=2, delay=0.0):
+        self.failures = failures
+        self.delay = delay
+        self.calls = 0
+
+    def output(self, x):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        if self.calls <= self.failures:
+            raise RuntimeError("transient backend failure")
+        import numpy as np
+        return np.asarray(x).sum(axis=-1, keepdims=True)
+
+
+class TestServerRobustness:
+    def _post_raw(self, port, payload: bytes):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/serving", data=payload,
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10)
+
+    def test_client_retries_5xx_with_backoff(self):
+        from deeplearning4j_tpu.remote import (JsonModelServer,
+                                               JsonRemoteInference)
+        model = _FlakyModel(failures=2)
+        server = JsonModelServer(model).start()
+        try:
+            client = JsonRemoteInference(port=server.port, retries=3,
+                                         backoff=0.01, seed=0)
+            out = client.predict([[1.0, 2.0]])
+            assert out.shape == (1, 1) and model.calls == 3
+        finally:
+            server.stop()
+
+    def test_client_does_not_retry_400(self):
+        from deeplearning4j_tpu.remote import (JsonModelServer,
+                                               JsonRemoteInference)
+        net = _net()
+        server = JsonModelServer(net).start()
+        try:
+            client = JsonRemoteInference(port=server.port, retries=3,
+                                         backoff=0.01, seed=0)
+            # wrong feature width -> shape mismatch -> 400, raised
+            # immediately (one request, no retries)
+            with pytest.raises(RuntimeError, match="HTTP 400"):
+                client.predict(np.ones((1, 7), np.float32))
+        finally:
+            server.stop()
+
+    def test_malformed_json_is_400(self):
+        from deeplearning4j_tpu.remote import JsonModelServer
+        server = JsonModelServer(_net()).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post_raw(server.port, b"{not json")
+            assert ei.value.code == 400
+            assert "error" in json.loads(ei.value.read())
+        finally:
+            server.stop()
+
+    def test_shape_mismatch_is_400_not_500(self):
+        from deeplearning4j_tpu.remote import JsonModelServer
+        server = JsonModelServer(_net()).start()
+        try:
+            bad = json.dumps({"features": [[1.0] * 7]}).encode()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post_raw(server.port, bad)
+            assert ei.value.code == 400
+        finally:
+            server.stop()
+
+    def test_request_timeout_is_504(self):
+        from deeplearning4j_tpu.remote import (JsonModelServer,
+                                               JsonRemoteInference)
+        model = _FlakyModel(failures=0, delay=0.1)
+        server = JsonModelServer(model, requestTimeout=0.02).start()
+        try:
+            client = JsonRemoteInference(port=server.port, retries=0)
+            with pytest.raises(RuntimeError, match="(?i)504|timeout"):
+                client.predict([[1.0, 2.0]])
+        finally:
+            server.stop()
+
+
+# -------------------------------------------------------------- fetchers ----
+
+class TestFetcherFallback:
+    def test_failing_fetch_retries_then_synthetic(self, caplog):
+        from deeplearning4j_tpu.datasets.fetchers import \
+            Cifar10DataSetIterator
+        from deeplearning4j_tpu.fault import FailingFetch
+        fault = FailingFetch("cifar10", times=5)   # > retry budget
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.datasets.fetchers"):
+            with inject(fault):
+                it = Cifar10DataSetIterator(32, numExamples=64)
+        assert it.isSynthetic
+        assert fault.attempts == 3                  # bounded retry
+        assert any("falling back to the synthetic set" in r.message
+                   for r in caplog.records)
+        ds = it.next()
+        assert ds.features.shape == (32, 3, 32, 32)
+
+    def test_transient_fetch_failure_recovers(self, caplog):
+        from deeplearning4j_tpu.datasets.fetchers import \
+            EmnistDataSetIterator
+        from deeplearning4j_tpu.fault import FailingFetch
+        fault = FailingFetch("emnist", times=2)     # within retry budget
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.datasets.fetchers"):
+            with inject(fault):
+                it = EmnistDataSetIterator("DIGITS", 16, numExamples=32)
+        assert fault.attempts == 3
+        assert it.next().features.shape[0] == 16
+
+    def test_slow_fetch_does_not_fail(self):
+        from deeplearning4j_tpu.datasets.fetchers import \
+            EmnistDataSetIterator
+        from deeplearning4j_tpu.fault import SlowFetch
+        slow = SlowFetch("emnist", delay=0.02)
+        with inject(slow):
+            it = EmnistDataSetIterator("DIGITS", 16, numExamples=32)
+        assert it.next().numExamples() == 16

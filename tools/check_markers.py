@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Fail when any test file uses a pytest marker not registered in conftest.
+
+An unregistered marker is how a test suite silently loses coverage: a typo
+like ``@pytest.mark.slwo`` still collects and RUNS under ``-m 'not slow'``
+(burning the tier-1 time budget), while an unregistered gating marker means
+``-m fault`` selects nothing and the suite goes green without testing
+anything.  Run at the top of the tier-1 command (see ROADMAP.md).
+
+Usage: python tools/check_markers.py [tests_dir]
+"""
+import re
+import sys
+from pathlib import Path
+
+# markers pytest itself defines — always legal
+BUILTIN = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "no_cover",
+}
+
+MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_]\w*)")
+REGISTER_RE = re.compile(
+    r'addinivalue_line\(\s*["\']markers["\']\s*,\s*["\']([A-Za-z_]\w*)')
+
+
+def registered_markers(tests_dir: Path) -> set:
+    conftest = tests_dir / "conftest.py"
+    if not conftest.exists():
+        return set()
+    return set(REGISTER_RE.findall(conftest.read_text()))
+
+
+def main(argv) -> int:
+    tests_dir = Path(argv[1]) if len(argv) > 1 else \
+        Path(__file__).resolve().parent.parent / "tests"
+    allowed = BUILTIN | registered_markers(tests_dir)
+    bad = []
+    for path in sorted(tests_dir.rglob("test_*.py")):
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]    # a marker named in a comment
+            for name in MARK_RE.findall(code):  # is not a marker in use
+                if name not in allowed:
+                    bad.append((path, lineno, name))
+    if bad:
+        for path, lineno, name in bad:
+            print(f"{path}:{lineno}: unregistered pytest marker "
+                  f"'{name}' (register it in tests/conftest.py "
+                  f"pytest_configure)", file=sys.stderr)
+        return 1
+    print(f"check_markers: OK ({len(allowed)} registered/builtin markers)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
