@@ -1771,11 +1771,11 @@ class SameDiff:
             if n not in self._opt_state:  # extend for vars added after a fit
                 self._opt_state[n] = cfg.updater.init(v)
         from deeplearning4j_tpu.autodiff.listeners import At, Loss
+        from deeplearning4j_tpu.optimize.listeners import notifyListeners
         losses, curve = [], []
         for ep in range(int(epochs)):
             at = At(epoch=ep, iteration=self.iterationCount)
-            for l in self._listeners:
-                l.epochStart(self, at)
+            notifyListeners(self._listeners, "epochStart", self, at)
             if isinstance(data, (DataSet, MultiDataSet)):
                 batches = [data]
             else:
@@ -1784,8 +1784,8 @@ class SameDiff:
                 batches = data
             for ds in batches:
                 at = At(epoch=ep, iteration=self.iterationCount)
-                for l in self._listeners:
-                    l.iterationStart(self, at, ds)
+                notifyListeners(self._listeners, "iterationStart", self,
+                                at, ds)
                 ph = self._bind(ds, cfg)
                 variables, self._opt_state, loss = self._train_step(
                     variables, self._opt_state, ph,
@@ -1796,15 +1796,17 @@ class SameDiff:
                 # attached the host sync is paid anyway (the listener
                 # contract is a Python float), so convert only then.
                 losses.append(loss)
-                for l in self._listeners:
-                    l.iterationDone(self, at, ds,
-                                    Loss(["loss"], [float(losses[-1])]))
+                if self._listeners:
+                    # float() only with listeners attached — see comment
+                    # above: listener-free fits keep the loss async
+                    notifyListeners(
+                        self._listeners, "iterationDone", self, at, ds,
+                        Loss(["loss"], [float(losses[-1])]))
             if self._listeners:
                 curve = _fetch_curve(losses)
-                for l in self._listeners:
-                    l.epochEnd(self, At(epoch=ep,
-                                        iteration=self.iterationCount),
-                               loss_curve=curve)
+                notifyListeners(self._listeners, "epochEnd", self,
+                                At(epoch=ep, iteration=self.iterationCount),
+                                loss_curve=curve)
         self._arrays.update(variables)
         # Reuse the last epochEnd fetch when listeners ran (nothing was
         # appended after it); otherwise one stacked transfer.

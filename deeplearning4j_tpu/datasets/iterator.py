@@ -66,7 +66,11 @@ class DataSetIterator:
     def __next__(self) -> DataSet:
         if not self.hasNext():
             raise StopIteration
-        return self.next()
+        # route protocol-driven consumption through the ETL telemetry the
+        # framework train loops already use (etl span + stall gauges), so
+        # `for ds in it` loops are observable too
+        from deeplearning4j_tpu.telemetry import etl_fetch
+        return etl_fetch(self)
 
 
 class ListDataSetIterator(DataSetIterator):

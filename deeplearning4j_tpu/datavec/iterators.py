@@ -166,6 +166,9 @@ class AsyncDataSetIterator(DataSetIterator):
     def _start(self) -> None:
         self._q = queue.Queue(maxsize=self.queueSize)
         self._peek = None
+        # waits incurred by a pre-reset drain (normalizer fit) are not the
+        # next epoch's stalls
+        self._telemetry_pending_wait = 0.0
 
         def produce():
             try:
@@ -181,7 +184,34 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def hasNext(self) -> bool:
         if self._peek is None:
+            import time as _time
+
+            from deeplearning4j_tpu.telemetry import get_registry
+            reg = get_registry()
+            # depth BEFORE the blocking get: 0 here means the device loop
+            # is outrunning host ETL (the producer is the bottleneck)
+            reg.gauge(
+                "dl4j_tpu_etl_queue_depth",
+                "Prefetch-queue depth observed by the consumer").set(
+                    self._q.qsize())
+            t0 = _time.perf_counter()
             self._peek = self._q.get()
+            wait = _time.perf_counter() - t0
+            # the blocking wait lives HERE (hasNext populates the peek),
+            # not in next() — hand it to the next etl_fetch so the etl
+            # span/gauge/counter see it, or an input-bound async pipeline
+            # would read as stall-free.  Waits for the _END sentinel or a
+            # producer exception are NOT batch stalls: reporting them
+            # would show a phantom stall once per epoch (producer drain)
+            # or leak into the next unrelated fetch's accounting.
+            if self._peek is not self._END and \
+                    not isinstance(self._peek, BaseException):
+                from deeplearning4j_tpu.telemetry import note_etl_wait
+                reg.gauge(
+                    "dl4j_tpu_etl_prefetch_wait_seconds",
+                    "Consumer block time on the last prefetch-queue "
+                    "get").set(wait)
+                note_etl_wait(wait, self)
         if isinstance(self._peek, BaseException):
             exc = self._peek
             self._peek = None

@@ -39,9 +39,15 @@ from __future__ import annotations
 
 import logging
 import math
+import time
 from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.optimize.listeners import notifyListeners
+from deeplearning4j_tpu.telemetry import (etl_fetch, flight_recorder,
+                                          get_registry, microbatch_scope,
+                                          record_crash, record_logical_step,
+                                          supervised_scope, tracer)
 from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer
 
 __all__ = ["FaultTolerantTrainer", "TrainingDivergedError", "is_oom_error"]
@@ -135,11 +141,15 @@ class FaultTolerantTrainer:
         return float(getattr(self.net, "_lrScale", 1.0))
 
     def _checkpoint(self, stepInEpoch: int) -> None:
-        step = self.ckpt.saveWithManifest(
-            self.net, metadata={"stepInEpoch": int(stepInEpoch),
-                                "epoch": int(self.net.epochCount),
-                                "lrScale": self._lrScale()})
+        with tracer().span("checkpoint", step=self.net.iterationCount):
+            step = self.ckpt.saveWithManifest(
+                self.net, metadata={"stepInEpoch": int(stepInEpoch),
+                                    "epoch": int(self.net.epochCount),
+                                    "lrScale": self._lrScale()})
         self.stats["checkpoints"] += 1
+        get_registry().counter(
+            "dl4j_tpu_fault_checkpoints_total",
+            "Sealed checkpoints written by the supervisor").inc()
         inj = self.injector
         if inj is not None:
             inj.after_checkpoint(step, self.ckpt.stepPath(step))
@@ -150,8 +160,19 @@ class FaultTolerantTrainer:
             raise TrainingDivergedError(
                 "divergence before any checkpoint existed — nothing to "
                 "roll back to")
-        self.ckpt.restore(self.net, step=step)
+        self._timedRestore(step)
         return step
+
+    def _timedRestore(self, step: int) -> None:
+        reg = get_registry()
+        t0 = time.perf_counter()
+        with tracer().span("checkpoint_restore", step=step):
+            self.ckpt.restore(self.net, step=step)
+        reg.histogram("dl4j_tpu_fault_restore_seconds",
+                      "Checkpoint restore latency").observe(
+                          time.perf_counter() - t0)
+        reg.counter("dl4j_tpu_fault_checkpoint_restores_total",
+                    "Checkpoint restores (rollback + resume)").inc()
 
     # -- the supervised loop --------------------------------------------
     def fit(self, iterator, epochs: int = 1) -> None:
@@ -161,8 +182,9 @@ class FaultTolerantTrainer:
         skip = 0
         step = None
         if self.resume:
-            step = self.ckpt.restoreLatestValid(net)
+            step = self.ckpt.latestValidStep()
             if step is not None:
+                self._timedRestore(step)
                 meta = self.ckpt.readMetadata(step)
                 skip = int(meta.get("stepInEpoch", 0))
                 if hasattr(net, "setLrScale"):
@@ -184,12 +206,11 @@ class FaultTolerantTrainer:
             # guarantee a rollback target before the first optimizer step
             self._checkpoint(stepInEpoch=0)
         while net.epochCount < int(epochs):
-            for l in net.getListeners():
-                l.onEpochStart(net)
+            notifyListeners(net.getListeners(), "onEpochStart", net)
             iterator.reset()
             stepInEpoch = 0
             while iterator.hasNext():
-                ds = iterator.next()
+                ds = etl_fetch(iterator)
                 if skip > 0:
                     # fast-forward a mid-epoch resume to the stored
                     # position (counters/RNG came from the checkpoint,
@@ -203,8 +224,7 @@ class FaultTolerantTrainer:
                     self._checkpoint(stepInEpoch)
             skip = 0
             net.epochCount += 1
-            for l in net.getListeners():
-                l.onEpochEnd(net)
+            notifyListeners(net.getListeners(), "onEpochEnd", net)
         self._checkpoint(stepInEpoch=0)
         self.ckpt.waitUntilFinished()
 
@@ -215,7 +235,8 @@ class FaultTolerantTrainer:
         while True:
             diverged = None
             try:
-                self._stepOnce(ds)
+                with supervised_scope():
+                    self._stepOnce(ds)
                 loss = float(net.score())
                 if math.isnan(loss) or math.isinf(loss):
                     diverged = f"non-finite loss {loss}"
@@ -236,19 +257,29 @@ class FaultTolerantTrainer:
                 return
             rollbacks += 1
             self.stats["rollbacks"] += 1
+            get_registry().counter(
+                "dl4j_tpu_fault_nan_rollbacks_total",
+                "Divergence (NaN/Inf/threshold/solver) rollbacks to the "
+                "last good checkpoint").inc()
+            flight_recorder().record(
+                event="rollback", reason=diverged,
+                iteration=net.iterationCount, epoch=net.epochCount)
             if rollbacks > self.maxRollbacks:
-                raise TrainingDivergedError(
-                    f"still diverging after {self.maxRollbacks} rollbacks "
-                    f"({diverged})")
-            epoch_now = net.epochCount
-            step = self._restoreLastGood()
-            # rollback rewinds the STEP counter/params/opt-state, not the
-            # epoch loop position: the iterator hasn't moved, so a restore
-            # from a previous epoch's checkpoint must not make the epoch
-            # loop re-run a whole extra epoch
-            net.epochCount = epoch_now
-            if hasattr(net, "setLrScale"):
-                net.setLrScale(self._lrScale() * self.lrBackoff)
+                reason = (f"still diverging after {self.maxRollbacks} "
+                          f"rollbacks ({diverged})")
+                record_crash(reason, model=net)
+                raise TrainingDivergedError(reason)
+            with tracer().span("recovery", reason=diverged,
+                               rollback=rollbacks):
+                epoch_now = net.epochCount
+                step = self._restoreLastGood()
+                # rollback rewinds the STEP counter/params/opt-state, not
+                # the epoch loop position: the iterator hasn't moved, so a
+                # restore from a previous epoch's checkpoint must not make
+                # the epoch loop re-run a whole extra epoch
+                net.epochCount = epoch_now
+                if hasattr(net, "setLrScale"):
+                    net.setLrScale(self._lrScale() * self.lrBackoff)
             log.warning(
                 "divergence (%s): rolled back to checkpoint step %d, "
                 "lrScale now %.4g (rollback %d/%d)", diverged, step,
@@ -270,19 +301,35 @@ class FaultTolerantTrainer:
                     or ds.numExamples() < 2:
                 raise
             self.stats["oomSplits"] += 1
+            get_registry().counter(
+                "dl4j_tpu_fault_oom_retries_total",
+                "Device-OOM steps retried as micro-batches").inc()
+            flight_recorder().record(
+                event="oom_retry", iteration=it0,
+                micro_batch=ds.numExamples() // 2)
             log.warning(
                 "device OOM at step %d (%s); retrying as %d-example "
                 "micro-batches", it0, type(e).__name__,
                 ds.numExamples() // 2)
-            for half in _split_dataset(ds):
-                # every micro-batch updates at the SAME schedule position:
-                # without the reset, half 2 would consume iteration it0+1
-                # and the next real batch would repeat it (double-stepping
-                # any iteration-keyed LR schedule)
-                net.iterationCount = it0
-                self._stepOnce(half, depth + 1)
+            t0 = time.perf_counter()
+            with microbatch_scope():
+                for half in _split_dataset(ds):
+                    # every micro-batch updates at the SAME schedule
+                    # position: without the reset, half 2 would consume
+                    # iteration it0+1 and the next real batch would repeat
+                    # it (double-stepping any iteration-keyed LR schedule)
+                    net.iterationCount = it0
+                    self._stepOnce(half, depth + 1)
             # the outside world saw ONE logical step
             net.iterationCount = it0 + 1
+            if depth == 0:
+                # the halves deferred their reporting (microbatch_scope):
+                # count the logical step's metrics and fire iterationDone
+                # exactly once at the step boundary
+                record_logical_step(net, time.perf_counter() - t0,
+                                    ds.numExamples())
+                notifyListeners(net.getListeners(), "iterationDone", net,
+                                net.iterationCount, net.epochCount)
 
     def _fitOne(self, ds) -> None:
         if self.wrapper is not None:

@@ -33,7 +33,10 @@ from deeplearning4j_tpu.models.multilayer import (_apply_updates, _get_leaf,
 from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import Layer
 from deeplearning4j_tpu.ops import NDArray
+from deeplearning4j_tpu.optimize.listeners import notifyListeners
 from deeplearning4j_tpu.profiler import check_panic, panic_enabled
+from deeplearning4j_tpu.telemetry import (etl_fetch, in_microbatch,
+                                          tracer, train_step_span)
 
 
 class ComputationGraph:
@@ -277,14 +280,12 @@ class ComputationGraph:
             self._fitBatch(data)
         elif isinstance(data, DataSetIterator):
             for _ in range(epochs):
-                for l in self._listeners:
-                    l.onEpochStart(self)
+                notifyListeners(self._listeners, "onEpochStart", self)
                 data.reset()
                 while data.hasNext():
-                    self._fitBatch(data.next())
+                    self._fitBatch(etl_fetch(data))
                 self.epochCount += 1
-                for l in self._listeners:
-                    l.onEpochEnd(self)
+                notifyListeners(self._listeners, "onEpochEnd", self)
         elif labels is not None:
             self._fitBatch(DataSet(data, labels))
         else:
@@ -301,44 +302,52 @@ class ComputationGraph:
     def _fitBatch(self, ds) -> None:
         pb = self._place_batch
         fmask = None
-        if isinstance(ds, MultiDataSet):
-            inputs = tuple(pb(f.jax.astype(self._dtype))
-                           for f in ds.features)
-            labels = tuple(pb(l.jax) for l in ds.labels)
-            masks = tuple(pb(m.jax) for m in ds.labelsMasks) \
-                if ds.labelsMasks else None
-            if getattr(ds, "featuresMasks", None):
-                fmask = tuple(pb(m.jax) if m is not None else None
-                              for m in ds.featuresMasks)
-        else:
-            inputs = (pb(ds.features.jax.astype(self._dtype)),)
-            labels = (pb(ds.labels.jax),)
-            masks = (pb(ds.labelsMask.jax),) \
-                if ds.labelsMask is not None else None
-            if ds.featuresMask is not None:
-                fmask = (pb(ds.featuresMask.jax),)
+        with tracer().span("h2d"):
+            if isinstance(ds, MultiDataSet):
+                inputs = tuple(pb(f.jax.astype(self._dtype))
+                               for f in ds.features)
+                labels = tuple(pb(l.jax) for l in ds.labels)
+                masks = tuple(pb(m.jax) for m in ds.labelsMasks) \
+                    if ds.labelsMasks else None
+                if getattr(ds, "featuresMasks", None):
+                    fmask = tuple(pb(m.jax) if m is not None else None
+                                  for m in ds.featuresMasks)
+            else:
+                inputs = (pb(ds.features.jax.astype(self._dtype)),)
+                labels = (pb(ds.labels.jax),)
+                masks = (pb(ds.labelsMask.jax),) \
+                    if ds.labelsMask is not None else None
+                if ds.featuresMask is not None:
+                    fmask = (pb(ds.featuresMask.jax),)
         self.lastBatchSize = int(inputs[0].shape[0])
         algo = str(self.conf.globalConf.get("optimizationAlgo")
                    or "STOCHASTIC_GRADIENT_DESCENT").upper()
         if algo != "STOCHASTIC_GRADIENT_DESCENT":
-            self._runSolverStep(inputs, labels, masks, fmask, algo)
+            with train_step_span(self, self.lastBatchSize):
+                self._runSolverStep(inputs, labels, masks, fmask, algo)
             self.iterationCount += 1
-            for l in self._listeners:
-                l.iterationDone(self, self.iterationCount, self.epochCount)
+            if not in_microbatch():
+                notifyListeners(self._listeners, "iterationDone", self,
+                                self.iterationCount, self.epochCount)
             return
         from deeplearning4j_tpu.nn.conf import BackpropType
         # TBPTT needs per-timestep (rank-3) labels on every output
         # (reference: ComputationGraph.doTruncatedBPTT)
-        if self.conf.backpropType == BackpropType.TruncatedBPTT \
-                and all(i.ndim == 3 for i in inputs) \
-                and all(l.ndim == 3 for l in labels) \
-                and inputs[0].shape[2] > self.conf.tbpttFwdLength:
-            self._fitTbptt(inputs, labels, masks, fmask)
-        else:
-            self._runTrainStep(inputs, labels, masks, fmask, carries=None)
+        with train_step_span(self, self.lastBatchSize):
+            if self.conf.backpropType == BackpropType.TruncatedBPTT \
+                    and all(i.ndim == 3 for i in inputs) \
+                    and all(l.ndim == 3 for l in labels) \
+                    and inputs[0].shape[2] > self.conf.tbpttFwdLength:
+                self._fitTbptt(inputs, labels, masks, fmask)
+            else:
+                self._runTrainStep(inputs, labels, masks, fmask,
+                                   carries=None)
         self.iterationCount += 1
-        for l in self._listeners:
-            l.iterationDone(self, self.iterationCount, self.epochCount)
+        if not in_microbatch():
+            # OOM-retry halves share one logical iteration — the
+            # supervisor fires iterationDone ONCE at the step boundary
+            notifyListeners(self._listeners, "iterationDone", self,
+                            self.iterationCount, self.epochCount)
 
     def _runTrainStep(self, inputs, labels, masks, fmask, carries):
         self._fitKey, key = jax.random.split(self._fitKey)
@@ -496,7 +505,9 @@ class ComputationGraph:
         ev = Evaluation()
         it.reset()
         while it.hasNext():
-            ds = it.next()
+            # etl_fetch also consumes async-prefetch waits noted in
+            # hasNext (see MultiLayerNetwork.evaluate)
+            ds = etl_fetch(it)
             out = self.output(ds.features, featuresMask=ds.featuresMask)
             if isinstance(out, list):
                 out = out[0]

@@ -29,7 +29,10 @@ from deeplearning4j_tpu.learning.regularization import WeightDecay
 from deeplearning4j_tpu.nn.conf import (GradientNormalization,
                                         MultiLayerConfiguration)
 from deeplearning4j_tpu.ops import NDArray
+from deeplearning4j_tpu.optimize.listeners import notifyListeners
 from deeplearning4j_tpu.profiler import check_panic, panic_enabled
+from deeplearning4j_tpu.telemetry import (etl_fetch, in_microbatch,
+                                          tracer, train_step_span)
 
 Params = Dict[str, Dict[str, jax.Array]]
 
@@ -446,23 +449,22 @@ class MultiLayerNetwork:
             raise TypeError(f"Cannot fit on {type(data)}")
 
     def _fitEpoch(self, it: DataSetIterator) -> None:
-        for l in self._listeners:
-            l.onEpochStart(self)
+        notifyListeners(self._listeners, "onEpochStart", self)
         it.reset()
         while it.hasNext():
-            self._fitBatch(it.next())
+            self._fitBatch(etl_fetch(it))
         self.epochCount += 1
-        for l in self._listeners:
-            l.onEpochEnd(self)
+        notifyListeners(self._listeners, "onEpochEnd", self)
 
     def _fitBatch(self, ds: DataSet) -> None:
         from deeplearning4j_tpu.nn.conf import BackpropType
-        x = self._place_batch(ds.features.jax.astype(self._dtype))
-        y = self._place_batch(ds.labels.jax)
-        fmask = self._place_batch(
-            ds.featuresMask.jax if ds.featuresMask is not None else None)
-        lmask = self._place_batch(
-            ds.labelsMask.jax if ds.labelsMask is not None else None)
+        with tracer().span("h2d"):
+            x = self._place_batch(ds.features.jax.astype(self._dtype))
+            y = self._place_batch(ds.labels.jax)
+            fmask = self._place_batch(
+                ds.featuresMask.jax if ds.featuresMask is not None else None)
+            lmask = self._place_batch(
+                ds.labelsMask.jax if ds.labelsMask is not None else None)
         self.lastBatchSize = int(x.shape[0])
         self._lastInput = x      # device ref for StatsListener activations
 
@@ -470,20 +472,24 @@ class MultiLayerNetwork:
                    or "STOCHASTIC_GRADIENT_DESCENT").upper()
         # TBPTT needs per-timestep (rank-3) labels; otherwise fall back to
         # standard BP (reference: doTruncatedBPTT label-rank requirement)
-        if algo != "STOCHASTIC_GRADIENT_DESCENT":
-            # legacy line-search solvers (LBFGS/CG/line GD): one
-            # line-searched iteration per fit call — reference Solver
-            # semantics (optimize/solvers.py)
-            self._runSolverStep(x, y, fmask, lmask, algo)
-        elif (self.conf.backpropType == BackpropType.TruncatedBPTT
-                and x.ndim == 3 and y.ndim == 3
-                and x.shape[2] > self.conf.tbpttFwdLength):
-            self._fitTbptt(x, y, fmask, lmask)
-        else:
-            self._runTrainStep(x, y, fmask, lmask, carries=None)
+        with train_step_span(self, self.lastBatchSize):
+            if algo != "STOCHASTIC_GRADIENT_DESCENT":
+                # legacy line-search solvers (LBFGS/CG/line GD): one
+                # line-searched iteration per fit call — reference Solver
+                # semantics (optimize/solvers.py)
+                self._runSolverStep(x, y, fmask, lmask, algo)
+            elif (self.conf.backpropType == BackpropType.TruncatedBPTT
+                    and x.ndim == 3 and y.ndim == 3
+                    and x.shape[2] > self.conf.tbpttFwdLength):
+                self._fitTbptt(x, y, fmask, lmask)
+            else:
+                self._runTrainStep(x, y, fmask, lmask, carries=None)
         self.iterationCount += 1
-        for l in self._listeners:
-            l.iterationDone(self, self.iterationCount, self.epochCount)
+        if not in_microbatch():
+            # OOM-retry halves share one logical iteration — the
+            # supervisor fires iterationDone ONCE at the step boundary
+            notifyListeners(self._listeners, "iterationDone", self,
+                            self.iterationCount, self.epochCount)
 
     def _runSolverStep(self, x, y, fmask, lmask, algo: str) -> None:
         from jax.flatten_util import ravel_pytree
@@ -709,7 +715,10 @@ class MultiLayerNetwork:
               "roc": ROC}[metric]()
         it.reset()
         while it.hasNext():
-            ds = it.next()
+            # etl_fetch also CONSUMES async-prefetch waits noted in
+            # hasNext — a bare it.next() here would leave them pending to
+            # poison the next training fetch's stall accounting
+            ds = etl_fetch(it)
             out = self.output(ds.features, featuresMask=ds.featuresMask)
             ev.eval(ds.labels.numpy(), out.numpy(),
                     ds.labelsMask.numpy() if ds.labelsMask is not None else None)

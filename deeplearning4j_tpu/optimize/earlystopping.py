@@ -372,7 +372,8 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = TerminationReason.EpochTerminationCondition, ""
 
-        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        from deeplearning4j_tpu.optimize.listeners import (
+            TrainingListener, TrainingStopSignal)
 
         class _IterCheck(TrainingListener):
             stop = None
@@ -384,7 +385,10 @@ class EarlyStoppingTrainer:
                         _IterCheck.stop = str(c)
                         raise _StopTraining()
 
-        class _StopTraining(Exception):
+        class _StopTraining(TrainingStopSignal):
+            # TrainingStopSignal: the train loop's non-fatal listener
+            # wrapper re-raises control-flow signals instead of logging
+            # them away like monitor bugs
             pass
 
         listener = _IterCheck()

@@ -13,9 +13,51 @@ from typing import List, Optional, Tuple
 log = logging.getLogger("deeplearning4j_tpu")
 
 
+class TrainingStopSignal(Exception):
+    """Deliberate listener-driven control flow (early stopping's
+    iteration-termination check): ``notifyListeners`` re-raises it instead
+    of swallowing it like an ordinary listener bug."""
+
+
+def notifyListeners(listeners, method: str, model, *args, **kwargs) -> None:
+    """Invoke one listener hook across all listeners, non-fatally.
+
+    A listener is a MONITOR: a bug in one (a flaky remote stats push, a
+    bad histogram on a diverged tensor) must log a warning and increment
+    ``dl4j_tpu_train_listener_errors_total`` — never kill the training
+    run it watches.  :class:`TrainingStopSignal` (deliberate control
+    flow), ``SimulatedPreemption`` and other BaseExceptions still
+    propagate."""
+    for l in listeners:
+        try:
+            getattr(l, method)(model, *args, **kwargs)
+        except TrainingStopSignal:
+            raise
+        except Exception as e:
+            if getattr(l, "failOnError", False):
+                # side-effecting listeners (checkpoint writers) are NOT
+                # monitors: a run that silently stops producing artifacts
+                # is worse than a dead one
+                raise
+            from deeplearning4j_tpu.telemetry.registry import get_registry
+            get_registry().counter(
+                "dl4j_tpu_train_listener_errors_total",
+                "Listener callback exceptions swallowed by the train "
+                "loop").inc()
+            log.warning("listener %s.%s failed (swallowed): %s: %s",
+                        type(l).__name__, method, type(e).__name__, e)
+
+
 class TrainingListener:
     """SPI: iterationDone / onEpochStart / onEpochEnd / onForwardPass /
-    onBackwardPass / onGradientCalculation."""
+    onBackwardPass / onGradientCalculation.
+
+    ``failOnError`` (class attr): monitors default to False — the train
+    loop swallows their exceptions (warning + counter).  Listeners whose
+    side effects the run DEPENDS on (checkpoint writers) set True so a
+    failure still kills the run."""
+
+    failOnError = False
 
     def iterationDone(self, model, iteration: int, epoch: int) -> None:
         pass
@@ -48,7 +90,18 @@ class ScoreIterationListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """Throughput logging (``PerformanceListener.java``)."""
+    """Throughput logging (``PerformanceListener.java``), registry-backed.
+
+    The train loops dispatch asynchronously (the per-step loss stays an
+    async device scalar), so a naive timestamp here would measure the
+    DISPATCH rate, not device throughput.  On reporting iterations the
+    listener first blocks on the step output (``jax.block_until_ready``
+    on the pending loss scalar) and only then stamps time — samples/sec
+    is device-accurate, and the sync cost is paid once per ``frequency``
+    iterations, not per step.  Rates also land in
+    ``dl4j_tpu_train_throughput_examples_per_second`` /
+    ``dl4j_tpu_train_iterations_per_second`` on the default registry.
+    """
 
     def __init__(self, frequency: int = 10, reportScore: bool = False):
         self.frequency = max(int(frequency), 1)
@@ -57,19 +110,30 @@ class PerformanceListener(TrainingListener):
         self._lastIter = 0
 
     def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        arr = getattr(model, "_scoreArr", None)
+        if arr is not None:
+            import jax
+            jax.block_until_ready(arr)
         now = time.time()
-        if iteration % self.frequency == 0:
-            if self._last is not None and iteration > self._lastIter:
-                dt = now - self._last
-                its = (iteration - self._lastIter) / dt if dt > 0 else 0.0
-                bs = getattr(model, "lastBatchSize", 0)
-                msg = (f"iteration {iteration}; iterations/sec: {its:.2f}; "
-                       f"samples/sec: {its * bs:.2f}")
-                if self.reportScore:
-                    msg += f"; score: {model.score()}"
-                print(msg)
-            self._last = now
-            self._lastIter = iteration
+        if self._last is not None and iteration > self._lastIter:
+            dt = now - self._last
+            its = (iteration - self._lastIter) / dt if dt > 0 else 0.0
+            bs = getattr(model, "lastBatchSize", 0)
+            from deeplearning4j_tpu.telemetry.registry import get_registry
+            reg = get_registry()
+            reg.gauge("dl4j_tpu_train_iterations_per_second",
+                      "Blocked (device-accurate) iterations/sec").set(its)
+            reg.gauge("dl4j_tpu_train_throughput_examples_per_second",
+                      "Blocked (device-accurate) samples/sec").set(its * bs)
+            msg = (f"iteration {iteration}; iterations/sec: {its:.2f}; "
+                   f"samples/sec: {its * bs:.2f}")
+            if self.reportScore:
+                msg += f"; score: {model.score()}"
+            print(msg)
+        self._last = now
+        self._lastIter = iteration
 
 
 class CollectScoresIterationListener(TrainingListener):
@@ -127,6 +191,8 @@ class EvaluativeListener(TrainingListener):
 class CheckpointListener(TrainingListener):
     """Periodic model checkpointing with keep-last-K GC
     (``CheckpointListener.java``)."""
+
+    failOnError = True     # a run with no checkpoints must not look green
 
     def __init__(self, saveDir: str, saveEveryNIterations: int = 0,
                  saveEveryNEpochs: int = 0, keepLast: int = 3):

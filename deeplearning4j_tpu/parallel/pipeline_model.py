@@ -364,9 +364,11 @@ class PipelinedTrainer:
                 self.iterationCount += 1
                 net.iterationCount += 1
                 net._scoreArr = loss
-                for l in getattr(net, "_listeners", []):
-                    l.iterationDone(net, net.iterationCount,
-                                    net.epochCount + ep)
+                from deeplearning4j_tpu.optimize.listeners import \
+                    notifyListeners
+                notifyListeners(getattr(net, "_listeners", []),
+                                "iterationDone", net, net.iterationCount,
+                                net.epochCount + ep)
         net.epochCount += int(epochs)
         self.lastLoss = float(loss) if loss is not None else float("nan")
         self.net._scoreArr = None

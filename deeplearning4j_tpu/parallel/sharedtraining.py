@@ -93,14 +93,21 @@ class _TrainingMaster:
         job, so it plugs in here: pass the trainer's kwargs, e.g.
         ``{"checkpointDir": "/ckpts/run1", "checkpointEveryN": 50}``, and
         a re-launched job auto-resumes from the latest valid step."""
+        from deeplearning4j_tpu.telemetry import get_registry, tracer
         mesh = self.mesh or DeviceMesh()
         wrapper = ParallelWrapper(net, mesh=mesh)
-        if faultConfig is not None:
-            from deeplearning4j_tpu.fault import FaultTolerantTrainer
-            FaultTolerantTrainer(wrapper, **faultConfig).fit(
-                iterator, epochs=epochs)
-            return net
-        wrapper.fit(iterator, epochs=epochs)
+        get_registry().gauge(
+            "dl4j_tpu_parallel_workers",
+            "Data-parallel worker count of the active training master"
+        ).set(mesh.dataSize)
+        with tracer().span("cluster_fit", workers=int(mesh.dataSize),
+                           supervised=faultConfig is not None):
+            if faultConfig is not None:
+                from deeplearning4j_tpu.fault import FaultTolerantTrainer
+                FaultTolerantTrainer(wrapper, **faultConfig).fit(
+                    iterator, epochs=epochs)
+                return net
+            wrapper.fit(iterator, epochs=epochs)
         return net
 
     executeTraining = fitMultiLayerNetwork

@@ -17,11 +17,14 @@ parity and ignored (documented no-ops, SURVEY.md §7.1).
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh, shard_params
+from deeplearning4j_tpu.telemetry import (ReplicaTimingListener,
+                                          get_registry, tracer)
 
 
 class TrainingMode:
@@ -131,6 +134,20 @@ class ParallelWrapper:
             return
         self._fit_dp(iterator, epochs)
 
+    def _timing(self) -> ReplicaTimingListener:
+        """Persistent straggler/contention watcher for this wrapper's mesh:
+        per-replica lockstep step-time gauges + the rolling max/min spread
+        (``dl4j_tpu_parallel_step_time_spread``) matching bench.py's
+        contention flag."""
+        if getattr(self, "_replicaTimer", None) is None:
+            devices = list(self.mesh.mesh.devices.flat)
+            self._replicaTimer = ReplicaTimingListener(devices)
+            get_registry().gauge(
+                "dl4j_tpu_parallel_replicas",
+                "Devices participating in the data-parallel mesh").set(
+                    len(devices))
+        return self._replicaTimer
+
     def fitDataSet(self, ds) -> None:
         """One data-parallel train step on a single batch — the
         FaultTolerantTrainer's per-batch entry point (it owns the epoch
@@ -152,10 +169,14 @@ class ParallelWrapper:
             self._dp_place()
         else:
             net.setBatchSharding(self.mesh.dataSharding())
+        t0 = time.perf_counter()
         try:
-            net.fit(ds)
+            with tracer().span("dp_step",
+                               replicas=int(self.mesh.dataSize)):
+                net.fit(ds)
         finally:
             net.setBatchSharding(None)
+        self._timing().record(time.perf_counter() - t0)
 
     def _needs_place(self) -> bool:
         """Params already living on this mesh (the steady state: the jitted
@@ -197,10 +218,15 @@ class ParallelWrapper:
     def _fit_dp(self, iterator, epochs: int) -> None:
         net = self.model
         self._dp_place()
+        timer = self._timing()
+        net.addListeners(timer)
         try:
-            net.fit(iterator, epochs=epochs)
+            with tracer().span("dp_fit", replicas=int(self.mesh.dataSize),
+                               epochs=int(epochs)):
+                net.fit(iterator, epochs=epochs)
         finally:
             net.setBatchSharding(None)
+            net.removeListener(timer)
 
     def shutdown(self) -> None:
         pass

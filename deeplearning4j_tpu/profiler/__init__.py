@@ -128,19 +128,33 @@ def panic_enabled() -> bool:
 # -- device-level traces (TensorBoard) --------------------------------------
 
 def start_trace(log_dir: str) -> None:
-    """XLA-level profiling via jax.profiler (kernel timings on the chip)."""
+    """XLA-level profiling via jax.profiler (kernel timings on the chip).
+    While active, every ``telemetry.tracer().span(...)`` also enters a
+    ``jax.profiler.TraceAnnotation`` so host spans line up with the
+    kernel timeline in the capture."""
     import jax
+
+    from deeplearning4j_tpu.telemetry import set_device_trace_active
     jax.profiler.start_trace(log_dir)
+    set_device_trace_active(True)
 
 
 def stop_trace() -> None:
     import jax
+
+    from deeplearning4j_tpu.telemetry import set_device_trace_active
+    set_device_trace_active(False)
     jax.profiler.stop_trace()
 
 
 class ProfilingListener:
     """TrainingListener emitting one Chrome-trace slice per iteration
     (reference: autodiff/listeners/profiler/ProfilingListener.java).
+
+    Registry-backed: iteration slices are recorded through the process
+    telemetry :func:`~deeplearning4j_tpu.telemetry.tracer`, so the flushed
+    file is the MERGED trace — the train loop's nested step/h2d/etl/
+    compile spans and the OpProfiler's phase events, one file.
 
     The trace file flushes every ``flushEveryNIterations`` (and on epoch
     end) — a per-iteration rewrite of the cumulative JSON would be O(n²)
@@ -150,14 +164,17 @@ class ProfilingListener:
     def __init__(self, outputPath: str, flushEveryNIterations: int = 100):
         self.outputPath = outputPath
         self.flushEvery = max(1, flushEveryNIterations)
-        self._prof = OpProfiler()
         self._iter_start = None
+
+    #: newest tracer events kept by the cheap PERIODIC flush (epoch end
+    #: writes the full ring) — bounds the hot-loop serialization cost
+    PERIODIC_FLUSH_TAIL = 10_000
 
     def onEpochStart(self, model):
         pass
 
     def onEpochEnd(self, model):
-        self._prof.writeChromeTrace(self.outputPath)
+        self._flush()
 
     def onForwardPass(self, model, activations=None):
         pass
@@ -168,14 +185,20 @@ class ProfilingListener:
     def onGradientCalculation(self, model):
         pass
 
+    def _flush(self, tail=None):
+        from deeplearning4j_tpu.telemetry import tracer
+        tracer().write_chrome_trace(self.outputPath, tail=tail)
+
     def iterationDone(self, model, iteration, epoch):
+        from deeplearning4j_tpu.telemetry import tracer
         now = time.perf_counter()
         if self._iter_start is not None:
-            self._prof._events.append({
-                "name": f"iteration_{iteration}", "ph": "X", "pid": 1,
-                "tid": 1, "ts": (self._iter_start - self._prof._t0) * 1e6,
-                "dur": (now - self._iter_start) * 1e6,
-                "args": {"score": model.score()}})
+            tracer().record_complete(
+                f"iteration_{iteration}", self._iter_start,
+                now - self._iter_start, args={"score": model.score()})
         self._iter_start = now
         if iteration % self.flushEvery == 0:
-            self._prof.writeChromeTrace(self.outputPath)
+            # tail-bounded: the periodic flush exists so the file is fresh
+            # if the run dies, not to re-serialize the entire shared ring
+            # every N iterations in the hot loop
+            self._flush(tail=self.PERIODIC_FLUSH_TAIL)

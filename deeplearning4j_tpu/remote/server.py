@@ -120,6 +120,23 @@ class JsonModelServer:
             def log_message(self, *a):
                 pass
 
+            def do_GET(self):
+                # Prometheus scrape surface: the process-global telemetry
+                # registry (training, fault, parallel, ETL and serving
+                # metrics all land there)
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                from deeplearning4j_tpu.telemetry import get_registry
+                data = get_registry().exposition().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_POST(self):
                 # payload faults are the CLIENT's (400); model-execution
                 # faults are OURS (500); a blown time budget is 504 —
@@ -147,6 +164,11 @@ class JsonModelServer:
                     except Exception as e:
                         body = {"error": f"{type(e).__name__}: {e}"}
                         code = 500
+                from deeplearning4j_tpu.telemetry import get_registry
+                get_registry().counter(
+                    "dl4j_tpu_remote_requests_total",
+                    "Inference requests served, by HTTP status",
+                    labelnames=("code",)).inc(code=str(code))
                 data = json.dumps(body).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")

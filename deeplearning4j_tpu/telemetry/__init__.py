@@ -1,0 +1,33 @@
+"""Unified telemetry spine: metrics registry, span tracing, flight
+recorder.
+
+One place every layer reports through (SURVEY.md §5.1's ``OpProfiler`` /
+``PerformanceListener`` / ``StatsListener`` fragments, unified):
+
+- :mod:`.registry` — counters/gauges/histograms with labels, thread-safe,
+  process-global default; Prometheus text exposition served from
+  ``/metrics`` on both ``remote.JsonModelServer`` and ``ui.UIServer``.
+- :mod:`.tracing` — nested ``span(name, **attrs)`` contexts merged with
+  the ``OpProfiler`` Chrome-trace events into ONE trace file;
+  ``jax.profiler.TraceAnnotation`` attach when a device trace is active.
+- :mod:`.flight` — ring buffer of the last N step records, dumped to JSON
+  on ``InvalidStepException``/divergence/crash (``CrashReportingUtil``
+  analogue).
+- :mod:`.instrument` — the hot-path helpers the model/fault/parallel/ETL
+  layers call.
+
+Metric naming convention (linted by ``tools/lint_telemetry.py``):
+``dl4j_tpu_<subsystem>_<name>``; counters end ``_total``.
+"""
+from deeplearning4j_tpu.telemetry.flight import (  # noqa: F401
+    FlightRecorder, flight_recorder, set_flight_recorder)
+from deeplearning4j_tpu.telemetry.instrument import (  # noqa: F401
+    ReplicaTimingListener, etl_fetch, in_microbatch, microbatch_scope,
+    note_etl_wait, record_crash, record_logical_step, supervised_scope,
+    train_step_span)
+from deeplearning4j_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    get_registry, set_registry)
+from deeplearning4j_tpu.telemetry.tracing import (  # noqa: F401
+    Tracer, device_trace_active, set_device_trace_active, set_tracer,
+    tracer)

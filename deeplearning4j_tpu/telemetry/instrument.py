@@ -1,0 +1,296 @@
+"""Hot-path instrumentation helpers shared by the model/fault/parallel
+layers.
+
+Everything here is designed to be safe in the fused-step hot loop:
+
+- metric lookups are dict-gets under a lock (no allocation churn);
+- the step timer measures HOST wall time around the jitted call — with
+  donated param buffers the next dispatch backpressures on the previous
+  step, so over a window the dispatch rate converges to true device
+  throughput without forcing a per-step ``block_until_ready`` round-trip
+  (the listener-level throughput in
+  :class:`~deeplearning4j_tpu.optimize.listeners.PerformanceListener`
+  DOES block, and is the accurate samples/sec surface);
+- jit cache misses are detected exactly via the jitted function's
+  ``_cache_size()`` delta, so recompiles (new shape, dropped mesh trace)
+  show up as ``dl4j_tpu_train_jit_cache_misses_total`` plus their wall
+  time in ``dl4j_tpu_train_compile_seconds_total`` and a ``compile``
+  span in the merged Chrome trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional, Sequence
+
+from deeplearning4j_tpu.telemetry.flight import flight_recorder
+from deeplearning4j_tpu.telemetry.registry import get_registry
+from deeplearning4j_tpu.telemetry.tracing import tracer
+
+__all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
+           "supervised_scope", "microbatch_scope", "in_microbatch",
+           "record_logical_step", "ReplicaTimingListener"]
+
+# set while a fault supervisor owns the step: a step-level
+# InvalidStepException/panic is then a RECOVERABLE divergence (the
+# supervisor rolls back), not a crash — no dump, no crash counter.
+# The supervisor itself dumps exactly once if recovery finally fails.
+_scope = threading.local()
+
+
+@contextlib.contextmanager
+def supervised_scope():
+    prev = getattr(_scope, "supervised", False)
+    _scope.supervised = True
+    try:
+        yield
+    finally:
+        _scope.supervised = prev
+
+
+@contextlib.contextmanager
+def microbatch_scope():
+    """Active during OOM micro-batch retries: half-batch step times must
+    not enter the replica step-time/spread gauges (a recovered OOM would
+    read as sustained contention for a whole window)."""
+    prev = getattr(_scope, "microbatch", False)
+    _scope.microbatch = True
+    try:
+        yield
+    finally:
+        _scope.microbatch = prev
+
+
+def _jit_cache_size(model) -> Optional[int]:
+    # _trainStep is a cached_property: reading model.__dict__ avoids
+    # triggering the jit-wrapper build just to measure it
+    fn = model.__dict__.get("_trainStep")
+    if fn is None:
+        return 0
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+def _report_step(model, seconds: float, batch_size: int,
+                 **flight_extra) -> None:
+    """The one reporting tail every logical step goes through — normal
+    steps and OOM micro-batch splits must land in the SAME series."""
+    reg = get_registry()
+    reg.counter("dl4j_tpu_train_steps_total",
+                "Logical train steps dispatched").inc()
+    reg.histogram("dl4j_tpu_train_step_seconds",
+                  "Host wall time per logical train step").observe(seconds)
+    if seconds > 0:
+        reg.gauge(
+            "dl4j_tpu_train_examples_per_second",
+            "Dispatch-rate examples/sec (see PerformanceListener for the "
+            "blocked, device-accurate rate)").set(batch_size / seconds)
+    flight_recorder().record(
+        iteration=model.iterationCount, epoch=model.epochCount,
+        step_seconds=round(seconds, 6), batch_size=int(batch_size),
+        **flight_extra)
+
+
+@contextlib.contextmanager
+def train_step_span(model, batch_size: int):
+    """Wrap one logical train step (fused step / TBPTT chunk loop / legacy
+    solver iteration): step counter + step-time histogram + examples/sec
+    gauge + jit-compile accounting + a ``step`` span + a FlightRecorder
+    record.  Crashes inside the step dump the flight ring (see
+    :func:`record_crash`) and re-raise."""
+    if getattr(_scope, "microbatch", False):
+        # OOM-retry half-batches are not logical steps: the supervisor
+        # keeps iterationCount at ONE step for the whole split, so the
+        # step counter/histogram/throughput must not see the halves —
+        # only a trace span marking the retry work
+        with tracer().span("microbatch_step", batch=int(batch_size)):
+            yield
+        return
+    reg = get_registry()
+    before = _jit_cache_size(model)
+    t0 = time.perf_counter()
+    try:
+        with tracer().span("step", iteration=model.iterationCount,
+                           epoch=model.epochCount, batch=int(batch_size)):
+            yield
+    except Exception as e:
+        from deeplearning4j_tpu.optimize.solvers import InvalidStepException
+        if isinstance(e, (InvalidStepException, FloatingPointError)):
+            if getattr(_scope, "supervised", False):
+                # the supervisor will roll back and retry — log the event
+                # in the ring but don't report a crash for a recoverable
+                # divergence (it dumps once itself if recovery fails)
+                flight_recorder().record(
+                    event="invalid_step", reason=f"{type(e).__name__}: {e}",
+                    iteration=model.iterationCount)
+            else:
+                record_crash(f"{type(e).__name__}: {e}", model=model)
+        raise
+    dt = time.perf_counter() - t0
+    after = _jit_cache_size(model)
+    if before is not None and after is not None and after > before:
+        reg.counter(
+            "dl4j_tpu_train_jit_cache_misses_total",
+            "Fused-step executable cache misses (recompiles)").inc(
+                after - before)
+        reg.counter(
+            "dl4j_tpu_train_compile_seconds_total",
+            "Wall seconds of steps that included an XLA compile").inc(dt)
+        tracer().record_complete("compile", t0, dt,
+                                 args={"iteration": model.iterationCount})
+    _report_step(model, dt, batch_size, jit_cache_size=after)
+
+
+def in_microbatch() -> bool:
+    """True inside an OOM micro-batch retry (see :func:`microbatch_scope`);
+    the model train loops use this to defer per-step listener/metric
+    reporting to the supervisor's logical-step boundary."""
+    return getattr(_scope, "microbatch", False)
+
+
+def record_logical_step(model, seconds: float, batch_size: int) -> None:
+    """Count one LOGICAL step completed via micro-batch OOM retry: the
+    halves themselves are skipped (``microbatch_scope``), so the
+    supervisor reports the whole split here — without this the step
+    counter would drift below ``iterationCount`` and the step-time
+    histogram would be missing exactly the slowest steps."""
+    _report_step(model, seconds, batch_size, oom_split=True)
+
+
+def record_crash(reason: str, model=None) -> str:
+    """Append a crash record, mark the trace, and dump the flight ring to
+    JSON (the ``CrashReportingUtil`` analogue).  Returns the dump path."""
+    fr = flight_recorder()
+    rec = {"event": "crash", "reason": reason}
+    if model is not None:
+        rec["iteration"] = getattr(model, "iterationCount", None)
+        rec["epoch"] = getattr(model, "epochCount", None)
+    fr.record(**rec)
+    tracer().instant("crash", reason=reason)
+    get_registry().counter("dl4j_tpu_train_crash_dumps_total",
+                           "FlightRecorder crash dumps written").inc()
+    return fr.dump(reason=reason)
+
+
+def note_etl_wait(seconds: float, owner) -> None:
+    """Record blocking ETL wait incurred outside ``next()``
+    (AsyncDataSetIterator blocks in ``hasNext()`` to populate its peek),
+    charged to ``owner`` — the iterator that blocked — and folded into the
+    next :func:`etl_fetch` ON THAT ITERATOR.  Keying by iterator (not a
+    bare thread-local) keeps a drain that never calls ``etl_fetch`` (a
+    normalizer ``fit`` pass) from leaking its waits into an unrelated
+    fetch; the iterator zeroes its pending on reset."""
+    owner._telemetry_pending_wait = getattr(
+        owner, "_telemetry_pending_wait", 0.0) + float(seconds)
+
+
+def etl_fetch(iterator):
+    """One batch fetch timed as the ETL phase: an ``etl`` trace event, the
+    last-fetch stall gauge, and cumulative stall seconds.  Used by every
+    training loop that drains an iterator, so a slow input pipeline is
+    visible as ``dl4j_tpu_etl_stall_seconds`` regardless of which loop
+    drives it — including async iterators whose blocking happens in
+    ``hasNext`` (handed over via :func:`note_etl_wait`)."""
+    reg = get_registry()
+    pending = getattr(iterator, "_telemetry_pending_wait", 0.0)
+    if pending:
+        iterator._telemetry_pending_wait = 0.0
+    t0 = time.perf_counter()
+    ds = iterator.next()
+    dt = (time.perf_counter() - t0) + pending
+    # start is backdated over the hasNext wait so the trace slice spans
+    # the whole time the loop stood still for data
+    tracer().record_complete("etl", t0 - pending, dt)
+    reg.gauge("dl4j_tpu_etl_stall_seconds",
+              "Host wall time the train loop spent waiting on the last "
+              "batch fetch (async prefetch waits included)").set(dt)
+    reg.counter("dl4j_tpu_etl_stall_seconds_total",
+                "Cumulative seconds the train loop waited on batch "
+                "fetches").inc(dt)
+    return ds
+
+
+class ReplicaTimingListener:
+    """Per-replica step-time gauges + timing-spread gauge for data-parallel
+    fits (attached internally by ``ParallelWrapper``).
+
+    Under GSPMD the step is ONE executable synchronous across replicas, so
+    each replica's step time IS the lockstep wall time; the straggler /
+    contention signal ``bench.py`` flags (``timing_spread``) is the
+    max/min ratio over a rolling window of those lockstep times — a
+    contended window reads as spread, not as a uniform regression."""
+
+    def __init__(self, devices: Sequence, window: int = 20):
+        self._device_ids = [str(getattr(d, "id", i))
+                            for i, d in enumerate(devices)]
+        self._window = max(2, int(window))
+        self._times = []
+        self._last = None
+        self._etl_mark = None
+
+    def _etl_total(self) -> float:
+        c = get_registry().get("dl4j_tpu_etl_stall_seconds_total")
+        return c.value() if c is not None else 0.0
+
+    # TrainingListener duck-typed surface (only the hooks it needs)
+    def onEpochStart(self, model):
+        # epoch boundaries (iterator reset, async-producer drain/join) are
+        # not step time — restart the inter-iteration clock so the gap
+        # can't masquerade as a straggler in the spread gauge
+        self._last = None
+
+    def onEpochEnd(self, model):
+        self._last = None
+
+    def onForwardPass(self, model, activations=None):
+        pass
+
+    def onBackwardPass(self, model):
+        pass
+
+    def onGradientCalculation(self, model):
+        pass
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.perf_counter()
+        etl_now = self._etl_total()
+        if self._last is None:
+            self._last, self._etl_mark = now, etl_now
+            return
+        # the inter-iteration interval contains one batch fetch — subtract
+        # the ETL counter's delta so a slow fetch (cold cache, starved
+        # prefetcher) doesn't read as device contention in the spread;
+        # this keeps one semantics with the fitDataSet path, which times
+        # the step call alone
+        dt = max(now - self._last - (etl_now - (self._etl_mark or 0.0)),
+                 0.0)
+        self._last, self._etl_mark = now, etl_now
+        if dt > 0:
+            self.record(dt)
+
+    def record(self, dt: float) -> None:
+        """Feed one lockstep step time directly (the per-batch
+        ``fitDataSet`` path times the step call itself so supervisor
+        overhead between batches doesn't pollute the gauge)."""
+        if getattr(_scope, "microbatch", False):
+            return      # OOM half-batches are not representative steps
+        reg = get_registry()
+        g = reg.gauge("dl4j_tpu_parallel_replica_step_seconds",
+                      "Lockstep per-replica step wall time",
+                      labelnames=("replica",))
+        for rid in self._device_ids:
+            g.set(dt, replica=rid)
+        self._times.append(dt)
+        if len(self._times) > self._window:
+            self._times.pop(0)
+        if len(self._times) >= 2:
+            lo = min(self._times)
+            if lo > 0:
+                reg.gauge(
+                    "dl4j_tpu_parallel_step_time_spread",
+                    "max/min step time over a rolling window (bench.py's "
+                    "contention flag fires above 2.0)").set(
+                        max(self._times) / lo)

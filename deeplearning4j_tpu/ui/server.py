@@ -147,6 +147,13 @@ class UIServer:
                     self.wfile.write(data)
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    # Prometheus scrape surface (same process-global
+                    # registry the remote JsonModelServer serves)
+                    from deeplearning4j_tpu.telemetry import get_registry
+                    self._send(get_registry().exposition(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                    return
                 sessions = server._sessions()
                 if self.path == "/train/sessions":
                     self._send(json.dumps(list(sessions)),

@@ -12,8 +12,9 @@ append-only JSONL instead of MapDB — readable by anything.
 from __future__ import annotations
 
 import json
+import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -35,17 +36,42 @@ class StatsStorage:
 
 
 class InMemoryStatsStorage(StatsStorage):
-    def __init__(self):
-        self._data: Dict[str, List[dict]] = defaultdict(list)
+    """Bounded in-memory storage: keeps the newest ``maxRecordsPerSession``
+    updates per session (default 10k) so a long or runaway run cannot grow
+    the monitoring process without limit — dropped records are counted in
+    ``dl4j_tpu_ui_stats_records_dropped_total``."""
+
+    def __init__(self, maxRecordsPerSession: int = 10_000):
+        if maxRecordsPerSession < 1:
+            raise ValueError("maxRecordsPerSession must be >= 1")
+        self.maxRecordsPerSession = int(maxRecordsPerSession)
+        self._data: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=self.maxRecordsPerSession))
+        # UIServer's ThreadingHTTPServer reads while trainers write: the
+        # full-check + append must be atomic or evictions go uncounted,
+        # and deques (unlike lists) raise if iterated during an append,
+        # so the read-side snapshots take the same lock
+        self._lock = threading.Lock()
 
     def putUpdate(self, sessionId, update):
-        self._data[sessionId].append(update)
+        with self._lock:
+            q = self._data[sessionId]
+            dropped = len(q) == self.maxRecordsPerSession
+            q.append(update)
+        if dropped:
+            from deeplearning4j_tpu.telemetry import get_registry
+            get_registry().counter(
+                "dl4j_tpu_ui_stats_records_dropped_total",
+                "Oldest stats updates evicted by the per-session "
+                "retention bound").inc()
 
     def listSessionIDs(self):
-        return list(self._data)
+        with self._lock:
+            return list(self._data)
 
     def getUpdates(self, sessionId):
-        return list(self._data[sessionId])
+        with self._lock:
+            return list(self._data[sessionId])
 
 
 class FileStatsStorage(StatsStorage):

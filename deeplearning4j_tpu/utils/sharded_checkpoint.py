@@ -248,6 +248,11 @@ class ShardedCheckpointer:
         for step in sorted(self._mgr.all_steps(), reverse=True):
             if self.verifyStep(step):
                 return int(step)
+            from deeplearning4j_tpu.telemetry.registry import get_registry
+            get_registry().counter(
+                "dl4j_tpu_fault_corrupt_manifests_skipped_total",
+                "Checkpoint steps skipped on restore because the "
+                "checksum manifest failed to verify").inc()
             log.warning(
                 "checkpoint step %d failed checksum verification; "
                 "falling back to an earlier step", step)

@@ -44,6 +44,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fault: fault-tolerance tests (supervisor recovery "
         "paths driven by the deterministic injection harness)")
+    config.addinivalue_line(
+        "markers", "telemetry: telemetry-spine tests (metrics registry, "
+        "/metrics exposition, span tracing, flight recorder)")
 
 
 def pytest_collection_modifyitems(config, items):

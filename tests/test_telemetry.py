@@ -1,0 +1,530 @@
+"""Telemetry spine: registry semantics, /metrics exposition, span tracing
+merged with the OpProfiler trace, flight-recorder crash dumps, and the
+instrumented training/fault/parallel/ETL paths (driven by the
+deterministic fault-injection harness — no real faults, no sleeps)."""
+import json
+import re
+import sys
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (FaultTolerantTrainer, Fault,
+                                      NaNAtStep, OOMAtStep,
+                                      TrainingDivergedError, inject)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                          Tracer, flight_recorder,
+                                          get_registry, tracer)
+from deeplearning4j_tpu.telemetry.registry import Counter
+
+pytestmark = pytest.mark.telemetry
+
+_TOOLS = Path(__file__).resolve().parent.parent / "tools"
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry(tmp_path):
+    """Swap fresh process-global registry/tracer/flight-recorder per test
+    (the spine is process-global by design; tests must not share it)."""
+    prev_reg = telemetry.set_registry(MetricsRegistry())
+    prev_tr = telemetry.set_tracer(Tracer())
+    prev_fr = telemetry.set_flight_recorder(
+        FlightRecorder(capacity=64, dumpDir=str(tmp_path)))
+    yield
+    telemetry.set_registry(prev_reg)
+    telemetry.set_tracer(prev_tr)
+    telemetry.set_flight_recorder(prev_fr)
+
+
+def _net(seed=42, lr=0.01):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer.builder().nIn(4).nOut(8)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iterator(batch=32, n=128):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    cls = np.clip((x.sum(1) > 0).astype(int) + (x[:, 0] > 1).astype(int),
+                  0, 2)
+    return ListDataSetIterator(
+        [DataSet(x, np.eye(3, dtype=np.float32)[cls])], batch=batch)
+
+
+# ------------------------------------------------------------- registry ----
+
+class TestRegistry:
+    def test_counter_semantics(self):
+        reg = get_registry()
+        c = reg.counter("dl4j_tpu_test_things_total", "help text")
+        c.inc()
+        c.inc(2.5)
+        assert c.value() == pytest.approx(3.5)
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        # idempotent re-registration returns the same instance
+        assert reg.counter("dl4j_tpu_test_things_total") is c
+        # a type conflict on the same name is a bug, not a new metric
+        with pytest.raises(ValueError):
+            reg.gauge("dl4j_tpu_test_things_total")
+
+    def test_labels_and_cardinality(self):
+        reg = get_registry()
+        c = reg.counter("dl4j_tpu_test_req_total", labelnames=("code",))
+        c.inc(code="200")
+        c.inc(code="200")
+        c.inc(code="500")
+        assert c.value(code="200") == 2
+        with pytest.raises(ValueError):    # undeclared label
+            c.inc(verb="GET")
+        tight = Counter("dl4j_tpu_test_tight_total", labelnames=("k",),
+                        maxLabelSets=3)
+        for i in range(3):
+            tight.inc(k=str(i))
+        with pytest.raises(ValueError, match="cardinality"):
+            tight.inc(k="overflow")
+
+    def test_histogram_bucket_mismatch_raises(self):
+        reg = get_registry()
+        reg.histogram("dl4j_tpu_test_bm_seconds", buckets=(0.1, 1.0))
+        assert reg.histogram("dl4j_tpu_test_bm_seconds",
+                             buckets=(1.0, 0.1)) is not None  # same set
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("dl4j_tpu_test_bm_seconds", buckets=(0.5,))
+
+    def test_histogram_buckets(self):
+        h = get_registry().histogram("dl4j_tpu_test_lat_seconds",
+                                     buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        counts = h.bucketCounts()
+        assert counts[0.1] == 1                  # cumulative le semantics
+        assert counts[1.0] == 3
+        assert counts[10.0] == 4
+        assert counts[float("inf")] == 5
+        assert h.count() == 5
+        assert h.sum() == pytest.approx(56.05)
+
+    def test_exposition_parses(self):
+        reg = get_registry()
+        reg.counter("dl4j_tpu_test_a_total").inc()
+        reg.gauge("dl4j_tpu_test_b", labelnames=("x",)).set(1.5, x="q v")
+        reg.histogram("dl4j_tpu_test_c_seconds",
+                      buckets=(1.0,)).observe(0.5)
+        text = reg.exposition()
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+            r'(-?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)$')
+        lines = [ln for ln in text.splitlines() if ln]
+        assert lines, "empty exposition"
+        for ln in lines:
+            if ln.startswith("#"):
+                assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:]", ln), ln
+            else:
+                assert sample.match(ln), f"unparseable sample line: {ln!r}"
+        assert 'dl4j_tpu_test_c_seconds_bucket{le="+Inf"} 1' in text
+        assert "dl4j_tpu_test_c_seconds_count 1" in text
+
+
+# ------------------------------------------------- instrumented training ----
+
+class TestTrainingInstrumentation:
+    def test_step_metrics_and_flight_records(self):
+        net = _net()
+        net.fit(_iterator(), epochs=1)           # 4 steps
+        reg = get_registry()
+        assert reg.get("dl4j_tpu_train_steps_total").value() == 4
+        assert reg.get("dl4j_tpu_train_step_seconds").count() == 4
+        assert reg.get("dl4j_tpu_train_jit_cache_misses_total").value() >= 1
+        assert reg.get("dl4j_tpu_train_compile_seconds_total").value() > 0
+        assert reg.get("dl4j_tpu_train_examples_per_second").value() > 0
+        assert reg.get("dl4j_tpu_etl_stall_seconds_total").value() > 0
+        recs = flight_recorder().snapshot()
+        assert len(recs) == 4
+        assert recs[-1]["batch_size"] == 32
+        names = {e["name"] for e in tracer().events()}
+        assert {"step", "h2d", "etl", "compile"} <= names
+
+    def test_listener_exceptions_are_nonfatal(self):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+        class Bomb(TrainingListener):
+            def iterationDone(self, model, iteration, epoch):
+                raise RuntimeError("boom")
+
+            def onEpochEnd(self, model):
+                raise RuntimeError("boom")
+
+        net = _net()
+        net.setListeners(Bomb())
+        net.fit(_iterator(), epochs=1)           # must not raise
+        assert net.iterationCount == 4
+        errs = get_registry().get("dl4j_tpu_train_listener_errors_total")
+        assert errs.value() == 5                 # 4 iterations + epoch end
+
+    def test_fail_on_error_listener_still_fatal(self):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+        class Checkpointish(TrainingListener):
+            failOnError = True   # side-effecting: must NOT be swallowed
+
+            def iterationDone(self, model, iteration, epoch):
+                raise OSError("disk full")
+
+        net = _net()
+        net.setListeners(Checkpointish())
+        with pytest.raises(OSError, match="disk full"):
+            net.fit(_iterator(), epochs=1)
+
+    def test_performance_listener_blocked_throughput(self, capsys):
+        from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+        net = _net()
+        net.setListeners(PerformanceListener(frequency=1))
+        net.fit(_iterator(), epochs=1)
+        g = get_registry().get(
+            "dl4j_tpu_train_throughput_examples_per_second")
+        assert g is not None and g.value() > 0
+        assert "samples/sec" in capsys.readouterr().out
+
+
+# ------------------------------------------------------- fault telemetry ----
+
+class TestFaultTelemetry:
+    def test_fault_run_exposes_metrics_over_http_and_merged_trace(
+            self, tmp_path):
+        """The ISSUE acceptance path: a fault-injected run exposes non-zero
+        nan-rollback and oom-retry counters plus the step-time histogram
+        through an HTTP GET of /metrics, and one merged Chrome trace holds
+        step + recovery (nested restore) spans."""
+        from deeplearning4j_tpu.remote import JsonModelServer
+        net = _net()
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2, keepLast=10)
+        with inject(NaNAtStep(3), OOMAtStep(5)):
+            t.fit(_iterator(), epochs=2)
+        assert t.stats["rollbacks"] == 1 and t.stats["oomSplits"] == 1
+
+        server = JsonModelServer(net, port=0).start()
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics",
+                timeout=10).read().decode()
+        finally:
+            server.stop()
+        m = re.search(r"^dl4j_tpu_fault_nan_rollbacks_total (\S+)$", text,
+                      re.M)
+        assert m and float(m.group(1)) > 0
+        m = re.search(r"^dl4j_tpu_fault_oom_retries_total (\S+)$", text,
+                      re.M)
+        assert m and float(m.group(1)) > 0
+        assert "dl4j_tpu_train_step_seconds_bucket" in text
+        assert "dl4j_tpu_fault_restore_seconds_bucket" in text
+
+        out = tmp_path / "merged_trace.json"
+        tracer().write_chrome_trace(str(out))
+        events = json.loads(out.read_text())["traceEvents"]
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e["name"], []).append(e)
+        assert "step" in by_name and "recovery" in by_name
+        # the recovery span NESTS the checkpoint restore it performed
+        rec = by_name["recovery"][0]
+        restore = by_name["checkpoint_restore"][-1]
+        assert rec["ts"] <= restore["ts"]
+        assert restore["ts"] + restore["dur"] <= rec["ts"] + rec["dur"] + 1
+
+    def test_ui_server_serves_metrics(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+        get_registry().counter("dl4j_tpu_test_seen_total").inc()
+        server = UIServer(port=0)
+        server.attach(InMemoryStatsStorage())
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics",
+                timeout=10).read().decode()
+        finally:
+            server.stop()
+        assert "dl4j_tpu_test_seen_total 1" in text
+
+    def test_flight_recorder_dumps_on_invalid_step(self, tmp_path):
+        from deeplearning4j_tpu.optimize.solvers import InvalidStepException
+
+        class InvalidAtStep(Fault):
+            def __init__(self, step):
+                self.step = step
+
+            def before_step(self, step, net, ds):
+                if step == self.step:
+                    raise InvalidStepException("injected invalid step")
+
+        net = _net()
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2, maxRollbacks=0)
+        with inject(InvalidAtStep(2)):
+            with pytest.raises(TrainingDivergedError):
+                t.fit(_iterator(), epochs=1)
+        fr = flight_recorder()
+        assert fr.lastDumpPath, "no crash dump written"
+        dump = json.loads(Path(fr.lastDumpPath).read_text())
+        assert "invalid step" in dump["reason"]
+        events = [r.get("event") for r in dump["records"]]
+        assert "rollback" in events and "crash" in events
+        assert any(r.get("step_seconds") is not None
+                   for r in dump["records"]), "no step records in dump"
+        # exactly ONE dump for one terminal failure (the supervisor owns
+        # the dump; the step wrapper must not also fire per attempt)
+        dumps = list(Path(fr.dumpDir).glob("dl4j_tpu_flight_*.json"))
+        assert len(dumps) == 1, dumps
+
+    def test_recovered_invalid_step_is_not_a_crash(self, tmp_path):
+        from deeplearning4j_tpu.optimize.solvers import InvalidStepException
+
+        class InvalidOnce(Fault):
+            def __init__(self, step):
+                self.step, self.fired = step, False
+
+            def before_step(self, step, net, ds):
+                if step == self.step and not self.fired:
+                    self.fired = True
+                    raise InvalidStepException("transient")
+
+        net = _net()
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2, maxRollbacks=2)
+        with inject(InvalidOnce(2)):
+            t.fit(_iterator(), epochs=1)       # recovers via rollback
+        assert t.stats["rollbacks"] == 1
+        fr = flight_recorder()
+        assert fr.lastDumpPath is None, "recoverable divergence dumped"
+        c = get_registry().get("dl4j_tpu_train_crash_dumps_total")
+        assert c is None or c.value() == 0
+
+    def test_oom_split_counts_one_logical_step_and_one_listener_fire(
+            self, tmp_path):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+        class Counts(TrainingListener):
+            fired = []
+
+            def iterationDone(self, model, iteration, epoch):
+                Counts.fired.append(iteration)
+
+        Counts.fired = []
+        net = _net()
+        net.setListeners(Counts())
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2)
+        with inject(OOMAtStep(2)):
+            t.fit(_iterator(), epochs=1)        # 4 logical steps
+        # one iterationDone per LOGICAL step, no duplicate for the halves
+        assert Counts.fired == [1, 2, 3, 4]
+        reg = get_registry()
+        assert reg.get("dl4j_tpu_train_steps_total").value() == 4
+        assert reg.get("dl4j_tpu_train_step_seconds").count() == 4
+        # the split itself is visible in the flight ring
+        assert any(r.get("oom_split") for r in flight_recorder().snapshot())
+
+    def test_corrupt_manifest_skip_counted(self, tmp_path):
+        from deeplearning4j_tpu.fault import corrupt_checkpoint
+        net = _net()
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2, keepLast=10)
+        t.fit(_iterator(), epochs=1)
+        corrupt_checkpoint(str(tmp_path / "ck"), 4)
+        net2 = _net()
+        FaultTolerantTrainer(net2, str(tmp_path / "ck")).fit(
+            _iterator(), epochs=1)
+        c = get_registry().get(
+            "dl4j_tpu_fault_corrupt_manifests_skipped_total")
+        assert c is not None and c.value() >= 1
+
+
+# --------------------------------------------------- parallel / ETL / UI ----
+
+class TestParallelAndEtl:
+    def test_parallel_fit_sets_replica_and_spread_gauges(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        net = _net()
+        ParallelWrapper(net).fit(_iterator(), epochs=1)
+        reg = get_registry()
+        g = reg.get("dl4j_tpu_parallel_replica_step_seconds")
+        assert g is not None
+        import jax
+        assert g.value(replica=str(jax.devices()[0].id)) > 0
+        assert reg.get("dl4j_tpu_parallel_step_time_spread").value() >= 1.0
+        assert reg.get("dl4j_tpu_parallel_replicas").value() == \
+            len(jax.devices())
+
+    def test_async_iterator_queue_gauges(self):
+        from deeplearning4j_tpu.datavec import AsyncDataSetIterator
+        from deeplearning4j_tpu.telemetry import etl_fetch
+        it = AsyncDataSetIterator(_iterator(), queueSize=2)
+        n = 0
+        while it.hasNext():
+            etl_fetch(it)
+            n += 1
+        assert n == 4
+        reg = get_registry()
+        assert reg.get("dl4j_tpu_etl_queue_depth") is not None
+        assert reg.get("dl4j_tpu_etl_prefetch_wait_seconds").value() >= 0
+        # the hasNext() block time is handed into the etl accounting, so
+        # an input-bound async pipeline cannot read as stall-free
+        assert reg.get("dl4j_tpu_etl_stall_seconds_total").value() > 0
+
+    def test_async_hasnext_wait_lands_in_etl_gauge(self):
+        import time as _t
+
+        from deeplearning4j_tpu.datavec import AsyncDataSetIterator
+        from deeplearning4j_tpu.telemetry import etl_fetch
+
+        class SlowIter(type(_iterator())):
+            def next(self, num=0):
+                _t.sleep(0.05)       # slow producer -> consumer waits in
+                return super().next(num)  # the async hasNext(), not next()
+
+        src = _iterator()
+        slow = SlowIter(list(src._ds))
+        it = AsyncDataSetIterator(slow, queueSize=1)
+        assert it.hasNext()
+        etl_fetch(it)
+        g = get_registry().get("dl4j_tpu_etl_stall_seconds")
+        assert g is not None and g.value() >= 0.01
+
+    def test_raw_drain_waits_do_not_leak_into_next_fetch(self):
+        import time as _t
+
+        from deeplearning4j_tpu.datavec import AsyncDataSetIterator
+        from deeplearning4j_tpu.telemetry import etl_fetch
+
+        class SlowIter(type(_iterator())):
+            def next(self, num=0):
+                _t.sleep(0.05)
+                return super().next(num)
+
+        # a raw hasNext()/next() drain (what a normalizer fit does) books
+        # waits on the iterator it drained — after reset, the first real
+        # etl_fetch must start clean, not inherit the whole drain
+        it = AsyncDataSetIterator(SlowIter(list(_iterator()._ds)),
+                                  queueSize=1)
+        while it.hasNext():
+            it.next()
+        it.reset()
+        assert it.hasNext()
+        etl_fetch(it)
+        total = get_registry().get("dl4j_tpu_etl_stall_seconds_total")
+        # one fetch's wait (~0.05s), not the 4-batch drain's (~0.2s)
+        assert total.value() < 0.15
+
+        # and waits never cross iterators: the drained-but-never-fetched
+        # iterator can't pollute an unrelated fast one
+        fast = AsyncDataSetIterator(_iterator(), queueSize=2)
+        assert fast.hasNext()
+        before = total.value()
+        etl_fetch(fast)
+        assert total.value() - before < 0.05
+
+    def test_inmemory_stats_retention_bound(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage
+        st = InMemoryStatsStorage(maxRecordsPerSession=5)
+        for i in range(12):
+            st.putUpdate("s", {"iteration": i})
+        ups = st.getUpdates("s")
+        assert len(ups) == 5
+        assert [u["iteration"] for u in ups] == [7, 8, 9, 10, 11]
+        dropped = get_registry().get(
+            "dl4j_tpu_ui_stats_records_dropped_total")
+        assert dropped.value() == 7
+
+
+# ------------------------------------------------------- tracer / tools ----
+
+class TestTracerAndTools:
+    def test_nested_spans_merge_with_profiler_trace(self, tmp_path):
+        from deeplearning4j_tpu.profiler import OpProfiler
+        tr = tracer()
+        with tr.span("outer", job="x"):
+            with tr.span("inner"):
+                pass
+        with OpProfiler.getInstance().phase("legacy_phase"):
+            pass
+        out = tmp_path / "merged.json"
+        tr.write_chrome_trace(str(out))
+        events = json.loads(out.read_text())["traceEvents"]
+        names = [e["name"] for e in events]
+        assert {"outer", "inner", "legacy_phase"} <= set(names)
+        outer = next(e for e in events if e["name"] == "outer")
+        inner = next(e for e in events if e["name"] == "inner")
+        assert inner["args"]["depth"] == outer["args"]["depth"] + 1
+        assert outer["ts"] <= inner["ts"]
+        assert inner["dur"] <= outer["dur"]
+
+    def test_merged_profiler_events_are_epoch_aligned(self, tmp_path):
+        """OpProfiler's ts epoch differs from the tracer's (and moves on
+        reset()); the merge must shift phases into the tracer's timeline
+        or they render minutes away from the spans they overlapped."""
+        from deeplearning4j_tpu.profiler import OpProfiler
+        prof = OpProfiler.getInstance()
+        prof.reset()                       # re-zeros the profiler epoch
+        tr = tracer()
+        with tr.span("around"):
+            with prof.phase("phase_inside"):
+                pass
+        out = tmp_path / "aligned.json"
+        tr.write_chrome_trace(str(out))
+        events = json.loads(out.read_text())["traceEvents"]
+        span = next(e for e in events if e["name"] == "around")
+        phase = next(e for e in events if e["name"] == "phase_inside")
+        assert span["ts"] <= phase["ts"] + 1
+        assert phase["ts"] + phase["dur"] <= span["ts"] + span["dur"] + 1
+
+    def test_tracer_ring_is_bounded(self):
+        t = Tracer(maxEvents=10)
+        for i in range(25):
+            t.record_complete(f"e{i}", 0.0, 0.001)
+        ev = t.events()
+        assert len(ev) == 10 and ev[0]["name"] == "e15"
+
+    def test_flight_ring_is_bounded(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(20):
+            fr.record(i=i)
+        snap = fr.snapshot()
+        assert len(snap) == 8 and snap[0]["i"] == 12
+
+    def test_lint_telemetry_and_check_markers_pass(self):
+        sys.path.insert(0, str(_TOOLS))
+        try:
+            import check_markers
+            import lint_telemetry
+            assert lint_telemetry.main(["lint_telemetry.py"]) == 0
+            assert check_markers.main(["check_markers.py"]) == 0
+        finally:
+            sys.path.remove(str(_TOOLS))
+
+    def test_naming_convention_rejects_bad_names(self, tmp_path):
+        sys.path.insert(0, str(_TOOLS))
+        try:
+            import lint_telemetry
+            bad = tmp_path / "bad.py"
+            bad.write_text(
+                'reg.counter("dl4j_tpu_train_steps")\n'      # no _total
+                'reg.gauge("queue_depth")\n')                # no prefix
+            errors = lint_telemetry.lint(tmp_path)
+            assert len(errors) == 2
+        finally:
+            sys.path.remove(str(_TOOLS))

@@ -19,6 +19,11 @@ BUILTIN = {
     "filterwarnings", "no_cover",
 }
 
+# gating markers the suite RELIES on: if one of these silently vanishes
+# from conftest registration, `-m <marker>` selects nothing and that whole
+# subsystem's coverage evaporates without a red test
+REQUIRED = {"tpu", "slow", "fault", "telemetry"}
+
 MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_]\w*)")
 REGISTER_RE = re.compile(
     r'addinivalue_line\(\s*["\']markers["\']\s*,\s*["\']([A-Za-z_]\w*)')
@@ -34,7 +39,15 @@ def registered_markers(tests_dir: Path) -> set:
 def main(argv) -> int:
     tests_dir = Path(argv[1]) if len(argv) > 1 else \
         Path(__file__).resolve().parent.parent / "tests"
-    allowed = BUILTIN | registered_markers(tests_dir)
+    registered = registered_markers(tests_dir)
+    missing = REQUIRED - registered
+    if missing:
+        for name in sorted(missing):
+            print(f"{tests_dir / 'conftest.py'}: required gating marker "
+                  f"'{name}' is not registered (pytest_configure "
+                  "addinivalue_line)", file=sys.stderr)
+        return 1
+    allowed = BUILTIN | registered
     bad = []
     for path in sorted(tests_dir.rglob("test_*.py")):
         for lineno, line in enumerate(path.read_text().splitlines(), 1):

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Lint the telemetry metric namespace.
+
+Scans every registry registration call in ``deeplearning4j_tpu/`` —
+``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` — and
+fails unless each public metric name follows the naming convention:
+
+- ``dl4j_tpu_<subsystem>_<name>`` (lower-snake, at least one subsystem
+  segment between the prefix and the name);
+- counters end in ``_total`` (Prometheus counter convention: rate() and
+  increase() assume it);
+- gauges and histograms do NOT end in ``_total`` (a gauge named like a
+  counter lies to every recording rule that touches it);
+- histograms measuring time end in ``_seconds`` (base-unit rule).
+
+A drifting metric name is an outage for every dashboard/alert built on
+the old one — this lint makes the convention a CI property, not a review
+nitpick.  Run: ``python tools/lint_telemetry.py`` (exercised by
+tests/test_telemetry.py so it rides tier-1).
+"""
+import re
+import sys
+from pathlib import Path
+
+NAME_PATTERN = re.compile(r"^dl4j_tpu_[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+CALL_RE = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*\n?\s*[\"']([^\"']+)[\"']")
+
+
+def lint(pkg_dir: Path):
+    errors = []
+    for path in sorted(pkg_dir.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in CALL_RE.finditer(text):
+            kind, name = m.group(1), m.group(2)
+            line = text.count("\n", 0, m.start()) + 1
+            where = f"{path}:{line}"
+            if not NAME_PATTERN.match(name):
+                errors.append(
+                    f"{where}: {kind} {name!r} does not match "
+                    "dl4j_tpu_<subsystem>_<name> (lower-snake)")
+                continue
+            if kind == "counter" and not name.endswith("_total"):
+                errors.append(
+                    f"{where}: counter {name!r} must end in '_total'")
+            if kind in ("gauge", "histogram") and name.endswith("_total"):
+                errors.append(
+                    f"{where}: {kind} {name!r} must not end in '_total' "
+                    "(reserved for counters)")
+            if kind == "histogram" and not name.endswith(
+                    ("_seconds", "_bytes", "_examples")):
+                errors.append(
+                    f"{where}: histogram {name!r} must carry a base-unit "
+                    "suffix (_seconds/_bytes/_examples)")
+    return errors
+
+
+def main(argv) -> int:
+    pkg_dir = Path(argv[1]) if len(argv) > 1 else \
+        Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
+    errors = lint(pkg_dir)
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1
+    n = sum(len(CALL_RE.findall(p.read_text(encoding="utf-8")))
+            for p in pkg_dir.rglob("*.py"))
+    print(f"lint_telemetry: OK ({n} metric registration sites)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
