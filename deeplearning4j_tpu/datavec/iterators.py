@@ -171,12 +171,33 @@ class AsyncDataSetIterator(DataSetIterator):
         self._telemetry_pending_wait = 0.0
 
         def produce():
+            # liveness signal for the watchdog's starvation rule: depth 0
+            # with an ACTIVE producer is starvation; depth 0 after the
+            # producer exited is just a drained epoch.  Registration is
+            # guarded INSIDE the sentinel-guaranteeing structure — a
+            # telemetry failure (e.g. a conflicting registration of this
+            # name) must degrade to "no gauge", never to a consumer
+            # blocked forever on a queue that never sees _END
+            active = None
             try:
+                try:
+                    from deeplearning4j_tpu.telemetry import get_registry
+                    active = get_registry().gauge(
+                        "dl4j_tpu_etl_producer_active",
+                        "Async prefetch producer threads currently running")
+                    active.inc()
+                except Exception:
+                    active = None
                 while self.wrapped.hasNext():
                     self._q.put(self.wrapped.next())
             except BaseException as e:  # surface in the consumer, not stderr
                 self._q.put(e)
             finally:
+                if active is not None:
+                    try:
+                        active.dec()
+                    except Exception:
+                        pass
                 self._q.put(self._END)
 
         self._thread = threading.Thread(target=produce, daemon=True)
@@ -190,12 +211,34 @@ class AsyncDataSetIterator(DataSetIterator):
             reg = get_registry()
             # depth BEFORE the blocking get: 0 here means the device loop
             # is outrunning host ETL (the producer is the bottleneck)
+            depth = self._q.qsize()
             reg.gauge(
                 "dl4j_tpu_etl_queue_depth",
-                "Prefetch-queue depth observed by the consumer").set(
-                    self._q.qsize())
+                "Prefetch-queue depth observed by the consumer").set(depth)
+            waiting = None
+            if depth == 0:
+                # starvation signals: the consumer arrived at an EMPTY
+                # queue and is about to block.  The counter makes each
+                # starved arrival countable; the waiting gauge is LIVE
+                # for the duration of the block — the watchdog's
+                # EtlStarvationRule keys on it because the depth gauge
+                # goes stale between polls (a consumer busy compiling
+                # for minutes must not read as starved)
+                reg.counter(
+                    "dl4j_tpu_etl_queue_empty_polls_total",
+                    "Consumer polls that found the prefetch queue "
+                    "empty").inc()
+                waiting = reg.gauge(
+                    "dl4j_tpu_etl_consumers_waiting",
+                    "Consumers currently blocked on an empty prefetch "
+                    "queue")
+                waiting.inc()
             t0 = _time.perf_counter()
-            self._peek = self._q.get()
+            try:
+                self._peek = self._q.get()
+            finally:
+                if waiting is not None:
+                    waiting.dec()
             wait = _time.perf_counter() - t0
             # the blocking wait lives HERE (hasNext populates the peek),
             # not in next() — hand it to the next etl_fetch so the etl

@@ -8,6 +8,7 @@ corruption, device OOM, slow/failing data fetches).
 from deeplearning4j_tpu.fault.injection import (  # noqa: F401
     CorruptCheckpointAtStep, FailingFetch, Fault, FaultInjector, InjectedOOM,
     NaNAtStep, OOMAtStep, PreemptAtStep, SimulatedPreemption, SlowFetch,
-    clear_injector, corrupt_checkpoint, get_injector, inject, set_injector)
+    StallAtStep, clear_injector, corrupt_checkpoint, get_injector, inject,
+    set_injector)
 from deeplearning4j_tpu.fault.supervisor import (  # noqa: F401
     FaultTolerantTrainer, TrainingDivergedError, is_oom_error)

@@ -33,9 +33,9 @@ import numpy as np
 
 __all__ = [
     "SimulatedPreemption", "InjectedOOM", "Fault", "NaNAtStep",
-    "PreemptAtStep", "OOMAtStep", "CorruptCheckpointAtStep", "FailingFetch",
-    "SlowFetch", "FaultInjector", "set_injector", "get_injector",
-    "clear_injector", "inject", "corrupt_checkpoint",
+    "PreemptAtStep", "OOMAtStep", "StallAtStep", "CorruptCheckpointAtStep",
+    "FailingFetch", "SlowFetch", "FaultInjector", "set_injector",
+    "get_injector", "clear_injector", "inject", "corrupt_checkpoint",
 ]
 
 
@@ -123,6 +123,26 @@ class OOMAtStep(Fault):
         if step == self.step and self.times > 0:
             self.times -= 1
             raise InjectedOOM(f"step {step}")
+
+
+class StallAtStep(Fault):
+    """Freeze the training loop for ``seconds`` right before step ``step``
+    — a deterministic stand-in for a hung collective / wedged host.  The
+    run itself is untouched (the step proceeds after the sleep); what the
+    stall exercises is the WATCHDOG: a
+    :class:`~deeplearning4j_tpu.telemetry.health.TrainingStallRule` with
+    a timeout under ``seconds`` must fire while the loop is frozen and
+    resolve once steps resume."""
+
+    def __init__(self, step: int, seconds: float = 0.5, times: int = 1):
+        self.step = int(step)
+        self.seconds = float(seconds)
+        self.times = int(times)
+
+    def before_step(self, step, net, ds):
+        if step == self.step and self.times > 0:
+            self.times -= 1
+            time.sleep(self.seconds)
 
 
 class CorruptCheckpointAtStep(Fault):

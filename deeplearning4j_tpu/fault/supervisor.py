@@ -115,7 +115,9 @@ class FaultTolerantTrainer:
                  lrBackoff: float = 0.5, maxRollbacks: int = 3,
                  divergenceThreshold: Optional[float] = None,
                  maxMicroBatchSplits: int = 2, resume: bool = True,
-                 injector: Optional["_inj.FaultInjector"] = None):
+                 injector: Optional["_inj.FaultInjector"] = None,
+                 healthMonitor=None,
+                 durableExport: bool = True):
         self.wrapper = model if hasattr(model, "model") else None
         self.net = model.model if self.wrapper is not None else model
         self.ckpt = ShardedCheckpointer(checkpointDir, keepLast=keepLast)
@@ -126,10 +128,25 @@ class FaultTolerantTrainer:
         self.maxMicroBatchSplits = int(maxMicroBatchSplits)
         self.resume = bool(resume)
         self._injector = injector
+        # watchdog integration: a telemetry.HealthMonitor whose event log
+        # receives the supervisor's rollback/restore/divergence hooks and
+        # whose rules run for the duration of fit() (started there if the
+        # caller hasn't already)
+        self.healthMonitor = healthMonitor
+        # arm the atexit/SIGTERM final-snapshot + flight-ring flush: a
+        # supervised batch job that dies unscraped still leaves its
+        # counters and crash record on disk
+        self.durableExport = bool(durableExport)
         self.lastLoss: Optional[float] = None
         self.stats: Dict[str, Any] = {"rollbacks": 0, "oomSplits": 0,
                                       "resumedFromStep": None,
                                       "checkpoints": 0}
+
+    def _note(self, event: str, **details) -> None:
+        """Alert hook: structured event into the watchdog's JSON log (a
+        no-op without a monitor — the counters still tell the story)."""
+        if self.healthMonitor is not None:
+            self.healthMonitor.note(event, **details)
 
     # -- injection ------------------------------------------------------
     @property
@@ -176,6 +193,23 @@ class FaultTolerantTrainer:
 
     # -- the supervised loop --------------------------------------------
     def fit(self, iterator, epochs: int = 1) -> None:
+        if self.durableExport:
+            from deeplearning4j_tpu.telemetry import install_export_handlers
+            install_export_handlers()
+        owns_monitor = (self.healthMonitor is not None and
+                        not self.healthMonitor.is_running())
+        if owns_monitor:
+            self.healthMonitor.start()
+        try:
+            self._fit(iterator, epochs)
+        finally:
+            if owns_monitor:
+                # stop() resolves anything still firing: the run is over,
+                # so "training stalled" would be vacuously stale; the
+                # firing history survives in the event log and counters
+                self.healthMonitor.stop()
+
+    def _fit(self, iterator, epochs: int) -> None:
         net = self.net
         if net.params_ is None:
             net.init()
@@ -190,6 +224,8 @@ class FaultTolerantTrainer:
                 if hasattr(net, "setLrScale"):
                     net.setLrScale(float(meta.get("lrScale", 1.0)))
                 self.stats["resumedFromStep"] = step
+                self._note("checkpoint_resume", step=step,
+                           epoch=net.epochCount, stepInEpoch=skip)
                 log.info("resumed from checkpoint step %d "
                          "(epoch %d, stepInEpoch %d)", step,
                          net.epochCount, skip)
@@ -267,12 +303,19 @@ class FaultTolerantTrainer:
             if rollbacks > self.maxRollbacks:
                 reason = (f"still diverging after {self.maxRollbacks} "
                           f"rollbacks ({diverged})")
+                self._note("training_diverged", reason=reason,
+                           iteration=net.iterationCount)
                 record_crash(reason, model=net)
                 raise TrainingDivergedError(reason)
+            self._note("rollback", reason=diverged,
+                       iteration=net.iterationCount, epoch=net.epochCount,
+                       attempt=rollbacks)
             with tracer().span("recovery", reason=diverged,
                                rollback=rollbacks):
                 epoch_now = net.epochCount
                 step = self._restoreLastGood()
+                self._note("checkpoint_restore", step=step,
+                           reason=diverged)
                 # rollback rewinds the STEP counter/params/opt-state, not
                 # the epoch loop position: the iterator hasn't moved, so a
                 # restore from a previous epoch's checkpoint must not make

@@ -86,28 +86,94 @@ class _TrainingMaster:
 
     # -- training --------------------------------------------------------
     def fitMultiLayerNetwork(self, net, iterator, epochs: int = 1,
-                             faultConfig: Optional[dict] = None):
+                             faultConfig: Optional[dict] = None,
+                             telemetryDir: Optional[str] = None,
+                             healthConfig: Optional[dict] = None):
         """``faultConfig`` (optional) supervises the run through
         :class:`~deeplearning4j_tpu.fault.FaultTolerantTrainer` — at
         cluster scale preemption/divergence handling is the launcher's
         job, so it plugs in here: pass the trainer's kwargs, e.g.
         ``{"checkpointDir": "/ckpts/run1", "checkpointEveryN": 50}``, and
-        a re-launched job auto-resumes from the latest valid step."""
-        from deeplearning4j_tpu.telemetry import get_registry, tracer
+        a re-launched job auto-resumes from the latest valid step.
+
+        ``telemetryDir`` (or ``DL4J_TPU_TELEMETRY_DIR``) federates the
+        run: every process writes periodic registry snapshots there, the
+        merged view serves at ``/metrics/federated``, and the
+        atexit/SIGTERM durable flush is armed so a preempted worker's
+        final counters survive it.  ``healthConfig`` starts a watchdog
+        :class:`~deeplearning4j_tpu.telemetry.health.HealthMonitor` for
+        the duration of the fit — pass ``{}`` for the default rules
+        (stall/straggler/starvation/divergence) or override their knobs:
+        ``{"stallTimeout": 300, "stragglerRatio": 3.0, "interval": 10}``.
+        """
+        from deeplearning4j_tpu.telemetry import (HealthMonitor,
+                                                  SnapshotWriter,
+                                                  get_registry,
+                                                  install_export_handlers,
+                                                  set_federation_dir,
+                                                  tracer)
+        from deeplearning4j_tpu.telemetry import federation as _federation
         mesh = self.mesh or DeviceMesh()
         wrapper = ParallelWrapper(net, mesh=mesh)
         get_registry().gauge(
             "dl4j_tpu_parallel_workers",
             "Data-parallel worker count of the active training master"
         ).set(mesh.dataSize)
-        with tracer().span("cluster_fit", workers=int(mesh.dataSize),
-                           supervised=faultConfig is not None):
+        run_dir = telemetryDir or _federation.get_federation_dir()
+        writer = monitor = None
+        # everything from the first started thread onward lives inside
+        # the try: a failure while building the monitor (bad healthConfig
+        # key) must not leak a periodic writer advertising a phantom
+        # live worker into the federated view for the process lifetime
+        try:
+            if run_dir is not None:
+                set_federation_dir(run_dir)
+                writer = SnapshotWriter(run_dir).start()
+                install_export_handlers()
+            if healthConfig is not None:
+                hc = dict(healthConfig)
+                from deeplearning4j_tpu.telemetry.health import (
+                    DivergencePrecursorRule, EtlStarvationRule,
+                    TrainingStallRule)
+                rules = [TrainingStallRule(
+                             timeout=hc.pop("stallTimeout", 120.0)),
+                         EtlStarvationRule(
+                             forSeconds=hc.pop("starvationSeconds", 30.0)),
+                         DivergencePrecursorRule(
+                             quietSeconds=hc.pop(
+                                 "divergenceQuietSeconds", 300.0))]
+                rules += wrapper.healthRules(
+                    stragglerRatio=hc.pop("stragglerRatio", 2.0))
+                monitor = HealthMonitor(rules=rules, **hc)
             if faultConfig is not None:
-                from deeplearning4j_tpu.fault import FaultTolerantTrainer
-                FaultTolerantTrainer(wrapper, **faultConfig).fit(
-                    iterator, epochs=epochs)
-                return net
-            wrapper.fit(iterator, epochs=epochs)
+                faultConfig = dict(faultConfig)
+                if monitor is not None:
+                    # the supervisor's rollback/restore hooks and the
+                    # watchdog's transitions belong in ONE event log; two
+                    # competing monitors would silently drop the caller's
+                    # healthConfig, so the ambiguity is an error
+                    if faultConfig.get("healthMonitor") is not None:
+                        raise ValueError(
+                            "pass either healthConfig= or "
+                            "faultConfig['healthMonitor'], not both")
+                    faultConfig["healthMonitor"] = monitor
+            elif monitor is not None:
+                monitor.start()
+            with tracer().span("cluster_fit", workers=int(mesh.dataSize),
+                               supervised=faultConfig is not None):
+                if faultConfig is not None:
+                    from deeplearning4j_tpu.fault import \
+                        FaultTolerantTrainer
+                    FaultTolerantTrainer(wrapper, **faultConfig).fit(
+                        iterator, epochs=epochs)
+                else:
+                    wrapper.fit(iterator, epochs=epochs)
+        finally:
+            if monitor is not None and monitor.is_running():
+                monitor.stop()
+            if writer is not None:
+                writer.stop()       # final write: the federated view
+                # keeps this worker's end-of-fit numbers after it exits
         return net
 
     executeTraining = fitMultiLayerNetwork

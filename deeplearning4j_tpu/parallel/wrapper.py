@@ -148,6 +148,20 @@ class ParallelWrapper:
                     len(devices))
         return self._replicaTimer
 
+    def healthRules(self, stragglerRatio: float = 2.0):
+        """Watchdog rules scoped to THIS wrapper's mesh: the per-replica
+        straggler check over the step-time gauges the wrapper's
+        ``ReplicaTimingListener`` publishes.  ``SharedTrainingMaster``
+        composes these with the run-level stall/starvation/divergence
+        rules when it builds the fit's HealthMonitor; callers running the
+        wrapper directly can do the same::
+
+            HealthMonitor(rules=default_rules() + wrapper.healthRules())
+        """
+        from deeplearning4j_tpu.telemetry.health import ReplicaStragglerRule
+        self._timing()      # ensure the replica gauges exist to watch
+        return [ReplicaStragglerRule(ratio=stragglerRatio)]
+
     def fitDataSet(self, ds) -> None:
         """One data-parallel train step on a single batch — the
         FaultTolerantTrainer's per-batch entry point (it owns the epoch

@@ -120,22 +120,24 @@ class JsonModelServer:
             def log_message(self, *a):
                 pass
 
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                # Prometheus scrape surface: the process-global telemetry
-                # registry (training, fault, parallel, ETL and serving
-                # metrics all land there)
-                if self.path != "/metrics":
+                # observability surface (/metrics, /metrics/federated,
+                # /healthz) — shared routing with ui.UIServer
+                from deeplearning4j_tpu.telemetry.http import \
+                    observability_route
+                route = observability_route(self.path)
+                if route is None:
                     self.send_response(404)
                     self.end_headers()
                     return
-                from deeplearning4j_tpu.telemetry import get_registry
-                data = get_registry().exposition().encode("utf-8")
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self._reply(*route)
 
             def do_POST(self):
                 # payload faults are the CLIENT's (400); model-execution

@@ -15,12 +15,31 @@ One place every layer reports through (SURVEY.md §5.1's ``OpProfiler`` /
   analogue).
 - :mod:`.instrument` — the hot-path helpers the model/fault/parallel/ETL
   layers call.
+- :mod:`.federation` — cross-process snapshot writers + the aggregator
+  behind ``/metrics/federated`` (counters sum across hosts,
+  gauges/histograms gain a ``host`` label).
+- :mod:`.health` — watchdog alert rules + :class:`HealthMonitor`
+  (firing/resolved transitions to a JSON event log and the
+  ``dl4j_tpu_health_alerts_firing`` gauge); ``/healthz`` liveness.
+- :mod:`.export` — durable final-snapshot flush on atexit/SIGTERM for
+  scrape-less batch jobs (plus the FlightRecorder ring, so preempted
+  jobs leave a crash record).
 
 Metric naming convention (linted by ``tools/lint_telemetry.py``):
 ``dl4j_tpu_<subsystem>_<name>``; counters end ``_total``.
 """
+from deeplearning4j_tpu.telemetry.export import (  # noqa: F401
+    install_export_handlers, uninstall_export_handlers,
+    write_final_snapshot)
+from deeplearning4j_tpu.telemetry.federation import (  # noqa: F401
+    SnapshotWriter, TelemetryAggregator, federated_exposition,
+    get_federation_dir, host_id, set_federation_dir)
 from deeplearning4j_tpu.telemetry.flight import (  # noqa: F401
     FlightRecorder, flight_recorder, set_flight_recorder)
+from deeplearning4j_tpu.telemetry.health import (  # noqa: F401
+    AlertRule, DivergencePrecursorRule, EtlStarvationRule, HealthMonitor,
+    ReplicaStragglerRule, ThresholdRule, TrainingStallRule, default_rules,
+    health_summary)
 from deeplearning4j_tpu.telemetry.instrument import (  # noqa: F401
     ReplicaTimingListener, etl_fetch, in_microbatch, microbatch_scope,
     note_etl_wait, record_crash, record_logical_step, supervised_scope,

@@ -1,0 +1,469 @@
+"""Watchdog alerting over registry values: declarative rules + monitor.
+
+Metrics nobody watches are a dashboard, not observability.  Awan et al.
+(arXiv:1810.11112) characterize distributed DNN training as dominated at
+scale by stragglers and communication stalls — conditions that are
+*silent* in per-process logs and only visible as relationships between
+registry values over time.  :class:`HealthMonitor` is the thread that
+watches those relationships:
+
+- **declarative rules** (:class:`AlertRule` subclasses) evaluated every
+  ``interval`` seconds against the process-global registry;
+- **firing/resolved transitions** appended as JSON lines to a structured
+  event log (one object per line — ``jq``-able, tail-able) and mirrored
+  into two metrics: ``dl4j_tpu_health_alerts_firing`` (count, the
+  pager-feed gauge) and ``dl4j_tpu_health_alert_state{rule=...}`` (0/1
+  per rule, which the federation layer tags per host);
+- a :func:`health_summary` liveness snapshot served at ``/healthz``.
+
+Built-in rules (see :func:`default_rules`): training stall (step counter
+frozen), replica straggler (per-replica step gauge vs. the median), ETL
+starvation (prefetch queue pinned empty while the producer lives), and
+divergence precursor (NaN-rollback counter rising).  All rules take an
+explicit ``now`` so tests drive time deterministically — no sleeps.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.telemetry.registry import (MetricsRegistry,
+                                                   get_registry)
+
+__all__ = ["AlertRule", "ThresholdRule", "TrainingStallRule",
+           "ReplicaStragglerRule", "EtlStarvationRule",
+           "DivergencePrecursorRule", "HealthMonitor", "default_rules",
+           "health_summary"]
+
+_process_start = time.time()
+
+
+class AlertRule:
+    """One watchdog condition.  ``evaluate`` returns a human-readable
+    detail string while the condition holds, None while it doesn't; the
+    monitor turns edges of that into firing/resolved events.  Rules keep
+    their own state (last counter value, first-seen-zero time) — they are
+    single-monitor objects, not shareable constants."""
+
+    name = "alert"
+
+    def evaluate(self, registry: MetricsRegistry,
+                 now: float) -> Optional[str]:
+        raise NotImplementedError
+
+
+class ThresholdRule(AlertRule):
+    """Generic: fire while ``metric <op> threshold`` (op in <, >, <=, >=).
+    The escape hatch for run-specific conditions the built-ins don't
+    cover — e.g. loss ceilings exported as gauges."""
+
+    _OPS = {"<": lambda a, b: a < b, ">": lambda a, b: a > b,
+            "<=": lambda a, b: a <= b, ">=": lambda a, b: a >= b}
+
+    def __init__(self, name: str, metric: str, op: str, threshold: float,
+                 **labels):
+        if op not in self._OPS:
+            raise ValueError(f"op must be one of {sorted(self._OPS)}")
+        self.name = name
+        self.metric = metric
+        self.op = op
+        self.threshold = float(threshold)
+        self.labels = labels
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        try:
+            v = m.value(**self.labels)
+        except (ValueError, AttributeError):
+            return None
+        if self._OPS[self.op](v, self.threshold):
+            return (f"{self.metric}{self.labels or ''} = {v:g} "
+                    f"{self.op} {self.threshold:g}")
+        return None
+
+
+class TrainingStallRule(AlertRule):
+    """No step-counter progress for ``timeout`` seconds.
+
+    Arms only once the counter is nonzero — a job still compiling its
+    first step (or a coordinator that never trains) must not page as
+    stalled; resolves the moment the counter moves again."""
+
+    name = "training_stall"
+
+    def __init__(self, timeout: float = 120.0,
+                 counter: str = "dl4j_tpu_train_steps_total"):
+        self.timeout = float(timeout)
+        self.counter = counter
+        self._last_value: Optional[float] = None
+        self._last_change: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.counter)
+        if m is None:
+            return None
+        v = _total_value(m)
+        if self._last_value is None or v != self._last_value:
+            self._last_value, self._last_change = v, now
+            return None
+        if v <= 0:
+            return None
+        stalled = now - self._last_change
+        if stalled >= self.timeout:
+            return (f"no {self.counter} progress for {stalled:.1f}s "
+                    f"(threshold {self.timeout:g}s, stuck at {v:g})")
+        return None
+
+
+class ReplicaStragglerRule(AlertRule):
+    """Any replica's step-time gauge above ``ratio`` × the median replica.
+
+    Under lockstep GSPMD every replica of ONE process publishes the same
+    time, so within a single local registry this cannot fire — the
+    divergence it hunts lives across hosts.  Run it on a coordinator's
+    ``HealthMonitor(federated=True)``, where the evaluated registry is
+    the merged federated view and each host's gauge is a separate
+    ``host``-labeled cell."""
+
+    name = "replica_straggler"
+
+    def __init__(self, ratio: float = 2.0,
+                 gauge: str = "dl4j_tpu_parallel_replica_step_seconds"):
+        self.ratio = float(ratio)
+        self.gauge = gauge
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.gauge)
+        if m is None:
+            return None
+        cells = m.data().get("cells", [])
+        vals = sorted(float(v) for _k, v in cells)
+        if len(vals) < 2:
+            return None
+        # LOWER median: with an even cell count the midpoint average
+        # would include the straggler's own value, making
+        # "worst > k*median" unsatisfiable for 2 hosts (w > w+b); the
+        # lower median compares the worst against the healthy half
+        median = vals[(len(vals) - 1) // 2]
+        if median <= 0:
+            return None
+        worst_key, worst = max(cells, key=lambda kv: float(kv[1]))
+        if float(worst) > self.ratio * median:
+            return (f"replica {'/'.join(worst_key)} step time "
+                    f"{float(worst):.4g}s > {self.ratio:g}x median "
+                    f"{median:.4g}s")
+        return None
+
+
+class EtlStarvationRule(AlertRule):
+    """A consumer BLOCKED on an empty prefetch queue for ``forSeconds``
+    while the producer thread is still alive
+    (``dl4j_tpu_etl_producer_active``) — the input pipeline can't keep up
+    with the device loop.  Keys on ``dl4j_tpu_etl_consumers_waiting``
+    (live for the duration of the block) rather than the queue-depth
+    gauge, which goes STALE between consumer polls: a loop stuck in a
+    minutes-long XLA compile would otherwise read as "pinned at 0" and
+    false-page.  A drained epoch end (producer exited) must NOT fire."""
+
+    name = "etl_starvation"
+
+    def __init__(self, forSeconds: float = 30.0,
+                 gauge: str = "dl4j_tpu_etl_consumers_waiting"):
+        self.forSeconds = float(forSeconds)
+        self.gauge = gauge
+        self._waiting_since: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        waiting = registry.get(self.gauge)
+        if waiting is None or _total_value(waiting) <= 0:
+            self._waiting_since = None
+            return None
+        active = registry.get("dl4j_tpu_etl_producer_active")
+        if active is not None and _total_value(active) <= 0:
+            self._waiting_since = None     # clean drain, not starvation
+            return None
+        if self._waiting_since is None:
+            self._waiting_since = now
+            return None
+        blocked = now - self._waiting_since
+        if blocked >= self.forSeconds:
+            return (f"consumer blocked {blocked:.1f}s on an empty "
+                    f"prefetch queue with a live producer (threshold "
+                    f"{self.forSeconds:g}s)")
+        return None
+
+
+class DivergencePrecursorRule(AlertRule):
+    """NaN-rollback counter rising: fires on any increase, stays firing
+    until ``quietSeconds`` pass with no further rollback (the supervisor
+    is coping, but someone should look before maxRollbacks runs out)."""
+
+    name = "divergence_precursor"
+
+    def __init__(self, quietSeconds: float = 300.0,
+                 counter: str = "dl4j_tpu_fault_nan_rollbacks_total"):
+        self.quietSeconds = float(quietSeconds)
+        self.counter = counter
+        self._last_value: Optional[float] = None
+        self._last_rise: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.counter)
+        if m is None:
+            return None
+        v = _total_value(m)
+        if self._last_value is None:
+            self._last_value = v
+            return None
+        if v > self._last_value:
+            self._last_value, self._last_rise = v, now
+        elif v < self._last_value:
+            # counter reset (a federated worker restarted and re-zeroed
+            # its share of the sum): re-baseline so the NEXT rollback
+            # still reads as a rise instead of hiding under the old max
+            self._last_value = v
+        if self._last_rise is not None and \
+                now - self._last_rise < self.quietSeconds:
+            return (f"{self.counter} rose to {v:g} "
+                    f"{now - self._last_rise:.1f}s ago")
+        return None
+
+
+def _total_value(metric) -> float:
+    """Sum over every label set (label-less metrics: the single cell)."""
+    try:
+        return sum(float(v) for _k, v in metric.data().get("cells", []))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def default_rules(stallTimeout: float = 120.0, stragglerRatio: float = 2.0,
+                  starvationSeconds: float = 30.0,
+                  divergenceQuietSeconds: float = 300.0
+                  ) -> List[AlertRule]:
+    """The four conditions every supervised run should watch (ISSUE 5):
+    stall, straggler, ETL starvation, divergence precursor."""
+    return [TrainingStallRule(timeout=stallTimeout),
+            ReplicaStragglerRule(ratio=stragglerRatio),
+            EtlStarvationRule(forSeconds=starvationSeconds),
+            DivergencePrecursorRule(quietSeconds=divergenceQuietSeconds)]
+
+
+class HealthMonitor:
+    """Daemon watchdog: evaluates rules on an interval, logs transitions.
+
+    The event log is JSON Lines — each line
+    ``{"ts", "host", "rule", "state", "detail"}`` with ``state`` one of
+    ``firing``/``resolved``/``event`` (``event`` lines come from
+    :meth:`note`, the supervisor's rollback/restore hook).  Everything is
+    also visible to scrapes: ``dl4j_tpu_health_alerts_firing`` counts
+    currently-firing rules, ``dl4j_tpu_health_alert_state{rule=}`` holds
+    each rule's 0/1, and ``dl4j_tpu_health_alert_transitions_total``
+    counts edges.  ``evaluate_once(now=...)`` drives the same logic
+    deterministically for tests (no thread, no sleeps).
+
+    ``federated=True`` makes a COORDINATOR's monitor evaluate its rules
+    against the merged federated registry (every worker snapshot in the
+    configured run dir + this process's live registry) instead of the
+    local one — the only place cross-host conditions like a replica
+    straggler are visible (each host's gauge is a separate
+    ``host``-labeled cell there).  Alert-state metrics still land in the
+    LOCAL registry, so they export/federate normally."""
+
+    def __init__(self, rules: Optional[Sequence[AlertRule]] = None,
+                 interval: float = 5.0,
+                 eventLogPath: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 federated: bool = False):
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.interval = float(interval)
+        self._eventLogPath = eventLogPath
+        self._registry = registry
+        self.federated = bool(federated)
+        self.firing: Dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log_lock = threading.Lock()
+
+    @property
+    def eventLogPath(self) -> str:
+        # resolved lazily like FlightRecorder.dumpDir: the launcher may
+        # configure the run dir (set_federation_dir or the env var) after
+        # this monitor is constructed — the alerts belong next to the
+        # metric snapshots the operator is already tailing
+        if self._eventLogPath is not None:
+            return self._eventLogPath
+        from deeplearning4j_tpu.telemetry.federation import \
+            get_federation_dir
+        base = get_federation_dir() or tempfile.gettempdir()
+        return os.path.join(base, f"health_events_{os.getpid()}.jsonl")
+
+    def _reg(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None else \
+            get_registry()
+
+    # -- event log -------------------------------------------------------
+    def _append(self, record: dict) -> None:
+        """Append one JSON line; never raises (an unwritable log must not
+        kill the watchdog, let alone the training it watches)."""
+        try:
+            line = json.dumps(record, default=str)
+            with self._log_lock:
+                os.makedirs(os.path.dirname(self.eventLogPath) or ".",
+                            exist_ok=True)
+                with open(self.eventLogPath, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
+        except Exception:
+            pass
+
+    def note(self, event: str, **details) -> None:
+        """Structured non-rule event (the supervisor's rollback/restore/
+        divergence hooks land here) — same log, ``state: "event"``."""
+        from deeplearning4j_tpu.telemetry.federation import host_id
+        self._append({"ts": time.time(), "host": host_id(), "rule": event,
+                      "state": "event", "detail": details})
+
+    # -- evaluation ------------------------------------------------------
+    def evaluate_once(self, now: Optional[float] = None) -> Dict[str, str]:
+        """One evaluation pass over every rule; returns the currently
+        firing {rule: detail} map.  ``now`` is monotonic-clock seconds
+        (tests pass explicit values to step time forward)."""
+        if now is None:
+            now = time.monotonic()
+        reg = self._reg()
+        eval_reg = reg
+        if self.federated:
+            from deeplearning4j_tpu.telemetry.federation import (
+                TelemetryAggregator, get_federation_dir)
+            run_dir = get_federation_dir()
+            if run_dir is not None:
+                try:
+                    eval_reg = TelemetryAggregator(
+                        run_dir, localRegistry=reg).merged()
+                except Exception:
+                    eval_reg = reg      # a torn run dir must not blind
+                    # the LOCAL rules too — degrade to local evaluation
+        from deeplearning4j_tpu.telemetry.federation import host_id
+        state_g = reg.gauge(
+            "dl4j_tpu_health_alert_state",
+            "1 while the named watchdog rule is firing, else 0",
+            labelnames=("rule",))
+        for rule in self.rules:
+            try:
+                detail = rule.evaluate(eval_reg, now)
+            except Exception as e:
+                # a broken rule is an alert about the watchdog, not a
+                # watchdog crash
+                detail = None
+                self._append({"ts": time.time(), "host": host_id(),
+                              "rule": rule.name, "state": "rule_error",
+                              "detail": f"{type(e).__name__}: {e}"})
+            was = rule.name in self.firing
+            if detail is not None and not was:
+                self.firing[rule.name] = detail
+                self._transition(rule.name, "firing", detail)
+            elif detail is None and was:
+                prev = self.firing.pop(rule.name)
+                self._transition(rule.name, "resolved", prev)
+            elif detail is not None:
+                self.firing[rule.name] = detail    # refresh detail
+            state_g.set(1.0 if rule.name in self.firing else 0.0,
+                        rule=rule.name)
+        reg.gauge("dl4j_tpu_health_alerts_firing",
+                  "Watchdog alert rules currently firing").set(
+                      len(self.firing))
+        return dict(self.firing)
+
+    def _transition(self, rule: str, state: str, detail: str) -> None:
+        from deeplearning4j_tpu.telemetry.federation import host_id
+        self._append({"ts": time.time(), "host": host_id(), "rule": rule,
+                      "state": state, "detail": detail})
+        self._reg().counter(
+            "dl4j_tpu_health_alert_transitions_total",
+            "Watchdog firing/resolved edges",
+            labelnames=("rule", "state")).inc(rule=rule, state=state)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "HealthMonitor":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+
+            def loop():
+                while not self._stop.wait(self.interval):
+                    self.evaluate_once()
+
+            self._thread = threading.Thread(
+                target=loop, name="telemetry-health-monitor", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the thread and RESOLVE anything still firing: a stopped
+        watchdog can't claim alerts are active, and a run that just ended
+        (the usual caller) makes 'training stalled' vacuously stale.  The
+        firing history stays in the event log and transition counters."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.firing:
+            reg = self._reg()
+            state_g = reg.gauge(
+                "dl4j_tpu_health_alert_state",
+                "1 while the named watchdog rule is firing, else 0",
+                labelnames=("rule",))
+            for rule in list(self.firing):
+                self.firing.pop(rule)
+                self._transition(rule, "resolved", "watchdog stopped")
+                state_g.set(0.0, rule=rule)
+        reg = self._reg()
+        g = reg.get("dl4j_tpu_health_alerts_firing")
+        if g is not None:
+            g.set(0.0)
+
+    def is_running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+# -- /healthz ------------------------------------------------------------
+
+_progress_lock = threading.Lock()
+# keyed to the registry OBJECT: a swapped/cleared registry (new run in
+# the same serving process, tests) must restart the age clock even when
+# the new run coincidentally reaches the same step total
+_progress = {"registry": None, "value": None, "t": None}
+
+
+def health_summary(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Liveness JSON for ``/healthz``: uptime, seconds since the step
+    counter last moved (null before the first step), and the firing alert
+    count.  Self-contained — works with or without a HealthMonitor (the
+    last-step age is tracked across calls right here, so the first scrape
+    after a stall already shows a growing age)."""
+    reg = registry if registry is not None else get_registry()
+    now = time.monotonic()
+    steps = reg.get("dl4j_tpu_train_steps_total")
+    total = _total_value(steps) if steps is not None else None
+    last_step_age = None
+    with _progress_lock:
+        if _progress["registry"] is not reg:
+            _progress.update(registry=reg, value=None, t=None)
+        if total is not None and total > 0:
+            if _progress["value"] != total:
+                _progress["value"], _progress["t"] = total, now
+            last_step_age = now - _progress["t"]
+    firing = reg.get("dl4j_tpu_health_alerts_firing")
+    n_firing = int(firing.value()) if firing is not None else 0
+    return {"status": "alerting" if n_firing else "ok",
+            "uptime_seconds": round(time.time() - _process_start, 3),
+            "steps_total": total,
+            "last_step_age_seconds": None if last_step_age is None
+            else round(last_step_age, 3),
+            "firing_alerts": n_firing,
+            "pid": os.getpid()}

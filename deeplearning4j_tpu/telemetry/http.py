@@ -1,0 +1,49 @@
+"""Shared GET routing for the observability endpoints.
+
+``JsonModelServer`` and ``UIServer`` expose the same three surfaces —
+``/metrics``, ``/metrics/federated``, ``/healthz``.  One routing function
+keeps the status codes, content types, and the federation hint text from
+drifting between two hand-maintained handler copies.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional, Tuple
+
+__all__ = ["observability_route", "PROMETHEUS_CTYPE"]
+
+PROMETHEUS_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def observability_route(path: str) -> Optional[Tuple[int, bytes, str]]:
+    """Resolve an observability GET.  Returns ``(status, body, ctype)``,
+    or None when ``path`` is not an observability endpoint (the server's
+    own routing continues):
+
+    - ``/metrics`` — this process's registry, Prometheus text;
+    - ``/metrics/federated`` — every worker snapshot in the configured
+      run dir merged (counters summed, gauges/histograms host-labeled);
+      404 with a configuration hint when federation is unconfigured;
+    - ``/healthz`` — liveness JSON (uptime, last-step age, firing alert
+      count).
+    """
+    from deeplearning4j_tpu.telemetry.federation import \
+        federated_exposition
+    from deeplearning4j_tpu.telemetry.health import health_summary
+    from deeplearning4j_tpu.telemetry.registry import get_registry
+    if path == "/metrics":
+        return (200, get_registry().exposition().encode("utf-8"),
+                PROMETHEUS_CTYPE)
+    if path == "/metrics/federated":
+        text = federated_exposition()
+        if text is None:
+            return (404, json.dumps(
+                {"error": "federation unconfigured: set "
+                 "DL4J_TPU_TELEMETRY_DIR or call telemetry."
+                 "set_federation_dir(runDir)"}).encode("utf-8"),
+                "application/json")
+        return 200, text.encode("utf-8"), PROMETHEUS_CTYPE
+    if path == "/healthz":
+        return (200, json.dumps(health_summary()).encode("utf-8"),
+                "application/json")
+    return None

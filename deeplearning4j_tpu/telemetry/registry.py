@@ -107,6 +107,22 @@ class _Metric:
     def expose(self) -> List[str]:
         raise NotImplementedError
 
+    def data(self) -> dict:
+        """JSON-able structural dump of this metric (type/help/labels plus
+        every cell's raw state) — the unit of cross-process federation:
+        workers serialize ``data()`` into snapshot files and the
+        coordinator's :class:`~deeplearning4j_tpu.telemetry.federation.
+        TelemetryAggregator` rebuilds and merges them."""
+        with self._lock:
+            items = list(self._cells.items())
+        return {"type": self.typ, "help": self.help,
+                "labelnames": list(self.labelnames),
+                "cells": [[list(key), self._cell_data(cell)]
+                          for key, cell in sorted(items)]}
+
+    def _cell_data(self, cell):
+        raise NotImplementedError
+
     def _header(self) -> List[str]:
         out = []
         if self.help:
@@ -147,6 +163,10 @@ class _ScalarMetric(_Metric):
             out.append(f"{self.name}{_label_str(self.labelnames, key)} "
                        f"{_fmt(cell.v)}")
         return out
+
+    def _cell_data(self, cell: _Value) -> float:
+        with cell.lock:
+            return cell.v
 
 
 class Counter(_ScalarMetric):
@@ -218,6 +238,16 @@ class Histogram(_Metric):
         cell = self._cell(labels)
         with cell.lock:
             return cell.sum
+
+    def data(self) -> dict:
+        out = super().data()
+        out["buckets"] = list(self.buckets)
+        return out
+
+    def _cell_data(self, cell: _HistCell) -> dict:
+        with cell.lock:
+            return {"counts": list(cell.counts), "sum": cell.sum,
+                    "count": cell.count}
 
     def bucketCounts(self, **labels) -> Dict[float, int]:
         """CUMULATIVE per-upper-bound counts (Prometheus ``le`` semantics),
@@ -324,6 +354,14 @@ class MetricsRegistry:
         for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-able {name: metric.data()} dump of every registered metric
+        — what :class:`~deeplearning4j_tpu.telemetry.federation.
+        SnapshotWriter` persists and the aggregator merges."""
+        with self._lock:
+            metrics = [(n, self._metrics[n]) for n in sorted(self._metrics)]
+        return {n: m.data() for n, m in metrics}
 
 
 _default = MetricsRegistry()

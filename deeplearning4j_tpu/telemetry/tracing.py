@@ -54,6 +54,11 @@ class Tracer:
         self._t0 = time.perf_counter()
         self._track = _ThreadTrack()
         self._next_tid = 0
+        # spans currently INSIDE their with-block, keyed by a unique id —
+        # a crash/SIGTERM dump needs "what was the process in the middle
+        # of", which the completed-event ring by definition can't hold
+        self._live: dict = {}
+        self._next_span_id = 0
 
     # -- spans ------------------------------------------------------------
     @contextlib.contextmanager
@@ -64,6 +69,11 @@ class Tracer:
         depth = self._track.depth
         start = time.perf_counter()
         live_attrs = dict(attrs)
+        with self._lock:
+            self._next_span_id += 1
+            span_id = self._next_span_id
+            self._live[span_id] = {"name": name, "start": start,
+                                   "depth": depth, "attrs": live_attrs}
         ann = None
         if _device_trace_active:
             try:
@@ -81,6 +91,8 @@ class Tracer:
                 except Exception:
                     pass
             self._track.depth -= 1
+            with self._lock:
+                self._live.pop(span_id, None)
             self.record_complete(name, start, time.perf_counter() - start,
                                  args=dict(live_attrs, depth=depth))
 
@@ -126,9 +138,23 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def open_spans(self) -> List[dict]:
+        """Spans whose with-block has not exited yet (outermost first):
+        name, attrs, depth, and seconds open so far.  A SIGTERM'd worker's
+        final snapshot includes this — "preempted 48s into `compile`" is
+        the post-mortem one-liner the completed-event ring can't give."""
+        now = time.perf_counter()
+        with self._lock:
+            live = sorted(self._live.items())
+        return [{"name": s["name"], "depth": s["depth"],
+                 "open_seconds": round(now - s["start"], 6),
+                 "attrs": {k: v for k, v in s["attrs"].items()}}
+                for _sid, s in live]
+
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
+            self._live.clear()
         self._t0 = time.perf_counter()
 
     def write_chrome_trace(self, path: str, merge_profiler: bool = True,

@@ -117,9 +117,11 @@ class UIServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, body: str, ctype: str = "text/html"):
-                data = body.encode("utf-8")
-                self.send_response(200)
+            def _send(self, body, ctype: str = "text/html",
+                      status: int = 200):
+                data = body if isinstance(body, bytes) else \
+                    body.encode("utf-8")
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -147,12 +149,14 @@ class UIServer:
                     self.wfile.write(data)
 
             def do_GET(self):
-                if self.path == "/metrics":
-                    # Prometheus scrape surface (same process-global
-                    # registry the remote JsonModelServer serves)
-                    from deeplearning4j_tpu.telemetry import get_registry
-                    self._send(get_registry().exposition(),
-                               "text/plain; version=0.0.4; charset=utf-8")
+                # observability surface (/metrics, /metrics/federated,
+                # /healthz) — shared routing with remote.JsonModelServer
+                from deeplearning4j_tpu.telemetry.http import \
+                    observability_route
+                route = observability_route(self.path)
+                if route is not None:
+                    status, data, ctype = route
+                    self._send(data, ctype, status)
                     return
                 sessions = server._sessions()
                 if self.path == "/train/sessions":

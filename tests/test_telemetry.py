@@ -522,8 +522,8 @@ class TestTracerAndTools:
             import lint_telemetry
             bad = tmp_path / "bad.py"
             bad.write_text(
-                'reg.counter("dl4j_tpu_train_steps")\n'      # no _total
-                'reg.gauge("queue_depth")\n')                # no prefix
+                'reg.counter("dl4j_tpu_train_steps", "h")\n'  # no _total
+                'reg.gauge("queue_depth", "h")\n')            # no prefix
             errors = lint_telemetry.lint(tmp_path)
             assert len(errors) == 2
         finally:

@@ -1,0 +1,807 @@
+"""Federation + watchdog + durable export (ISSUE 5).
+
+Covers the three tentpole layers deterministically:
+
+- federation: registry snapshots, the aggregator's merge semantics
+  (counters sum, gauges/histograms host-labeled), and a REAL 2-process
+  run whose merged ``/metrics/federated`` exposition sums the workers;
+- watchdog: every built-in rule driven through ``evaluate_once(now=...)``
+  (no sleeps), plus the acceptance path — a deterministic injected stall
+  (``fault.injection.StallAtStep``) fires and then resolves a
+  ``training_stall`` alert in the JSON event log;
+- durable export: SIGTERM'd and cleanly-exiting subprocesses leave a
+  final registry snapshot (and open-span/flight dumps) on disk.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (FaultTolerantTrainer, NaNAtStep,
+                                      StallAtStep, inject)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.telemetry import (DivergencePrecursorRule,
+                                          EtlStarvationRule, FlightRecorder,
+                                          HealthMonitor, MetricsRegistry,
+                                          ReplicaStragglerRule,
+                                          SnapshotWriter,
+                                          TelemetryAggregator,
+                                          ThresholdRule, Tracer,
+                                          TrainingStallRule, get_registry,
+                                          health_summary,
+                                          set_federation_dir, tracer,
+                                          write_final_snapshot)
+
+pytestmark = pytest.mark.telemetry
+
+_ROOT = Path(__file__).resolve().parent.parent
+_TOOLS = _ROOT / "tools"
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry(tmp_path):
+    """Fresh process-global registry/tracer/flight-recorder AND a clean
+    federation config per test (the federated endpoint reads a global)."""
+    prev_reg = telemetry.set_registry(MetricsRegistry())
+    prev_tr = telemetry.set_tracer(Tracer())
+    prev_fr = telemetry.set_flight_recorder(
+        FlightRecorder(capacity=64, dumpDir=str(tmp_path)))
+    prev_fed = set_federation_dir(None)
+    yield
+    telemetry.set_registry(prev_reg)
+    telemetry.set_tracer(prev_tr)
+    telemetry.set_flight_recorder(prev_fr)
+    set_federation_dir(prev_fed)
+
+
+def _net(seed=42, lr=0.01):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer.builder().nIn(4).nOut(8)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iterator(batch=32, n=128):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    cls = np.clip((x.sum(1) > 0).astype(int) + (x[:, 0] > 1).astype(int),
+                  0, 2)
+    return ListDataSetIterator(
+        [DataSet(x, np.eye(3, dtype=np.float32)[cls])], batch=batch)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+# ---------------------------------------------------------- federation ----
+
+class TestFederation:
+    def test_registry_snapshot_roundtrip(self):
+        reg = get_registry()
+        reg.counter("dl4j_tpu_test_req_total", "reqs",
+                    labelnames=("code",)).inc(3, code="200")
+        reg.gauge("dl4j_tpu_test_depth", "depth").set(7)
+        reg.histogram("dl4j_tpu_test_lat_seconds", "lat",
+                      buckets=(0.1, 1.0)).observe(0.5)
+        snap = reg.snapshot()
+        assert snap["dl4j_tpu_test_req_total"]["type"] == "counter"
+        assert snap["dl4j_tpu_test_req_total"]["cells"] == [[["200"], 3.0]]
+        assert snap["dl4j_tpu_test_depth"]["cells"] == [[[], 7.0]]
+        h = snap["dl4j_tpu_test_lat_seconds"]
+        assert h["buckets"] == [0.1, 1.0]
+        assert h["cells"][0][1] == {"counts": [0, 1, 0], "sum": 0.5,
+                                    "count": 1}
+        json.dumps(snap)    # must be JSON-able as-is
+
+    def test_aggregator_sums_counters_labels_gauges_and_histograms(
+            self, tmp_path):
+        for host, n in (("w0", 3), ("w1", 5)):
+            r = MetricsRegistry()
+            r.counter("dl4j_tpu_train_steps_total", "steps").inc(n)
+            r.counter("dl4j_tpu_remote_requests_total", "reqs",
+                      labelnames=("code",)).inc(n, code="200")
+            r.gauge("dl4j_tpu_etl_queue_depth", "depth").set(n)
+            r.histogram("dl4j_tpu_train_step_seconds", "t",
+                        buckets=(0.1, 1.0)).observe(0.05 * n)
+            w = SnapshotWriter(str(tmp_path), hostId=host, registry=r)
+            assert w.write_now() == w.path
+        agg = TelemetryAggregator(str(tmp_path))
+        text = agg.exposition()
+        assert "dl4j_tpu_train_steps_total 8.0" in text
+        assert 'dl4j_tpu_remote_requests_total{code="200"} 8.0' in text
+        assert 'dl4j_tpu_etl_queue_depth{host="w0"} 3.0' in text
+        assert 'dl4j_tpu_etl_queue_depth{host="w1"} 5.0' in text
+        # w0 observed 0.15s: above the 0.1 bound, inside 1.0 (cumulative)
+        assert ('dl4j_tpu_train_step_seconds_bucket{host="w0",le="0.1"} 0'
+                in text)
+        assert ('dl4j_tpu_train_step_seconds_bucket{host="w0",le="1.0"} 1'
+                in text)
+        assert "dl4j_tpu_federation_hosts 2.0" in text
+        assert sorted(agg.hosts) == ["w0", "w1"]
+
+    def test_aggregator_tolerates_corrupt_and_foreign_files(self, tmp_path):
+        r = MetricsRegistry()
+        r.counter("dl4j_tpu_train_steps_total", "steps").inc(2)
+        SnapshotWriter(str(tmp_path), hostId="good", registry=r).write_now()
+        (tmp_path / "metrics_torn.json").write_text('{"host": "torn", "me')
+        (tmp_path / "unrelated.json").write_text("{}")
+        agg = TelemetryAggregator(str(tmp_path))
+        text = agg.exposition()
+        assert "dl4j_tpu_train_steps_total 2.0" in text
+        assert agg.hosts == ["good"]
+
+    def test_local_hosts_own_snapshot_not_double_counted(self, tmp_path):
+        """The serving process usually ALSO runs a SnapshotWriter (the
+        master wiring); its on-disk file must not add to its own live
+        registry in the merge."""
+        local = MetricsRegistry()
+        local.counter("dl4j_tpu_train_steps_total", "steps").inc(5)
+        me = telemetry.host_id()
+        SnapshotWriter(str(tmp_path), hostId=me,
+                       registry=local).write_now()
+        agg = TelemetryAggregator(str(tmp_path), localRegistry=local)
+        text = agg.exposition()
+        assert "dl4j_tpu_train_steps_total 5.0" in text     # not 10.0
+        assert "dl4j_tpu_federation_hosts 1.0" in text
+
+    def test_custom_host_id_writer_not_double_counted(self, tmp_path):
+        # a PROCESS-GLOBAL writer under a custom hostId (launchers use
+        # ranks) must still dedupe against the live registry
+        get_registry().counter("dl4j_tpu_train_steps_total",
+                               "steps").inc(4)
+        SnapshotWriter(str(tmp_path), hostId="rank0").write_now()
+        agg = TelemetryAggregator(str(tmp_path),
+                                  localRegistry=get_registry())
+        text = agg.exposition()
+        assert "dl4j_tpu_train_steps_total 4.0" in text     # not 8.0
+        from deeplearning4j_tpu.telemetry import federation
+        assert federation.local_snapshot_host_id() == "rank0"
+
+    def test_aggregator_includes_local_registry(self, tmp_path):
+        r = MetricsRegistry()
+        r.counter("dl4j_tpu_train_steps_total", "steps").inc(2)
+        SnapshotWriter(str(tmp_path), hostId="w0", registry=r).write_now()
+        local = MetricsRegistry()
+        local.counter("dl4j_tpu_train_steps_total", "steps").inc(1)
+        agg = TelemetryAggregator(str(tmp_path), localRegistry=local,
+                                  localHost="coord")
+        text = agg.exposition()
+        assert "dl4j_tpu_train_steps_total 3.0" in text
+        assert "dl4j_tpu_federation_hosts 2.0" in text
+
+    def test_snapshot_writer_thread_updates_file(self, tmp_path):
+        reg = get_registry()
+        c = reg.counter("dl4j_tpu_test_ticks_total", "ticks")
+        w = SnapshotWriter(str(tmp_path), hostId="t", interval=0.02)
+        w.start()
+        try:
+            c.inc(4)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if os.path.exists(w.path):
+                    snap = json.loads(Path(w.path).read_text())
+                    cells = snap["metrics"].get(
+                        "dl4j_tpu_test_ticks_total", {}).get("cells")
+                    if cells == [[[], 4.0]]:
+                        break
+                time.sleep(0.01)
+            else:
+                pytest.fail("snapshot file never caught up")
+        finally:
+            w.stop()
+        # stop() writes a final snapshot with the stop reason
+        assert json.loads(Path(w.path).read_text())["reason"] == "stop"
+
+    def test_federated_endpoint_two_real_processes(self, tmp_path):
+        """Satellite/acceptance: two WORKER PROCESSES write snapshots; the
+        coordinator's /metrics/federated sums their counters and labels
+        their gauges by host."""
+        worker = textwrap.dedent("""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, {root!r})
+            rank = int(sys.argv[1])
+            from deeplearning4j_tpu.telemetry import (SnapshotWriter,
+                                                      get_registry)
+            reg = get_registry()
+            reg.counter("dl4j_tpu_train_steps_total",
+                        "Logical train steps dispatched").inc(10 * (rank + 1))
+            reg.gauge("dl4j_tpu_parallel_replica_step_seconds",
+                      "Lockstep per-replica step wall time",
+                      labelnames=("replica",)).set(
+                          0.1 * (rank + 1), replica="0")
+            path = SnapshotWriter({run_dir!r},
+                                  hostId=f"worker{{rank}}").write_now()
+            assert path, "snapshot write failed"
+            print("WROTE", path, flush=True)
+        """).format(root=str(_ROOT), run_dir=str(tmp_path))
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        procs = [subprocess.Popen([sys.executable, "-c", worker, str(i)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  env=env)
+                 for i in range(2)]
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out[-2000:]
+            assert "WROTE" in out
+
+        from deeplearning4j_tpu.remote import JsonModelServer
+        set_federation_dir(str(tmp_path))
+        server = JsonModelServer(None, port=0).start()
+        try:
+            text = _get(f"http://127.0.0.1:{server.port}/metrics/federated")
+        finally:
+            server.stop()
+        # counters: 10 + 20 summed across hosts, no host label
+        assert "dl4j_tpu_train_steps_total 30.0" in text
+        # gauges: one series per host
+        assert ('dl4j_tpu_parallel_replica_step_seconds'
+                '{replica="0",host="worker0"} 0.1') in text
+        assert ('dl4j_tpu_parallel_replica_step_seconds'
+                '{replica="0",host="worker1"} 0.2') in text
+        assert "dl4j_tpu_federation_hosts 3.0" in text  # +local registry
+
+    def test_explicit_clear_beats_env_var(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.telemetry import federation
+        monkeypatch.setenv("DL4J_TPU_TELEMETRY_DIR", str(tmp_path))
+        try:
+            # explicit DISABLE (what the autouse fixture relies on) wins
+            # over the inherited env var
+            set_federation_dir(None)
+            assert federation.get_federation_dir() is None
+            # the pristine unset state falls back to the env var
+            set_federation_dir(federation._UNSET)
+            assert federation.get_federation_dir() == str(tmp_path)
+        finally:
+            set_federation_dir(None)
+
+    def test_federated_endpoint_404_when_unconfigured(self):
+        from deeplearning4j_tpu.remote import JsonModelServer
+        server = JsonModelServer(None, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{server.port}/metrics/federated")
+            assert ei.value.code == 404
+            assert "unconfigured" in ei.value.read().decode()
+        finally:
+            server.stop()
+
+    def test_ui_server_serves_federated_and_healthz(self, tmp_path):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+        r = MetricsRegistry()
+        r.counter("dl4j_tpu_train_steps_total", "steps").inc(6)
+        SnapshotWriter(str(tmp_path), hostId="w0", registry=r).write_now()
+        set_federation_dir(str(tmp_path))
+        server = UIServer(port=0)
+        server.attach(InMemoryStatsStorage())
+        try:
+            text = _get(f"http://127.0.0.1:{server.port}/metrics/federated")
+            hz = json.loads(
+                _get(f"http://127.0.0.1:{server.port}/healthz"))
+        finally:
+            server.stop()
+        assert "dl4j_tpu_train_steps_total 6.0" in text
+        assert hz["status"] == "ok" and hz["uptime_seconds"] >= 0
+        assert hz["firing_alerts"] == 0 and hz["pid"] == os.getpid()
+
+
+# ------------------------------------------------------------ watchdog ----
+
+class TestWatchdogRules:
+    def test_stall_rule_fires_and_resolves_deterministically(self):
+        reg = get_registry()
+        c = reg.counter("dl4j_tpu_train_steps_total", "steps")
+        c.inc(4)
+        mon = HealthMonitor(rules=[TrainingStallRule(timeout=10)],
+                            registry=reg)
+        assert mon.evaluate_once(now=0.0) == {}      # first observation
+        assert mon.evaluate_once(now=5.0) == {}      # under timeout
+        firing = mon.evaluate_once(now=20.0)
+        assert "training_stall" in firing
+        c.inc()
+        assert mon.evaluate_once(now=21.0) == {}     # progress resolves
+        g = reg.get("dl4j_tpu_health_alerts_firing")
+        assert g.value() == 0
+        assert reg.get("dl4j_tpu_health_alert_state").value(
+            rule="training_stall") == 0
+        t = reg.get("dl4j_tpu_health_alert_transitions_total")
+        assert t.value(rule="training_stall", state="firing") == 1
+        assert t.value(rule="training_stall", state="resolved") == 1
+
+    def test_stall_rule_does_not_fire_before_first_step(self):
+        reg = get_registry()
+        reg.counter("dl4j_tpu_train_steps_total", "steps")   # stays 0
+        mon = HealthMonitor(rules=[TrainingStallRule(timeout=10)],
+                            registry=reg)
+        assert mon.evaluate_once(now=0.0) == {}
+        assert mon.evaluate_once(now=100.0) == {}    # compiling, not stalled
+
+    def test_straggler_rule(self):
+        reg = get_registry()
+        g = reg.gauge("dl4j_tpu_parallel_replica_step_seconds", "t",
+                      labelnames=("replica",))
+        mon = HealthMonitor(rules=[ReplicaStragglerRule(ratio=2.0)],
+                            registry=reg)
+        for rid in "012":
+            g.set(0.1, replica=rid)
+        assert mon.evaluate_once(now=0.0) == {}
+        g.set(0.5, replica="2")
+        firing = mon.evaluate_once(now=1.0)
+        assert "replica_straggler" in firing
+        assert "replica 2" in firing["replica_straggler"]
+        g.set(0.1, replica="2")
+        assert mon.evaluate_once(now=2.0) == {}
+
+    def test_straggler_rule_fires_with_two_hosts(self):
+        # even cell counts: the straggler's own value must not inflate
+        # the midpoint median into unsatisfiability (w > w+b)
+        reg = get_registry()
+        g = reg.gauge("dl4j_tpu_parallel_replica_step_seconds", "t",
+                      labelnames=("replica", "host"))
+        mon = HealthMonitor(rules=[ReplicaStragglerRule(ratio=2.0)],
+                            registry=reg)
+        g.set(0.1, replica="0", host="a")
+        g.set(0.5, replica="0", host="b")
+        assert "replica_straggler" in mon.evaluate_once(now=0.0)
+
+    def test_divergence_rule_rebaselines_after_counter_reset(self):
+        rule = DivergencePrecursorRule(quietSeconds=10)
+        r1 = MetricsRegistry()
+        r1.counter("dl4j_tpu_fault_nan_rollbacks_total", "rb").inc(10)
+        assert rule.evaluate(r1, 0.0) is None        # baseline
+        # federated sum dips to 0 (worker restarted): no fire, re-baseline
+        r2 = MetricsRegistry()
+        c2 = r2.counter("dl4j_tpu_fault_nan_rollbacks_total", "rb")
+        assert rule.evaluate(r2, 1.0) is None
+        # the restarted worker's FIRST new rollback must read as a rise
+        c2.inc()
+        assert rule.evaluate(r2, 2.0) is not None
+
+    def test_straggler_fires_on_federated_view(self, tmp_path):
+        """In a real multi-process run each process's lockstep gauge is
+        uniform — the straggler only appears across HOSTS, so the
+        coordinator's monitor evaluates the merged federated registry."""
+        for host, dt in (("w0", 0.1), ("w1", 0.1), ("w2", 0.65)):
+            r = MetricsRegistry()
+            r.gauge("dl4j_tpu_parallel_replica_step_seconds", "t",
+                    labelnames=("replica",)).set(dt, replica="0")
+            SnapshotWriter(str(tmp_path), hostId=host,
+                           registry=r).write_now()
+        set_federation_dir(str(tmp_path))
+        mon = HealthMonitor(rules=[ReplicaStragglerRule(ratio=2.0)],
+                            federated=True)
+        firing = mon.evaluate_once(now=0.0)
+        assert "replica_straggler" in firing
+        assert "w2" in firing["replica_straggler"]
+        # alert-state metrics land in the LOCAL registry
+        assert get_registry().get(
+            "dl4j_tpu_health_alerts_firing").value() == 1
+
+    def test_starvation_rule_needs_blocked_consumer_and_live_producer(
+            self):
+        reg = get_registry()
+        waiting = reg.gauge("dl4j_tpu_etl_consumers_waiting", "w")
+        active = reg.gauge("dl4j_tpu_etl_producer_active", "a")
+        mon = HealthMonitor(rules=[EtlStarvationRule(forSeconds=30)],
+                            registry=reg)
+        waiting.set(1)                               # consumer blocked
+        active.set(1)
+        assert mon.evaluate_once(now=0.0) == {}      # arms
+        firing = mon.evaluate_once(now=40.0)
+        assert "etl_starvation" in firing
+        waiting.set(0)                               # batch arrived
+        assert mon.evaluate_once(now=41.0) == {}
+        # a consumer NOT blocked (e.g. minutes inside an XLA compile,
+        # stale depth gauge notwithstanding) must never fire
+        reg.gauge("dl4j_tpu_etl_queue_depth", "d").set(0)
+        assert mon.evaluate_once(now=42.0) == {}
+        assert mon.evaluate_once(now=200.0) == {}
+        # blocked but producer EXITED: drained epoch, not starvation
+        waiting.set(1)
+        active.set(0)
+        assert mon.evaluate_once(now=201.0) == {}
+        assert mon.evaluate_once(now=300.0) == {}
+
+    def test_divergence_precursor_rule(self):
+        reg = get_registry()
+        c = reg.counter("dl4j_tpu_fault_nan_rollbacks_total", "rb")
+        mon = HealthMonitor(
+            rules=[DivergencePrecursorRule(quietSeconds=300)], registry=reg)
+        assert mon.evaluate_once(now=0.0) == {}
+        c.inc()
+        firing = mon.evaluate_once(now=1.0)
+        assert "divergence_precursor" in firing
+        assert "divergence_precursor" in mon.evaluate_once(now=200.0)
+        assert mon.evaluate_once(now=302.0) == {}    # quiet period passed
+
+    def test_threshold_rule_and_rule_error_isolation(self, tmp_path):
+        reg = get_registry()
+        reg.gauge("dl4j_tpu_test_loss", "loss").set(9.0)
+
+        class Broken(TrainingStallRule):
+            name = "broken"
+
+            def evaluate(self, registry, now):
+                raise RuntimeError("rule bug")
+
+        log = tmp_path / "ev.jsonl"
+        mon = HealthMonitor(
+            rules=[Broken(), ThresholdRule("loss_ceiling",
+                                           "dl4j_tpu_test_loss", ">", 5.0)],
+            registry=reg, eventLogPath=str(log))
+        firing = mon.evaluate_once(now=0.0)
+        assert firing == {"loss_ceiling":
+                          "dl4j_tpu_test_loss = 9 > 5"}
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        assert any(ln["state"] == "rule_error" and ln["rule"] == "broken"
+                   for ln in lines)
+
+    def test_async_iterator_starvation_signals(self):
+        from deeplearning4j_tpu.datavec import AsyncDataSetIterator
+
+        class SlowIter(type(_iterator())):
+            def next(self, num=0):
+                time.sleep(0.02)        # producer slower than consumer:
+                return super().next(num)  # every poll finds the queue empty
+
+        it = AsyncDataSetIterator(SlowIter(list(_iterator()._ds)),
+                                  queueSize=2)
+        while it.hasNext():
+            it.next()
+        reg = get_registry()
+        assert reg.get("dl4j_tpu_etl_queue_empty_polls_total").value() >= 1
+        # the block-duration gauge always unwinds to 0 after the drain
+        assert reg.get("dl4j_tpu_etl_consumers_waiting").value() == 0
+        deadline = time.time() + 5
+        active = reg.get("dl4j_tpu_etl_producer_active")
+        while time.time() < deadline and active.value() != 0:
+            time.sleep(0.01)
+        assert active.value() == 0      # drained producer exits cleanly
+
+
+class TestWatchdogAcceptance:
+    def test_injected_stall_fires_and_resolves_training_stall(
+            self, tmp_path):
+        """ISSUE acceptance: a deterministic injected stall fires and then
+        resolves a training_stall alert in the JSON event log."""
+        log = tmp_path / "health_events.jsonl"
+        mon = HealthMonitor(
+            rules=[TrainingStallRule(timeout=0.15)], interval=0.02,
+            eventLogPath=str(log))
+        net = _net()
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2, healthMonitor=mon)
+        with inject(StallAtStep(step=3, seconds=0.6)):
+            t.fit(_iterator(), epochs=2)       # 8 steps, stall mid-run
+        assert not mon.is_running()            # fit() owns its lifecycle
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        stall = [(ln["state"], ln["detail"]) for ln in lines
+                 if ln["rule"] == "training_stall"]
+        states = [s for s, _ in stall]
+        assert "firing" in states and "resolved" in states, lines
+        assert states.index("firing") < states.index("resolved")
+        assert "no dl4j_tpu_train_steps_total progress" in \
+            dict(stall)["firing"]
+        # the gauge came back down with the resolution
+        assert get_registry().get(
+            "dl4j_tpu_health_alerts_firing").value() == 0
+
+    def test_supervisor_rollback_hooks_land_in_event_log(self, tmp_path):
+        log = tmp_path / "health_events.jsonl"
+        mon = HealthMonitor(rules=[], interval=60, eventLogPath=str(log))
+        net = _net()
+        t = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                 checkpointEveryN=2, keepLast=10,
+                                 healthMonitor=mon)
+        with inject(NaNAtStep(3)):
+            t.fit(_iterator(), epochs=1)
+        assert t.stats["rollbacks"] == 1
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        by_rule = {}
+        for ln in lines:
+            by_rule.setdefault(ln["rule"], []).append(ln)
+        assert "rollback" in by_rule and \
+            by_rule["rollback"][0]["state"] == "event"
+        assert "non-finite loss" in \
+            by_rule["rollback"][0]["detail"]["reason"]
+        assert "checkpoint_restore" in by_rule
+
+    def test_conflicting_monitor_and_health_config_raises(self, tmp_path):
+        from deeplearning4j_tpu.parallel.sharedtraining import \
+            SharedTrainingMaster
+        with pytest.raises(ValueError, match="not both"):
+            SharedTrainingMaster().fitMultiLayerNetwork(
+                _net(), _iterator(), epochs=1,
+                faultConfig={"checkpointDir": str(tmp_path / "ck"),
+                             "healthMonitor": HealthMonitor(rules=[])},
+                healthConfig={"stallTimeout": 60})
+
+    def test_producer_gauge_conflict_does_not_hang_consumer(self):
+        from deeplearning4j_tpu.datavec import AsyncDataSetIterator
+        # poison the name with a conflicting TYPE: the producer's gauge
+        # registration now raises; the drain must still terminate
+        get_registry().counter("dl4j_tpu_etl_producer_active", "oops")
+        it = AsyncDataSetIterator(_iterator(), queueSize=2)
+        n = 0
+        while it.hasNext():
+            it.next()
+            n += 1
+        assert n == 4
+
+    def test_step_age_resets_on_registry_swap(self):
+        r1 = MetricsRegistry()
+        r1.counter("dl4j_tpu_train_steps_total", "s").inc(3)
+        health_summary(r1)
+        time.sleep(0.05)
+        assert health_summary(r1)["last_step_age_seconds"] >= 0.04
+        # a NEW registry at the same coincidental total restarts the clock
+        r2 = MetricsRegistry()
+        r2.counter("dl4j_tpu_train_steps_total", "s").inc(3)
+        assert health_summary(r2)["last_step_age_seconds"] < 0.04
+
+    def test_healthz_tracks_step_age_and_firing_count(self):
+        reg = get_registry()
+        hz = health_summary(reg)
+        assert hz["steps_total"] is None        # nothing trained yet
+        assert hz["last_step_age_seconds"] is None
+        reg.counter("dl4j_tpu_train_steps_total", "steps").inc(3)
+        hz = health_summary(reg)
+        assert hz["steps_total"] == 3.0
+        assert hz["last_step_age_seconds"] is not None
+        reg.gauge("dl4j_tpu_health_alerts_firing", "n").set(2)
+        hz = health_summary(reg)
+        assert hz["status"] == "alerting" and hz["firing_alerts"] == 2
+
+    def test_remote_server_healthz(self):
+        from deeplearning4j_tpu.remote import JsonModelServer
+        get_registry().counter("dl4j_tpu_train_steps_total",
+                               "steps").inc(5)
+        server = JsonModelServer(None, port=0).start()
+        try:
+            hz = json.loads(
+                _get(f"http://127.0.0.1:{server.port}/healthz"))
+        finally:
+            server.stop()
+        assert hz["status"] == "ok"
+        assert hz["steps_total"] == 5.0
+        assert hz["uptime_seconds"] > 0
+
+
+# ------------------------------------------------------ durable export ----
+
+_EXPORT_WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {root!r})
+    from deeplearning4j_tpu.telemetry import (flight_recorder, get_registry,
+                                              install_export_handlers,
+                                              tracer)
+    assert install_export_handlers()    # main thread: SIGTERM hook armed
+    get_registry().counter("dl4j_tpu_train_steps_total",
+                           "Logical train steps dispatched").inc(7)
+    flight_recorder().record(iteration=1, step_seconds=0.01, batch_size=8)
+    mode = sys.argv[1]
+    if mode == "sigterm":
+        with tracer().span("busy_loop", iteration=1):
+            print("READY", flush=True)
+            time.sleep(60)              # killed long before this expires
+    else:
+        print("READY", flush=True)      # clean exit -> atexit flush
+""")
+
+
+class TestDurableExport:
+    def _run_worker(self, mode, run_dir, flight_dir):
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["DL4J_TPU_TELEMETRY_DIR"] = str(run_dir)
+        env["DL4J_TPU_FLIGHT_DIR"] = str(flight_dir)
+        code = _EXPORT_WORKER.format(root=str(_ROOT))
+        return subprocess.Popen([sys.executable, "-c", code, mode],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=env)
+
+    def test_sigterm_leaves_final_snapshot_flight_and_open_spans(
+            self, tmp_path):
+        """ISSUE acceptance: killing a worker with SIGTERM leaves a final
+        registry snapshot on disk (plus the flight ring and the span it
+        died inside)."""
+        run_dir = tmp_path / "run"
+        flight_dir = tmp_path / "flight"
+        flight_dir.mkdir()
+        p = self._run_worker("sigterm", run_dir, flight_dir)
+        assert "READY" in p.stdout.readline()
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 143, out[-2000:]     # conventional 128+15
+        snaps = list(run_dir.glob("metrics_*.json"))
+        assert len(snaps) == 1, list(run_dir.iterdir())
+        snap = json.loads(snaps[0].read_text())
+        assert snap["reason"] == "final_sigterm"
+        assert snap["metrics"]["dl4j_tpu_train_steps_total"]["cells"] == \
+            [[[], 7.0]]
+        spans = list(run_dir.glob("dl4j_tpu_spans_*.json"))
+        assert len(spans) == 1
+        open_spans = json.loads(spans[0].read_text())["open_spans"]
+        assert [s["name"] for s in open_spans] == ["busy_loop"]
+        assert open_spans[0]["open_seconds"] > 0
+        flights = list(flight_dir.glob("dl4j_tpu_flight_*.json"))
+        assert len(flights) == 1
+        dump = json.loads(flights[0].read_text())
+        assert dump["reason"] == "flush_sigterm"
+        assert dump["records"][0]["iteration"] == 1
+
+    def test_clean_exit_flushes_final_snapshot_via_atexit(self, tmp_path):
+        run_dir = tmp_path / "run"
+        p = self._run_worker("atexit", run_dir, tmp_path)
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out[-2000:]
+        snaps = list(run_dir.glob("metrics_*.json"))
+        assert len(snaps) == 1
+        snap = json.loads(snaps[0].read_text())
+        assert snap["reason"] == "final_atexit"
+        assert snap["metrics"]["dl4j_tpu_train_steps_total"]["cells"] == \
+            [[[], 7.0]]
+
+    def test_event_log_follows_federation_dir(self, tmp_path):
+        set_federation_dir(str(tmp_path))
+        mon = HealthMonitor(rules=[])
+        assert mon.eventLogPath == str(
+            tmp_path / f"health_events_{os.getpid()}.jsonl")
+        mon.note("probe", detail=1)
+        assert Path(mon.eventLogPath).exists()
+
+    def test_sigterm_honors_inherited_sig_ign(self, tmp_path):
+        """A launcher that set SIGTERM to SIG_IGN must keep its process:
+        the export handler flushes, then honors the ignore instead of
+        exiting."""
+        worker = textwrap.dedent("""
+            import os, signal, sys, time
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, {root!r})
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            from deeplearning4j_tpu.telemetry import (get_registry,
+                install_export_handlers)
+            assert install_export_handlers()
+            get_registry().counter("dl4j_tpu_train_steps_total",
+                                   "steps").inc(3)
+            print("READY", flush=True)
+            time.sleep(60)
+        """).format(root=str(_ROOT))
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["DL4J_TPU_TELEMETRY_DIR"] = str(tmp_path)
+        p = subprocess.Popen([sys.executable, "-c", worker],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True, env=env)
+        try:
+            assert "READY" in p.stdout.readline()
+            p.send_signal(signal.SIGTERM)
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    not list(tmp_path.glob("metrics_*.json")):
+                time.sleep(0.05)
+            snaps = list(tmp_path.glob("metrics_*.json"))
+            assert snaps, "SIGTERM did not flush a snapshot"
+            # ...but the process SURVIVED the ignored signal
+            time.sleep(0.3)
+            assert p.poll() is None, "SIG_IGN process died on SIGTERM"
+        finally:
+            p.kill()
+            p.communicate(timeout=60)
+
+    def test_install_upgrades_from_main_thread(self):
+        import threading
+
+        from deeplearning4j_tpu.telemetry import export
+        export.uninstall_export_handlers()
+        try:
+            res = []
+            th = threading.Thread(
+                target=lambda: res.append(export.install_export_handlers()))
+            th.start()
+            th.join()
+            assert res == [False]      # worker thread: atexit only
+            assert export.install_export_handlers() is True  # main: upgrade
+        finally:
+            export.uninstall_export_handlers()
+
+    def test_write_final_snapshot_without_federation(self, tmp_path):
+        get_registry().counter("dl4j_tpu_test_done_total", "d").inc()
+        with tracer().span("exporting"):
+            path = write_final_snapshot(reason="manual")
+        assert path and os.path.dirname(path) == str(tmp_path)
+        snap = json.loads(Path(path).read_text())
+        assert snap["reason"] == "final_manual"
+        assert "dl4j_tpu_test_done_total" in snap["metrics"]
+        spans = list(tmp_path.glob("dl4j_tpu_spans_*.json"))
+        assert len(spans) == 1
+        assert json.loads(spans[0].read_text())["open_spans"][0]["name"] \
+            == "exporting"
+
+
+# ------------------------------------------------------- lint / tier-1 ----
+
+class TestLintExtensions:
+    def test_lint_rejects_missing_and_empty_help(self, tmp_path):
+        sys.path.insert(0, str(_TOOLS))
+        try:
+            import lint_telemetry
+            bad = tmp_path / "bad.py"
+            bad.write_text(
+                'reg.counter("dl4j_tpu_a_b_total")\n'
+                'reg.gauge("dl4j_tpu_a_c", "")\n'
+                'reg.histogram("dl4j_tpu_a_d_seconds", help="ok")\n'
+                'reg.gauge("dl4j_tpu_a_e", labelnames=("x",))\n'
+                'reg.counter("dl4j_tpu_a_f_total", _HELP)\n'     # variable:
+                'reg.gauge("dl4j_tpu_a_g", f"dyn {x}")\n'   # unverifiable,
+                'reg.counter("dl4j_tpu_a_h_total",)\n'          # accepted
+                'reg.gauge("dl4j_tpu_a_i", ("rule",))\n')
+            errors = lint_telemetry.lint(tmp_path)
+            assert len(errors) == 5, errors
+            assert "without a help" in errors[0]
+            assert "EMPTY help" in errors[1]
+            assert "dl4j_tpu_a_e" in errors[2] and \
+                "without a help" in errors[2]
+            assert "dl4j_tpu_a_h_total" in errors[3]    # trailing comma
+            assert "dl4j_tpu_a_i" in errors[4]          # tuple, not help
+        finally:
+            sys.path.remove(str(_TOOLS))
+
+    def test_lint_rejects_cross_module_duplicates(self, tmp_path):
+        sys.path.insert(0, str(_TOOLS))
+        try:
+            import lint_telemetry
+            (tmp_path / "mod_a.py").write_text(
+                'reg.counter("dl4j_tpu_a_b_total", "help a")\n')
+            (tmp_path / "mod_b.py").write_text(
+                'reg.counter("dl4j_tpu_a_b_total", "help b")\n')
+            errors = lint_telemetry.lint(tmp_path)
+            assert len(errors) == 1
+            assert "2 modules" in errors[0]
+            # same name twice in ONE module (idempotent re-fetch) is fine
+            (tmp_path / "mod_b.py").unlink()
+            (tmp_path / "mod_a.py").write_text(
+                'reg.counter("dl4j_tpu_a_b_total", "help a")\n'
+                'reg.counter("dl4j_tpu_a_b_total", "help a")\n')
+            assert lint_telemetry.lint(tmp_path) == []
+        finally:
+            sys.path.remove(str(_TOOLS))
+
+    def test_check_markers_gates_on_telemetry_lint(self, tmp_path):
+        sys.path.insert(0, str(_TOOLS))
+        try:
+            import check_markers
+            bad_pkg = tmp_path / "pkg"
+            bad_pkg.mkdir()
+            (bad_pkg / "m.py").write_text(
+                'reg.counter("dl4j_tpu_a_b_total")\n')   # missing help
+            rc = check_markers.main(["check_markers.py",
+                                     str(_ROOT / "tests"), str(bad_pkg)])
+            assert rc == 1
+            rc = check_markers.main(["check_markers.py",
+                                     str(_ROOT / "tests"),
+                                     str(_ROOT / "deeplearning4j_tpu")])
+            assert rc == 0
+        finally:
+            sys.path.remove(str(_TOOLS))

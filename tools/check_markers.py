@@ -39,6 +39,8 @@ def registered_markers(tests_dir: Path) -> set:
 def main(argv) -> int:
     tests_dir = Path(argv[1]) if len(argv) > 1 else \
         Path(__file__).resolve().parent.parent / "tests"
+    pkg_dir = Path(argv[2]) if len(argv) > 2 else \
+        Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
     registered = registered_markers(tests_dir)
     missing = REQUIRED - registered
     if missing:
@@ -62,7 +64,16 @@ def main(argv) -> int:
                   f"pytest_configure)", file=sys.stderr)
         return 1
     print(f"check_markers: OK ({len(allowed)} registered/builtin markers)")
-    return 0
+    # the telemetry namespace lint rides the same tier-1 gate: a drifting
+    # or undocumented metric name breaks dashboards/alerts just as
+    # silently as a typo'd marker loses test coverage
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    try:
+        import lint_telemetry
+        rc = lint_telemetry.main(["lint_telemetry.py", str(pkg_dir)])
+    finally:
+        sys.path.pop(0)
+    return rc
 
 
 if __name__ == "__main__":

@@ -11,24 +11,44 @@ fails unless each public metric name follows the naming convention:
   increase() assume it);
 - gauges and histograms do NOT end in ``_total`` (a gauge named like a
   counter lies to every recording rule that touches it);
-- histograms measuring time end in ``_seconds`` (base-unit rule).
+- histograms measuring time end in ``_seconds`` (base-unit rule);
+- every registration carries a NON-EMPTY help string (a bare name on a
+  federated dashboard three hops from the code is unreadable; ``# HELP``
+  is the only documentation a scrape carries);
+- a metric name is registered from ONE module only (two modules
+  registering the same name will eventually drift in help/labels/type,
+  and the second registration's intent silently loses — the shared
+  metric belongs in a common module both import).
 
 A drifting metric name is an outage for every dashboard/alert built on
 the old one — this lint makes the convention a CI property, not a review
-nitpick.  Run: ``python tools/lint_telemetry.py`` (exercised by
-tests/test_telemetry.py so it rides tier-1).
+nitpick.  Run: ``python tools/lint_telemetry.py`` (invoked by
+``tools/check_markers.py``, so it gates tier-1).
 """
 import re
 import sys
+from collections import defaultdict
 from pathlib import Path
 
 NAME_PATTERN = re.compile(r"^dl4j_tpu_[a-z][a-z0-9]*(_[a-z0-9]+)+$")
 CALL_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*\n?\s*[\"']([^\"']+)[\"']")
+    r"\.(counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
+# the name argument's terminator: nothing after it (no help at all) is a
+# hard error; a string literal (optionally help=/f-prefixed) is checked
+# for a non-empty FIRST fragment (implicit concatenation may continue it
+# across lines); any other expression (a variable, a call) can't be
+# verified statically and is accepted
+NO_HELP_RE = re.compile(
+    r"\s*(,?\s*\)"                                  # ) or trailing-comma )
+    r"|,\s*(labelnames|buckets|maxLabelSets)\s*="   # help skipped by kwarg
+    r"|,\s*[(\[])")                                 # positional tuple/list
+HELP_LITERAL_RE = re.compile(
+    r"\s*,\s*(?:help\s*=\s*)?[frbuFRBU]{0,2}[\"'](?P<first>[^\"']*)[\"']")
 
 
 def lint(pkg_dir: Path):
     errors = []
+    sites_by_name = defaultdict(set)
     for path in sorted(pkg_dir.rglob("*.py")):
         text = path.read_text(encoding="utf-8")
         for m in CALL_RE.finditer(text):
@@ -40,6 +60,7 @@ def lint(pkg_dir: Path):
                     f"{where}: {kind} {name!r} does not match "
                     "dl4j_tpu_<subsystem>_<name> (lower-snake)")
                 continue
+            sites_by_name[name].add(path)
             if kind == "counter" and not name.endswith("_total"):
                 errors.append(
                     f"{where}: counter {name!r} must end in '_total'")
@@ -52,6 +73,22 @@ def lint(pkg_dir: Path):
                 errors.append(
                     f"{where}: histogram {name!r} must carry a base-unit "
                     "suffix (_seconds/_bytes/_examples)")
+            hm = HELP_LITERAL_RE.match(text, m.end())
+            if NO_HELP_RE.match(text, m.end()):
+                errors.append(
+                    f"{where}: {kind} {name!r} registered without a help "
+                    "string (# HELP is the only documentation a scrape "
+                    "carries)")
+            elif hm is not None and not hm.group("first").strip():
+                errors.append(
+                    f"{where}: {kind} {name!r} has an EMPTY help string")
+    for name, paths in sorted(sites_by_name.items()):
+        if len(paths) > 1:
+            listing = ", ".join(str(p) for p in sorted(paths))
+            errors.append(
+                f"{name}: registered from {len(paths)} modules "
+                f"({listing}) — registrations drift; move the shared "
+                "metric to one module both import")
     return errors
 
 
