@@ -20,6 +20,7 @@ mfu (flops basis: 2*MAC standard counting, v5e bf16 peak 197 TFLOP/s).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -178,11 +179,187 @@ def bench_long_context(t: int = 2048, b: int = 4, steps: int = 6):
     return b * t * steps / (time.perf_counter() - t0)
 
 
+class StreamingImageSource:
+    """Picklable decode-heavy synthetic image source for the streaming-ETL
+    benchmark: per image it runs the work a real JPEG path pays on the
+    host (entropy-ish byte generation stands in for Huffman decode, then
+    bilinear resize, float conversion, per-channel normalize, HWC->CHW)
+    so the measurement stresses Python-side decode + H2D, not the model.
+    ``shard()`` is the producer-pool contract: worker ``i`` of ``n``
+    decodes batches ``i % n`` only — no image decoded twice."""
+
+    def __init__(self, nBatches: int, batch: int, img: int,
+                 classes: int = 100, _lo: int = 0, _stride: int = 1):
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+        self.nBatches, self.batch, self.img = nBatches, batch, img
+        self.classes = classes
+        self._lo, self._stride = _lo, _stride
+        self._ids = list(range(_lo, nBatches, _stride))
+        self._i = 0
+        self._dsi = DataSetIterator         # keep the SPI import alive
+
+    def streaming(self) -> bool:
+        return True
+
+    def shard(self, index: int, count: int) -> "StreamingImageSource":
+        return StreamingImageSource(self.nBatches, self.batch, self.img,
+                                    self.classes, _lo=index, _stride=count)
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._ids)
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def batchSizeOf(self) -> int:
+        return self.batch
+
+    def _decode_one(self, rng, raw_hw: int):
+        raw = rng.randint(0, 256, (raw_hw, raw_hw, 3)).astype(np.uint8)
+        ys = (np.arange(self.img) * raw_hw / self.img)
+        y0 = ys.astype(int)
+        fy = (ys - y0)[:, None, None]
+        xs = (np.arange(self.img) * raw_hw / self.img)
+        x0 = xs.astype(int)
+        fx = (xs - x0)[None, :, None]
+        y1 = np.minimum(y0 + 1, raw_hw - 1)
+        x1 = np.minimum(x0 + 1, raw_hw - 1)
+        f = raw.astype(np.float32)
+        img = ((f[y0][:, x0] * (1 - fy) + f[y1][:, x0] * fy) * (1 - fx)
+               + (f[y0][:, x1] * (1 - fy) + f[y1][:, x1] * fy) * fx)
+        img = (img / 255.0 - 0.45) / 0.225
+        return np.ascontiguousarray(img.transpose(2, 0, 1))
+
+    def next(self, num: int = 0):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        bid = self._ids[self._i]
+        self._i += 1
+        rng = np.random.RandomState(1000 + bid)
+        raw_hw = self.img + self.img // 2
+        x = np.stack([self._decode_one(rng, raw_hw)
+                      for _ in range(self.batch)])
+        y = np.eye(self.classes, dtype=np.float32)[
+            rng.randint(0, self.classes, self.batch)]
+        return DataSet(x.astype(np.float32), y)
+
+
+def bench_streaming(workers: int = 4, batch: int = 64, img: int = 96,
+                    batches: int = 24) -> dict:
+    """Streaming-ETL benchmark (ROADMAP item 2 / ISSUE 6 acceptance):
+    the SAME decode-heavy source drained two ways —
+
+    - ``naive``: the seed streaming path (single process decodes each
+      batch inline, then a blocking host->device transfer the step must
+      wait out — the 47 images/sec shape of BENCH_r05);
+    - ``pipeline``: ``PrefetchingDataSetIterator`` — ``workers`` decode
+      processes sharded over the batches, shared-memory assembly, and
+      the double-buffered async H2D staging ring.
+
+    Both consume through one tiny jitted reduction per batch (forces the
+    data on device without model noise).  H2D MB/s comes from the
+    ``dl4j_tpu_etl_h2d_bytes_total`` / ``_seconds`` series the staging
+    ring maintains — the exact counters the federated dashboards watch.
+    On the tunneled chip ``block_until_ready`` can return before the
+    async transfer lands (the bench.py header's measurement note), so
+    the per-transfer histogram under-measures there: ``h2d_wall_mb_s``
+    (bytes over the whole pipelined window) is the honest rate on the
+    relay, ``h2d_mb_s`` on local backends.  With a trivial consumer the
+    tunnel caps BOTH paths at link speed; the real-step overlap win is
+    measured by the fit-path integration, not this microbench.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datavec.pipeline import \
+        PrefetchingDataSetIterator
+    from deeplearning4j_tpu.telemetry import get_registry
+
+    src = StreamingImageSource(batches, batch, img)
+
+    @jax.jit
+    def consume(x):
+        return jnp.sum(x)
+
+    # warm the consumer executable outside both windows
+    float(consume(jax.device_put(
+        np.zeros((batch, 3, img, img), np.float32))))
+
+    # -- naive single-process path (the seed shape) ---------------------
+    src.reset()
+    t0 = time.perf_counter()
+    n_naive = 0
+    while src.hasNext():
+        ds = src.next()
+        xb = ds.features.numpy()
+        dev = jax.device_put(xb)
+        jax.block_until_ready(dev)          # un-overlapped transfer
+        float(consume(dev))
+        n_naive += xb.shape[0]
+    naive_s = time.perf_counter() - t0
+    naive_ips = n_naive / naive_s
+
+    # -- sharded pool + staging ring ------------------------------------
+    reg = get_registry()
+    b0 = reg.get("dl4j_tpu_etl_h2d_bytes_total")
+    bytes0 = b0.value() if b0 is not None else 0.0
+    h0 = reg.get("dl4j_tpu_etl_h2d_seconds")
+    secs0 = h0.sum() if h0 is not None else 0.0
+    pit = PrefetchingDataSetIterator(src, numWorkers=workers,
+                                     queueDepth=max(4, workers + 2))
+    try:
+        t0 = time.perf_counter()
+        n_pipe = 0
+        while pit.hasNext():
+            ds = pit.next()                 # already staged on device
+            float(consume(ds.features.jax))
+            n_pipe += int(ds.features.shape[0])
+        pipe_s = time.perf_counter() - t0
+    finally:
+        pit.close()
+    pipe_ips = n_pipe / pipe_s
+    h2d_bytes = (reg.get("dl4j_tpu_etl_h2d_bytes_total").value()
+                 - bytes0)
+    h2d_secs = reg.get("dl4j_tpu_etl_h2d_seconds").sum() - secs0
+    assert n_pipe == n_naive, (n_pipe, n_naive)
+
+    return {
+        "metric": "streaming_etl_images_per_sec",
+        "value": round(pipe_ips, 1),
+        "unit": "images/sec",
+        "naive_images_per_sec": round(naive_ips, 1),
+        # capped by the HOST's real core parallelism: this container
+        # advertises 2 CPUs whose measured 2-process scaling is ~1.1x
+        # (sibling threads), so speedup here is a floor for real
+        # multi-core hosts, not the pipeline's ceiling
+        "speedup_vs_naive": round(pipe_ips / naive_ips, 3),
+        "cpu_count": os.cpu_count(),
+        # effective H2D rate of the staging ring: issue+wait seconds are
+        # near zero once transfers overlap the consumer, so also report
+        # wall-clock MB/s over the whole pipelined window
+        "h2d_mb_s": round(h2d_bytes / max(h2d_secs, 1e-9) / 1e6, 1),
+        "h2d_wall_mb_s": round(h2d_bytes / pipe_s / 1e6, 1),
+        "h2d_bytes": int(h2d_bytes),
+        "workers": workers,
+        "batch": batch,
+        "image": img,
+        "batches": batches,
+    }
+
+
 def main() -> None:
     import jax
 
     from deeplearning4j_tpu.datasets import DataSet
     from deeplearning4j_tpu.zoo import ResNet50
+
+    if "--streaming" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        workers = int(args[0]) if args else 4
+        batch = int(args[1]) if len(args) > 1 else 64
+        img = int(args[2]) if len(args) > 2 else 96
+        batches = int(args[3]) if len(args) > 3 else 24
+        print(json.dumps(bench_streaming(workers, batch, img, batches)))
+        return
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     img = int(sys.argv[2]) if len(sys.argv) > 2 else 224

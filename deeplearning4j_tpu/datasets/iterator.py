@@ -40,6 +40,16 @@ class DataSetIterator:
     def asyncSupported(self) -> bool:
         return True
 
+    def streaming(self) -> bool:
+        """True when ``next()`` does real per-record host work (file
+        decode, CSV parse, augmentation) rather than handing out
+        pre-materialized arrays.  The fit paths use this to decide
+        whether to engage the sharded multi-process producer pool
+        (:class:`~deeplearning4j_tpu.datavec.pipeline.
+        PrefetchingDataSetIterator`) — wrapping an in-memory iterator in
+        worker processes only adds IPC cost."""
+        return False
+
     def getPreProcessor(self):
         return getattr(self, "_preProcessor", None)
 

@@ -42,3 +42,6 @@ from deeplearning4j_tpu.datavec.columnar import (  # noqa: F401
 from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401
     AsyncDataSetIterator, RecordReaderDataSetIterator,
     SequenceRecordReaderDataSetIterator)
+from deeplearning4j_tpu.datavec.pipeline import (  # noqa: F401
+    PrefetchingDataSetIterator, ProducerWorkerError, ShardSpec,
+    maybe_prefetch)

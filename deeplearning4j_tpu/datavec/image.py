@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.datavec.records import (FileSplit, InputSplit,
-                                                RecordReader)
+                                                RecordReader, _shard_check)
 from deeplearning4j_tpu.datavec.writable import (IntWritable, NDArrayWritable,
                                                  Writable)
 
@@ -202,6 +202,7 @@ class ImageRecordReader(RecordReader):
         self.loader = NativeImageLoader(height, width, channels)
         self.labelGenerator = labelGenerator
         self.imageTransform = imageTransform
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
         self._files: List[str] = []
         self._labels: List[str] = []
@@ -237,3 +238,28 @@ class ImageRecordReader(RecordReader):
 
     def reset(self) -> None:
         self._i = 0
+
+    def streaming(self) -> bool:
+        return True     # file decode + augmentation per next()
+
+    def setEpoch(self, epoch: int) -> None:
+        """Producer-pool epoch signal: re-derive the augmentation RNG so
+        the pool's frozen-pickle worker generations don't replay the
+        same augmented batches every epoch (deterministic in
+        (seed, epoch), matching the seeded-pipeline reproducibility
+        contract)."""
+        self._rng = np.random.RandomState(
+            (self._seed + 1000003 * (int(epoch) + 1)) % (2**31 - 1))
+
+    def shard(self, index: int, count: int) -> "ImageRecordReader":
+        """Producer-pool shard: every worker keeps the FULL label
+        vocabulary (computed from the whole split at initialize) but
+        decodes only its ``i % count == index`` slice of the files."""
+        import copy
+        _shard_check(index, count)
+        out = copy.copy(self)
+        out._files = self._files[index::count]
+        out._rng = np.random.RandomState(self._rng.randint(2**31 - 1)
+                                         + index)
+        out._i = 0
+        return out

@@ -74,10 +74,20 @@ class RecordReaderDataSetIterator(DataSetIterator):
                     ls.append([w.toDouble() for w in label])
                 else:
                     k = int(label[0].toDouble())
+                    if not 0 <= k < self.numPossibleLabels:
+                        raise ValueError(
+                            f"label index {k} out of range for "
+                            f"numPossibleLabels={self.numPossibleLabels} "
+                            f"(record {len(fs) - 1} of this batch)")
                     onehot = np.zeros(self.numPossibleLabels,
                                       dtype=np.float32)
                     onehot[k] = 1.0
                     ls.append(onehot)
+        if not fs:
+            # next() past the end: np.stack([]) would raise a bare
+            # ValueError deep in numpy — make the exhausted-reader
+            # contract explicit
+            raise StopIteration("reader exhausted: call reset() first")
         f = np.stack(fs)
         l = np.asarray(ls, dtype=np.float32) if ls else None
         return self._applyPre(DataSet(f, l))
@@ -90,6 +100,31 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
     def totalOutcomes(self) -> int:
         return self.numPossibleLabels
+
+    def streaming(self) -> bool:
+        return self.reader.streaming()
+
+    def setEpoch(self, epoch: int) -> None:
+        """Producer-pool epoch signal (see ``datavec.pipeline``): lets a
+        reader with per-epoch randomness (augmentation) vary across the
+        pool's frozen-pickle generations."""
+        se = getattr(self.reader, "setEpoch", None)
+        if se is not None:
+            se(epoch)
+
+    def shard(self, index: int, count: int
+              ) -> "RecordReaderDataSetIterator":
+        """Deterministic 1-of-``count`` shard: a copy of this iterator
+        over the records ``i % count == index`` (the producer-pool
+        worker contract — see ``datavec.pipeline``)."""
+        out = RecordReaderDataSetIterator(
+            self.reader.shard(index, count), self.batchSize,
+            labelIndex=self.labelIndex,
+            numPossibleLabels=self.numPossibleLabels,
+            regression=self.regression, labelIndexTo=self.labelIndexTo)
+        if self.getPreProcessor() is not None:
+            out.setPreProcessor(self.getPreProcessor())
+        return out
 
 
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
@@ -116,8 +151,21 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         seqs = []
         while self.reader.hasNext() and len(seqs) < n:
             seqs.append(self.reader.nextSequence())
+        if not seqs:
+            # same exhausted-reader contract as the non-sequence
+            # iterator: max() over zero sequences is a bare ValueError
+            raise StopIteration("reader exhausted: call reset() first")
         tmax = max(len(s) for s in seqs)
-        nin = len(seqs[0][0]) - 1
+        # infer nin from EVERY time step, not just the first step of the
+        # first sequence — ragged rows must fail loudly here, not as a
+        # shape error in the assignment loop below
+        widths = {len(step) for seq in seqs for step in seq}
+        if len(widths) != 1:
+            raise ValueError(
+                "inconsistent sequence step widths in batch: "
+                f"{sorted(widths)} columns (every time step must carry "
+                "the same feature+label column count)")
+        nin = widths.pop() - 1
         nout = 1 if self.regression else self.numPossibleLabels
         b = len(seqs)
         f = np.zeros((b, nin, tmax), dtype=np.float32)
@@ -143,6 +191,19 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
 
     def totalOutcomes(self) -> int:
         return self.numPossibleLabels
+
+    def streaming(self) -> bool:
+        return self.reader.streaming()
+
+    def shard(self, index: int, count: int
+              ) -> "SequenceRecordReaderDataSetIterator":
+        out = SequenceRecordReaderDataSetIterator(
+            self.reader.shard(index, count), self.batchSize,
+            numPossibleLabels=self.numPossibleLabels,
+            labelIndex=self.labelIndex, regression=self.regression)
+        if self.getPreProcessor() is not None:
+            out.setPreProcessor(self.getPreProcessor())
+        return out
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -181,10 +242,8 @@ class AsyncDataSetIterator(DataSetIterator):
             active = None
             try:
                 try:
-                    from deeplearning4j_tpu.telemetry import get_registry
-                    active = get_registry().gauge(
-                        "dl4j_tpu_etl_producer_active",
-                        "Async prefetch producer threads currently running")
+                    from deeplearning4j_tpu.telemetry import etl_metrics
+                    active = etl_metrics().producer_active()
                     active.inc()
                 except Exception:
                     active = None
@@ -207,14 +266,12 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._peek is None:
             import time as _time
 
-            from deeplearning4j_tpu.telemetry import get_registry
-            reg = get_registry()
+            from deeplearning4j_tpu.telemetry import etl_metrics
+            em = etl_metrics()
             # depth BEFORE the blocking get: 0 here means the device loop
             # is outrunning host ETL (the producer is the bottleneck)
             depth = self._q.qsize()
-            reg.gauge(
-                "dl4j_tpu_etl_queue_depth",
-                "Prefetch-queue depth observed by the consumer").set(depth)
+            em.queue_depth().set(depth)
             waiting = None
             if depth == 0:
                 # starvation signals: the consumer arrived at an EMPTY
@@ -224,14 +281,8 @@ class AsyncDataSetIterator(DataSetIterator):
                 # EtlStarvationRule keys on it because the depth gauge
                 # goes stale between polls (a consumer busy compiling
                 # for minutes must not read as starved)
-                reg.counter(
-                    "dl4j_tpu_etl_queue_empty_polls_total",
-                    "Consumer polls that found the prefetch queue "
-                    "empty").inc()
-                waiting = reg.gauge(
-                    "dl4j_tpu_etl_consumers_waiting",
-                    "Consumers currently blocked on an empty prefetch "
-                    "queue")
+                em.empty_polls().inc()
+                waiting = em.consumers_waiting()
                 waiting.inc()
             t0 = _time.perf_counter()
             try:
@@ -250,10 +301,7 @@ class AsyncDataSetIterator(DataSetIterator):
             if self._peek is not self._END and \
                     not isinstance(self._peek, BaseException):
                 from deeplearning4j_tpu.telemetry import note_etl_wait
-                reg.gauge(
-                    "dl4j_tpu_etl_prefetch_wait_seconds",
-                    "Consumer block time on the last prefetch-queue "
-                    "get").set(wait)
+                em.prefetch_wait().set(wait)
                 note_etl_wait(wait, self)
         if isinstance(self._peek, BaseException):
             exc = self._peek
@@ -269,10 +317,20 @@ class AsyncDataSetIterator(DataSetIterator):
         return ds
 
     def reset(self) -> None:
-        # drain current producer, reset source, restart
+        # drain current producer, reset source, restart.  A producer
+        # exception encountered while draining (held in _peek or still
+        # queued behind it) is re-raised AFTER the drain: a truncated
+        # epoch must not be reset away silently.  State is left clean
+        # (_peek == _END, thread joined) so a subsequent reset() can
+        # still restart the pipeline after the caller handles the error.
+        exc = self._peek if isinstance(self._peek, BaseException) else None
         while self._peek is not self._END:
             self._peek = self._q.get()
+            if exc is None and isinstance(self._peek, BaseException):
+                exc = self._peek
         self._thread.join()
+        if exc is not None:
+            raise exc
         self.wrapped.reset()
         self._start()
 

@@ -13,6 +13,7 @@ host ETL isn't a Python-loop bottleneck feeding the device.
 """
 from __future__ import annotations
 
+import copy
 import glob as _glob
 import os
 import re
@@ -105,6 +106,27 @@ class RecordReader:
         while self.hasNext():
             yield self.next()
 
+    def streaming(self) -> bool:
+        """True when ``next()`` does real decode work per record (CSV
+        parse, file read, image decode) — the signal the fit paths use to
+        engage the multi-process producer pool."""
+        return False
+
+    def shard(self, index: int, count: int) -> "RecordReader":
+        """Return a reader over records ``i % count == index`` of this
+        (already-initialized) reader — the deterministic per-worker shard
+        assignment of the producer pool.  Readers that can slice their
+        backing store override this; the default refuses so the pool
+        falls back to batch-granularity ownership instead of silently
+        duplicating records."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support record sharding")
+
+
+def _shard_check(index: int, count: int) -> None:
+    if count < 1 or not 0 <= index < count:
+        raise ValueError(f"invalid shard {index}/{count}")
+
 
 class SequenceRecordReader(RecordReader):
     """next() is one sequence = List[List[Writable]] (time-major)."""
@@ -140,6 +162,13 @@ class LineRecordReader(RecordReader):
 
     def reset(self) -> None:
         self._i = 0
+
+    def shard(self, index: int, count: int) -> "LineRecordReader":
+        _shard_check(index, count)
+        out = copy.copy(self)
+        out._lines = self._lines[index::count]
+        out._i = 0
+        return out
 
 
 def _parse_field(tok: str) -> Writable:
@@ -198,6 +227,17 @@ class CSVRecordReader(RecordReader):
     def reset(self) -> None:
         self._i = 0
 
+    def streaming(self) -> bool:
+        return True     # field parse happens per next()
+
+    def shard(self, index: int, count: int) -> "CSVRecordReader":
+        _shard_check(index, count)
+        out = copy.copy(self)
+        out._lines = self._lines[index::count]
+        out._raw = "\n".join(out._lines)
+        out._i = 0
+        return out
+
     def loadAll(self) -> np.ndarray:
         """All-numeric bulk load through the native parser.
 
@@ -243,6 +283,16 @@ class CSVSequenceRecordReader(SequenceRecordReader):
 
     def reset(self) -> None:
         self._i = 0
+
+    def streaming(self) -> bool:
+        return True     # one file open + parse per sequence
+
+    def shard(self, index: int, count: int) -> "CSVSequenceRecordReader":
+        _shard_check(index, count)
+        out = copy.copy(self)
+        out._files = self._files[index::count]
+        out._i = 0
+        return out
 
 
 class RegexLineRecordReader(RecordReader):
@@ -294,6 +344,13 @@ class CollectionRecordReader(RecordReader):
     def reset(self) -> None:
         self._i = 0
 
+    def shard(self, index: int, count: int) -> "CollectionRecordReader":
+        _shard_check(index, count)
+        out = copy.copy(self)
+        out._records = self._records[index::count]
+        out._i = 0
+        return out
+
 
 class CollectionSequenceRecordReader(SequenceRecordReader):
     def __init__(self, sequences: Sequence[Sequence[Sequence]]):
@@ -317,6 +374,14 @@ class CollectionSequenceRecordReader(SequenceRecordReader):
 
     def reset(self) -> None:
         self._i = 0
+
+    def shard(self, index: int, count: int
+              ) -> "CollectionSequenceRecordReader":
+        _shard_check(index, count)
+        out = copy.copy(self)
+        out._seqs = self._seqs[index::count]
+        out._i = 0
+        return out
 
 
 class SVMLightRecordReader(RecordReader):
@@ -346,3 +411,11 @@ class SVMLightRecordReader(RecordReader):
 
     def reset(self) -> None:
         self._inner.reset()
+
+    def streaming(self) -> bool:
+        return True     # sparse-row parse per next()
+
+    def shard(self, index: int, count: int) -> "SVMLightRecordReader":
+        out = copy.copy(self)
+        out._inner = self._inner.shard(index, count)
+        return out

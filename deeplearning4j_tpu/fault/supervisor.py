@@ -196,6 +196,23 @@ class FaultTolerantTrainer:
         if self.durableExport:
             from deeplearning4j_tpu.telemetry import install_export_handlers
             install_export_handlers()
+        # streaming sources engage the producer pool, ALWAYS pinned to
+        # one worker under supervision: checkpoints record a mid-epoch
+        # position (stepInEpoch) that resume fast-forwards through, so
+        # the stream order must be deterministic on BOTH the writing run
+        # and the resuming run — a multi-worker pool interleaves shards
+        # scheduling-dependently.  One worker still moves decode off the
+        # training process and keeps the async H2D staging ring.
+        from deeplearning4j_tpu.datavec.pipeline import maybe_prefetch
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        src = iterator
+        iterator = maybe_prefetch(
+            iterator, numWorkers=1,
+            # host sharding only makes sense when the supervised model
+            # all-reduces across hosts (the ParallelWrapper /
+            # SharedTrainingMaster cluster path); a bare net must see
+            # the full stream on every process
+            hostShard=isinstance(self.net, ParallelWrapper))
         owns_monitor = (self.healthMonitor is not None and
                         not self.healthMonitor.is_running())
         if owns_monitor:
@@ -203,6 +220,8 @@ class FaultTolerantTrainer:
         try:
             self._fit(iterator, epochs)
         finally:
+            if iterator is not src:
+                iterator.close()
             if owns_monitor:
                 # stop() resolves anything still firing: the run is over,
                 # so "training stalled" would be vacuously stale; the

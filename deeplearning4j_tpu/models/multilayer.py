@@ -441,8 +441,20 @@ class MultiLayerNetwork:
         if isinstance(data, DataSet):
             self._fitBatch(data)
         elif isinstance(data, DataSetIterator):
-            for _ in range(epochs):
-                self._fitEpoch(data)
+            # streaming sources (file decode / CSV parse per record)
+            # auto-engage the sharded producer pool + H2D staging ring;
+            # in-memory iterators pass through unchanged.  hostShard
+            # stays OFF here: a bare fit has no cross-host all-reduce,
+            # so under jax.distributed each process must see the full
+            # stream (ParallelWrapper/SharedTrainingMaster opt in)
+            from deeplearning4j_tpu.datavec.pipeline import maybe_prefetch
+            it = maybe_prefetch(data, hostShard=False)
+            try:
+                for _ in range(epochs):
+                    self._fitEpoch(it)
+            finally:
+                if it is not data:
+                    it.close()      # release the pool's shm slots
         elif labels is not None:
             self._fitBatch(DataSet(data, labels))
         else:

@@ -23,7 +23,21 @@ import numpy as np
 
 from deeplearning4j_tpu.ops.dtype import DataType, from_np, promote
 
-__all__ = ["NDArray", "NDArrayIndex"]
+__all__ = ["NDArray", "NDArrayIndex", "set_host_only_arrays"]
+
+# When True, NDArray keeps numpy values as numpy instead of converting
+# through ``jnp.asarray``.  Set (process-locally) by the ETL producer-pool
+# workers (``datavec.pipeline._worker_main``): a fork-started worker
+# inherits the parent's XLA runtime with whatever mutexes its thread
+# pools held at fork time, so the FIRST jax call in the child can
+# deadlock — host ETL must stay pure numpy there.  The parent's staging
+# ring owns the device transfer.
+_HOST_ONLY = False
+
+
+def set_host_only_arrays(on: bool = True) -> None:
+    global _HOST_ONLY
+    _HOST_ONLY = bool(on)
 
 
 class NDArrayIndex:
@@ -80,7 +94,10 @@ class NDArray:
         if isinstance(value, NDArray):
             value = value._value
         if not isinstance(value, (jax.Array, jnp.ndarray)):
-            value = jnp.asarray(value)
+            if _HOST_ONLY:
+                value = np.asarray(value)
+            else:
+                value = jnp.asarray(value)
         self._value = value
         self._parent = parent
         self._index = index

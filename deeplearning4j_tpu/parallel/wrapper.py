@@ -98,6 +98,19 @@ class ParallelWrapper:
         (``pipeline_model.PipelinedTrainer``); a ``seq`` axis makes the
         attention layers compile ring (context-parallel) attention —
         both through the dl4j-shaped model config, no user JAX."""
+        # streaming sources engage the sharded producer pool here (not in
+        # net.fit) so the GPipe pipeline path overlaps host ETL too; the
+        # wrapper owns the pool's close()
+        from deeplearning4j_tpu.datavec.pipeline import maybe_prefetch
+        src = iterator
+        iterator = maybe_prefetch(iterator)
+        try:
+            self._fit_inner(iterator, epochs)
+        finally:
+            if iterator is not src:
+                iterator.close()
+
+    def _fit_inner(self, iterator, epochs: int) -> None:
         from deeplearning4j_tpu.parallel.mesh import activate_mesh
         net = self.model
         if self.mesh.stageSize > 1:

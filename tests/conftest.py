@@ -47,6 +47,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "telemetry: telemetry-spine tests (metrics registry, "
         "/metrics exposition, span tracing, flight recorder)")
+    config.addinivalue_line(
+        "markers", "etl: input-pipeline tests (sharded producer pool, "
+        "shared-memory batch assembly, H2D staging ring)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -73,6 +73,17 @@ def lint(pkg_dir: Path):
                 errors.append(
                     f"{where}: histogram {name!r} must carry a base-unit "
                     "suffix (_seconds/_bytes/_examples)")
+            if "bytes" in name:
+                # byte-unit rule (the ETL H2D series): rate() over a
+                # mis-suffixed byte metric silently reports garbage MB/s
+                if kind == "counter" and not name.endswith("_bytes_total"):
+                    errors.append(
+                        f"{where}: byte counter {name!r} must end in "
+                        "'_bytes_total' (base unit + counter convention)")
+                if kind == "gauge" and not name.endswith("_bytes"):
+                    errors.append(
+                        f"{where}: byte gauge {name!r} must end in "
+                        "'_bytes'")
             hm = HELP_LITERAL_RE.match(text, m.end())
             if NO_HELP_RE.match(text, m.end()):
                 errors.append(
