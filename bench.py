@@ -346,11 +346,176 @@ def bench_streaming(workers: int = 4, batch: int = 64, img: int = 96,
     }
 
 
+def bench_serving(clients: int = 8, duration: float = 4.0,
+                  warmup: float = 1.0, nIn: int = 32,
+                  decodeTokens: int = 48) -> dict:
+    """Serving-tier benchmark (ROADMAP item 1 / ISSUE 8 acceptance):
+    sustained concurrent RPS + latency percentiles + compile-cache hit
+    rate through the continuous-batching tier.
+
+    ``clients`` threads hammer ``POST /v1/serving/mlp`` over HTTP with
+    mixed batch sizes (1..4 rows — every request rounds UP to a warm
+    bucket), so the measurement covers the full path: HTTP parse,
+    admission, queue coalescing, padded dispatch on a warm executable,
+    result split.  The hit rate is computed from the
+    ``dl4j_tpu_serving_compile_cache_*`` counters over the measurement
+    window only (warmup traffic excluded) — the acceptance bar is >= 0.9,
+    i.e. steady state never triggers a fresh XLA trace.
+
+    A second, in-process measurement drives the KV-cache decode path
+    (``TransformerLM.generate``) and reports tokens/sec — generation cost
+    per token is O(cache capacity), independent of tokens generated.
+    """
+    import urllib.request
+
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nlp.transformer import TransformerLM
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.remote import (AdmissionControl, BucketLadder,
+                                           ForwardServing, GenerativeServing,
+                                           InferenceServer, ModelRegistry)
+    from deeplearning4j_tpu.telemetry import get_registry
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer.builder().nIn(nIn).nOut(64)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nIn(64).nOut(10)
+                   .activation("softmax").build())
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    registry = ModelRegistry()
+    registry.register(
+        "mlp",
+        ForwardServing(net, BucketLadder(batchSizes=(1, 2, 4, 8, 16),
+                                         seqLens=()),
+                       inputShape=(nIn,)),
+        admission=AdmissionControl(maxQueueRows=4096))
+    lm = TransformerLM(vocabSize=128, nLayers=2, nHeads=4, headSize=16,
+                       maxLen=128, seed=2)
+    registry.register("lm", GenerativeServing(
+        lm, BucketLadder(batchSizes=(1, 2, 4), seqLens=(16, 32))))
+    srv = InferenceServer(registry, port=0).start()    # warms the ladders
+
+    rng = np.random.RandomState(0)
+    payloads = [json.dumps({"features": rng.randn(b, nIn).astype(
+        np.float32).tolist()}).encode("utf-8") for b in (1, 2, 3, 4)]
+    url = f"http://127.0.0.1:{srv.port}/v1/serving/mlp"
+    stop = time.perf_counter() + warmup + duration
+    measure_from = time.perf_counter() + warmup
+    lat: list = []
+    counts = {"ok": 0, "shed": 0, "errors": 0}
+    lock = __import__("threading").Lock()
+    reg = get_registry()
+
+    def snapshot():
+        h = reg.get("dl4j_tpu_serving_compile_cache_hits_total")
+        m = reg.get("dl4j_tpu_serving_compile_cache_misses_total")
+
+        def val(c):
+            try:
+                return c.value(model="mlp") if c is not None else 0.0
+            except ValueError:
+                return 0.0
+        return val(h), val(m)
+
+    marks = {}
+
+    def client(i):
+        r = np.random.RandomState(100 + i)
+        while True:
+            now = time.perf_counter()
+            if now >= stop:
+                return
+            if "t0" not in marks and now >= measure_from:
+                with lock:
+                    if "t0" not in marks:
+                        marks["t0"] = now
+                        marks["counters"] = snapshot()
+            body = payloads[r.randint(len(payloads))]
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                dt = time.perf_counter() - t0
+                with lock:
+                    if t0 >= measure_from:
+                        lat.append(dt)
+                        counts["ok"] += 1
+            except Exception as e:
+                code = getattr(e, "code", None)
+                with lock:
+                    counts["shed" if code == 429 else "errors"] += 1
+
+    import threading as _th
+    threads = [_th.Thread(target=client, args=(i,)) for i in range(clients)]
+    t_start = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    t_end = time.perf_counter()
+    hits0, miss0 = marks.get("counters", (0.0, 0.0))
+    hits1, miss1 = snapshot()
+
+    # -- KV-cache decode throughput (in-process, the serving dispatch) ---
+    prompt = rng.randint(1, 128, (4, 16)).astype(np.int32)
+    lm.generate(prompt, 4)                   # warm prefill + decode
+    t0 = time.perf_counter()
+    lm.generate(prompt, decodeTokens)
+    decode_s = time.perf_counter() - t0
+    decode_tps = prompt.shape[0] * decodeTokens / decode_s
+    srv.stop()
+
+    window = t_end - marks.get("t0", t_start)
+    lat.sort()
+
+    def pct(q):
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 2)
+
+    dh, dm = hits1 - hits0, miss1 - miss0
+    return {
+        "metric": "serving_sustained_rps",
+        "value": round(counts["ok"] / window, 1),
+        "unit": "requests/sec",
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "requests_ok": counts["ok"],
+        "requests_shed": counts["shed"],
+        "requests_errored": counts["errors"],
+        # steady-state discipline: EVERY measured dispatch must land on
+        # an executable warmed at start() (acceptance: rate >= 0.9)
+        "compile_cache_hit_rate": round(dh / (dh + dm), 4)
+        if (dh + dm) > 0 else None,
+        "compile_cache_hits": int(dh),
+        "compile_cache_misses": int(dm),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "decode_batch": int(prompt.shape[0]),
+        "decode_new_tokens": int(decodeTokens),
+        "clients": clients,
+        "window_seconds": round(window, 2),
+    }
+
+
 def main() -> None:
     import jax
 
     from deeplearning4j_tpu.datasets import DataSet
     from deeplearning4j_tpu.zoo import ResNet50
+
+    if "--serving" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        clients = int(args[0]) if args else 8
+        duration = float(args[1]) if len(args) > 1 else 4.0
+        print(json.dumps(bench_serving(clients, duration)))
+        return
 
     if "--streaming" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]

@@ -44,9 +44,10 @@ from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu.fault import injection as _inj
 from deeplearning4j_tpu.optimize.listeners import notifyListeners
-from deeplearning4j_tpu.telemetry import (etl_fetch, flight_recorder,
-                                          get_registry, microbatch_scope,
-                                          record_crash, record_logical_step,
+from deeplearning4j_tpu.telemetry import (DEFAULT_BUCKETS, etl_fetch,
+                                          flight_recorder, get_registry,
+                                          microbatch_scope, record_crash,
+                                          record_logical_step,
                                           supervised_scope, tracer)
 from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer
 
@@ -186,7 +187,8 @@ class FaultTolerantTrainer:
         with tracer().span("checkpoint_restore", step=step):
             self.ckpt.restore(self.net, step=step)
         reg.histogram("dl4j_tpu_fault_restore_seconds",
-                      "Checkpoint restore latency").observe(
+                      "Checkpoint restore latency",
+                      buckets=DEFAULT_BUCKETS).observe(
                           time.perf_counter() - t0)
         reg.counter("dl4j_tpu_fault_checkpoint_restores_total",
                     "Checkpoint restores (rollback + resume)").inc()

@@ -8,6 +8,8 @@ from deeplearning4j_tpu.nlp.tokenization import (BertWordPieceTokenizer,  # noqa
                                                  DefaultTokenizer,
                                                  DefaultTokenizerFactory)
 from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401
+from deeplearning4j_tpu.nlp.transformer import (  # noqa: F401
+    TransformerLM, TransformerLMConfig)
 from deeplearning4j_tpu.nlp.word2vec import (  # noqa: F401
     FastText, Glove, ParagraphVectors, VocabCache, Word2Vec, WordVectors,
     WordVectorSerializer)

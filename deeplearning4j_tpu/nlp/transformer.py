@@ -1,0 +1,264 @@
+"""Decoder-only transformer LM with incremental (KV-cached) decode.
+
+The training side of this repo already runs transformer encoders (the
+SameDiff BERT of ``zoo/bert.py``, flash attention for long context);
+serving generative traffic needs the *decode* discipline those graphs
+don't have: generation re-run through a full forward is O(t) per token and
+re-traces on every prompt length.  This model keeps decode O(1) per token
+by carrying a :class:`~deeplearning4j_tpu.nn.conf.attention.KVCache`
+through every attention layer, with all executable shapes STATIC:
+
+- :meth:`prefill` runs the prompt through the stack once (causal
+  attention dispatching through ``parallel.ring.dot_product_attention``,
+  i.e. the flash kernel on TPU for long prompts) and fills the caches;
+- :meth:`decodeStep` feeds ONE token per example against the caches —
+  fixed (batch, capacity) shapes, so the serving tier warms exactly one
+  executable per batch bucket and never re-traces in steady state;
+- left-padding support (``lengths``) keeps ragged prompts bucketable:
+  every example ends at the same position, so the cache write position
+  stays one scalar (see ``KVCache.start``).
+
+Weights follow the pre-LN GPT block (LN → attention → residual, LN → FFN
+→ residual) with tied input/output embeddings.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.attention import KVCache, cached_attention
+
+__all__ = ["TransformerLMConfig", "TransformerLM"]
+
+
+@dataclasses.dataclass
+class TransformerLMConfig:
+    vocabSize: int = 256
+    nLayers: int = 2
+    nHeads: int = 4
+    headSize: int = 16
+    ffnMult: int = 4
+    maxLen: int = 128          # cache capacity == max prompt + generation
+    initializerRange: float = 0.02
+    seed: int = 0
+
+    @property
+    def hiddenSize(self) -> int:
+        return self.nHeads * self.headSize
+
+
+class TransformerLM:
+    """GPT-style causal LM; ``generate`` == prefill + N decode steps."""
+
+    def __init__(self, config: Optional[TransformerLMConfig] = None, **kw):
+        self.config = config or TransformerLMConfig(**kw)
+        self.params = self._init_params()
+
+    # ------------------------------------------------------------------
+    def _init_params(self) -> Dict:
+        c = self.config
+        rng = np.random.RandomState(c.seed)
+        H, F = c.hiddenSize, c.ffnMult * c.hiddenSize
+
+        def init(*shape):
+            return jnp.asarray(
+                (rng.randn(*shape) * c.initializerRange).astype(np.float32))
+
+        p = {"emb": init(c.vocabSize, H), "pos": init(c.maxLen, H),
+             "lnf_g": jnp.ones((H,)), "lnf_b": jnp.zeros((H,)),
+             "layers": []}
+        for _ in range(c.nLayers):
+            p["layers"].append({
+                "ln1_g": jnp.ones((H,)), "ln1_b": jnp.zeros((H,)),
+                "Wq": init(H, H), "Wk": init(H, H), "Wv": init(H, H),
+                "Wo": init(H, H),
+                "ln2_g": jnp.ones((H,)), "ln2_b": jnp.zeros((H,)),
+                "Wi": init(H, F), "bi": jnp.zeros((F,)),
+                "Wp": init(F, H), "bp": jnp.zeros((H,))})
+        return p
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _ln(x, g, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    def _heads(self, y):
+        b, t, _ = y.shape
+        c = self.config
+        return y.reshape(b, t, c.nHeads, c.headSize).transpose(0, 2, 1, 3)
+
+    def _merge(self, ctx):
+        b, _, t, _ = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(b, t, -1)
+
+    def _block_full(self, lp, x, mask):
+        """Full-sequence causal block (prefill/training).  Dispatches the
+        score chain through ``dot_product_attention`` — flash on TPU for
+        long unmasked prompts, mask-honoring dense/blockwise otherwise."""
+        from deeplearning4j_tpu.parallel.ring import dot_product_attention
+        h = self._ln(x, lp["ln1_g"], lp["ln1_b"])
+        qh = self._heads(jnp.matmul(h, lp["Wq"]))
+        kh = self._heads(jnp.matmul(h, lp["Wk"]))
+        vh = self._heads(jnp.matmul(h, lp["Wv"]))
+        ctx = dot_product_attention(qh, kh, vh, mask=mask, causal=True)
+        x = x + jnp.matmul(self._merge(ctx), lp["Wo"])
+        h = self._ln(x, lp["ln2_g"], lp["ln2_b"])
+        ff = jax.nn.gelu(jnp.matmul(h, lp["Wi"]) + lp["bi"])
+        return x + jnp.matmul(ff, lp["Wp"]) + lp["bp"], (kh, vh)
+
+    def _block_cached(self, lp, x, cache: KVCache):
+        h = self._ln(x, lp["ln1_g"], lp["ln1_b"])
+        qh = self._heads(jnp.matmul(h, lp["Wq"]))
+        kh = self._heads(jnp.matmul(h, lp["Wk"]))
+        vh = self._heads(jnp.matmul(h, lp["Wv"]))
+        ctx, cache = cached_attention(qh, kh, vh, cache)
+        x = x + jnp.matmul(self._merge(ctx), lp["Wo"])
+        h = self._ln(x, lp["ln2_g"], lp["ln2_b"])
+        ff = jax.nn.gelu(jnp.matmul(h, lp["Wi"]) + lp["bi"])
+        return x + jnp.matmul(ff, lp["Wp"]) + lp["bp"], cache
+
+    def _embed(self, params, tokens, pos_ids):
+        x = params["emb"][tokens]                      # (b, t, H)
+        return x + params["pos"][pos_ids]
+
+    def _logits(self, params, x):
+        h = self._ln(x, params["lnf_g"], params["lnf_b"])
+        return jnp.matmul(h, params["emb"].T)          # tied head
+
+    # ------------------------------------------------------------------
+    # full forward (the recompute baseline the KV path must match)
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _fwd(self):
+        def run(params, tokens):
+            t = tokens.shape[1]
+            x = self._embed(params, tokens,
+                            jnp.arange(t, dtype=jnp.int32)[None, :])
+            for lp in params["layers"]:
+                x, _ = self._block_full(lp, x, None)
+            return self._logits(params, x)
+        return jax.jit(run)
+
+    def forward(self, tokens) -> jax.Array:
+        """Full causal forward: (b, t) int32 -> (b, t, vocab) logits."""
+        return self._fwd(self.params, jnp.asarray(tokens, jnp.int32))
+
+    # ------------------------------------------------------------------
+    # incremental decode
+    # ------------------------------------------------------------------
+    def initCaches(self, batch: int) -> List[KVCache]:
+        c = self.config
+        return [KVCache.create(batch, c.nHeads, c.maxLen, c.headSize)
+                for _ in range(c.nLayers)]
+
+    @functools.cached_property
+    def _prefillFn(self):
+        def run(params, tokens, start, padded):
+            # start[b] = index of the first REAL token (left padding);
+            # position ids count from the real start so padded and
+            # unpadded prompts see identical positional embeddings.
+            # ``padded`` is static: unpadded prompts keep mask=None so the
+            # causal dispatch stays flash-eligible on TPU for long context
+            b, t = tokens.shape
+            kpos = jnp.arange(t, dtype=jnp.int32)[None, :]
+            pos_ids = jnp.maximum(kpos - start[:, None], 0)
+            mask = (kpos >= start[:, None]).astype(jnp.float32) \
+                if padded else None                              # (b, t)
+            x = self._embed(params, tokens, pos_ids)
+            caches = []
+            for lp in params["layers"]:
+                x, (kh, vh) = self._block_full(lp, x, mask)
+                cache = KVCache.create(b, self.config.nHeads,
+                                       self.config.maxLen,
+                                       self.config.headSize,
+                                       kh.dtype, start=start)
+                k = jax.lax.dynamic_update_slice(cache.k, kh, (0, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(cache.v, vh, (0, 0, 0, 0))
+                caches.append(KVCache(k, v, jnp.asarray(t, jnp.int32),
+                                      start))
+            return self._logits(params, x[:, -1:])[:, 0], caches
+        return jax.jit(run, static_argnames=("padded",))
+
+    def prefill(self, tokens, lengths=None):
+        """Run the prompt once, filling every layer's cache.
+
+        ``tokens`` (b, t) int32, LEFT-padded when ragged; ``lengths`` (b,)
+        gives each example's real token count (defaults to full t).
+        Returns ``(last_logits (b, vocab), caches)`` — the logits predict
+        the first generated token.
+        """
+        tokens = jnp.asarray(tokens, jnp.int32)
+        t = tokens.shape[1]
+        if t > self.config.maxLen:
+            raise ValueError(f"prompt length {t} exceeds cache capacity "
+                             f"{self.config.maxLen}")
+        if lengths is None:
+            start = jnp.zeros((tokens.shape[0],), jnp.int32)
+        else:
+            start = t - jnp.asarray(lengths, jnp.int32)
+        return self._prefillFn(self.params, tokens, start,
+                               lengths is not None)
+
+    @functools.cached_property
+    def _decodeFn(self):
+        def run(params, tok, caches):
+            # tok: (b,) int32 — ONE new token per example
+            pos_ids = (caches[0].pos - caches[0].start)[:, None]  # (b, 1)
+            x = self._embed(params, tok[:, None], pos_ids)
+            new = []
+            for lp, cache in zip(params["layers"], caches):
+                x, cache = self._block_cached(lp, x, cache)
+                new.append(cache)
+            return self._logits(params, x)[:, 0], new
+        return jax.jit(run)
+
+    def decodeStep(self, tok, caches):
+        """One generated token per example: (b,) int32 + caches ->
+        ((b, vocab) logits, new caches).  O(capacity) per call — the
+        prefix never re-enters the layer stack."""
+        return self._decodeFn(self.params, jnp.asarray(tok, jnp.int32),
+                              caches)
+
+    def compileCacheSize(self) -> int:
+        """Total jit-cache entries across the forward/prefill/decode
+        executables — the serving tier's compile hit/miss probe."""
+        n = 0
+        for name in ("_fwd", "_prefillFn", "_decodeFn"):
+            fn = self.__dict__.get(name)
+            if fn is not None:
+                try:
+                    n += int(fn._cache_size())
+                except Exception:
+                    pass
+        return n
+
+    # ------------------------------------------------------------------
+    def generate(self, prompts, maxNewTokens: int, lengths=None
+                 ) -> np.ndarray:
+        """Greedy decode: (b, t) prompts -> (b, maxNewTokens) int32.
+
+        Capacity check: t + maxNewTokens must fit ``maxLen`` (the caches
+        are fixed-size by design — growing them would re-trace)."""
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim == 1:
+            prompts = prompts[None, :]
+        t = prompts.shape[1]
+        if t + maxNewTokens > self.config.maxLen:
+            raise ValueError(
+                f"prompt {t} + maxNewTokens {maxNewTokens} exceeds cache "
+                f"capacity {self.config.maxLen}")
+        logits, caches = self.prefill(prompts, lengths)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = [tok]
+        for _ in range(maxNewTokens - 1):   # token 0 came from prefill —
+            logits, caches = self.decodeStep(tok, caches)   # N-1 steps
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(tok)
+        return np.stack([np.asarray(o) for o in out], axis=1)

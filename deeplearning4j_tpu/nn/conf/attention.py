@@ -14,7 +14,7 @@ dispatch.  Data format follows the DL4J RNN convention (b, nIn, t); masks are
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,10 +24,12 @@ from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
 from deeplearning4j_tpu.nn.weights import init_weight
 
 __all__ = ["SelfAttentionLayer", "LearnedSelfAttentionLayer",
-           "RecurrentAttentionLayer", "KerasMultiHeadAttention"]
+           "RecurrentAttentionLayer", "KerasMultiHeadAttention",
+           "KVCache", "cached_attention"]
 
 
-def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None, impl="auto"):
+def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None, impl="auto",
+         causal=False):
     """Multi-head attention core.  x_btn: (b, t, n); mask: (b, t_k).
 
     The score/softmax/context chain dispatches through
@@ -43,9 +45,81 @@ def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None, impl="auto"):
         return y.reshape(b, inp.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
 
     qh, kh, vh = heads(q_btn, Wq), heads(x_btn, Wk), heads(x_btn, Wv)
-    ctx = dot_product_attention(qh, kh, vh, mask=mask, impl=impl)
+    ctx = dot_product_attention(qh, kh, vh, mask=mask, causal=causal,
+                                impl=impl)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, tq, -1)
     return jnp.matmul(ctx, Wo)                       # (b, tq, nOut)
+
+
+# ---------------------------------------------------------------------------
+# incremental (KV-cached) decode — the serving tier's O(1)-per-token path
+# ---------------------------------------------------------------------------
+
+class KVCache(NamedTuple):
+    """Per-layer key/value cache for incremental causal decode.
+
+    A NamedTuple of jax arrays IS a pytree, so a cache flows through
+    ``jax.jit`` unchanged and the decode executable's shapes stay STATIC:
+    ``k``/``v`` are allocated at full ``capacity`` up front and written
+    in place with ``lax.dynamic_update_slice``, so serving one more token
+    never re-traces — the compile-once/serve-many discipline the bucketed
+    executor (``remote/serving.py``) is built on.
+
+    ``start`` carries per-example left-padding offsets: bucketed serving
+    left-pads ragged prompts to one prompt bucket, which keeps the write
+    position ``pos`` a single scalar for the whole batch (a right-padded
+    layout would need per-example scatter writes every step).  Keys before
+    ``start[b]`` are masked out of every attention.
+    """
+    k: jax.Array        # (b, nHeads, capacity, headSize)
+    v: jax.Array        # (b, nHeads, capacity, headSize)
+    pos: jax.Array      # () int32 — next write index (tokens cached so far)
+    start: jax.Array    # (b,) int32 — first VALID key index per example
+
+    @staticmethod
+    def create(batch: int, nHeads: int, capacity: int, headSize: int,
+               dtype=jnp.float32, start=None) -> "KVCache":
+        return KVCache(
+            k=jnp.zeros((batch, nHeads, capacity, headSize), dtype),
+            v=jnp.zeros((batch, nHeads, capacity, headSize), dtype),
+            pos=jnp.asarray(0, jnp.int32),
+            start=(jnp.zeros((batch,), jnp.int32) if start is None
+                   else jnp.asarray(start, jnp.int32)))
+
+    @property
+    def capacity(self) -> int:
+        return int(self.k.shape[2])
+
+
+def cached_attention(qh, kh_new, vh_new, cache: KVCache):
+    """Causal attention of ``tq`` NEW positions against a KV cache.
+
+    ``qh``/``kh_new``/``vh_new``: (b, h, tq, d) for the new positions only.
+    Writes the new K/V at ``[pos, pos+tq)`` and attends over the whole
+    fixed-capacity cache with validity masking (key index within
+    ``[start[b], pos+i]`` for query ``i``) — per-token cost is
+    O(capacity), independent of how many tokens were generated, and the
+    prefix is never recomputed through the layer stack.
+    """
+    b, h, tq, d = qh.shape
+    pos = jnp.asarray(cache.pos, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    k = jax.lax.dynamic_update_slice(
+        cache.k, kh_new.astype(cache.k.dtype), (zero, zero, pos, zero))
+    v = jax.lax.dynamic_update_slice(
+        cache.v, vh_new.astype(cache.v.dtype), (zero, zero, pos, zero))
+    cap = k.shape[2]
+    kpos = jnp.arange(cap, dtype=jnp.int32)
+    qpos = pos + jnp.arange(tq, dtype=jnp.int32)
+    valid = (kpos[None, :] <= qpos[:, None])[None]          # (1, tq, cap)
+    valid = valid & (kpos[None, None, :] >=
+                     cache.start[:, None, None])            # (b, tq, cap)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, k.astype(qh.dtype))
+    s = s * (1.0 / jnp.sqrt(jnp.asarray(d, s.dtype)))
+    s = jnp.where(valid[:, None], s, jnp.asarray(-1e30, s.dtype))
+    w = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(qh.dtype))
+    return ctx, KVCache(k, v, pos + tq, cache.start)
 
 
 @dataclasses.dataclass
@@ -55,12 +129,17 @@ class SelfAttentionLayer(BaseLayer):
     Reference: ``conf/layers/SelfAttentionLayer.java``.  Input (b, nIn, t) →
     output (b, nOut, t).  ``projectInput`` must be true when nHeads > 1
     (matching the reference's validation).
+
+    ``causal=True`` masks attention to past-and-self (decoder style); only
+    causal layers can serve through the incremental :meth:`decodeStep`
+    path (the KV cache can't contain the future).
     """
     nIn: int = 0
     nOut: int = 0
     nHeads: int = 1
     headSize: int = 0
     projectInput: bool = True
+    causal: bool = False
 
     def preferredFormat(self):
         return "RNN"
@@ -102,11 +181,47 @@ class SelfAttentionLayer(BaseLayer):
         xt = jnp.transpose(x, (0, 2, 1))             # (b, t, nIn)
         if self.projectInput:
             y = _mha(xt, params["Wq"], params["Wk"], params["Wv"],
-                     params["Wo"], self.nHeads, mask)
+                     params["Wo"], self.nHeads, mask, causal=self.causal)
         else:
             eye = jnp.eye(self.nIn, dtype=xt.dtype)
-            y = _mha(xt, eye, eye, eye, eye, 1, mask)
+            y = _mha(xt, eye, eye, eye, eye, 1, mask, causal=self.causal)
         return jnp.transpose(y, (0, 2, 1)), state
+
+    # -- incremental decode (KV cache) ----------------------------------
+    def initCache(self, batch: int, capacity: int, dtype=jnp.float32,
+                  start=None) -> KVCache:
+        """Fresh fixed-capacity cache for :meth:`decodeStep`."""
+        if not self.causal:
+            raise ValueError(
+                "KV-cache decode requires causal=True (an incremental "
+                "step can only ever attend to the past)")
+        h = self.nHeads if self.projectInput else 1
+        d = self.headSize if self.projectInput else self.nIn
+        return KVCache.create(batch, h, capacity, d, dtype, start=start)
+
+    def decodeStep(self, params, x, cache: KVCache):
+        """Feed ``t_new`` timesteps (x: (b, nIn, t_new)), attending to
+        everything cached so far plus the new steps — exactly the causal
+        ``forward`` restricted to new positions, at O(capacity) instead of
+        O(t²) per call.  Returns ``(y (b, nOut, t_new), new_cache)``."""
+        xt = jnp.transpose(x, (0, 2, 1))             # (b, t_new, nIn)
+        b, tq, _ = xt.shape
+
+        def heads(inp, w, n):
+            y = jnp.matmul(inp, w)
+            return y.reshape(b, tq, n, -1).transpose(0, 2, 1, 3)
+
+        if self.projectInput:
+            qh = heads(xt, params["Wq"], self.nHeads)
+            kh = heads(xt, params["Wk"], self.nHeads)
+            vh = heads(xt, params["Wv"], self.nHeads)
+            Wo = params["Wo"]
+        else:
+            qh = kh = vh = xt[:, None]               # (b, 1, t_new, nIn)
+            Wo = jnp.eye(self.nIn, dtype=xt.dtype)
+        ctx, cache = cached_attention(qh, kh, vh, cache)
+        y = jnp.matmul(ctx.transpose(0, 2, 1, 3).reshape(b, tq, -1), Wo)
+        return jnp.transpose(y, (0, 2, 1)), cache
 
 
 @dataclasses.dataclass

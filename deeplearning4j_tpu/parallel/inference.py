@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -27,6 +28,11 @@ class InferenceMode:
     BATCHED = "BATCHED"
 
 
+#: wakes the worker so it can observe shutdown (a bare flag flip leaves it
+#: parked in Queue.get for up to its poll timeout)
+_SHUTDOWN = object()
+
+
 class ParallelInference:
     def __init__(self, model, mesh: Optional[DeviceMesh] = None,
                  inferenceMode: str = InferenceMode.BATCHED,
@@ -38,6 +44,14 @@ class ParallelInference:
         self.batchLimit = int(batchLimit)
         self._q: "queue.Queue" = queue.Queue(maxsize=queueLimit)
         self._lock = threading.Lock()
+        # gates BOTH the running check + enqueue and shutdown's drain, so
+        # a request can never slip into the queue after the drain ran
+        self._qlock = threading.Lock()
+        # NOT self._lock: that one is held across whole device dispatches,
+        # and enqueue-time validation must never wait on a running batch
+        self._shapeLock = threading.Lock()
+        self._expectTrailing: Optional[tuple] = None
+        self._worker: Optional[threading.Thread] = None
         self._running = inferenceMode == InferenceMode.BATCHED
         if self._running:
             self._worker = threading.Thread(target=self._loop, daemon=True)
@@ -68,15 +82,45 @@ class ParallelInference:
             return ParallelInference(self._model, **self._kw)
 
     # -- serving ---------------------------------------------------------
+    def _validate(self, xv: np.ndarray) -> None:
+        """Reject a mismatched feature shape at ENQUEUE time — only the
+        offender errors, instead of its whole coalesced batch failing in
+        ``np.concatenate`` (batch poisoning).  The expected shape is
+        latched from the first SUCCESSFULLY served batch (see ``_loop``)
+        — latching from the first request *seen* would let one malformed
+        request poison every valid request for the instance's lifetime."""
+        if xv.ndim < 1:
+            raise ValueError("features must include a batch axis")
+        trailing = tuple(xv.shape[1:])
+        with self._shapeLock:
+            expect = self._expectTrailing
+        if expect is not None and trailing != expect:
+            raise ValueError(
+                f"feature shape {xv.shape} (trailing {trailing}) does "
+                f"not match this server's batch shape {expect}; mixed "
+                "shapes cannot share a coalesced batch")
+
     def output(self, x) -> NDArray:
         xv = np.asarray(x.numpy() if isinstance(x, NDArray) else x)
         if self.inferenceMode == InferenceMode.SEQUENTIAL:
             return self._run(xv)
-        if not self._running:
-            raise RuntimeError("ParallelInference has been shut down")
+        self._validate(xv)
         ev = threading.Event()
         holder = {}
-        self._q.put((xv, ev, holder))
+        item = (xv, ev, holder)
+        while True:
+            with self._qlock:
+                if not self._running:
+                    raise RuntimeError(
+                        "ParallelInference has been shut down")
+                try:
+                    self._q.put_nowait(item)
+                    break
+                except queue.Full:
+                    pass
+            # full queue: back off OUTSIDE the lock (the worker needs no
+            # lock to drain, and shutdown must be able to take it)
+            time.sleep(0.001)
         ev.wait()
         if "err" in holder:
             raise holder["err"]
@@ -90,21 +134,37 @@ class ParallelInference:
             return self.model.output(xv)
 
     def _loop(self):
-        while self._running:
+        stop = False
+        while not stop:
             try:
                 first = self._q.get(timeout=0.1)
             except queue.Empty:
+                if not self._running:
+                    return
                 continue
+            if first is _SHUTDOWN:
+                return
             batch = [first]
             while len(batch) < self.batchLimit:
                 try:
-                    batch.append(self._q.get_nowait())
+                    item = self._q.get_nowait()
                 except queue.Empty:
                     break
+                if item is _SHUTDOWN:
+                    stop = True     # serve what we already hold, then exit
+                    break
+                batch.append(item)
             xs = [b[0] for b in batch]
             sizes = [x.shape[0] for x in xs]
             try:
                 out = self._run(np.concatenate(xs, axis=0)).numpy()
+                if self._expectTrailing is None:
+                    # the model accepted this shape: pin it as THE
+                    # serving shape — future mismatches are rejected at
+                    # enqueue, individually
+                    with self._shapeLock:
+                        if self._expectTrailing is None:
+                            self._expectTrailing = tuple(xs[0].shape[1:])
                 pos = 0
                 for (x, ev, holder), n in zip(batch, sizes):
                     holder["out"] = NDArray(out[pos:pos + n])
@@ -116,12 +176,31 @@ class ParallelInference:
                     ev.set()
 
     def shutdown(self):
-        self._running = False
-        # fail any requests still queued so callers don't block forever
-        while True:
+        """Idempotent.  Order matters: flip ``_running`` under the enqueue
+        lock (no new requests can slip in), wake + join the worker via a
+        sentinel, then reject whatever is still queued — a request that
+        passed the running check before the flip is guaranteed to be in
+        the queue by then, so nobody blocks forever."""
+        with self._qlock:
+            if not self._running:
+                return
+            self._running = False
+        worker = self._worker
+        if worker is not None:
             try:
-                _, ev, holder = self._q.get_nowait()
-            except queue.Empty:
-                break
-            holder["err"] = RuntimeError("ParallelInference shut down")
-            ev.set()
+                self._q.put_nowait(_SHUTDOWN)
+            except queue.Full:
+                pass                # worker is draining; the flag stops it
+            worker.join(timeout=5.0)
+            self._worker = None
+        with self._qlock:
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _SHUTDOWN:
+                    continue
+                _, ev, holder = item
+                holder["err"] = RuntimeError("ParallelInference shut down")
+                ev.set()

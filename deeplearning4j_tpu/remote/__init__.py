@@ -1,4 +1,9 @@
 """Remote inference serving (reference: deeplearning4j-remote —
-JsonModelServer / SameDiffJsonModelServer, SURVEY.md §2.5)."""
+JsonModelServer / SameDiffJsonModelServer, SURVEY.md §2.5) plus the
+continuous-batching serving tier (``serving.py``: bucketed warm
+executables, KV-cache decode, multi-model hosting, admission control)."""
 from deeplearning4j_tpu.remote.server import (  # noqa: F401
     JsonModelServer, JsonRemoteInference, SameDiffJsonModelServer)
+from deeplearning4j_tpu.remote.serving import (  # noqa: F401
+    AdmissionControl, BucketedExecutor, BucketLadder, ForwardServing,
+    GenerativeServing, InferenceServer, ModelRegistry, ServiceOverloaded)

@@ -19,9 +19,30 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
+
+
+def reply_safely(handler, code: int, body: bytes, ctype: str,
+                 headers: Optional[Dict[str, str]] = None) -> None:
+    """Write one HTTP response, surviving a client that hung up mid-reply.
+
+    Shared by every HTTP front in the remote package (``JsonModelServer``
+    here, ``serving.InferenceServer``): a BrokenPipeError out of
+    ``wfile.write`` used to propagate and take the handler thread down
+    mid-response — the disconnecting client's problem must stay its own.
+    """
+    try:
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+        handler.close_connection = True
 
 
 class JsonModelServer:
@@ -121,11 +142,7 @@ class JsonModelServer:
                 pass
 
             def _reply(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                reply_safely(self, code, body, ctype)
 
             def do_GET(self):
                 # observability surface (/metrics, /metrics/federated,
@@ -171,12 +188,8 @@ class JsonModelServer:
                     "dl4j_tpu_remote_requests_total",
                     "Inference requests served, by HTTP status",
                     labelnames=("code",)).inc(code=str(code))
-                data = json.dumps(body).encode("utf-8")
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self._reply(code, json.dumps(body).encode("utf-8"),
+                            "application/json")
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]

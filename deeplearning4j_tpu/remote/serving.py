@@ -1,0 +1,845 @@
+"""Continuous-batching serving tier: warm bucketed executables, KV-cache
+decode, multi-model hosting, admission control.
+
+The in-process ``JsonModelServer`` + ``ParallelInference`` pair re-traces
+on every novel batch shape and has no backpressure; this tier is the
+compile-once/serve-many rebuild (ROADMAP item 1; the ahead-of-time shape
+specialization TVM argues for, PAPERS arXiv:1802.04799):
+
+- :class:`BucketLadder` — the fixed ladder of batch / sequence buckets
+  every request is padded up to, so EVERY dispatch lands on an executable
+  compiled at ``start()``;
+- :class:`BucketedExecutor` — per-model request queue + scheduler: each
+  tick coalesces the queue into the LARGEST ready bucket (not FIFO
+  concatenation of raw shapes), pads, dispatches, and splits results
+  back per request.  Weights stay device-resident jax buffers shared by
+  every worker thread — requests carry only activations;
+- :class:`ForwardServing` / :class:`GenerativeServing` — the two model
+  adapters: padded batched forward (mask-correct for sequence models)
+  and KV-cache decode (prefill once, O(1)-per-token generation through
+  :class:`~deeplearning4j_tpu.nlp.transformer.TransformerLM`);
+- :class:`AdmissionControl` — load shedding (HTTP 429 + ``Retry-After``)
+  driven by ``ThresholdRule``s over the ``dl4j_tpu_serving_*`` metrics
+  (queue depth, p99 read off the request histogram) — the same
+  health-rule machinery the training watchdog uses;
+- :class:`ModelRegistry` + :class:`InferenceServer` — multi-model hosting
+  behind ``POST /v1/serving/<name>`` (bare ``/v1/serving`` routes to the
+  default model), with the shared observability GET surface.
+
+Compile-cache accounting: every dispatch measures the model's jit cache
+size; steady state must be all hits (``bench.py --serving`` asserts the
+hit rate, and the warm ladder is the mechanism that makes it true).
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry import (ThresholdRule, get_registry,
+                                          serving_metrics)
+
+__all__ = ["BucketLadder", "ServiceOverloaded", "AdmissionControl",
+           "ForwardServing", "GenerativeServing", "BucketedExecutor",
+           "ModelRegistry", "InferenceServer", "histogram_quantile"]
+
+
+class ServiceOverloaded(RuntimeError):
+    """Admission control rejected the request (HTTP 429).  ``retryAfter``
+    is the server's backoff hint in seconds."""
+
+    def __init__(self, detail: str, retryAfter: float = 1.0):
+        super().__init__(detail)
+        self.retryAfter = float(retryAfter)
+
+
+class BucketLadder:
+    """The fixed shape ladder: requests round UP to the nearest bucket.
+
+    ``batchSizes`` bounds how many rows one dispatch carries; ``seqLens``
+    buckets the time axis of rank-3 (b, n, t) inputs and prompt lengths.
+    A request above the top batch bucket is chunked, never traced fresh;
+    a sequence above the top seq bucket is a 400 (the executable for it
+    was never compiled, and serving it would re-trace).
+    """
+
+    def __init__(self, batchSizes: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 seqLens: Sequence[int] = (16, 32, 64, 128)):
+        if not batchSizes:
+            raise ValueError("need at least one batch bucket")
+        self.batchSizes = tuple(sorted(int(b) for b in batchSizes))
+        self.seqLens = tuple(sorted(int(t) for t in seqLens))
+
+    @property
+    def maxBatch(self) -> int:
+        return self.batchSizes[-1]
+
+    @property
+    def maxSeq(self) -> int:
+        return self.seqLens[-1] if self.seqLens else 0
+
+    def batchBucket(self, n: int) -> int:
+        for b in self.batchSizes:
+            if n <= b:
+                return b
+        return self.maxBatch
+
+    def seqBucket(self, t: int) -> int:
+        for s in self.seqLens:
+            if t <= s:
+                return s
+        raise ValueError(
+            f"sequence length {t} exceeds the top bucket {self.maxSeq} "
+            "(no warm executable exists for it)")
+
+
+def histogram_quantile(hist, q: float, **labels) -> Optional[float]:
+    """Quantile estimate off a registry histogram's cumulative bucket
+    counts (upper-bound attribution, the Prometheus
+    ``histogram_quantile`` convention).  None with no observations."""
+    try:
+        counts = hist.bucketCounts(**labels)
+    except Exception:
+        return None
+    total = max(counts.values()) if counts else 0
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound = 0.0
+    for bound, cum in counts.items():
+        if cum >= rank:
+            return bound if not math.isinf(bound) else prev_bound
+        prev_bound = bound
+    return prev_bound
+
+
+class AdmissionControl:
+    """Shed load before it queues: evaluated on every submit.
+
+    Both default conditions are plain ``ThresholdRule``s over the
+    ``dl4j_tpu_serving_*`` series (queue-depth gauge, p99 gauge the
+    executor maintains from the request histogram) — the identical rule
+    machinery ``telemetry.health`` runs, so an operator can mirror the
+    same thresholds into the watchdog's alert log.  Extra rules append.
+    """
+
+    def __init__(self, maxQueueRows: int = 256,
+                 p99Threshold: Optional[float] = None,
+                 retryAfter: float = 1.0,
+                 rules: Optional[Sequence[ThresholdRule]] = None):
+        self.maxQueueRows = int(maxQueueRows)
+        self.p99Threshold = p99Threshold
+        self.retryAfter = float(retryAfter)
+        self._extra = list(rules or [])
+        self._rules: List[ThresholdRule] = []
+        self._latencyRules: List[ThresholdRule] = []
+
+    def bind(self, model: str) -> None:
+        """Materialize the per-model rules (called by the executor once
+        its model name is known)."""
+        self._rules = [ThresholdRule(
+            "serving_queue_full", "dl4j_tpu_serving_queue_depth", ">=",
+            self.maxQueueRows, model=model)]
+        self._rules.extend(self._extra)
+        self._latencyRules = []
+        if self.p99Threshold is not None:
+            self._latencyRules.append(ThresholdRule(
+                "serving_p99_high", "dl4j_tpu_serving_p99_seconds", ">",
+                self.p99Threshold, model=model))
+
+    def check(self, queuedRows: int = 0) -> Optional[Tuple[str, str]]:
+        """(rule_name, detail) of the first firing rule, else None.
+
+        Latency rules only apply while a backlog exists (``queuedRows``
+        > 0): the p99 gauge is refreshed by dispatches, so with ALL
+        traffic shed it would freeze above threshold and 429 an idle
+        server forever.  An empty queue means the next request cannot be
+        queue-delayed — admit it, and its dispatch refreshes the gauge.
+        """
+        reg = get_registry()
+        now = time.time()
+        rules = list(self._rules)
+        if queuedRows > 0:
+            rules += getattr(self, "_latencyRules", [])
+        for rule in rules:
+            detail = rule.evaluate(reg, now)
+            if detail is not None:
+                return rule.name, detail
+        return None
+
+
+class _Request:
+    __slots__ = ("payload", "rows", "event", "result", "error", "t0")
+
+    def __init__(self, payload, rows: int):
+        self.payload = payload
+        self.rows = int(rows)
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# model adapters
+# ---------------------------------------------------------------------------
+
+class ForwardServing:
+    """Bucketed batched forward for MLN/ComputationGraph-style models.
+
+    Requests are feature arrays; the group key is the non-batch shape
+    (with the time axis bucketed), so the scheduler only ever
+    concatenates compatible rows — a request with a mismatched trailing
+    shape is ITS OWN 400 at validation time, never a poisoned batch.
+
+    Sequence padding is mask-correct: rank-3 inputs are zero-padded up to
+    the seq bucket and served with a features mask (1 = real timestep),
+    so mask-honoring models produce outputs identical to the unpadded
+    forward at every real position.  Rank-3 dispatches ALWAYS carry a
+    mask (all-ones when unpadded) — mask-presence is part of the trace,
+    and flipping it per request would double the executable count.
+    """
+
+    def __init__(self, model, ladder: Optional[BucketLadder] = None,
+                 inputShape: Optional[Sequence[int]] = None,
+                 dtype=np.float32):
+        self.model = model
+        self.ladder = ladder or BucketLadder()
+        # trailing (non-batch) dims; rank-3 models give (nIn, None) and
+        # get their time axis bucketed
+        self.inputShape = tuple(inputShape) if inputShape is not None \
+            else None
+        self.dtype = dtype
+
+    # -- request admission / grouping -----------------------------------
+    def makeRequest(self, payload) -> _Request:
+        xv = np.asarray(payload, dtype=self.dtype)
+        if xv.ndim < 2:
+            raise ValueError(
+                f"features must include a batch axis; got shape {xv.shape}")
+        if self.inputShape is not None:
+            want = self.inputShape
+            got = xv.shape[1:]
+            ok = len(got) == len(want) and all(
+                w is None or int(w) == int(g) for w, g in zip(want, got))
+            if not ok:
+                raise ValueError(
+                    f"feature shape {tuple(got)} does not match the "
+                    f"serving input shape {tuple(want)}")
+        if xv.ndim == 3:
+            self.ladder.seqBucket(xv.shape[2])      # reject un-warmable t
+        return _Request(xv, xv.shape[0])
+
+    def groupKey(self, req: _Request):
+        xv = req.payload
+        if xv.ndim == 3:
+            return ("fwd3", xv.shape[1], self.ladder.seqBucket(xv.shape[2]))
+        return ("fwd",) + tuple(xv.shape[1:])
+
+    def maxRowsPerDispatch(self, key) -> int:
+        return self.ladder.maxBatch
+
+    # -- dispatch --------------------------------------------------------
+    def _pad_rows(self, x: np.ndarray, bucket: int) -> np.ndarray:
+        if x.shape[0] == bucket:
+            return x
+        pad = np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)
+        return np.concatenate([x, pad], axis=0)
+
+    def _run(self, x: np.ndarray, mask: Optional[np.ndarray]):
+        if mask is not None:
+            out = self.model.output(x, featuresMask=mask)
+        else:
+            out = self.model.output(x)
+        return np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+
+    def dispatch(self, key, reqs: List[_Request]) -> List[np.ndarray]:
+        rank3 = key[0] == "fwd3"
+        T = key[2] if rank3 else None
+        xs, masks, true_t = [], [], []
+        for r in reqs:
+            xv = r.payload
+            if rank3:
+                t = xv.shape[2]
+                true_t.append(t)
+                if t < T:
+                    padT = np.zeros(xv.shape[:2] + (T - t,), xv.dtype)
+                    xv = np.concatenate([xv, padT], axis=2)
+                m = np.zeros((xv.shape[0], T), np.float32)
+                m[:, :t] = 1.0
+                masks.append(m)
+            xs.append(xv)
+        x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        mask = (np.concatenate(masks, axis=0) if len(masks) > 1
+                else masks[0]) if rank3 else None
+        results: List[Optional[np.ndarray]] = [None] * len(reqs)
+        sm = serving_metrics()
+        pos = 0
+        chunk_start = 0
+        maxB = self.ladder.maxBatch
+        outs = []
+        # oversized coalesced batches chunk at the TOP bucket — never a
+        # fresh trace, just more than one warm dispatch
+        while chunk_start < x.shape[0]:
+            rows = min(maxB, x.shape[0] - chunk_start)
+            B = self.ladder.batchBucket(rows)
+            cx = self._pad_rows(x[chunk_start:chunk_start + rows], B)
+            cm = None
+            if rank3:
+                cm = np.ones((B, T), np.float32)
+                cm[:rows] = mask[chunk_start:chunk_start + rows]
+            sm.pad_rows().inc(B - rows, model=_model_name.get() or "?")
+            sm.batch_occupancy().set(
+                rows / B, model=_model_name.get() or "?")
+            outs.append(self._run(cx, cm)[:rows])
+            chunk_start += rows
+        out = np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        for i, r in enumerate(reqs):
+            piece = out[pos:pos + r.rows]
+            if rank3 and piece.ndim == 3 and true_t[i] < T:
+                piece = piece[:, :, :true_t[i]]
+            results[i] = piece
+            pos += r.rows
+        return results
+
+    # -- warm start ------------------------------------------------------
+    def warmKeys(self):
+        if self.inputShape is None:
+            return []
+        if len(self.inputShape) == 2 and self.inputShape[1] is None:
+            return [("fwd3", self.inputShape[0], s)
+                    for s in self.ladder.seqLens]
+        return [("fwd",) + tuple(self.inputShape)]
+
+    def warm(self, key) -> None:
+        rank3 = key[0] == "fwd3"
+        for B in self.ladder.batchSizes:
+            if rank3:
+                x = np.zeros((B, key[1], key[2]), self.dtype)
+                m = np.ones((B, key[2]), np.float32)
+                self._run(x, m)
+            else:
+                self._run(np.zeros((B,) + key[1:], self.dtype), None)
+
+    def compileCacheSize(self) -> Optional[int]:
+        fn = getattr(self.model, "_outputFn", None)
+        if fn is None:
+            return None
+        try:
+            return int(fn._cache_size())
+        except Exception:
+            return None
+
+
+class GenerativeServing:
+    """Bucketed KV-cache generation for :class:`TransformerLM`.
+
+    Requests are ``{"tokens": [...], "maxNewTokens": n}``; the group key
+    is the PROMPT bucket, prompts are LEFT-padded to it (uniform cache
+    write position — see ``KVCache.start``), and one prefill + max(n)
+    decode steps serve the whole group.  Decode executables exist per
+    batch bucket only — generation length never changes a shape.
+    """
+
+    def __init__(self, lm, ladder: Optional[BucketLadder] = None):
+        self.lm = lm
+        cap = lm.config.maxLen
+        self.ladder = ladder or BucketLadder(
+            batchSizes=(1, 2, 4, 8),
+            seqLens=tuple(s for s in (16, 32, 64, 128, 256, 512, 1024)
+                          if s <= cap // 2) or (cap // 2,))
+
+    def makeRequest(self, payload) -> _Request:
+        if not isinstance(payload, dict) or "tokens" not in payload:
+            raise ValueError('generative request needs {"tokens": [...]}')
+        toks = np.asarray(payload["tokens"], np.int32)
+        if toks.ndim == 1:
+            toks = toks[None, :]
+        if toks.ndim != 2 or toks.shape[1] < 1:
+            raise ValueError(f"tokens must be (t,) or (b, t) with t >= 1; "
+                             f"got shape {toks.shape}")
+        vocab = self.lm.config.vocabSize
+        if toks.min() < 0 or toks.max() >= vocab:
+            raise ValueError(f"token ids must be in [0, {vocab})")
+        n = int(payload.get("maxNewTokens", 16))
+        if n < 1:
+            raise ValueError("maxNewTokens must be >= 1")
+        Tp = self.ladder.seqBucket(toks.shape[1])
+        if Tp + n > self.lm.config.maxLen:
+            raise ValueError(
+                f"prompt bucket {Tp} + maxNewTokens {n} exceeds cache "
+                f"capacity {self.lm.config.maxLen}")
+        return _Request({"tokens": toks, "n": n}, toks.shape[0])
+
+    def groupKey(self, req: _Request):
+        return ("gen", self.ladder.seqBucket(req.payload["tokens"].shape[1]))
+
+    def maxRowsPerDispatch(self, key) -> int:
+        return self.ladder.maxBatch
+
+    def _left_pad(self, toks: np.ndarray, Tp: int) -> np.ndarray:
+        if toks.shape[1] == Tp:
+            return toks
+        pad = np.zeros((toks.shape[0], Tp - toks.shape[1]), np.int32)
+        return np.concatenate([pad, toks], axis=1)
+
+    def dispatch(self, key, reqs: List[_Request]) -> List[np.ndarray]:
+        Tp = key[1]
+        toks = np.concatenate(
+            [self._left_pad(r.payload["tokens"], Tp) for r in reqs], axis=0)
+        lengths = np.concatenate(
+            [np.full(r.rows, r.payload["tokens"].shape[1], np.int32)
+             for r in reqs])
+        steps = max(r.payload["n"] for r in reqs)
+        rows = toks.shape[0]
+        sm = serving_metrics()
+        name = _model_name.get() or "?"
+        results: List[Optional[np.ndarray]] = [None] * len(reqs)
+        chunk_start = 0
+        outs = []
+        maxB = self.ladder.maxBatch
+        while chunk_start < rows:
+            n = min(maxB, rows - chunk_start)
+            B = self.ladder.batchBucket(n)
+            ct = toks[chunk_start:chunk_start + n]
+            cl = lengths[chunk_start:chunk_start + n]
+            if n < B:
+                # pad rows: single-token prompts, generated then dropped
+                ct = np.concatenate(
+                    [ct, np.zeros((B - n, Tp), np.int32)], axis=0)
+                cl = np.concatenate([cl, np.ones(B - n, np.int32)])
+            sm.pad_rows().inc(B - n, model=name)
+            sm.batch_occupancy().set(n / B, model=name)
+            outs.append(self.lm.generate(ct, steps, lengths=cl)[:n])
+            sm.decode_tokens().inc(B * steps, model=name)
+            chunk_start += n
+        gen = np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        pos = 0
+        for i, r in enumerate(reqs):
+            results[i] = gen[pos:pos + r.rows, :r.payload["n"]]
+            pos += r.rows
+        return results
+
+    def warmKeys(self):
+        return [("gen", s) for s in self.ladder.seqLens]
+
+    def warm(self, key) -> None:
+        Tp = key[1]
+        if Tp + 2 > self.lm.config.maxLen:
+            return
+        for B in self.ladder.batchSizes:
+            # 2 new tokens: token 0 comes from prefill's logits, so only
+            # a 2+-token generate compiles the decode executable too
+            toks = np.zeros((B, Tp), np.int32)
+            self.lm.generate(toks, 2,
+                             lengths=np.full(B, max(1, Tp // 2), np.int32))
+
+    def compileCacheSize(self) -> Optional[int]:
+        try:
+            return int(self.lm.compileCacheSize())
+        except Exception:
+            return None
+
+
+# the adapter dispatch runs on executor worker threads; the model name
+# they report metrics under travels in a context-local
+class _ModelName(threading.local):
+    def __init__(self):
+        self.name = None
+
+    def get(self):
+        return self.name
+
+
+_model_name = _ModelName()
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+class BucketedExecutor:
+    """Per-model continuous-batching scheduler over warm executables.
+
+    ``submit()`` validates + enqueues and blocks for the result; worker
+    threads repeatedly pick the group with the most queued rows (the
+    largest ready bucket), coalesce up to the top batch bucket, and
+    dispatch through the adapter.  Model weights are device-resident jax
+    buffers owned by the adapter's model — every worker thread dispatches
+    against the SAME buffers, so hosting cost is one weight copy per
+    model regardless of worker count.
+    """
+
+    def __init__(self, serving, name: str = "default",
+                 admission: Optional[AdmissionControl] = None,
+                 workers: int = 1):
+        self.serving = serving
+        self.name = str(name)
+        self.admission = admission or AdmissionControl()
+        self._workers = max(1, int(workers))
+        self._groups: Dict[object, deque] = {}
+        self._queuedRows = 0
+        self._cv = threading.Condition()
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self._warmed = False
+        # compile accounting: high-water mark of the model's jit-cache
+        # size, advanced under its own lock so concurrent workers don't
+        # double-count one compile (or miscount a neighbor's compile as
+        # their own miss AND a hit)
+        self._acctLock = threading.Lock()
+        self._cacheSeen: Optional[int] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "BucketedExecutor":
+        if self._running:
+            return self
+        sm = serving_metrics()
+        self.admission.bind(self.name)
+        sm.queue_depth().set(0, model=self.name)
+        # materialize the hit/miss cells at zero: a scrape (or hit-rate
+        # probe) must see an explicit 0, not an absent series
+        sm.compile_hits().inc(0, model=self.name)
+        sm.compile_misses().inc(0, model=self.name)
+        if not self._warmed:
+            before = self.serving.compileCacheSize()
+            _model_name.name = self.name
+            try:
+                for key in self.serving.warmKeys():
+                    self.serving.warm(key)
+            finally:
+                _model_name.name = None
+            after = self.serving.compileCacheSize()
+            if before is not None and after is not None:
+                sm.warmup_compiles().inc(max(0, after - before),
+                                         model=self.name)
+            self._warmed = True
+        self._cacheSeen = self.serving.compileCacheSize()
+        self._running = True
+        self._threads = []
+        for i in range(self._workers):
+            th = threading.Thread(target=self._loop, daemon=True,
+                                  name=f"serving-{self.name}-{i}")
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def shutdown(self) -> None:
+        with self._cv:
+            if not self._running:
+                return
+            self._running = False
+            # reject everything still queued under the SAME lock that
+            # gates enqueue — a submit that raced past the running check
+            # either lands before this drain (rejected here) or re-checks
+            # running and raises at the caller
+            err = RuntimeError(f"serving executor {self.name!r} shut down")
+            for dq in self._groups.values():
+                for req in dq:
+                    req.error = err
+                    req.event.set()
+            self._groups.clear()
+            self._queuedRows = 0
+            serving_metrics().queue_depth().set(0, model=self.name)
+            self._cv.notify_all()
+        for th in self._threads:
+            th.join(timeout=5.0)
+        self._threads = []
+
+    # -- request path ----------------------------------------------------
+    def queuedRows(self) -> int:
+        with self._cv:
+            return self._queuedRows
+
+    def submit(self, payload, timeout: Optional[float] = None):
+        """Validate, admit, enqueue, and block until the result is ready.
+        Raises ``ValueError`` for malformed payloads (HTTP 400),
+        :class:`ServiceOverloaded` when admission sheds (HTTP 429)."""
+        sm = serving_metrics()
+        req = self.serving.makeRequest(payload)      # offender-only 400
+        fired = self.admission.check(self.queuedRows())
+        if fired is not None:
+            rule, detail = fired
+            sm.shed().inc(model=self.name, rule=rule)
+            sm.requests().inc(model=self.name, outcome="shed")
+            raise ServiceOverloaded(detail, self.admission.retryAfter)
+        key = self.serving.groupKey(req)
+        with self._cv:
+            if not self._running:
+                raise RuntimeError(
+                    f"serving executor {self.name!r} is not running")
+            self._groups.setdefault(key, deque()).append(req)
+            self._queuedRows += req.rows
+            sm.queue_depth().set(self._queuedRows, model=self.name)
+            self._cv.notify()
+        if not req.event.wait(timeout):
+            # pull the abandoned request back OUT of the queue — left
+            # behind it would still be dispatched at full device cost
+            # (a whole prefill+decode for generative models) with nobody
+            # waiting, and its rows would keep feeding the admission
+            # queue-depth rule
+            with self._cv:
+                dq = self._groups.get(key)
+                if dq is not None and req in dq:
+                    dq.remove(req)
+                    if not dq:
+                        del self._groups[key]
+                    self._queuedRows -= req.rows
+                    sm.queue_depth().set(self._queuedRows, model=self.name)
+            if not req.event.is_set():   # not completed while cancelling
+                raise TimeoutError(
+                    f"serving request timed out after {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- scheduler -------------------------------------------------------
+    def _take_batch(self):
+        """Under the lock: pop the largest ready group's requests up to
+        the top batch bucket.  Returns (key, [requests]) or None."""
+        if not self._groups:
+            return None
+        key = max(self._groups, key=lambda k: sum(
+            r.rows for r in self._groups[k]))
+        dq = self._groups[key]
+        limit = self.serving.maxRowsPerDispatch(key)
+        batch, rows = [], 0
+        while dq and (not batch or rows + dq[0].rows <= limit):
+            r = dq.popleft()
+            batch.append(r)
+            rows += r.rows
+        if not dq:
+            del self._groups[key]
+        self._queuedRows -= rows
+        serving_metrics().queue_depth().set(self._queuedRows,
+                                            model=self.name)
+        return key, batch
+
+    def _loop(self) -> None:
+        sm = serving_metrics()
+        while True:
+            with self._cv:
+                while self._running and self._queuedRows == 0:
+                    self._cv.wait(0.1)
+                if not self._running:
+                    return
+                taken = self._take_batch()
+            if taken is None:
+                continue
+            key, batch = taken
+            _model_name.name = self.name
+            try:
+                results = self.serving.dispatch(key, batch)
+            except Exception as e:
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                sm.requests().inc(len(batch), model=self.name,
+                                  outcome="error")
+                _model_name.name = None
+                continue
+            _model_name.name = None
+            after = self.serving.compileCacheSize()
+            if after is not None:
+                # misses count newly compiled EXECUTABLES (cache delta
+                # past the high-water mark), hits count clean dispatches
+                with self._acctLock:
+                    seen = self._cacheSeen if self._cacheSeen is not None \
+                        else after
+                    if after > seen:
+                        sm.compile_misses().inc(after - seen,
+                                                model=self.name)
+                        self._cacheSeen = after
+                    else:
+                        sm.compile_hits().inc(model=self.name)
+            now = time.perf_counter()
+            hist = sm.request_seconds()
+            for r, res in zip(batch, results):
+                r.result = res
+                hist.observe(now - r.t0, model=self.name)
+                r.event.set()
+            sm.requests().inc(len(batch), model=self.name, outcome="ok")
+            p99 = histogram_quantile(hist, 0.99, model=self.name)
+            if p99 is not None:
+                sm.p99_seconds().set(p99, model=self.name)
+
+    # -- introspection ---------------------------------------------------
+    def compileHitRate(self) -> Optional[float]:
+        """hits / (hits + misses) since start; None before any traffic."""
+        sm = serving_metrics()
+        try:
+            h = sm.compile_hits().value(model=self.name)
+            m = sm.compile_misses().value(model=self.name)
+        except Exception:
+            return None
+        return h / (h + m) if (h + m) > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# multi-model hosting
+# ---------------------------------------------------------------------------
+
+class ModelRegistry:
+    """name -> :class:`BucketedExecutor`; the first registered model is
+    the default route for bare ``/v1/serving``."""
+
+    def __init__(self):
+        self._executors: Dict[str, BucketedExecutor] = {}
+        self._default: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def register(self, name: str, serving,
+                 admission: Optional[AdmissionControl] = None,
+                 workers: int = 1) -> BucketedExecutor:
+        """``serving`` is a model adapter (:class:`ForwardServing` /
+        :class:`GenerativeServing`) or an already-built executor."""
+        if isinstance(serving, BucketedExecutor):
+            ex = serving
+            ex.name = name
+        else:
+            ex = BucketedExecutor(serving, name=name, admission=admission,
+                                  workers=workers)
+        with self._lock:
+            if name in self._executors:
+                raise ValueError(f"model {name!r} already registered")
+            self._executors[name] = ex
+            if self._default is None:
+                self._default = name
+        return ex
+
+    def get(self, name: Optional[str]) -> Optional[BucketedExecutor]:
+        with self._lock:
+            if name is None or name == "":
+                name = self._default
+            return self._executors.get(name) if name else None
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._executors)
+
+    def start(self) -> "ModelRegistry":
+        for ex in list(self._executors.values()):
+            ex.start()
+        return self
+
+    def shutdown(self) -> None:
+        for ex in list(self._executors.values()):
+            ex.shutdown()
+
+
+class InferenceServer:
+    """HTTP front of the serving tier.
+
+    ``POST /v1/serving/<name>`` (bare ``/v1/serving`` = default model)
+    with ``{"features": [...]}`` for forward models or
+    ``{"tokens": [...], "maxNewTokens": n}`` for generative ones.
+    Status split: 400 = the caller's payload, 404 = unknown model,
+    429 + ``Retry-After`` = admission shed, 500 = ours.  GET serves the
+    shared observability surface (``/metrics``, ``/healthz``, ...) plus
+    ``/v1/serving`` (model listing).
+    """
+
+    def __init__(self, registry: ModelRegistry, port: int = 0):
+        self.registry = registry
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> "InferenceServer":
+        self.registry.start()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str,
+                       headers: Optional[Dict[str, str]] = None) -> None:
+                from deeplearning4j_tpu.remote.server import reply_safely
+                reply_safely(self, code, body, ctype, headers)
+
+            def _reply_json(self, code: int, obj,
+                            headers: Optional[Dict[str, str]] = None):
+                self._reply(code, json.dumps(obj).encode("utf-8"),
+                            "application/json", headers)
+
+            def do_GET(self):
+                from deeplearning4j_tpu.telemetry.http import \
+                    observability_route
+                route = observability_route(self.path)
+                if route is not None:
+                    self._reply(*route)
+                    return
+                if self.path.rstrip("/") == "/v1/serving":
+                    self._reply_json(200,
+                                     {"models": server.registry.names()})
+                    return
+                self._reply_json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                name = None
+                path = self.path.rstrip("/")
+                if path == "/v1/serving":
+                    name = None
+                elif path.startswith("/v1/serving/"):
+                    name = path[len("/v1/serving/"):]
+                else:
+                    self._reply_json(404,
+                                     {"error": f"no route {self.path}"})
+                    return
+                ex = server.registry.get(name)
+                if ex is None:
+                    self._reply_json(404, {
+                        "error": f"unknown model {name!r}; hosted: "
+                                 f"{server.registry.names()}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except Exception as e:
+                    self._reply_json(400,
+                                     {"error": f"{type(e).__name__}: {e}"})
+                    return
+                try:
+                    if "features" in payload:
+                        out = ex.submit(payload["features"])
+                        body, code = {"output": np.asarray(out).tolist()}, \
+                            200
+                    elif "tokens" in payload:
+                        out = ex.submit(payload)
+                        body = {"tokens": np.asarray(out).tolist()}
+                        code = 200
+                    else:
+                        body = {"error": "payload needs 'features' or "
+                                         "'tokens'"}
+                        code = 400
+                except ServiceOverloaded as e:
+                    self._reply_json(
+                        429, {"error": f"overloaded: {e}",
+                              "retry_after": e.retryAfter},
+                        headers={"Retry-After":
+                                 str(max(1, int(math.ceil(e.retryAfter))))})
+                    return
+                except (ValueError, TypeError) as e:
+                    body, code = {"error": f"{type(e).__name__}: {e}"}, 400
+                except Exception as e:
+                    body, code = {"error": f"{type(e).__name__}: {e}"}, 500
+                self._reply_json(code, body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.registry.shutdown()

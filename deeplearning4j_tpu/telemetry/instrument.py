@@ -25,13 +25,14 @@ import time
 from typing import Optional, Sequence
 
 from deeplearning4j_tpu.telemetry.flight import flight_recorder
-from deeplearning4j_tpu.telemetry.registry import get_registry
+from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS,
+                                                   get_registry)
 from deeplearning4j_tpu.telemetry.tracing import tracer
 
 __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "supervised_scope", "microbatch_scope", "in_microbatch",
            "record_logical_step", "ReplicaTimingListener", "etl_metrics",
-           "EtlMetrics"]
+           "EtlMetrics", "ServingMetrics", "serving_metrics"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -83,7 +84,8 @@ def _report_step(model, seconds: float, batch_size: int,
     reg.counter("dl4j_tpu_train_steps_total",
                 "Logical train steps dispatched").inc()
     reg.histogram("dl4j_tpu_train_step_seconds",
-                  "Host wall time per logical train step").observe(seconds)
+                  "Host wall time per logical train step",
+                  buckets=DEFAULT_BUCKETS).observe(seconds)
     if seconds > 0:
         reg.gauge(
             "dl4j_tpu_train_examples_per_second",
@@ -224,7 +226,8 @@ class EtlMetrics:
         return get_registry().histogram(
             "dl4j_tpu_etl_h2d_seconds",
             "Per-batch host->device transfer wall time (issue + "
-            "completion wait) in the ETL staging ring")
+            "completion wait) in the ETL staging ring",
+            buckets=DEFAULT_BUCKETS)
 
     def pool_workers(self):
         return get_registry().gauge(
@@ -250,6 +253,109 @@ def etl_metrics() -> EtlMetrics:
     """Accessor for the shared ETL metric namespace (see
     :class:`EtlMetrics`)."""
     return _ETL_METRICS
+
+
+#: serving latency spans sub-ms (warm MLP on-host) to tens of seconds
+#: (long-context decode) — finer low end than DEFAULT_BUCKETS so a p99
+#: read off the bucket bounds stays meaningful at serving speeds
+SERVING_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class ServingMetrics:
+    """The ``dl4j_tpu_serving_*`` namespace, registered from ONE site.
+
+    The continuous-batching tier (``remote/serving.py``) reports here;
+    admission control reads the same registry back through
+    ``ThresholdRule``s, so the shed decision and the dashboards see one
+    coherent series.  Accessors re-resolve through :func:`get_registry`
+    on every call (tests swap the registry).  Every per-model series
+    carries a ``model`` label — one serving process hosts many models.
+    """
+
+    def request_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_request_seconds",
+            "End-to-end request latency inside the serving tier "
+            "(enqueue to response ready), per model",
+            labelnames=("model",), buckets=SERVING_LATENCY_BUCKETS)
+
+    def requests(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_requests_total",
+            "Requests completed by the bucketed executor, by model and "
+            "outcome (ok/error/shed)",
+            labelnames=("model", "outcome"))
+
+    def queue_depth(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_queue_depth",
+            "Feature rows currently queued ahead of the scheduler, per "
+            "model (the admission controller's primary signal)",
+            labelnames=("model",))
+
+    def shed(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_shed_total",
+            "Requests rejected by admission control (HTTP 429), by model "
+            "and the rule that fired",
+            labelnames=("model", "rule"))
+
+    def compile_hits(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_compile_cache_hits_total",
+            "Dispatches that hit a warm executable (no fresh XLA trace)",
+            labelnames=("model",))
+
+    def compile_misses(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_compile_cache_misses_total",
+            "Dispatches that triggered a fresh XLA trace after warmup "
+            "(steady state should hold this at zero)",
+            labelnames=("model",))
+
+    def warmup_compiles(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_warmup_compiles_total",
+            "Executables compiled eagerly by BucketedExecutor.start() "
+            "over the bucket ladder",
+            labelnames=("model",))
+
+    def p99_seconds(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_p99_seconds",
+            "p99 request latency read off the request histogram after "
+            "each dispatch (admission control's latency signal)",
+            labelnames=("model",))
+
+    def batch_occupancy(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_batch_occupancy",
+            "Real rows / padded rows of the last dispatched bucket "
+            "(1.0 = no padding waste)",
+            labelnames=("model",))
+
+    def pad_rows(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_pad_rows_total",
+            "Padding rows dispatched to round batches up to a bucket",
+            labelnames=("model",))
+
+    def decode_tokens(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_decode_tokens_total",
+            "Tokens generated through the KV-cache decode path",
+            labelnames=("model",))
+
+
+_SERVING_METRICS = ServingMetrics()
+
+
+def serving_metrics() -> ServingMetrics:
+    """Accessor for the shared serving metric namespace (see
+    :class:`ServingMetrics`)."""
+    return _SERVING_METRICS
 
 
 def note_etl_wait(seconds: float, owner) -> None:

@@ -50,6 +50,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "etl: input-pipeline tests (sharded producer pool, "
         "shared-memory batch assembly, H2D staging ring)")
+    config.addinivalue_line(
+        "markers", "serving: continuous-batching serving-tier tests "
+        "(bucketed warm executables, KV-cache decode, admission control)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -12,6 +12,11 @@ fails unless each public metric name follows the naming convention:
 - gauges and histograms do NOT end in ``_total`` (a gauge named like a
   counter lies to every recording rule that touches it);
 - histograms measuring time end in ``_seconds`` (base-unit rule);
+- ``*_seconds`` histograms DECLARE their buckets (``buckets=`` in the
+  registration call): latency quantiles are read off the bucket bounds,
+  so an implicit default silently decides every p99 the dashboards and
+  the serving tier's admission control see — the choice must be visible
+  (and reviewable) at the registration site;
 - every registration carries a NON-EMPTY help string (a bare name on a
   federated dashboard three hops from the code is unreadable; ``# HELP``
   is the only documentation a scrape carries);
@@ -44,6 +49,22 @@ NO_HELP_RE = re.compile(
     r"|,\s*[(\[])")                                 # positional tuple/list
 HELP_LITERAL_RE = re.compile(
     r"\s*,\s*(?:help\s*=\s*)?[frbuFRBU]{0,2}[\"'](?P<first>[^\"']*)[\"']")
+BUCKETS_KWARG_RE = re.compile(r"\bbuckets\s*=")
+
+
+def _call_span(text: str, open_paren: int) -> str:
+    """The argument text of the call whose ``(`` sits at ``open_paren``
+    (balanced-paren scan; string contents may miscount parens, which at
+    worst makes the span longer — never shorter than the real call)."""
+    depth = 0
+    for i in range(open_paren, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_paren:i + 1]
+    return text[open_paren:]
 
 
 def lint(pkg_dir: Path):
@@ -73,6 +94,15 @@ def lint(pkg_dir: Path):
                 errors.append(
                     f"{where}: histogram {name!r} must carry a base-unit "
                     "suffix (_seconds/_bytes/_examples)")
+            if kind == "histogram" and name.endswith("_seconds"):
+                span = _call_span(text,
+                                  m.start() + m.group(0).index("("))
+                if not BUCKETS_KWARG_RE.search(span):
+                    errors.append(
+                        f"{where}: histogram {name!r} must declare its "
+                        "buckets (buckets=...) — latency quantiles are "
+                        "read off the bucket bounds, so the choice must "
+                        "be explicit at the registration site")
             if "bytes" in name:
                 # byte-unit rule (the ETL H2D series): rate() over a
                 # mis-suffixed byte metric silently reports garbage MB/s
