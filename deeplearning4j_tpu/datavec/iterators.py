@@ -61,6 +61,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def _feat_array(self, feats) -> np.ndarray:
         if len(feats) == 1 and isinstance(feats[0], NDArrayWritable):
             return feats[0].value.astype(np.float32)
+        # jaxlint: sync-ok -- record decode: writables are host data, no device involved
         return np.array([w.toDouble() for w in feats], dtype=np.float32)
 
     def next(self, num: int = 0) -> DataSet:
@@ -89,6 +90,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
             # contract explicit
             raise StopIteration("reader exhausted: call reset() first")
         f = np.stack(fs)
+        # jaxlint: sync-ok -- label assembly from host-decoded records
         l = np.asarray(ls, dtype=np.float32) if ls else None
         return self._applyPre(DataSet(f, l))
 
@@ -179,6 +181,7 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 if self.regression:
                     l[bi, 0, t] = lab
                 else:
+                    # jaxlint: disable=host-sync -- lab is a host float from record decode
                     l[bi, int(lab), t] = 1.0
                 fm[bi, t] = 1.0
         return self._applyPre(DataSet(f, l, fm, fm.copy()))

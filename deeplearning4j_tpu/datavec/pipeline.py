@@ -171,7 +171,9 @@ def _to_np(x) -> Optional[np.ndarray]:
     if x is None:
         return None
     if hasattr(x, "numpy"):
+        # jaxlint: sync-ok -- producer worker is host-side by design (decode into shm, never jax)
         x = x.numpy()
+    # jaxlint: sync-ok -- contiguous host copy is what the shm slot memcpy requires
     return np.ascontiguousarray(np.asarray(x))
 
 
@@ -294,6 +296,7 @@ class _StagedBatch:
         for a in self.dev:
             if a is not None and hasattr(a, "block_until_ready"):
                 try:
+                    # jaxlint: sync-ok -- the sync IS the H2D completion fence of the staging ring
                     a.block_until_ready()
                 except AttributeError:  # pragma: no cover
                     pass
@@ -301,6 +304,7 @@ class _StagedBatch:
         etl_metrics().h2d_seconds().observe(self.issueSeconds + wait)
         tracer().record_complete(
             "h2d_stage", self.issuedAt, self.issueSeconds + wait,
+            # jaxlint: disable=host-sync -- nbytes is a Python int, not a device scalar
             args={"bytes": int(self.nbytes)})
         return DataSet(*self.dev)
 
@@ -608,6 +612,7 @@ class PrefetchingDataSetIterator(DataSetIterator):
                                       offset=off)
                     # private copy so the slot recycles immediately; the
                     # async device transfer then reads stable memory
+                    # jaxlint: sync-ok -- host-to-host copy out of the shm slot, no device involved
                     fields.append(np.array(view, copy=True))
                 self._freeQ.put(slot)
                 tracer().record_complete("etl_assemble", t0,

@@ -66,6 +66,7 @@ class ComputationGraph:
     def setLrScale(self, scale: float) -> None:
         """See MultiLayerNetwork.setLrScale — the fault supervisor's
         rollback backoff; traced data, changing it never retraces."""
+        # jaxlint: disable=host-sync -- scale is a host config scalar from the supervisor
         self._lrScale = float(scale)
 
     def getLrScale(self) -> float:
@@ -92,12 +93,14 @@ class ComputationGraph:
 
         if params is not None:
             self.params_ = params
+            # jaxlint: disable=retrace-closure -- one-shot state init at build: traced once per init()
             self.state_ = jax.jit(lambda: {
                 name: self.conf.nodes[name][0].initState(
                     self.conf.vertexInputTypes.get(name), self._dtype)
                 for name in self.conf.topoOrder
                 if hasattr(self.conf.nodes[name][0], "initState")})()
         else:
+            # jaxlint: disable=retrace-closure -- one-shot param init at build: traced once per init()
             self.params_, self.state_ = jax.jit(build_ps)(
                 jax.random.PRNGKey(self._rngSeed))
         self._initOptState()
@@ -111,6 +114,7 @@ class ComputationGraph:
                            for path, pname, pval in _iter_leaf_params(lp)}
                     for name, lp in p_tree.items()}
 
+        # jaxlint: disable=retrace-closure -- one-shot optimizer-state init: traced once per init()
         self.optState_ = jax.jit(build_opt)(self.params_ or {})
 
     def _updaterFor(self, layer, pname: str):
@@ -241,6 +245,7 @@ class ComputationGraph:
         new_flat, f_new = self._solver.step(flat, inputs, labels, masks,
                                             fmask)
         self.params_ = unravel(new_flat)
+        # jaxlint: sync-ok -- the line-search solver contract needs the host loss each iteration
         self._score = float(f_new)
         self._scoreArr = None
 
@@ -363,6 +368,7 @@ class ComputationGraph:
         self._scoreArr = loss
         if panic_enabled():
             # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
+            # jaxlint: sync-ok -- panic mode opts INTO a per-step sync to fail on the exact step
             self._score = float(loss)
             self._scoreArr = None
             check_panic(self._score)
@@ -480,6 +486,7 @@ class ComputationGraph:
         ``ComputationGraph.score(DataSet)``); without: last training score."""
         if ds is None:
             if self._scoreArr is not None:
+                # jaxlint: sync-ok -- score() IS the lazy materialization point of the async loss
                 self._score = float(self._scoreArr)
                 self._scoreArr = None
             return self._score
@@ -511,7 +518,9 @@ class ComputationGraph:
             out = self.output(ds.features, featuresMask=ds.featuresMask)
             if isinstance(out, list):
                 out = out[0]
+            # jaxlint: sync-ok -- evaluation is host-side by contract (metrics math in numpy)
             ev.eval(ds.labels.numpy(), out.numpy(),
+                    # jaxlint: disable=host-sync -- same evaluation D2H as the line above
                     ds.labelsMask.numpy() if getattr(ds, "labelsMask", None)
                     is not None else None)
         it.reset()

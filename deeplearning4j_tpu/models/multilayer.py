@@ -222,6 +222,7 @@ class MultiLayerNetwork:
         supervisor's rollback backoff knob).  Enters the compiled step as
         traced data — changing it does NOT retrace.  No effect on the
         legacy line-search solvers (they pick their own step length)."""
+        # jaxlint: disable=host-sync -- scale is a host config scalar from the supervisor
         self._lrScale = float(scale)
 
     def getLrScale(self) -> float:
@@ -285,12 +286,14 @@ class MultiLayerNetwork:
 
         if params is not None:
             self.params_ = params
+            # jaxlint: disable=retrace-closure -- one-shot state init at build: traced once per init()
             self.state_ = jax.jit(lambda: {
                 str(i): layer.initState(self.conf.layerInputTypes[i],
                                         self._dtype)
                 for i, layer in enumerate(self.conf.layers)
                 if hasattr(layer, "initState")})()
         else:
+            # jaxlint: disable=retrace-closure -- one-shot param init at build: traced once per init()
             self.params_, self.state_ = jax.jit(build_ps)(
                 jax.random.PRNGKey(self._rngSeed))
         self._initOptState()
@@ -308,6 +311,7 @@ class MultiLayerNetwork:
                            in _iter_leaf_params(p_tree[li])}
             return opt
 
+        # jaxlint: disable=retrace-closure -- one-shot optimizer-state init: traced once per init()
         self.optState_ = jax.jit(build_opt)(self.params_)
 
     def _updaterFor(self, layer, pname: str):
@@ -527,6 +531,7 @@ class MultiLayerNetwork:
         # masks enter as jit args too; None stays None (static)
         new_flat, f_new = self._solver.step(flat, x, y, fmask, lmask)
         self.params_ = unravel(new_flat)
+        # jaxlint: sync-ok -- the line-search solver contract needs the host loss each iteration
         self._score = float(f_new)
         self._scoreArr = None
 
@@ -547,6 +552,7 @@ class MultiLayerNetwork:
         if panic_enabled():
             # NAN_PANIC/INF_PANIC (reference: profilingConfigurableHookOut)
             # — opt-in mode that needs the value immediately.
+            # jaxlint: sync-ok -- panic mode opts INTO a per-step sync to fail on the exact step
             self._score = float(loss)
             self._scoreArr = None
             check_panic(self._score)
@@ -649,6 +655,7 @@ class MultiLayerNetwork:
         # FF output is (b, nOut): argmax over -1.  RNN output is (b, nOut, t)
         # (DL4J layout): the class axis is 1, NOT the trailing time axis.
         axis = 1 if out.ndim == 3 else -1
+        # jaxlint: sync-ok -- predict() returns host labels by contract (API boundary)
         return np.asarray(jnp.argmax(out, axis=axis))
 
     def pretrain(self, iterator, epochs: int = 1) -> None:
@@ -692,10 +699,13 @@ class MultiLayerNetwork:
                     newp[n] = params[n] - upd
                     newo[n] = st
                 return newp, newo, loss
+            # jaxlint: disable=retrace-loop -- one executable per pretrained LAYER by design
+            # (the layer is baked into the trace); reused across every epoch of that layer
             jstep = jax.jit(step)
 
             it_count = 0
             loss = None
+            # jaxlint: disable=host-sync -- epochs is a Python int argument
             for _ in range(int(epochs)):
                 if hasattr(iterator, "reset"):
                     iterator.reset()
@@ -712,6 +722,7 @@ class MultiLayerNetwork:
     def score(self, ds: Optional[DataSet] = None) -> float:
         if ds is None:
             if self._scoreArr is not None:
+                # jaxlint: sync-ok -- score() IS the lazy materialization point of the async loss
                 self._score = float(self._scoreArr)
                 self._scoreArr = None
             return self._score
@@ -732,7 +743,9 @@ class MultiLayerNetwork:
             # poison the next training fetch's stall accounting
             ds = etl_fetch(it)
             out = self.output(ds.features, featuresMask=ds.featuresMask)
+            # jaxlint: sync-ok -- evaluation is host-side by contract (metrics math in numpy)
             ev.eval(ds.labels.numpy(), out.numpy(),
+                    # jaxlint: disable=host-sync -- same evaluation D2H as the line above
                     ds.labelsMask.numpy() if ds.labelsMask is not None else None)
         it.reset()
         return ev

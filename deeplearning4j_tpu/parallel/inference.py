@@ -101,6 +101,7 @@ class ParallelInference:
                 "shapes cannot share a coalesced batch")
 
     def output(self, x) -> NDArray:
+        # jaxlint: sync-ok -- request normalization: features must be host rows before coalescing
         xv = np.asarray(x.numpy() if isinstance(x, NDArray) else x)
         if self.inferenceMode == InferenceMode.SEQUENTIAL:
             return self._run(xv)
@@ -157,6 +158,7 @@ class ParallelInference:
             xs = [b[0] for b in batch]
             sizes = [x.shape[0] for x in xs]
             try:
+                # jaxlint: sync-ok -- D2H of the coalesced batch result, split per waiting request
                 out = self._run(np.concatenate(xs, axis=0)).numpy()
                 if self._expectTrailing is None:
                     # the model accepted this shape: pin it as THE

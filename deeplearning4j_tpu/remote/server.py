@@ -61,6 +61,7 @@ class JsonModelServer:
         # restrict ComputationGraph responses to these named outputs
         self.outputNames = list(outputNames) if outputNames else None
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
         self._parallelInference = bool(parallelInference)
         self._batchLimit = int(batchLimit)
         self._pi = None
@@ -193,8 +194,9 @@ class JsonModelServer:
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
         return self
 
     def stop(self) -> None:
@@ -202,6 +204,12 @@ class JsonModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            # stop() must not return while the acceptor thread still
+            # runs: a stop()/start() cycle would race the old loop
+            # (jaxlint thread-join discipline)
+            self._thread.join(timeout=5.0)
+            self._thread = None
         if self._pi is not None:
             self._pi.shutdown()
             self._pi = None      # rebuilt on the next start()

@@ -219,6 +219,7 @@ class ForwardServing:
 
     # -- request admission / grouping -----------------------------------
     def makeRequest(self, payload) -> _Request:
+        # jaxlint: sync-ok -- request decode: the payload is host JSON, not a device array
         xv = np.asarray(payload, dtype=self.dtype)
         if xv.ndim < 2:
             raise ValueError(
@@ -227,6 +228,7 @@ class ForwardServing:
             want = self.inputShape
             got = xv.shape[1:]
             ok = len(got) == len(want) and all(
+                # jaxlint: disable=host-sync -- shape dims are Python ints, not device scalars
                 w is None or int(w) == int(g) for w, g in zip(want, got))
             if not ok:
                 raise ValueError(
@@ -257,6 +259,7 @@ class ForwardServing:
             out = self.model.output(x, featuresMask=mask)
         else:
             out = self.model.output(x)
+        # jaxlint: sync-ok -- D2H of the batched forward result IS the response payload
         return np.asarray(out.numpy() if hasattr(out, "numpy") else out)
 
     def dispatch(self, key, reqs: List[_Request]) -> List[np.ndarray]:
@@ -358,6 +361,7 @@ class GenerativeServing:
     def makeRequest(self, payload) -> _Request:
         if not isinstance(payload, dict) or "tokens" not in payload:
             raise ValueError('generative request needs {"tokens": [...]}')
+        # jaxlint: sync-ok -- request decode: token ids arrive as host JSON
         toks = np.asarray(payload["tokens"], np.int32)
         if toks.ndim == 1:
             toks = toks[None, :]
@@ -546,11 +550,15 @@ class BucketedExecutor:
                     req.event.set()
             self._groups.clear()
             self._queuedRows = 0
-            serving_metrics().queue_depth().set(0, model=self.name)
             self._cv.notify_all()
         for th in self._threads:
             th.join(timeout=5.0)
         self._threads = []
+        # registry locks are never taken under _cv (scheduler -> registry
+        # lock order, jaxlint lock-order discipline); the zero is written
+        # AFTER the worker joins so no in-flight worker write can land
+        # later and leave a phantom backlog on a stopped executor
+        serving_metrics().queue_depth().set(0, model=self.name)
 
     # -- request path ----------------------------------------------------
     def queuedRows(self) -> int:
@@ -563,7 +571,15 @@ class BucketedExecutor:
         :class:`ServiceOverloaded` when admission sheds (HTTP 429)."""
         sm = serving_metrics()
         req = self.serving.makeRequest(payload)      # offender-only 400
-        fired = self.admission.check(self.queuedRows())
+        queued = self.queuedRows()
+        # re-sync the depth gauge from the live count BEFORE admission
+        # reads it: gauge writes happen outside _cv (lock discipline —
+        # scheduler locks never hold registry locks), so a drain/enqueue
+        # pair can land out of order; without this refresh a stale high
+        # value could shed traffic forever (shed requests never enqueue,
+        # so nothing else would rewrite the gauge on an idle queue)
+        sm.queue_depth().set(queued, model=self.name)
+        fired = self.admission.check(queued)
         if fired is not None:
             rule, detail = fired
             sm.shed().inc(model=self.name, rule=rule)
@@ -576,14 +592,18 @@ class BucketedExecutor:
                     f"serving executor {self.name!r} is not running")
             self._groups.setdefault(key, deque()).append(req)
             self._queuedRows += req.rows
-            sm.queue_depth().set(self._queuedRows, model=self.name)
+            depth = self._queuedRows
             self._cv.notify()
+        # gauge write AFTER releasing _cv (scheduler -> registry lock
+        # order; see shutdown)
+        sm.queue_depth().set(depth, model=self.name)
         if not req.event.wait(timeout):
             # pull the abandoned request back OUT of the queue — left
             # behind it would still be dispatched at full device cost
             # (a whole prefill+decode for generative models) with nobody
             # waiting, and its rows would keep feeding the admission
             # queue-depth rule
+            depth = None
             with self._cv:
                 dq = self._groups.get(key)
                 if dq is not None and req in dq:
@@ -591,7 +611,9 @@ class BucketedExecutor:
                     if not dq:
                         del self._groups[key]
                     self._queuedRows -= req.rows
-                    sm.queue_depth().set(self._queuedRows, model=self.name)
+                    depth = self._queuedRows
+            if depth is not None:
+                sm.queue_depth().set(depth, model=self.name)
             if not req.event.is_set():   # not completed while cancelling
                 raise TimeoutError(
                     f"serving request timed out after {timeout}s")
@@ -617,8 +639,6 @@ class BucketedExecutor:
         if not dq:
             del self._groups[key]
         self._queuedRows -= rows
-        serving_metrics().queue_depth().set(self._queuedRows,
-                                            model=self.name)
         return key, batch
 
     def _loop(self) -> None:
@@ -630,6 +650,11 @@ class BucketedExecutor:
                 if not self._running:
                     return
                 taken = self._take_batch()
+                depth = self._queuedRows
+            # the registry's metric locks are taken only AFTER _cv is
+            # released — one global scheduler -> registry order on every
+            # path (jaxlint lock-order discipline)
+            sm.queue_depth().set(depth, model=self.name)
             if taken is None:
                 continue
             key, batch = taken
@@ -749,6 +774,7 @@ class InferenceServer:
         self.registry = registry
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "InferenceServer":
         self.registry.start()
@@ -808,10 +834,12 @@ class InferenceServer:
                 try:
                     if "features" in payload:
                         out = ex.submit(payload["features"])
+                        # jaxlint: sync-ok -- response serialization: the result leaves as JSON
                         body, code = {"output": np.asarray(out).tolist()}, \
                             200
                     elif "tokens" in payload:
                         out = ex.submit(payload)
+                        # jaxlint: sync-ok -- response serialization: the result leaves as JSON
                         body = {"tokens": np.asarray(out).tolist()}
                         code = 200
                     else:
@@ -833,8 +861,9 @@ class InferenceServer:
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
         return self
 
     def stop(self) -> None:
@@ -842,4 +871,10 @@ class InferenceServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            # stop() must not return while the acceptor thread still
+            # runs — handlers mid-request would race the executor
+            # shutdown below (jaxlint thread-join discipline)
+            self._thread.join(timeout=5.0)
+            self._thread = None
         self.registry.shutdown()

@@ -284,4 +284,10 @@ class UIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            # shutdown() stops serve_forever, but returning before the
+            # thread exits lets a stop()/start() cycle race the old
+            # acceptor (jaxlint thread-join)
+            self._thread.join(timeout=5.0)
+            self._thread = None
         UIServer._instance = None

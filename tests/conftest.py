@@ -53,6 +53,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: continuous-batching serving-tier tests "
         "(bucketed warm executables, KV-cache decode, admission control)")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis tests (the jaxlint AST "
+        "framework, its rule fixtures, and the repo-is-clean smoke "
+        "gate)")
 
 
 def pytest_collection_modifyitems(config, items):

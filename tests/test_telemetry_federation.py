@@ -758,13 +758,17 @@ class TestLintExtensions:
                 'reg.counter("dl4j_tpu_a_h_total",)\n'          # accepted
                 'reg.gauge("dl4j_tpu_a_i", ("rule",))\n')
             errors = lint_telemetry.lint(tmp_path)
-            assert len(errors) == 5, errors
+            # 6: the PR 8 buckets rule also fires on a_d_seconds (this
+            # fixture predates it — the count was stale at 5)
+            assert len(errors) == 6, errors
             assert "without a help" in errors[0]
             assert "EMPTY help" in errors[1]
-            assert "dl4j_tpu_a_e" in errors[2] and \
-                "without a help" in errors[2]
-            assert "dl4j_tpu_a_h_total" in errors[3]    # trailing comma
-            assert "dl4j_tpu_a_i" in errors[4]          # tuple, not help
+            assert "dl4j_tpu_a_d_seconds" in errors[2] and \
+                "buckets" in errors[2]
+            assert "dl4j_tpu_a_e" in errors[3] and \
+                "without a help" in errors[3]
+            assert "dl4j_tpu_a_h_total" in errors[4]    # trailing comma
+            assert "dl4j_tpu_a_i" in errors[5]          # tuple, not help
         finally:
             sys.path.remove(str(_TOOLS))
 

@@ -22,7 +22,7 @@ BUILTIN = {
 # gating markers the suite RELIES on: if one of these silently vanishes
 # from conftest registration, `-m <marker>` selects nothing and that whole
 # subsystem's coverage evaporates without a red test
-REQUIRED = {"tpu", "slow", "fault", "telemetry", "etl", "serving"}
+REQUIRED = {"tpu", "slow", "fault", "telemetry", "etl", "serving", "lint"}
 
 MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_]\w*)")
 REGISTER_RE = re.compile(
@@ -64,16 +64,21 @@ def main(argv) -> int:
                   f"pytest_configure)", file=sys.stderr)
         return 1
     print(f"check_markers: OK ({len(allowed)} registered/builtin markers)")
-    # the telemetry namespace lint rides the same tier-1 gate: a drifting
-    # or undocumented metric name breaks dashboards/alerts just as
-    # silently as a typo'd marker loses test coverage
-    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    # jaxlint rides the same tier-1 gate, AHEAD of pytest: a retrace
+    # hazard, hidden host sync, lock-order cycle, leaked thread or
+    # drifting metric name breaks production just as silently as a
+    # typo'd marker loses test coverage.  Full rule set — the telemetry
+    # namespace rules (formerly tools/lint_telemetry.py) are part of it.
+    repo = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo))
     try:
-        import lint_telemetry
-        rc = lint_telemetry.main(["lint_telemetry.py", str(pkg_dir)])
+        from tools.jaxlint import render_text, run
+        result = run(paths=[pkg_dir], root=repo)
     finally:
         sys.path.pop(0)
-    return rc
+    out = render_text(result)
+    print(out) if result.exit_code == 0 else print(out, file=sys.stderr)
+    return result.exit_code
 
 
 if __name__ == "__main__":

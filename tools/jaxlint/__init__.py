@@ -1,0 +1,34 @@
+"""jaxlint — AST-based JAX/TPU hazard analyzer for this repository.
+
+Rules: retrace hazards (retrace-loop / retrace-closure /
+retrace-static-args), hidden host syncs on declared hot paths
+(host-sync), lock discipline (lock-order / lock-blocking-call), thread
+lifecycle (thread-daemon / thread-join), and the telemetry metric
+namespace (telemetry-*, re-based from tools/lint_telemetry.py).
+
+Run ``python -m tools.jaxlint --help``; the full catalog with examples
+lives in ``tools/jaxlint/RULES.md``.
+"""
+from tools.jaxlint.core import (Finding, Linter, Rule, RunResult,  # noqa
+                                all_rule_ids, load_baseline, make_rules,
+                                register_rule, render_json, render_text,
+                                save_baseline)
+
+__all__ = ["Finding", "Linter", "Rule", "RunResult", "all_rule_ids",
+           "load_baseline", "make_rules", "register_rule", "render_json",
+           "render_text", "save_baseline", "run"]
+
+
+def run(paths=None, root=None, rules=None, baseline_path=None):
+    """Programmatic one-call entry (check_markers, tests): lint
+    ``paths`` and return the :class:`RunResult`."""
+    from pathlib import Path
+    repo = Path(root) if root is not None else \
+        Path(__file__).resolve().parents[2]
+    if paths is None:
+        paths = [repo / "deeplearning4j_tpu"]
+    if baseline_path is None:
+        baseline_path = Path(__file__).resolve().parent / "baseline.json"
+    baseline = load_baseline(Path(baseline_path))
+    return Linter(repo, rules=rules, baseline=baseline).run(
+        [Path(p) for p in paths])

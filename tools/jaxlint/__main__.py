@@ -1,0 +1,114 @@
+"""CLI: ``python -m tools.jaxlint [paths...] [options]``.
+
+Exit codes: 0 clean (no unsuppressed, unbaselined findings), 1 findings,
+2 usage error.  Invoked by ``tools/check_markers.py`` ahead of pytest,
+so a hazard fails tier-1 exactly like a failing test.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.jaxlint.core import (Linter, load_baseline, make_rules,
+                                render_json, render_text, save_baseline)
+
+_HERE = Path(__file__).resolve().parent
+_REPO = _HERE.parents[1]
+DEFAULT_BASELINE = _HERE / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="AST-based JAX/TPU hazard analyzer "
+                    "(rule catalog: tools/jaxlint/RULES.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "deeplearning4j_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the JSON report instead of text")
+    p.add_argument("--rules",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help=f"baseline file (default: {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (show grandfathered "
+                        "findings too)")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="rewrite the baseline from the current "
+                        "unsuppressed findings and exit 0")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list suppressed/baselined findings")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in make_rules():
+            print(f"{rule.id:22s} {rule.summary}")
+            for sid in getattr(rule, "sibling_ids", ()):
+                print(f"{sid:22s}   (emitted by {rule.id})")
+        return 0
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    paths = [Path(p) for p in args.paths] or \
+        [_REPO / "deeplearning4j_tpu"]
+    for p in paths:
+        if not p.exists():
+            print(f"jaxlint: no such path {p}", file=sys.stderr)
+            return 2
+    baseline_path = Path(args.baseline)
+    try:
+        baseline = None if (args.no_baseline or args.baseline_update) \
+            else load_baseline(baseline_path)
+    except (ValueError, KeyError) as e:
+        print(f"jaxlint: unreadable baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        linter = Linter(_REPO, rules=rules, baseline=baseline)
+    except ValueError as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+    result = linter.run(paths)
+    if args.baseline_update:
+        # meta findings (bad suppressions, parse errors) are never
+        # grandfatherable — they must be fixed, not frozen
+        from tools.jaxlint.core import META_RULES
+        entries = [f for f in result.findings if f.rule not in META_RULES]
+        # a path- or rule-filtered update only owns what it re-checked:
+        # out-of-scope entries from the existing baseline are preserved
+        # verbatim, never silently deleted
+        scanned = set(result.scanned_relpaths)
+        try:
+            existing = load_baseline(baseline_path)
+        except (ValueError, KeyError):
+            existing = {}
+        preserved = [k for k, n in sorted(existing.items())
+                     if not (k[1] in scanned and k[0] in result.active_ids)
+                     for _ in range(n)]
+        save_baseline(baseline_path, entries, extra_keys=preserved)
+        blocked = [f for f in result.findings if f.rule in META_RULES]
+        print(f"jaxlint: baseline rewritten with {len(entries)} "
+              f"finding(s) + {len(preserved)} preserved out-of-scope "
+              f"entr{'y' if len(preserved) == 1 else 'ies'} -> "
+              f"{baseline_path}")
+        for f in blocked:
+            print(f"{f.location()}: {f.rule}: {f.message} "
+                  "[not baselineable]", file=sys.stderr)
+        return 1 if blocked else 0
+    if args.as_json:
+        print(json.dumps(render_json(result), indent=1))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,430 @@
+"""Lock-discipline rules: acquisition-order cycles and blocking calls
+made while holding a lock.
+
+The serving scheduler, ``ParallelInference`` and the telemetry registry
+are all lock-heavy concurrent tiers; PR 8 already had to fix one
+shutdown race by hand.  Two properties of that code are checkable from
+the AST:
+
+- ``lock-order`` — build a lock-acquisition graph: an edge A→B for
+  every ``with B:`` entered while A is held, both directly nested and
+  one level through calls that resolve inside the analyzed set
+  (``self.method()``, same-module functions, ``from x import f``
+  imports).  Any cycle in that graph is a latent deadlock: two threads
+  taking the locks in opposite orders need exactly one bad interleaving.
+  A *self*-edge on a non-reentrant ``threading.Lock`` is reported too —
+  re-acquiring it deadlocks unconditionally.
+- ``lock-blocking-call`` — while a lock is held, flag unbounded waits
+  and slow I/O: ``time.sleep``, thread/process ``.join()``, queue
+  ``.get()`` without a timeout, bare ``.wait()`` (except on the held
+  condition variable itself — ``Condition.wait`` *releases* the lock),
+  and HTTP requests.  Every thread that wants the lock stalls behind
+  the sleeper.
+
+Lock identity is static: a lock is a ``threading.Lock/RLock/Condition/
+Semaphore`` assignment target (module global, class or ``self``
+attribute), named ``<file>::<Class>.<attr>``; a ``with`` on a lock-ish
+attribute that no assignment defines (e.g. ``cell.lock``) gets an
+approximate id from its expression text.  Calls that cannot be resolved
+statically contribute no edges — the graph under-approximates, so every
+cycle it reports is real modulo lock *identity* (two instances of one
+class share an id; an A→B edge between instances is ordered by object,
+which the analyzer cannot see — suppress with the reason when that is
+the design).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.jaxlint.core import (Finding, Rule, dotted, register_rule,
+                                walk_shallow)
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock", "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+    "Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+}
+_LOCKISH_TAILS = ("lock", "mutex", "cv", "cond", "condition", "sem")
+
+
+def _lockish(name: str) -> bool:
+    n = name.lower().lstrip("_")
+    return any(n == t or n.endswith(t) for t in _LOCKISH_TAILS)
+
+
+class _FileModel:
+    """Everything the two lock rules need from one file, gathered in a
+    single shallow pass per function."""
+
+    def __init__(self, src):
+        self.src = src
+        self.lock_types: Dict[str, str] = {}     # lock id -> ctor kind
+        # function key -> locks acquired directly anywhere inside
+        self.fn_locks: Dict[Tuple[str, str], Set[str]] = {}
+        # function key -> resolvable callee keys
+        self.fn_calls: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        # (held lock id, callee key, line) while holding
+        self.calls_under_lock: List[Tuple[str, Tuple[str, str], int]] = []
+        # direct nesting edges: (held, acquired, line)
+        self.edges: List[Tuple[str, str, int]] = []
+        self.blocking: List[Tuple[str, int, str]] = []  # (lockid, line, what)
+        self._import_map = self._imports(src.tree)
+        self._module_funcs = {n.name for n in src.tree.body
+                              if isinstance(n, ast.FunctionDef)}
+        self._collect_locks()
+        self._walk_functions()
+
+    # -- lock definitions ------------------------------------------------
+    def _lock_id(self, cls: Optional[str], attr: str) -> str:
+        scope = f"{cls}." if cls else ""
+        return f"{self.src.relpath}::{scope}{attr}"
+
+    def _collect_locks(self) -> None:
+        src = self.src
+
+        def ctor_kind(value) -> Optional[str]:
+            if isinstance(value, ast.Call):
+                return _LOCK_CTORS.get(dotted(value.func))
+            return None
+
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.lock_types[self._lock_id(None, t.id)] = \
+                                kind
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    kind = ctor_kind(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):        # class attr
+                            self.lock_types[
+                                self._lock_id(node.name, t.id)] = kind
+                        elif isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            self.lock_types[
+                                self._lock_id(node.name, t.attr)] = kind
+
+    def _imports(self, tree) -> Dict[str, str]:
+        """imported name -> source module (dotted) for from-imports."""
+        out = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    out[a.asname or a.name] = node.module
+        return out
+
+    # -- lock-expression resolution --------------------------------------
+    def resolve_lock(self, expr: ast.AST,
+                     cls: Optional[str]) -> Optional[Tuple[str, str]]:
+        """(lock id, expression text) when ``with expr`` acquires a lock,
+        else None.  Only Name/Attribute expressions qualify — a ``with``
+        on a call (file handle, span context) is not an acquisition."""
+        text = dotted(expr)
+        if not text:
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and cls is not None:
+            lid = self._lock_id(cls, expr.attr)
+            if lid in self.lock_types or _lockish(expr.attr):
+                self.lock_types.setdefault(lid, "Lock")
+                return lid, text
+            return None
+        if isinstance(expr, ast.Name):
+            mod = self._import_map.get(expr.id)
+            if mod is not None:
+                # an imported lock is THE defining module's lock — a
+                # per-file id would hide every cross-module cycle
+                lid = f"{mod.replace('.', '/')}.py::{expr.id}"
+                if _lockish(expr.id):
+                    self.lock_types.setdefault(lid, "Lock")
+                    return lid, text
+                return None
+            lid = self._lock_id(None, expr.id)
+            if lid in self.lock_types or _lockish(expr.id):
+                self.lock_types.setdefault(lid, "Lock")
+                return lid, text
+            return None
+        # foreign attribute chain (cell.lock): approximate by text
+        tail = text.rsplit(".", 1)[-1]
+        if _lockish(tail):
+            lid = f"{self.src.relpath}::~{text}"
+            self.lock_types.setdefault(lid, "unknown")
+            return lid, text
+        return None
+
+    # -- callee resolution -----------------------------------------------
+    def resolve_callee(self, call: ast.Call,
+                       cls: Optional[str]) -> Optional[Tuple[str, str]]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and cls is not None:
+            return (self.src.relpath, f"{cls}.{f.attr}")
+        if isinstance(f, ast.Name):
+            if f.id in self._module_funcs:
+                return (self.src.relpath, f.id)
+            mod = self._import_map.get(f.id)
+            if mod:
+                return (mod.replace(".", "/") + ".py", f.id)
+        return None
+
+    # -- per-function walk -----------------------------------------------
+    def _walk_functions(self) -> None:
+        stack: List[Tuple[Optional[str], ast.AST]] = [(None, self.src.tree)]
+        while stack:
+            cls, node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child.name, child))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    key = (self.src.relpath,
+                           f"{cls}.{child.name}" if cls else child.name)
+                    self.fn_locks.setdefault(key, set())
+                    self.fn_calls.setdefault(key, set())
+                    self._walk_body(child.body, cls, key, [])
+                    stack.append((cls, child))
+
+    def _walk_body(self, stmts, cls, key, held: List[Tuple[str, str]]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # nested scope runs on its own schedule
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in stmt.items:
+                    lk = self.resolve_lock(item.context_expr, cls)
+                    if lk is not None:
+                        lid, text = lk
+                        self.fn_locks[key].add(lid)
+                        for held_id, _t in held:
+                            self.edges.append((held_id, lid, stmt.lineno))
+                        acquired.append(lk)
+                    else:
+                        self._scan_expr(item.context_expr, cls, key, held)
+                self._walk_body(stmt.body, cls, key, held + acquired)
+                continue
+            # compound statements recurse so nested With blocks see the
+            # held set; everything else scans flat
+            if isinstance(stmt, (ast.If,)):
+                self._scan_expr(stmt.test, cls, key, held)
+                self._walk_body(stmt.body, cls, key, held)
+                self._walk_body(stmt.orelse, cls, key, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(stmt.iter, cls, key, held)
+                self._walk_body(stmt.body, cls, key, held)
+                self._walk_body(stmt.orelse, cls, key, held)
+            elif isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test, cls, key, held)
+                self._walk_body(stmt.body, cls, key, held)
+                self._walk_body(stmt.orelse, cls, key, held)
+            elif isinstance(stmt, ast.Try):
+                self._walk_body(stmt.body, cls, key, held)
+                for h in stmt.handlers:
+                    self._walk_body(h.body, cls, key, held)
+                self._walk_body(stmt.orelse, cls, key, held)
+                self._walk_body(stmt.finalbody, cls, key, held)
+            else:
+                self._scan_expr(stmt, cls, key, held)
+
+    def _scan_expr(self, node, cls, key, held: List[Tuple[str, str]]):
+        """Record calls inside an expression/simple statement."""
+        for sub in walk_shallow(node) if not isinstance(node, ast.Call) \
+                else list(walk_shallow(node)) + [node]:
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = self.resolve_callee(sub, cls)
+            if callee is not None:
+                self.fn_calls[key].add(callee)
+                for held_id, _t in held:
+                    self.calls_under_lock.append(
+                        (held_id, callee, sub.lineno))
+            if held:
+                what = self._blocking_kind(sub, held)
+                if what is not None:
+                    self.blocking.append((held[-1][0], sub.lineno, what))
+
+    def _blocking_kind(self, call: ast.Call,
+                       held: List[Tuple[str, str]]) -> Optional[str]:
+        f = call.func
+        name = dotted(f)
+        if name in ("time.sleep",) or (
+                isinstance(f, ast.Name) and f.id == "sleep" and
+                self._import_map.get("sleep") == "time"):
+            return "time.sleep()"
+        if name.startswith(("urllib.request.urlopen", "requests.")) or \
+                name == "urlopen":
+            return f"HTTP request ({name})"
+        if not isinstance(f, ast.Attribute):
+            return None
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if f.attr == "join" and not call.args:
+            return ".join()" if not has_timeout else None
+        if f.attr == "get" and not call.args and not has_timeout:
+            return ".get() with no timeout"
+        if f.attr == "wait" and not call.args and not has_timeout:
+            target = dotted(f.value)
+            if target and any(target == t for _lid, t in held):
+                return None     # Condition.wait on the held cv RELEASES it
+            return ".wait() with no timeout"
+        return None
+
+
+def _model_for(src) -> _FileModel:
+    """One `_FileModel` per SourceFile, shared by both lock rules (the
+    single-walk discipline, cached on the parsed file itself)."""
+    model = getattr(src, "_jaxlint_lock_model", None)
+    if model is None:
+        model = _FileModel(src)
+        src._jaxlint_lock_model = model
+    return model
+
+
+@register_rule
+class LockOrderRule(Rule):
+    id = "lock-order"
+    summary = ("lock-acquisition-order cycle (or non-reentrant "
+               "self-acquisition) across the analyzed modules")
+
+    def __init__(self):
+        self.models: List[_FileModel] = []
+
+    def visit(self, src, report) -> None:
+        self.models.append(_model_for(src))
+
+    def finalize(self, report) -> None:
+        # transitive lock summaries: fn -> locks it may acquire
+        fn_locks: Dict[Tuple[str, str], Set[str]] = {}
+        fn_calls: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        lock_types: Dict[str, str] = {}
+        for m in self.models:
+            fn_locks.update({k: set(v) for k, v in m.fn_locks.items()})
+            for k, v in m.fn_calls.items():
+                fn_calls.setdefault(k, set()).update(v)
+            lock_types.update(m.lock_types)
+        changed = True
+        while changed:          # fixpoint over the (small) call graph
+            changed = False
+            for k, callees in fn_calls.items():
+                for c in callees:
+                    extra = fn_locks.get(c)
+                    if extra and not extra <= fn_locks.setdefault(k, set()):
+                        fn_locks[k] |= extra
+                        changed = True
+        # edges: direct nesting + one hop through resolved calls
+        edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        for m in self.models:
+            for a, b, line in m.edges:
+                edges.setdefault((a, b), []).append((m.src.relpath, line))
+            for held, callee, line in m.calls_under_lock:
+                for b in fn_locks.get(callee, ()):
+                    edges.setdefault((held, b), []).append(
+                        (m.src.relpath, line))
+        # self-edges on non-reentrant locks deadlock unconditionally
+        for (a, b), sites in sorted(edges.items()):
+            if a == b and lock_types.get(a) == "Lock":
+                path, line = sites[0]
+                report(Finding(
+                    self.id, path, line, 0,
+                    f"lock {a.split('::', 1)[1]!r} is acquired while "
+                    "already held: threading.Lock is not reentrant — "
+                    "this path deadlocks unconditionally"))
+        # cycle detection over distinct locks
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            names = sorted(s.split("::", 1)[1] for s in scc)
+            for (a, b), sites in sorted(edges.items()):
+                if a in scc and b in scc and a != b:
+                    path, line = sites[0]
+                    report(Finding(
+                        self.id, path, line, 0,
+                        f"lock-order cycle among {{{', '.join(names)}}}: "
+                        f"this site orders {a.split('::', 1)[1]} -> "
+                        f"{b.split('::', 1)[1]} while another path "
+                        "orders them oppositely — pick one global order "
+                        "(or narrow a critical section so the inner "
+                        "acquisition moves outside the outer lock)"))
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan, iterative (the lock graph is tiny but recursion limits
+    are not worth risking in a CI gate)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out
+
+
+@register_rule
+class LockBlockingCallRule(Rule):
+    id = "lock-blocking-call"
+    summary = ("blocking call (sleep/join/untimed get/wait/HTTP) made "
+               "while holding a lock")
+
+    def visit(self, src, report) -> None:
+        model = _model_for(src)
+        for lock_id, line, what in model.blocking:
+            report(Finding(
+                self.id, src.relpath, line, 0,
+                f"{what} while holding {lock_id.split('::', 1)[1]!r}: "
+                "every thread that wants the lock stalls behind this "
+                "call — move the wait outside the critical section or "
+                "bound it with a timeout"))

@@ -1,0 +1,172 @@
+"""Telemetry metric-namespace rules (the ``tools/lint_telemetry.py``
+rule set re-based onto the jaxlint framework: one AST walk instead of a
+private regex scan per file, shared suppression syntax).
+
+Every check the regex linter enforced is preserved — none are loosened:
+
+- ``telemetry-name``         dl4j_tpu_<subsystem>_<name> lower-snake
+- ``telemetry-counter-total`` counters end in ``_total``
+- ``telemetry-unit``         gauges/histograms must NOT end ``_total``;
+                             histograms carry a base-unit suffix
+                             (_seconds/_bytes/_examples); byte series
+                             end _bytes_total (counter) / _bytes (gauge)
+- ``telemetry-buckets``      ``*_seconds`` histograms declare buckets=
+- ``telemetry-help``         every registration carries non-empty help
+- ``telemetry-dup-module``   a metric name registers from ONE module
+
+A registration site is any ``.counter("…")`` / ``.gauge("…")`` /
+``.histogram("…")`` call with a literal name — exactly the population
+the regex matched, minus the false positives a regex can't avoid
+(the same text inside a docstring or comment).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from tools.jaxlint.core import Finding, Rule, register_rule
+
+NAME_PATTERN = re.compile(r"^dl4j_tpu_[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+_KINDS = ("counter", "gauge", "histogram")
+_HIST_UNITS = ("_seconds", "_bytes", "_examples")
+
+
+def _registration(node: ast.Call) -> Tuple[str, str]:
+    """(kind, literal name) when ``node`` is a metric registration with
+    a constant name, else ('', '')."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _KINDS):
+        return "", ""
+    if not node.args:
+        return "", ""
+    name = node.args[0]
+    if isinstance(name, ast.Constant) and isinstance(name.value, str):
+        return f.attr, name.value
+    return "", ""
+
+
+def _help_arg(node: ast.Call):
+    """The help argument node, or None when the call passes none at all
+    (positional arg 1 or ``help=``)."""
+    if len(node.args) >= 2:
+        return node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "help":
+            return kw.value
+    return None
+
+
+@register_rule
+class TelemetryRule(Rule):
+    """All six telemetry checks in one single-pass rule; findings carry
+    distinct ids so each is independently suppressible."""
+
+    id = "telemetry-name"
+    summary = ("metric naming/unit/help/buckets conventions "
+               "(dl4j_tpu_* namespace; also emits telemetry-counter-"
+               "total, telemetry-unit, telemetry-buckets, telemetry-"
+               "help, telemetry-dup-module)")
+
+    #: the sibling ids this rule emits — registered as aliases below so
+    #: `--rules` filtering and suppression validation know them
+    sibling_ids = ("telemetry-counter-total", "telemetry-unit",
+                   "telemetry-buckets", "telemetry-help",
+                   "telemetry-dup-module")
+
+    def __init__(self):
+        # name -> [(relpath, line)]
+        self.sites: Dict[str, List[Tuple[str, int]]] = {}
+        self.total_sites = 0        # every literal registration seen
+
+    def visit(self, src, report) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind, name = _registration(node)
+            if not kind:
+                continue
+            self.total_sites += 1
+            line, col = node.lineno, node.col_offset
+            where = (src.relpath, line)
+
+            def emit(rule_id: str, msg: str) -> None:
+                report(Finding(rule_id, src.relpath, line, col, msg))
+
+            if not NAME_PATTERN.match(name):
+                emit("telemetry-name",
+                     f"{kind} {name!r} does not match "
+                     "dl4j_tpu_<subsystem>_<name> (lower-snake, at "
+                     "least one subsystem segment)")
+                continue
+            self.sites.setdefault(name, []).append(where)
+            if kind == "counter" and not name.endswith("_total"):
+                emit("telemetry-counter-total",
+                     f"counter {name!r} must end in '_total' "
+                     "(Prometheus rate()/increase() assume it)")
+            if kind in ("gauge", "histogram") and name.endswith("_total"):
+                emit("telemetry-unit",
+                     f"{kind} {name!r} must not end in '_total' "
+                     "(reserved for counters — a gauge named like a "
+                     "counter lies to every recording rule)")
+            if kind == "histogram" and not name.endswith(_HIST_UNITS):
+                emit("telemetry-unit",
+                     f"histogram {name!r} must carry a base-unit suffix "
+                     "(_seconds/_bytes/_examples)")
+            if kind == "histogram" and name.endswith("_seconds") and \
+                    not any(kw.arg == "buckets" for kw in node.keywords):
+                emit("telemetry-buckets",
+                     f"histogram {name!r} must declare its buckets "
+                     "(buckets=...) — latency quantiles are read off "
+                     "the bucket bounds, so the choice must be explicit "
+                     "at the registration site")
+            if "bytes" in name:
+                if kind == "counter" and not name.endswith("_bytes_total"):
+                    emit("telemetry-unit",
+                         f"byte counter {name!r} must end in "
+                         "'_bytes_total' (base unit + counter "
+                         "convention)")
+                if kind == "gauge" and not name.endswith("_bytes"):
+                    emit("telemetry-unit",
+                         f"byte gauge {name!r} must end in '_bytes'")
+            help_node = _help_arg(node)
+            if help_node is None or isinstance(
+                    help_node, (ast.Tuple, ast.List, ast.Set, ast.Dict)):
+                # a positional tuple/list where help belongs is a
+                # labelnames/buckets value skipping help, not an
+                # unverifiable expression (the regex linter flagged it
+                # too — the re-base must not loosen this)
+                emit("telemetry-help",
+                     f"{kind} {name!r} registered without a help string "
+                     "(# HELP is the only documentation a scrape "
+                     "carries)")
+            elif isinstance(help_node, ast.Constant):
+                if not (isinstance(help_node.value, str) and
+                        help_node.value.strip()):
+                    emit("telemetry-help",
+                         f"{kind} {name!r} has an EMPTY help string")
+            elif isinstance(help_node, ast.JoinedStr) and \
+                    not help_node.values:
+                emit("telemetry-help",
+                     f"{kind} {name!r} has an EMPTY help string")
+            # any other expression (a variable, a call) can't be
+            # verified statically and is accepted — same contract as
+            # the regex linter
+
+    def collect_stats(self) -> Dict[str, int]:
+        return {"telemetry_sites": self.total_sites}
+
+    def finalize(self, report) -> None:
+        for name, sites in sorted(self.sites.items()):
+            modules = sorted({p for p, _l in sites})
+            if len(modules) < 2:
+                continue
+            listing = ", ".join(modules)
+            for path, line in sorted(sites):
+                report(Finding(
+                    "telemetry-dup-module", path, line, 0,
+                    f"{name!r} is registered from {len(modules)} "
+                    f"modules ({listing}) — registrations drift; move "
+                    "the shared metric to one module both import"))
+
+

@@ -1,0 +1,168 @@
+"""Thread-lifecycle rules.
+
+A background thread the repo has already been bitten by twice (the
+PR 8 ``ParallelInference.shutdown`` race; the PR 5 watchdog/export
+threads) has exactly two safe shapes:
+
+- ``thread-daemon`` — every ``threading.Thread(...)`` construction
+  declares ``daemon=`` explicitly (or sets ``t.daemon = ...`` before
+  ``start()`` in the same function).  The default is inherited from the
+  *creating* thread, so an undeclared thread created from a worker can
+  silently become non-daemon and wedge interpreter shutdown — the
+  decision must be visible at the construction site.
+- ``thread-join`` — a thread stored on ``self`` is an owned resource:
+  some method of the owning class must ``join()`` it (its stop/
+  shutdown/close path).  A stored-but-never-joined thread means the
+  owner's teardown returns while the thread still runs — the shape of
+  every "test hangs at exit / metrics written after shutdown" bug.
+  Fire-and-forget daemon threads (not stored anywhere) are accepted:
+  they declare, via ``daemon=True`` + anonymity, that nobody owns their
+  lifetime.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.jaxlint.core import (Finding, Rule, dotted, register_rule,
+                                walk_shallow)
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    return dotted(node.func) in ("threading.Thread", "Thread")
+
+
+def _has_daemon_kwarg(node: ast.Call) -> bool:
+    return any(kw.arg == "daemon" for kw in node.keywords)
+
+
+@register_rule
+class ThreadDaemonRule(Rule):
+    id = "thread-daemon"
+    summary = ("threading.Thread constructed without an explicit "
+               "daemon= declaration")
+
+    def visit(self, src, report) -> None:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Module))):
+                continue
+            # per-scope: collect ctor sites and `X.daemon = ...` fixups
+            ctors: List[Tuple[ast.Call, Optional[str]]] = []
+            daemon_set: Set[str] = set()
+            for sub in walk_shallow(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                t.attr == "daemon":
+                            name = dotted(t.value)
+                            if name:
+                                daemon_set.add(name)
+                for call in ast.walk(sub) if isinstance(
+                        sub, (ast.Assign, ast.Expr, ast.Return)) else ():
+                    if isinstance(call, ast.Call) and \
+                            _is_thread_ctor(call) and \
+                            not _has_daemon_kwarg(call):
+                        target = None
+                        if isinstance(sub, ast.Assign) and \
+                                len(sub.targets) == 1:
+                            target = dotted(sub.targets[0])
+                        ctors.append((call, target))
+            for call, target in ctors:
+                if target and target in daemon_set:
+                    continue
+                report(Finding(
+                    self.id, src.relpath, call.lineno, call.col_offset,
+                    "threading.Thread(...) without an explicit daemon= "
+                    "— the default inherits from the CREATING thread, "
+                    "so whether this thread can wedge interpreter "
+                    "shutdown depends on who called; declare daemon= at "
+                    "the construction site"))
+
+
+@register_rule
+class ThreadJoinRule(Rule):
+    id = "thread-join"
+    summary = ("thread stored on self is never joined by any method of "
+               "the owning class")
+
+    def visit(self, src, report) -> None:
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._check_class(src, node, report)
+
+    def _check_class(self, src, cls: ast.ClassDef, report) -> None:
+        # attr -> creation site(s) of threads stored on self
+        stored: Dict[str, List[ast.Call]] = {}
+        joined: Set[str] = set()
+        for fn in [n for n in ast.walk(cls)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            aliases: Dict[str, str] = {}    # local name -> self attr
+            appended: Dict[str, str] = {}   # local thread var -> list attr
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    v = sub.value
+                    tname = dotted(t)
+                    # self._x = threading.Thread(...)
+                    if tname.startswith("self.") and \
+                            isinstance(v, ast.Call) and _is_thread_ctor(v):
+                        stored.setdefault(tname[5:], []).append(v)
+                    # local = threading.Thread(...)
+                    elif isinstance(t, ast.Name) and \
+                            isinstance(v, ast.Call) and _is_thread_ctor(v):
+                        appended.setdefault(t.id, "")
+                    # worker = self._worker (join-through-alias idiom)
+                    elif isinstance(t, ast.Name) and \
+                            dotted(v).startswith("self."):
+                        aliases[t.id] = dotted(v)[5:]
+                # self._threads.append(th) / .append(Thread(...))
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "append" and \
+                        dotted(sub.func.value).startswith("self.") and \
+                        sub.args:
+                    arg = sub.args[0]
+                    attr = dotted(sub.func.value)[5:]
+                    if isinstance(arg, ast.Call) and _is_thread_ctor(arg):
+                        stored.setdefault(attr, []).append(arg)
+                    elif isinstance(arg, ast.Name) and \
+                            arg.id in appended:
+                        appended[arg.id] = attr
+                        # creation site: find the ctor assigned earlier
+                # iteration alias: for t in self._threads: t.join()
+                if isinstance(sub, (ast.For, ast.AsyncFor)) and \
+                        isinstance(sub.target, ast.Name) and \
+                        dotted(sub.iter).startswith("self."):
+                    aliases[sub.target.id] = dotted(sub.iter)[5:]
+                # joins
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "join":
+                    base = dotted(sub.func.value)
+                    if base.startswith("self."):
+                        joined.add(base[5:])
+                    elif base in aliases:
+                        joined.add(aliases[base])
+            # locals appended into self lists count as stored on that list
+            for local, attr in appended.items():
+                if attr:
+                    for sub in ast.walk(fn):
+                        if isinstance(sub, ast.Assign) and \
+                                len(sub.targets) == 1 and \
+                                isinstance(sub.targets[0], ast.Name) and \
+                                sub.targets[0].id == local and \
+                                isinstance(sub.value, ast.Call) and \
+                                _is_thread_ctor(sub.value):
+                            stored.setdefault(attr, []).append(sub.value)
+        for attr, sites in sorted(stored.items()):
+            if attr in joined:
+                continue
+            for site in sites:
+                report(Finding(
+                    self.id, src.relpath, site.lineno, site.col_offset,
+                    f"thread stored on self.{attr} is never joined by "
+                    f"any method of {cls.name} — the owning object's "
+                    "stop/shutdown path must join it (or the teardown "
+                    "returns while the thread still runs)"))

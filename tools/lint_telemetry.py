@@ -1,149 +1,82 @@
 #!/usr/bin/env python
-"""Lint the telemetry metric namespace.
+"""Lint the telemetry metric namespace (jaxlint front-end).
 
-Scans every registry registration call in ``deeplearning4j_tpu/`` —
-``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` — and
-fails unless each public metric name follows the naming convention:
+Historically this was a standalone regex scanner that re-read every
+file on its own; the rule set now lives in the shared jaxlint framework
+(``tools/jaxlint/rules_telemetry.py``) where the telemetry checks share
+ONE parse per file with the retrace/host-sync/lock/thread analyzers and
+the common ``# jaxlint: disable=<rule> -- <reason>`` suppression syntax.
+This entry point remains for operators and scripts that invoke the
+telemetry lint by name; ``tools/check_markers.py`` runs the full jaxlint
+rule set (telemetry rules included) ahead of tier-1.
 
-- ``dl4j_tpu_<subsystem>_<name>`` (lower-snake, at least one subsystem
-  segment between the prefix and the name);
-- counters end in ``_total`` (Prometheus counter convention: rate() and
-  increase() assume it);
-- gauges and histograms do NOT end in ``_total`` (a gauge named like a
-  counter lies to every recording rule that touches it);
-- histograms measuring time end in ``_seconds`` (base-unit rule);
-- ``*_seconds`` histograms DECLARE their buckets (``buckets=`` in the
-  registration call): latency quantiles are read off the bucket bounds,
-  so an implicit default silently decides every p99 the dashboards and
-  the serving tier's admission control see — the choice must be visible
-  (and reviewable) at the registration site;
-- every registration carries a NON-EMPTY help string (a bare name on a
-  federated dashboard three hops from the code is unreadable; ``# HELP``
-  is the only documentation a scrape carries);
-- a metric name is registered from ONE module only (two modules
-  registering the same name will eventually drift in help/labels/type,
-  and the second registration's intent silently loses — the shared
-  metric belongs in a common module both import).
+The enforced conventions are unchanged — none were loosened in the
+re-base (each is a jaxlint rule id, individually suppressible WITH a
+reason):
 
-A drifting metric name is an outage for every dashboard/alert built on
-the old one — this lint makes the convention a CI property, not a review
-nitpick.  Run: ``python tools/lint_telemetry.py`` (invoked by
-``tools/check_markers.py``, so it gates tier-1).
+- ``telemetry-name``          ``dl4j_tpu_<subsystem>_<name>`` lower-snake;
+- ``telemetry-counter-total`` counters end in ``_total``;
+- ``telemetry-unit``          gauges/histograms must NOT end ``_total``;
+                              histograms carry a base-unit suffix
+                              (``_seconds``/``_bytes``/``_examples``);
+                              byte series use ``_bytes_total``/``_bytes``;
+- ``telemetry-buckets``       ``*_seconds`` histograms declare buckets=;
+- ``telemetry-help``          every registration carries non-empty help;
+- ``telemetry-dup-module``    a metric name registers from ONE module.
+
+Run: ``python tools/lint_telemetry.py [pkg_dir]``.
 """
-import re
 import sys
-from collections import defaultdict
 from pathlib import Path
 
-NAME_PATTERN = re.compile(r"^dl4j_tpu_[a-z][a-z0-9]*(_[a-z0-9]+)+$")
-CALL_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
-# the name argument's terminator: nothing after it (no help at all) is a
-# hard error; a string literal (optionally help=/f-prefixed) is checked
-# for a non-empty FIRST fragment (implicit concatenation may continue it
-# across lines); any other expression (a variable, a call) can't be
-# verified statically and is accepted
-NO_HELP_RE = re.compile(
-    r"\s*(,?\s*\)"                                  # ) or trailing-comma )
-    r"|,\s*(labelnames|buckets|maxLabelSets)\s*="   # help skipped by kwarg
-    r"|,\s*[(\[])")                                 # positional tuple/list
-HELP_LITERAL_RE = re.compile(
-    r"\s*,\s*(?:help\s*=\s*)?[frbuFRBU]{0,2}[\"'](?P<first>[^\"']*)[\"']")
-BUCKETS_KWARG_RE = re.compile(r"\bbuckets\s*=")
+_REPO = Path(__file__).resolve().parent.parent
+
+TELEMETRY_RULES = ("telemetry-name", "telemetry-counter-total",
+                   "telemetry-unit", "telemetry-buckets", "telemetry-help",
+                   "telemetry-dup-module")
 
 
-def _call_span(text: str, open_paren: int) -> str:
-    """The argument text of the call whose ``(`` sits at ``open_paren``
-    (balanced-paren scan; string contents may miscount parens, which at
-    worst makes the span longer — never shorter than the real call)."""
-    depth = 0
-    for i in range(open_paren, len(text)):
-        if text[i] == "(":
-            depth += 1
-        elif text[i] == ")":
-            depth -= 1
-            if depth == 0:
-                return text[open_paren:i + 1]
-    return text[open_paren:]
-
-
-def lint(pkg_dir: Path):
-    errors = []
-    sites_by_name = defaultdict(set)
-    for path in sorted(pkg_dir.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for m in CALL_RE.finditer(text):
-            kind, name = m.group(1), m.group(2)
-            line = text.count("\n", 0, m.start()) + 1
-            where = f"{path}:{line}"
-            if not NAME_PATTERN.match(name):
-                errors.append(
-                    f"{where}: {kind} {name!r} does not match "
-                    "dl4j_tpu_<subsystem>_<name> (lower-snake)")
+def lint(pkg_dir):
+    """Historical API: error strings for ``pkg_dir``, file order, one
+    error per cross-module duplicate NAME (tests and scripts call this
+    directly).  No baseline — the telemetry namespace has none."""
+    sys.path.insert(0, str(_REPO))
+    try:
+        from tools.jaxlint import Linter
+    finally:
+        sys.path.pop(0)
+    result = Linter(_REPO, rules=list(TELEMETRY_RULES)).run(
+        [Path(pkg_dir)])
+    errors, seen_dups = [], set()
+    for f in result.findings:
+        if f.rule == "telemetry-dup-module":
+            # per-site findings in jaxlint; ONE name-level error here
+            if f.message in seen_dups:
                 continue
-            sites_by_name[name].add(path)
-            if kind == "counter" and not name.endswith("_total"):
-                errors.append(
-                    f"{where}: counter {name!r} must end in '_total'")
-            if kind in ("gauge", "histogram") and name.endswith("_total"):
-                errors.append(
-                    f"{where}: {kind} {name!r} must not end in '_total' "
-                    "(reserved for counters)")
-            if kind == "histogram" and not name.endswith(
-                    ("_seconds", "_bytes", "_examples")):
-                errors.append(
-                    f"{where}: histogram {name!r} must carry a base-unit "
-                    "suffix (_seconds/_bytes/_examples)")
-            if kind == "histogram" and name.endswith("_seconds"):
-                span = _call_span(text,
-                                  m.start() + m.group(0).index("("))
-                if not BUCKETS_KWARG_RE.search(span):
-                    errors.append(
-                        f"{where}: histogram {name!r} must declare its "
-                        "buckets (buckets=...) — latency quantiles are "
-                        "read off the bucket bounds, so the choice must "
-                        "be explicit at the registration site")
-            if "bytes" in name:
-                # byte-unit rule (the ETL H2D series): rate() over a
-                # mis-suffixed byte metric silently reports garbage MB/s
-                if kind == "counter" and not name.endswith("_bytes_total"):
-                    errors.append(
-                        f"{where}: byte counter {name!r} must end in "
-                        "'_bytes_total' (base unit + counter convention)")
-                if kind == "gauge" and not name.endswith("_bytes"):
-                    errors.append(
-                        f"{where}: byte gauge {name!r} must end in "
-                        "'_bytes'")
-            hm = HELP_LITERAL_RE.match(text, m.end())
-            if NO_HELP_RE.match(text, m.end()):
-                errors.append(
-                    f"{where}: {kind} {name!r} registered without a help "
-                    "string (# HELP is the only documentation a scrape "
-                    "carries)")
-            elif hm is not None and not hm.group("first").strip():
-                errors.append(
-                    f"{where}: {kind} {name!r} has an EMPTY help string")
-    for name, paths in sorted(sites_by_name.items()):
-        if len(paths) > 1:
-            listing = ", ".join(str(p) for p in sorted(paths))
-            errors.append(
-                f"{name}: registered from {len(paths)} modules "
-                f"({listing}) — registrations drift; move the shared "
-                "metric to one module both import")
+            seen_dups.add(f.message)
+        errors.append(f"{f.location()}: {f.message}")
     return errors
 
 
 def main(argv) -> int:
+    sys.path.insert(0, str(_REPO))
+    try:
+        from tools.jaxlint import run
+    finally:
+        sys.path.pop(0)
     pkg_dir = Path(argv[1]) if len(argv) > 1 else \
-        Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
-    errors = lint(pkg_dir)
-    if errors:
-        for e in errors:
-            print(e, file=sys.stderr)
+        _REPO / "deeplearning4j_tpu"
+    result = run(paths=[pkg_dir], rules=list(TELEMETRY_RULES))
+    if result.findings:
+        for f in result.findings:
+            print(f"{f.location()}: {f.rule}: {f.message}",
+                  file=sys.stderr)
         return 1
-    n = sum(len(CALL_RE.findall(p.read_text(encoding="utf-8")))
-            for p in pkg_dir.rglob("*.py"))
-    print(f"lint_telemetry: OK ({n} metric registration sites)")
+    # site count mirrors the historical OK line (and proves the walk
+    # actually saw the registrations it is vouching for)
+    n = result.stats.get("telemetry_sites", 0)
+    print(f"lint_telemetry: OK ({n} metric registration sites, "
+          f"{len(TELEMETRY_RULES)} jaxlint rules)")
     return 0
 
 
