@@ -346,6 +346,138 @@ def bench_streaming(workers: int = 4, batch: int = 64, img: int = 96,
     }
 
 
+def _reexec_cpu_mesh(devices: int = 8) -> None:
+    """``--mesh`` is the CPU-proxy sweep: it NEEDS ``devices`` virtual
+    XLA host devices, which must be configured before jax initializes.
+    If the env isn't set (or jax already claimed another platform),
+    re-exec this script with the proxy env and relay the child's JSON.
+    On a driver that exports the flags itself this is a no-op."""
+    import subprocess
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={devices}"
+    if os.environ.get("_DL4J_MESH_CHILD") != "1" and (
+            "xla_force_host_platform_device_count" not in flags
+            or os.environ.get("JAX_PLATFORMS") != "cpu"
+            or "jax" in sys.modules):
+        env = dict(os.environ,
+                   XLA_FLAGS=(flags + " " + want).strip(),
+                   JAX_PLATFORMS="cpu", _DL4J_MESH_CHILD="1")
+        raise SystemExit(subprocess.call(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env))
+
+
+def bench_mesh(steps: int = 12, batch: int = 64, width: int = 512,
+               depth: int = 4, classes: int = 16) -> dict:
+    """Mesh-config sweep (ISSUE 10 acceptance): MFU + images/sec for the
+    SAME model stepped through the unified ``MeshTrainer`` path under
+    pure DP, DP x TP, and DP + ZeRO-1 ShardingPlans, on the
+    ``xla_force_host_platform_device_count=8`` CPU proxy (the r06
+    driver capture re-runs it on the real chip).
+
+    Every config steps through ``ParallelWrapper.fitDataSet`` — the
+    facade-over-MeshTrainer path the fault supervisor drives — and the
+    steady-state discipline is measured, not assumed:
+    ``jit_cache_misses_steady`` must be 0 after the first step.  MFU
+    uses an analytic dense-MLP flop count (3x fwd 2*MAC) against the
+    v5e bf16 nominal peak for JSON-shape parity with the other bench
+    modes; on the CPU proxy the absolute value is meaningless and the
+    images/sec RATIOS between configs are the signal.
+    """
+    import jax
+
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (InputType,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import (DeviceMesh, ParallelWrapper,
+                                             ZeroStage1)
+    from deeplearning4j_tpu.telemetry import get_registry
+
+    n_dev = len(jax.devices())
+
+    def build_net():
+        b = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+             .list()
+             .layer(DenseLayer.builder().nIn(width).nOut(width)
+                    .activation("relu").build()))
+        for _ in range(depth - 1):
+            b.layer(DenseLayer.builder().nOut(width).activation("relu")
+                    .build())
+        b.layer(OutputLayer.builder("mcxent").nOut(classes)
+                .activation("softmax").build())
+        return MultiLayerNetwork(
+            b.setInputType(InputType.feedForward(width)).build()).init()
+
+    # fwd 2*MAC flops of the dense stack; train ~= 3x forward
+    mlp_flops = 2 * (width * width * depth + width * classes)
+    flops_per_image = 3 * mlp_flops
+
+    rng = np.random.RandomState(0)
+    pool = [DataSet(rng.randn(batch, width).astype(np.float32),
+                    np.eye(classes, dtype=np.float32)[
+                        rng.randint(0, classes, batch)])
+            for _ in range(2)]
+
+    configs = [
+        ("dp", dict(data=n_dev), False, False),
+        ("dp_tp", dict(data=n_dev // 2, model=2), True, False),
+        ("dp_zero1", dict(data=n_dev), False, True),
+    ]
+    reg = get_registry()
+
+    def misses():
+        c = reg.get("dl4j_tpu_mesh_jit_cache_misses_total")
+        return c.value() if c is not None else 0.0
+
+    results = []
+    for name, axes, tp, zero in configs:
+        net = build_net()
+        mesh = DeviceMesh(**axes)
+        if zero:
+            ZeroStage1(mesh).apply(net)
+        pw = ParallelWrapper(net, mesh=mesh, tensorParallel=tp)
+        pw.fitDataSet(pool[0])      # compile
+        pw.fitDataSet(pool[1])      # warm both staged batches
+        net.score()
+        m0 = misses()
+        t0 = time.perf_counter()
+        for i in range(steps):
+            pw.fitDataSet(pool[i % len(pool)])
+        net.score()                 # forces the donated-param chain
+        dt = time.perf_counter() - t0
+        ips = batch * steps / dt
+        results.append({
+            "config": name,
+            "mesh": {k: int(v) for k, v in axes.items()},
+            "images_per_sec": round(ips, 1),
+            "step_ms": round(dt / steps * 1e3, 3),
+            # aggregate throughput over ALL mesh devices vs aggregate
+            # peak (n_dev chips) — comparable to the per-chip numbers
+            # the other bench modes report
+            "mfu": round(ips * flops_per_image
+                         / (_V5E_PEAK_FLOPS * n_dev), 6),
+            "jit_cache_misses_steady": int(misses() - m0),
+        })
+
+    best = max(results, key=lambda r: r["images_per_sec"])
+    return {
+        "metric": "mesh_train_images_per_sec",
+        "value": best["images_per_sec"],
+        "unit": "images/sec",
+        "best_config": best["config"],
+        "devices": n_dev,
+        "batch": batch,
+        "width": width,
+        "depth": depth,
+        "steps": steps,
+        "cpu_proxy": jax.default_backend() == "cpu",
+        "configs": results,
+    }
+
+
 def bench_serving(clients: int = 8, duration: float = 4.0,
                   warmup: float = 1.0, nIn: int = 32,
                   decodeTokens: int = 48) -> dict:
@@ -505,6 +637,14 @@ def bench_serving(clients: int = 8, duration: float = 4.0,
 
 
 def main() -> None:
+    if "--mesh" in sys.argv:
+        _reexec_cpu_mesh(8)
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        steps = int(args[0]) if args else 12
+        batch = int(args[1]) if len(args) > 1 else 64
+        print(json.dumps(bench_mesh(steps, batch)))
+        return
+
     import jax
 
     from deeplearning4j_tpu.datasets import DataSet

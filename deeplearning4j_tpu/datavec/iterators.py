@@ -219,13 +219,23 @@ class AsyncDataSetIterator(DataSetIterator):
 
     _END = object()
 
-    def __init__(self, wrapped: DataSetIterator, queueSize: int = 4):
+    def __init__(self, wrapped: DataSetIterator, queueSize: int = 4,
+                 device=None):
         self.wrapped = wrapped
         self.queueSize = queueSize
+        self._device = device
         self._q: queue.Queue = queue.Queue(maxsize=queueSize)
         self._thread: Optional[threading.Thread] = None
         self._peek = None
         self._start()
+
+    def setDevice(self, device) -> None:
+        """Route the prefetch H2D through ``device`` — a Device or a
+        MeshTrainer plan's batch NamedSharding, so sharded inputs land
+        directly on their mesh shards instead of replicated-then-
+        resharded (the producer thread reads this live; set it before
+        or between fits)."""
+        self._device = device
 
     def _start(self) -> None:
         self._q = queue.Queue(maxsize=self.queueSize)
@@ -306,6 +316,16 @@ class AsyncDataSetIterator(DataSetIterator):
                 from deeplearning4j_tpu.telemetry import note_etl_wait
                 em.prefetch_wait().set(wait)
                 note_etl_wait(wait, self)
+                if self._device is not None:
+                    # issue the async device_put as soon as the peek
+                    # exists: the transfer overlaps the caller's current
+                    # step, and staging HERE (not in the producer) keeps
+                    # at most ONE batch in flight on device — the
+                    # bounded-ring discipline of the pool path, not
+                    # queueSize batches of HBM
+                    from deeplearning4j_tpu.datavec.pipeline import \
+                        stage_batch
+                    self._peek = stage_batch(self._peek, self._device)
         if isinstance(self._peek, BaseException):
             exc = self._peek
             self._peek = None
@@ -317,6 +337,8 @@ class AsyncDataSetIterator(DataSetIterator):
             raise StopIteration
         ds = self._peek
         self._peek = None
+        if hasattr(ds, "materialize"):      # staged H2D (see setDevice)
+            ds = ds.materialize()
         return ds
 
     def reset(self) -> None:

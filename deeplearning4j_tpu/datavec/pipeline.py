@@ -66,7 +66,7 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 log = logging.getLogger(__name__)
 
 __all__ = ["ShardSpec", "PrefetchingDataSetIterator", "ProducerWorkerError",
-           "maybe_prefetch", "default_host_spec"]
+           "maybe_prefetch", "default_host_spec", "stage_batch"]
 
 _FIELDS = ("features", "labels", "featuresMask", "labelsMask")
 
@@ -263,12 +263,22 @@ def _worker_main(sourceBlob: bytes, spec: ShardSpec, shmNames, shmBytes: int,
 # ------------------------------------------------------------ H2D ring ----
 
 def _device_put(a, device):
+    """``device`` may be a Device OR a Sharding — a MeshTrainer plan's
+    batch NamedSharding routes here so sharded inputs land DIRECTLY on
+    their mesh shards instead of replicated-then-resharded inside the
+    step.  A batch the sharding rejects (ragged tail not divisible by
+    the data axis) falls back to default placement — the step's own
+    ``_place_batch`` handles it the same way."""
     if a is None:
         return None
     try:
         import jax
-        return jax.device_put(a, device) if device is not None \
-            else jax.device_put(a)
+        if device is None:
+            return jax.device_put(a)
+        try:
+            return jax.device_put(a, device)
+        except ValueError:
+            return jax.device_put(a)
     except Exception:
         return a        # no backend: hand the host array through
 
@@ -307,6 +317,20 @@ class _StagedBatch:
             # jaxlint: disable=host-sync -- nbytes is a Python int, not a device scalar
             args={"bytes": int(self.nbytes)})
         return DataSet(*self.dev)
+
+
+def stage_batch(ds, device) -> _StagedBatch:
+    """Stage a DataSet's arrays onto ``device`` (a Device or a mesh
+    batch Sharding) asynchronously; ``.materialize()`` later returns the
+    on-device DataSet after the completion fence.  Used by
+    ``AsyncDataSetIterator`` so its thread-prefetch path gets the same
+    direct-to-shard H2D routing as the producer pool."""
+    fields = []
+    for name in _FIELDS:
+        a = getattr(ds, name, None)
+        fields.append(None if a is None
+                      else (a.jax if hasattr(a, "jax") else a))
+    return _StagedBatch(fields, device)
 
 
 # ------------------------------------------------------------- consumer ----

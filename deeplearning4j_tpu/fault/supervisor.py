@@ -159,6 +159,13 @@ class FaultTolerantTrainer:
         return float(getattr(self.net, "_lrScale", 1.0))
 
     def _checkpoint(self, stepInEpoch: int) -> None:
+        # mesh-trainer sync hook: a stage (GPipe) mesh keeps its live
+        # weights in stacked per-stage rows — flush them into the net's
+        # trees so the checkpoint captures the real training state (free
+        # no-op for every other mesh shape)
+        sync = getattr(self.wrapper, "syncToNet", None)
+        if sync is not None:
+            sync()
         with tracer().span("checkpoint", step=self.net.iterationCount):
             step = self.ckpt.saveWithManifest(
                 self.net, metadata={"stepInEpoch": int(stepInEpoch),
@@ -186,6 +193,12 @@ class FaultTolerantTrainer:
         t0 = time.perf_counter()
         with tracer().span("checkpoint_restore", step=step):
             self.ckpt.restore(self.net, step=step)
+            # mesh-trainer hook: restored arrays land on one device —
+            # re-assert the ShardingPlan placement (stage meshes restack
+            # their GPipe rows) before the next supervised step
+            place = getattr(self.wrapper, "placeAfterRestore", None)
+            if place is not None:
+                place()
         reg.histogram("dl4j_tpu_fault_restore_seconds",
                       "Checkpoint restore latency",
                       buckets=DEFAULT_BUCKETS).observe(
@@ -206,15 +219,24 @@ class FaultTolerantTrainer:
         # scheduling-dependently.  One worker still moves decode off the
         # training process and keeps the async H2D staging ring.
         from deeplearning4j_tpu.datavec.pipeline import maybe_prefetch
-        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
         src = iterator
+        # prefetch H2D routes through the wrapper's ShardingPlan batch
+        # sharding (when there is one) so supervised sharded inputs land
+        # directly on their mesh shards, same as ParallelWrapper.fit
+        device = None
+        mesh = getattr(self.wrapper, "mesh", None)
+        if mesh is not None and mesh.dataSize > 1 and \
+                mesh.stageSize == 1 and hasattr(self.wrapper, "trainer"):
+            device = self.wrapper.trainer().plan.batch_sharding()
         iterator = maybe_prefetch(
             iterator, numWorkers=1,
             # host sharding only makes sense when the supervised model
             # all-reduces across hosts (the ParallelWrapper /
             # SharedTrainingMaster cluster path); a bare net must see
-            # the full stream on every process
-            hostShard=isinstance(self.net, ParallelWrapper))
+            # the full stream on every process.  self.net is the
+            # UNWRAPPED model, so the wrapper handle is the signal.
+            hostShard=self.wrapper is not None,
+            device=device)
         owns_monitor = (self.healthMonitor is not None and
                         not self.healthMonitor.is_running())
         if owns_monitor:

@@ -23,11 +23,13 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.learning.config import Sgd
 from deeplearning4j_tpu.learning.regularization import WeightDecay
-from deeplearning4j_tpu.models.multilayer import (_apply_updates, _get_leaf,
+from deeplearning4j_tpu.models.multilayer import (_apply_updates,
+                                                  _constrain_act, _get_leaf,
                                                   _grad_normalize,
                                                   _iter_leaf_params,
                                                   _param_key_order,
                                                   _place_batch_with,
+                                                  _ravel_replicated,
                                                   _reg_penalty, _set_leaf,
                                                   _updater_for)
 from deeplearning4j_tpu.models.graph_conf import ComputationGraphConfiguration
@@ -175,9 +177,9 @@ class ComputationGraph:
                                           lkey, state.get(name, {}))
                 if st2:
                     new_state[name] = st2
-                acts[name] = y
+                acts[name] = _constrain_act(y)
             else:
-                acts[name] = node.forward(*xs)
+                acts[name] = _constrain_act(node.forward(*xs))
             ot = out_types.get(name)
             if m is not None and (ot is None or ot.kind == "RNN"):
                 mmap[name] = m
@@ -217,7 +219,16 @@ class ComputationGraph:
         total = self._sumLosses(acts, labels, masks)
         reg = _reg_penalty((self.conf.nodes[name][0], lp)
                            for name, lp in params.items())
-        return total + reg, (new_state, total, new_carries)
+        # layer-state aux channel (MoE Switch load balancing) — same
+        # contract as MultiLayerNetwork._auxLoss, or a graph-hosted MoE
+        # router would silently collapse onto one expert
+        aux = 0.0
+        for name in self.conf.topoOrder:
+            if getattr(self.conf.nodes[name][0], "hasAuxLoss", False):
+                st = new_state.get(name)
+                if st and "auxLoss" in st:
+                    aux = aux + st["auxLoss"]
+        return total + reg + aux, (new_state, total, new_carries)
 
     def _runSolverStep(self, inputs, labels, masks, fmask,
                        algo: str) -> None:
@@ -250,7 +261,11 @@ class ComputationGraph:
         self._scoreArr = None
 
     @functools.cached_property
-    def _trainStep(self):
+    def _stepFn(self):
+        """Raw fused train step (see MultiLayerNetwork._stepFn): jitted
+        plain by ``_trainStep``, or with a ShardingPlan's in/out
+        shardings by ``parallel.meshtrainer.MeshTrainer`` — one stepping
+        path for every mesh shape."""
         def step(params, optState, state, inputs, labels, masks, key,
                  iteration, epoch, fmask, carries, lrScale):
             grad_fn = jax.value_and_grad(self._lossFn, has_aux=True)
@@ -262,7 +277,11 @@ class ComputationGraph:
                 epoch, lrScale=lrScale)
             return new_params, new_opt, new_state, loss, new_carries
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    @functools.cached_property
+    def _trainStep(self):
+        return jax.jit(self._stepFn, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _outputFn(self):
@@ -278,7 +297,20 @@ class ComputationGraph:
         return jax.jit(run)
 
     # ------------------------------------------------------------------
+    def _ensure_trace_mesh(self) -> None:
+        """Drop executables compiled under a MeshTrainer plan when this
+        graph is used OUTSIDE any mesh (see MultiLayerNetwork's
+        _ensure_trace_mesh — the sharding constraints are baked into the
+        trace)."""
+        from deeplearning4j_tpu.parallel.mesh import active_mesh
+        if getattr(self, "_meshTrace", None) is not None \
+                and active_mesh() is None:
+            for k in ("_trainStep", "_outputFn", "_scoreFn"):
+                self.__dict__.pop(k, None)
+            self._meshTrace = None
+
     def fit(self, data, labels=None, epochs: int = 1) -> None:
+        self._ensure_trace_mesh()
         if self.params_ is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -404,6 +436,7 @@ class ComputationGraph:
         return out or None
 
     def output(self, *inputs, featuresMask=None):
+        self._ensure_trace_mesh()
         xs = tuple((x.jax if isinstance(x, NDArray) else jnp.asarray(x))
                    .astype(self._dtype) for x in inputs)
         fm = None
@@ -543,22 +576,27 @@ class ComputationGraph:
             self._listeners.remove(listener)
 
     def params(self) -> NDArray:
+        """Flattened param vector as a DEVICE-RESIDENT view (one
+        jnp.concatenate, no host sync — see MultiLayerNetwork.params)."""
         chunks = []
         for name in self.conf.topoOrder:
             if name in (self.params_ or {}):
                 for _path, _pname, v in _iter_leaf_params(self.params_[name]):
-                    chunks.append(np.asarray(v).ravel())
-        return NDArray(np.concatenate(chunks) if chunks else np.zeros(0))
+                    chunks.append(_ravel_replicated(v))
+        return NDArray(jnp.concatenate(chunks) if chunks
+                       else jnp.zeros((0,)))
 
     def setParams(self, flat) -> None:
-        vec = np.asarray(flat.numpy() if isinstance(flat, NDArray) else flat).ravel()
+        vec = jnp.ravel(flat.jax if isinstance(flat, NDArray)
+                        else jnp.asarray(flat))
         pos = 0
         for name in self.conf.topoOrder:
             if name in self.params_:
                 for path, _pname, cur in _iter_leaf_params(self.params_[name]):
                     n = int(np.prod(cur.shape))
-                    _set_leaf(self.params_[name], path, jnp.asarray(
-                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype))
+                    _set_leaf(self.params_[name], path,
+                              vec[pos:pos + n].reshape(cur.shape)
+                              .astype(cur.dtype))
                     pos += n
 
     def numParams(self) -> int:

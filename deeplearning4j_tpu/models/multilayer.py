@@ -72,6 +72,32 @@ def _iter_leaf_params(lp: Dict, prefix: str = ""):
             yield prefix + k, k, v
 
 
+def _ravel_replicated(v):
+    """Device-resident 1D view of a param leaf for the flat-vector API.
+
+    Mesh-sharded leaves reshard to replicated FIRST: the flat vector is
+    a logical (unsharded) object, and eager ``jnp.concatenate`` over
+    mixed-sharded inputs miscompiles on some backends (observed on the
+    CPU host-platform mesh: stride-pattern garbage).  The reshard is an
+    on-device all-gather, not a host sync."""
+    sh = getattr(v, "sharding", None)
+    if sh is not None and hasattr(sh, "spec") and \
+            not sh.is_fully_replicated:
+        from jax.sharding import NamedSharding, PartitionSpec
+        v = jax.device_put(v, NamedSharding(sh.mesh, PartitionSpec()))
+    return jnp.ravel(v)
+
+
+def _constrain_act(x):
+    """Anchor an activation's layout when a MeshTrainer plan is active
+    (trace-time, like ``mesh.active_mesh``): ``with_sharding_constraint``
+    pins the batch dim over the data axis so GSPMD keeps one layout
+    between layers instead of re-deriving it per op."""
+    from deeplearning4j_tpu.parallel.meshtrainer import active_plan
+    plan = active_plan()
+    return x if plan is None else plan.constrain(x)
+
+
 def _get_leaf(d: Dict, path: str):
     for p in path.split("/"):
         d = d[p]
@@ -352,6 +378,7 @@ class MultiLayerNetwork:
                 x, st2 = layer.forward(p, x, train, lkey, st, mask=mask)
             else:
                 x, st2 = layer.forward(p, x, train, lkey, st)
+            x = _constrain_act(x)
             if st2:
                 new_state[str(i)] = st2
         return x, new_state, new_carries
@@ -360,6 +387,20 @@ class MultiLayerNetwork:
         return _reg_penalty((layer, params[str(i)])
                             for i, layer in enumerate(self.conf.layers)
                             if str(i) in params)
+
+    def _auxLoss(self, new_state):
+        """Sum of auxiliary losses layers emitted through their state
+        (``hasAuxLoss`` layers — e.g. the MoE router's Switch
+        load-balancing term, already scaled at the layer).  Added to the
+        training loss so the router trains; differentiable because
+        ``new_state`` is computed inside the traced loss."""
+        total = 0.0
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "hasAuxLoss", False):
+                st = new_state.get(str(i))
+                if st and "auxLoss" in st:
+                    total = total + st["auxLoss"]
+        return total
 
     def _lossFn(self, params: Params, state, x, y, fmask, lmask, key,
                 carries=None):
@@ -377,14 +418,20 @@ class MultiLayerNetwork:
             out = out.astype(jnp.float32)   # loss in f32 under bf16 compute
         per_ex = outLayer.computeScore(y, out, lmask)
         data_loss = jnp.mean(per_ex)
-        return (data_loss + self._regScore(params),
+        return (data_loss + self._regScore(params)
+                + self._auxLoss(new_state),
                 (new_state, new_carries, data_loss))
 
     # ------------------------------------------------------------------
     # the fused train step (single XLA executable)
     # ------------------------------------------------------------------
     @functools.cached_property
-    def _trainStep(self):
+    def _stepFn(self):
+        """The RAW fused train step (fwd + loss + bwd + updater) —
+        ``_trainStep`` jits it for single-device/DP-by-placement use,
+        and ``parallel.meshtrainer.MeshTrainer`` compiles the SAME
+        function with a ShardingPlan's explicit in/out shardings, so
+        every mesh shape executes one stepping path."""
         layers = self.conf.layers
 
         def step(params, optState, state, x, y, fmask, lmask, key,
@@ -398,7 +445,11 @@ class MultiLayerNetwork:
                 epoch, lrScale=lrScale)
             return new_params, new_opt, new_state, loss, new_carries
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    @functools.cached_property
+    def _trainStep(self):
+        return jax.jit(self._stepFn, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _outputFn(self):
@@ -774,27 +825,35 @@ class MultiLayerNetwork:
 
     # -- params ----------------------------------------------------------
     def params(self) -> NDArray:
-        """Single flattened param vector (reference: ``paramsFlattened``)."""
+        """Single flattened param vector (reference: ``paramsFlattened``),
+        assembled as a DEVICE-RESIDENT view: one ``jnp.concatenate`` over
+        the ravelled leaves, no host round-trip.  Callers that need host
+        bytes (serialization) take them explicitly via ``.numpy()``."""
         chunks = []
         for i in range(len(self.conf.layers)):
             li = str(i)
             if li in self.params_:
                 for _path, _pname, v in _iter_leaf_params(self.params_[li]):
-                    chunks.append(np.asarray(v).ravel())
+                    chunks.append(_ravel_replicated(v))
         if not chunks:
             return NDArray(jnp.zeros((0,)))
-        return NDArray(np.concatenate(chunks))
+        return NDArray(jnp.concatenate(chunks))
 
     def setParams(self, flat) -> None:
-        vec = np.asarray(flat.numpy() if isinstance(flat, NDArray) else flat).ravel()
+        """Write a flat vector back into the param tree — device-side
+        slicing (the H2D transfer, if any, happens once for the whole
+        vector; nothing is pulled back to the host)."""
+        vec = jnp.ravel(flat.jax if isinstance(flat, NDArray)
+                        else jnp.asarray(flat))
         pos = 0
         for i in range(len(self.conf.layers)):
             li = str(i)
             if li in self.params_:
                 for path, _pname, cur in _iter_leaf_params(self.params_[li]):
                     n = int(np.prod(cur.shape))
-                    _set_leaf(self.params_[li], path, jnp.asarray(
-                        vec[pos:pos + n].reshape(cur.shape), dtype=cur.dtype))
+                    _set_leaf(self.params_[li], path,
+                              vec[pos:pos + n].reshape(cur.shape)
+                              .astype(cur.dtype))
                     pos += n
         if pos != vec.size:
             raise ValueError(f"Param vector length {vec.size} != model {pos}")

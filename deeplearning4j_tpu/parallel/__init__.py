@@ -13,7 +13,10 @@ from deeplearning4j_tpu.parallel.gradientsharing import (  # noqa: F401
 from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
     PipelineStack, pipeline_apply)
 from deeplearning4j_tpu.parallel.moe import (  # noqa: F401
-    MoELayer, init_moe, moe_apply, moe_apply_expert_parallel)
+    MoEFeedForwardLayer, MoELayer, init_moe, moe_apply,
+    moe_apply_expert_parallel)
+from deeplearning4j_tpu.parallel.meshtrainer import (  # noqa: F401
+    MeshTrainer, ShardingPlan, activate_plan, active_plan)
 from deeplearning4j_tpu.parallel.zero import (  # noqa: F401
     ZeroStage1, shard_optimizer_state)
 from deeplearning4j_tpu.parallel.inference import (  # noqa: F401

@@ -19,6 +19,7 @@ Auxiliary load-balancing loss follows Switch (mean fraction * mean prob).
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Tuple
 
 import jax
@@ -26,8 +27,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer
+
 __all__ = ["init_moe", "moe_apply", "moe_apply_expert_parallel",
-           "MoELayer"]
+           "MoELayer", "MoEFeedForwardLayer"]
 
 
 def init_moe(key, n_experts: int, d_in: int, d_hidden: int, d_out: int,
@@ -143,6 +147,66 @@ def moe_apply_expert_parallel(mesh, params, x, capacity_factor: float = 1.25,
                        in_specs=(pspec, P("data")),
                        out_specs=(P("data"), P()), check_vma=False)
     return fn(params, x)
+
+
+@dataclasses.dataclass
+class MoEFeedForwardLayer(BaseLayer):
+    """Mixture-of-Experts feed-forward block as a model-DSL layer —
+    drop it into a ``NeuralNetConfiguration...list()`` stack and the
+    model's ONE fused train step carries it; under a
+    ``MeshTrainer``/``ShardingPlan`` with a ``model`` axis the expert
+    dim of every expert tensor shards over that axis (EP), composed
+    with DP/ZeRO-1 in the same executable.
+
+    The Switch load-balancing loss reaches the training loss through the
+    layer-state aux channel (``hasAuxLoss``): forward returns
+    ``auxLossScale * aux`` in its state and
+    ``MultiLayerNetwork._lossFn`` adds it — without it the router
+    collapses onto one expert.
+    """
+
+    nIn: int = 0
+    nOut: int = 0
+    nExperts: int = 4
+    hiddenSize: Optional[int] = None
+    topK: int = 1
+    auxLossScale: float = 0.01
+
+    #: consumed by MultiLayerNetwork._auxLoss
+    hasAuxLoss = True
+
+    def preferredFormat(self):
+        return "FF"
+
+    def inferNIn(self, inputType):
+        if not self.nIn:
+            self.nIn = inputType.size
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        return init_moe(key, self.nExperts, self.nIn,
+                        self.hiddenSize or 4 * self.nIn, self.nOut, dtype)
+
+    def initState(self, inputType, dtype=jnp.float32):
+        # declaring the aux slot up front keeps the state pytree
+        # structure identical before/after the first step (no retrace)
+        return {"auxLoss": jnp.zeros((), jnp.float32)}
+
+    def weightParamKeys(self):
+        return ("router", "W1", "W2")
+
+    def expertParamKeys(self):
+        """Params whose LEADING dim is the expert dim — the ShardingPlan
+        shards it over the ``model`` (expert) axis when divisible."""
+        return ("W1", "b1", "W2", "b2")
+
+    def forward(self, params, x, train, key, state):
+        x = self._dropin(x, train, key)
+        y, aux = moe_apply(params, x, self.topK)
+        return y, {"auxLoss": (self.auxLossScale * aux)
+                   .astype(jnp.float32)}
 
 
 class MoELayer:

@@ -31,6 +31,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 __all__ = ["PipelineStack", "pipeline_apply"]
 
 
+def _varying(x, axis_name):
+    """Mark ``x`` varying over ``axis_name`` under the new shard_map
+    vma type system (``lax.pcast``); identity on jax releases with the
+    older check_rep system, which has no varying type at scan
+    boundaries to satisfy."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return x
+
+
 def pipeline_apply(mesh, block_fn: Callable, stacked_params, x,
                    n_microbatches: int, axis_name: str = "stage"):
     """Run ``block_fn(params_s, h) -> h`` through S pipelined stages.
@@ -62,8 +72,8 @@ def pipeline_apply(mesh, block_fn: Callable, stacked_params, x,
         # initial carries must already be marked stage-varying: the scan
         # body makes them varying (axis_index/ppermute), and scan requires
         # carry-in and carry-out types to match
-        state = lax.pcast(jnp.zeros_like(mb[0]), axis_name, to="varying")
-        outs = lax.pcast(jnp.zeros_like(mb), axis_name, to="varying")
+        state = _varying(jnp.zeros_like(mb[0]), axis_name)
+        outs = _varying(jnp.zeros_like(mb), axis_name)
 
         def tick(carry, t):
             state, outs = carry

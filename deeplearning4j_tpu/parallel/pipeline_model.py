@@ -146,28 +146,30 @@ class PipelinedTrainer:
         self.Lo = max(max(self._o_sizes), 1)
         jmesh = mesh.mesh
 
-        def stack_pad(flats, L):
-            rows = [jnp.pad(f.astype(jnp.float32), (0, L - f.size))
-                    for f in flats]
-            arr = jnp.stack(rows)
-            return jax.device_put(arr, NamedSharding(jmesh, P("stage")))
-
-        self.stacked = stack_pad(p_flats, self.Lp)
-        self.opt_stacked = stack_pad(o_flats, self.Lo)
+        self.stacked = self._stack_pad(p_flats, self.Lp)
+        self.opt_stacked = self._stack_pad(o_flats, self.Lo)
 
         self.out_layer = layers[-1]
-        out_idx = str(len(layers) - 1)
+        self._out_key = str(len(layers) - 1)
         self.out_params = jax.device_put(
-            net.params_[out_idx],
+            net.params_[self._out_key],
             jax.tree.map(lambda _: NamedSharding(jmesh, P()),
-                         net.params_[out_idx]))
+                         net.params_[self._out_key]))
         g = conf.globalConf
         self._out_opt = {
             path: _updater_for(g, self.out_layer, pname).init(leaf)
-            for path, pname, leaf in _iter_leaf_params(net.params_[out_idx])}
+            for path, pname, leaf
+            in _iter_leaf_params(net.params_[self._out_key])}
         self.M = int(n_microbatches) if n_microbatches else None
         self.iterationCount = 0
         self._step = None   # built on the first batch (M adapts to it)
+
+    def _stack_pad(self, flats, L):
+        rows = [jnp.pad(f.astype(jnp.float32), (0, L - f.size))
+                for f in flats]
+        arr = jnp.stack(rows)
+        return jax.device_put(
+            arr, NamedSharding(self.mesh.mesh, P("stage")))
 
     # ------------------------------------------------------------------
     def _seg_forward(self, s: int, p_dict, h):
@@ -335,6 +337,47 @@ class PipelinedTrainer:
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
+    def _step_batch(self, ds, epoch: int):
+        """One GPipe train step on a single batch — the shared per-batch
+        body of :meth:`fit` and :meth:`fitDataSet` (the MeshTrainer /
+        fault-supervisor entry for stage meshes)."""
+        net = self.net
+        if getattr(ds, "featuresMask", None) is not None or \
+                getattr(ds, "labelsMask", None) is not None:
+            raise ValueError("masked DataSets are unsupported "
+                             "under pipelineStages")
+        x = jnp.asarray(ds.features.numpy()
+                        if hasattr(ds.features, "numpy")
+                        else ds.features)
+        y = jnp.asarray(ds.labels.numpy()
+                        if hasattr(ds.labels, "numpy")
+                        else ds.labels)
+        if self._step is None:
+            self._resolve_microbatches(int(x.shape[0]))
+            self._step = self._make_step()
+        (self.stacked, self.out_params, self.opt_stacked,
+         self._out_opt, loss) = self._step(
+            self.stacked, self.out_params, self.opt_stacked,
+            self._out_opt, x, y,
+            jnp.asarray(self.iterationCount, jnp.int32),
+            jnp.asarray(epoch, jnp.int32))
+        self.iterationCount += 1
+        net.iterationCount += 1
+        net._scoreArr = loss
+        from deeplearning4j_tpu.optimize.listeners import notifyListeners
+        notifyListeners(getattr(net, "_listeners", []), "iterationDone",
+                        net, net.iterationCount, epoch)
+        return loss
+
+    def fitDataSet(self, ds):
+        """Supervised per-batch stepping (FaultTolerantTrainer via
+        MeshTrainer.step): one GPipe step at the net's CURRENT epoch;
+        the supervisor owns the epoch loop and reads the async loss
+        through ``net.score()``.  Trained weights stay in the stacked
+        stage rows until ``syncToNet()`` (checkpoint time) writes them
+        back."""
+        return self._step_batch(ds, self.net.epochCount)
+
     def fit(self, iterator, epochs: int = 1) -> float:
         loss = None
         net = self.net
@@ -342,33 +385,7 @@ class PipelinedTrainer:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                if getattr(ds, "featuresMask", None) is not None or \
-                        getattr(ds, "labelsMask", None) is not None:
-                    raise ValueError("masked DataSets are unsupported "
-                                     "under pipelineStages")
-                x = jnp.asarray(ds.features.numpy()
-                                if hasattr(ds.features, "numpy")
-                                else ds.features)
-                y = jnp.asarray(ds.labels.numpy()
-                                if hasattr(ds.labels, "numpy")
-                                else ds.labels)
-                if self._step is None:
-                    self._resolve_microbatches(int(x.shape[0]))
-                    self._step = self._make_step()
-                (self.stacked, self.out_params, self.opt_stacked,
-                 self._out_opt, loss) = self._step(
-                    self.stacked, self.out_params, self.opt_stacked,
-                    self._out_opt, x, y,
-                    jnp.asarray(self.iterationCount, jnp.int32),
-                    jnp.asarray(net.epochCount + ep, jnp.int32))
-                self.iterationCount += 1
-                net.iterationCount += 1
-                net._scoreArr = loss
-                from deeplearning4j_tpu.optimize.listeners import \
-                    notifyListeners
-                notifyListeners(getattr(net, "_listeners", []),
-                                "iterationDone", net, net.iterationCount,
-                                net.epochCount + ep)
+                loss = self._step_batch(ds, net.epochCount + ep)
         net.epochCount += int(epochs)
         self.lastLoss = float(loss) if loss is not None else float("nan")
         self.net._scoreArr = None
@@ -378,12 +395,47 @@ class PipelinedTrainer:
 
     def _write_back(self) -> None:
         """Unravel the trained per-stage rows back into the net's
-        per-layer dict so output()/save() reflect the pipeline run."""
+        per-layer dict so output()/save() reflect the pipeline run.
+        Optimizer state writes back too — a supervised checkpoint taken
+        at this point captures the FULL training state."""
         net = self.net
         rows = jax.device_get(self.stacked)
+        orows = jax.device_get(self.opt_stacked)
         for s in range(len(self.segments)):
             sp = self._p_unravel[s](jnp.asarray(rows[s][:self._p_sizes[s]]))
             for key, lp in sp.items():
                 net.params_[key] = lp
-        net.params_[str(len(net.conf.layers) - 1)] = self.out_params
+            so = self._o_unravel[s](
+                jnp.asarray(orows[s][:self._o_sizes[s]]))
+            for key, lo in so.items():
+                net.optState_[key] = lo
+        net.params_[self._out_key] = self.out_params
+        net.optState_[self._out_key] = self._out_opt
+
+    # -- supervision hooks (MeshTrainer/FaultTolerantTrainer) -----------
+    def syncToNet(self) -> None:
+        """Checkpoint hook: flush the stacked stage rows (params AND
+        optimizer state) back into the net's per-layer trees."""
+        self._write_back()
+
+    def reloadFromNet(self) -> None:
+        """Restore hook: restack params/optimizer state from the net's
+        (just-restored) per-layer trees.  The compiled step is reused —
+        only the donated buffers are rebuilt."""
+        net = self.net
+        p_flats, o_flats = [], []
+        for s, seg in enumerate(self.segments):
+            sp = {str(i): net.params_[str(i)] for i, _ in seg
+                  if str(i) in net.params_}
+            so = {key: net.optState_[key] for key in sp}
+            p_flats.append(ravel_pytree(sp)[0])
+            o_flats.append(ravel_pytree(so)[0])
+        self.stacked = self._stack_pad(p_flats, self.Lp)
+        self.opt_stacked = self._stack_pad(o_flats, self.Lo)
+        jmesh = self.mesh.mesh
+        self.out_params = jax.device_put(
+            net.params_[self._out_key],
+            jax.tree.map(lambda _: NamedSharding(jmesh, P()),
+                         net.params_[self._out_key]))
+        self._out_opt = net.optState_[self._out_key]
 

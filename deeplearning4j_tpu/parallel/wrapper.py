@@ -7,13 +7,17 @@ averages params / shares threshold-encoded gradients every N iterations
 (SURVEY.md §2.6 P1).
 
 TPU-native design: no clones, no trainer threads, no averaging step.  The
-wrapped model's ONE fused train step is compiled with the batch sharded over
-the ``data`` mesh axis and params replicated; GSPMD inserts the gradient
-all-reduce (psum over ICI) inside the executable.  This is mathematically the
-reference's synchronous averaging with averagingFrequency=1 — every device
-steps with the globally-averaged gradient — at ICI speed.  The
-``trainingMode``/``averagingFrequency``/threshold knobs are accepted for API
-parity and ignored (documented no-ops, SURVEY.md §7.1).
+wrapper is now a thin FACADE over
+:class:`~deeplearning4j_tpu.parallel.meshtrainer.MeshTrainer`: one
+``ShardingPlan`` over the mesh axes places params/optimizer state and the
+batch, and ONE jitted donated train step (compiled with the plan's in/out
+shardings) executes every mesh shape — pure DP, DP x TP, DP + ZeRO-1,
+expert-parallel MoE, sequence (ring attention) and pipeline (GPipe)
+meshes all through ``MeshTrainer.step``.  GSPMD inserts the gradient
+all-reduce (psum over ICI) inside the executable; this is mathematically
+the reference's synchronous averaging with averagingFrequency=1 at ICI
+speed.  The ``trainingMode``/``averagingFrequency``/threshold knobs are
+accepted for API parity and ignored (documented no-ops, SURVEY.md §7.1).
 """
 from __future__ import annotations
 
@@ -22,7 +26,7 @@ from typing import Optional
 
 import jax
 
-from deeplearning4j_tpu.parallel.mesh import DeviceMesh, shard_params
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.telemetry import (ReplicaTimingListener,
                                           get_registry, tracer)
 
@@ -41,6 +45,7 @@ class ParallelWrapper:
         self.model = model
         self.mesh = mesh or DeviceMesh()
         self.tensorParallel = tensorParallel
+        self._trainer = None
 
     # -- builder ---------------------------------------------------------
     class Builder:
@@ -83,27 +88,47 @@ class ParallelWrapper:
                                   devices=jax.devices()[:workers])
             return ParallelWrapper(self._model, mesh=mesh)
 
+    # -- the one stepping path -------------------------------------------
+    def trainer(self):
+        """The MeshTrainer this facade steps through (built lazily; rebuilt
+        when the model object or its ZeRO tag changed — e.g.
+        ``zero.ZeroStage1`` applied between fits)."""
+        from deeplearning4j_tpu.parallel.meshtrainer import MeshTrainer
+        tr = self._trainer
+        zero_now = getattr(self.model, "_zero1Axis", None) is not None
+        if tr is None or tr.net is not self.model or \
+                tr.plan.zero1 != zero_now:
+            tr = MeshTrainer(self.model, mesh=self.mesh,
+                             tensorParallel=self.tensorParallel)
+            self._trainer = tr
+        return tr
+
     # -- API -------------------------------------------------------------
     def fit(self, iterator, epochs: int = 1) -> None:
         """Train with batches sharded across the mesh's data axis.
 
-        Sharding is part of the model's OWN step compilation: the model's
-        ``setBatchSharding`` places every incoming batch with the mesh's
-        data-axis NamedSharding, and GSPMD specializes the already-fused
-        train step with the psum all-reduce inside — no wrapper-side
-        monkey-patching or NDArray mutation.
-
-        Mesh axes beyond data/model route automatically: a ``stage`` axis
-        trains the model's pipelineStages segments GPipe-scheduled
-        (``pipeline_model.PipelinedTrainer``); a ``seq`` axis makes the
-        attention layers compile ring (context-parallel) attention —
-        both through the dl4j-shaped model config, no user JAX."""
+        All mesh shapes route through ``MeshTrainer``'s single jitted
+        step: a ``stage`` axis trains the model's pipelineStages segments
+        GPipe-scheduled behind the same surface, a ``seq`` axis makes the
+        attention layers compile ring (context-parallel) attention, and
+        DP/TP/ZeRO-1/EP compose inside the one executable — all through
+        the dl4j-shaped model config, no user JAX."""
         # streaming sources engage the sharded producer pool here (not in
         # net.fit) so the GPipe pipeline path overlaps host ETL too; the
-        # wrapper owns the pool's close()
+        # wrapper owns the pool's close().  Prefetch H2D staging routes
+        # through the plan's batch sharding so sharded inputs land
+        # directly on their mesh shards instead of replicated-then-
+        # resharded (stage meshes consume on host and keep plain staging).
         from deeplearning4j_tpu.datavec.pipeline import maybe_prefetch
+        tr = self.trainer()
+        device = tr.plan.batch_sharding() \
+            if self.mesh.dataSize > 1 and self.mesh.stageSize == 1 else None
         src = iterator
-        iterator = maybe_prefetch(iterator)
+        if device is not None and hasattr(iterator, "setDevice"):
+            # a caller-built AsyncDataSetIterator gets the same
+            # direct-to-shard H2D routing as the producer pool
+            iterator.setDevice(device)
+        iterator = maybe_prefetch(iterator, device=device)
         try:
             self._fit_inner(iterator, epochs)
         finally:
@@ -111,41 +136,19 @@ class ParallelWrapper:
                 iterator.close()
 
     def _fit_inner(self, iterator, epochs: int) -> None:
-        from deeplearning4j_tpu.parallel.mesh import activate_mesh
-        net = self.model
+        tr = self.trainer()
         if self.mesh.stageSize > 1:
-            from deeplearning4j_tpu.parallel.pipeline_model import \
-                PipelinedTrainer
-            # rebuild when the net's params dict was REPLACED (net.init()
-            # or a loaded checkpoint) — the trainer's stacked copy would
-            # otherwise silently overwrite the new weights on write-back
-            if getattr(self, "_pipeline", None) is None or \
-                    self._pipeline_src is not net.params_:
-                self._pipeline = PipelinedTrainer(net, self.mesh)
-                self._pipeline_src = net.params_
-            self._pipeline.fit(iterator, epochs=epochs)
+            tr.fit(iterator, epochs=epochs)
             return
-        if self.mesh.seqSize > 1:
-            # the routing decision is baked in at trace time: drop steps
-            # compiled under a DIFFERENT (or no) mesh, then keep this
-            # mesh's executables cached across repeated wrapper fits.
-            # The net itself drops mesh-bound traces when later used
-            # outside any wrapper (MultiLayerNetwork._ensure_trace_mesh).
-            if getattr(net, "_meshTrace", None) is not self.mesh:
-                for k in ("_trainStep", "_outputFn", "_scoreFn"):
-                    net.__dict__.pop(k, None)
-                net._meshTrace = self.mesh
-            try:
-                with activate_mesh(self.mesh):
-                    self._fit_dp(iterator, epochs)
-            except BaseException:
-                # don't leave half-compiled mesh-bound traces behind
-                for k in ("_trainStep", "_outputFn", "_scoreFn"):
-                    net.__dict__.pop(k, None)
-                net._meshTrace = None
-                raise
-            return
-        self._fit_dp(iterator, epochs)
+        net = self.model
+        timer = self._timing()
+        net.addListeners(timer)
+        try:
+            with tracer().span("dp_fit", replicas=int(self.mesh.dataSize),
+                               epochs=int(epochs)):
+                tr.fit(iterator, epochs=epochs)
+        finally:
+            net.removeListener(timer)
 
     def _timing(self) -> ReplicaTimingListener:
         """Persistent straggler/contention watcher for this wrapper's mesh:
@@ -176,84 +179,29 @@ class ParallelWrapper:
         return [ReplicaStragglerRule(ratio=stragglerRatio)]
 
     def fitDataSet(self, ds) -> None:
-        """One data-parallel train step on a single batch — the
-        FaultTolerantTrainer's per-batch entry point (it owns the epoch
-        loop, checkpoint cadence, and rollback, so it needs step-level
-        granularity the iterator-driven ``fit`` can't give it).
-
-        Placement is re-asserted per call (cheap no-op when params already
-        carry this mesh's sharding — and after a checkpoint rollback the
-        restored trees get re-placed exactly as ``fit`` would).  Stage/seq
-        meshes are not supported here yet (ROADMAP open item: supervised
-        pipeline/ring training)."""
-        if self.mesh.stageSize > 1 or self.mesh.seqSize > 1:
-            raise NotImplementedError(
-                "fitDataSet (fault-supervised stepping) supports data/"
-                "tensor-parallel meshes; pipeline/sequence axes are an "
-                "open item")
-        net = self.model
-        if self._needs_place():
-            self._dp_place()
-        else:
-            net.setBatchSharding(self.mesh.dataSharding())
+        """One train step on a single batch — the FaultTolerantTrainer's
+        per-batch entry point (it owns the epoch loop, checkpoint cadence,
+        and rollback, so it needs step-level granularity the
+        iterator-driven ``fit`` can't give it).  EVERY mesh shape steps
+        here through ``MeshTrainer.step`` — data/tensor/sequence/expert
+        axes compile into the one sharded executable, a stage axis runs
+        the GPipe schedule behind the same surface."""
+        tr = self.trainer()
         t0 = time.perf_counter()
-        try:
-            with tracer().span("dp_step",
-                               replicas=int(self.mesh.dataSize)):
-                net.fit(ds)
-        finally:
-            net.setBatchSharding(None)
+        with tracer().span("dp_step", replicas=int(self.mesh.dataSize)):
+            tr.step(ds)
         self._timing().record(time.perf_counter() - t0)
 
-    def _needs_place(self) -> bool:
-        """Params already living on this mesh (the steady state: the jitted
-        DP step returns mesh-sharded trees) skip the O(leaves) placement
-        walk — it only needs to re-run after init or a checkpoint restore
-        dropped arrays somewhere else."""
-        net = self.model
-        if net.params_ is None:
-            return True
-        leaves = jax.tree_util.tree_leaves(net.params_)
-        if not leaves:
-            return True
-        leaf = leaves[0]
-        return not (hasattr(leaf, "sharding") and
-                    set(leaf.sharding.device_set) ==
-                    set(self.mesh.mesh.devices.flat))
+    # -- supervision hooks (driven by FaultTolerantTrainer) ---------------
+    def syncToNet(self) -> None:
+        """Flush trainer-held state (stage meshes: the stacked GPipe
+        rows) back into the net's trees before a checkpoint."""
+        if self._trainer is not None:
+            self._trainer.syncToNet()
 
-    def _dp_place(self) -> None:
-        net = self.model
-        if net.params_ is None:
-            net.init()
-        net.params_ = shard_params(self.mesh, net.params_,
-                                   self.tensorParallel)
-        if net.optState_ is not None and not self.tensorParallel:
-            # replicate ONLY leaves not already placed across this mesh —
-            # a ZeRO-sharded optimizer state (zero.ZeroStage1) must keep its
-            # sharding or the memory saving silently evaporates
-            mesh_devices = set(self.mesh.mesh.devices.flat)
-
-            def place(leaf):
-                if hasattr(leaf, "sharding") and \
-                        set(leaf.sharding.device_set) == mesh_devices:
-                    return leaf
-                return jax.device_put(leaf, self.mesh.replicated())
-
-            net.optState_ = jax.tree.map(place, net.optState_)
-        net.setBatchSharding(self.mesh.dataSharding())
-
-    def _fit_dp(self, iterator, epochs: int) -> None:
-        net = self.model
-        self._dp_place()
-        timer = self._timing()
-        net.addListeners(timer)
-        try:
-            with tracer().span("dp_fit", replicas=int(self.mesh.dataSize),
-                               epochs=int(epochs)):
-                net.fit(iterator, epochs=epochs)
-        finally:
-            net.setBatchSharding(None)
-            net.removeListener(timer)
+    def placeAfterRestore(self) -> None:
+        """Re-assert plan placement after a checkpoint restore."""
+        self.trainer().placeAfterRestore()
 
     def shutdown(self) -> None:
         pass

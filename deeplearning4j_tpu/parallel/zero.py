@@ -56,6 +56,13 @@ class ZeroStage1:
     sharded).  Usage::
 
         ZeroStage1(mesh).apply(net)    # before ParallelWrapper.fit
+
+    A thin facade over the unified mesh plan: ``apply`` places the
+    updater state AND tags the net so
+    :class:`~deeplearning4j_tpu.parallel.meshtrainer.ShardingPlan.for_model`
+    builds matching optimizer-state specs — the MeshTrainer step is then
+    compiled with those in/out shardings, pinning the ZeRO placement in
+    the executable instead of hoping propagation keeps it.
     """
 
     def __init__(self, mesh: DeviceMesh, axis: str = "data"):
@@ -67,4 +74,6 @@ class ZeroStage1:
             net.init()
         net.optState_ = shard_optimizer_state(self.mesh, net.optState_,
                                               self.axis)
+        # the MeshTrainer plan reads this tag (ShardingPlan.for_model)
+        net._zero1Axis = self.axis
         return net

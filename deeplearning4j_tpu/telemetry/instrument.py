@@ -32,7 +32,8 @@ from deeplearning4j_tpu.telemetry.tracing import tracer
 __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "supervised_scope", "microbatch_scope", "in_microbatch",
            "record_logical_step", "ReplicaTimingListener", "etl_metrics",
-           "EtlMetrics", "ServingMetrics", "serving_metrics"]
+           "EtlMetrics", "ServingMetrics", "serving_metrics",
+           "MeshMetrics", "mesh_metrics"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -356,6 +357,62 @@ def serving_metrics() -> ServingMetrics:
     """Accessor for the shared serving metric namespace (see
     :class:`ServingMetrics`)."""
     return _SERVING_METRICS
+
+
+class MeshMetrics:
+    """The ``dl4j_tpu_mesh_*`` namespace, registered from ONE site.
+
+    ``parallel.meshtrainer.MeshTrainer`` — the unified GSPMD stepping
+    path every parallel facade (ParallelWrapper, SharedTrainingMaster,
+    ZeRO, MoE, pipeline) executes through — reports here: step time,
+    per-axis collective traffic estimated statically from the
+    ShardingPlan, and executable cache misses (the steady-state
+    acceptance bar is this counter staying FLAT after step 1).
+    Accessors re-resolve through :func:`get_registry` on every call
+    (tests swap the registry).
+    """
+
+    def steps(self):
+        return get_registry().counter(
+            "dl4j_tpu_mesh_steps_total",
+            "Train steps dispatched through the MeshTrainer unified "
+            "sharded step (all parallel facades step here)")
+
+    def step_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_mesh_step_seconds",
+            "Host wall time per MeshTrainer step (lockstep across the "
+            "mesh: one executable, GSPMD collectives inside)",
+            buckets=DEFAULT_BUCKETS)
+
+    def jit_cache_misses(self):
+        return get_registry().counter(
+            "dl4j_tpu_mesh_jit_cache_misses_total",
+            "Sharded-step executable cache misses (steady state must "
+            "hold this flat after the first step)")
+
+    def collective_bytes(self):
+        return get_registry().counter(
+            "dl4j_tpu_mesh_collective_bytes_total",
+            "Estimated bytes moved per mesh axis and collective "
+            "(all_reduce / reduce_scatter / all_gather), priced "
+            "statically from the ShardingPlan",
+            labelnames=("axis", "collective"))
+
+    def axis_size(self):
+        return get_registry().gauge(
+            "dl4j_tpu_mesh_axis_size",
+            "Device count per named mesh axis of the active "
+            "ShardingPlan", labelnames=("axis",))
+
+
+_MESH_METRICS = MeshMetrics()
+
+
+def mesh_metrics() -> MeshMetrics:
+    """Accessor for the shared mesh metric namespace (see
+    :class:`MeshMetrics`)."""
+    return _MESH_METRICS
 
 
 def note_etl_wait(seconds: float, owner) -> None:

@@ -57,6 +57,11 @@ def pytest_configure(config):
         "markers", "lint: static-analysis tests (the jaxlint AST "
         "framework, its rule fixtures, and the repo-is-clean smoke "
         "gate)")
+    config.addinivalue_line(
+        "markers", "mesh: unified GSPMD mesh tests (MeshTrainer single "
+        "sharded step: DP/TP/ZeRO/EP equivalence, steady-state "
+        "compile-cache discipline, fault supervision across mesh "
+        "shapes)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,386 @@
+"""Unified GSPMD mesh tests (ISSUE 10): ONE stepping path for all of
+parallel/.
+
+- numeric equivalence: identical loss trajectory for the same model/data
+  under 1-device, DP=2, DP=2 x TP=2, and DP=4 + ZeRO-1 ShardingPlans
+  (sync all-reduce DP == large-batch SGD; TP/ZeRO change placement, not
+  math);
+- single stepping path: ParallelWrapper, SharedTrainingMaster, ZeRO and
+  MoE fits all dispatch MeshTrainer's one jitted sharded step (asserted
+  via the installed executable identity + the dl4j_tpu_mesh_* counters);
+- steady-state discipline: the jit-cache-miss counter is FLAT after
+  step 1 for every mesh shape;
+- fault supervision: FaultTolerantTrainer rollback AND kill/resume work
+  through MeshTrainer on a TP mesh (plus the seq/stage shapes the old
+  per-strategy paths refused to supervise).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (FaultTolerantTrainer, NaNAtStep,
+                                      PreemptAtStep, SimulatedPreemption,
+                                      inject)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (DeviceMesh, MeshTrainer,
+                                         MoEFeedForwardLayer,
+                                         ParallelWrapper, ShardingPlan,
+                                         SharedTrainingMaster,
+                                         SparkDl4jMultiLayer,
+                                         VoidConfiguration, ZeroStage1)
+from deeplearning4j_tpu.telemetry import get_registry
+
+pytestmark = pytest.mark.mesh
+
+
+def _mlp(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer.builder().nIn(8).nOut(16)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(4)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(8)).build())
+    return MultiLayerNetwork(conf)
+
+
+def _toy(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = np.random.RandomState(1).randn(8, 4)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def _counter(name):
+    c = get_registry().get(name)
+    return c.value() if c is not None else 0.0
+
+
+def _mesh_configs():
+    dev = jax.devices()
+    return [
+        ("dp2", DeviceMesh(data=2, devices=dev[:2]), False, False),
+        ("dp2_tp2", DeviceMesh(data=2, model=2, devices=dev[:4]), True,
+         False),
+        ("dp4_zero1", DeviceMesh(data=4, devices=dev[:4]), False, True),
+    ]
+
+
+class TestNumericEquivalence:
+    def test_loss_trajectory_matches_single_device(self):
+        """Same model/data, 4 steps: every mesh shape must walk the SAME
+        loss trajectory as the single-device run (atol) — sharding is
+        placement, not math."""
+        x, y = _toy()
+        batches = [DataSet(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+                   for i in range(4)]
+
+        ref_net = _mlp()
+        ref_net.init()
+        ref = []
+        for ds in batches:
+            ref_net.fit(ds)
+            ref.append(float(ref_net.score()))
+
+        for name, mesh, tp, zero in _mesh_configs():
+            net = _mlp()
+            net.init()
+            if zero:
+                ZeroStage1(mesh).apply(net)
+            pw = ParallelWrapper(net, mesh=mesh, tensorParallel=tp)
+            traj = []
+            for ds in batches:
+                pw.fitDataSet(ds)
+                traj.append(float(net.score()))
+            np.testing.assert_allclose(traj, ref, atol=1e-4, err_msg=name)
+            np.testing.assert_allclose(net.params().numpy(),
+                                       ref_net.params().numpy(),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=name)
+
+    def test_zero1_keeps_optimizer_state_sharded(self):
+        x, y = _toy()
+        mesh = DeviceMesh(data=4, devices=jax.devices()[:4])
+        net = _mlp()
+        net.init()
+        ZeroStage1(mesh).apply(net)
+        pw = ParallelWrapper(net, mesh=mesh)
+        for _ in range(3):
+            pw.fitDataSet(DataSet(x, y))
+        leaf = jax.tree_util.tree_leaves(
+            [v for k, v in net.optState_["0"].items()
+             if "W" in str(k)])[0]
+        assert not leaf.sharding.is_fully_replicated
+
+
+class TestOneSteppingPath:
+    def test_all_facades_dispatch_the_meshtrainer_step(self):
+        """ParallelWrapper, SharedTrainingMaster, ZeRO and MoE fits all
+        execute through MeshTrainer's single jitted step: the installed
+        executable IS the trainer's jit, and every step lands in the
+        dl4j_tpu_mesh_steps_total series."""
+        x, y = _toy()
+        it = ListDataSetIterator([DataSet(x, y)], batch=64)
+        dev = jax.devices()
+
+        # -- ParallelWrapper ------------------------------------------
+        net = _mlp()
+        net.init()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=2, devices=dev[:2]))
+        s0 = _counter("dl4j_tpu_mesh_steps_total")
+        pw.fit(it, epochs=2)
+        assert _counter("dl4j_tpu_mesh_steps_total") == s0 + 2
+        assert net.__dict__["_trainStep"] is pw.trainer()._jit
+
+        # -- SharedTrainingMaster -------------------------------------
+        net2 = _mlp()
+        net2.init()
+        tm = (SharedTrainingMaster.Builder(VoidConfiguration())
+              .batchSizePerWorker(32)
+              .mesh(DeviceMesh(data=2, devices=dev[:2])).build())
+        s0 = _counter("dl4j_tpu_mesh_steps_total")
+        SparkDl4jMultiLayer(None, net2, tm).fit(it, epochs=2)
+        assert _counter("dl4j_tpu_mesh_steps_total") == s0 + 2
+
+        # -- ZeRO-1 ---------------------------------------------------
+        net3 = _mlp()
+        net3.init()
+        mesh3 = DeviceMesh(data=4, devices=dev[:4])
+        ZeroStage1(mesh3).apply(net3)
+        pw3 = ParallelWrapper(net3, mesh=mesh3)
+        s0 = _counter("dl4j_tpu_mesh_steps_total")
+        pw3.fit(it, epochs=1)
+        assert _counter("dl4j_tpu_mesh_steps_total") == s0 + 1
+        assert pw3.trainer().plan.zero1
+
+        # -- MoE (model axis doubles as the expert axis) --------------
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(0.01)).list()
+                .layer(MoEFeedForwardLayer(nIn=8, nOut=16, nExperts=4,
+                                           hiddenSize=16))
+                .layer(OutputLayer.builder("mcxent").nOut(4)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(8)).build())
+        net4 = MultiLayerNetwork(conf).init()
+        pw4 = ParallelWrapper(net4,
+                              mesh=DeviceMesh(data=2, model=4,
+                                              devices=dev[:8]))
+        s0 = _counter("dl4j_tpu_mesh_steps_total")
+        pw4.fit(it, epochs=2)
+        assert _counter("dl4j_tpu_mesh_steps_total") == s0 + 2
+        # expert tensors actually sharded over the model/expert axis
+        spec = net4.params_["0"]["W1"].sharding.spec
+        assert "model" in tuple(spec)
+
+    def test_moe_trains_and_router_gets_gradient(self):
+        """The Switch aux loss reaches the training loss through the
+        layer-state channel: the router must MOVE during training (it
+        would stay frozen if the aux term were dropped)."""
+        x, y = _toy()
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(0.01)).list()
+                .layer(MoEFeedForwardLayer(nIn=8, nOut=16, nExperts=4,
+                                           hiddenSize=16))
+                .layer(OutputLayer.builder("mcxent").nOut(4)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        router0 = np.array(net.params_["0"]["router"])
+        mesh = DeviceMesh(data=2, model=4)
+        pw = ParallelWrapper(net, mesh=mesh)
+        s0 = net.score(DataSet(x, y))
+        pw.fit(ListDataSetIterator([DataSet(x, y)], batch=64), epochs=15)
+        assert net.score(DataSet(x, y)) < s0 * 0.6
+        assert np.abs(np.array(net.params_["0"]["router"])
+                      - router0).max() > 1e-5
+
+    def test_zero_steady_state_recompiles(self):
+        """Acceptance bar: the mesh jit-cache-miss counter is FLAT after
+        step 1 for every mesh shape (one executable, reused)."""
+        x, y = _toy()
+        ds = DataSet(x, y)
+        for name, mesh, tp, zero in _mesh_configs():
+            net = _mlp()
+            net.init()
+            if zero:
+                ZeroStage1(mesh).apply(net)
+            pw = ParallelWrapper(net, mesh=mesh, tensorParallel=tp)
+            pw.fitDataSet(ds)   # step 1: the one compile
+            m1 = _counter("dl4j_tpu_mesh_jit_cache_misses_total")
+            for _ in range(4):
+                pw.fitDataSet(ds)
+            m2 = _counter("dl4j_tpu_mesh_jit_cache_misses_total")
+            assert m2 == m1, f"{name}: {m2 - m1} steady-state recompiles"
+
+    def test_collective_bytes_estimated_per_axis(self):
+        x, y = _toy()
+        net = _mlp()
+        net.init()
+        mesh = DeviceMesh(data=2, model=2, devices=jax.devices()[:4])
+        pw = ParallelWrapper(net, mesh=mesh, tensorParallel=True)
+        c0 = _counter("dl4j_tpu_mesh_steps_total")
+        pw.fitDataSet(DataSet(x, y))
+        assert _counter("dl4j_tpu_mesh_steps_total") == c0 + 1
+        cb = get_registry().get("dl4j_tpu_mesh_collective_bytes_total")
+        assert cb is not None
+        # replicated params all-reduce over the data axis every step
+        assert cb.value(axis="data", collective="all_reduce") > 0
+
+    def test_plan_specs_compose_tp_and_zero(self):
+        net = _mlp()
+        net.init()
+        mesh = DeviceMesh(data=2, model=2, devices=jax.devices()[:4])
+        net._zero1Axis = "data"
+        plan = ShardingPlan.for_model(net, mesh, tensorParallel=True)
+        assert plan.zero1 and plan.tensorParallel
+        psh = plan.param_shardings(net)
+        # TP: dense W column-shards over model
+        assert "model" in tuple(psh["0"]["W"].spec)
+        osh = plan.opt_shardings(net)
+        # TP moment tensors mirror the param spec; ZeRO shards the rest
+        w_opt = jax.tree_util.tree_leaves(osh["0"]["W"])[0]
+        assert "model" in tuple(w_opt.spec)
+
+
+class TestFaultSupervisionThroughMesh:
+    def test_nan_rollback_on_tp_mesh(self, tmp_path):
+        x, y = _toy()
+        batches = [DataSet(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+                   for i in range(4)]
+        it = ListDataSetIterator(batches, batch=16)
+        net = _mlp()
+        net.init()
+        pw = ParallelWrapper(net,
+                             mesh=DeviceMesh(data=2, model=2,
+                                             devices=jax.devices()[:4]),
+                             tensorParallel=True)
+        tr = FaultTolerantTrainer(pw, str(tmp_path / "tp"),
+                                  checkpointEveryN=2, keepLast=10)
+        with inject(NaNAtStep(3)):
+            tr.fit(it, epochs=2)
+        assert tr.stats["rollbacks"] >= 1
+        assert np.isfinite(tr.lastLoss)
+        # params stayed on the TP mesh through rollback/re-place
+        leaf = net.params_["0"]["W"]
+        assert len(leaf.sharding.device_set) == 4
+
+    def test_kill_and_resume_on_tp_mesh_matches_uninterrupted(
+            self, tmp_path):
+        """Preempt mid-run, re-run the same entrypoint: resume restores
+        counters/RNG/params INTO the mesh placement and lands on the
+        uninterrupted run's final loss."""
+        x, y = _toy()
+
+        def batches():
+            return ListDataSetIterator(
+                [DataSet(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+                 for i in range(4)], batch=16)
+
+        def wrapped(net):
+            return ParallelWrapper(
+                net, mesh=DeviceMesh(data=2, model=2,
+                                     devices=jax.devices()[:4]),
+                tensorParallel=True)
+
+        base = _mlp()
+        base.init()
+        tb = FaultTolerantTrainer(wrapped(base), str(tmp_path / "base"),
+                                  checkpointEveryN=2, keepLast=10)
+        tb.fit(batches(), epochs=2)
+        assert base.iterationCount == 8
+
+        killed = _mlp()
+        killed.init()
+        tk = FaultTolerantTrainer(wrapped(killed), str(tmp_path / "run"),
+                                  checkpointEveryN=2, keepLast=10)
+        with inject(PreemptAtStep(5)):
+            with pytest.raises(SimulatedPreemption):
+                tk.fit(batches(), epochs=2)
+        assert killed.iterationCount < 8
+
+        resumed = _mlp()
+        resumed.init()
+        tr = FaultTolerantTrainer(wrapped(resumed), str(tmp_path / "run"),
+                                  checkpointEveryN=2, keepLast=10)
+        tr.fit(batches(), epochs=2)
+        assert tr.stats["resumedFromStep"] == 4
+        assert resumed.iterationCount == 8
+        assert tr.lastLoss == pytest.approx(tb.lastLoss, abs=1e-5)
+
+    def test_seq_mesh_supervised_stepping(self, tmp_path):
+        """Sequence-parallel meshes were a NotImplementedError in the old
+        per-strategy fitDataSet — through MeshTrainer they supervise like
+        any other shape."""
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(1e-3)).list()
+                .layer(SelfAttentionLayer(nHeads=2, headSize=4, nOut=8))
+                .layer(RnnOutputLayer.builder("mse").nOut(2)
+                       .activation("identity").build())
+                .setInputType(InputType.recurrent(8, 16)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(8, 8, 16).astype(np.float32),
+                     rng.randn(8, 2, 16).astype(np.float32))
+        pw = ParallelWrapper(net,
+                             mesh=DeviceMesh(data=2, seq=2,
+                                             devices=jax.devices()[:4]))
+        tr = FaultTolerantTrainer(pw, str(tmp_path / "seq"),
+                                  checkpointEveryN=2)
+        tr.fit(ListDataSetIterator([ds], batch=8), epochs=2)
+        assert net.iterationCount == 2
+        assert np.isfinite(tr.lastLoss)
+
+    def test_stage_mesh_supervised_stepping(self, tmp_path):
+        """Pipeline (GPipe) meshes step through the same MeshTrainer
+        surface: per-batch supervision, checkpoint sync of the stacked
+        stage rows, restore restacking."""
+        from deeplearning4j_tpu.learning import Sgd
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05))
+             .list())
+        for _ in range(4):
+            b.layer(DenseLayer.builder().nOut(16).activation("tanh")
+                    .build())
+        b.layer(OutputLayer.builder("mse").nOut(4)
+                .activation("identity").build())
+        b.pipelineStages(4)
+        conf = b.setInputType(InputType.feedForward(16)).build()
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 16).astype(np.float32)
+        y = rng.randn(64, 4).astype(np.float32)
+        it = ListDataSetIterator(
+            [DataSet(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+             for i in range(4)], batch=16)
+        pw = ParallelWrapper(net,
+                             mesh=DeviceMesh(data=1, stage=4,
+                                             devices=jax.devices()[:4]))
+        tr = FaultTolerantTrainer(pw, str(tmp_path / "pipe"),
+                                  checkpointEveryN=2, keepLast=10)
+        tr.fit(it, epochs=2)
+        assert net.iterationCount == 8
+        assert np.isfinite(tr.lastLoss)
+        assert tr.stats["checkpoints"] >= 4
+
+
+class TestTraceHygiene:
+    def test_net_usable_outside_mesh_after_wrapper_fit(self):
+        """After a mesh fit the net must drop the mesh-bound executable
+        when used standalone (constraints are baked into the trace)."""
+        x, y = _toy()
+        net = _mlp()
+        net.init()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=2,
+                                                  devices=jax.devices()[:2]))
+        pw.fit(ListDataSetIterator([DataSet(x, y)], batch=64), epochs=1)
+        net.fit(DataSet(x, y))      # standalone: re-traces cleanly
+        assert np.isfinite(net.score())
+        out = net.output(x[:4])
+        assert out.shape == (4, 4)

@@ -22,7 +22,8 @@ BUILTIN = {
 # gating markers the suite RELIES on: if one of these silently vanishes
 # from conftest registration, `-m <marker>` selects nothing and that whole
 # subsystem's coverage evaporates without a red test
-REQUIRED = {"tpu", "slow", "fault", "telemetry", "etl", "serving", "lint"}
+REQUIRED = {"tpu", "slow", "fault", "telemetry", "etl", "serving", "lint",
+            "mesh"}
 
 MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_]\w*)")
 REGISTER_RE = re.compile(

@@ -38,6 +38,9 @@ HOT_PATH_SUFFIXES = (
     "models/graph.py",
     "remote/serving.py",
     "parallel/inference.py",
+    "parallel/meshtrainer.py",
+    "parallel/zero.py",
+    "parallel/moe.py",
     "datavec/pipeline.py",
     "datavec/iterators.py",
 )
