@@ -687,6 +687,34 @@ class PrefetchingDataSetIterator(DataSetIterator):
             self._pendingError = e
         return self._applyPre(ds)
 
+    def setDevice(self, device) -> None:
+        """Retarget the H2D staging ring (elastic re-mesh: the plan's
+        batch sharding changed mesh).  Applies from the NEXT staged
+        batch; already-staged batches keep their old placement — the
+        step's own ``_place_batch`` reconciles those stragglers."""
+        self.device = device
+
+    def reassign(self, hostIndex: Optional[int] = None,
+                 hostCount: Optional[int] = None) -> None:
+        """Re-assign this consumer's ShardSpec host slot (elastic
+        re-mesh: a host left or joined the pod, so record ownership
+        must repartition or records get double-read/dropped).  Stops
+        the pool; the next ``hasNext()`` restarts it with the new spec
+        FROM THE STREAM'S START — callers realign mid-epoch position
+        via the supervisor's checkpoint skip fast-forward, exactly like
+        a resume."""
+        err = self._shutdown()
+        if hostIndex is not None:
+            # jaxlint: sync-ok -- host slot indices are Python ints, not device scalars
+            self.hostIndex = int(hostIndex)
+        if hostCount is not None:
+            # jaxlint: sync-ok -- host slot indices are Python ints, not device scalars
+            self.hostCount = int(hostCount)
+        self._ring.clear()
+        self._exhausted = False
+        if err is not None:
+            self._pendingError = err
+
     def reset(self) -> None:
         err = self._shutdown()
         if err is None:

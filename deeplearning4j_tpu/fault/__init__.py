@@ -6,9 +6,13 @@ exercises every path (NaN at step k, simulated preemption, checkpoint
 corruption, device OOM, slow/failing data fetches).
 """
 from deeplearning4j_tpu.fault.injection import (  # noqa: F401
-    CorruptCheckpointAtStep, FailingFetch, Fault, FaultInjector, InjectedOOM,
-    NaNAtStep, OOMAtStep, PreemptAtStep, SimulatedPreemption, SlowFetch,
-    StallAtStep, clear_injector, corrupt_checkpoint, get_injector, inject,
-    set_injector)
+    CorruptCheckpointAtStep, DeviceLossAtStep, FailingFetch, Fault,
+    FaultInjector, InjectedDeviceLoss, InjectedOOM, NaNAtStep, OOMAtStep,
+    PreemptAtStep, RestoreCapacityAtStep, SimulatedPreemption, SlowFetch,
+    StallAtStep, StragglerReplica, clear_injector, clear_lost_devices,
+    corrupt_checkpoint, get_injector, inject, lose_devices,
+    lost_device_ids, restore_devices, set_injector)
 from deeplearning4j_tpu.fault.supervisor import (  # noqa: F401
     FaultTolerantTrainer, TrainingDivergedError, is_oom_error)
+from deeplearning4j_tpu.fault.elastic import (  # noqa: F401
+    ElasticCapacityError, ElasticSupervisor, is_device_loss_error)

@@ -1,0 +1,405 @@
+"""Elastic pod-scale training: shrink on device loss, grow on recovery.
+
+At pod scale a chip WILL die permanently mid-run (SURVEY.md §5.4; the
+reference's ``SharedTrainingMaster`` lineage only ever restarts the same
+topology), and a chronically slow host sets the whole pod's lockstep
+pace (the straggler effect arXiv:1810.11112 characterizes).  PR 10
+collapsed every parallel strategy onto ONE ``MeshTrainer``/``ShardingPlan``
+step, which turns re-meshing from a code-path problem into a
+checkpoint-resharding problem — this module is that reshard:
+
+- **plan-to-plan resharding** — param/optimizer/RNG/iterator state moves
+  between *different* mesh shapes deterministically.  Live state moves
+  through :func:`~deeplearning4j_tpu.parallel.meshtrainer.reshard_tree`
+  (a jitted device-side gather when the device set is unchanged,
+  device-to-device ``device_put`` when it isn't — never a host
+  round-trip); checkpointed state restores DIRECTLY into the target
+  plan's shardings through the shape-agnostic manifests
+  (``ShardedCheckpointer.restore(shardings=)``), so each host reads only
+  its shards of the NEW layout.
+- **shrink on device loss** — a step that dies with a device-loss error
+  (:func:`is_device_loss_error`) triggers: rebuild the largest valid
+  :class:`~deeplearning4j_tpu.parallel.mesh.DeviceMesh` from surviving
+  devices (non-data axes preserved — replica loss shrinks the data
+  axis), reshard the last *sealed* checkpoint onto it, realign the
+  data-iterator skip state (the resume fast-forward replays the stream
+  to the checkpoint's ``stepInEpoch``), and resume.  The state that died
+  mid-update is never trusted.
+- **grow on recovery** — when the availability probe sees capacity
+  return, the supervisor re-meshes at the next checkpoint boundary
+  through the SAME reshard path, live (the state is intact, so no
+  checkpoint restore — a plan-to-plan reshard of the running trees).
+- **straggler eviction** — the federated ``replica_straggler`` signal
+  (the per-replica step-time gauge, host-labeled through the federation
+  layer) evicts a chronically slow host's devices through the live
+  shrink path instead of letting it set the pod's pace.
+
+Everything is exercised deterministically through
+:mod:`deeplearning4j_tpu.fault.injection` (``DeviceLossAtStep``,
+``RestoreCapacityAtStep``, ``StragglerReplica`` — see
+tests/test_elastic.py).
+
+Usage::
+
+    pw = ParallelWrapper(net, mesh=DeviceMesh(data=8))
+    sup = ElasticSupervisor(pw, "/ckpts/run1", checkpointEveryN=50)
+    sup.fit(iterator, epochs=10)   # survives dead chips, grows back
+
+Telemetry: the ``dl4j_tpu_elastic_*`` namespace (registered once in
+``telemetry.instrument.ElasticMetrics``) — re-mesh events by direction,
+re-mesh latency, live device count, loss/eviction counters — plus
+``remesh``/``device_loss``/``straggler_evicted`` events in the watchdog
+event log when a ``healthMonitor`` is attached.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence
+
+from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.fault.supervisor import FaultTolerantTrainer
+from deeplearning4j_tpu.telemetry import (elastic_metrics, flight_recorder,
+                                          get_registry, record_crash,
+                                          replica_step_gauge, tracer)
+
+__all__ = ["ElasticSupervisor", "ElasticCapacityError",
+           "is_device_loss_error"]
+
+log = logging.getLogger(__name__)
+
+
+class ElasticCapacityError(RuntimeError):
+    """Raised when no valid mesh can be rebuilt from surviving devices
+    (fewer than ``model*seq*stage`` left, or the re-mesh budget
+    ``maxRemeshes`` is exhausted) — the run needs an operator, not
+    another retry."""
+
+
+class _RemeshRestart(Exception):
+    """Internal control flow: the mesh was rebuilt and the last sealed
+    checkpoint resharded onto it — unwind to the supervisor's outer loop
+    so the resume path realigns counters/RNG/iterator and continues."""
+
+
+def is_device_loss_error(e: BaseException) -> bool:
+    """Permanent device loss, by shape: XLA surfaces a dead chip as an
+    ``UNAVAILABLE`` status mentioning the device (jaxlib's
+    ``XlaRuntimeError`` has no stable class hierarchy to catch), and the
+    injection harness's :class:`InjectedDeviceLoss` is shaped the same
+    way on purpose."""
+    msg = f"{type(e).__name__}: {e}".lower()
+    return (isinstance(e, _inj.InjectedDeviceLoss) or
+            "device_unavailable" in msg or
+            ("unavailable" in msg and "device" in msg) or
+            "device is unhealthy" in msg)
+
+
+class ElasticSupervisor(FaultTolerantTrainer):
+    """A :class:`FaultTolerantTrainer` that survives hardware churn.
+
+    ``model`` MUST be a mesh facade exposing ``mesh``/``trainer()``/
+    ``remesh()`` (a :class:`~deeplearning4j_tpu.parallel.wrapper.
+    ParallelWrapper`) — elasticity is a property of the mesh, not of a
+    bare net.
+
+    Extra knobs on top of the base supervisor:
+
+    - ``elasticGrow`` — re-mesh up when the availability probe reports
+      more devices (checked at checkpoint boundaries); off, the run
+      stays on its shrunken mesh until restart.
+    - ``maxRemeshes`` — total shrink budget before giving up with
+      :class:`ElasticCapacityError` (a pod losing chips every minute is
+      an incident, not churn).
+    - ``stragglerRatio``/``stragglerPatience`` — evict a replica/host
+      whose step-time gauge exceeds ``ratio`` x the (lower) median for
+      ``patience`` consecutive checkpoint boundaries.  ``hostDevices``
+      maps a gauge label (a federated host id) to its device ids; a
+      label that parses as an int is taken as a device id directly.
+    - ``availableDevices`` — the availability probe: a callable
+      returning the devices currently usable.  The default is
+      ``jax.devices()`` minus the injection harness's lost set minus
+      evicted devices; real deployments plug in their fleet health
+      source here.
+
+    Defaults ``asyncSeal=True``: an elastic run checkpoints often enough
+    that joining every tensorstore write would dominate; the manifest
+    seals on a background thread instead.
+    """
+
+    def __init__(self, model, checkpointDir: str, *,
+                 elasticGrow: bool = True, maxRemeshes: int = 8,
+                 stragglerRatio: Optional[float] = None,
+                 stragglerPatience: int = 2,
+                 hostDevices: Optional[Dict[str, Sequence[int]]] = None,
+                 availableDevices: Optional[Callable[[], list]] = None,
+                 asyncSeal: bool = True, **kw):
+        super().__init__(model, checkpointDir, asyncSeal=asyncSeal, **kw)
+        if self.wrapper is None or not hasattr(self.wrapper, "remesh"):
+            raise ValueError(
+                "ElasticSupervisor needs a mesh facade (ParallelWrapper) "
+                "— elasticity is a property of the mesh, not a bare net")
+        self.elasticGrow = bool(elasticGrow)
+        self.maxRemeshes = int(maxRemeshes)
+        self.stragglerRatio = None if stragglerRatio is None \
+            else float(stragglerRatio)
+        self.stragglerPatience = max(1, int(stragglerPatience))
+        self.hostDevices = {str(k): tuple(int(d) for d in v)
+                            for k, v in (hostDevices or {}).items()}
+        self._availableDevices = availableDevices
+        # the elastic DOMAIN: the original mesh's devices.  Availability
+        # fluctuates WITHIN it — grow returns lost capacity, it never
+        # annexes chips the operator didn't give this run
+        self._domainIds = set(self.wrapper.mesh.deviceIds())
+        self._evicted: set = set()
+        self._stragglerStreak: Dict[tuple, int] = {}
+        self.stats["remeshes"] = []
+        elastic_metrics().mesh_devices().set(
+            self.wrapper.mesh.numDevices())
+
+    # -- availability ---------------------------------------------------
+    def _usableDevices(self) -> list:
+        if self._availableDevices is not None:
+            devs = list(self._availableDevices())
+        else:
+            import jax
+            devs = list(jax.devices())
+        lost = _inj.lost_device_ids()
+        out = []
+        for i, d in enumerate(devs):
+            # jaxlint: sync-ok -- device .id is a Python int from the backend client, not a device scalar
+            did = int(getattr(d, "id", i))
+            if did in self._domainIds and did not in lost \
+                    and did not in self._evicted:
+                out.append(d)
+        return out
+
+    def _rebuiltMesh(self):
+        """Largest valid mesh from currently usable devices, preserving
+        the non-data axes (see ``DeviceMesh.largest_from``)."""
+        from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+        old = self.wrapper.mesh
+        return DeviceMesh.largest_from(
+            self._usableDevices(), model=old.modelSize,
+            seq=old.seqSize, stage=old.stageSize)
+
+    # -- the reshard path (shared by shrink / grow / evict) -------------
+    def _remesh(self, newMesh, direction: str, reshard: bool,
+                reason: str) -> None:
+        wr = self.wrapper
+        old = wr.mesh
+        t0 = time.perf_counter()
+        with tracer().span("elastic_remesh", direction=direction,
+                           from_devices=old.numDevices(),
+                           to_devices=newMesh.numDevices()):
+            wr.remesh(newMesh, reshard=reshard)
+            self._realignIterator()
+        dt = time.perf_counter() - t0
+        em = elastic_metrics()
+        em.remeshes().inc(direction=direction)
+        em.remesh_seconds().observe(dt)
+        em.mesh_devices().set(newMesh.numDevices())
+        entry = {"direction": direction, "reason": reason,
+                 "fromDevices": old.deviceIds(),
+                 "toDevices": newMesh.deviceIds(),
+                 # jaxlint: sync-ok -- iterationCount is a host-side Python counter
+                 "iteration": int(self.net.iterationCount),
+                 "seconds": round(dt, 6)}
+        self.stats["remeshes"].append(entry)
+        flight_recorder().record(event="remesh", **entry)
+        self._note("remesh", **entry)
+        log.warning("elastic re-mesh (%s): %d -> %d devices at iteration "
+                    "%d (%s)", direction, old.numDevices(),
+                    newMesh.numDevices(), self.net.iterationCount, reason)
+
+    def _realignIterator(self) -> None:
+        """Retarget the active input pipeline to the new mesh: the H2D
+        staging ring's batch sharding changed, and (multi-process pods)
+        the ShardSpec host slot may have — a host that left the mesh
+        must stop owning stream shards."""
+        it = self._activeIterator
+        if it is None:
+            return
+        wr = self.wrapper
+        if hasattr(it, "setDevice"):
+            device = None
+            if wr.mesh.dataSize > 1 and wr.mesh.stageSize == 1:
+                device = wr.trainer().plan.batch_sharding()
+            it.setDevice(device)
+
+    # -- restore-into-the-plan (the checkpoint reshard) -----------------
+    def _restoreShardings(self):
+        wr = self.wrapper
+        if wr.mesh.stageSize > 1:
+            # stage meshes restore per-layer trees and restack GPipe rows
+            # via placeAfterRestore — the plan has no per-param shardings
+            return None
+        net = self.net
+        if not getattr(net, "params_", None):
+            return None
+        plan = wr.trainer().plan
+        return {"params": plan.param_shardings(net),
+                "optState": plan.opt_shardings(net),
+                "rest": plan.mesh.replicated()}
+
+    # -- shrink on device loss ------------------------------------------
+    def _superviseStep(self, ds) -> None:
+        try:
+            super()._superviseStep(ds)
+        except Exception as e:
+            if not is_device_loss_error(e):
+                raise
+            self._onDeviceLoss(e)
+
+    def _onDeviceLoss(self, exc: BaseException) -> None:
+        elastic_metrics().device_losses().inc()
+        self._note("device_loss", reason=str(exc)[:300],
+                   iteration=self.net.iterationCount)
+        old = self.wrapper.mesh
+        try:
+            newMesh = self._rebuiltMesh()
+        except ValueError as e:
+            reason = (f"device loss with no rebuildable mesh: {e} "
+                      f"(original: {exc})")
+            record_crash(reason, model=self.net)
+            raise ElasticCapacityError(reason) from exc
+        if set(newMesh.deviceIds()) == set(old.deviceIds()):
+            # the probe can't see the loss — re-meshing onto the same
+            # devices would loop forever; surface the original error
+            raise exc
+        # reshard=False: the state that died mid-update is not trusted —
+        # the sealed checkpoint reshards directly into the new placement
+        # on the resume path (_restoreShardings)
+        self._remesh(newMesh, "shrink", reshard=False,
+                     reason=f"device loss: {exc}")
+        raise _RemeshRestart()
+
+    # -- grow / evict at checkpoint boundaries --------------------------
+    def _checkpoint(self, stepInEpoch: int) -> None:
+        super()._checkpoint(stepInEpoch)
+        self._maybeEvict()
+        self._maybeGrow()
+
+    def _maybeGrow(self) -> None:
+        if not self.elasticGrow:
+            return
+        old = self.wrapper.mesh
+        try:
+            newMesh = self._rebuiltMesh()
+        except ValueError:
+            return
+        if newMesh.numDevices() <= old.numDevices():
+            return
+        # the state is intact (we are AT a sealed checkpoint): live
+        # plan-to-plan reshard, no restore, no step replay
+        self._remesh(newMesh, "grow", reshard=True,
+                     reason="capacity returned")
+
+    def _devicesFor(self, cellKey: Iterable[str]) -> set:
+        """Device ids behind one replica-gauge cell: the ``hostDevices``
+        mapping first (federated host labels), else any label that
+        parses as an int is a device id (the local timing listener's
+        convention)."""
+        ids: set = set()
+        for label in cellKey:
+            if label in self.hostDevices:
+                ids.update(self.hostDevices[label])
+            else:
+                try:
+                    # jaxlint: sync-ok -- gauge label values are Python strings, not device scalars
+                    ids.add(int(label))
+                except (TypeError, ValueError):
+                    pass
+        return ids
+
+    def _stragglerRegistry(self):
+        reg = get_registry()
+        if self.healthMonitor is not None and \
+                getattr(self.healthMonitor, "federated", False):
+            from deeplearning4j_tpu.telemetry.federation import (
+                TelemetryAggregator, get_federation_dir)
+            run_dir = get_federation_dir()
+            if run_dir is not None:
+                try:
+                    return TelemetryAggregator(
+                        run_dir, localRegistry=reg).merged()
+                except Exception:
+                    pass
+        return reg
+
+    def _maybeEvict(self) -> None:
+        if self.stragglerRatio is None:
+            return
+        m = self._stragglerRegistry().get(
+            replica_step_gauge().name)
+        if m is None:
+            return
+        meshIds = set(self.wrapper.mesh.deviceIds())
+        cells = []
+        for key, v in m.data().get("cells", []):
+            key = tuple(key)
+            # only cells actionable on THIS mesh participate: a cell
+            # whose devices left the mesh (lost or evicted) goes stale —
+            # the new timing listener never overwrites it — and would
+            # otherwise win max() forever and block real evictions; an
+            # unmappable label can't be evicted either way
+            if not (self._devicesFor(key) & meshIds):
+                continue
+            # jaxlint: sync-ok -- registry gauge cells hold Python floats, not device scalars
+            cells.append((key, float(v)))
+        if len(cells) < 2:
+            return
+        vals = sorted(v for _k, v in cells)
+        # lower median, same rationale as ReplicaStragglerRule: the
+        # worst cell must compare against the healthy half
+        median = vals[(len(vals) - 1) // 2]
+        if median <= 0:
+            return
+        worstKey, worst = max(cells, key=lambda kv: kv[1])
+        if worst <= self.stragglerRatio * median:
+            self._stragglerStreak.pop(worstKey, None)
+            return
+        streak = self._stragglerStreak.get(worstKey, 0) + 1
+        self._stragglerStreak[worstKey] = streak
+        if streak < self.stragglerPatience:
+            return
+        self._stragglerStreak.pop(worstKey, None)
+        evictIds = self._devicesFor(worstKey) & meshIds
+        if not evictIds or evictIds == meshIds:
+            return      # nothing of the mesh to evict, or all of it
+        self._evicted |= evictIds
+        try:
+            newMesh = self._rebuiltMesh()
+        except ValueError:
+            self._evicted -= evictIds   # eviction would kill the mesh
+            return
+        elastic_metrics().evictions().inc()
+        self._note("straggler_evicted",
+                   replica="/".join(worstKey), devices=sorted(evictIds),
+                   stepSeconds=worst, medianSeconds=median)
+        # live reshard: the straggler is slow, not wrong — its state is
+        # coherent, so no checkpoint restore, just a smaller mesh
+        self._remesh(newMesh, "evict", reshard=True,
+                     reason=f"straggler {'/'.join(worstKey)}: "
+                            f"{worst:.4g}s vs median {median:.4g}s")
+
+    # -- the outer loop: restart-and-resume after a shrink --------------
+    def _fit(self, iterator, epochs: int) -> None:
+        remeshes = 0
+        while True:
+            try:
+                super()._fit(iterator, epochs)
+                return
+            except _RemeshRestart:
+                remeshes += 1
+                if remeshes > self.maxRemeshes:
+                    reason = (f"re-mesh budget exhausted "
+                              f"({self.maxRemeshes}) — the pod is "
+                              "shedding devices faster than it trains")
+                    record_crash(reason, model=self.net)
+                    raise ElasticCapacityError(reason)
+                # resume from the sealed checkpoint: restore lands
+                # directly in the new plan's shardings and the epoch
+                # loop fast-forwards the stream to stepInEpoch
+                self.resume = True
+                continue

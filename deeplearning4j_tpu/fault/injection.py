@@ -32,10 +32,13 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = [
-    "SimulatedPreemption", "InjectedOOM", "Fault", "NaNAtStep",
-    "PreemptAtStep", "OOMAtStep", "StallAtStep", "CorruptCheckpointAtStep",
-    "FailingFetch", "SlowFetch", "FaultInjector", "set_injector",
-    "get_injector", "clear_injector", "inject", "corrupt_checkpoint",
+    "SimulatedPreemption", "InjectedOOM", "InjectedDeviceLoss", "Fault",
+    "NaNAtStep", "PreemptAtStep", "OOMAtStep", "StallAtStep",
+    "CorruptCheckpointAtStep", "DeviceLossAtStep", "RestoreCapacityAtStep",
+    "StragglerReplica", "FailingFetch", "SlowFetch", "FaultInjector",
+    "set_injector", "get_injector", "clear_injector", "inject",
+    "corrupt_checkpoint", "lose_devices", "restore_devices",
+    "lost_device_ids", "clear_lost_devices",
 ]
 
 
@@ -54,6 +57,47 @@ class InjectedOOM(RuntimeError):
         super().__init__(
             f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
             f"device buffer ({note})")
+
+
+class InjectedDeviceLoss(RuntimeError):
+    """Shaped like XLA's permanent-device-loss error (``UNAVAILABLE``
+    status + a device mention) so the elastic supervisor's matcher
+    (:func:`~deeplearning4j_tpu.fault.elastic.is_device_loss_error`)
+    treats it exactly like a dead chip."""
+
+    def __init__(self, device_ids=(), note: str = "injected"):
+        self.device_ids = tuple(int(d) for d in device_ids)
+        super().__init__(
+            f"UNAVAILABLE: device(s) {list(self.device_ids)} lost "
+            f"({note}); the accelerator is permanently unreachable")
+
+
+# -- simulated device availability -----------------------------------------
+# The set of device ids currently "dead" from the injection harness's
+# point of view.  ElasticSupervisor's default availability probe consults
+# this (real deployments override the probe); inject() clears it on exit
+# so one test's dead chips never leak into the next.
+
+_LOST_DEVICES: set = set()
+
+
+def lose_devices(ids) -> None:
+    """Mark device ids as permanently lost (until restore_devices)."""
+    _LOST_DEVICES.update(int(i) for i in ids)
+
+
+def restore_devices(ids) -> None:
+    """Return previously lost device ids to the available pool (the
+    capacity-returns half of the elastic grow/shrink cycle)."""
+    _LOST_DEVICES.difference_update(int(i) for i in ids)
+
+
+def lost_device_ids() -> frozenset:
+    return frozenset(_LOST_DEVICES)
+
+
+def clear_lost_devices() -> None:
+    _LOST_DEVICES.clear()
 
 
 class Fault:
@@ -158,6 +202,66 @@ class CorruptCheckpointAtStep(Fault):
             _corrupt_tree(step_path)
 
 
+class DeviceLossAtStep(Fault):
+    """Permanently kill device ids right before step ``step``: registers
+    them in the lost-device set (the elastic supervisor's availability
+    probe stops seeing them) and raises :class:`InjectedDeviceLoss`.
+    One-shot — a re-mesh that resumes past ``step`` must not re-lose the
+    same chips."""
+
+    def __init__(self, step: int, devices=(0,)):
+        self.step = int(step)
+        self.devices = tuple(int(d) for d in devices)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step == self.step:
+            self.fired = True
+            lose_devices(self.devices)
+            raise InjectedDeviceLoss(self.devices,
+                                     note=f"before step {step}")
+
+
+class RestoreCapacityAtStep(Fault):
+    """Return previously lost device ids to the pool once the iteration
+    count reaches ``step`` (``>=``, not ``==`` — rollbacks can skip the
+    exact number) — the grow-back half of an elastic test.  The
+    supervisor notices at its next checkpoint boundary."""
+
+    def __init__(self, step: int, devices=(0,)):
+        self.step = int(step)
+        self.devices = tuple(int(d) for d in devices)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step >= self.step:
+            self.fired = True
+            restore_devices(self.devices)
+
+
+class StragglerReplica(Fault):
+    """Publish a chronically slow step-time cell into the replica gauge
+    (``dl4j_tpu_parallel_replica_step_seconds``) under label
+    ``replica=<replica>`` from step ``fromStep`` on — the deterministic
+    stand-in for a slow HOST whose gauge arrives host-labeled through
+    the federation layer.  Use a label the local timing listener does
+    not own (it overwrites its own device-id cells every step) and map
+    it to device ids via ``ElasticSupervisor(hostDevices=...)``."""
+
+    def __init__(self, replica: str, seconds: float = 10.0,
+                 fromStep: int = 0):
+        self.replica = str(replica)
+        self.seconds = float(seconds)
+        self.fromStep = int(fromStep)
+
+    def before_step(self, step, net, ds):
+        if step < self.fromStep:
+            return None
+        from deeplearning4j_tpu.telemetry.instrument import \
+            replica_step_gauge
+        replica_step_gauge().set(self.seconds, replica=self.replica)
+
+
 class FailingFetch(Fault):
     """Fail the first ``times`` real-data fetch attempts for dataset
     ``what`` (None = any) — exercises the fetchers' bounded retry and
@@ -236,13 +340,16 @@ def clear_injector() -> None:
 
 @contextlib.contextmanager
 def inject(*faults: Fault):
-    """Activate an injector for the duration of a with-block."""
+    """Activate an injector for the duration of a with-block.  On exit
+    the simulated lost-device set is cleared too — one test's dead chips
+    must not bleed into the next test's availability probe."""
     prev = get_injector()
     set_injector(FaultInjector(*faults))
     try:
         yield get_injector()
     finally:
         set_injector(prev)
+        clear_lost_devices()
 
 
 def check_fetch_fault(what: str) -> None:

@@ -31,9 +31,10 @@ Usage::
     trainer = FaultTolerantTrainer(net, "/ckpts/run1", checkpointEveryN=50)
     trainer.fit(iterator, epochs=10)    # re-run after a kill: auto-resumes
 
-Not covered (ROADMAP "Open items"): elastic re-mesh on permanent device
-loss — a dead chip still needs an operator/scheduler to replace the slice;
-we only guarantee the restarted job resumes losslessly.
+Permanent device loss is covered one layer up:
+:class:`~deeplearning4j_tpu.fault.elastic.ElasticSupervisor` extends this
+class with shrink-on-device-loss / grow-on-recovery re-meshing through
+the plan-to-plan reshard path (ROADMAP item 4).
 """
 from __future__ import annotations
 
@@ -118,7 +119,8 @@ class FaultTolerantTrainer:
                  maxMicroBatchSplits: int = 2, resume: bool = True,
                  injector: Optional["_inj.FaultInjector"] = None,
                  healthMonitor=None,
-                 durableExport: bool = True):
+                 durableExport: bool = True,
+                 asyncSeal: bool = False):
         self.wrapper = model if hasattr(model, "model") else None
         self.net = model.model if self.wrapper is not None else model
         self.ckpt = ShardedCheckpointer(checkpointDir, keepLast=keepLast)
@@ -138,6 +140,13 @@ class FaultTolerantTrainer:
         # supervised batch job that dies unscraped still leaves its
         # counters and crash record on disk
         self.durableExport = bool(durableExport)
+        # async manifest sealing: the checkpoint cadence no longer joins
+        # the orbax tensorstore write (ElasticSupervisor's default; see
+        # ShardedCheckpointer.saveWithManifest(block=))
+        self.asyncSeal = bool(asyncSeal)
+        # the (possibly prefetch-wrapped) iterator of the CURRENT fit —
+        # the elastic re-mesh path retargets its H2D staging/ShardSpec
+        self._activeIterator = None
         self.lastLoss: Optional[float] = None
         self.stats: Dict[str, Any] = {"rollbacks": 0, "oomSplits": 0,
                                       "resumedFromStep": None,
@@ -170,7 +179,8 @@ class FaultTolerantTrainer:
             step = self.ckpt.saveWithManifest(
                 self.net, metadata={"stepInEpoch": int(stepInEpoch),
                                     "epoch": int(self.net.epochCount),
-                                    "lrScale": self._lrScale()})
+                                    "lrScale": self._lrScale()},
+                block=not self.asyncSeal)
         self.stats["checkpoints"] += 1
         get_registry().counter(
             "dl4j_tpu_fault_checkpoints_total",
@@ -188,11 +198,19 @@ class FaultTolerantTrainer:
         self._timedRestore(step)
         return step
 
+    def _restoreShardings(self):
+        """Target shardings for restore, or None for the live-template
+        default.  ``ElasticSupervisor`` overrides this with the current
+        ShardingPlan's shardings so a checkpoint written on one mesh
+        restores directly INTO a different mesh's placement."""
+        return None
+
     def _timedRestore(self, step: int) -> None:
         reg = get_registry()
         t0 = time.perf_counter()
         with tracer().span("checkpoint_restore", step=step):
-            self.ckpt.restore(self.net, step=step)
+            self.ckpt.restore(self.net, step=step,
+                              shardings=self._restoreShardings())
             # mesh-trainer hook: restored arrays land on one device —
             # re-assert the ShardingPlan placement (stage meshes restack
             # their GPipe rows) before the next supervised step
@@ -241,9 +259,11 @@ class FaultTolerantTrainer:
                         not self.healthMonitor.is_running())
         if owns_monitor:
             self.healthMonitor.start()
+        self._activeIterator = iterator
         try:
             self._fit(iterator, epochs)
         finally:
+            self._activeIterator = None
             if iterator is not src:
                 iterator.close()
             if owns_monitor:

@@ -55,6 +55,31 @@ class DeviceMesh:
         self.dataSize, self.modelSize = data, model
         self.seqSize, self.stageSize = seq, stage
 
+    # -- elastic rebuild ------------------------------------------------
+    @classmethod
+    def largest_from(cls, devices: Sequence, model: int = 1, seq: int = 1,
+                     stage: int = 1) -> "DeviceMesh":
+        """Largest valid mesh buildable from ``devices`` that preserves
+        the non-data axis sizes — the elastic re-mesh rule: a lost chip
+        shrinks the *data* axis (pure replica loss), never the tensor/
+        sequence/pipeline factorization the executable's math depends
+        on.  Raises ``ValueError`` when fewer than ``model*seq*stage``
+        devices survive (no valid mesh exists at this factorization)."""
+        devices = list(devices)
+        rest = int(model) * int(seq) * int(stage)
+        usable = (len(devices) // rest) * rest
+        if usable < rest:
+            raise ValueError(
+                f"{len(devices)} surviving devices cannot host a mesh "
+                f"with model*seq*stage={rest}")
+        return cls(data=usable // rest, model=model, seq=seq, stage=stage,
+                   devices=devices[:usable])
+
+    def deviceIds(self):
+        """The participating device ids, flat (re-mesh bookkeeping)."""
+        return [int(getattr(d, "id", i))
+                for i, d in enumerate(self.mesh.devices.flat)]
+
     # -- shardings ------------------------------------------------------
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())

@@ -50,7 +50,53 @@ from deeplearning4j_tpu.parallel.mesh import (DeviceMesh, activate_mesh,
                                               _dense_tp_spec)
 from deeplearning4j_tpu.parallel.zero import _leaf_spec
 
-__all__ = ["ShardingPlan", "MeshTrainer", "active_plan", "activate_plan"]
+__all__ = ["ShardingPlan", "MeshTrainer", "active_plan", "activate_plan",
+           "reshard_tree"]
+
+
+def _identity(tree):
+    return tree
+
+
+def reshard_tree(tree, shardings):
+    """Plan-to-plan reshard: move a pytree onto ``shardings`` device-side.
+
+    Two lowerings, both free of a host round-trip:
+
+    - **same device set** (the plan changed but the chips didn't — e.g.
+      a TP/ZeRO layout change, or an axis refactorization over the same
+      slice): ONE jitted identity executable with explicit
+      ``out_shardings`` — GSPMD lowers the move to pure on-device
+      collective gather/scatter, and the donated input buffers are
+      aliased or freed as each leaf lands;
+    - **different device sets** (elastic shrink/grow: chips left or
+      joined): ``jax.device_put`` onto the target shardings, which XLA
+      services with device-to-device copies where the runtime supports
+      them.
+
+    A deliberate re-mesh compiles a fresh executable by design — that
+    is the cost of changing the mesh, paid once per re-mesh, not per
+    step."""
+    if tree is None:
+        return None
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves and all(hasattr(leaf, "sharding") for leaf in leaves):
+        src = set()
+        for leaf in leaves:
+            src |= set(leaf.sharding.device_set)
+        dst = set()
+        for sh in jax.tree_util.tree_leaves(shardings):
+            dst |= set(sh.device_set)
+        if src == dst:
+            try:
+                # jaxlint: disable=retrace-closure -- a re-mesh IS a one-shot recompile by design: new shardings => new executable, paid once per re-mesh, never per step
+                return jax.jit(_identity, out_shardings=shardings,
+                               donate_argnums=0)(tree)
+            except Exception:
+                # an out_shardings the compiler rejects (uncommitted
+                # inputs, odd layouts) still reshards correctly below
+                pass
+    return jax.device_put(tree, shardings)
 
 
 #: the ShardingPlan the enclosing MeshTrainer step is compiling against —
@@ -479,6 +525,52 @@ class MeshTrainer:
             net.setBatchSharding(None)
         self._record(net.iterationCount - it0, time.perf_counter() - t0,
                      self.jitCacheSize() - misses0)
+
+    # -- elastic re-mesh ------------------------------------------------
+    def remesh(self, plan: ShardingPlan, reshard: bool = True) -> None:
+        """Adopt a new :class:`ShardingPlan` (elastic shrink/grow or a
+        deliberate layout change) and invalidate the installed
+        executable so the next step compiles against the new mesh.
+
+        ``reshard=True`` moves the LIVE params/optimizer state onto the
+        new plan's shardings via :func:`reshard_tree` (device-side; the
+        grow / straggler-eviction path, where the training state is
+        intact).  ``reshard=False`` only swaps the plan — the caller is
+        about to restore a sealed checkpoint directly INTO the new
+        placement (the shrink-on-device-loss path, where the state that
+        died mid-step cannot be trusted)."""
+        net = self.net
+        self.plan = plan
+        self._bytes = None
+        self._pipeline = None
+        self._pipeline_src = None
+        if reshard and net.params_ is not None \
+                and plan.mesh.stageSize == 1:
+            net.params_ = reshard_tree(net.params_,
+                                       plan.param_shardings(net))
+            osh = plan.opt_shardings(net)
+            if net.optState_ is not None and osh is not None:
+                net.optState_ = reshard_tree(net.optState_, osh)
+            # EVERY step input must land on the new device set or the
+            # jitted step mixes device assignments: aux layer state, the
+            # training RNG key and rnn carries are replicated, so a
+            # broadcast placement is their reshard
+            rep = NamedSharding(plan.mesh.mesh, P())
+            if getattr(net, "state_", None):
+                net.state_ = jax.device_put(net.state_, rep)
+            if getattr(net, "_fitKey", None) is not None:
+                net._fitKey = jax.device_put(net._fitKey, rep)
+            if getattr(net, "_rnnCarries", None):
+                net._rnnCarries = jax.device_put(net._rnnCarries, rep)
+        # _stepFn included: it is a cached_property, and JAX's jaxpr
+        # cache keys on the underlying function identity + avals (NOT
+        # shardings) — reusing the object would resurrect the OLD mesh's
+        # baked-in with_sharding_constraint equations on the new mesh
+        for k in ("_trainStep", "_outputFn", "_scoreFn", "_stepFn"):
+            net.__dict__.pop(k, None)
+        net._meshTrace = None
+        self._jit = None
+        self._jitKey = None
 
     # -- supervision hooks ----------------------------------------------
     def syncToNet(self) -> None:

@@ -103,6 +103,28 @@ class ParallelWrapper:
             self._trainer = tr
         return tr
 
+    def remesh(self, mesh: DeviceMesh, reshard: bool = True) -> None:
+        """Swap this wrapper onto a different mesh (elastic shrink/grow,
+        straggler eviction).  Rebuilds the ShardingPlan with the same
+        TP/ZeRO flags, reshards live state through the trainer's
+        plan-to-plan path (``reshard=True``; a shrink that is about to
+        restore a sealed checkpoint passes ``False``), and resets the
+        per-replica timing listener — its device list is stale."""
+        from deeplearning4j_tpu.parallel.meshtrainer import (MeshTrainer,
+                                                             ShardingPlan)
+        self.mesh = mesh
+        plan = ShardingPlan.for_model(self.model, mesh,
+                                      tensorParallel=self.tensorParallel)
+        if self._trainer is not None and self._trainer.net is self.model:
+            self._trainer.remesh(plan, reshard=reshard)
+        else:
+            self._trainer = MeshTrainer(self.model, plan=plan)
+        self._replicaTimer = None
+        get_registry().gauge(
+            "dl4j_tpu_parallel_replicas",
+            "Devices participating in the data-parallel mesh").set(
+                mesh.numDevices())
+
     # -- API -------------------------------------------------------------
     def fit(self, iterator, epochs: int = 1) -> None:
         """Train with batches sharded across the mesh's data axis.

@@ -39,7 +39,7 @@ from deeplearning4j_tpu.telemetry.registry import (Counter, Gauge, Histogram,
 
 __all__ = ["SnapshotWriter", "TelemetryAggregator", "host_id",
            "set_federation_dir", "get_federation_dir",
-           "federated_exposition"]
+           "federated_exposition", "reset_counter_smoothing"]
 
 _SNAPSHOT_PREFIX = "metrics_"
 #: tri-state: _UNSET -> fall back to the env var; None -> explicitly
@@ -185,14 +185,66 @@ class SnapshotWriter:
             self.write_now(reason="stop")
 
 
+# -- counter-reset smoothing ----------------------------------------------
+# A restarted worker re-zeroes its counters; summed naively, the
+# federated total DROPS and every rate() over it goes negative for one
+# window (and loses the pre-restart total forever).  The aggregator
+# instead treats a per-(run,host,metric,cell) decrease as a reset and
+# accumulates a monotonic offset: reported = offset + current.  State is
+# module-global because aggregators are constructed per scrape.
+_smooth_lock = threading.Lock()
+_smooth_state: Dict[tuple, list] = {}    # key -> [last_seen, offset]
+
+
+def _monotonic_counter(runDir: str, host: str, name: str, cellKey: tuple,
+                       v: float) -> float:
+    key = (runDir, host, name, cellKey)
+    with _smooth_lock:
+        st = _smooth_state.setdefault(key, [v, 0.0])
+        if v < st[0]:
+            # the worker restarted and re-zeroed: fold the pre-restart
+            # total into the offset so the federated series stays
+            # monotonic (rate() sees a flat spot, not a cliff)
+            st[1] += st[0]
+        st[0] = v
+        return v + st[1]
+
+
+def reset_counter_smoothing(runDir: Optional[str] = None) -> None:
+    """Forget accumulated reset offsets — for ``runDir`` only, or all
+    (tests; a genuinely new run should use a new directory instead)."""
+    with _smooth_lock:
+        if runDir is None:
+            _smooth_state.clear()
+        else:
+            for k in [k for k in _smooth_state if k[0] == runDir]:
+                del _smooth_state[k]
+
+
+def _prune_smoothing(runDir: str, liveHosts) -> None:
+    """Drop smoothing state for hosts no longer present in ``runDir``'s
+    merge (run directory cleaned up, or pid-suffixed host ids churned
+    by restarts) — a long-lived scraping process must not grow state
+    for every host it EVER saw.  A host whose snapshot is merely torn
+    for one scrape re-baselines on return: its next value counts as a
+    fresh start, which only under-reports, never double-counts."""
+    live = set(liveHosts)
+    with _smooth_lock:
+        for k in [k for k in _smooth_state
+                  if k[0] == runDir and k[1] not in live]:
+            del _smooth_state[k]
+
+
 def _merge_scalar(merged: MetricsRegistry, name: str, data: dict,
-                  host: str) -> None:
+                  host: str, runDir: str = "") -> None:
     labelnames = tuple(data.get("labelnames") or ())
     help_ = data.get("help", "")
     if data["type"] == "counter":
         c = merged.counter(name, help_, labelnames)
         for key, v in data.get("cells", []):
-            c.inc(float(v), **dict(zip(labelnames, key)))
+            v = _monotonic_counter(runDir, host, name, tuple(key),
+                                   float(v))
+            c.inc(v, **dict(zip(labelnames, key)))
     else:
         g = merged.gauge(name, help_, labelnames + ("host",))
         for key, v in data.get("cells", []):
@@ -292,13 +344,15 @@ class TelemetryAggregator:
                     if data["type"] == "histogram":
                         _merge_histogram(merged, name, data, host)
                     elif data["type"] in ("counter", "gauge"):
-                        _merge_scalar(merged, name, data, host)
+                        _merge_scalar(merged, name, data, host,
+                                      runDir=self.runDir)
                 except (ValueError, KeyError, TypeError):
                     self.skipped.append(f"{name}@{host}")
         g = merged.gauge("dl4j_tpu_federation_hosts",
                          "Worker snapshots merged into this federated "
                          "view (coordinator's own registry included)")
         g.set(len(self.hosts))
+        _prune_smoothing(self.runDir, self.hosts)
         return merged
 
     def exposition(self) -> str:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue as _queue
 import tempfile
 import threading
 import time
@@ -280,7 +281,10 @@ class HealthMonitor:
                  interval: float = 5.0,
                  eventLogPath: Optional[str] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 federated: bool = False):
+                 federated: bool = False,
+                 webhookUrl: Optional[str] = None,
+                 webhookTimeout: float = 2.0, webhookRetries: int = 3,
+                 webhookBackoff: float = 0.1, webhookQueueSize: int = 256):
         self.rules = list(rules) if rules is not None else default_rules()
         self.interval = float(interval)
         self._eventLogPath = eventLogPath
@@ -290,6 +294,18 @@ class HealthMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._log_lock = threading.Lock()
+        # webhook alert delivery: firing/resolved transitions POST to
+        # webhookUrl from a dedicated sender thread — the watchdog only
+        # ever enqueues (put_nowait), so a dead endpoint can delay
+        # deliveries, never rule evaluation
+        self.webhookUrl = webhookUrl
+        self.webhookTimeout = float(webhookTimeout)
+        self.webhookRetries = max(1, int(webhookRetries))
+        self.webhookBackoff = float(webhookBackoff)
+        self._whQ: Optional[_queue.Queue] = None
+        self._whQueueSize = int(webhookQueueSize)
+        self._whStop = threading.Event()
+        self._whThread: Optional[threading.Thread] = None
 
     @property
     def eventLogPath(self) -> str:
@@ -382,12 +398,89 @@ class HealthMonitor:
 
     def _transition(self, rule: str, state: str, detail: str) -> None:
         from deeplearning4j_tpu.telemetry.federation import host_id
-        self._append({"ts": time.time(), "host": host_id(), "rule": rule,
-                      "state": state, "detail": detail})
+        record = {"ts": time.time(), "host": host_id(), "rule": rule,
+                  "state": state, "detail": detail}
+        self._append(record)
         self._reg().counter(
             "dl4j_tpu_health_alert_transitions_total",
             "Watchdog firing/resolved edges",
             labelnames=("rule", "state")).inc(rule=rule, state=state)
+        self._enqueueWebhook(record)
+
+    # -- webhook delivery ------------------------------------------------
+    def _enqueueWebhook(self, record: dict) -> None:
+        """Hand a transition to the sender thread.  NEVER blocks: a full
+        queue (endpoint down for a long time) drops the oldest-undelivered
+        semantics in favor of protecting the watchdog — drops are counted
+        in ``dl4j_tpu_health_webhook_dropped_total``."""
+        if self.webhookUrl is None:
+            return
+        self._ensureSender()
+        try:
+            self._whQ.put_nowait(record)
+        except _queue.Full:
+            self._reg().counter(
+                "dl4j_tpu_health_webhook_dropped_total",
+                "Alert webhook payloads dropped because the delivery "
+                "queue was full (endpoint down or too slow)").inc()
+
+    def _ensureSender(self) -> None:
+        if self._whThread is not None and self._whThread.is_alive():
+            return
+        if self._whQ is None:
+            self._whQ = _queue.Queue(maxsize=self._whQueueSize)
+        self._whStop.clear()
+        self._whThread = threading.Thread(
+            target=self._webhookLoop, name="telemetry-health-webhook",
+            daemon=True)
+        self._whThread.start()
+
+    def _webhookLoop(self) -> None:
+        while True:
+            try:
+                record = self._whQ.get(timeout=0.2)
+            except _queue.Empty:
+                if self._whStop.is_set():
+                    return
+                continue
+            self._deliverWebhook(record)
+
+    def _deliverWebhook(self, record: dict) -> None:
+        """One POST with bounded retry + exponential backoff.  Runs on
+        the sender thread only; a permanently failing delivery is
+        counted and logged to the event file, never raised."""
+        import urllib.request
+        data = json.dumps(record, default=str).encode("utf-8")
+        last = None
+        for attempt in range(self.webhookRetries):
+            try:
+                req = urllib.request.Request(
+                    self.webhookUrl, data=data,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(
+                        req, timeout=self.webhookTimeout) as resp:
+                    status = getattr(resp, "status", 200)
+                    if 200 <= status < 300:
+                        self._reg().counter(
+                            "dl4j_tpu_health_webhook_deliveries_total",
+                            "Alert webhook POSTs by outcome",
+                            labelnames=("status",)).inc(status="ok")
+                        return
+                    last = f"HTTP {status}"
+            except Exception as e:
+                last = f"{type(e).__name__}: {e}"
+            if attempt < self.webhookRetries - 1:
+                time.sleep(self.webhookBackoff * (2 ** attempt))
+        self._reg().counter(
+            "dl4j_tpu_health_webhook_deliveries_total",
+            "Alert webhook POSTs by outcome",
+            labelnames=("status",)).inc(status="failed")
+        from deeplearning4j_tpu.telemetry.federation import host_id
+        self._append({"ts": time.time(), "host": host_id(),
+                      "rule": record.get("rule"), "state": "webhook_error",
+                      "detail": f"delivery failed after "
+                                f"{self.webhookRetries} attempts: {last}"})
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "HealthMonitor":
@@ -426,6 +519,13 @@ class HealthMonitor:
         g = reg.get("dl4j_tpu_health_alerts_firing")
         if g is not None:
             g.set(0.0)
+        # drain-then-stop the webhook sender AFTER resolving, so the
+        # resolved transitions above still deliver (bounded: each pending
+        # payload retries at most webhookRetries times)
+        if self._whThread is not None:
+            self._whStop.set()
+            self._whThread.join(timeout=30.0)
+            self._whThread = None
 
     def is_running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()

@@ -33,7 +33,8 @@ __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "supervised_scope", "microbatch_scope", "in_microbatch",
            "record_logical_step", "ReplicaTimingListener", "etl_metrics",
            "EtlMetrics", "ServingMetrics", "serving_metrics",
-           "MeshMetrics", "mesh_metrics"]
+           "MeshMetrics", "mesh_metrics", "ElasticMetrics",
+           "elastic_metrics", "replica_step_gauge"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -415,6 +416,59 @@ def mesh_metrics() -> MeshMetrics:
     return _MESH_METRICS
 
 
+class ElasticMetrics:
+    """The ``dl4j_tpu_elastic_*`` namespace, registered from ONE site.
+
+    ``fault.elastic.ElasticSupervisor`` reports here: re-mesh events by
+    direction (shrink on device loss, grow on recovered capacity, evict
+    on a chronic straggler), re-mesh latency (mesh rebuild + plan-to-plan
+    reshard + iterator realignment), the current device count, and the
+    raw loss/eviction counters the ops dashboards alert on.  Accessors
+    re-resolve through :func:`get_registry` on every call (tests swap
+    the registry).
+    """
+
+    def remeshes(self):
+        return get_registry().counter(
+            "dl4j_tpu_elastic_remesh_total",
+            "Elastic re-mesh events by direction (shrink = device loss, "
+            "grow = capacity returned, evict = straggler host removed)",
+            labelnames=("direction",))
+
+    def remesh_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_elastic_remesh_seconds",
+            "Wall time of one elastic re-mesh: mesh rebuild + "
+            "plan-to-plan reshard (or resharded checkpoint restore) + "
+            "input-pipeline realignment",
+            buckets=DEFAULT_BUCKETS)
+
+    def mesh_devices(self):
+        return get_registry().gauge(
+            "dl4j_tpu_elastic_mesh_devices",
+            "Devices in the currently active elastic mesh")
+
+    def device_losses(self):
+        return get_registry().counter(
+            "dl4j_tpu_elastic_device_losses_total",
+            "Permanent device losses detected by the elastic supervisor")
+
+    def evictions(self):
+        return get_registry().counter(
+            "dl4j_tpu_elastic_straggler_evictions_total",
+            "Hosts/replicas evicted from the mesh because the "
+            "replica-straggler condition held past its patience")
+
+
+_ELASTIC_METRICS = ElasticMetrics()
+
+
+def elastic_metrics() -> ElasticMetrics:
+    """Accessor for the shared elastic metric namespace (see
+    :class:`ElasticMetrics`)."""
+    return _ELASTIC_METRICS
+
+
 def note_etl_wait(seconds: float, owner) -> None:
     """Record blocking ETL wait incurred outside ``next()``
     (AsyncDataSetIterator blocks in ``hasNext()`` to populate its peek),
@@ -451,6 +505,16 @@ def etl_fetch(iterator):
                 "Cumulative seconds the train loop waited on batch "
                 "fetches").inc(dt)
     return ds
+
+
+def replica_step_gauge():
+    """The per-replica lockstep step-time gauge — registered HERE (one
+    module) and shared by :class:`ReplicaTimingListener`, the straggler
+    watchdog rule, and the fault-injection straggler stand-in."""
+    return get_registry().gauge(
+        "dl4j_tpu_parallel_replica_step_seconds",
+        "Lockstep per-replica step wall time",
+        labelnames=("replica",))
 
 
 class ReplicaTimingListener:
@@ -518,9 +582,7 @@ class ReplicaTimingListener:
         if getattr(_scope, "microbatch", False):
             return      # OOM half-batches are not representative steps
         reg = get_registry()
-        g = reg.gauge("dl4j_tpu_parallel_replica_step_seconds",
-                      "Lockstep per-replica step wall time",
-                      labelnames=("replica",))
+        g = replica_step_gauge()
         for rid in self._device_ids:
             g.set(dt, replica=rid)
         self._times.append(dt)

@@ -13,11 +13,52 @@ import hashlib
 import json
 import logging
 import os
-from typing import Any, Dict, Optional
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
 
 __all__ = ["ShardedCheckpointer"]
 
 log = logging.getLogger(__name__)
+
+
+def _io_retry(fn: Callable, what: str, attempts: int = 3,
+              backoff: float = 0.05, cleanup: Optional[Callable] = None):
+    """Bounded retry with exponential backoff for transient IO errors —
+    one flaky write (NFS hiccup, GCS 5xx surfacing as OSError) must not
+    mark a whole checkpoint step corrupt.  ``cleanup`` runs between
+    attempts (e.g. delete a half-written step so the re-save is clean)."""
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt == attempts - 1:
+                raise
+            log.warning("transient IO error during %s (%s: %s); retry "
+                        "%d/%d", what, type(e).__name__, e, attempt + 1,
+                        attempts - 1)
+            if cleanup is not None:
+                try:
+                    cleanup()
+                except Exception:
+                    pass
+            time.sleep(backoff * (2 ** attempt))
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory so the atomic rename itself is durable (a
+    crash after ``os.replace`` but before the dir entry hits disk would
+    otherwise lose the manifest the data files already paid for)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass        # not all filesystems support dir fsync
+    finally:
+        os.close(fd)
 
 
 class ShardedCheckpointer:
@@ -42,6 +83,10 @@ class ShardedCheckpointer:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=keepLast))
+        # async manifest sealing (saveWithManifest(block=False)): at most
+        # one sealer thread in flight, joined by waitUntilFinished/close
+        self._sealers = []
+        self._sealLock = threading.Lock()
 
     def _tree(self, net) -> Dict[str, Any]:
         tree = {
@@ -70,7 +115,18 @@ class ShardedCheckpointer:
         self._mgr.save(step, args=ocp.args.StandardSave(self._tree(net)))
         return step
 
+    def _joinSealers(self) -> None:
+        # only the training thread mutates the list, so iterating the
+        # attribute directly is race-free here
+        for t in self._sealers:
+            t.join()
+        with self._sealLock:
+            self._sealers = [t for t in self._sealers if t.is_alive()]
+
     def waitUntilFinished(self) -> None:
+        """Join outstanding async work: the orbax tensorstore writes AND
+        any in-flight manifest sealer thread."""
+        self._joinSealers()
         self._mgr.wait_until_finished()
 
     def latestStep(self) -> Optional[int]:
@@ -80,7 +136,7 @@ class ShardedCheckpointer:
     def allSteps(self):
         return list(self._mgr.all_steps())
 
-    def restore(self, net, step: Optional[int] = None):
+    def restore(self, net, step: Optional[int] = None, shardings=None):
         """Restore IN PLACE (params/opt/state/counters); returns net.
 
         When the live net already has device placements, restore is given an
@@ -92,6 +148,14 @@ class ShardedCheckpointer:
         no placement yet or its structure/shapes differ from the save (a
         fresh post-preemption net may lack optional slots like rnn carries
         or the fit key — the fallback keeps that resume path working).
+
+        ``shardings`` (optional) is ``{"params": <NamedSharding pytree>,
+        "optState": <pytree or None>}`` overriding the live arrays'
+        shardings in the template — the elastic plan-to-plan reshard
+        path: a checkpoint written on one mesh restores DIRECTLY onto a
+        different mesh's placement (each host reads only its shards of
+        the NEW layout; the manifest is shape-agnostic, recording
+        logical shapes, never a mesh).
         """
         import orbax.checkpoint as ocp
         step = self.latestStep() if step is None else int(step)
@@ -105,6 +169,32 @@ class ShardedCheckpointer:
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                                    sharding=a.sharding)
                     if hasattr(a, "sharding") else a, self._tree(net))
+                if shardings:
+                    def _retarget(sds, sh):
+                        return jax.ShapeDtypeStruct(sds.shape, sds.dtype,
+                                                    sharding=sh)
+                    if shardings.get("params") is not None:
+                        tpl["params"] = jax.tree.map(
+                            _retarget, tpl["params"], shardings["params"])
+                    if tpl.get("optState") is not None and \
+                            shardings.get("optState") is not None:
+                        tpl["optState"] = jax.tree.map(
+                            _retarget, tpl["optState"],
+                            shardings["optState"])
+                    rest = shardings.get("rest")
+                    if rest is not None:
+                        # everything else entering the step (aux state,
+                        # RNG key, rnn carries) is replicated — restore
+                        # it onto the TARGET mesh too, or the next step
+                        # mixes device assignments
+                        def _rest_one(leaf):
+                            if isinstance(leaf, jax.ShapeDtypeStruct):
+                                return jax.ShapeDtypeStruct(
+                                    leaf.shape, leaf.dtype, sharding=rest)
+                            return leaf
+                        for k in list(tpl):
+                            if k not in ("params", "optState"):
+                                tpl[k] = jax.tree.map(_rest_one, tpl[k])
                 restored = self._mgr.restore(
                     step, args=ocp.args.StandardRestore(tpl))
             except Exception as e:
@@ -132,6 +222,7 @@ class ShardedCheckpointer:
         return net
 
     def close(self):
+        self._joinSealers()
         self._mgr.close()    # joins outstanding writes itself
 
     # ------------------------------------------------------------------
@@ -166,17 +257,48 @@ class ShardedCheckpointer:
                 fp = os.path.join(root, fn)
                 yield os.path.relpath(fp, spath), fp
 
+    @staticmethod
+    def _treeSpec(net) -> Dict[str, Dict[str, Any]]:
+        """Shape-agnostic description of the checkpointed state: per-leaf
+        logical shape + dtype for params/optState.  Deliberately records
+        NO mesh or sharding — the manifest must stay valid for a restore
+        onto any mesh shape (the elastic reshard contract)."""
+        import jax
+        spec: Dict[str, Dict[str, Any]] = {}
+        for name in ("params", "optState"):
+            sub = getattr(net, name + "_", None)
+            if sub is None:
+                continue
+            leaves, _ = jax.tree_util.tree_flatten_with_path(sub)
+            spec[name] = {
+                jax.tree_util.keystr(path): {
+                    "shape": [int(d) for d in getattr(v, "shape", ())],
+                    "dtype": str(getattr(v, "dtype", ""))}
+                for path, v in leaves}
+        return spec
+
     def saveWithManifest(self, net, step: Optional[int] = None,
-                         metadata: Optional[Dict[str, Any]] = None) -> int:
-        """Synchronous sealed save: orbax save -> join the async write ->
-        checksum every file -> atomically publish the manifest.  Unlike the
-        bare async ``save``, this blocks until the step is durable (the
-        supervisor's checkpoint cadence amortizes the stall).
+                         metadata: Optional[Dict[str, Any]] = None,
+                         block: bool = True) -> int:
+        """Sealed save: orbax save -> join the async write -> checksum
+        every file -> atomically publish the manifest.
+
+        ``block=True`` (default) seals synchronously before returning
+        (the supervisor's checkpoint cadence amortizes the stall).
+        ``block=False`` returns as soon as the orbax write is ISSUED and
+        seals on a background thread — the manifest write no longer
+        joins the tensorstore write, so training resumes while the
+        shards land.  ``waitUntilFinished``/``latestValidStep``/``close``
+        join the sealer, so restore never races a half-sealed step (an
+        unsealed step is simply skipped, same as a crash mid-save).
 
         Re-saving an existing step (training rolled back past it and
         re-reached it) refreshes it: the stale step + manifest are deleted
         first so orbax doesn't skip the write.
         """
+        # one sealer in flight: a new save must not race the previous
+        # step's wait_until_finished/checksum pass on the shared manager
+        self._joinSealers()
         step = int(net.iterationCount if step is None else step)
         if step in set(self._mgr.all_steps()):
             self._mgr.delete(step)
@@ -184,23 +306,60 @@ class ShardedCheckpointer:
                 os.remove(self._manifestPath(step))
             except FileNotFoundError:
                 pass
-        self.save(net, step)
-        self.waitUntilFinished()
-        files = {rel: {"sha256": self._sha256(fp),
-                       "bytes": os.path.getsize(fp)}
-                 for rel, fp in self._walkFiles(step)}
-        manifest = {"step": step, "files": files,
-                    "metadata": dict(metadata or {})}
+        _io_retry(lambda: self.save(net, step),
+                  f"checkpoint step {step} save",
+                  cleanup=lambda: self._mgr.delete(step))
+        meta = dict(metadata or {})
+        tree = self._treeSpec(net)
+        if block:
+            self._seal(step, meta, tree)
+            return step
+        t = threading.Thread(target=self._sealSafely,
+                             args=(step, meta, tree),
+                             name=f"ckpt-seal-{step}", daemon=True)
+        with self._sealLock:
+            self._sealers.append(t)
+        t.start()
+        return step
+
+    def _sealSafely(self, step: int, metadata: Dict[str, Any],
+                    tree: Dict[str, Any]) -> None:
+        """Async sealer body: a sealing failure must not take down the
+        training thread — the step just stays unsealed (restore skips it
+        exactly like a crash mid-save)."""
+        try:
+            self._seal(step, metadata, tree)
+        except Exception as e:
+            log.error("async sealing of checkpoint step %d failed "
+                      "(%s: %s); step stays unsealed and restore will "
+                      "skip it", step, type(e).__name__, e)
+
+    def _seal(self, step: int, metadata: Dict[str, Any],
+              tree: Dict[str, Any]) -> None:
+        self._mgr.wait_until_finished()
+
+        def _checksums():
+            return {rel: {"sha256": self._sha256(fp),
+                          "bytes": os.path.getsize(fp)}
+                    for rel, fp in self._walkFiles(step)}
+
+        files = _io_retry(_checksums, f"checksumming step {step}")
+        manifest = {"step": step, "files": files, "tree": tree,
+                    "metadata": metadata}
         mpath = self._manifestPath(step)
         os.makedirs(os.path.dirname(mpath), exist_ok=True)
         tmp = mpath + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(manifest, fh, indent=1, sort_keys=True)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, mpath)
+
+        def _publish():
+            with open(tmp, "w") as fh:
+                json.dump(manifest, fh, indent=1, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, mpath)
+            _fsync_dir(os.path.dirname(mpath))
+
+        _io_retry(_publish, f"manifest publish for step {step}")
         self._pruneManifests()
-        return step
 
     def _pruneManifests(self) -> None:
         """Drop manifests whose step orbax already garbage-collected
@@ -239,6 +398,14 @@ class ShardedCheckpointer:
     def readMetadata(self, step: int) -> Dict[str, Any]:
         with open(self._manifestPath(step)) as fh:
             return json.load(fh).get("metadata", {})
+
+    def readTree(self, step: int) -> Dict[str, Any]:
+        """The manifest's shape-agnostic tree description (per-leaf
+        logical shape/dtype for params/optState) — what a resharding
+        restore needs to build a target template WITHOUT a live net of
+        the saving run's placement.  Empty for pre-upgrade manifests."""
+        with open(self._manifestPath(step)) as fh:
+            return json.load(fh).get("tree", {})
 
     def latestValidStep(self) -> Optional[int]:
         """Newest step whose checksum manifest verifies; corrupt/unsealed

@@ -62,6 +62,10 @@ def pytest_configure(config):
         "sharded step: DP/TP/ZeRO/EP equivalence, steady-state "
         "compile-cache discipline, fault supervision across mesh "
         "shapes)")
+    config.addinivalue_line(
+        "markers", "elastic: elastic re-mesh tests (plan-to-plan "
+        "resharding, shrink-on-device-loss, grow-on-recovery, "
+        "straggler eviction, async checkpoint sealing)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -809,3 +809,170 @@ class TestLintExtensions:
             assert rc == 0
         finally:
             sys.path.remove(str(_TOOLS))
+
+
+# ---------------------------------------- counter-reset smoothing ----
+
+class TestCounterResetSmoothing:
+    def test_worker_restart_accumulates_monotonic_offset(self, tmp_path):
+        """A per-worker counter that DECREASES between scrapes (worker
+        restart) must read as reset-plus-offset in the federated view —
+        never as a negative rate."""
+        from deeplearning4j_tpu.telemetry.federation import \
+            reset_counter_smoothing
+        reg = MetricsRegistry()
+        reg.counter("dl4j_tpu_smooth_test_total", "h").inc(10)
+        w = SnapshotWriter(str(tmp_path), hostId="w1", registry=reg)
+        w.write_now()
+        agg = TelemetryAggregator(str(tmp_path))
+        assert agg.merged().get(
+            "dl4j_tpu_smooth_test_total").value() == 10
+
+        # the worker restarts: counter re-zeroes, then counts to 2
+        reg2 = MetricsRegistry()
+        reg2.counter("dl4j_tpu_smooth_test_total", "h").inc(2)
+        SnapshotWriter(str(tmp_path), hostId="w1", registry=reg2).write_now()
+        assert TelemetryAggregator(str(tmp_path)).merged().get(
+            "dl4j_tpu_smooth_test_total").value() == 12    # 10 + 2
+
+        # further progress keeps adding on top of the folded offset
+        reg2.counter("dl4j_tpu_smooth_test_total", "h").inc(3)
+        SnapshotWriter(str(tmp_path), hostId="w1", registry=reg2).write_now()
+        assert TelemetryAggregator(str(tmp_path)).merged().get(
+            "dl4j_tpu_smooth_test_total").value() == 15    # 10 + 5
+        reset_counter_smoothing(str(tmp_path))
+
+    def test_smoothing_is_per_host_and_per_cell(self, tmp_path):
+        from deeplearning4j_tpu.telemetry.federation import \
+            reset_counter_smoothing
+        ra = MetricsRegistry()
+        ra.counter("dl4j_tpu_smooth_lbl_total", "h",
+                   labelnames=("k",)).inc(5, k="a")
+        SnapshotWriter(str(tmp_path), hostId="ha", registry=ra).write_now()
+        rb = MetricsRegistry()
+        rb.counter("dl4j_tpu_smooth_lbl_total", "h",
+                   labelnames=("k",)).inc(7, k="a")
+        SnapshotWriter(str(tmp_path), hostId="hb", registry=rb).write_now()
+        agg = TelemetryAggregator(str(tmp_path))
+        assert agg.merged().get(
+            "dl4j_tpu_smooth_lbl_total").value(k="a") == 12
+
+        # only host b restarts: a's share is untouched
+        rb2 = MetricsRegistry()
+        rb2.counter("dl4j_tpu_smooth_lbl_total", "h",
+                    labelnames=("k",)).inc(1, k="a")
+        SnapshotWriter(str(tmp_path), hostId="hb",
+                       registry=rb2).write_now()
+        assert TelemetryAggregator(str(tmp_path)).merged().get(
+            "dl4j_tpu_smooth_lbl_total").value(k="a") == 13   # 5 + 7 + 1
+        reset_counter_smoothing(str(tmp_path))
+
+    def test_smoothing_state_pruned_for_vanished_hosts(self, tmp_path):
+        """A long-lived scraping process must not grow smoothing state
+        for every (pid-suffixed) host it ever saw: hosts absent from a
+        merge are pruned for that run directory."""
+        from deeplearning4j_tpu.telemetry import federation as fed
+        reg = MetricsRegistry()
+        reg.counter("dl4j_tpu_smooth_prune_total", "h").inc(4)
+        w = SnapshotWriter(str(tmp_path), hostId="ephemeral",
+                           registry=reg)
+        w.write_now()
+        TelemetryAggregator(str(tmp_path)).merged()
+        key = (str(tmp_path), "ephemeral",
+               "dl4j_tpu_smooth_prune_total", ())
+        assert key in fed._smooth_state
+        os.remove(w.path)               # the worker's run dir entry dies
+        TelemetryAggregator(str(tmp_path)).merged()
+        assert key not in fed._smooth_state
+
+    def test_gauges_are_not_smoothed(self, tmp_path):
+        """Gauges legitimately decrease; smoothing them would be a lie."""
+        from deeplearning4j_tpu.telemetry.federation import \
+            reset_counter_smoothing
+        r1 = MetricsRegistry()
+        r1.gauge("dl4j_tpu_smooth_depth", "h").set(9)
+        SnapshotWriter(str(tmp_path), hostId="w1", registry=r1).write_now()
+        TelemetryAggregator(str(tmp_path)).merged()
+        r2 = MetricsRegistry()
+        r2.gauge("dl4j_tpu_smooth_depth", "h").set(3)
+        SnapshotWriter(str(tmp_path), hostId="w1", registry=r2).write_now()
+        merged = TelemetryAggregator(str(tmp_path)).merged()
+        assert merged.get("dl4j_tpu_smooth_depth").value(host="w1") == 3
+        reset_counter_smoothing(str(tmp_path))
+
+
+# ------------------------------------------------ webhook delivery ----
+
+class TestWebhookDelivery:
+    @staticmethod
+    def _server(posts, status=200):
+        import http.server
+        import threading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                posts.append(json.loads(self.rfile.read(n)))
+                self.send_response(status)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, t
+
+    def test_firing_and_resolved_transitions_post_json(self, tmp_path):
+        posts = []
+        srv, t = self._server(posts)
+        try:
+            url = f"http://127.0.0.1:{srv.server_port}/alerts"
+            rule = ThresholdRule("depth_high", "dl4j_tpu_wh_depth",
+                                 ">", 5.0)
+            mon = HealthMonitor(rules=[rule], webhookUrl=url,
+                                eventLogPath=str(tmp_path / "ev.jsonl"))
+            g = get_registry().gauge("dl4j_tpu_wh_depth", "h")
+            g.set(9)
+            mon.evaluate_once(now=1.0)      # firing
+            g.set(1)
+            mon.evaluate_once(now=2.0)      # resolved
+            mon.stop()                       # drains the sender
+            states = [(p["rule"], p["state"]) for p in posts]
+            assert ("depth_high", "firing") in states
+            assert ("depth_high", "resolved") in states
+            c = get_registry().get(
+                "dl4j_tpu_health_webhook_deliveries_total")
+            assert c.value(status="ok") == 2
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            t.join(timeout=5)
+
+    def test_dead_endpoint_never_blocks_watchdog(self, tmp_path):
+        """POSTs to a closed port fail after bounded retries on the
+        SENDER thread; rule evaluation itself must stay fast and the
+        failure must be counted, not raised."""
+        rule = ThresholdRule("depth_high", "dl4j_tpu_wh_depth", ">", 5.0)
+        mon = HealthMonitor(rules=[rule],
+                            webhookUrl="http://127.0.0.1:9/alerts",
+                            webhookTimeout=0.2, webhookRetries=2,
+                            webhookBackoff=0.01,
+                            eventLogPath=str(tmp_path / "ev.jsonl"))
+        get_registry().gauge("dl4j_tpu_wh_depth", "h").set(9)
+        t0 = time.perf_counter()
+        mon.evaluate_once(now=1.0)
+        assert time.perf_counter() - t0 < 1.0   # enqueue only, no POST
+        mon.stop()
+        c = get_registry().get(
+            "dl4j_tpu_health_webhook_deliveries_total")
+        assert c is not None and c.value(status="failed") >= 1
+
+    def test_no_webhook_url_means_no_sender_thread(self):
+        rule = ThresholdRule("x", "dl4j_tpu_wh_depth", ">", 5.0)
+        mon = HealthMonitor(rules=[rule])
+        get_registry().gauge("dl4j_tpu_wh_depth", "h").set(9)
+        mon.evaluate_once(now=1.0)
+        assert mon._whThread is None
+        mon.stop()

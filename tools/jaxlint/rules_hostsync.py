@@ -43,6 +43,7 @@ HOT_PATH_SUFFIXES = (
     "parallel/moe.py",
     "datavec/pipeline.py",
     "datavec/iterators.py",
+    "fault/elastic.py",
 )
 
 _SYNC_ATTRS = {"item", "block_until_ready"}
