@@ -53,6 +53,7 @@ import multiprocessing as _mp
 import os
 import pickle
 import queue as _queue
+import threading
 import time
 import weakref
 from multiprocessing import shared_memory as _shm
@@ -401,6 +402,15 @@ class PrefetchingDataSetIterator(DataSetIterator):
         self._closed = False
         self._epoch = -1
         self._pendingError: Optional[ProducerWorkerError] = None
+        # health-remediation restart: the etl_starvation action sets the
+        # event from the watchdog thread; the CONSUMER thread (which owns
+        # the pool) notices at its next poll and restarts the workers,
+        # fast-forwarding the new generation past the batches it already
+        # delivered this epoch (numWorkers=1 supervised streams are
+        # deterministic, so the skip is exact)
+        self._restartReq = threading.Event()
+        self._delivered = 0
+        self._skip = 0
         # state the leak finalizer can reach without holding self: a
         # dropped-without-close() iterator must stop its workers (they
         # block on freeQ forever once the consumer is gone), not just
@@ -533,6 +543,41 @@ class PrefetchingDataSetIterator(DataSetIterator):
         self._started = False
         return err
 
+    def requestRestart(self) -> None:
+        """Thread-safe producer-pool restart request — the
+        ``etl_starvation`` alert remediation.  Callable from any thread
+        (the watchdog fires it); the CONSUMER thread, which owns the
+        pool, performs the actual teardown/restart at its next poll —
+        including while it is blocked on the starved queue — and
+        fast-forwards the fresh worker generation past the batches it
+        already delivered this epoch, so no example is double-trained.
+
+        The replay skip is EXACT only for ``numWorkers=1`` (the
+        supervised default — multi-worker pools interleave shards
+        scheduling-dependently, so a mid-epoch restart there is
+        at-least-once, not exactly-once; the supervisor's remediation
+        declines to restart those)."""
+        self._restartReq.set()
+
+    def _restart_pool(self) -> None:
+        """Consumer-thread only: tear the pool down and restart the same
+        ShardSpec epoch, skipping the already-delivered prefix on
+        replay.  Staged-but-undelivered ring batches are dropped — the
+        new generation reproduces them (they are NOT in the skip count),
+        so delivery stays exactly-once."""
+        from deeplearning4j_tpu.telemetry import etl_metrics
+        log.warning("restarting ETL producer pool (epoch %d): replay "
+                    "will skip the %d batch(es) already delivered",
+                    max(self._epoch, 0), self._delivered)
+        err = self._shutdown()
+        if err is not None and self._pendingError is None:
+            self._pendingError = err
+        self._ring.clear()
+        self._skip = self._delivered
+        self._epoch -= 1    # same ShardSpec epoch: identical stream order
+        self._start()
+        etl_metrics().pool_restarts().inc()
+
     def close(self) -> None:
         """Full teardown: pool + shared-memory slots.  Idempotent.
         Unlike ``reset()``, explicit teardown does not re-raise pending
@@ -540,6 +585,8 @@ class PrefetchingDataSetIterator(DataSetIterator):
         self._shutdown()
         self._pendingError = None
         self._ring.clear()
+        self._restartReq.clear()
+        self._delivered = self._skip = 0
         self._cleanup_segments(self._segs)
         self._closed = True
 
@@ -588,6 +635,13 @@ class PrefetchingDataSetIterator(DataSetIterator):
                     msg = self._metaQ.get(timeout=0.2)
                     break
                 except _queue.Empty:
+                    if self._restartReq.is_set():
+                        # the starvation remediation: we ARE the blocked
+                        # consumer the alert is about — restart the pool
+                        # right here and resume polling the new queue
+                        self._restartReq.clear()
+                        self._restart_pool()
+                        continue
                     dead = self._dead_without_sentinel()
                     if dead is None:
                         continue
@@ -618,12 +672,22 @@ class PrefetchingDataSetIterator(DataSetIterator):
         from deeplearning4j_tpu.telemetry import etl_metrics, tracer
         em = etl_metrics()
         while not self._exhausted and len(self._ring) < self.stagingDepth:
+            if self._restartReq.is_set():
+                self._restartReq.clear()
+                if self._started:
+                    self._restart_pool()
             msg = self._get_msg(block and not self._ring)
             if msg is None:
                 return
             kind = msg[0]
             if kind == "batch":
                 _, w, slot, metas = msg
+                if self._skip > 0:
+                    # replay fast-forward after a pool restart: recycle
+                    # the slot without assembling the batch
+                    self._skip -= 1
+                    self._freeQ.put(slot)
+                    continue
                 t0 = time.perf_counter()
                 fields = []
                 for meta in metas:
@@ -645,6 +709,9 @@ class PrefetchingDataSetIterator(DataSetIterator):
                 self._ring.append(_StagedBatch(fields, self.device))
             elif kind == "inline":
                 _, w, fields = msg
+                if self._skip > 0:
+                    self._skip -= 1
+                    continue
                 em.pool_batches().inc()
                 em.pool_inline_batches().inc()
                 self._ring.append(_StagedBatch(fields, self.device))
@@ -676,6 +743,7 @@ class PrefetchingDataSetIterator(DataSetIterator):
         if not self.hasNext():
             raise StopIteration
         staged = self._ring.popleft()
+        self._delivered += 1
         ds = staged.materialize()
         # double buffering: issue the NEXT transfer before the caller
         # starts the step on this batch (non-blocking top-up).  A crash
@@ -711,6 +779,7 @@ class PrefetchingDataSetIterator(DataSetIterator):
             # jaxlint: sync-ok -- host slot indices are Python ints, not device scalars
             self.hostCount = int(hostCount)
         self._ring.clear()
+        self._delivered = self._skip = 0
         self._exhausted = False
         if err is not None:
             self._pendingError = err
@@ -721,6 +790,8 @@ class PrefetchingDataSetIterator(DataSetIterator):
             err = self._pendingError
         self._pendingError = None
         self._ring.clear()
+        self._restartReq.clear()
+        self._delivered = self._skip = 0
         self._exhausted = False     # lazy restart on the next hasNext()
         if err is not None:
             # a crash drained away (or deferred from a next() top-up)

@@ -6,13 +6,20 @@ exercises every path (NaN at step k, simulated preemption, checkpoint
 corruption, device OOM, slow/failing data fetches).
 """
 from deeplearning4j_tpu.fault.injection import (  # noqa: F401
-    CorruptCheckpointAtStep, DeviceLossAtStep, FailingFetch, Fault,
-    FaultInjector, InjectedDeviceLoss, InjectedOOM, NaNAtStep, OOMAtStep,
-    PreemptAtStep, RestoreCapacityAtStep, SimulatedPreemption, SlowFetch,
-    StallAtStep, StragglerReplica, clear_injector, clear_lost_devices,
-    corrupt_checkpoint, get_injector, inject, lose_devices,
-    lost_device_ids, restore_devices, set_injector)
+    CorruptCheckpointAtStep, DelayedHeartbeat, DeviceLossAtStep,
+    FailingFetch, Fault, FaultInjector, InjectedDeviceLoss, InjectedOOM,
+    NaNAtStep, OOMAtStep, PartitionedHost, PreemptAtStep,
+    RestoreCapacityAtStep, SimulatedPreemption, SlowFetch, StallAtStep,
+    StragglerReplica, clear_heartbeat_delays, clear_injector,
+    clear_lost_devices, clear_partitioned_hosts, corrupt_checkpoint,
+    get_injector, heal_host, heartbeat_delay, inject, lose_devices,
+    lost_device_ids, partition_host, partitioned_host_ids,
+    restore_devices, set_heartbeat_delay, set_injector)
 from deeplearning4j_tpu.fault.supervisor import (  # noqa: F401
     FaultTolerantTrainer, TrainingDivergedError, is_oom_error)
 from deeplearning4j_tpu.fault.elastic import (  # noqa: F401
-    ElasticCapacityError, ElasticSupervisor, is_device_loss_error)
+    DeviceHealthProbe, ElasticCapacityError, ElasticSupervisor,
+    is_device_loss_error)
+from deeplearning4j_tpu.fault.coordination import (  # noqa: F401
+    CoordinationError, GenerationFence, HeartbeatLease, PodCoordinator,
+    PodEvictedError, ReadmissionPolicy, StaleGenerationError)

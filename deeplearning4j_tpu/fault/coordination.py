@@ -1,0 +1,741 @@
+"""Pod-level coordinated elasticity: leases, generation consensus, fencing.
+
+PR 11's :class:`~deeplearning4j_tpu.fault.elastic.ElasticSupervisor` makes
+ONE process survive device loss — but a pod is many processes, and a
+unilateral shrink is exactly the divergence failure mode arXiv:1810.11112
+characterizes: every rank must enter each collective with an identical
+world view, or the run silently forks.  This module turns re-mesh into a
+coordinated, fenced, pod-wide transition — file-based over the federation
+run directory the checkpointer/telemetry layers already require, so it
+adds NO new network dependency:
+
+- **heartbeat leases** (:class:`HeartbeatLease`) — every process
+  periodically writes ``coord/hb_<host>.json`` atomically; a lease whose
+  age exceeds ``leaseTimeout`` marks its host dead.  Each lease carries
+  the host's *currently healthy* device ids (fed by the device-health
+  probe) and the mesh generation the host has adopted.
+- **mesh generation** — a monotonically increasing integer naming one
+  agreed topology.  The current agreement lives in ``coord/gen.json``
+  ``{generation, participants, deviceIds}``, written atomically by the
+  leader only.
+- **propose / agree** (:meth:`PodCoordinator.poll`) — each surviving
+  process publishes its healthy device set through its lease; the
+  deterministic leader (lowest live host id) computes the next topology
+  as the union of live participants' healthy devices (each process later
+  maps the agreed ids onto a mesh via ``DeviceMesh.largest_from_ids``)
+  and publishes generation N+1.
+- **barrier** — every participant acks ``coord/ack_<gen>_<host>.json``
+  at its next checkpoint boundary and waits for all other participants'
+  acks before resharding, so the whole pod transitions between two
+  well-defined states (the MPI-style lockstep contract) instead of
+  mixing topologies mid-collective.
+- **generation fencing** (:class:`GenerationFence`) — installed on
+  ``ShardedCheckpointer``: a process holding a stale generation (or one
+  evicted from the participants set) can never seal a checkpoint or
+  publish a manifest.  Rejected writes raise :class:`StaleGenerationError`
+  and count in ``dl4j_tpu_coord_fenced_writes_rejected_total``.
+- **re-admission** (:class:`ReadmissionPolicy`) — an evicted host that
+  resumes heartbeating re-enters only after N consecutive fresh healthy
+  heartbeats AND a probation window, within a ``maxReadmissions`` budget
+  (a flapping host must not churn the pod's topology every minute).
+
+Everything time-dependent takes an explicit ``now`` so tests drive the
+protocol deterministically — no sleeps in the fast paths.  All lease and
+plan I/O happens on the heartbeat thread or at checkpoint boundaries,
+never on the step path.
+
+Usage (one process of a pod)::
+
+    coord = PodCoordinator(runDir, hostId="h0", devices=[0, 1])
+    coord.start()
+    coord.establish(hosts=["h0", "h1"])       # leader seals generation 1
+    sup = ElasticSupervisor(pw, ckptDir, coordinator=coord)
+    sup.fit(iterator, epochs=10)              # re-mesh is now pod-wide
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.telemetry import coord_metrics, tracer
+
+__all__ = ["PodCoordinator", "HeartbeatLease", "GenerationFence",
+           "ReadmissionPolicy", "CoordinationError", "PodEvictedError",
+           "StaleGenerationError"]
+
+log = logging.getLogger(__name__)
+
+_COORD_SUBDIR = "coord"
+_HB_PREFIX = "hb_"
+_GEN_FILE = "gen.json"
+_ACK_PREFIX = "ack_"
+
+
+class CoordinationError(RuntimeError):
+    """The pod-wide transition could not complete (barrier timeout,
+    unreachable run directory) — the process cannot know the pod's state
+    and must not keep stepping as if it did."""
+
+
+class PodEvictedError(CoordinationError):
+    """This host is no longer a participant of the current generation:
+    the pod moved on without it (partition, missed leases).  The process
+    must stop training and await re-admission — its collectives have no
+    peers anymore."""
+
+
+class StaleGenerationError(CoordinationError):
+    """A fenced write was attempted under an out-of-date mesh generation
+    (or by an evicted host) — the checkpoint/manifest it would have
+    published could corrupt the pod's agreed lineage."""
+
+
+def _safe_name(hostId: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in hostId)
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    # deliberately NO fsync (unlike the checkpointer's manifest publish):
+    # leases/acks are refreshed at heartbeat cadence and a lost write is
+    # indistinguishable from a late heartbeat — the protocol already
+    # tolerates both, and fsync per heartbeat would dominate the cost
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".coord_", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f, default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _read_json(path: str) -> Optional[dict]:
+    """Parse one coordination file; a torn/missing file reads as absent
+    (the writer is atomic, so a tear means a dying writer — the protocol
+    treats it like the write never happened)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _plan_digest(plan: dict) -> str:
+    """Content identity of a published plan — what the barrier acks.
+    Two plans under the SAME generation number (racing leaders at the
+    lease-timeout edge) must not satisfy each other's barrier."""
+    core = {"generation": int(plan.get("generation", 0)),
+            "participants": sorted(str(h)
+                                   for h in plan.get("participants", ())),
+            # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
+            "deviceIds": sorted(int(d) for d in plan.get("deviceIds", ()))}
+    return hashlib.sha1(
+        json.dumps(core, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class HeartbeatLease:
+    """Periodic atomic lease for one process in the coordination dir.
+
+    The payload carries everything a peer needs to reason about this
+    host: identity, a monotonically increasing ``seq`` (so observers can
+    count FRESH heartbeats, not just see a file), the wall-clock ``ts``
+    the lease was written, the host's currently-healthy device ids, and
+    the mesh generation this host has adopted.
+
+    The injection harness hooks in here: a host in the partitioned-host
+    registry silently stops writing (split-brain: the process keeps
+    stepping, its lease goes stale), and a registered heartbeat delay
+    throttles writes so the lease ages past its timeout intermittently
+    (the slow-lease path).
+    """
+
+    def __init__(self, coordDir: str, hostId: str,
+                 devices: Sequence[int] = (), interval: float = 1.0):
+        self.coordDir = str(coordDir)
+        self.hostId = str(hostId)
+        self.devices = sorted(int(d) for d in devices)
+        self.interval = float(interval)
+        self.generation = 0
+        self.seq = 0
+        self._lastWrite: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.coordDir,
+                            f"{_HB_PREFIX}{_safe_name(self.hostId)}.json")
+
+    def setDevices(self, devices: Sequence[int]) -> None:
+        """Publish a new healthy-device set (the probe noticed a change);
+        takes effect immediately — peers must see a loss before their
+        next proposal, not an interval later."""
+        with self._lock:
+            # jaxlint: sync-ok -- device ids here are Python ints from the pod config/JSON, not device scalars
+            self.devices = sorted(int(d) for d in devices)
+        self.write_now()
+
+    def write_now(self, now: Optional[float] = None) -> str:
+        """One atomic lease write; returns the path, or '' when the
+        write was skipped (partitioned/delayed by injection) or failed
+        (lease I/O must never take down training)."""
+        now = time.time() if now is None else now
+        if self.hostId in _inj.partitioned_host_ids():
+            return ""
+        delay = _inj.heartbeat_delay(self.hostId)
+        with self._lock:
+            if delay > 0 and self._lastWrite is not None and \
+                    (now - self._lastWrite) < delay:
+                return ""       # injected slow lease: the write is late
+            self.seq += 1
+            payload = {"host": self.hostId, "pid": os.getpid(),
+                       "seq": self.seq, "ts": now,
+                       "devices": list(self.devices),
+                       "generation": self.generation}
+            # the file write stays under the lock: build + write must be
+            # one unit, or a descheduled heartbeat tick could land its
+            # STALE payload after a setDevices()/adopt write and
+            # un-narrow a published device loss (seq/ts going backwards)
+            try:
+                _atomic_write_json(self.path, payload)
+            except Exception:
+                return ""
+            self._lastWrite = now
+        return self.path
+
+    def start(self) -> "HeartbeatLease":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self.write_now()
+
+            def loop():
+                while not self._stop.wait(self.interval):
+                    self.write_now()
+
+            self._thread = threading.Thread(
+                target=loop, name=f"coord-heartbeat-{self.hostId}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class ReadmissionPolicy:
+    """When may an evicted host rejoin the pod?
+
+    Three gates, all required: ``healthyHeartbeats`` consecutive FRESH
+    heartbeats since it reappeared (a live lease file alone proves
+    nothing — the seq must advance), a ``probationSeconds`` window since
+    the eviction (a host that flaps every few seconds must not churn the
+    topology at lease speed), and a per-host ``maxReadmissions`` budget
+    for the run (the third eviction is a hardware ticket, not churn).
+    """
+
+    def __init__(self, healthyHeartbeats: int = 3,
+                 probationSeconds: float = 0.0, maxReadmissions: int = 2):
+        self.healthyHeartbeats = max(1, int(healthyHeartbeats))
+        self.probationSeconds = float(probationSeconds)
+        self.maxReadmissions = int(maxReadmissions)
+        self._state: Dict[str, dict] = {}
+
+    def _st(self, host: str) -> dict:
+        return self._state.setdefault(
+            str(host), {"evictedAt": None, "streak": 0, "lastSeq": None,
+                        "count": 0})
+
+    def note_evicted(self, host: str, now: float) -> None:
+        st = self._st(host)
+        st["evictedAt"] = now
+        st["streak"] = 0
+        st["lastSeq"] = None
+
+    def observe(self, host: str, seq, now: float,
+                healthy: bool = True) -> None:
+        """One observation of the evicted host.  ``seq`` must advance
+        for the observation to count as a fresh heartbeat; ``healthy``
+        False resets the streak (the probe saw it fail again)."""
+        st = self._st(host)
+        if st["lastSeq"] is not None and seq == st["lastSeq"]:
+            return
+        st["lastSeq"] = seq
+        st["streak"] = st["streak"] + 1 if healthy else 0
+
+    def eligible(self, host: str, now: float) -> bool:
+        st = self._st(host)
+        if st["count"] >= self.maxReadmissions:
+            return False
+        if st["streak"] < self.healthyHeartbeats:
+            return False
+        if st["evictedAt"] is not None and \
+                (now - st["evictedAt"]) < self.probationSeconds:
+            return False
+        return True
+
+    def record_readmitted(self, host: str) -> None:
+        st = self._st(host)
+        st["count"] += 1
+        st["streak"] = 0
+        st["lastSeq"] = None
+        st["evictedAt"] = None
+
+
+class GenerationFence:
+    """Write fence handed to ``ShardedCheckpointer.setFence``.
+
+    ``validate(op)`` re-reads the published agreement and rejects when
+    this process's adopted generation is no longer the pod's current one
+    OR this host is no longer a participant — a partitioned process that
+    kept stepping on the old topology can therefore never seal a
+    checkpoint or publish a manifest over the survivors' lineage.
+    """
+
+    def __init__(self, coordinator: "PodCoordinator"):
+        self._coord = coordinator
+
+    @property
+    def generation(self) -> int:
+        return self._coord.generation
+
+    def validate(self, op: str = "write") -> None:
+        plan = self._coord.currentPlan()
+        if plan is None:
+            return      # no agreed topology yet: nothing to fence against
+        gen = int(plan.get("generation", 0))
+        participants = [str(h) for h in plan.get("participants", ())]
+        me = self._coord.hostId
+        evicted = me not in participants
+        lagging = False
+        if not evicted and "publish" not in op:
+            # generation equality is additionally enforced at SAVE time
+            # (the training thread polls at the same boundary, so a
+            # healthy host is never behind there).  Publish runs on the
+            # ASYNC sealer, which can race this process's own adoption
+            # of a generation it participates in — a still-participant
+            # writer sealing a just-superseded step is the pod's own
+            # lineage, not a fork, so only eviction rejects there.
+            lagging = gen != self._coord.generation
+        if evicted or lagging:
+            if evicted:
+                # only a genuinely stale/evicted writer counts toward
+                # the rejected-writes metric: a still-participant save
+                # racing its own pod's lineage advance (the poll-to-save
+                # window) is retry mechanics — the boundary re-polls,
+                # adopts, and seals under the new generation — and
+                # counting it would hand operators false stale-writer
+                # alerts on every busy re-mesh
+                coord_metrics().fenced_writes_rejected().inc()
+            raise StaleGenerationError(
+                f"fenced {op}: host {me!r} holds generation "
+                f"{self._coord.generation} but the pod is at generation "
+                f"{gen} with participants {participants} — a stale/"
+                "evicted process must not publish over the survivors' "
+                "checkpoint lineage")
+
+
+class PodCoordinator:
+    """One process's handle on the pod's file-based consensus state.
+
+    ``devices`` are the device ids THIS host contributes to the pod
+    (globally unique across hosts by convention, exactly like
+    ``jax.devices()`` ids in a multi-process run).  The lease publishes
+    the currently-healthy subset; :meth:`setHealthyDevices` narrows it
+    when the probe (or a device-loss error) reports a dead chip.
+
+    ``poll()`` is the checkpoint-boundary hook: adopt a newer published
+    generation (acking the barrier first), or — when this host is the
+    leader — propose one if the pod's healthy topology changed.  It
+    returns the newly adopted plan dict, or None when nothing changed.
+    """
+
+    def __init__(self, runDir: str, hostId: str,
+                 devices: Sequence[int] = (), *,
+                 leaseTimeout: float = 3.0, heartbeatInterval: float = 1.0,
+                 barrierTimeout: float = 60.0, barrierPoll: float = 0.05,
+                 readmission: Optional[ReadmissionPolicy] = None):
+        self.runDir = str(runDir)
+        self.coordDir = os.path.join(self.runDir, _COORD_SUBDIR)
+        self.hostId = str(hostId)
+        self.ownDevices = tuple(sorted(int(d) for d in devices))
+        self.leaseTimeout = float(leaseTimeout)
+        self.barrierTimeout = float(barrierTimeout)
+        self.barrierPoll = float(barrierPoll)
+        self.readmission = readmission or ReadmissionPolicy()
+        self.lease = HeartbeatLease(self.coordDir, self.hostId,
+                                    devices=self.ownDevices,
+                                    interval=heartbeatInterval)
+        self.generation = 0
+        self.participants: tuple = ()
+        self.deviceIds: tuple = ()
+        self._adoptedDigest: Optional[str] = None
+        self._deadSeen: set = set()
+        self._pendingReadmits: List[str] = []
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "PodCoordinator":
+        self.lease.start()
+        return self
+
+    def stop(self) -> None:
+        self.lease.stop()
+
+    def fence(self) -> GenerationFence:
+        return GenerationFence(self)
+
+    # -- lease views -----------------------------------------------------
+    def setHealthyDevices(self, devices: Sequence[int]) -> None:
+        """Publish this host's currently-healthy device subset (must be
+        within ``ownDevices`` — a host cannot contribute chips it does
+        not own)."""
+        own = set(self.ownDevices)
+        # jaxlint: sync-ok -- device ids are Python ints from the pod config/JSON, not device scalars
+        self.lease.setDevices([d for d in devices if int(d) in own])
+
+    def leases(self) -> Dict[str, dict]:
+        """Every parseable lease in the coordination dir, by host id."""
+        out: Dict[str, dict] = {}
+        try:
+            names = sorted(os.listdir(self.coordDir))
+        except OSError:
+            return out
+        for fn in names:
+            if not (fn.startswith(_HB_PREFIX) and fn.endswith(".json")):
+                continue
+            payload = _read_json(os.path.join(self.coordDir, fn))
+            if payload and payload.get("host"):
+                out[str(payload["host"])] = payload
+        return out
+
+    def liveHosts(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Hosts whose lease age is within ``leaseTimeout``.  A lease
+        dated in the FUTURE beyond the timeout is as untrustworthy as a
+        stale one (a host with that much clock skew would break every
+        age comparison the pod makes), so liveness is |now - ts|."""
+        now = time.time() if now is None else now
+        live = {}
+        for host, payload in self.leases().items():
+            try:
+                ts = float(payload.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if abs(now - ts) <= self.leaseTimeout:
+                live[host] = payload
+        return live
+
+    def leader(self, now: Optional[float] = None) -> Optional[str]:
+        """Deterministic leader: the lowest live PARTICIPANT (every
+        process computes the same answer from the same lease files — no
+        election traffic).  Liveness alone is not enough: an evicted
+        host keeps heartbeating while it waits for re-admission, and
+        letting it pin leadership would deadlock the pod — the evicted
+        "leader" cannot propose (its poll() raises PodEvictedError
+        before the leader branch) while the real participants never
+        enter theirs.  Before any adoption (no participants yet) every
+        live host is a candidate."""
+        live = self.liveHosts(now)
+        if self.participants:
+            live = [h for h in live if h in self.participants]
+        return min(live) if live else None
+
+    def isLeader(self, now: Optional[float] = None) -> bool:
+        return self.leader(now) == self.hostId
+
+    # -- published agreement ---------------------------------------------
+    def _genPath(self) -> str:
+        return os.path.join(self.coordDir, _GEN_FILE)
+
+    def currentPlan(self) -> Optional[dict]:
+        """The currently published agreement (None before establish)."""
+        return _read_json(self._genPath())
+
+    def _publish(self, plan: dict) -> None:
+        _atomic_write_json(self._genPath(), plan)
+        log.warning("coord[%s]: published generation %s: devices=%s "
+                    "participants=%s (%s)", self.hostId,
+                    plan["generation"], plan["deviceIds"],
+                    plan["participants"], plan.get("reason", ""))
+
+    def _adopt(self, plan: dict) -> None:
+        self.generation = int(plan["generation"])
+        self._adoptedDigest = _plan_digest(plan)
+        self.participants = tuple(str(h) for h in plan["participants"])
+        # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
+        self.deviceIds = tuple(int(d) for d in plan["deviceIds"])
+        self.lease.generation = self.generation
+        self.lease.write_now()
+        coord_metrics().generation().set(self.generation)
+        self._pruneAcks()
+
+    # -- establish --------------------------------------------------------
+    def establish(self, hosts: Sequence[str], timeout: float = 30.0,
+                  poll: float = 0.05) -> dict:
+        """Bootstrap a known pod composition.  Every process calls this
+        with the same host list; all wait until every host's lease
+        exists, then the leader (lowest id among ``hosts``) publishes
+        the composition — generation 1 on a fresh run dir, or the NEXT
+        generation above a surviving plan whose participants differ (a
+        re-composed pod restarting over an old run dir: adopting the
+        old plan as-is would leave a replaced host out of the
+        participants and every fenced save it attempts rejected) — and
+        everyone adopts it."""
+        hosts = sorted(str(h) for h in hosts)
+        if self.hostId not in hosts:
+            raise CoordinationError(
+                f"host {self.hostId!r} is not in the pod {hosts}")
+        self.lease.write_now()
+        # jaxlint: sync-ok -- timeout is a Python float parameter, not a device scalar
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            leases = self.leases()
+            if all(h in leases for h in hosts):
+                break
+            if time.monotonic() >= deadline:
+                missing = [h for h in hosts if h not in leases]
+                raise CoordinationError(
+                    f"establish timed out waiting for leases of {missing}")
+            time.sleep(poll)
+
+        def _matches(plan):
+            return plan is not None and \
+                sorted(str(h) for h in plan.get("participants", ())) \
+                == hosts
+        if self.hostId == hosts[0]:
+            plan = self.currentPlan()
+            if not _matches(plan):
+                leases = self.leases()
+                # jaxlint: sync-ok -- lease device ids are JSON ints, not device scalars
+                devices = sorted({int(d) for h in hosts
+                                  for d in leases[h].get("devices", ())})
+                gen = 1 if plan is None \
+                    else int(plan.get("generation", 0)) + 1
+                plan = {"generation": gen, "participants": hosts,
+                        "deviceIds": devices, "proposedBy": self.hostId,
+                        "reason": "establish", "ts": time.time()}
+                self._publish(plan)
+        else:
+            while not _matches(self.currentPlan()):
+                if time.monotonic() >= deadline:
+                    raise CoordinationError(
+                        "establish timed out waiting for a plan with "
+                        f"participants {hosts}")
+                time.sleep(poll)
+        plan = self.currentPlan()
+        self._adopt(plan)
+        return plan
+
+    # -- propose / agree / barrier ----------------------------------------
+    def _computeProposal(self, now: float) -> Optional[dict]:
+        """Leader-only: the next topology, or None when nothing changed.
+        Candidates are the live current participants plus any live
+        evicted host the re-admission policy clears; the device set is
+        the union of candidates' published healthy devices."""
+        live = self.liveHosts(now)
+        current = set(self.participants)
+        # dead-host detection (leader-side, once per transition)
+        for host in sorted(current - set(live)):
+            if host not in self._deadSeen:
+                self._deadSeen.add(host)
+                coord_metrics().heartbeats_missed().inc()
+                self.readmission.note_evicted(host, now)
+                log.warning("coord[%s]: host %s lease expired "
+                            "(leaseTimeout=%.3gs)", self.hostId, host,
+                            self.leaseTimeout)
+        # a previously evicted host that is dead AGAIN must restart its
+        # re-admission clock: the streak counts CONSECUTIVE fresh beats,
+        # and a flapping host would otherwise accumulate them across
+        # partitions (note_evicted also re-arms probation from the LAST
+        # observed flap, not the original eviction)
+        for host in self._deadSeen - current - set(live):
+            self.readmission.note_evicted(host, now)
+        readmitted: List[str] = []
+        candidates: List[str] = []
+        for host, payload in sorted(live.items()):
+            if host in current:
+                candidates.append(host)
+                continue
+            # an evicted host heartbeating again: probation first
+            self.readmission.observe(host, payload.get("seq"), now)
+            if self.readmission.eligible(host, now):
+                candidates.append(host)
+                readmitted.append(host)
+        if not candidates:
+            return None
+        # jaxlint: sync-ok -- lease device ids are JSON ints, not device scalars
+        devices = sorted({int(d) for h in candidates
+                          for d in live[h].get("devices", ())})
+        if tuple(candidates) == self.participants and \
+                tuple(devices) == self.deviceIds:
+            return None
+        if not devices:
+            return None     # a pod with zero devices is not a topology
+        # budget accounting is deferred to _recordReadmissions AFTER the
+        # plan is actually published — a failed write or a racing
+        # leader's winning plan must not consume a host's
+        # maxReadmissions or reset its healthy streak
+        self._pendingReadmits = list(readmitted)
+        reason = ("readmitted " + ",".join(readmitted)) if readmitted \
+            else "topology change"
+        return {"generation": self.generation + 1,
+                "participants": candidates, "deviceIds": devices,
+                "proposedBy": self.hostId, "reason": reason,
+                "ts": time.time()}
+
+    def _recordReadmissions(self, plan: dict) -> None:
+        """Burn the re-admission budget for the hosts the last computed
+        proposal readmitted — called only once a plan is PUBLISHED, and
+        only for hosts the winning plan actually carries (a racing
+        leader's plan may have won the file without them)."""
+        hosts, self._pendingReadmits = self._pendingReadmits, []
+        participants = {str(h) for h in plan.get("participants", ())}
+        for host in hosts:
+            if host not in participants:
+                continue
+            self.readmission.record_readmitted(host)
+            self._deadSeen.discard(host)
+            coord_metrics().readmissions().inc()
+
+    def _ackPath(self, generation: int, host: str) -> str:
+        return os.path.join(
+            self.coordDir,
+            # jaxlint: sync-ok -- generation is a Python int, not a device scalar
+            f"{_ACK_PREFIX}{int(generation)}_{_safe_name(host)}.json")
+
+    def _pruneAcks(self) -> None:
+        """Drop ack files of superseded generations (bounded state)."""
+        try:
+            names = os.listdir(self.coordDir)
+        except OSError:
+            return
+        for fn in names:
+            if not fn.startswith(_ACK_PREFIX):
+                continue
+            try:
+                gen = int(fn[len(_ACK_PREFIX):].split("_", 1)[0])
+            except ValueError:
+                continue
+            if gen < self.generation:
+                try:
+                    os.remove(os.path.join(self.coordDir, fn))
+                except OSError:
+                    pass
+
+    def _barrier(self, plan: dict) -> Optional[dict]:
+        """Ack the plan and wait until every participant acked it too —
+        the whole pod reshards between the same two steps or not at all.
+        Dead hosts are not participants by construction, so the barrier
+        only ever waits on processes that WILL reach a checkpoint
+        boundary (bounded by their checkpoint cadence).  Returns None
+        once every participant acked this plan, or the SUPERSEDING
+        published plan when a racing leader's publish won the file (the
+        caller re-anchors on it)."""
+        gen = int(plan["generation"])
+        participants = [str(h) for h in plan["participants"]]
+        digest = _plan_digest(plan)
+        _atomic_write_json(self._ackPath(gen, self.hostId),
+                           {"host": self.hostId, "generation": gen,
+                            "digest": digest, "ts": time.time()})
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + self.barrierTimeout
+        try:
+            with tracer().span("coord_barrier", generation=gen,
+                               participants=len(participants)):
+                while True:
+                    # two leaders racing at the lease-timeout edge can
+                    # both publish under the same generation number; the
+                    # FILE is canonical (last write wins), so a barrier
+                    # anchored on the losing plan must re-anchor, not
+                    # pass on acks that were made for a different
+                    # topology
+                    published = self.currentPlan()
+                    if published is not None and \
+                            _plan_digest(published) != digest and \
+                            int(published.get("generation", 0)) >= gen:
+                        return published
+                    missing = [
+                        h for h in participants
+                        if (_read_json(self._ackPath(gen, h)) or {}
+                            ).get("digest") != digest]
+                    if not missing:
+                        return None
+                    if time.monotonic() >= deadline:
+                        raise CoordinationError(
+                            f"barrier for generation {gen} timed out "
+                            f"after {self.barrierTimeout:g}s waiting "
+                            f"for {missing}")
+                    time.sleep(self.barrierPoll)
+        finally:
+            coord_metrics().barrier_seconds().observe(
+                time.perf_counter() - t0)
+
+    def poll(self, now: Optional[float] = None) -> Optional[dict]:
+        """The checkpoint-boundary hook.  Returns the newly ADOPTED plan
+        (barrier passed, local generation bumped) or None when the
+        topology is unchanged.  Raises :class:`PodEvictedError` when a
+        newer generation excludes this host."""
+        now = time.time() if now is None else now
+        plan = self.currentPlan()
+        if plan is not None and int(plan.get("generation", 0)) \
+                > self.generation:
+            return self._adoptPublished(plan)
+        if plan is not None and self.generation > 0 and \
+                int(plan.get("generation", 0)) == self.generation and \
+                _plan_digest(plan) != self._adoptedDigest:
+            # two leaders racing at the lease-timeout edge can publish
+            # DIFFERENT plans under the same generation number; a host
+            # that passed its barrier on the losing plan before the
+            # winner landed must re-anchor on the canonical file —
+            # otherwise peers still in their barrier wait forever for
+            # this host's ack of the winning digest
+            return self._adoptPublished(plan)
+        if plan is not None and self.isLeader(now):
+            proposal = self._computeProposal(now)
+            if proposal is not None:
+                self._publish(proposal)
+                # re-read: another leader's publish may have won the
+                # file after ours — what is PUBLISHED is what the pod
+                # agrees on, not what this process proposed
+                published = self.currentPlan()
+                winning = published if published is not None else proposal
+                self._recordReadmissions(winning)
+                return self._adoptPublished(winning)
+        return None
+
+    def _adoptPublished(self, plan: dict) -> dict:
+        me = self.hostId
+        # bounded re-anchoring: each round either adopts the plan it
+        # barriered on or switches to the plan a racing publisher won
+        # the file with (racing publishers are racing LEADERS — two at
+        # the lease-timeout edge; more rounds than hosts cannot happen)
+        for _ in range(8):
+            if me not in [str(h) for h in plan.get("participants", ())]:
+                raise PodEvictedError(
+                    f"host {me!r} is not a participant of generation "
+                    f"{plan.get('generation')} — the pod re-meshed "
+                    "without it; stop training and await re-admission")
+            superseded = self._barrier(plan)
+            if superseded is None:
+                self._adopt(plan)
+                return dict(plan)
+            plan = superseded
+        raise CoordinationError(
+            "could not converge on a published plan after 8 rounds — "
+            "the generation file is being rewritten faster than the "
+            "barrier can anchor on it")

@@ -59,14 +59,161 @@ from typing import Callable, Dict, Iterable, Optional, Sequence
 
 from deeplearning4j_tpu.fault import injection as _inj
 from deeplearning4j_tpu.fault.supervisor import FaultTolerantTrainer
-from deeplearning4j_tpu.telemetry import (elastic_metrics, flight_recorder,
-                                          get_registry, record_crash,
-                                          replica_step_gauge, tracer)
+from deeplearning4j_tpu.telemetry import (coord_metrics, elastic_metrics,
+                                          flight_recorder, get_registry,
+                                          record_crash, replica_step_gauge,
+                                          tracer)
 
 __all__ = ["ElasticSupervisor", "ElasticCapacityError",
-           "is_device_loss_error"]
+           "DeviceHealthProbe", "is_device_loss_error"]
 
 log = logging.getLogger(__name__)
+
+
+def _probe_inc(x):
+    """The per-device probe op body (module-level so the probe jits it
+    exactly once for its lifetime)."""
+    return x + 1
+
+
+_PROBE_FN = None
+
+
+def _probe_fn():
+    """Process-wide jitted probe op: one fn identity so JAX's executable
+    cache is shared across probe instances (a new supervisor must not
+    re-pay 1 compile per device)."""
+    global _PROBE_FN
+    if _PROBE_FN is None:
+        import jax
+        _PROBE_FN = jax.jit(_probe_inc)
+    return _PROBE_FN
+
+
+class DeviceHealthProbe:
+    """Real device-health probing: a tiny jitted op dispatched to each
+    device with a timeout and a consecutive-failure threshold.
+
+    The injection harness exercised the elastic paths (ROADMAP item 4's
+    "beyond the injection harness" gap); this is the production default
+    for ``ElasticSupervisor(availableDevices=)``: a device is unhealthy
+    once ``failThreshold`` CONSECUTIVE probes fail (timeout, error, or
+    membership in the injected lost set), and healthy again after one
+    passing probe resets its streak — a single slow probe must not shed
+    a chip, and a recovered chip must not stay blacklisted.
+
+    Probes run on a short-lived DAEMON thread so a WEDGED device (the
+    op never completes) costs the caller exactly ``timeout`` seconds,
+    not forever, and the abandoned thread can never block interpreter
+    shutdown (a ``ThreadPoolExecutor`` would: its workers are
+    non-daemon on py>=3.9 and ``concurrent.futures`` joins them at
+    exit).  A device whose probe DISPATCH failed (timeout/error, as
+    opposed to the injected lost set, which short-circuits) is then
+    only re-probed every ``deadRetrySeconds`` — without the backoff a
+    dead chip would stall every checkpoint boundary by ``timeout`` for
+    the rest of the run.  Called from checkpoint boundaries and the
+    heartbeat refresh only — never from the step path.
+    """
+
+    def __init__(self, timeout: float = 5.0, failThreshold: int = 2,
+                 devices: Optional[Sequence] = None,
+                 deadRetrySeconds: float = 30.0):
+        self.timeout = float(timeout)
+        self.failThreshold = max(1, int(failThreshold))
+        self.deadRetrySeconds = float(deadRetrySeconds)
+        self._devices = list(devices) if devices is not None else None
+        self._fails: Dict[int, int] = {}
+        self._retryAt: Dict[int, float] = {}
+        self._inflight: Dict[int, object] = {}
+        self._fn = None
+
+    def _probe_once(self, device) -> bool:
+        """One probe dispatch; True iff the device produced the value."""
+        import jax
+        if self._fn is None:
+            self._fn = _probe_fn()
+        x = jax.device_put(1, device)
+        # jaxlint: sync-ok -- the probe EXISTS to force a round-trip: a healthy device answers, a dead one times out
+        out = self._fn(x).block_until_ready()
+        # jaxlint: sync-ok -- comparing the probe result is the health check itself (checkpoint-boundary cadence, not the step path)
+        return int(out) == 2
+
+    def _run_with_timeout(self, device) -> bool:
+        import threading
+        # jaxlint: sync-ok -- device .id is a Python int from the backend client, not a device scalar
+        did = int(getattr(device, "id", -1))
+        prev = self._inflight.get(did)
+        if prev is not None and prev.is_alive():
+            # the last probe of this device is STILL wedged in
+            # block_until_ready: dispatching another would leak one
+            # blocked thread (plus the buffer it holds) per retry for
+            # the life of the run — the stuck dispatch IS the answer
+            return False
+        result = []
+
+        def worker():
+            try:
+                result.append(bool(self._probe_once(device)))
+            except Exception:
+                result.append(False)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="device-health-probe")
+        t.start()
+        t.join(self.timeout)
+        # a still-running thread is wedged on the dead device: abandon
+        # it (daemon — it can never block interpreter shutdown) but
+        # remember it so the next retry doesn't stack another on top
+        if t.is_alive():
+            self._inflight[did] = t
+        else:
+            self._inflight.pop(did, None)
+        return bool(result and result[0])
+
+    def __call__(self) -> list:
+        import jax
+        # default scope is the devices THIS process can address: a probe
+        # dispatched to a remote peer's device always fails (device_put
+        # to a non-addressable device raises) and would shed every
+        # remote chip from the healthy view — remote health travels via
+        # the owner's heartbeat lease, not our probe
+        devs = self._devices if self._devices is not None \
+            else list(jax.local_devices())
+        lost = _inj.lost_device_ids()
+        now = time.monotonic()
+        healthy = []
+        for i, d in enumerate(devs):
+            # jaxlint: sync-ok -- device .id is a Python int from the backend client, not a device scalar
+            did = int(getattr(d, "id", i))
+            probed = True
+            if did in lost:
+                ok = False      # injected loss: no dispatch, no backoff
+            elif now < self._retryAt.get(did, 0.0):
+                # known-dead: inside the retry backoff — no dispatch,
+                # and the streak HOLDS (we learned nothing new; the
+                # threshold counts probes, not boundaries)
+                ok, probed = False, False
+            else:
+                ok = self._run_with_timeout(d)
+                if ok:
+                    self._retryAt.pop(did, None)
+                elif self._fails.get(did, 0) + 1 >= self.failThreshold:
+                    # provably wedged (threshold reached): don't pay
+                    # `timeout` again at every boundary; re-probe only
+                    # every deadRetrySeconds.  Backoff must not start
+                    # earlier — a single transient timeout followed by
+                    # unprobed boundaries would otherwise consume the
+                    # whole threshold without a second real probe.
+                    self._retryAt[did] = now + self.deadRetrySeconds
+            streak = 0 if ok else \
+                self._fails.get(did, 0) + (1 if probed else 0)
+            self._fails[did] = streak
+            if streak < self.failThreshold:
+                healthy.append(d)
+            elif probed and streak == self.failThreshold:
+                log.warning("device %d failed %d consecutive health "
+                            "probes; marking unhealthy", did, streak)
+        return healthy
 
 
 class ElasticCapacityError(RuntimeError):
@@ -117,10 +264,24 @@ class ElasticSupervisor(FaultTolerantTrainer):
       maps a gauge label (a federated host id) to its device ids; a
       label that parses as an int is taken as a device id directly.
     - ``availableDevices`` — the availability probe: a callable
-      returning the devices currently usable.  The default is
-      ``jax.devices()`` minus the injection harness's lost set minus
-      evicted devices; real deployments plug in their fleet health
-      source here.
+      returning the devices currently usable.  The default is a real
+      :class:`DeviceHealthProbe` (tiny jitted per-device op, timeout +
+      consecutive-failure threshold) — the injection harness's lost set
+      and evicted devices are subtracted on top either way.
+    - ``coordinator`` — a started :class:`~deeplearning4j_tpu.fault.
+      coordination.PodCoordinator`: re-meshing becomes a POD-WIDE
+      transition (lease → propose → agree → barrier → fenced reshard at
+      checkpoint boundaries) instead of a unilateral one, and the
+      checkpointer is generation-fenced so this process can never seal
+      over the pod's lineage once it goes stale.  Local grow/evict are
+      disabled — topology changes flow exclusively through consensus.
+    - ``readmitAfter``/``readmissionProbation``/``maxReadmissions`` —
+      re-admission for straggler-EVICTED devices (non-coordinated runs):
+      an evicted device rejoins after ``readmitAfter`` consecutive
+      healthy probe observations at checkpoint boundaries, once
+      ``readmissionProbation`` seconds passed since eviction, at most
+      ``maxReadmissions`` times per device.  ``readmitAfter=None``
+      (default) keeps PR 11's eviction-is-permanent behavior.
 
     Defaults ``asyncSeal=True``: an elastic run checkpoints often enough
     that joining every tensorstore write would dominate; the manifest
@@ -133,6 +294,9 @@ class ElasticSupervisor(FaultTolerantTrainer):
                  stragglerPatience: int = 2,
                  hostDevices: Optional[Dict[str, Sequence[int]]] = None,
                  availableDevices: Optional[Callable[[], list]] = None,
+                 coordinator=None, readmitAfter: Optional[int] = None,
+                 readmissionProbation: float = 0.0,
+                 maxReadmissions: int = 2,
                  asyncSeal: bool = True, **kw):
         super().__init__(model, checkpointDir, asyncSeal=asyncSeal, **kw)
         if self.wrapper is None or not hasattr(self.wrapper, "remesh"):
@@ -146,24 +310,65 @@ class ElasticSupervisor(FaultTolerantTrainer):
         self.stragglerPatience = max(1, int(stragglerPatience))
         self.hostDevices = {str(k): tuple(int(d) for d in v)
                             for k, v in (hostDevices or {}).items()}
-        self._availableDevices = availableDevices
         # the elastic DOMAIN: the original mesh's devices.  Availability
         # fluctuates WITHIN it — grow returns lost capacity, it never
         # annexes chips the operator didn't give this run
         self._domainIds = set(self.wrapper.mesh.deviceIds())
+        self._domainDevices = list(self.wrapper.mesh.mesh.devices.flat)
+        if availableDevices is not None:
+            self._availableDevices = availableDevices
+        else:
+            # default probe scoped to the domain's LOCAL devices: chips
+            # outside the domain can never join the mesh, so probing
+            # them only buys wasted dispatches — and a wedged non-mesh
+            # device would stall every boundary by the probe timeout
+            import jax
+            pid = jax.process_index()
+            self._availableDevices = DeviceHealthProbe(devices=[
+                d for d in self._domainDevices
+                if getattr(d, "process_index", pid) == pid])
         self._evicted: set = set()
         self._stragglerStreak: Dict[tuple, int] = {}
+        self._stragglerAlert = False
+        self.coordinator = coordinator
+        if coordinator is not None:
+            # generation fencing: every checkpoint seal / manifest
+            # publish validates against the pod's current agreement
+            self.ckpt.setFence(coordinator.fence())
+        self.readmitAfter = None if readmitAfter is None \
+            else max(1, int(readmitAfter))
+        self._readmitSeq = 0
+        self._readmitPolicy = None
+        if self.readmitAfter is not None:
+            from deeplearning4j_tpu.fault.coordination import \
+                ReadmissionPolicy
+            self._readmitPolicy = ReadmissionPolicy(
+                healthyHeartbeats=self.readmitAfter,
+                probationSeconds=float(readmissionProbation),
+                maxReadmissions=int(maxReadmissions))
         self.stats["remeshes"] = []
         elastic_metrics().mesh_devices().set(
             self.wrapper.mesh.numDevices())
 
     # -- availability ---------------------------------------------------
-    def _usableDevices(self) -> list:
-        if self._availableDevices is not None:
+    def _remoteDomainDevices(self) -> list:
+        """Domain devices this process cannot address: invisible to the
+        local probe, their owner's lease/coordinator vouches for them —
+        both the rebuilt mesh and the readmission healthy view pass
+        them through rather than silently dropping every remote chip."""
+        import jax
+        pid = jax.process_index()
+        return [d for d in self._domainDevices
+                if getattr(d, "process_index", pid) != pid]
+
+    def _usableDevices(self, devs: Optional[list] = None) -> list:
+        if devs is None:
             devs = list(self._availableDevices())
-        else:
-            import jax
-            devs = list(jax.devices())
+        seen = {int(getattr(d, "id", i)) for i, d in enumerate(devs)}
+        devs = devs + [
+            d for d in self._remoteDomainDevices()
+            # jaxlint: sync-ok -- device .id is a Python int from the backend client, not a device scalar
+            if int(getattr(d, "id", -1)) not in seen]
         lost = _inj.lost_device_ids()
         out = []
         for i, d in enumerate(devs):
@@ -174,13 +379,16 @@ class ElasticSupervisor(FaultTolerantTrainer):
                 out.append(d)
         return out
 
-    def _rebuiltMesh(self):
+    def _rebuiltMesh(self, devs: Optional[list] = None):
         """Largest valid mesh from currently usable devices, preserving
-        the non-data axes (see ``DeviceMesh.largest_from``)."""
+        the non-data axes (see ``DeviceMesh.largest_from``).  ``devs``
+        reuses an availability snapshot already taken this boundary —
+        every fresh ``_availableDevices()`` call pays a full per-device
+        probe round-trip."""
         from deeplearning4j_tpu.parallel.mesh import DeviceMesh
         old = self.wrapper.mesh
         return DeviceMesh.largest_from(
-            self._usableDevices(), model=old.modelSize,
+            self._usableDevices(devs), model=old.modelSize,
             seq=old.seqSize, stage=old.stageSize)
 
     # -- the reshard path (shared by shrink / grow / evict) -------------
@@ -255,6 +463,9 @@ class ElasticSupervisor(FaultTolerantTrainer):
         elastic_metrics().device_losses().inc()
         self._note("device_loss", reason=str(exc)[:300],
                    iteration=self.net.iterationCount)
+        if self.coordinator is not None:
+            self._coordDeviceLoss(exc)      # raises _RemeshRestart
+            return
         old = self.wrapper.mesh
         try:
             newMesh = self._rebuiltMesh()
@@ -274,18 +485,182 @@ class ElasticSupervisor(FaultTolerantTrainer):
                      reason=f"device loss: {exc}")
         raise _RemeshRestart()
 
-    # -- grow / evict at checkpoint boundaries --------------------------
-    def _checkpoint(self, stepInEpoch: int) -> None:
-        super()._checkpoint(stepInEpoch)
-        self._maybeEvict()
-        self._maybeGrow()
+    # -- pod-coordinated re-mesh ----------------------------------------
+    def _probeHealthyIds(self, devs: Optional[list] = None) -> set:
+        """Device ids the probe currently reports healthy, minus the
+        injection harness's lost set (no domain/evicted filtering — the
+        raw health view the lease and the readmission policy need).
+        ``devs`` reuses an availability snapshot already taken this
+        boundary."""
+        if devs is None:
+            devs = list(self._availableDevices())
+        lost = _inj.lost_device_ids()
+        ids = set()
+        for i, d in enumerate(devs + self._remoteDomainDevices()):
+            # jaxlint: sync-ok -- device .id is a Python int from the backend client, not a device scalar
+            did = int(getattr(d, "id", i))
+            if did not in lost:
+                ids.add(did)
+        return ids
 
-    def _maybeGrow(self) -> None:
+    def _coordRefreshLease(self) -> None:
+        """Publish this host's currently-healthy share of its own
+        devices — peers must see a loss in the lease before their next
+        proposal."""
+        healthy = self._probeHealthyIds()
+        self.coordinator.setHealthyDevices(
+            [d for d in self.coordinator.ownDevices if d in healthy])
+
+    def _coordPoll(self) -> None:
+        """Checkpoint-boundary consensus hook: adopt a newly agreed
+        generation (barrier included) and re-mesh onto it."""
+        plan = self.coordinator.poll()
+        if plan is not None:
+            self._adoptPlan(plan)
+
+    def _adoptPlan(self, plan: dict) -> None:
+        """Re-mesh onto an ADOPTED pod agreement.  Devices leaving the
+        mesh take the checkpoint-reshard path (in a real pod their
+        arrays are gone with the dead host); a pure grow reshards
+        live."""
+        from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+        old = self.wrapper.mesh
+        oldIds = set(old.deviceIds())
+        # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
+        newIds = {int(d) for d in plan["deviceIds"]} & self._domainIds
+        if newIds == oldIds:
+            return
+        gen = int(plan["generation"])
+        try:
+            newMesh = DeviceMesh.largest_from_ids(
+                sorted(newIds), model=old.modelSize, seq=old.seqSize,
+                stage=old.stageSize)
+        except ValueError as e:
+            reason = (f"agreed generation {gen} leaves no rebuildable "
+                      f"mesh in this host's domain: {e}")
+            record_crash(reason, model=self.net)
+            raise ElasticCapacityError(reason)
+        reason = f"coordinated generation {gen}: {plan.get('reason', '')}"
+        if oldIds - newIds:
+            self._remesh(newMesh, "shrink", reshard=False, reason=reason)
+            raise _RemeshRestart()
+        self._remesh(newMesh, "grow", reshard=True, reason=reason)
+
+    def _coordDeviceLoss(self, exc: BaseException) -> None:
+        """Coordinated shrink after a LOCAL device-loss error: narrow
+        this host's lease, then wait for the pod to agree a topology
+        excluding the dead chips (the leader — possibly this process —
+        proposes as soon as it sees the lease change).  A unilateral
+        shrink here is exactly the divergence the coordinator exists to
+        prevent, so on timeout the run stops instead of forking."""
+        deadline = time.monotonic() + self.coordinator.barrierTimeout
+        nextRefresh = 0.0
+        while time.monotonic() < deadline:
+            # refresh at HEARTBEAT cadence, not every 50 ms poll: each
+            # refresh is a full probe sweep (thread spawn + dispatch +
+            # block per device) plus an atomic lease write, and peers
+            # only read leases at lease granularity.  Repeated sweeps
+            # are still needed — the probe's consecutive-failure
+            # threshold means the first sweep after a real loss may
+            # report the dying chip healthy; the lease only narrows
+            # once the streak crosses the threshold.  The plan poll
+            # stays at barrierPoll so adoption is prompt.
+            if time.monotonic() >= nextRefresh:
+                self._coordRefreshLease()
+                nextRefresh = time.monotonic() + \
+                    self.coordinator.lease.interval
+            self._coordPoll()       # raises _RemeshRestart on shrink
+            time.sleep(self.coordinator.barrierPoll)
+        reason = (f"pod agreed no new topology within "
+                  f"{self.coordinator.barrierTimeout:g}s of a device "
+                  f"loss (original: {exc})")
+        record_crash(reason, model=self.net)
+        raise ElasticCapacityError(reason) from exc
+
+    # -- grow / evict / readmit at checkpoint boundaries ----------------
+    def _checkpoint(self, stepInEpoch: int) -> None:
+        if self.coordinator is not None:
+            # coordinated runs change topology ONLY through consensus —
+            # a local grow here would annex a dead peer's devices the
+            # local runtime still simulates as alive.  Poll BEFORE the
+            # save: a healthy non-leader must adopt a generation its
+            # leader already published (barrier included) so its save
+            # carries the CURRENT generation — saving first would fence
+            # out a participant that merely hadn't polled yet.  An
+            # adopted shrink unwinds here (pre-save) and resumes from
+            # the previous sealed boundary; the replay is deterministic
+            # and placement is not math.
+            from deeplearning4j_tpu.fault.coordination import \
+                StaleGenerationError
+            self._coordRefreshLease()
+            self._coordPoll()
+            try:
+                super()._checkpoint(stepInEpoch)
+            except StaleGenerationError:
+                # a peer leader can publish a new generation in the
+                # window between our poll and the fenced save (the save
+                # joins the previous step's sealer first — seconds on a
+                # big checkpoint): that is the pod's own lineage
+                # advancing, not this host going stale.  Re-poll — it
+                # adopts the new generation (unwinding via
+                # _RemeshRestart on a topology change, PodEvictedError
+                # if the pod moved on without us) — then retry the save
+                # ONCE under the adopted generation.
+                self._coordPoll()
+                super()._checkpoint(stepInEpoch)
+            return
+        super()._checkpoint(stepInEpoch)
+        # ONE availability sweep per boundary, shared by readmit/grow —
+        # and only when one of them can use it: every fresh probe call
+        # pays a per-device round-trip.  Grow only needs it while there
+        # is domain capacity the mesh doesn't already span, so the
+        # steady-state healthy boundary stays free.  Straggler checks
+        # read the step-time gauges, not the probe: eviction sweeps
+        # lazily inside _rebuiltMesh only in the rare boundary that
+        # actually evicts.
+        growCould = self.elasticGrow and \
+            set(self.wrapper.mesh.deviceIds()) != \
+            (self._domainIds - self._evicted)
+        needSweep = growCould or \
+            (self._readmitPolicy is not None and self._evicted)
+        if not (needSweep or self.stragglerRatio is not None
+                or self._stragglerAlert):
+            return
+        devs = list(self._availableDevices()) if needSweep else None
+        self._maybeReadmit(devs)
+        self._maybeEvict(devs)
+        if devs is not None:
+            self._maybeGrow(devs)
+
+    def _maybeReadmit(self, devs: Optional[list] = None) -> None:
+        """Re-admission for straggler-evicted devices: ``readmitAfter``
+        consecutive healthy probe observations + probation +
+        per-device budget (see :class:`~deeplearning4j_tpu.fault.
+        coordination.ReadmissionPolicy`)."""
+        if self._readmitPolicy is None or not self._evicted:
+            return
+        now = time.time()
+        healthy = self._probeHealthyIds(devs)
+        self._readmitSeq += 1
+        pol = self._readmitPolicy
+        for dev in sorted(self._evicted):
+            pol.observe(str(dev), self._readmitSeq, now,
+                        healthy=dev in healthy)
+            if pol.eligible(str(dev), now):
+                pol.record_readmitted(str(dev))
+                self._evicted.discard(dev)
+                coord_metrics().readmissions().inc()
+                self._note("device_readmitted", device=dev)
+                log.warning("evicted device %d passed the re-admission "
+                            "policy; returning it to the elastic pool "
+                            "(grow picks it up at this boundary)", dev)
+
+    def _maybeGrow(self, devs: Optional[list] = None) -> None:
         if not self.elasticGrow:
             return
         old = self.wrapper.mesh
         try:
-            newMesh = self._rebuiltMesh()
+            newMesh = self._rebuiltMesh(devs)
         except ValueError:
             return
         if newMesh.numDevices() <= old.numDevices():
@@ -327,9 +702,16 @@ class ElasticSupervisor(FaultTolerantTrainer):
                     pass
         return reg
 
-    def _maybeEvict(self) -> None:
-        if self.stragglerRatio is None:
-            return
+    def _maybeEvict(self, devs: Optional[list] = None) -> None:
+        # the watchdog's replica_straggler alert arms one eviction check
+        # even when the local ratio watch is off — the alert itself
+        # already encodes persistence, so it gets patience 1
+        ratio, patience = self.stragglerRatio, self.stragglerPatience
+        if ratio is None:
+            if not self._stragglerAlert:
+                return
+            ratio, patience = 2.0, 1
+        self._stragglerAlert = False
         m = self._stragglerRegistry().get(
             replica_step_gauge().name)
         if m is None:
@@ -356,12 +738,12 @@ class ElasticSupervisor(FaultTolerantTrainer):
         if median <= 0:
             return
         worstKey, worst = max(cells, key=lambda kv: kv[1])
-        if worst <= self.stragglerRatio * median:
+        if worst <= ratio * median:
             self._stragglerStreak.pop(worstKey, None)
             return
         streak = self._stragglerStreak.get(worstKey, 0) + 1
         self._stragglerStreak[worstKey] = streak
-        if streak < self.stragglerPatience:
+        if streak < patience:
             return
         self._stragglerStreak.pop(worstKey, None)
         evictIds = self._devicesFor(worstKey) & meshIds
@@ -369,10 +751,14 @@ class ElasticSupervisor(FaultTolerantTrainer):
             return      # nothing of the mesh to evict, or all of it
         self._evicted |= evictIds
         try:
-            newMesh = self._rebuiltMesh()
+            newMesh = self._rebuiltMesh(devs)
         except ValueError:
             self._evicted -= evictIds   # eviction would kill the mesh
             return
+        if self._readmitPolicy is not None:
+            now = time.time()
+            for dev in sorted(evictIds):
+                self._readmitPolicy.note_evicted(str(dev), now)
         elastic_metrics().evictions().inc()
         self._note("straggler_evicted",
                    replica="/".join(worstKey), devices=sorted(evictIds),
@@ -382,6 +768,24 @@ class ElasticSupervisor(FaultTolerantTrainer):
         self._remesh(newMesh, "evict", reshard=True,
                      reason=f"straggler {'/'.join(worstKey)}: "
                             f"{worst:.4g}s vs median {median:.4g}s")
+
+    # -- alert -> action remediations -----------------------------------
+    def _remediations(self) -> Dict[str, Callable]:
+        out = super()._remediations()
+        out["replica_straggler"] = self._remediateStraggler
+        return out
+
+    def _remediateStraggler(self, rule: str, detail: str) -> Optional[str]:
+        """The watchdog's ``replica_straggler`` alert feeds eviction:
+        arm one eviction check at the next checkpoint boundary (the
+        straggler signal and the eviction decision read the same
+        federated gauge, so the boundary check re-verifies before any
+        devices leave)."""
+        if self.coordinator is not None:
+            return None     # coordinated runs evict through consensus
+        self._stragglerAlert = True
+        self._note("straggler_eviction_armed", reason=detail)
+        return "straggler eviction armed for the next checkpoint boundary"
 
     # -- the outer loop: restart-and-resume after a shrink --------------
     def _fit(self, iterator, epochs: int) -> None:

@@ -35,10 +35,14 @@ __all__ = [
     "SimulatedPreemption", "InjectedOOM", "InjectedDeviceLoss", "Fault",
     "NaNAtStep", "PreemptAtStep", "OOMAtStep", "StallAtStep",
     "CorruptCheckpointAtStep", "DeviceLossAtStep", "RestoreCapacityAtStep",
-    "StragglerReplica", "FailingFetch", "SlowFetch", "FaultInjector",
+    "StragglerReplica", "PartitionedHost", "DelayedHeartbeat",
+    "FailingFetch", "SlowFetch", "FaultInjector",
     "set_injector", "get_injector", "clear_injector", "inject",
     "corrupt_checkpoint", "lose_devices", "restore_devices",
     "lost_device_ids", "clear_lost_devices",
+    "partition_host", "heal_host", "partitioned_host_ids",
+    "clear_partitioned_hosts", "set_heartbeat_delay", "heartbeat_delay",
+    "clear_heartbeat_delays",
 ]
 
 
@@ -98,6 +102,53 @@ def lost_device_ids() -> frozenset:
 
 def clear_lost_devices() -> None:
     _LOST_DEVICES.clear()
+
+
+# -- simulated host partition / slow leases ---------------------------------
+# Coordination-layer analogues of the lost-device registry: a PARTITIONED
+# host silently stops writing heartbeat leases while its process keeps
+# stepping (the split-brain the generation fence exists to contain), and
+# a heartbeat DELAY throttles lease writes so the lease ages past its
+# timeout intermittently (the slow-lease path).  Both registries are
+# cleared on inject() exit like the device-loss registry — one test's
+# partition must not bleed into the next test's pod.
+
+_PARTITIONED_HOSTS: set = set()
+_HEARTBEAT_DELAYS: dict = {}
+
+
+def partition_host(hostId) -> None:
+    """Silence a host's heartbeat lease (until heal_host) — its process
+    keeps running, but peers see the lease go stale."""
+    _PARTITIONED_HOSTS.add(str(hostId))
+
+
+def heal_host(hostId) -> None:
+    """End a simulated partition: the host's lease writes resume."""
+    _PARTITIONED_HOSTS.discard(str(hostId))
+
+
+def partitioned_host_ids() -> frozenset:
+    return frozenset(_PARTITIONED_HOSTS)
+
+
+def clear_partitioned_hosts() -> None:
+    _PARTITIONED_HOSTS.clear()
+
+
+def set_heartbeat_delay(hostId, seconds: float) -> None:
+    """Throttle a host's lease writes to at most one per ``seconds`` —
+    with ``seconds`` above the pod's leaseTimeout the lease flaps
+    stale/fresh deterministically."""
+    _HEARTBEAT_DELAYS[str(hostId)] = float(seconds)
+
+
+def heartbeat_delay(hostId) -> float:
+    return float(_HEARTBEAT_DELAYS.get(str(hostId), 0.0))
+
+
+def clear_heartbeat_delays() -> None:
+    _HEARTBEAT_DELAYS.clear()
 
 
 class Fault:
@@ -262,6 +313,41 @@ class StragglerReplica(Fault):
         replica_step_gauge().set(self.seconds, replica=self.replica)
 
 
+class PartitionedHost(Fault):
+    """Silence ``host``'s heartbeat lease right before step ``step``
+    while the process keeps stepping — the deterministic split-brain:
+    peers agree a new topology without this host, and its next fenced
+    checkpoint write must be rejected.  One-shot.  ``step=None``
+    partitions immediately at the first injection-site consultation."""
+
+    def __init__(self, host: str, step: Optional[int] = None):
+        self.host = str(host)
+        self.step = None if step is None else int(step)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and (self.step is None or step >= self.step):
+            self.fired = True
+            partition_host(self.host)
+
+
+class DelayedHeartbeat(Fault):
+    """Throttle ``host``'s lease writes to one per ``seconds`` from step
+    ``fromStep`` on — the slow-lease stand-in (an overloaded host whose
+    heartbeats arrive late enough to look dead intermittently)."""
+
+    def __init__(self, host: str, seconds: float, fromStep: int = 0):
+        self.host = str(host)
+        self.seconds = float(seconds)
+        self.fromStep = int(fromStep)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step >= self.fromStep:
+            self.fired = True
+            set_heartbeat_delay(self.host, self.seconds)
+
+
 class FailingFetch(Fault):
     """Fail the first ``times`` real-data fetch attempts for dataset
     ``what`` (None = any) — exercises the fetchers' bounded retry and
@@ -342,7 +428,9 @@ def clear_injector() -> None:
 def inject(*faults: Fault):
     """Activate an injector for the duration of a with-block.  On exit
     the simulated lost-device set is cleared too — one test's dead chips
-    must not bleed into the next test's availability probe."""
+    must not bleed into the next test's availability probe — and so are
+    the partitioned-host and heartbeat-delay registries (same contract
+    for the coordination layer's leases)."""
     prev = get_injector()
     set_injector(FaultInjector(*faults))
     try:
@@ -350,6 +438,8 @@ def inject(*faults: Fault):
     finally:
         set_injector(prev)
         clear_lost_devices()
+        clear_partitioned_hosts()
+        clear_heartbeat_delays()
 
 
 def check_fetch_fault(what: str) -> None:

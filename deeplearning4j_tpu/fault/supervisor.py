@@ -257,6 +257,10 @@ class FaultTolerantTrainer:
             device=device)
         owns_monitor = (self.healthMonitor is not None and
                         not self.healthMonitor.is_running())
+        if self.healthMonitor is not None:
+            # alert -> action: the watchdog doesn't just page for the
+            # failures this supervisor can fix itself (ROADMAP item 5)
+            self._registerRemediations(self.healthMonitor)
         if owns_monitor:
             self.healthMonitor.start()
         self._activeIterator = iterator
@@ -266,11 +270,63 @@ class FaultTolerantTrainer:
             self._activeIterator = None
             if iterator is not src:
                 iterator.close()
+            if self.healthMonitor is not None:
+                self._unregisterRemediations(self.healthMonitor)
             if owns_monitor:
                 # stop() resolves anything still firing: the run is over,
                 # so "training stalled" would be vacuously stale; the
                 # firing history survives in the event log and counters
                 self.healthMonitor.stop()
+
+    # -- alert -> action remediations -----------------------------------
+    def _remediations(self) -> Dict[str, Any]:
+        """rule name -> remediation callable, registered on the fit's
+        HealthMonitor for the duration of the run.  Subclasses extend
+        (``ElasticSupervisor`` adds ``replica_straggler`` eviction)."""
+        return {"etl_starvation": self._remediateEtlStarvation,
+                "divergence_precursor": self._remediateDivergence}
+
+    def _registerRemediations(self, monitor) -> None:
+        for rule, action in self._remediations().items():
+            monitor.registerAction(rule, action)
+
+    def _unregisterRemediations(self, monitor) -> None:
+        for rule, action in self._remediations().items():
+            monitor.unregisterAction(rule, action)
+
+    def _remediateEtlStarvation(self, rule: str,
+                                detail: str) -> Optional[str]:
+        """A starved consumer with a live producer usually means the
+        pool is wedged (worker deadlock, stuck decode): request a
+        producer-pool restart.  The CONSUMER thread performs it at its
+        next poll — including while blocked on the starved queue — and
+        the replay fast-forward keeps delivery exactly-once."""
+        it = self._activeIterator
+        req = getattr(it, "requestRestart", None)
+        if req is None:
+            return None
+        if getattr(it, "numWorkers", 1) != 1:
+            # the replay skip is exact only for a single-worker pool
+            # (deterministic stream order; supervised fits always pin
+            # one worker) — restarting a multi-worker pool mid-epoch
+            # would reorder the interleave and break exactly-once
+            return None
+        req()
+        self._note("etl_pool_restart_requested", reason=detail)
+        return "producer-pool restart requested"
+
+    def _remediateDivergence(self, rule: str, detail: str) -> Optional[str]:
+        """Divergence precursors (rollbacks happening) tighten the
+        rollback window: halve the checkpoint cadence so the NEXT
+        rollback replays fewer steps."""
+        old = self.checkpointEveryN
+        if old <= 1:
+            return None
+        self.checkpointEveryN = max(1, old // 2)
+        self._note("rollback_window_tightened", was=old,
+                   now=self.checkpointEveryN, reason=detail)
+        return (f"checkpoint cadence tightened "
+                f"{old} -> {self.checkpointEveryN}")
 
     def _fit(self, iterator, epochs: int) -> None:
         net = self.net

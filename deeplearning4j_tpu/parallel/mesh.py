@@ -75,6 +75,23 @@ class DeviceMesh:
         return cls(data=usable // rest, model=model, seq=seq, stage=stage,
                    devices=devices[:usable])
 
+    @classmethod
+    def largest_from_ids(cls, ids, model: int = 1, seq: int = 1,
+                         stage: int = 1,
+                         devices: Optional[Sequence] = None) -> "DeviceMesh":
+        """:meth:`largest_from` over device IDS — the pod-coordination
+        path: consensus agrees on ids (the only representation every
+        host shares), and each process maps them onto its local
+        runtime's device objects here.  Ids absent from the local
+        runtime are ignored (a real pod's processes each see the global
+        device list, so nothing is absent there; the CPU proxy simulates
+        remote hosts with ids the local runtime may not have)."""
+        pool = list(devices if devices is not None else jax.devices())
+        want = {int(i) for i in ids}
+        picked = [d for i, d in enumerate(pool)
+                  if int(getattr(d, "id", i)) in want]
+        return cls.largest_from(picked, model=model, seq=seq, stage=stage)
+
     def deviceIds(self):
         """The participating device ids, flat (re-mesh bookkeeping)."""
         return [int(getattr(d, "id", i))

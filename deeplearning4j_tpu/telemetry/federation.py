@@ -291,13 +291,19 @@ class TelemetryAggregator:
         self._local = localRegistry
         self._localHost = localHost or host_id()
         self.skipped: List[str] = []
+        self.skippedFiles: List[str] = []
         self.hosts: List[str] = []
 
     def load(self) -> List[dict]:
         """All parseable snapshots, oldest write first (stable merge
-        order).  Torn/corrupt files are skipped — a worker mid-death must
-        not 500 the coordinator's scrape."""
+        order).  Torn/partial/corrupt files are skipped AND counted
+        (``dl4j_tpu_federation_snapshots_skipped_total`` in the local
+        registry + :attr:`skippedFiles`) — a worker mid-death or a
+        non-atomic writer must not 500 the coordinator's scrape, but the
+        operator must still see that the federated view is missing a
+        host."""
         snaps = []
+        self.skippedFiles = []
         try:
             names = sorted(os.listdir(self.runDir))
         except OSError:
@@ -310,10 +316,26 @@ class TelemetryAggregator:
                 with open(os.path.join(self.runDir, fn),
                           encoding="utf-8") as f:
                     snap = json.load(f)
-                if isinstance(snap.get("metrics"), dict):
+                if isinstance(snap, dict) and \
+                        isinstance(snap.get("metrics"), dict):
                     snaps.append(snap)
+                else:
+                    self.skippedFiles.append(fn)
             except (OSError, ValueError):
-                continue
+                self.skippedFiles.append(fn)
+        if self.skippedFiles:
+            # count where the federated merge will actually look: the
+            # aggregator's own registry when it has one (the endpoint
+            # wiring passes get_registry(), a custom registry must see
+            # its own skips in merged()), the process registry otherwise
+            reg = self._local if self._local is not None else \
+                get_registry()
+            reg.counter(
+                "dl4j_tpu_federation_snapshots_skipped_total",
+                "Per-worker snapshot files skipped by the aggregator "
+                "because they were torn/partial or unparseable "
+                "(counted per scrape while the file stays bad)").inc(
+                    len(self.skippedFiles))
         snaps.sort(key=lambda s: s.get("written_at", 0.0))
         return snaps
 

@@ -294,6 +294,11 @@ class HealthMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._log_lock = threading.Lock()
+        # alert -> action remediations: callables dispatched on the
+        # FIRING edge of the named rule (see registerAction) — the
+        # self-healing half of the ops loop (ROADMAP item 5)
+        self._actions: Dict[str, List] = {}
+        self._actions_lock = threading.Lock()
         # webhook alert delivery: firing/resolved transitions POST to
         # webhookUrl from a dedicated sender thread — the watchdog only
         # ever enqueues (put_nowait), so a dead endpoint can delay
@@ -406,6 +411,59 @@ class HealthMonitor:
             "Watchdog firing/resolved edges",
             labelnames=("rule", "state")).inc(rule=rule, state=state)
         self._enqueueWebhook(record)
+        if state == "firing":
+            self._dispatchActions(rule, detail)
+
+    # -- alert -> action remediations ------------------------------------
+    def registerAction(self, rule: str, action) -> None:
+        """Register a remediation for ``rule``: ``action(rule, detail)``
+        runs on the FIRING edge (once per transition, not per refresh),
+        on the evaluating thread.  It returns a short outcome string
+        (logged as an ``action`` event) or None for "not applicable".
+        Actions must be quick and thread-safe — heavyweight work should
+        set a flag the owning loop consumes (see
+        ``PrefetchingDataSetIterator.requestRestart``)."""
+        with self._actions_lock:
+            self._actions.setdefault(str(rule), []).append(action)
+
+    def unregisterAction(self, rule: str, action=None) -> None:
+        """Remove ``action`` for ``rule`` (all of the rule's actions
+        when ``action`` is None)."""
+        with self._actions_lock:
+            if action is None:
+                self._actions.pop(str(rule), None)
+                return
+            lst = self._actions.get(str(rule), [])
+            if action in lst:
+                lst.remove(action)
+
+    def _dispatchActions(self, rule: str, detail: str) -> None:
+        with self._actions_lock:
+            actions = list(self._actions.get(rule, ()))
+        if not actions:
+            return
+        from deeplearning4j_tpu.telemetry.federation import host_id
+        counter = self._reg().counter(
+            "dl4j_tpu_health_actions_total",
+            "Remediation actions dispatched on alert firing edges, by "
+            "rule and outcome (ok / noop / failed)",
+            labelnames=("rule", "outcome"))
+        for action in actions:
+            name = getattr(action, "__name__", type(action).__name__)
+            try:
+                result = action(rule, detail)
+                outcome = "noop" if result is None else "ok"
+                note = result or "not applicable"
+            except Exception as e:
+                # a broken remediation is an alert about the remediation,
+                # never a watchdog crash (same contract as rule errors)
+                outcome = "failed"
+                note = f"{type(e).__name__}: {e}"
+            counter.inc(rule=rule, outcome=outcome)
+            self._append({"ts": time.time(), "host": host_id(),
+                          "rule": rule, "state": "action",
+                          "detail": {"action": name, "outcome": outcome,
+                                     "note": note}})
 
     # -- webhook delivery ------------------------------------------------
     def _enqueueWebhook(self, record: dict) -> None:

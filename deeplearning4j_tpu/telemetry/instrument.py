@@ -34,7 +34,8 @@ __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "record_logical_step", "ReplicaTimingListener", "etl_metrics",
            "EtlMetrics", "ServingMetrics", "serving_metrics",
            "MeshMetrics", "mesh_metrics", "ElasticMetrics",
-           "elastic_metrics", "replica_step_gauge"]
+           "elastic_metrics", "CoordMetrics", "coord_metrics",
+           "replica_step_gauge"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -246,6 +247,13 @@ class EtlMetrics:
             "dl4j_tpu_etl_pool_inline_batches_total",
             "Pool batches that bypassed shared memory (oversized or "
             "partial: pickled through the queue instead)")
+
+    def pool_restarts(self):
+        return get_registry().counter(
+            "dl4j_tpu_etl_pool_restarts_total",
+            "Producer-pool restarts (etl_starvation remediation or an "
+            "explicit requestRestart) — the stream position is "
+            "preserved by the consumer's skip fast-forward")
 
 
 _ETL_METRICS = EtlMetrics()
@@ -467,6 +475,67 @@ def elastic_metrics() -> ElasticMetrics:
     """Accessor for the shared elastic metric namespace (see
     :class:`ElasticMetrics`)."""
     return _ELASTIC_METRICS
+
+
+#: a coordinated barrier spans "peers already at their boundary" (ms) to
+#: "the slowest participant is a full checkpoint period away" (tens of
+#: seconds) — DEFAULT_BUCKETS tops out too early for the long tail an
+#: operator needs to see before raising barrierTimeout
+COORD_BARRIER_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0)
+
+
+class CoordMetrics:
+    """The ``dl4j_tpu_coord_*`` namespace, registered from ONE site.
+
+    ``fault.coordination`` reports here: the mesh generation this
+    process has adopted, barrier latency, leader-side dead-lease
+    detections, fenced (stale-generation) writes rejected by the
+    checkpoint fence, and host re-admissions.  Accessors re-resolve
+    through :func:`get_registry` on every call (tests swap the
+    registry).
+    """
+
+    def generation(self):
+        return get_registry().gauge(
+            "dl4j_tpu_coord_generation",
+            "Mesh generation this process has adopted (bumps on every "
+            "agreed pod-wide re-mesh)")
+
+    def barrier_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_coord_barrier_seconds",
+            "Wall time spent in the pod-wide re-mesh barrier (ack "
+            "published to all participants acked)",
+            buckets=COORD_BARRIER_BUCKETS)
+
+    def heartbeats_missed(self):
+        return get_registry().counter(
+            "dl4j_tpu_coord_heartbeats_missed_total",
+            "Hosts whose heartbeat lease expired (leader-side dead-host "
+            "detections, one per live->dead transition)")
+
+    def fenced_writes_rejected(self):
+        return get_registry().counter(
+            "dl4j_tpu_coord_fenced_writes_rejected_total",
+            "Checkpoint seals/manifest publishes rejected by the "
+            "generation fence (stale or evicted writer)")
+
+    def readmissions(self):
+        return get_registry().counter(
+            "dl4j_tpu_coord_readmissions_total",
+            "Evicted hosts/devices re-admitted to the mesh after "
+            "passing the probation policy")
+
+
+_COORD_METRICS = CoordMetrics()
+
+
+def coord_metrics() -> CoordMetrics:
+    """Accessor for the shared coordination metric namespace (see
+    :class:`CoordMetrics`)."""
+    return _COORD_METRICS
 
 
 def note_etl_wait(seconds: float, owner) -> None:

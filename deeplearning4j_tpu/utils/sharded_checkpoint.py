@@ -87,6 +87,24 @@ class ShardedCheckpointer:
         # one sealer thread in flight, joined by waitUntilFinished/close
         self._sealers = []
         self._sealLock = threading.Lock()
+        # generation fence (pod-coordinated elasticity): when installed,
+        # saves and manifest publishes are validated against the pod's
+        # current mesh generation — see setFence()
+        self._fence = None
+
+    def setFence(self, fence) -> None:
+        """Install a write fence (duck-typed: ``validate(op)`` raising
+        when this process must not write, plus a ``generation``
+        attribute).  With a fence installed, every ``saveWithManifest``
+        is validated before the orbax write AND again before the
+        manifest publish, and sealed manifests carry the writer's
+        generation in their metadata.  The publish-time re-check
+        rejects a writer the fence considers EVICTED; a fence may
+        deliberately let a still-legitimate writer whose generation
+        merely advanced mid-seal publish (see
+        :class:`~deeplearning4j_tpu.fault.coordination.GenerationFence`
+        for the participant-vs-evicted distinction)."""
+        self._fence = fence
 
     def _tree(self, net) -> Dict[str, Any]:
         tree = {
@@ -299,6 +317,8 @@ class ShardedCheckpointer:
         # one sealer in flight: a new save must not race the previous
         # step's wait_until_finished/checksum pass on the shared manager
         self._joinSealers()
+        if self._fence is not None:
+            self._fence.validate("checkpoint save")
         step = int(net.iterationCount if step is None else step)
         if step in set(self._mgr.all_steps()):
             self._mgr.delete(step)
@@ -310,6 +330,11 @@ class ShardedCheckpointer:
                   f"checkpoint step {step} save",
                   cleanup=lambda: self._mgr.delete(step))
         meta = dict(metadata or {})
+        if self._fence is not None:
+            # tag the manifest with the writer's mesh generation: a
+            # resharding restore can then tell WHICH topology lineage a
+            # sealed step belongs to
+            meta.setdefault("generation", int(self._fence.generation))
         tree = self._treeSpec(net)
         if block:
             self._seal(step, meta, tree)
@@ -336,6 +361,12 @@ class ShardedCheckpointer:
 
     def _seal(self, step: int, metadata: Dict[str, Any],
               tree: Dict[str, Any]) -> None:
+        if self._fence is not None:
+            # publish-time re-check: the pod may have agreed a NEWER
+            # generation between the save being issued and the (possibly
+            # async) seal running — an unsealed step is simply skipped by
+            # restore, exactly like a crash mid-save
+            self._fence.validate("manifest publish")
         self._mgr.wait_until_finished()
 
         def _checksums():

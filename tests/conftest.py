@@ -66,6 +66,11 @@ def pytest_configure(config):
         "markers", "elastic: elastic re-mesh tests (plan-to-plan "
         "resharding, shrink-on-device-loss, grow-on-recovery, "
         "straggler eviction, async checkpoint sealing)")
+    config.addinivalue_line(
+        "markers", "coord: pod-level coordination tests (heartbeat "
+        "leases, mesh-generation consensus and barrier, checkpoint "
+        "generation fencing, re-admission policy, device-health "
+        "probe, alert-driven remediation)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,1087 @@
+"""Pod-level coordinated elasticity tests (ISSUE 12).
+
+Deterministic coverage of the coordination layer:
+
+- **heartbeat leases**: liveness by lease age, the injected partition
+  (writes stop silently) and slow-lease (writes throttled) faults;
+- **consensus**: establish -> generation 1; dead-host detection; the
+  leader's shrink proposal; the two-host barrier; eviction semantics;
+- **generation fencing**: a stale/evicted process cannot seal a
+  checkpoint or publish a manifest (rejections counted);
+- **re-admission**: probation policy gates (streak + window + budget)
+  at both the coordinator (hosts) and supervisor (devices) levels;
+- **device-health probe**: consecutive-failure threshold, timeout,
+  recovery;
+- **alert -> action remediation**: firing-edge dispatch, the
+  ``etl_starvation`` producer-pool restart (exactly-once delivery
+  preserved), ``divergence_precursor`` rollback-window tightening;
+- **coordinated supervisor** (slow): a peer host dies -> the survivor
+  agrees a shrunken topology and its post-shrink trajectory matches the
+  equivalent single-process ``ElasticSupervisor`` shrink, with a flat
+  steady-state jit-miss counter across the whole re-mesh; plus the REAL
+  2-process kill-one-host acceptance run (federation-test pattern).
+
+Everything fast is driven with explicit ``now`` values — no sleeps on
+the protocol paths; only the multi-process cases are marked ``slow``.
+"""
+import functools
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (DeviceHealthProbe, ElasticSupervisor,
+                                      FaultTolerantTrainer, HeartbeatLease,
+                                      PodCoordinator, PodEvictedError,
+                                      ReadmissionPolicy,
+                                      StaleGenerationError, DeviceLossAtStep,
+                                      PartitionedHost, DelayedHeartbeat,
+                                      inject, partitioned_host_ids)
+from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.fault.elastic import _RemeshRestart
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+from deeplearning4j_tpu.telemetry import (DivergencePrecursorRule,
+                                          EtlStarvationRule, HealthMonitor,
+                                          MetricsRegistry, ThresholdRule,
+                                          get_registry)
+from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer
+
+pytestmark = pytest.mark.coord
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = telemetry.set_registry(MetricsRegistry())
+    yield
+    telemetry.set_registry(prev)
+
+
+def _counter(name, **labels):
+    m = get_registry().get(name)
+    if m is None:
+        return 0.0
+    return m.value(**labels)
+
+
+def _mlp(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer.builder().nIn(8).nOut(16)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(4)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(8)).build())
+    return MultiLayerNetwork(conf)
+
+
+def _toy(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = np.random.RandomState(1).randn(8, 4)
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def _batches(x, y, per=16):
+    n = len(x) // per
+    return ListDataSetIterator(
+        [DataSet(x[i * per:(i + 1) * per], y[i * per:(i + 1) * per])
+         for i in range(n)], batch=per)
+
+
+def _pod(run_dir, t0=1000.0, **kw):
+    """Two in-process coordinators over one run dir, established at
+    generation 1 (h0 owns devices 0-1, h1 owns 2-3)."""
+    c0 = PodCoordinator(str(run_dir), "h0", devices=[0, 1], **kw)
+    c1 = PodCoordinator(str(run_dir), "h1", devices=[2, 3], **kw)
+    c0.lease.write_now(now=t0)
+    c1.lease.write_now(now=t0)
+    c0.establish(["h0", "h1"], timeout=5)
+    c1.establish(["h0", "h1"], timeout=5)
+    return c0, c1
+
+
+# ------------------------------------------------------------- leases ----
+
+class TestHeartbeatLease:
+    def test_lease_liveness_by_age(self, tmp_path):
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        c0.lease.write_now(now=100.0)
+        c1.lease.write_now(now=100.0)
+        assert set(c0.liveHosts(now=101.0)) == {"h0", "h1"}
+        c0.lease.write_now(now=105.0)
+        # h1's last write at 100, age 5 > 2 -> dead
+        assert set(c0.liveHosts(now=105.0)) == {"h0"}
+        assert c0.leader(now=105.0) == "h0"
+
+    def test_leader_is_lowest_live_host(self, tmp_path):
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        c0.lease.write_now(now=100.0)
+        c1.lease.write_now(now=100.0)
+        assert c0.leader(now=100.5) == "h0"
+        assert c1.leader(now=100.5) == "h0"
+        c1.lease.write_now(now=110.0)   # h0 stale now
+        assert c1.leader(now=110.5) == "h1"
+        assert c1.isLeader(now=110.5)
+
+    def test_partitioned_host_stops_writing(self, tmp_path):
+        lease = HeartbeatLease(str(tmp_path / "coord"), "hx",
+                               devices=[0])
+        assert lease.write_now(now=1.0)
+        seq = lease.seq
+        with inject(PartitionedHost("hx", step=None)) as inj:
+            inj.before_step(0, None, None)
+            assert "hx" in partitioned_host_ids()
+            assert lease.write_now(now=2.0) == ""
+            assert lease.seq == seq     # a skipped write is not a beat
+        # inject() exit clears the partition registry (satellite
+        # contract: like the device-loss registry)
+        assert not partitioned_host_ids()
+        assert lease.write_now(now=3.0)
+
+    def test_delayed_heartbeat_throttles_writes(self, tmp_path):
+        lease = HeartbeatLease(str(tmp_path / "coord"), "hy")
+        with inject(DelayedHeartbeat("hy", seconds=10.0)) as inj:
+            inj.before_step(0, None, None)
+            assert lease.write_now(now=100.0)
+            assert lease.write_now(now=105.0) == ""  # inside the delay
+            assert lease.write_now(now=111.0)        # late beat lands
+        assert _inj.heartbeat_delay("hy") == 0.0     # cleared on exit
+
+
+# ---------------------------------------------------------- consensus ----
+
+class TestConsensus:
+    def test_establish_seals_generation_one(self, tmp_path):
+        c0, c1 = _pod(tmp_path)
+        for c in (c0, c1):
+            assert c.generation == 1
+            assert c.participants == ("h0", "h1")
+            assert c.deviceIds == (0, 1, 2, 3)
+        assert _counter("dl4j_tpu_coord_generation") == 1.0
+
+    def test_dead_host_shrink_bumps_generation(self, tmp_path):
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        c0.lease.write_now(now=100.0)
+        c1.lease.write_now(now=100.0)
+        hb0 = _counter("dl4j_tpu_coord_heartbeats_missed_total")
+        # h1 stops beating; at now=110 its lease is long stale
+        c0.lease.write_now(now=110.0)
+        plan = c0.poll(now=110.0)
+        assert plan is not None and plan["generation"] == 2
+        assert plan["participants"] == ["h0"]
+        assert plan["deviceIds"] == [0, 1]
+        assert c0.generation == 2
+        assert _counter("dl4j_tpu_coord_heartbeats_missed_total") == \
+            hb0 + 1
+        assert _counter("dl4j_tpu_coord_generation") == 2.0
+        h = get_registry().get("dl4j_tpu_coord_barrier_seconds")
+        assert h is not None and h.count() >= 1
+        # steady state: no further proposals
+        assert c0.poll(now=110.5) is None
+
+    def test_device_change_triggers_two_host_barrier(self, tmp_path):
+        """h0 loses device 1: the leader proposes [0, 2, 3] and BLOCKS
+        in the barrier until h1 acks at its own boundary — then both
+        adopt the same generation."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=30.0, barrierTimeout=10.0)
+        c0.setHealthyDevices([0])
+        c1.lease.write_now()
+        results = {}
+
+        def leader():
+            results["h0"] = c0.poll()
+
+        t = threading.Thread(target=leader, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while (c1.currentPlan() or {}).get("generation", 0) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        results["h1"] = c1.poll()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        for host in ("h0", "h1"):
+            assert results[host]["generation"] == 2
+            assert results[host]["deviceIds"] == [0, 2, 3]
+        assert c0.generation == c1.generation == 2
+
+    def test_evicted_host_poll_raises(self, tmp_path):
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        c0.lease.write_now(now=110.0)
+        assert c0.poll(now=110.0)["participants"] == ["h0"]
+        with pytest.raises(PodEvictedError):
+            c1.poll(now=111.0)
+        assert c1.generation == 1   # the stale host never adopts
+
+    def test_establish_recomposed_pod_over_old_run_dir(self, tmp_path):
+        """A pod restarting over a surviving run dir with a REPLACED
+        host must not adopt the old plan as-is (the new host would not
+        be a participant and every fenced save it attempts would be
+        rejected): the leader publishes the next generation with the
+        new composition."""
+        _pod(tmp_path, leaseTimeout=2.0)        # old lineage: gen 1
+        c0 = PodCoordinator(str(tmp_path), "h0", devices=[0, 1])
+        c2 = PodCoordinator(str(tmp_path), "h2", devices=[4, 5])
+        c0.lease.write_now()
+        c2.lease.write_now()
+        c0.establish(["h0", "h2"], timeout=5)
+        c2.establish(["h0", "h2"], timeout=5)
+        assert c0.generation == c2.generation == 2
+        assert c0.participants == ("h0", "h2")
+        assert c0.deviceIds == (0, 1, 4, 5)
+        c2.fence().validate("checkpoint save")  # h2 can seal: no raise
+
+    def test_same_generation_racing_publish_converges_on_file(
+            self, tmp_path):
+        """Two leaders racing at the lease-timeout edge publish
+        DIFFERENT plans under the same generation number: the published
+        file is canonical — a barrier anchored on the losing plan must
+        re-anchor on it, never pass on acks made for a different
+        topology (the split-brain the module exists to prevent)."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=30.0, barrierTimeout=10.0)
+        losing = {"generation": 2, "participants": ["h0", "h1"],
+                  "deviceIds": [0, 1], "proposedBy": "h0",
+                  "reason": "race-a", "ts": time.time()}
+        winning = {"generation": 2, "participants": ["h0", "h1"],
+                   "deviceIds": [0, 1, 2, 3], "proposedBy": "h1",
+                   "reason": "race-b", "ts": time.time()}
+        c1._publish(winning)                # last write won the file
+        t = threading.Thread(
+            target=lambda: c1._adoptPublished(dict(winning)), daemon=True)
+        t.start()
+        adopted = c0._adoptPublished(dict(losing))
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert adopted["deviceIds"] == [0, 1, 2, 3]
+        assert c0.deviceIds == c1.deviceIds == (0, 1, 2, 3)
+        assert c0.generation == c1.generation == 2
+
+    def test_adopted_losing_plan_reanchors_at_next_poll(self, tmp_path):
+        """The narrower race: a host whose barrier COMPLETED on the
+        losing plan before the winner landed has already ADOPTED it —
+        its next poll() must re-anchor on the canonical file (same
+        generation, different digest) and ack the winner, or peers
+        still in their barrier wait forever for this host's ack."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=30.0, barrierTimeout=10.0)
+        losing = {"generation": 2, "participants": ["h0", "h1"],
+                  "deviceIds": [0, 1], "proposedBy": "h0",
+                  "reason": "race-a", "ts": time.time()}
+        winning = {"generation": 2, "participants": ["h0", "h1"],
+                   "deviceIds": [0, 1, 2, 3], "proposedBy": "h1",
+                   "reason": "race-b", "ts": time.time()}
+        c0._adopt(dict(losing))     # its barrier passed pre-publish
+        c1._publish(winning)
+        t = threading.Thread(
+            target=lambda: c1._adoptPublished(dict(winning)), daemon=True)
+        t.start()
+        plan = c0.poll()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert plan is not None and plan["deviceIds"] == [0, 1, 2, 3]
+        assert c0.deviceIds == c1.deviceIds == (0, 1, 2, 3)
+        assert c0.generation == c1.generation == 2
+        # stable afterwards: same generation, same digest — no churn
+        assert c0.poll() is None
+
+
+# ------------------------------------------------------------ fencing ----
+
+class TestGenerationFencing:
+    def _shrunken_pod(self, tmp_path):
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        c0.lease.write_now(now=110.0)
+        assert c0.poll(now=110.0)["generation"] == 2
+        return c0, c1
+
+    def test_stale_writer_cannot_save_checkpoint(self, tmp_path):
+        c0, c1 = self._shrunken_pod(tmp_path)
+        net = _mlp()
+        net.init()
+        ckpt = ShardedCheckpointer(str(tmp_path / "ck1"))
+        ckpt.setFence(c1.fence())
+        rej0 = _counter("dl4j_tpu_coord_fenced_writes_rejected_total")
+        try:
+            with pytest.raises(StaleGenerationError):
+                ckpt.saveWithManifest(net, step=1)
+            # rejected BEFORE the orbax write: no step, no manifest
+            assert ckpt.allSteps() == []
+            assert _counter(
+                "dl4j_tpu_coord_fenced_writes_rejected_total") == rej0 + 1
+        finally:
+            ckpt.close()
+
+    def test_current_holder_seals_with_generation_metadata(self, tmp_path):
+        c0, _c1 = self._shrunken_pod(tmp_path)
+        net = _mlp()
+        net.init()
+        ckpt = ShardedCheckpointer(str(tmp_path / "ck0"))
+        ckpt.setFence(c0.fence())
+        try:
+            step = ckpt.saveWithManifest(net, step=3,
+                                         metadata={"stepInEpoch": 1})
+            assert ckpt.latestValidStep() == step
+            meta = ckpt.readMetadata(step)
+            assert meta["generation"] == 2
+            assert meta["stepInEpoch"] == 1
+        finally:
+            ckpt.close()
+
+    def test_publish_time_fence_rejects_seal(self, tmp_path):
+        """The generation moves between the save being issued and the
+        manifest publish: the seal-time re-check leaves the step
+        UNSEALED (restore skips it like a crash mid-save)."""
+        class FlipFence:
+            generation = 1
+            stale = False
+
+            def validate(self, op):
+                if self.stale and "publish" in op:
+                    raise StaleGenerationError(f"fenced {op}")
+
+        net = _mlp()
+        net.init()
+        ckpt = ShardedCheckpointer(str(tmp_path / "ck"))
+        fence = FlipFence()
+        ckpt.setFence(fence)
+        try:
+            ckpt.saveWithManifest(net, step=1)      # sealed while valid
+            fence.stale = True
+            with pytest.raises(StaleGenerationError):
+                ckpt.saveWithManifest(net, step=2)
+            assert ckpt.latestValidStep() == 1      # step 2 unsealed
+        finally:
+            ckpt.setFence(None)
+            ckpt.close()
+
+
+# --------------------------------------------------------- readmission ----
+
+class TestReadmission:
+    def test_policy_gates(self):
+        pol = ReadmissionPolicy(healthyHeartbeats=2, probationSeconds=10.0,
+                                maxReadmissions=1)
+        pol.note_evicted("h1", now=100.0)
+        assert not pol.eligible("h1", now=100.0)
+        pol.observe("h1", seq=1, now=101.0)
+        pol.observe("h1", seq=1, now=102.0)     # same seq: not a beat
+        assert not pol.eligible("h1", now=115.0)
+        pol.observe("h1", seq=2, now=103.0)
+        # streak satisfied but probation window not elapsed
+        assert not pol.eligible("h1", now=105.0)
+        assert pol.eligible("h1", now=111.0)
+        # an unhealthy observation resets the streak
+        pol.observe("h1", seq=3, now=112.0, healthy=False)
+        assert not pol.eligible("h1", now=120.0)
+        pol.observe("h1", seq=4, now=121.0)
+        pol.observe("h1", seq=5, now=122.0)
+        assert pol.eligible("h1", now=122.0)
+        pol.record_readmitted("h1")
+        # budget exhausted: a second eviction is permanent
+        pol.note_evicted("h1", now=200.0)
+        pol.observe("h1", seq=6, now=201.0)
+        pol.observe("h1", seq=7, now=202.0)
+        assert not pol.eligible("h1", now=300.0)
+
+    def test_coordinator_readmits_after_probation(self, tmp_path):
+        pol = ReadmissionPolicy(healthyHeartbeats=2, probationSeconds=0.0,
+                                maxReadmissions=1)
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0, barrierTimeout=10.0,
+                      readmission=pol)
+        c0.readmission = pol
+        # h1 dies -> gen 2 without it
+        c0.lease.write_now(now=110.0)
+        assert c0.poll(now=110.0)["generation"] == 2
+        re0 = _counter("dl4j_tpu_coord_readmissions_total")
+        # h1 returns: two fresh beats required before the proposal
+        c1.lease.write_now(now=111.0)
+        c0.lease.write_now(now=111.0)
+        assert c0.poll(now=111.0) is None       # streak 1 of 2
+        c1.lease.write_now(now=112.0)
+        c0.lease.write_now(now=112.0)
+
+        results = {}
+
+        def leader():
+            results["plan"] = c0.poll(now=112.0)
+
+        t = threading.Thread(target=leader, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while (c1.currentPlan() or {}).get("generation", 0) < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # h1 adopts gen 3 directly (it never saw gen 2, which is fine:
+        # the plan file only ever holds the pod's latest agreement)
+        c1.poll(now=112.5)
+        t.join(timeout=10.0)
+        plan = results["plan"]
+        assert plan["generation"] == 3
+        assert plan["participants"] == ["h0", "h1"]
+        assert plan["deviceIds"] == [0, 1, 2, 3]
+        assert c1.generation == 3
+        assert _counter("dl4j_tpu_coord_readmissions_total") == re0 + 1
+        # second death: the budget (1) is spent -> never readmitted
+        c0.lease.write_now(now=130.0)
+        assert c0.poll(now=130.0)["generation"] == 4
+        c1.lease.write_now(now=131.0)
+        c0.lease.write_now(now=131.0)
+        assert c0.poll(now=131.0) is None
+        c1.lease.write_now(now=132.0)
+        c0.lease.write_now(now=132.0)
+        assert c0.poll(now=132.0) is None
+        assert c0.generation == 4
+
+    def test_evicted_heartbeating_host_does_not_pin_leadership(
+            self, tmp_path):
+        """An evicted host that keeps heartbeating (required while it
+        awaits re-admission) must not win leader election: a leader
+        outside the participants can never propose (its poll raises
+        PodEvictedError first) while the real participants never enter
+        their leader branch — the pod would deadlock."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        plan = {"generation": 2, "participants": ["h1"],
+                "deviceIds": [2, 3], "proposedBy": "h1",
+                "reason": "topology change", "ts": 100.0}
+        c1._publish(plan)
+        c1._adopt(plan)
+        # h0 (the lowest host id) heals and heartbeats again
+        c0.lease.write_now(now=200.0)
+        c1.lease.write_now(now=200.0)
+        assert c1.leader(now=200.5) == "h1"
+        assert c1.isLeader(now=200.5)
+
+    def test_readmission_budget_survives_failed_publish(
+            self, tmp_path, monkeypatch):
+        """The re-admission budget burns when the plan is PUBLISHED,
+        not when the proposal is computed — a transient publish failure
+        must not consume maxReadmissions or reset the healthy streak."""
+        pol = ReadmissionPolicy(healthyHeartbeats=1, probationSeconds=0.0,
+                                maxReadmissions=1)
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0, barrierTimeout=10.0,
+                      readmission=pol)
+        c0.readmission = pol
+        c0.lease.write_now(now=110.0)
+        assert c0.poll(now=110.0)["generation"] == 2    # h1 dead
+        re0 = _counter("dl4j_tpu_coord_readmissions_total")
+        c1.lease.write_now(now=111.0)
+        c0.lease.write_now(now=111.0)
+        monkeypatch.setattr(
+            c0, "_publish",
+            lambda plan: (_ for _ in ()).throw(OSError("disk full")))
+        with pytest.raises(OSError):
+            c0.poll(now=111.0)
+        # nothing was published: budget intact, streak intact
+        assert pol.eligible("h1", now=111.0)
+        assert _counter("dl4j_tpu_coord_readmissions_total") == re0
+
+    def test_supervisor_device_readmission(self, tmp_path):
+        """Straggler-evicted DEVICES re-enter through the same policy:
+        readmitAfter healthy boundaries + probation + budget."""
+        net = _mlp()
+        net.init()
+        dev = jax.devices()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=4, devices=dev[:4]))
+        es = ElasticSupervisor(pw, str(tmp_path / "el"),
+                               checkpointEveryN=2, readmitAfter=2,
+                               readmissionProbation=0.0, maxReadmissions=1)
+        es._evicted = {2, 3}
+        es._readmitPolicy.note_evicted("2", now=0.0)
+        es._readmitPolicy.note_evicted("3", now=0.0)
+        re0 = _counter("dl4j_tpu_coord_readmissions_total")
+        es._maybeReadmit()                  # streak 1 of 2
+        assert es._evicted == {2, 3}
+        es._maybeReadmit()                  # streak 2: readmitted
+        assert es._evicted == set()
+        assert _counter("dl4j_tpu_coord_readmissions_total") == re0 + 2
+        es.close()
+
+
+# ------------------------------------------------------- health probe ----
+
+class TestDeviceHealthProbe:
+    def test_all_healthy_on_cpu(self):
+        dev = jax.devices()[:3]
+        probe = DeviceHealthProbe(timeout=10.0, devices=dev)
+        assert probe() == list(dev)
+
+    def test_consecutive_failure_threshold_and_recovery(self, monkeypatch):
+        dev = jax.devices()[:3]
+        probe = DeviceHealthProbe(timeout=10.0, failThreshold=2,
+                                  devices=dev, deadRetrySeconds=0.0)
+        bad = {1}
+        monkeypatch.setattr(
+            probe, "_run_with_timeout",
+            lambda d: int(getattr(d, "id", -1)) not in bad)
+        ids = lambda devs: [int(d.id) for d in devs]  # noqa: E731
+        # one failure is below the threshold: still healthy
+        assert ids(probe()) == [0, 1, 2]
+        # second consecutive failure: unhealthy
+        assert ids(probe()) == [0, 2]
+        # one passing probe resets the streak
+        bad.clear()
+        assert ids(probe()) == [0, 1, 2]
+
+    def test_dead_dispatch_backoff_skips_reprobing(self, monkeypatch):
+        """A device whose probe DISPATCH failed is not re-dispatched
+        inside the backoff window — a dead chip must not stall every
+        checkpoint boundary by `timeout` for the rest of the run."""
+        dev = jax.devices()[:2]
+        probe = DeviceHealthProbe(timeout=10.0, failThreshold=1,
+                                  devices=dev, deadRetrySeconds=60.0)
+        calls = []
+        monkeypatch.setattr(
+            probe, "_run_with_timeout",
+            lambda d: calls.append(int(d.id)) or int(d.id) != 1)
+        assert [int(d.id) for d in probe()] == [0]
+        assert [int(d.id) for d in probe()] == [0]
+        assert [int(d.id) for d in probe()] == [0]
+        # device 1 was dispatched exactly once; device 0 every sweep
+        assert calls.count(1) == 1 and calls.count(0) == 3
+
+    def test_single_transient_timeout_not_shed_by_backoff(self,
+                                                          monkeypatch):
+        """The failure threshold counts PROBES, not boundaries: one
+        transient dispatch failure must not consume the whole threshold
+        through unprobed backoff boundaries (the backoff only starts
+        once the streak reaches the threshold), and a dead chip still
+        needs ``failThreshold`` REAL failed probes before it is shed."""
+        dev = jax.devices()[:3]
+        probe = DeviceHealthProbe(timeout=10.0, failThreshold=2,
+                                  devices=dev, deadRetrySeconds=60.0)
+        flaky = {1: [False]}        # one transient blip, then healthy
+        dead = {2}
+        calls = []
+
+        def run(d):
+            did = int(d.id)
+            calls.append(did)
+            if did in dead:
+                return False
+            seq = flaky.get(did)
+            return not (seq and not seq.pop(0))
+
+        monkeypatch.setattr(probe, "_run_with_timeout", run)
+        ids = lambda devs: [int(d.id) for d in devs]  # noqa: E731
+        # blip on 1 (streak 1 < 2: healthy, NO backoff below threshold);
+        # first real failure on 2
+        assert ids(probe()) == [0, 1, 2]
+        # 1 is re-probed (not held by backoff) and recovers; 2 crosses
+        # the threshold on its SECOND real probe and starts its backoff
+        assert ids(probe()) == [0, 1]
+        # inside 2's backoff: no dispatch, streak holds, stays unhealthy
+        assert ids(probe()) == [0, 1]
+        assert calls.count(1) == 3 and calls.count(2) == 2
+
+    def test_injected_lost_devices_fail_probes(self):
+        dev = jax.devices()[:2]
+        probe = DeviceHealthProbe(timeout=10.0, failThreshold=1,
+                                  devices=dev)
+        with inject(DeviceLossAtStep(0, devices=(0,))) as inj:
+            with pytest.raises(Exception):
+                inj.before_step(0, None, None)
+            assert [int(d.id) for d in probe()] == [1]
+        assert probe() == list(dev)     # restored after inject() exit
+
+    def test_timeout_marks_probe_failed(self, monkeypatch):
+        dev = jax.devices()[:1]
+        probe = DeviceHealthProbe(timeout=0.05, failThreshold=1,
+                                  devices=dev)
+
+        def wedged(device):
+            time.sleep(0.3)
+            return True
+
+        monkeypatch.setattr(probe, "_probe_once", wedged)
+        assert probe() == []
+
+
+# ------------------------------------------------- alert -> action -------
+
+class TestHealthActions:
+    def test_action_dispatch_on_firing_edge_only(self, tmp_path):
+        g = get_registry().gauge("dl4j_tpu_test_pressure", "test signal")
+        mon = HealthMonitor(
+            rules=[ThresholdRule("pressure", "dl4j_tpu_test_pressure",
+                                 ">", 0.5)],
+            eventLogPath=str(tmp_path / "events.jsonl"))
+        calls = []
+        mon.registerAction("pressure",
+                           lambda rule, detail: calls.append(detail)
+                           or "handled")
+        g.set(1.0)
+        mon.evaluate_once(now=1.0)
+        mon.evaluate_once(now=2.0)      # still firing: no re-dispatch
+        assert len(calls) == 1
+        g.set(0.0)
+        mon.evaluate_once(now=3.0)      # resolved
+        g.set(1.0)
+        mon.evaluate_once(now=4.0)      # new edge: dispatched again
+        assert len(calls) == 2
+        assert _counter("dl4j_tpu_health_actions_total",
+                        rule="pressure", outcome="ok") == 2.0
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "events.jsonl").read_text().splitlines()]
+        acts = [ln for ln in lines if ln["state"] == "action"]
+        assert len(acts) == 2 and acts[0]["rule"] == "pressure"
+
+    def test_failing_action_is_counted_not_fatal(self, tmp_path):
+        g = get_registry().gauge("dl4j_tpu_test_pressure", "test signal")
+        mon = HealthMonitor(
+            rules=[ThresholdRule("pressure", "dl4j_tpu_test_pressure",
+                                 ">", 0.5)],
+            eventLogPath=str(tmp_path / "events.jsonl"))
+
+        def boom(rule, detail):
+            raise RuntimeError("remediation exploded")
+
+        mon.registerAction("pressure", boom)
+        g.set(1.0)
+        firing = mon.evaluate_once(now=1.0)     # must not raise
+        assert "pressure" in firing
+        assert _counter("dl4j_tpu_health_actions_total",
+                        rule="pressure", outcome="failed") == 1.0
+        mon.unregisterAction("pressure")
+        g.set(0.0)
+        mon.evaluate_once(now=2.0)
+        g.set(1.0)
+        mon.evaluate_once(now=3.0)
+        assert _counter("dl4j_tpu_health_actions_total",
+                        rule="pressure", outcome="failed") == 1.0
+
+    def test_divergence_precursor_tightens_rollback_window(self, tmp_path):
+        net = _mlp()
+        tr = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                  checkpointEveryN=8)
+        mon = HealthMonitor(
+            rules=[DivergencePrecursorRule(quietSeconds=300.0)],
+            eventLogPath=str(tmp_path / "events.jsonl"))
+        tr._registerRemediations(mon)
+        c = get_registry().counter(
+            "dl4j_tpu_fault_nan_rollbacks_total",
+            "Divergence (NaN/Inf/threshold/solver) rollbacks to the "
+            "last good checkpoint")
+        mon.evaluate_once(now=0.0)      # baseline
+        c.inc()
+        mon.evaluate_once(now=1.0)      # precursor fires -> tighten
+        assert tr.checkpointEveryN == 4
+        assert _counter("dl4j_tpu_health_actions_total",
+                        rule="divergence_precursor", outcome="ok") == 1.0
+        tr.close()
+
+
+def _wedge_factory(flagPath, spec):
+    """Picklable pool source: one batch, then the worker wedges until
+    the flag file appears (the deterministic stand-in for a stuck
+    decode), then the remaining batches."""
+    import os
+    import time as _t
+
+    import numpy as _np
+
+    from deeplearning4j_tpu.datasets import DataSet as _DS
+
+    def gen():
+        x = _np.ones((4, 2), _np.float32)
+        y = _np.zeros((4, 1), _np.float32)
+        yield _DS(x * 0, y)
+        deadline = _t.time() + 30.0
+        while not os.path.exists(flagPath) and _t.time() < deadline:
+            _t.sleep(0.02)
+        for i in (1, 2, 3):
+            yield _DS(x * i, y)
+
+    return gen()
+
+
+class TestEtlStarvationRemediation:
+    def test_alert_restarts_pool_and_resolves(self, tmp_path):
+        """Acceptance: the consumer starves on a wedged producer, the
+        etl_starvation alert fires, the supervisor's remediation
+        restarts the pool, every batch is delivered exactly once, and
+        the alert resolves."""
+        from deeplearning4j_tpu.datavec.pipeline import \
+            PrefetchingDataSetIterator
+        flag = tmp_path / "unwedge.flag"
+        it = PrefetchingDataSetIterator(
+            functools.partial(_wedge_factory, str(flag)),
+            numWorkers=1, hostIndex=0, hostCount=1)
+        tr = FaultTolerantTrainer(_mlp(), str(tmp_path / "ck"))
+        tr._activeIterator = it
+        mon = HealthMonitor(
+            rules=[EtlStarvationRule(forSeconds=5.0)],
+            eventLogPath=str(tmp_path / "events.jsonl"))
+        tr._registerRemediations(mon)
+        got = []
+
+        def consume():
+            while it.hasNext():
+                got.append(float(it.next().features.numpy()[0, 0]))
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        try:
+            # wait until the first batch landed and the consumer is
+            # demonstrably blocked on the wedged producer
+            deadline = time.monotonic() + 30.0
+            waiting = get_registry().get("dl4j_tpu_etl_consumers_waiting")
+            while not (len(got) >= 1 and waiting is not None
+                       and waiting.value() >= 1):
+                assert time.monotonic() < deadline, "consumer never blocked"
+                time.sleep(0.02)
+                waiting = get_registry().get(
+                    "dl4j_tpu_etl_consumers_waiting")
+            restarts0 = _counter("dl4j_tpu_etl_pool_restarts_total")
+            mon.evaluate_once(now=100.0)            # arms the stopwatch
+            firing = mon.evaluate_once(now=106.0)   # past forSeconds
+            assert "etl_starvation" in firing
+            assert _counter("dl4j_tpu_health_actions_total",
+                            rule="etl_starvation", outcome="ok") == 1.0
+            flag.write_text("go")                   # unwedge gen 2
+            t.join(timeout=30.0)
+            assert not t.is_alive()
+            # exactly-once: the replayed prefix was skipped
+            assert got == [0.0, 1.0, 2.0, 3.0]
+            assert _counter("dl4j_tpu_etl_pool_restarts_total") == \
+                restarts0 + 1
+            # the stream is flowing again: the alert resolves
+            assert "etl_starvation" not in mon.evaluate_once(now=200.0)
+            assert _counter("dl4j_tpu_health_alert_transitions_total",
+                            rule="etl_starvation", state="resolved") == 1.0
+        finally:
+            it.close()
+            tr.close()
+
+
+# ------------------------------------------- coordinated supervisor ------
+
+class TestCoordinatedSupervisor:
+    def test_checkpoint_boundary_shrink_via_consensus(self, tmp_path):
+        """Fast integration (no training): the peer's lease is stale at
+        the checkpoint boundary -> the supervisor agrees a shrunken
+        topology, remeshes through the PR 11 path, and unwinds to the
+        resume loop."""
+        run = tmp_path / "run"
+        c0 = PodCoordinator(str(run), "h0", devices=[0, 1],
+                            leaseTimeout=1.0)
+        peer = HeartbeatLease(os.path.join(str(run), "coord"), "h1",
+                              devices=[2, 3])
+        peer.write_now(now=time.time() - 60.0)  # present but long dead
+        c0.establish(["h0", "h1"], timeout=5)
+        assert c0.deviceIds == (0, 1, 2, 3)
+
+        net = _mlp()
+        net.init()
+        dev = jax.devices()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=4, devices=dev[:4]))
+        es = ElasticSupervisor(pw, str(tmp_path / "el"),
+                               checkpointEveryN=2, coordinator=c0)
+        try:
+            with pytest.raises(_RemeshRestart):
+                es._checkpoint(stepInEpoch=0)
+            assert sorted(pw.mesh.deviceIds()) == [0, 1]
+            assert c0.generation == 2
+            assert [r["direction"] for r in es.stats["remeshes"]] == \
+                ["shrink"]
+            assert _counter("dl4j_tpu_coord_generation") == 2.0
+            # the adoption happened BEFORE the save (a healthy host
+            # must never be fenced by a generation it was about to
+            # adopt): the next boundary seals under generation 2
+            es._checkpoint(stepInEpoch=0)
+            step = es.ckpt.latestValidStep()
+            assert step is not None
+            assert es.ckpt.readMetadata(step)["generation"] == 2
+        finally:
+            es.close()
+
+    def test_save_time_generation_race_retries_not_fatal(self, tmp_path):
+        """A peer leader publishing a new generation in the window
+        between this host's poll and its fenced save (manifest sealing
+        joins first — seconds on big checkpoints) is the pod's own
+        lineage advancing, not this host going stale: the boundary must
+        re-poll, adopt, and seal under the NEW generation instead of
+        crashing a healthy participant."""
+        run = tmp_path / "run"
+        c0 = PodCoordinator(str(run), "h0", devices=[0, 1],
+                            leaseTimeout=30.0)
+        c0.establish(["h0"], timeout=5)
+        net = _mlp()
+        net.init()
+        dev = jax.devices()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=2, devices=dev[:2]))
+        es = ElasticSupervisor(pw, str(tmp_path / "el"),
+                               checkpointEveryN=2, coordinator=c0)
+        realPoll = es._coordPoll
+
+        def racingPoll():
+            racing = c0.generation == 1
+            realPoll()
+            if racing and c0.generation == 1:
+                # the "peer": same topology, next generation (e.g. a
+                # readmission round) published right after our poll
+                c0._publish({"generation": 2, "participants": ["h0"],
+                             "deviceIds": [0, 1], "proposedBy": "h1",
+                             "reason": "race", "ts": time.time()})
+
+        es._coordPoll = racingPoll
+        try:
+            es._checkpoint(stepInEpoch=0)       # must NOT raise
+            assert c0.generation == 2
+            step = es.ckpt.latestValidStep()
+            assert step is not None
+            assert es.ckpt.readMetadata(step)["generation"] == 2
+            # the first attempt was fenced and retried, but a healthy
+            # still-participant racing its own pod's lineage advance is
+            # NOT a stale writer: the metric must stay flat or every
+            # busy re-mesh would hand operators false stale-writer
+            # alerts
+            assert _counter(
+                "dl4j_tpu_coord_fenced_writes_rejected_total") == 0.0
+        finally:
+            es.close()
+
+    @pytest.mark.slow
+    def test_coordinated_shrink_matches_local_shrink_trajectory(
+            self, tmp_path):
+        """A peer host dies before the run's first boundary: the
+        survivor's coordinated shrink must produce the SAME trajectory
+        as a single-process ElasticSupervisor losing those devices
+        locally — and the jit-miss counter stays flat across continued
+        stepping after the re-mesh."""
+        x, y = _toy()
+        dev = jax.devices()
+
+        ref = _mlp()
+        ref.init()
+        pr = ParallelWrapper(ref, mesh=DeviceMesh(data=4, devices=dev[:4]))
+        tr_ref = ElasticSupervisor(pr, str(tmp_path / "ref"),
+                                   checkpointEveryN=2, keepLast=10)
+        with inject(DeviceLossAtStep(0, devices=(2, 3))):
+            tr_ref.fit(_batches(x, y), epochs=2)
+        assert sorted(pr.mesh.deviceIds()) == [0, 1]
+
+        run = tmp_path / "run"
+        c0 = PodCoordinator(str(run), "h0", devices=[0, 1],
+                            leaseTimeout=1.0)
+        peer = HeartbeatLease(os.path.join(str(run), "coord"), "h1",
+                              devices=[2, 3])
+        peer.write_now(now=time.time() - 60.0)
+        c0.establish(["h0", "h1"], timeout=5)
+
+        net = _mlp()
+        net.init()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=4, devices=dev[:4]))
+        es = ElasticSupervisor(pw, str(tmp_path / "el"),
+                               checkpointEveryN=2, keepLast=10,
+                               coordinator=c0)
+        es.fit(_batches(x, y), epochs=2)
+
+        assert sorted(pw.mesh.deviceIds()) == [0, 1]
+        assert c0.generation == 2
+        assert [r["direction"] for r in es.stats["remeshes"]] == ["shrink"]
+        assert net.iterationCount == 8
+        assert es.lastLoss == pytest.approx(tr_ref.lastLoss, abs=1e-5)
+        np.testing.assert_allclose(net.params().numpy(),
+                                   ref.params().numpy(),
+                                   rtol=2e-4, atol=2e-5)
+        # zero steady-state recompiles across the whole coordinated
+        # re-mesh: more steps on the agreed mesh hit the warm executable
+        m1 = _counter("dl4j_tpu_mesh_jit_cache_misses_total")
+        for _ in range(3):
+            pw.fitDataSet(DataSet(x[:16], y[:16]))
+        assert _counter("dl4j_tpu_mesh_jit_cache_misses_total") == m1
+        es.close()
+
+
+_POD_PREAMBLE = """
+import os, sys, json, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, {root!r})
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (ElasticSupervisor, PodCoordinator,
+                                      PodEvictedError,
+                                      StaleGenerationError)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+def mlp():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer.builder().nIn(8).nOut(16)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(4)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(8)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+rng = np.random.RandomState(0)
+x = rng.randn(64, 8).astype(np.float32)
+w = np.random.RandomState(1).randn(8, 4)
+y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+def batches():
+    return ListDataSetIterator(
+        [DataSet(x[i*16:(i+1)*16], y[i*16:(i+1)*16]) for i in range(4)],
+        batch=16)
+
+run = {run_dir!r}
+"""
+
+_H0_SCRIPT = _POD_PREAMBLE + """
+coord = PodCoordinator(run, "h0", devices=[0, 1], leaseTimeout=1.0,
+                       heartbeatInterval=0.2, barrierTimeout=60.0)
+coord.start()
+coord.establish(["h0", "h1"], timeout=120)
+print("ESTABLISHED", coord.generation, flush=True)
+deadline = time.time() + 120
+while "h1" in coord.liveHosts():
+    if time.time() > deadline:
+        print("TIMEOUT waiting for h1 partition", flush=True)
+        sys.exit(2)
+    time.sleep(0.05)
+net = mlp()
+pw = ParallelWrapper(net, mesh=DeviceMesh(data=4,
+                                          devices=jax.devices()[:4]))
+es = ElasticSupervisor(pw, os.path.join(run, "ck_h0"),
+                       checkpointEveryN=2, keepLast=10, coordinator=coord)
+es.fit(batches(), epochs=2)
+print("RESULT " + json.dumps({{
+    "generation": coord.generation,
+    "mesh": sorted(pw.mesh.deviceIds()),
+    "remeshes": [r["direction"] for r in es.stats["remeshes"]],
+    "iterations": int(net.iterationCount),
+    "loss": float(es.lastLoss),
+    "params": [round(float(v), 8)
+               for v in np.asarray(net.params().numpy()).ravel()],
+}}), flush=True)
+coord.stop()
+"""
+
+_H1_SCRIPT = _POD_PREAMBLE + """
+from deeplearning4j_tpu.fault import (FaultInjector, PartitionedHost,
+                                      set_injector)
+from deeplearning4j_tpu.telemetry import get_registry
+coord = PodCoordinator(run, "h1", devices=[2, 3], leaseTimeout=1.0,
+                       heartbeatInterval=0.2)
+coord.start()
+coord.establish(["h0", "h1"], timeout=120)
+print("ESTABLISHED", coord.generation, flush=True)
+# heartbeats go silent right before step 1 — the process keeps stepping
+# on the old topology (the split-brain the fence must contain)
+set_injector(FaultInjector(PartitionedHost("h1", step=1)))
+net = mlp()
+pw = ParallelWrapper(net, mesh=DeviceMesh(data=4,
+                                          devices=jax.devices()[:4]))
+es = ElasticSupervisor(pw, os.path.join(run, "ck_h1"),
+                       checkpointEveryN=2, keepLast=10, coordinator=coord)
+fenced = False
+try:
+    es.fit(batches(), epochs=2)
+except StaleGenerationError:
+    fenced = True
+except PodEvictedError:
+    pass
+if not fenced:
+    deadline = time.time() + 120
+    while True:
+        plan = coord.currentPlan()
+        if plan and int(plan.get("generation", 0)) >= 2:
+            break
+        if time.time() > deadline:
+            print("TIMEOUT waiting for generation 2", flush=True)
+            sys.exit(2)
+        time.sleep(0.05)
+    try:
+        es.ckpt.saveWithManifest(net, step=999)
+    except StaleGenerationError:
+        fenced = True
+rej = get_registry().get("dl4j_tpu_coord_fenced_writes_rejected_total")
+print("STALE " + json.dumps({{
+    "fenced": fenced,
+    "rejected": float(rej.value()) if rej is not None else 0.0,
+    "iterations": int(net.iterationCount),
+}}), flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessCoordinatedShrink:
+    def test_kill_one_host_survivor_agrees_topology(self, tmp_path):
+        """THE acceptance run (federation-test 2-process pattern): two
+        real worker processes establish a pod; one host's heartbeat is
+        killed while its process keeps stepping.  The survivor agrees
+        the shrunken topology (generation bumps), finishes with the
+        same trajectory as the equivalent single-process shrink, and
+        the stale host's checkpoint writes are fenced."""
+        run_dir = str(tmp_path / "pod")
+        os.makedirs(run_dir, exist_ok=True)
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env.pop("DL4J_TPU_TELEMETRY_DIR", None)
+        procs = [subprocess.Popen(
+            [sys.executable, "-c",
+             textwrap.dedent(script).format(root=str(_ROOT),
+                                            run_dir=run_dir)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for script in (_H0_SCRIPT, _H1_SCRIPT)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+        h0_out, h1_out = outs
+
+        result = json.loads(
+            [ln for ln in h0_out.splitlines()
+             if ln.startswith("RESULT ")][0][len("RESULT "):])
+        assert result["generation"] == 2
+        assert result["mesh"] == [0, 1]
+        assert result["remeshes"] == ["shrink"]
+        assert result["iterations"] == 8
+
+        stale = json.loads(
+            [ln for ln in h1_out.splitlines()
+             if ln.startswith("STALE ")][0][len("STALE "):])
+        assert stale["fenced"] is True
+        assert stale["rejected"] >= 1.0
+        assert stale["iterations"] >= 1     # it DID keep stepping
+
+        # trajectory parity with the equivalent single-process shrink
+        x, y = _toy()
+        ref = _mlp()
+        ref.init()
+        pr = ParallelWrapper(ref, mesh=DeviceMesh(
+            data=4, devices=jax.devices()[:4]))
+        tr_ref = ElasticSupervisor(pr, str(tmp_path / "ref"),
+                                   checkpointEveryN=2, keepLast=10)
+        with inject(DeviceLossAtStep(0, devices=(2, 3))):
+            tr_ref.fit(_batches(x, y), epochs=2)
+        assert result["loss"] == pytest.approx(tr_ref.lastLoss, abs=1e-5)
+        np.testing.assert_allclose(
+            np.array(result["params"], dtype=np.float64),
+            np.asarray(ref.params().numpy()).ravel().astype(np.float64),
+            rtol=2e-4, atol=2e-5)

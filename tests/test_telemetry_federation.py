@@ -189,6 +189,33 @@ class TestFederation:
         assert "dl4j_tpu_train_steps_total 3.0" in text
         assert "dl4j_tpu_federation_hosts 2.0" in text
 
+    def test_torn_snapshot_is_skipped_and_counted(self, tmp_path):
+        """A torn/partial worker snapshot (concurrent writer mid-rename,
+        non-atomic writer killed mid-write) must be skipped AND counted
+        — never raised out of /metrics/federated."""
+        r = MetricsRegistry()
+        r.counter("dl4j_tpu_train_steps_total", "steps").inc(5)
+        SnapshotWriter(str(tmp_path), hostId="good", registry=r).write_now()
+        # a torn file: truncated JSON under the snapshot prefix
+        (tmp_path / "metrics_torn.json").write_text(
+            '{"host": "torn", "metrics": {"dl4j_tpu_train_steps')
+        # and a parseable file whose payload shape is wrong
+        (tmp_path / "metrics_shape.json").write_text(
+            '{"host": "shape", "metrics": [1, 2, 3]}')
+        agg = TelemetryAggregator(str(tmp_path), localRegistry=None)
+        text = agg.exposition()
+        assert "dl4j_tpu_train_steps_total 5.0" in text
+        assert agg.hosts == ["good"]
+        assert sorted(agg.skippedFiles) == ["metrics_shape.json",
+                                            "metrics_torn.json"]
+        c = get_registry().get(
+            "dl4j_tpu_federation_snapshots_skipped_total")
+        assert c is not None and c.value() == 2.0
+        # a second scrape with the files still torn keeps counting (the
+        # operator sees an ongoing problem, not a one-off blip)
+        agg.exposition()
+        assert c.value() == 4.0
+
     def test_snapshot_writer_thread_updates_file(self, tmp_path):
         reg = get_registry()
         c = reg.counter("dl4j_tpu_test_ticks_total", "ticks")

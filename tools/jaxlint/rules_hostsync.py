@@ -44,6 +44,7 @@ HOT_PATH_SUFFIXES = (
     "datavec/pipeline.py",
     "datavec/iterators.py",
     "fault/elastic.py",
+    "fault/coordination.py",
 )
 
 _SYNC_ATTRS = {"item", "block_until_ready"}
