@@ -636,7 +636,162 @@ def bench_serving(clients: int = 8, duration: float = 4.0,
     }
 
 
+def bench_coldstart(nIn: int = 32, hidden: int = 64, classes: int = 10,
+                    batch: int = 16, steps: int = 4) -> dict:
+    """Cold-start benchmark (ROADMAP item 2 / ISSUE 13 acceptance):
+    restart-to-first-step and server-start-to-ready latency, cold AOT
+    cache vs warm.
+
+    Two boots of the SAME topology against one cache directory:
+
+    - **boot 1 (cold)**: empty cache — the supervised fit's first step
+      pays trace+compile (and bakes the executable), the serving
+      executor's ``start()`` compiles the whole bucket ladder;
+    - **boot 2 (warm)**: fresh model/supervisor/executor OBJECTS (their
+      in-memory jit caches are empty, exactly like a new process), same
+      cache dir — the resume path and the ladder warm-up LOAD serialized
+      executables instead, and ``dl4j_tpu_train_compile_seconds_total``
+      must stay flat (asserted by tests/test_aotcache.py; reported
+      here).
+
+    The headline value is the warm restart-to-first-step, with cold
+    numbers and speedups alongside — same one-line JSON shape as the
+    other modes.
+    """
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.compile.aotcache import set_aot_cache
+    from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.fault import FaultTolerantTrainer
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (InputType,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.remote import (BucketLadder, BucketedExecutor,
+                                           ForwardServing)
+    from deeplearning4j_tpu.telemetry import get_registry
+
+    work = tempfile.mkdtemp(prefix="dl4j-coldstart-")
+    set_aot_cache(os.path.join(work, "aot"))
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer.builder().nIn(nIn).nOut(hidden)
+                       .activation("relu").build())
+                .layer(OutputLayer.builder("mcxent").nOut(classes)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(nIn)).build())
+        return MultiLayerNetwork(conf)
+
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(batch, nIn).astype(np.float32),
+                       np.eye(classes, dtype=np.float32)[
+                           rng.randint(0, classes, batch)])
+               for _ in range(steps)]
+
+    class FirstStep:
+        """Listener capturing the wall time to the first completed
+        supervised step of a fit (restart-to-first-step)."""
+
+        def __init__(self):
+            self.t0 = time.perf_counter()
+            self.latency = None
+
+        def iterationDone(self, model, iteration, epoch):
+            if self.latency is None:
+                self.latency = time.perf_counter() - self.t0
+
+        def onEpochStart(self, model):
+            pass
+
+        def onEpochEnd(self, model):
+            pass
+
+    def supervised_boot(resume: bool, epochs: int):
+        # epochs grows by one per boot: the resumed run must have real
+        # steps LEFT to take, or there is no "first step" to time
+        net = build_net()
+        trainer = FaultTolerantTrainer(
+            net, os.path.join(work, "ckpt"), checkpointEveryN=2,
+            resume=resume)
+        probe = FirstStep()
+        net.setListeners(probe)
+        trainer.fit(ListDataSetIterator(batches, batch), epochs=epochs)
+        trainer.close()
+        return probe.latency
+
+    reg = get_registry()
+
+    def compile_s():
+        c = reg.get("dl4j_tpu_train_compile_seconds_total")
+        return c.value() if c is not None else 0.0
+
+    # -- restart-to-first-step ------------------------------------------
+    restart_cold = supervised_boot(resume=False, epochs=1)  # compile+bake
+    cs0 = compile_s()
+    restart_warm = supervised_boot(resume=True, epochs=2)   # cache load
+    warm_compile_delta = compile_s() - cs0
+
+    # -- server-start-to-ready ------------------------------------------
+    ladder = BucketLadder(batchSizes=(1, 2, 4, 8, 16), seqLens=())
+
+    def server_boot(name):
+        ex = BucketedExecutor(
+            ForwardServing(build_net().init(), ladder,
+                           inputShape=(nIn,)), name=name)
+        t0 = time.perf_counter()
+        ex.start()
+        ready = time.perf_counter() - t0
+        ex.submit(np.zeros((2, nIn), np.float32).tolist())
+        ex.shutdown()
+        return ready
+
+    server_cold = server_boot("cold")
+    server_warm = server_boot("warm")
+
+    def val(name, **labels):
+        c = reg.get(name)
+        try:
+            return c.value(**labels) if c is not None else 0.0
+        except ValueError:
+            return 0.0
+
+    out = {
+        "metric": "coldstart_restart_to_first_step_seconds",
+        "value": round(restart_warm, 4),
+        "unit": "seconds",
+        "restart_first_step_cold_s": round(restart_cold, 4),
+        "restart_first_step_warm_s": round(restart_warm, 4),
+        "restart_speedup": round(restart_cold / max(restart_warm, 1e-9),
+                                 2),
+        "server_ready_cold_s": round(server_cold, 4),
+        "server_ready_warm_s": round(server_warm, 4),
+        "server_ready_speedup": round(server_cold / max(server_warm,
+                                                        1e-9), 2),
+        # the acceptance bar: a warm boot re-compiles NOTHING
+        "warm_compile_seconds_delta": round(warm_compile_delta, 4),
+        "warm_server_warmup_compiles": int(val(
+            "dl4j_tpu_serving_warmup_compiles_total", model="warm")),
+        "aot_cache_hits": int(sum(
+            v for _k, v in (reg.get("dl4j_tpu_aot_cache_hits_total")
+                            .data().get("cells", []))))
+        if reg.get("dl4j_tpu_aot_cache_hits_total") else 0,
+        "batch": batch,
+        "steps": steps,
+    }
+    set_aot_cache(None)
+    shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def main() -> None:
+    if "--coldstart" in sys.argv:
+        print(json.dumps(bench_coldstart()))
+        return
+
     if "--mesh" in sys.argv:
         _reexec_cpu_mesh(8)
         args = [a for a in sys.argv[1:] if not a.startswith("--")]

@@ -338,6 +338,14 @@ class FaultTolerantTrainer:
             step = self.ckpt.latestValidStep()
             if step is not None:
                 self._timedRestore(step)
+                # resume preload: with the AOT cache configured, pull
+                # the fused step's warm executables off disk NOW —
+                # restart-to-first-step then pays a load, not a
+                # trace+compile (mesh facades preload at their own
+                # install; no-op with the cache off)
+                from deeplearning4j_tpu.compile.aotcache import \
+                    preload_model
+                preload_model(self.wrapper or net)
                 meta = self.ckpt.readMetadata(step)
                 skip = int(meta.get("stepInEpoch", 0))
                 if hasattr(net, "setLrScale"):

@@ -281,7 +281,11 @@ class ComputationGraph:
 
     @functools.cached_property
     def _trainStep(self):
-        return jax.jit(self._stepFn, donate_argnums=(0, 1, 2))
+        # persistent AOT cache dispatch when configured (see
+        # MultiLayerNetwork._trainStep); plain jit otherwise
+        from deeplearning4j_tpu.compile.aotcache import wrap_jit
+        return wrap_jit(jax.jit(self._stepFn, donate_argnums=(0, 1, 2)),
+                        kind="train_step", model=self)
 
     @functools.cached_property
     def _outputFn(self):

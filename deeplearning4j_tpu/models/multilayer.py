@@ -449,7 +449,12 @@ class MultiLayerNetwork:
 
     @functools.cached_property
     def _trainStep(self):
-        return jax.jit(self._stepFn, donate_argnums=(0, 1, 2))
+        # with the persistent AOT cache configured, the fused step
+        # dispatches through it (warm boots load the serialized
+        # executable instead of re-tracing); plain jit otherwise
+        from deeplearning4j_tpu.compile.aotcache import wrap_jit
+        return wrap_jit(jax.jit(self._stepFn, donate_argnums=(0, 1, 2)),
+                        kind="train_step", model=self)
 
     @functools.cached_property
     def _outputFn(self):

@@ -402,6 +402,14 @@ class MeshTrainer:
         jitted = jax.jit(net._stepFn, donate_argnums=(0, 1, 2),
                          in_shardings=tuple(in_sh),
                          out_shardings=(psh, osh, None, None, None))
+        # AOT cache (when configured): the sharded step dispatches
+        # through the persistent executable cache, keyed on THIS plan's
+        # digest + device set — so a boot (or post-remesh re-install)
+        # preloads warm executables, and a stale pre-remesh executable
+        # can never key-match the new plan.  Plain jit when off.
+        from deeplearning4j_tpu.compile.aotcache import wrap_jit
+        jitted = wrap_jit(jitted, kind="mesh_step", model=net,
+                          plan=self.plan)
         for k in ("_trainStep", "_outputFn", "_scoreFn"):
             net.__dict__.pop(k, None)
         net.__dict__["_trainStep"] = jitted

@@ -501,6 +501,37 @@ class BucketedExecutor:
         self._cacheSeen: Optional[int] = None
 
     # -- lifecycle -------------------------------------------------------
+    def warm(self) -> float:
+        """Make every ladder bucket dispatchable BEFORE traffic arrives:
+        wrap the model's inference executables in the persistent AOT
+        cache (when configured — warm boots then LOAD serialized
+        executables in ms instead of compiling), then drive the
+        adapter's warm keys.  Returns the warm-up wall seconds, which
+        also land in ``dl4j_tpu_serving_warmup_seconds`` — the
+        server-start-to-ready cost an operator watches."""
+        if self._warmed:
+            return 0.0
+        sm = serving_metrics()
+        t0 = time.perf_counter()
+        from deeplearning4j_tpu.compile.aotcache import wrap_serving_model
+        wrap_serving_model(getattr(self.serving, "model", None) or
+                           getattr(self.serving, "lm", None))
+        before = self.serving.compileCacheSize()
+        _model_name.name = self.name
+        try:
+            for key in self.serving.warmKeys():
+                self.serving.warm(key)
+        finally:
+            _model_name.name = None
+        after = self.serving.compileCacheSize()
+        if before is not None and after is not None:
+            sm.warmup_compiles().inc(max(0, after - before),
+                                     model=self.name)
+        self._warmed = True
+        dt = time.perf_counter() - t0
+        sm.warmup_seconds().observe(dt, model=self.name)
+        return dt
+
     def start(self) -> "BucketedExecutor":
         if self._running:
             return self
@@ -511,19 +542,7 @@ class BucketedExecutor:
         # probe) must see an explicit 0, not an absent series
         sm.compile_hits().inc(0, model=self.name)
         sm.compile_misses().inc(0, model=self.name)
-        if not self._warmed:
-            before = self.serving.compileCacheSize()
-            _model_name.name = self.name
-            try:
-                for key in self.serving.warmKeys():
-                    self.serving.warm(key)
-            finally:
-                _model_name.name = None
-            after = self.serving.compileCacheSize()
-            if before is not None and after is not None:
-                sm.warmup_compiles().inc(max(0, after - before),
-                                         model=self.name)
-            self._warmed = True
+        self.warm()
         self._cacheSeen = self.serving.compileCacheSize()
         self._running = True
         self._threads = []

@@ -35,7 +35,7 @@ __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "EtlMetrics", "ServingMetrics", "serving_metrics",
            "MeshMetrics", "mesh_metrics", "ElasticMetrics",
            "elastic_metrics", "CoordMetrics", "coord_metrics",
-           "replica_step_gauge"]
+           "AotCacheMetrics", "aot_metrics", "replica_step_gauge"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -272,6 +272,13 @@ SERVING_LATENCY_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0)
 
+#: a ladder warm-up spans "every bucket loads from the AOT cache" (ms)
+#: to "a deep generative ladder compiles from scratch" (minutes) —
+#: DEFAULT_BUCKETS can't resolve both ends
+SERVING_WARMUP_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0)
+
 
 class ServingMetrics:
     """The ``dl4j_tpu_serving_*`` namespace, registered from ONE site.
@@ -357,6 +364,14 @@ class ServingMetrics:
             "dl4j_tpu_serving_decode_tokens_total",
             "Tokens generated through the KV-cache decode path",
             labelnames=("model",))
+
+    def warmup_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_warmup_seconds",
+            "Wall time of one BucketedExecutor ladder warm-up (compile "
+            "on a cold AOT cache, executable loads on a warm one) — the "
+            "server-start-to-ready cost, per model",
+            labelnames=("model",), buckets=SERVING_WARMUP_BUCKETS)
 
 
 _SERVING_METRICS = ServingMetrics()
@@ -536,6 +551,82 @@ def coord_metrics() -> CoordMetrics:
     """Accessor for the shared coordination metric namespace (see
     :class:`CoordMetrics`)."""
     return _COORD_METRICS
+
+
+#: an executable load is a disk read + runtime deserialize: sub-ms to a
+#: few hundred ms for a big multi-device program — DEFAULT_BUCKETS has
+#: no resolution below 5 ms where most loads land
+AOT_LOAD_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0)
+
+#: a bake is a full XLA compile: tens of ms for a toy step to minutes
+#: for a big sharded program
+AOT_BAKE_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0)
+
+
+class AotCacheMetrics:
+    """The ``dl4j_tpu_aot_cache_*`` namespace, registered from ONE site.
+
+    ``compile.aotcache`` reports here: executable-cache hits/misses by
+    executable kind (mesh_step / train_step / output / prefill /
+    decode), load and bake latency, LRU evictions and quarantined
+    (corrupt) entries.  The warm-boot acceptance bar reads as: hits > 0
+    while ``dl4j_tpu_train_compile_seconds_total`` and the serving
+    compile-miss counters stay ~0.  Accessors re-resolve through
+    :func:`get_registry` on every call (tests swap the registry).
+    """
+
+    def hits(self):
+        return get_registry().counter(
+            "dl4j_tpu_aot_cache_hits_total",
+            "Serialized executables loaded from the persistent AOT "
+            "cache instead of compiled, by executable kind",
+            labelnames=("kind",))
+
+    def misses(self):
+        return get_registry().counter(
+            "dl4j_tpu_aot_cache_misses_total",
+            "AOT cache lookups that found no loadable entry (fresh "
+            "XLA compile follows), by executable kind",
+            labelnames=("kind",))
+
+    def load_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_aot_cache_load_seconds",
+            "Wall time to read + deserialize one cached executable",
+            buckets=AOT_LOAD_BUCKETS)
+
+    def bake_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_aot_cache_bake_seconds",
+            "Wall time of the fresh XLA compile behind one cache miss "
+            "(the cost the next boot skips)",
+            buckets=AOT_BAKE_BUCKETS)
+
+    def evictions(self):
+        return get_registry().counter(
+            "dl4j_tpu_aot_cache_evictions_total",
+            "Cache entries removed by LRU eviction to hold the "
+            "configured size bound")
+
+    def quarantined(self):
+        return get_registry().counter(
+            "dl4j_tpu_aot_cache_quarantined_total",
+            "Corrupt or stale cache entries moved to quarantine "
+            "(checksum/unpickle/deserialize failure; the caller "
+            "compiled fresh)")
+
+
+_AOT_METRICS = AotCacheMetrics()
+
+
+def aot_metrics() -> AotCacheMetrics:
+    """Accessor for the shared AOT-cache metric namespace (see
+    :class:`AotCacheMetrics`)."""
+    return _AOT_METRICS
 
 
 def note_etl_wait(seconds: float, owner) -> None:

@@ -237,7 +237,43 @@ class ShardedCheckpointer:
             net._fitKey = restored["fitKey"]
         if "rnnCarries" in restored:
             net._rnnCarries = restored["rnnCarries"]
+        self._refreshForAot(net)
         return net
+
+    @staticmethod
+    def _refreshForAot(net) -> None:
+        """Copy restored leaves into fresh XLA-owned buffers when the
+        AOT executable cache is active.
+
+        Orbax-restored arrays can alias EXTERNAL (tensorstore/numpy)
+        memory on the CPU backend.  The plain ``jax.jit`` dispatch
+        detects that such buffers are not donatable and copies them;
+        the raw AOT ``Compiled.__call__`` path the cache dispatches
+        through performs no such fallback — donating an aliased buffer
+        corrupts the heap (reproduced as intermittent segfaults/aborts
+        on warm mesh resume).  One device-side copy per restore, only
+        with the cache on; restores are boot/rollback-cadence, never
+        the step path."""
+        from deeplearning4j_tpu.compile.aotcache import aot_cache
+        if aot_cache() is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        def refresh(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
+                tree)
+
+        net.params_ = refresh(net.params_)
+        if net.optState_ is not None:
+            net.optState_ = refresh(net.optState_)
+        if net.state_:
+            net.state_ = refresh(net.state_)
+        if getattr(net, "_fitKey", None) is not None:
+            net._fitKey = refresh(net._fitKey)
+        if getattr(net, "_rnnCarries", None):
+            net._rnnCarries = refresh(net._rnnCarries)
 
     def close(self):
         self._joinSealers()

@@ -71,6 +71,11 @@ def pytest_configure(config):
         "leases, mesh-generation consensus and barrier, checkpoint "
         "generation fencing, re-admission policy, device-health "
         "probe, alert-driven remediation)")
+    config.addinivalue_line(
+        "markers", "aot: AOT compile + persistent executable cache "
+        "tests (content-addressed store, warm-boot preload, "
+        "corrupt-entry quarantine, re-mesh re-keying, cross-process "
+        "reuse)")
 
 
 def pytest_collection_modifyitems(config, items):

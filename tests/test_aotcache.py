@@ -1,0 +1,355 @@
+"""AOT compile + persistent executable cache tests (ISSUE 13).
+
+- warm boot: a second boot of the same topology LOADS serialized
+  executables — ``dl4j_tpu_train_compile_seconds_total`` stays flat and
+  the loss trajectory is bit-identical to the compiled run;
+- key correctness: the ShardingPlan digest + device set is in every
+  key, so a re-meshed trainer can NEVER load the pre-remesh executable
+  (the persistent-cache analogue of the jaxpr fun-identity hazard);
+- robustness: corrupt entries are quarantined and fall back to a fresh
+  compile; a version skew is a miss; LRU holds the size bound;
+- boot paths: serving ladder warm-up loads instead of compiling
+  (``dl4j_tpu_serving_warmup_seconds`` observed, warmup compiles 0),
+  the fault supervisor's kill/resume path re-compiles nothing, and a
+  subprocess ``tools/aotc`` bake is loadable by the parent (slow).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.compile.aotcache import (AotCache, AotDispatch,
+                                                 aot_cache, set_aot_cache,
+                                                 wrap_jit)
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.fault import (FaultTolerantTrainer, PreemptAtStep,
+                                      SimulatedPreemption, inject)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+from deeplearning4j_tpu.telemetry import (MetricsRegistry, get_registry,
+                                          set_registry)
+
+pytestmark = pytest.mark.aot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def registry():
+    prev = set_registry(MetricsRegistry())
+    yield get_registry()
+    set_registry(prev)
+
+
+@pytest.fixture
+def aot_dir(tmp_path, registry):
+    d = str(tmp_path / "aot")
+    set_aot_cache(d)
+    yield d
+    set_aot_cache(None)
+
+
+def _mlp(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer.builder().nIn(8).nOut(16)
+                   .activation("relu").build())
+            .layer(OutputLayer.builder("mcxent").nOut(4)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(8)).build())
+    return MultiLayerNetwork(conf)
+
+
+def _batches(n=2, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [DataSet(rng.randn(batch, 8).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[
+                        rng.randint(0, 4, batch)])
+            for _ in range(n)]
+
+
+def _val(name, **labels):
+    c = get_registry().get(name)
+    if c is None:
+        return 0.0
+    try:
+        return c.value(**labels)
+    except ValueError:
+        return 0.0
+
+
+class TestWarmBoot:
+    def test_second_boot_compile_seconds_zero(self, aot_dir):
+        """The acceptance bar: boot 2 of the same topology loads the
+        fused-step executable — zero compile seconds, identical
+        trajectory."""
+        batches = _batches()
+        net = _mlp().init()
+        traj1 = []
+        for ds in batches:
+            net.fit(ds)
+            traj1.append(float(net.score()))
+        disp = net.__dict__["_trainStep"]
+        assert isinstance(disp, AotDispatch)
+        assert disp._cache_size() == 1          # one fresh compile
+        assert _val("dl4j_tpu_aot_cache_misses_total",
+                    kind="train_step") == 1
+
+        cs0 = _val("dl4j_tpu_train_compile_seconds_total")
+        misses0 = _val("dl4j_tpu_train_jit_cache_misses_total")
+        net2 = _mlp().init()                    # fresh objects = boot 2
+        traj2 = []
+        for ds in batches:
+            net2.fit(ds)
+            traj2.append(float(net2.score()))
+        assert net2.__dict__["_trainStep"]._cache_size() == 0
+        assert _val("dl4j_tpu_train_compile_seconds_total") == cs0
+        assert _val("dl4j_tpu_train_jit_cache_misses_total") == misses0
+        assert _val("dl4j_tpu_aot_cache_hits_total",
+                    kind="train_step") >= 1
+        assert traj2 == pytest.approx(traj1, abs=0)
+
+    def test_disabled_is_plain_jit(self, registry):
+        set_aot_cache(None)
+        net = _mlp().init()
+        net.fit(_batches(1)[0])
+        assert not isinstance(net.__dict__["_trainStep"], AotDispatch)
+
+
+class TestKeying:
+    def test_version_mismatch_invalidates(self, aot_dir, monkeypatch):
+        """An entry baked under one jax/XLA fingerprint must be a MISS
+        under any other — a deserialized executable is only valid for
+        the exact runtime that produced it."""
+        f = jax.jit(lambda x: x * 2)
+        d1 = wrap_jit(f, kind="train_step")
+        d1(jnp.ones(4))
+        assert d1._cache_size() == 1
+        from deeplearning4j_tpu.compile import aotcache as mod
+        monkeypatch.setattr(mod, "version_fingerprint",
+                            lambda: {"jax": "0.0.0-other"})
+        d2 = wrap_jit(jax.jit(lambda x: x * 2), kind="train_step")
+        assert d2.group != d1.group
+        assert d2.preload() == 0                # nothing keyed for it
+
+    def test_corrupt_entry_quarantined(self, aot_dir):
+        f = jax.jit(lambda x: x + 1)
+        d1 = wrap_jit(f, kind="train_step")
+        out1 = np.asarray(d1(jnp.ones(4)))
+        cache = aot_cache()
+        (entry,) = [e for e in cache.entries()]
+        path = cache.entryPath(entry[0])
+        blob = open(path, "rb").read()
+        with open(path, "wb") as fh:            # flip bytes mid-payload
+            fh.write(blob[:100] + b"garbage" + blob[107:])
+
+        d2 = wrap_jit(jax.jit(lambda x: x + 1), kind="train_step")
+        assert d2.preload() == 0                # quarantined, not loaded
+        assert _val("dl4j_tpu_aot_cache_quarantined_total") == 1
+        qdir = os.path.join(cache.directory, "quarantine")
+        assert len(os.listdir(qdir)) == 1
+        out2 = np.asarray(d2(jnp.ones(4)))      # fell back to compile
+        assert d2._cache_size() == 1
+        np.testing.assert_array_equal(out1, out2)
+
+    def test_lru_eviction_bounds_size(self, aot_dir):
+        cache = aot_cache()
+        d = wrap_jit(jax.jit(lambda x: x * 3), kind="train_step")
+        for n in (4, 8, 16):
+            d(jnp.ones(n))
+        assert len(cache.entries()) == 3
+        cache.maxBytes = max(size for _d, size, _m in cache.entries()) * 2
+        cache._evict()
+        assert cache.totalBytes() <= cache.maxBytes
+        assert len(cache.entries()) < 3
+        assert _val("dl4j_tpu_aot_cache_evictions_total") >= 1
+        # evicted digests also left the ladder: a fresh boot preloads
+        # exactly the surviving entries, with zero phantom misses
+        miss0 = _val("dl4j_tpu_aot_cache_misses_total", kind="train_step")
+        d2 = wrap_jit(jax.jit(lambda x: x * 3), kind="train_step")
+        assert d2.loadedCount() == len(cache.entries())
+        assert _val("dl4j_tpu_aot_cache_misses_total",
+                    kind="train_step") == miss0
+
+
+class TestRemeshRekey:
+    def test_remesh_never_loads_pre_remesh_executable(self, aot_dir):
+        """Regression for the fun-identity class of hazard, persisted:
+        after an elastic re-mesh the NEW plan's digest keys the cache,
+        so the stale old-mesh executable (still on disk) can never
+        load — the post-remesh step is a fresh compile."""
+        dev = jax.devices()
+        batches = _batches()
+        net = _mlp().init()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=2,
+                                                  devices=dev[:2]))
+        pw.fitDataSet(batches[0])
+        old = net.__dict__["_trainStep"]
+        assert isinstance(old, AotDispatch) and old._cache_size() == 1
+        hits0 = _val("dl4j_tpu_aot_cache_hits_total", kind="mesh_step")
+
+        pw.remesh(DeviceMesh(data=1, devices=dev[:1]))
+        pw.fitDataSet(batches[1])
+        new = net.__dict__["_trainStep"]
+        assert new is not old
+        assert new.group != old.group           # re-keyed
+        assert new._cache_size() == 1           # compiled fresh
+        # the old entry is still on disk — and was NOT loaded
+        assert _val("dl4j_tpu_aot_cache_hits_total",
+                    kind="mesh_step") == hits0
+        assert np.isfinite(float(net.score()))
+
+        # a boot back onto the ORIGINAL mesh shape re-loads warmly
+        net2 = _mlp().init()
+        pw2 = ParallelWrapper(net2, mesh=DeviceMesh(data=2,
+                                                    devices=dev[:2]))
+        pw2.fitDataSet(batches[0])
+        assert net2.__dict__["_trainStep"]._cache_size() == 0
+        assert _val("dl4j_tpu_aot_cache_hits_total",
+                    kind="mesh_step") > hits0
+
+
+class TestServingWarmBoot:
+    def test_ladder_loads_instead_of_compiling(self, aot_dir):
+        from deeplearning4j_tpu.remote import (BucketLadder,
+                                               BucketedExecutor,
+                                               ForwardServing)
+        ladder = BucketLadder(batchSizes=(1, 2), seqLens=())
+
+        def executor(name):
+            conf = (NeuralNetConfiguration.builder().seed(1)
+                    .updater(Adam(1e-2)).list()
+                    .layer(DenseLayer.builder().nIn(8).nOut(16)
+                           .activation("relu").build())
+                    .layer(OutputLayer.builder("mcxent").nIn(16).nOut(4)
+                           .activation("softmax").build()).build())
+            return BucketedExecutor(
+                ForwardServing(MultiLayerNetwork(conf).init(), ladder,
+                               inputShape=(8,)), name=name)
+
+        ex = executor("cold").start()
+        out1 = ex.submit(np.ones((2, 8), np.float32).tolist())
+        ex.shutdown()
+        assert _val("dl4j_tpu_serving_warmup_compiles_total",
+                    model="cold") == 2
+
+        ex2 = executor("warm").start()
+        out2 = ex2.submit(np.ones((2, 8), np.float32).tolist())
+        ex2.shutdown()
+        # boot 2: every bucket came off disk, nothing compiled
+        assert _val("dl4j_tpu_serving_warmup_compiles_total",
+                    model="warm") == 0
+        assert _val("dl4j_tpu_aot_cache_hits_total", kind="output") >= 2
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out1))
+        hist = get_registry().get("dl4j_tpu_serving_warmup_seconds")
+        assert hist is not None
+        assert hist.count(model="cold") == 1
+        assert hist.count(model="warm") == 1
+        # the whole point: warm start-to-ready is much cheaper
+        assert hist.sum(model="warm") < hist.sum(model="cold")
+
+
+class TestFaultResume:
+    def test_mesh_warm_resume_donation_safety(self, aot_dir, tmp_path):
+        """Regression: a warm MESH resume feeds orbax-restored arrays
+        into the DESERIALIZED executable with donation.  Restored
+        buffers can alias external (tensorstore) memory, which the raw
+        AOT call path would donate anyway — heap corruption (observed
+        as intermittent segfaults / NaN steps) until
+        ``ShardedCheckpointer._refreshForAot`` copies them into
+        XLA-owned buffers.  This test crashes or diverges if that
+        refresh regresses."""
+        dev = jax.devices()
+        batches = _batches(4, batch=8)
+
+        def boot():
+            net = _mlp()
+            net.init()
+            pw = ParallelWrapper(net, mesh=DeviceMesh(data=2,
+                                                      devices=dev[:2]))
+            return net, FaultTolerantTrainer(
+                pw, str(tmp_path / "mesh-run"), checkpointEveryN=2)
+
+        net, tr = boot()
+        tr.fit(ListDataSetIterator(batches, 8), epochs=1)
+        tr.close()
+        loss1 = float(net.score())
+
+        cs0 = _val("dl4j_tpu_train_compile_seconds_total")
+        net2, tr2 = boot()
+        tr2.fit(ListDataSetIterator(batches, 8), epochs=2)
+        tr2.close()
+        assert np.isfinite(float(net2.score()))
+        assert tr2.stats["rollbacks"] == 0      # no NaN from stale buffers
+        assert net2.__dict__["_trainStep"]._cache_size() == 0
+        assert _val("dl4j_tpu_train_compile_seconds_total") == cs0
+        assert np.isfinite(loss1)
+
+    def test_kill_resume_no_recompile(self, aot_dir, tmp_path):
+        """The fault-injection kill/resume loop on a warm cache: the
+        resumed process restores the checkpoint and LOADS the step
+        executable — no recompile on resume."""
+        batches = _batches(4, batch=8)
+
+        def boot():
+            net = _mlp()
+            net.init()
+            return net, FaultTolerantTrainer(
+                net, str(tmp_path / "run"), checkpointEveryN=2,
+                keepLast=10)
+
+        net, trainer = boot()
+        with inject(PreemptAtStep(3)):
+            with pytest.raises(SimulatedPreemption):
+                trainer.fit(ListDataSetIterator(batches, 8), epochs=1)
+        trainer.close()
+
+        cs0 = _val("dl4j_tpu_train_compile_seconds_total")
+        hits0 = _val("dl4j_tpu_aot_cache_hits_total", kind="train_step")
+        net2, trainer2 = boot()
+        trainer2.fit(ListDataSetIterator(batches, 8), epochs=1)
+        trainer2.close()
+        assert trainer2.stats["resumedFromStep"] is not None
+        assert net2.iterationCount == 4
+        assert net2.__dict__["_trainStep"]._cache_size() == 0
+        assert _val("dl4j_tpu_train_compile_seconds_total") == cs0
+        assert _val("dl4j_tpu_aot_cache_hits_total",
+                    kind="train_step") > hits0
+
+
+@pytest.mark.slow
+class TestCrossProcess:
+    def test_subprocess_bake_parent_load(self, aot_dir):
+        """Fleet rollout: ``tools/aotc`` bakes in ANOTHER process; this
+        process boots the same topology with compile seconds == 0."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   DL4J_TPU_AOT_CACHE_DIR=aot_dir)
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.aotc", "bake",
+             "--cache-dir", aot_dir, "--mlp", "8,16,4",
+             "--batches", "2", "--train"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240)
+        assert out.returncode == 0, out.stderr
+        baked = json.loads(out.stdout.strip().splitlines()[-1])
+        assert baked["entries_baked"] >= 2      # output ladder + step
+
+        from tools.aotc import _build_mlp
+        cs0 = _val("dl4j_tpu_train_compile_seconds_total")
+        net = _build_mlp([8, 16, 4])
+        net.fit(_batches(1, batch=2)[0])
+        net.score()
+        assert net.__dict__["_trainStep"]._cache_size() == 0
+        assert _val("dl4j_tpu_train_compile_seconds_total") == cs0
+        assert _val("dl4j_tpu_aot_cache_hits_total",
+                    kind="train_step") >= 1

@@ -45,6 +45,7 @@ HOT_PATH_SUFFIXES = (
     "datavec/iterators.py",
     "fault/elastic.py",
     "fault/coordination.py",
+    "compile/aotcache.py",
 )
 
 _SYNC_ATTRS = {"item", "block_until_ready"}
