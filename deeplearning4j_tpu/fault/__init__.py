@@ -8,13 +8,16 @@ corruption, device OOM, slow/failing data fetches).
 from deeplearning4j_tpu.fault.injection import (  # noqa: F401
     CorruptCheckpointAtStep, DelayedHeartbeat, DeviceLossAtStep,
     FailingFetch, Fault, FaultInjector, InjectedDeviceLoss, InjectedOOM,
-    NaNAtStep, OOMAtStep, PartitionedHost, PreemptAtStep,
-    RestoreCapacityAtStep, SimulatedPreemption, SlowFetch, StallAtStep,
-    StragglerReplica, clear_heartbeat_delays, clear_injector,
-    clear_lost_devices, clear_partitioned_hosts, corrupt_checkpoint,
-    get_injector, heal_host, heartbeat_delay, inject, lose_devices,
-    lost_device_ids, partition_host, partitioned_host_ids,
-    restore_devices, set_heartbeat_delay, set_injector)
+    KillAtBarrier, LeaderCrashMidBarrier, NaNAtStep, OOMAtStep,
+    PartitionedHost, PreemptAtStep, RestoreCapacityAtStep,
+    SimulatedPreemption, SlowFetch, StallAtStep, StragglerReplica,
+    arm_barrier_kill, arm_leader_crash, clear_barrier_kills,
+    clear_heartbeat_delays, clear_injector, clear_leader_crashes,
+    clear_lost_devices, clear_partitioned_hosts, consume_barrier_kill,
+    consume_leader_crash, corrupt_checkpoint, get_injector, heal_host,
+    heartbeat_delay, inject, lose_devices, lost_device_ids,
+    partition_host, partitioned_host_ids, restore_devices,
+    set_heartbeat_delay, set_injector)
 from deeplearning4j_tpu.fault.supervisor import (  # noqa: F401
     FaultTolerantTrainer, TrainingDivergedError, is_oom_error)
 from deeplearning4j_tpu.fault.elastic import (  # noqa: F401
@@ -23,3 +26,5 @@ from deeplearning4j_tpu.fault.elastic import (  # noqa: F401
 from deeplearning4j_tpu.fault.coordination import (  # noqa: F401
     CoordinationError, GenerationFence, HeartbeatLease, PodCoordinator,
     PodEvictedError, ReadmissionPolicy, StaleGenerationError)
+from deeplearning4j_tpu.fault.chaos import (  # noqa: F401
+    ChaosSoak, build_schedule)

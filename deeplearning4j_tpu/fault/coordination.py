@@ -137,11 +137,18 @@ def _plan_digest(plan: dict) -> str:
     """Content identity of a published plan — what the barrier acks.
     Two plans under the SAME generation number (racing leaders at the
     lease-timeout edge) must not satisfy each other's barrier."""
+    # the sticky eviction set is plan CONTENT too: two plans that
+    # differ only here must not satisfy each other's barrier, or a
+    # racing leader with the smaller set could silently re-admit
+    # quorum-evicted devices
+    # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
+    evicted = sorted(int(d) for d in plan.get("evictedDeviceIds", ()))
     core = {"generation": int(plan.get("generation", 0)),
             "participants": sorted(str(h)
                                    for h in plan.get("participants", ())),
             # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
-            "deviceIds": sorted(int(d) for d in plan.get("deviceIds", ()))}
+            "deviceIds": sorted(int(d) for d in plan.get("deviceIds", ())),
+            "evictedDeviceIds": evicted}
     return hashlib.sha1(
         json.dumps(core, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -170,6 +177,10 @@ class HeartbeatLease:
         self.interval = float(interval)
         self.generation = 0
         self.seq = 0
+        # consensus straggler eviction: this host's current straggler
+        # VOTES, {replica label: [device ids]} — published with every
+        # beat so the leader can tally a quorum across live leases
+        self.flags: Dict[str, list] = {}
         self._lastWrite: Optional[float] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -189,6 +200,21 @@ class HeartbeatLease:
             self.devices = sorted(int(d) for d in devices)
         self.write_now()
 
+    def setFlags(self, flags: Dict[str, Sequence[int]]) -> None:
+        """Publish this host's straggler votes ({replica label: device
+        ids} — empty dict withdraws them).  A vote is an observation,
+        not a verdict: eviction happens only when the LEADER tallies a
+        quorum of independent flags for the same replica.  Writes only
+        on change — votes usually hold steady across many beats."""
+        # jaxlint: sync-ok -- flag device ids are Python ints from the gauge mapping, not device scalars
+        clean = {str(k): sorted(int(d) for d in v)
+                 for k, v in (flags or {}).items()}
+        with self._lock:
+            if clean == self.flags:
+                return
+            self.flags = clean
+        self.write_now()
+
     def write_now(self, now: Optional[float] = None) -> str:
         """One atomic lease write; returns the path, or '' when the
         write was skipped (partitioned/delayed by injection) or failed
@@ -205,7 +231,8 @@ class HeartbeatLease:
             payload = {"host": self.hostId, "pid": os.getpid(),
                        "seq": self.seq, "ts": now,
                        "devices": list(self.devices),
-                       "generation": self.generation}
+                       "generation": self.generation,
+                       "flags": dict(self.flags)}
             # the file write stays under the lock: build + write must be
             # one unit, or a descheduled heartbeat tick could land its
             # STALE payload after a setDevices()/adopt write and
@@ -370,7 +397,8 @@ class PodCoordinator:
                  devices: Sequence[int] = (), *,
                  leaseTimeout: float = 3.0, heartbeatInterval: float = 1.0,
                  barrierTimeout: float = 60.0, barrierPoll: float = 0.05,
-                 readmission: Optional[ReadmissionPolicy] = None):
+                 readmission: Optional[ReadmissionPolicy] = None,
+                 evictionQuorum: Optional[int] = None):
         self.runDir = str(runDir)
         self.coordDir = os.path.join(self.runDir, _COORD_SUBDIR)
         self.hostId = str(hostId)
@@ -379,15 +407,22 @@ class PodCoordinator:
         self.barrierTimeout = float(barrierTimeout)
         self.barrierPoll = float(barrierPoll)
         self.readmission = readmission or ReadmissionPolicy()
+        # consensus straggler eviction: None = majority of the live
+        # candidates (strictly more than half) — one skewed host's vote
+        # can never evict a replica from a multi-host pod by itself
+        self.evictionQuorum = None if evictionQuorum is None \
+            else max(1, int(evictionQuorum))
         self.lease = HeartbeatLease(self.coordDir, self.hostId,
                                     devices=self.ownDevices,
                                     interval=heartbeatInterval)
         self.generation = 0
         self.participants: tuple = ()
         self.deviceIds: tuple = ()
+        self.evictedDeviceIds: tuple = ()
         self._adoptedDigest: Optional[str] = None
         self._deadSeen: set = set()
         self._pendingReadmits: List[str] = []
+        self._voteCounts: Dict[str, tuple] = {}
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "PodCoordinator":
@@ -408,6 +443,14 @@ class PodCoordinator:
         own = set(self.ownDevices)
         # jaxlint: sync-ok -- device ids are Python ints from the pod config/JSON, not device scalars
         self.lease.setDevices([d for d in devices if int(d) in own])
+
+    def setStragglerFlags(self, flags: Dict[str, Sequence[int]]) -> None:
+        """Publish this host's straggler VOTES into its lease (empty
+        dict withdraws them).  Under coordination, eviction is a pod
+        decision: a replica leaves the topology only when a quorum of
+        live hosts independently flag it (see :meth:`_computeProposal`),
+        never because one host's local view says so."""
+        self.lease.setFlags(flags)
 
     def leases(self) -> Dict[str, dict]:
         """Every parseable lease in the coordination dir, by host id."""
@@ -473,16 +516,23 @@ class PodCoordinator:
                     plan["generation"], plan["deviceIds"],
                     plan["participants"], plan.get("reason", ""))
 
-    def _adopt(self, plan: dict) -> None:
+    def _adopt(self, plan: dict, now: Optional[float] = None) -> None:
         self.generation = int(plan["generation"])
         self._adoptedDigest = _plan_digest(plan)
         self.participants = tuple(str(h) for h in plan["participants"])
         # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
         self.deviceIds = tuple(int(d) for d in plan["deviceIds"])
+        # consensus-evicted devices ride in the plan so a SUCCESSOR
+        # leader inherits them — without this, the next proposal's
+        # device union would quietly re-admit an evicted straggler
+        # jaxlint: sync-ok -- plan device ids are JSON ints, not device scalars
+        evictedIds = sorted(int(d)
+                            for d in plan.get("evictedDeviceIds", ()))
+        self.evictedDeviceIds = tuple(evictedIds)
         self.lease.generation = self.generation
         self.lease.write_now()
         coord_metrics().generation().set(self.generation)
-        self._pruneAcks()
+        self._gcCoordDir(now)
 
     # -- establish --------------------------------------------------------
     def establish(self, hosts: Sequence[str], timeout: float = 30.0,
@@ -578,11 +628,26 @@ class PodCoordinator:
                 readmitted.append(host)
         if not candidates:
             return None
+        evicted = self._tallyEvictionVotes(live, candidates)
         # jaxlint: sync-ok -- lease device ids are JSON ints, not device scalars
         devices = sorted({int(d) for h in candidates
-                          for d in live[h].get("devices", ())})
+                          for d in live[h].get("devices", ())} - evicted)
+        # a host whose every published device the pod voted out has
+        # nothing left to train: drop it from the participants so it
+        # fails fast with PodEvictedError instead of grinding against
+        # an empty mesh (a host publishing NO devices is a different,
+        # pre-existing case and keeps its seat)
+        kept = []
+        for h in candidates:
+            # jaxlint: sync-ok -- lease device ids are JSON ints, not device scalars
+            hd = {int(d) for d in live[h].get("devices", ())}
+            if hd and not (hd - evicted):
+                continue
+            kept.append(h)
+        candidates = kept or candidates
         if tuple(candidates) == self.participants and \
-                tuple(devices) == self.deviceIds:
+                tuple(devices) == self.deviceIds and \
+                tuple(sorted(evicted)) == self.evictedDeviceIds:
             return None
         if not devices:
             return None     # a pod with zero devices is not a topology
@@ -593,10 +658,82 @@ class PodCoordinator:
         self._pendingReadmits = list(readmitted)
         reason = ("readmitted " + ",".join(readmitted)) if readmitted \
             else "topology change"
+        if evicted - set(self.evictedDeviceIds):
+            reason = ("straggler eviction by quorum: devices "
+                      f"{sorted(evicted - set(self.evictedDeviceIds))}"
+                      + ("; " + reason if readmitted else ""))
         return {"generation": self.generation + 1,
                 "participants": candidates, "deviceIds": devices,
+                "evictedDeviceIds": sorted(evicted),
                 "proposedBy": self.hostId, "reason": reason,
                 "ts": time.time()}
+
+    def _tallyEvictionVotes(self, live: Dict[str, dict],
+                            candidates: List[str]) -> set:
+        """Aggregate the straggler flags published in live candidates'
+        leases into the set of consensus-evicted device ids (carried
+        forward from the adopted plan — an eviction is sticky for the
+        run; re-entry is an operator decision, not lease churn).
+
+        A replica's devices leave the topology only when at least
+        ``evictionQuorum`` hosts (default: a strict majority of the
+        live candidates) independently flag the SAME replica — one
+        skewed host's clock or NIC can therefore no longer evict a
+        healthy peer.  Vote-count transitions land in
+        ``dl4j_tpu_coord_eviction_votes_total{replica,verdict}``
+        (verdict ``evict`` when the tally reaches quorum, ``hold``
+        while it hasn't)."""
+        # jaxlint: sync-ok -- adopted-plan device ids are Python ints, not device scalars
+        evicted = {int(d) for d in self.evictedDeviceIds}
+        votes: Dict[str, set] = {}
+        flagDevs: Dict[str, Dict[int, int]] = {}
+        for host in candidates:
+            for rep, devs in (live[host].get("flags") or {}).items():
+                votes.setdefault(str(rep), set()).add(host)
+                # jaxlint: sync-ok -- lease device ids are JSON ints, not device scalars
+                ids = {int(d) for d in devs}
+                counts = flagDevs.setdefault(str(rep), {})
+                for d in ids:
+                    counts[d] = counts.get(d, 0) + 1
+        quorum = self.evictionQuorum if self.evictionQuorum is not None \
+            else len(candidates) // 2 + 1
+        # jaxlint: sync-ok -- lease device ids are JSON ints, not device scalars
+        allDevs = {int(d) for h in candidates
+                   for d in live[h].get("devices", ())}
+        for rep in sorted(votes):
+            n = len(votes[rep])
+            # per-DEVICE quorum too: acting on the UNION of voters'
+            # sets would let one host's drifted replica->device mapping
+            # evict devices nobody else named — a device leaves only
+            # when a quorum of hosts independently flagged THAT device
+            ids = {d for d, c in flagDevs[rep].items()
+                   if c >= quorum} & allDevs
+            # the verdict reflects what actually HAPPENS: quorum alone
+            # is not an eviction when the flag maps to no live devices
+            # or would take the pod's last ones — counting "evict"
+            # there would hand dashboards phantom evictions
+            acts = n >= quorum and bool(ids) \
+                and bool(allDevs - evicted - ids)
+            if self._voteCounts.get(rep) != (n, acts):
+                # transition-counted, not boundary-counted: a vote that
+                # holds steady across a thousand polls is one fact.
+                # The verdict is part of the key — a quorum reached by
+                # the CANDIDATE COUNT dropping (voters outliving the
+                # non-voters) is an eviction too, and must not execute
+                # silently just because n never moved
+                self._voteCounts[rep] = (n, acts)
+                coord_metrics().eviction_votes().inc(
+                    replica=rep, verdict="evict" if acts else "hold")
+                log.warning("coord[%s]: straggler %r flagged by %d/%d "
+                            "live hosts (quorum %d): %s", self.hostId,
+                            rep, n, len(candidates), quorum,
+                            "evicting" if acts else "holding")
+            if acts:
+                evicted |= ids      # never evict the pod's last devices
+        for rep in list(self._voteCounts):
+            if rep not in votes:
+                del self._voteCounts[rep]   # votes withdrawn: re-armed
+        return evicted
 
     def _recordReadmissions(self, plan: dict) -> None:
         """Burn the re-admission budget for the hosts the last computed
@@ -637,23 +774,92 @@ class PodCoordinator:
                 except OSError:
                     pass
 
-    def _barrier(self, plan: dict) -> Optional[dict]:
-        """Ack the plan and wait until every participant acked it too —
-        the whole pod reshards between the same two steps or not at all.
-        Dead hosts are not participants by construction, so the barrier
-        only ever waits on processes that WILL reach a checkpoint
-        boundary (bounded by their checkpoint cadence).  Returns None
-        once every participant acked this plan, or the SUPERSEDING
-        published plan when a racing leader's publish won the file (the
-        caller re-anchors on it)."""
+    def _gcCoordDir(self, now: Optional[float] = None) -> None:
+        """Coordination-dir hygiene, run at every successful barrier
+        (adopt): superseded ack files go immediately (:meth:`_pruneAcks`),
+        and the heartbeat lease of a host that is (a) not a current
+        participant, (b) parked on a generation older than current−2 and
+        (c) long dead by lease age is deleted — a year-long soak run must
+        not accumulate thousands of dead-host files for ``leases()`` to
+        re-parse at every liveness check.  The age gate matters: an
+        EVICTED host awaiting re-admission also carries an old adopted
+        generation, but its lease is fresh — it survives the sweep.
+        Orphaned ``.coord_*.tmp`` files from writers killed mid-rename
+        are swept once they age past the same bar."""
+        now = time.time() if now is None else now
+        self._pruneAcks()
+        horizon = 3.0 * self.leaseTimeout
+        try:
+            names = os.listdir(self.coordDir)
+        except OSError:
+            return
+        for fn in names:
+            path = os.path.join(self.coordDir, fn)
+            if fn.startswith(".coord_") and fn.endswith(".tmp"):
+                try:
+                    if now - os.path.getmtime(path) > horizon:
+                        os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if not (fn.startswith(_HB_PREFIX) and fn.endswith(".json")):
+                continue
+            payload = _read_json(path)
+            if not payload:
+                continue
+            host = str(payload.get("host", ""))
+            if not host or host == self.hostId \
+                    or host in self.participants:
+                continue
+            try:
+                gen = int(payload.get("generation", 0))
+                ts = float(payload.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if gen < self.generation - 2 and abs(now - ts) > horizon:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def _barrier(self, plan: dict,
+                 now: Optional[float] = None) -> Optional[dict]:
+        """Ack the plan and wait until every LIVE participant acked it
+        too — the whole pod reshards between the same two steps or not
+        at all.  Returns None once the barrier passed, or the
+        SUPERSEDING published plan when a racing leader's publish won
+        the file (the caller re-anchors on it).
+
+        Barrier progress is leader-agnostic (acks key on the plan
+        digest, not on who proposed it), and it survives the death of
+        its own coordinator: a participant whose lease has expired can
+        never ack, so waiting for it would time the whole pod out — a
+        dead participant is EXCUSED (its exclusion is the next
+        generation's business), and when the dead host is the plan's
+        PROPOSER, the next-lowest live participant adopts the orphaned
+        plan as its own proposal — same generation, same digest (no
+        re-vote thrash, existing acks stay valid), ``proposedBy``
+        rewritten so exactly one successor counts
+        ``dl4j_tpu_coord_leader_failovers_total`` — and re-drives the
+        barrier to completion."""
         gen = int(plan["generation"])
         participants = [str(h) for h in plan["participants"]]
         digest = _plan_digest(plan)
+        if _inj.consume_barrier_kill(self.hostId):
+            raise _inj.SimulatedPreemption(
+                f"host {self.hostId} killed at the generation-{gen} "
+                "barrier entry, before its ack (injected)")
         _atomic_write_json(self._ackPath(gen, self.hostId),
                            {"host": self.hostId, "generation": gen,
                             "digest": digest, "ts": time.time()})
         t0 = time.perf_counter()
         deadline = time.monotonic() + self.barrierTimeout
+        # liveness only changes at heartbeat granularity: re-reading
+        # every lease file on each 50 ms poll would multiply the shared
+        # dir's IO for nothing (same rationale as the device-loss wait
+        # loop's lease-cadence refresh).  A test's explicit `now`
+        # checks every iteration — its single pass must see the state.
+        nextLiveness = 0.0
         try:
             with tracer().span("coord_barrier", generation=gen,
                                participants=len(participants)):
@@ -675,6 +881,26 @@ class PodCoordinator:
                             ).get("digest") != digest]
                     if not missing:
                         return None
+                    deadMissing: List[str] = []
+                    if now is not None or \
+                            time.monotonic() >= nextLiveness:
+                        nextLiveness = time.monotonic() + \
+                            self.lease.interval
+                        wallNow = time.time() if now is None else now
+                        live = set(self.liveHosts(wallNow))
+                        live.add(self.hostId)   # alive by definition
+                        deadMissing = [h for h in missing
+                                       if h not in live]
+                    if deadMissing:
+                        self._maybeAdoptOrphan(
+                            published if published is not None else plan,
+                            digest, deadMissing, live, participants)
+                        if len(deadMissing) == len(missing):
+                            # every missing ack belongs to a dead host:
+                            # it can never arrive — the live pod
+                            # completes the barrier without it (the
+                            # next proposal excludes the dead hosts)
+                            return None
                     if time.monotonic() >= deadline:
                         raise CoordinationError(
                             f"barrier for generation {gen} timed out "
@@ -685,16 +911,80 @@ class PodCoordinator:
             coord_metrics().barrier_seconds().observe(
                 time.perf_counter() - t0)
 
+    def _maybeAdoptOrphan(self, published: dict, digest: str,
+                          deadMissing: List[str], live: set,
+                          participants: List[str]) -> None:
+        """Leader-failover half of the barrier: when the plan's proposer
+        is among the dead missing participants, the lowest LIVE
+        participant re-publishes the plan as its own proposal (same
+        generation/participants/devices — the digest, and therefore
+        every ack already written, is unchanged) and counts the
+        failover.  Every other live participant simply excuses the dead
+        host; after the takeover the published proposer is live, so the
+        adoption happens exactly once."""
+        if _plan_digest(published) != digest:
+            return      # a different plan won the file; re-anchor path
+        proposer = str(published.get("proposedBy", ""))
+        if proposer not in deadMissing:
+            return
+        liveParts = sorted(h for h in participants if h in live)
+        if not liveParts or liveParts[0] != self.hostId:
+            return
+        # narrow the cross-host race: another participant whose
+        # liveness view also nominated itself (our own lease delayed
+        # past leaseTimeout — a double fault) may have published its
+        # takeover since the loop-top read; re-read and stand down if
+        # the proposer is no longer the corpse.  The file substrate has
+        # no compare-and-swap, so adoption is AT-LEAST-once under
+        # divergent liveness views, never lost: both takeovers carry
+        # the same digest (convergence and acks unaffected) and each
+        # candidate leader's readmission ledger burns once — only the
+        # failover counter can over-count in that corner.
+        latest = self.currentPlan()
+        if latest is None or _plan_digest(latest) != digest or \
+                str(latest.get("proposedBy", "")) != proposer:
+            return
+        takeover = dict(published)
+        takeover["proposedBy"] = self.hostId
+        takeover["reason"] = (f"leader failover: proposer {proposer!r} "
+                              f"died mid-barrier; adopted by "
+                              f"{self.hostId!r}")
+        takeover["failoverFrom"] = proposer
+        takeover["ts"] = time.time()
+        self._publish(takeover)
+        coord_metrics().leader_failovers().inc()
+        # inherit the dead leader's readmission bookkeeping: a
+        # participant of the orphan that we did not count as one was
+        # READMITTED by the plan we just adopted as ours — the proposer
+        # died before its _recordReadmissions, and without the burn a
+        # flapping host whose re-entries keep coinciding with leader
+        # deaths would dodge its maxReadmissions budget forever.  (Our
+        # own pending list is necessarily drained here: a leader runs
+        # _recordReadmissions before it ever enters a barrier.)
+        self._pendingReadmits = sorted(
+            {str(h) for h in published.get("participants", ())}
+            - set(self.participants) - {self.hostId})
+        self._recordReadmissions(published)
+        log.warning("coord[%s]: leader %s died mid-barrier for "
+                    "generation %s; adopted its in-flight plan "
+                    "(digest %s unchanged) and re-driving the barrier",
+                    self.hostId, proposer, published.get("generation"),
+                    digest)
+
     def poll(self, now: Optional[float] = None) -> Optional[dict]:
         """The checkpoint-boundary hook.  Returns the newly ADOPTED plan
         (barrier passed, local generation bumped) or None when the
         topology is unchanged.  Raises :class:`PodEvictedError` when a
         newer generation excludes this host."""
-        now = time.time() if now is None else now
+        # `now` stays None for production calls all the way into the
+        # barrier: liveness there must re-read the clock every loop
+        # iteration (a host can die DURING the wait), while a test's
+        # explicit `now` freezes the whole poll deterministically
+        wall = time.time() if now is None else now
         plan = self.currentPlan()
         if plan is not None and int(plan.get("generation", 0)) \
                 > self.generation:
-            return self._adoptPublished(plan)
+            return self._adoptPublished(plan, now=now)
         if plan is not None and self.generation > 0 and \
                 int(plan.get("generation", 0)) == self.generation and \
                 _plan_digest(plan) != self._adoptedDigest:
@@ -704,21 +994,30 @@ class PodCoordinator:
             # winner landed must re-anchor on the canonical file —
             # otherwise peers still in their barrier wait forever for
             # this host's ack of the winning digest
-            return self._adoptPublished(plan)
-        if plan is not None and self.isLeader(now):
-            proposal = self._computeProposal(now)
+            return self._adoptPublished(plan, now=now)
+        if plan is not None and self.isLeader(wall):
+            proposal = self._computeProposal(wall)
             if proposal is not None:
                 self._publish(proposal)
+                if _inj.consume_leader_crash(self.hostId):
+                    # injected leader death at the protocol's most
+                    # exposed moment: the plan is on disk, our ack is
+                    # not — the orphaned barrier a successor must adopt
+                    raise _inj.SimulatedPreemption(
+                        f"leader {self.hostId} crashed after publishing "
+                        f"generation {proposal['generation']}, before "
+                        "its barrier ack (injected)")
                 # re-read: another leader's publish may have won the
                 # file after ours — what is PUBLISHED is what the pod
                 # agrees on, not what this process proposed
                 published = self.currentPlan()
                 winning = published if published is not None else proposal
                 self._recordReadmissions(winning)
-                return self._adoptPublished(winning)
+                return self._adoptPublished(winning, now=now)
         return None
 
-    def _adoptPublished(self, plan: dict) -> dict:
+    def _adoptPublished(self, plan: dict,
+                        now: Optional[float] = None) -> dict:
         me = self.hostId
         # bounded re-anchoring: each round either adopts the plan it
         # barriered on or switches to the plan a racing publisher won
@@ -730,9 +1029,9 @@ class PodCoordinator:
                     f"host {me!r} is not a participant of generation "
                     f"{plan.get('generation')} — the pod re-meshed "
                     "without it; stop training and await re-admission")
-            superseded = self._barrier(plan)
+            superseded = self._barrier(plan, now=now)
             if superseded is None:
-                self._adopt(plan)
+                self._adopt(plan, now=now)
                 return dict(plan)
             plan = superseded
         raise CoordinationError(

@@ -330,6 +330,7 @@ class ElasticSupervisor(FaultTolerantTrainer):
         self._evicted: set = set()
         self._stragglerStreak: Dict[tuple, int] = {}
         self._stragglerAlert = False
+        self._votedFlags: Dict[str, list] = {}
         self.coordinator = coordinator
         if coordinator is not None:
             # generation fencing: every checkpoint seal / manifest
@@ -593,6 +594,10 @@ class ElasticSupervisor(FaultTolerantTrainer):
             from deeplearning4j_tpu.fault.coordination import \
                 StaleGenerationError
             self._coordRefreshLease()
+            # straggler VOTE before the poll: if this host happens to
+            # be the leader, its own proposal this boundary must
+            # already see the lease flag it just published
+            self._publishStragglerVotes()
             self._coordPoll()
             try:
                 super()._checkpoint(stepInEpoch)
@@ -702,20 +707,18 @@ class ElasticSupervisor(FaultTolerantTrainer):
                     pass
         return reg
 
-    def _maybeEvict(self, devs: Optional[list] = None) -> None:
-        # the watchdog's replica_straggler alert arms one eviction check
-        # even when the local ratio watch is off — the alert itself
-        # already encodes persistence, so it gets patience 1
-        ratio, patience = self.stragglerRatio, self.stragglerPatience
-        if ratio is None:
-            if not self._stragglerAlert:
-                return
-            ratio, patience = 2.0, 1
-        self._stragglerAlert = False
+    def _stragglerCandidate(self, ratio: float,
+                            patience: int) -> Optional[tuple]:
+        """Shared straggler detection (local eviction AND the
+        coordinated vote): the worst mesh-actionable replica cell vs
+        the lower median, gated by a ``patience`` streak.  Returns
+        ``(worstKey, worst, median)`` once the worst cell exceeded
+        ``ratio * median`` for ``patience`` consecutive boundaries,
+        else None (the worst cell recovering also clears its streak)."""
         m = self._stragglerRegistry().get(
             replica_step_gauge().name)
         if m is None:
-            return
+            return None
         meshIds = set(self.wrapper.mesh.deviceIds())
         cells = []
         for key, v in m.data().get("cells", []):
@@ -730,22 +733,93 @@ class ElasticSupervisor(FaultTolerantTrainer):
             # jaxlint: sync-ok -- registry gauge cells hold Python floats, not device scalars
             cells.append((key, float(v)))
         if len(cells) < 2:
-            return
+            return None
         vals = sorted(v for _k, v in cells)
         # lower median, same rationale as ReplicaStragglerRule: the
         # worst cell must compare against the healthy half
         median = vals[(len(vals) - 1) // 2]
         if median <= 0:
-            return
+            return None
         worstKey, worst = max(cells, key=lambda kv: kv[1])
         if worst <= ratio * median:
             self._stragglerStreak.pop(worstKey, None)
-            return
+            return None
         streak = self._stragglerStreak.get(worstKey, 0) + 1
         self._stragglerStreak[worstKey] = streak
         if streak < patience:
+            return None
+        return worstKey, worst, median
+
+    def _stragglerParams(self) -> Optional[tuple]:
+        """(ratio, patience) in force this boundary, or None when
+        neither the configured watch nor the watchdog alert is active.
+        The watchdog's replica_straggler edge arms a 2.0/1 fallback —
+        the alert itself already encodes persistence.  ONE resolution
+        site, so the local-eviction and coordinated-vote paths can
+        never drift apart on the threshold."""
+        if self.stragglerRatio is not None:
+            return self.stragglerRatio, self.stragglerPatience
+        if self._stragglerAlert:
+            return 2.0, 1
+        return None
+
+    def _publishStragglerVotes(self) -> None:
+        """Coordinated runs turn the local straggler verdict into a
+        VOTE, not a verdict: the {replica: devices} flag is published
+        into this host's lease and the LEADER evicts only once a quorum
+        of live hosts independently flag the same replica
+        (``PodCoordinator._tallyEvictionVotes``) — one host with a
+        skewed clock or a slow NIC can no longer unilaterally shrink
+        the pod.  The vote stands while the local signal holds and is
+        withdrawn (empty flags) when it clears."""
+        params = self._stragglerParams()
+        if params is None:
             return
+        # (under the alert-armed fallback the vote watch is PERSISTENT:
+        # the quorum needs the flag to HOLD across boundaries, so
+        # _stragglerAlert only resets below, once the signal clears)
+        cand = self._stragglerCandidate(*params)
+        ids = set()
+        if cand is not None:
+            worstKey, worst, median = cand
+            ids = self._devicesFor(worstKey) & \
+                set(self.wrapper.mesh.deviceIds())
+        if not ids:
+            # no actionable verdict (or none at all): any standing vote
+            # must be WITHDRAWN, or this host would keep counting
+            # toward the quorum for devices no longer on its mesh
+            if self._votedFlags:
+                self._votedFlags = {}
+                self.coordinator.setStragglerFlags({})
+                self._note("straggler_vote_withdrawn")
+            self._stragglerAlert = False
+            return
+        label = "/".join(worstKey)
+        flags = {label: sorted(ids)}
+        if flags != self._votedFlags:
+            self._votedFlags = flags
+            self.coordinator.setStragglerFlags(flags)
+            self._note("straggler_vote", replica=label,
+                       devices=sorted(ids), stepSeconds=worst,
+                       medianSeconds=median)
+            log.warning("straggler vote published for %s (%.4gs vs "
+                        "median %.4gs): eviction now needs a pod "
+                        "quorum, not this host's opinion", label, worst,
+                        median)
+
+    def _maybeEvict(self, devs: Optional[list] = None) -> None:
+        params = self._stragglerParams()
+        if params is None:
+            return
+        # the alert-armed fallback is a ONE-SHOT here (unlike the
+        # coordinated vote): the local check consumes the edge
+        self._stragglerAlert = False
+        cand = self._stragglerCandidate(*params)
+        if cand is None:
+            return
+        worstKey, worst, median = cand
         self._stragglerStreak.pop(worstKey, None)
+        meshIds = set(self.wrapper.mesh.deviceIds())
         evictIds = self._devicesFor(worstKey) & meshIds
         if not evictIds or evictIds == meshIds:
             return      # nothing of the mesh to evict, or all of it
@@ -781,9 +855,14 @@ class ElasticSupervisor(FaultTolerantTrainer):
         straggler signal and the eviction decision read the same
         federated gauge, so the boundary check re-verifies before any
         devices leave)."""
-        if self.coordinator is not None:
-            return None     # coordinated runs evict through consensus
         self._stragglerAlert = True
+        if self.coordinator is not None:
+            # coordinated runs evict through consensus: the alert arms
+            # a persistent VOTE watch — the flag lands in our lease at
+            # the next boundary and holds until the signal clears; the
+            # leader evicts only on a pod-wide quorum
+            self._note("straggler_vote_armed", reason=detail)
+            return "straggler vote armed for the next checkpoint boundary"
         self._note("straggler_eviction_armed", reason=detail)
         return "straggler eviction armed for the next checkpoint boundary"
 

@@ -36,13 +36,16 @@ __all__ = [
     "NaNAtStep", "PreemptAtStep", "OOMAtStep", "StallAtStep",
     "CorruptCheckpointAtStep", "DeviceLossAtStep", "RestoreCapacityAtStep",
     "StragglerReplica", "PartitionedHost", "DelayedHeartbeat",
+    "LeaderCrashMidBarrier", "KillAtBarrier",
     "FailingFetch", "SlowFetch", "FaultInjector",
     "set_injector", "get_injector", "clear_injector", "inject",
     "corrupt_checkpoint", "lose_devices", "restore_devices",
     "lost_device_ids", "clear_lost_devices",
     "partition_host", "heal_host", "partitioned_host_ids",
     "clear_partitioned_hosts", "set_heartbeat_delay", "heartbeat_delay",
-    "clear_heartbeat_delays",
+    "clear_heartbeat_delays", "arm_leader_crash", "consume_leader_crash",
+    "clear_leader_crashes", "arm_barrier_kill", "consume_barrier_kill",
+    "clear_barrier_kills",
 ]
 
 
@@ -149,6 +152,63 @@ def heartbeat_delay(hostId) -> float:
 
 def clear_heartbeat_delays() -> None:
     _HEARTBEAT_DELAYS.clear()
+
+
+# -- simulated coordinator death at the worst moments ------------------------
+# Leader-failover registries (ISSUE 14): an ARMED host dies exactly at the
+# point the coordination protocol is most exposed — right after publishing
+# a plan but before acking it (the orphaned in-flight barrier a successor
+# must adopt), or at barrier entry before the ack lands (a participant
+# whose ack will never come).  PodCoordinator consults these; "death" is
+# a SimulatedPreemption plus a silenced heartbeat (the partition registry),
+# so to every peer the host looks exactly like a crashed process.  One-shot
+# per arm; cleared on inject() exit like every other registry here.
+
+_LEADER_CRASHES: set = set()
+_BARRIER_KILLS: set = set()
+
+
+def arm_leader_crash(hostId) -> None:
+    """Arm ``hostId`` to die right after its next plan PUBLISH, before
+    its own barrier ack (the orphaned-plan failover path)."""
+    _LEADER_CRASHES.add(str(hostId))
+
+
+def consume_leader_crash(hostId) -> bool:
+    """One-shot check-and-clear, called by the coordinator after a
+    publish; also silences the host's heartbeat (a dead process writes
+    no leases)."""
+    host = str(hostId)
+    if host not in _LEADER_CRASHES:
+        return False
+    _LEADER_CRASHES.discard(host)
+    partition_host(host)
+    return True
+
+
+def clear_leader_crashes() -> None:
+    _LEADER_CRASHES.clear()
+
+
+def arm_barrier_kill(hostId) -> None:
+    """Arm ``hostId`` to die when it next ENTERS an ack barrier, before
+    writing its ack (peers must excuse it or wait forever)."""
+    _BARRIER_KILLS.add(str(hostId))
+
+
+def consume_barrier_kill(hostId) -> bool:
+    """One-shot check-and-clear at barrier entry; silences the
+    heartbeat like :func:`consume_leader_crash`."""
+    host = str(hostId)
+    if host not in _BARRIER_KILLS:
+        return False
+    _BARRIER_KILLS.discard(host)
+    partition_host(host)
+    return True
+
+
+def clear_barrier_kills() -> None:
+    _BARRIER_KILLS.clear()
 
 
 class Fault:
@@ -348,6 +408,44 @@ class DelayedHeartbeat(Fault):
             set_heartbeat_delay(self.host, self.seconds)
 
 
+class LeaderCrashMidBarrier(Fault):
+    """Arm ``host`` (at step ``step``; None = immediately) to die right
+    after it publishes its next plan, before its own barrier ack — the
+    orphaned in-flight plan in ``coord/gen.json`` whose barrier the
+    next-lowest live participant must adopt and re-drive (same
+    generation, same digest).  The death is a
+    :class:`SimulatedPreemption` raised out of the armed coordinator's
+    ``poll()`` plus a silenced heartbeat.  One-shot."""
+
+    def __init__(self, host: str, step: Optional[int] = None):
+        self.host = str(host)
+        self.step = None if step is None else int(step)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and (self.step is None or step >= self.step):
+            self.fired = True
+            arm_leader_crash(self.host)
+
+
+class KillAtBarrier(Fault):
+    """Arm ``host`` (at step ``step``; None = immediately) to die when
+    it next enters an ack barrier, BEFORE its ack lands — the
+    participant whose ack will never come; every live peer's barrier
+    must excuse it once its lease expires instead of timing out the
+    whole pod.  One-shot."""
+
+    def __init__(self, host: str, step: Optional[int] = None):
+        self.host = str(host)
+        self.step = None if step is None else int(step)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and (self.step is None or step >= self.step):
+            self.fired = True
+            arm_barrier_kill(self.host)
+
+
 class FailingFetch(Fault):
     """Fail the first ``times`` real-data fetch attempts for dataset
     ``what`` (None = any) — exercises the fetchers' bounded retry and
@@ -440,6 +538,8 @@ def inject(*faults: Fault):
         clear_lost_devices()
         clear_partitioned_hosts()
         clear_heartbeat_delays()
+        clear_leader_crashes()
+        clear_barrier_kills()
 
 
 def check_fetch_fault(what: str) -> None:

@@ -120,7 +120,8 @@ class FaultTolerantTrainer:
                  injector: Optional["_inj.FaultInjector"] = None,
                  healthMonitor=None,
                  durableExport: bool = True,
-                 asyncSeal: bool = False):
+                 asyncSeal: bool = False,
+                 cadenceRestoreSeconds: Optional[float] = 600.0):
         self.wrapper = model if hasattr(model, "model") else None
         self.net = model.model if self.wrapper is not None else model
         self.ckpt = ShardedCheckpointer(checkpointDir, keepLast=keepLast)
@@ -144,6 +145,16 @@ class FaultTolerantTrainer:
         # the orbax tensorstore write (ElasticSupervisor's default; see
         # ShardedCheckpointer.saveWithManifest(block=))
         self.asyncSeal = bool(asyncSeal)
+        # rollback-window hysteresis: once the divergence_precursor
+        # remediation tightens the checkpoint cadence, the ORIGINAL
+        # cadence comes back only after the run has stayed quiet (no
+        # new rollbacks, precursor not firing) for this long; None
+        # keeps the tightened cadence for the rest of the run
+        self.cadenceRestoreSeconds = None if cadenceRestoreSeconds \
+            is None else float(cadenceRestoreSeconds)
+        self._cadenceOriginal: Optional[int] = None
+        self._cadenceQuietSince: Optional[float] = None
+        self._cadenceRollbacksSeen = 0
         # the (possibly prefetch-wrapped) iterator of the CURRENT fit —
         # the elastic re-mesh path retargets its H2D staging/ShardSpec
         self._activeIterator = None
@@ -182,6 +193,7 @@ class FaultTolerantTrainer:
                                     "lrScale": self._lrScale()},
                 block=not self.asyncSeal)
         self.stats["checkpoints"] += 1
+        self._maybeRestoreCadence()
         get_registry().counter(
             "dl4j_tpu_fault_checkpoints_total",
             "Sealed checkpoints written by the supervisor").inc()
@@ -318,15 +330,56 @@ class FaultTolerantTrainer:
     def _remediateDivergence(self, rule: str, detail: str) -> Optional[str]:
         """Divergence precursors (rollbacks happening) tighten the
         rollback window: halve the checkpoint cadence so the NEXT
-        rollback replays fewer steps."""
+        rollback replays fewer steps.  The original cadence is restored
+        by :meth:`_maybeRestoreCadence` once the precursor has stayed
+        quiet for ``cadenceRestoreSeconds``."""
         old = self.checkpointEveryN
         if old <= 1:
             return None
+        if self._cadenceOriginal is None:
+            self._cadenceOriginal = old
         self.checkpointEveryN = max(1, old // 2)
+        self._cadenceQuietSince = None      # the quiet clock re-arms
         self._note("rollback_window_tightened", was=old,
                    now=self.checkpointEveryN, reason=detail)
         return (f"checkpoint cadence tightened "
                 f"{old} -> {self.checkpointEveryN}")
+
+    def _maybeRestoreCadence(self, now: Optional[float] = None) -> None:
+        """Un-tighten the rollback window (checked at every checkpoint
+        boundary): once ``divergence_precursor`` tightened the cadence,
+        restore the ORIGINAL ``checkpointEveryN`` only after
+        ``cadenceRestoreSeconds`` of quiet — no new rollbacks AND the
+        precursor rule itself resolved.  Hysteresis by construction: a
+        flapping precursor resets the quiet clock on every new rollback
+        (and re-halves on every firing edge), so the cadence can thrash
+        at most once per full quiet period, never per flap."""
+        if self._cadenceOriginal is None or \
+                self.cadenceRestoreSeconds is None or \
+                self.checkpointEveryN >= self._cadenceOriginal:
+            return
+        now = time.monotonic() if now is None else now
+        rollbacks = int(self.stats["rollbacks"])
+        if rollbacks != self._cadenceRollbacksSeen or \
+                (self.healthMonitor is not None and
+                 "divergence_precursor" in self.healthMonitor.firing):
+            self._cadenceRollbacksSeen = rollbacks
+            self._cadenceQuietSince = now
+            return
+        if self._cadenceQuietSince is None:
+            self._cadenceQuietSince = now
+            return
+        if now - self._cadenceQuietSince < self.cadenceRestoreSeconds:
+            return
+        was = self.checkpointEveryN
+        self.checkpointEveryN = self._cadenceOriginal
+        self._cadenceQuietSince = None
+        self._note("rollback_window_restored", was=was,
+                   now=self.checkpointEveryN,
+                   quietSeconds=self.cadenceRestoreSeconds)
+        log.info("divergence precursor quiet for %gs: checkpoint "
+                 "cadence restored %d -> %d", self.cadenceRestoreSeconds,
+                 was, self.checkpointEveryN)
 
     def _fit(self, iterator, epochs: int) -> None:
         net = self.net

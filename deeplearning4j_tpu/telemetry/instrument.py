@@ -543,6 +543,28 @@ class CoordMetrics:
             "Evicted hosts/devices re-admitted to the mesh after "
             "passing the probation policy")
 
+    def leader_failovers(self):
+        return get_registry().counter(
+            "dl4j_tpu_coord_leader_failovers_total",
+            "In-flight plans orphaned by a proposer dying mid-barrier "
+            "and adopted by the next-lowest live participant (same "
+            "generation, same digest)")
+
+    def eviction_votes(self):
+        return get_registry().counter(
+            "dl4j_tpu_coord_eviction_votes_total",
+            "Straggler-eviction vote-count transitions tallied by the "
+            "leader, by replica and verdict (evict = quorum reached, "
+            "hold = below quorum)",
+            labelnames=("replica", "verdict"))
+
+    def chaos_events(self):
+        return get_registry().counter(
+            "dl4j_tpu_coord_chaos_events_total",
+            "Fault events fired by the deterministic chaos-soak "
+            "harness, by event kind",
+            labelnames=("event",))
+
 
 _COORD_METRICS = CoordMetrics()
 

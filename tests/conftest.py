@@ -76,6 +76,11 @@ def pytest_configure(config):
         "tests (content-addressed store, warm-boot preload, "
         "corrupt-entry quarantine, re-mesh re-keying, cross-process "
         "reuse)")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic chaos-soak tests (seeded "
+        "fault schedules over a coordinated training run: leader "
+        "failover, barrier deaths, partitions, corrupt/torn state — "
+        "with the standing lineage/trajectory/delivery/jit invariants)")
 
 
 def pytest_collection_modifyitems(config, items):

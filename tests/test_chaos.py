@@ -1,0 +1,140 @@
+"""Deterministic chaos-soak harness tests (ISSUE 14).
+
+Fast tier: the schedule is a pure function of the seed (bit-for-bit
+replay, seed-sensitive, caps and pairings respected) plus ONE short
+soak — a seeded fault schedule over a real coordinated training run
+with every standing invariant checked.  The multi-seed soak and the
+CLI round-trip carry the ``slow`` marker (tier-1 time budget).
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.fault.chaos import (_CAPS, EVENT_KINDS, ChaosSoak,
+                                            build_schedule)
+from deeplearning4j_tpu.telemetry import MetricsRegistry
+
+pytestmark = pytest.mark.chaos
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = telemetry.set_registry(MetricsRegistry())
+    yield
+    telemetry.set_registry(prev)
+
+
+class TestSchedule:
+    def test_same_seed_is_bit_for_bit_identical(self):
+        a = build_schedule(7, 8, events=4)
+        b = build_schedule(7, 8, events=4)
+        assert json.dumps(a, sort_keys=True) == \
+            json.dumps(b, sort_keys=True)
+        assert a      # a seeded schedule is never empty
+
+    def test_different_seeds_differ(self):
+        schedules = {json.dumps(build_schedule(s, 8, events=4),
+                                sort_keys=True) for s in range(8)}
+        assert len(schedules) > 1
+
+    def test_caps_pairings_and_order(self):
+        for seed in range(20):
+            sch = build_schedule(seed, 8, events=5)
+            steps = [e["step"] for e in sch]
+            assert steps == sorted(steps)
+            counts = {}
+            for e in sch:
+                counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+            for kind, cap in _CAPS.items():
+                assert counts.get(kind, 0) <= cap, (seed, kind)
+            # every destructive draw carries its paired recovery
+            assert counts.get("device_loss", 0) == \
+                counts.get("capacity_return", 0)
+            assert counts.get("partition_peer", 0) == \
+                counts.get("heal_peer", 0)
+            assert counts.get("delayed_heartbeat", 0) == \
+                counts.get("heal_heartbeat", 0)
+            # distinct devices die, and never the lowest (a data axis
+            # must survive)
+            lost = [d for e in sch if e["kind"] == "device_loss"
+                    for d in e["devices"]]
+            assert len(lost) == len(set(lost))
+            assert 0 not in lost
+            extras = {"capacity_return", "heal_peer", "heal_heartbeat"}
+            assert all(e["kind"] in set(EVENT_KINDS) | extras
+                       for e in sch)
+
+    def test_leader_crash_owns_h0(self):
+        """Host-exclusivity: partitions and slow leases target h2, so
+        an armed leader crash can never be masked by its victim already
+        being partitioned (the failover count stays assertable)."""
+        for seed in range(30):
+            for e in build_schedule(seed, 8, events=6):
+                if e["kind"] in ("partition_peer", "delayed_heartbeat",
+                                 "kill_at_barrier"):
+                    assert e["host"] == "h2"
+                if e["kind"] == "leader_crash":
+                    assert e["host"] == "h0"
+
+
+class TestSoak:
+    def test_soak_invariants_hold(self, tmp_path):
+        """One full seeded soak in tier-1: every scheduled event fires
+        (or provably cannot), the four standing invariants hold, and
+        the leader-failover counter equals the number of leader crashes
+        the schedule fired.  Seed 7's draw includes kill_at_barrier,
+        torn_snapshot, corrupt_checkpoint AND leader_crash — the
+        densest protocol workout of the small seeds."""
+        report = ChaosSoak(7, str(tmp_path / "run"), events=4).run()
+        assert report["ok"], report
+        inv = report["invariants"]
+        assert inv["single_sealed_lineage"]
+        assert inv["trajectory_matches_reference"]
+        assert inv["exactly_once_delivery"]
+        assert inv["flat_jit_misses"]
+        crashes = sum(1 for k in report["fired"] if k == "leader_crash")
+        assert report["leader_failovers"] == crashes == 1
+        assert report["generation"] >= 2
+        assert not report["peer_errors"]
+
+    @pytest.mark.slow
+    def test_soak_three_distinct_seeds(self, tmp_path):
+        """The acceptance soak: at least three distinct seeds, denser
+        schedules, every invariant green."""
+        for seed in (3, 11, 42):
+            report = ChaosSoak(seed, str(tmp_path / f"run{seed}"),
+                               events=6).run()
+            assert report["ok"], (seed, report)
+            assert all(report["invariants"].values()), (seed, report)
+
+    @pytest.mark.slow
+    def test_cli_schedule_bit_for_bit_and_soak(self, tmp_path):
+        """tools/chaos.py --seed N replays the identical schedule
+        bit-for-bit across invocations, and a full CLI soak exits 0
+        with ok=true."""
+        cmd = [sys.executable, str(_ROOT / "tools" / "chaos.py"),
+               "--seed", "9", "--schedule-only"]
+        env = {k: v for k, v in os.environ.items()}
+        a = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=120)
+        b = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert a.returncode == 0 and b.returncode == 0, a.stderr
+        assert a.stdout == b.stdout
+        assert json.loads(a.stdout)["schedule"]
+        full = subprocess.run(
+            [sys.executable, str(_ROOT / "tools" / "chaos.py"),
+             "--seed", "9", "--dir", str(tmp_path / "cli")],
+            capture_output=True, text=True, env=env, timeout=280)
+        assert full.returncode == 0, full.stdout[-3000:] + \
+            full.stderr[-3000:]
+        report = json.loads(full.stdout.strip().splitlines()[-1])
+        assert report["ok"] is True
+        assert report["seed"] == 9

@@ -43,12 +43,15 @@ from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
 from deeplearning4j_tpu.fault import (DeviceHealthProbe, ElasticSupervisor,
                                       FaultTolerantTrainer, HeartbeatLease,
+                                      KillAtBarrier, LeaderCrashMidBarrier,
                                       PodCoordinator, PodEvictedError,
-                                      ReadmissionPolicy,
+                                      ReadmissionPolicy, SimulatedPreemption,
                                       StaleGenerationError, DeviceLossAtStep,
                                       PartitionedHost, DelayedHeartbeat,
+                                      arm_barrier_kill,
                                       inject, partitioned_host_ids)
 from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.fault.coordination import _plan_digest
 from deeplearning4j_tpu.fault.elastic import _RemeshRestart
 from deeplearning4j_tpu.learning import Adam
 from deeplearning4j_tpu.models import MultiLayerNetwork
@@ -300,6 +303,375 @@ class TestConsensus:
         assert c0.generation == c1.generation == 2
         # stable afterwards: same generation, same digest — no churn
         assert c0.poll() is None
+
+
+# ---------------------------------------------------- leader failover ----
+
+class TestLeaderFailover:
+    def test_leader_crash_mid_barrier_successor_adopts(self, tmp_path):
+        """THE failover acceptance (in-process, now-driven): the leader
+        publishes a gen-2 plan and dies before its own barrier ack.
+        The survivor detects the orphaned in-flight plan, adopts it as
+        its own proposal (same generation, SAME digest — no re-vote),
+        completes the barrier with the dead proposer excused, and the
+        next generation excludes the corpse with the counter still
+        monotonic."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0, barrierTimeout=10.0)
+        c0.lease.write_now(now=100.0)
+        c1.lease.write_now(now=100.0)
+        with inject(LeaderCrashMidBarrier("h0")) as inj:
+            inj.before_step(0, None, None)      # arm
+            c0.setHealthyDevices([0])           # device 1 died: proposal
+            c0.lease.write_now(now=100.5)       # re-stamp logical time
+            with pytest.raises(SimulatedPreemption):
+                c0.poll(now=101.0)
+        # the orphan: gen 2 on disk, proposed by h0, h0's ack missing
+        orphan = c0.currentPlan()
+        assert orphan["generation"] == 2
+        assert orphan["proposedBy"] == "h0"
+        digest = _plan_digest(orphan)
+        assert _read_or_none(c0._ackPath(2, "h0")) is None
+        fo0 = _counter("dl4j_tpu_coord_leader_failovers_total")
+
+        c1.lease.write_now(now=110.0)           # h0 long dead by now
+        plan = c1.poll(now=110.0)
+        assert plan is not None and plan["generation"] == 2
+        assert c1.generation == 2
+        assert c1.deviceIds == (0, 2, 3)
+        published = c1.currentPlan()
+        assert _plan_digest(published) == digest    # same plan, no fork
+        assert published["proposedBy"] == "h1"      # adopted as its own
+        assert published["failoverFrom"] == "h0"
+        assert _counter("dl4j_tpu_coord_leader_failovers_total") == \
+            fo0 + 1
+        # monotonic continuation: the successor now leads and excludes
+        # the dead host at the next boundary
+        c1.lease.write_now(now=111.0)
+        plan3 = c1.poll(now=111.0)
+        assert plan3["generation"] == 3
+        assert plan3["participants"] == ["h1"]
+        assert plan3["deviceIds"] == [2, 3]
+        assert _counter("dl4j_tpu_coord_leader_failovers_total") == \
+            fo0 + 1     # a normal dead-host shrink is NOT a failover
+
+    def test_failover_burns_inherited_readmission_budget(self, tmp_path):
+        """A leader that readmits a host and dies before recording it
+        must not leak the host's maxReadmissions budget: the successor
+        inherits the bookkeeping at takeover (the budget lives with
+        leadership, and the takeover IS the new leadership)."""
+        c0, c1, c2 = _pod3(tmp_path, leaseTimeout=2.0,
+                           barrierTimeout=10.0)
+        plan2 = {"generation": 2, "participants": ["h0", "h1"],
+                 "deviceIds": [0, 1, 2, 3], "proposedBy": "h0",
+                 "reason": "h2 evicted", "ts": 100.0}
+        c0._publish(plan2)
+        c0._adopt(plan2)
+        c1._adopt(plan2)
+        c1.readmission.note_evicted("h2", now=100.0)
+        re0 = _counter("dl4j_tpu_coord_readmissions_total")
+        # the orphan: h0 readmits h2 at generation 3 and dies before
+        # its ack (and before _recordReadmissions)
+        plan3 = {"generation": 3, "participants": ["h0", "h1", "h2"],
+                 "deviceIds": [0, 1, 2, 3, 4, 5], "proposedBy": "h0",
+                 "reason": "readmitted h2", "ts": 101.0}
+        c0._publish(plan3)
+        c1.lease.write_now(now=110.0)
+        c2.lease.write_now(now=110.0)
+        t = threading.Thread(target=lambda: c2.poll(now=110.0),
+                             daemon=True)
+        t.start()
+        plan = c1.poll(now=110.0)       # successor takeover
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert plan["generation"] == 3
+        assert c1.currentPlan()["proposedBy"] == "h1"
+        # the successor burned h2's budget exactly once
+        assert c1.readmission._st("h2")["count"] == 1
+        assert _counter("dl4j_tpu_coord_readmissions_total") == re0 + 1
+        # the non-successor participant did not double-burn
+        assert c2.readmission._st("h2")["count"] == 0
+
+    def test_leader_death_between_propose_and_publish(self, tmp_path):
+        """A leader dying BEFORE its publish leaves nothing to adopt:
+        the successor simply becomes leader (lowest live participant)
+        and proposes the next generation itself — the counter stays
+        monotonic and no failover is recorded (there was no orphan)."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        fo0 = _counter("dl4j_tpu_coord_leader_failovers_total")
+        # h0 computed a proposal in memory and died: the file still
+        # holds gen 1 and h0's lease goes stale
+        c1.lease.write_now(now=110.0)
+        plan = c1.poll(now=110.0)
+        assert plan["generation"] == 2
+        assert plan["participants"] == ["h1"]
+        assert plan["proposedBy"] == "h1"
+        assert _counter("dl4j_tpu_coord_leader_failovers_total") == fo0
+
+    def test_follower_killed_at_barrier_is_excused(self, tmp_path):
+        """The complementary death: a FOLLOWER dies entering the
+        barrier, before its ack.  The live pod must excuse it once its
+        lease expires (its ack can never come) instead of timing the
+        whole pod out — and no failover is counted, because the
+        proposer is alive."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0, barrierTimeout=10.0)
+        c0.lease.write_now(now=100.0)
+        c1.lease.write_now(now=100.0)
+        plan = {"generation": 2, "participants": ["h0", "h1"],
+                "deviceIds": [0, 1, 2], "proposedBy": "h0",
+                "reason": "test", "ts": 100.0}
+        c0._publish(plan)
+        arm_barrier_kill("h1")
+        try:
+            with pytest.raises(SimulatedPreemption):
+                c1.poll(now=100.5)          # dies entering the barrier
+        finally:
+            _inj.clear_barrier_kills()
+            _inj.clear_partitioned_hosts()
+        assert c1.generation == 1           # never adopted
+        assert _read_or_none(c1._ackPath(2, "h1")) is None
+        fo0 = _counter("dl4j_tpu_coord_leader_failovers_total")
+        # h1's lease (ts=100) is stale at now=110: h0's barrier excuses
+        # it and completes on the same digest
+        c0.lease.write_now(now=110.0)
+        adopted = c0.poll(now=110.0)
+        assert adopted is not None and adopted["generation"] == 2
+        assert c0.generation == 2
+        assert _counter("dl4j_tpu_coord_leader_failovers_total") == fo0
+        # next boundary: the dead follower leaves the participants
+        c0.lease.write_now(now=111.0)
+        plan3 = c0.poll(now=111.0)
+        assert plan3["generation"] == 3
+        assert plan3["participants"] == ["h0"]
+
+
+def _read_or_none(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ----------------------------------------------- consensus eviction ------
+
+def _pod3(run_dir, **kw):
+    """Three in-process coordinators (h0: 0-1, h1: 2-3, h2: 4-5)."""
+    cs = [PodCoordinator(str(run_dir), f"h{i}",
+                         devices=[2 * i, 2 * i + 1], **kw)
+          for i in range(3)]
+    for c in cs:
+        c.lease.write_now()
+    for c in cs:
+        c.establish(["h0", "h1", "h2"], timeout=5)
+    return cs
+
+
+class TestQuorumEviction:
+    def test_one_skewed_host_cannot_evict_but_quorum_can(self, tmp_path):
+        """Eviction is a pod decision now: one host flagging replica
+        'r2' does nothing (verdict `hold`); a second independent flag
+        reaches the majority quorum and the next generation excludes
+        the replica's devices — which stay excluded (sticky) even after
+        the votes are withdrawn."""
+        c0, c1, c2 = _pod3(tmp_path, leaseTimeout=30.0,
+                           barrierTimeout=10.0)
+        # one skewed host alone: no eviction
+        c0.setStragglerFlags({"r2": [4, 5]})
+        assert c0.poll() is None
+        assert c0.generation == 1
+        assert _counter("dl4j_tpu_coord_eviction_votes_total",
+                        replica="r2", verdict="hold") == 1.0
+        assert _counter("dl4j_tpu_coord_eviction_votes_total",
+                        replica="r2", verdict="evict") == 0.0
+        # steady state: an unchanged vote count is not re-counted
+        assert c0.poll() is None
+        assert _counter("dl4j_tpu_coord_eviction_votes_total",
+                        replica="r2", verdict="hold") == 1.0
+        # a second independent flag: quorum (2 of 3) -> eviction
+        c1.setStragglerFlags({"r2": [4, 5]})
+        results = {}
+
+        def leader():
+            results["plan"] = c0.poll()
+
+        t = threading.Thread(target=leader, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while (c1.currentPlan() or {}).get("generation", 0) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        c1.poll()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        # the evicted replica's host lost its seat along with its
+        # devices: its poll fails fast instead of grinding on an empty
+        # mesh
+        with pytest.raises(PodEvictedError):
+            c2.poll()
+        plan = results["plan"]
+        assert plan["generation"] == 2
+        assert plan["deviceIds"] == [0, 1, 2, 3]
+        assert plan["evictedDeviceIds"] == [4, 5]
+        # h2 lost every device it published: it leaves the participants
+        assert plan["participants"] == ["h0", "h1"]
+        assert _counter("dl4j_tpu_coord_eviction_votes_total",
+                        replica="r2", verdict="evict") == 1.0
+        # sticky: withdrawing the votes must NOT quietly re-admit the
+        # evicted devices through the next device union
+        c0.setStragglerFlags({})
+        c1.setStragglerFlags({})
+        assert c0.poll() is None
+        assert c0.deviceIds == (0, 1, 2, 3)
+
+    def test_disjoint_device_votes_do_not_evict(self, tmp_path):
+        """Per-DEVICE quorum: two hosts flagging the same replica label
+        but naming different devices (one of them has a drifted
+        hostDevices mapping) must not evict anything — a device leaves
+        only when a quorum independently named THAT device."""
+        c0, c1, _c2 = _pod3(tmp_path, leaseTimeout=30.0)
+        c0.setStragglerFlags({"r2": [4, 5]})
+        c1.setStragglerFlags({"r2": [2, 3]})    # drifted mapping
+        assert c0.poll() is None
+        assert c0.generation == 1
+        assert c0.deviceIds == (0, 1, 2, 3, 4, 5)
+        assert _counter("dl4j_tpu_coord_eviction_votes_total",
+                        replica="r2", verdict="evict") == 0.0
+        assert _counter("dl4j_tpu_coord_eviction_votes_total",
+                        replica="r2", verdict="hold") == 1.0
+
+    def test_supervisor_publishes_vote_instead_of_local_evict(
+            self, tmp_path):
+        """Under coordination the supervisor's straggler verdict goes
+        into its LEASE as a vote — it must not re-mesh locally (the
+        eviction only happens when the pod agrees)."""
+        run = tmp_path / "run"
+        c0 = PodCoordinator(str(run), "h0", devices=[0, 1, 2, 3],
+                            leaseTimeout=30.0)
+        c0.establish(["h0"], timeout=5)
+        net = _mlp()
+        net.init()
+        dev = jax.devices()
+        pw = ParallelWrapper(net, mesh=DeviceMesh(data=4,
+                                                  devices=dev[:4]))
+        es = ElasticSupervisor(pw, str(tmp_path / "el"),
+                               checkpointEveryN=2, coordinator=c0,
+                               stragglerRatio=2.0, stragglerPatience=2,
+                               hostDevices={"r9": [2, 3]})
+        try:
+            from deeplearning4j_tpu.telemetry import replica_step_gauge
+            replica_step_gauge().set(0.1, replica="0")
+            replica_step_gauge().set(0.1, replica="1")
+            replica_step_gauge().set(5.0, replica="r9")
+            es._publishStragglerVotes()         # streak 1 of 2: no vote
+            assert c0.lease.flags == {}
+            es._publishStragglerVotes()         # streak 2: vote lands
+            assert c0.lease.flags == {"r9": [2, 3]}
+            assert es.stats["remeshes"] == []   # vote, not verdict
+            assert sorted(pw.mesh.deviceIds()) == [0, 1, 2, 3]
+            # signal clears -> the vote is withdrawn
+            replica_step_gauge().set(0.1, replica="r9")
+            es._publishStragglerVotes()
+            assert c0.lease.flags == {}
+        finally:
+            es.close()
+
+
+# ---------------------------------------------------- coord dir GC -------
+
+class TestCoordDirGc:
+    def test_dead_host_lease_and_stale_acks_pruned(self, tmp_path):
+        """A long soak must not accumulate dead-host files: once the
+        pod is ≥3 generations past a dead host's last adopted one, its
+        stale lease is GC'd (acks of superseded generations already go
+        at every adopt) — while an EVICTED-but-heartbeating host's
+        fresh lease survives the sweep."""
+        c0, c1 = _pod(tmp_path, leaseTimeout=2.0)
+        coordDir = c0.coordDir
+        c1.lease.write_now(now=100.0)   # ancient ts: h1 dies here
+        c0.lease.write_now(now=110.0)
+        # h1 dies at generation 1; drive three more topology changes
+        assert c0.poll(now=110.0)["generation"] == 2
+        assert os.path.exists(c1.lease.path)    # gen 1 is within 2
+        c0.setHealthyDevices([0])
+        c0.lease.write_now(now=111.0)
+        assert c0.poll(now=111.0)["generation"] == 3
+        c0.setHealthyDevices([0, 1])
+        c0.lease.write_now(now=112.0)
+        assert c0.poll(now=112.0)["generation"] == 4
+        # h1's lease: generation 1 < 4-2, ts ancient -> swept
+        assert not os.path.exists(c1.lease.path)
+        names = os.listdir(coordDir)
+        acks = [n for n in names if n.startswith("ack_")]
+        assert acks == ["ack_4_h0.json"]    # superseded acks pruned
+        # a fresh-but-evicted lease survives (it is awaiting
+        # re-admission, not dead) — stamped in the SAME logical clock
+        # the poll drives, which the GC now sees end to end
+        c1.lease.write_now(now=113.0)       # fresh at poll time, gen 1
+        c0.setHealthyDevices([0])
+        c0.lease.write_now(now=113.0)
+        assert c0.poll(now=113.0)["generation"] == 5
+        assert os.path.exists(c1.lease.path)
+
+
+# ------------------------------------------------ cadence restore --------
+
+class TestCadenceRestore:
+    def test_rollback_window_restores_after_quiet_period(self, tmp_path):
+        """ROADMAP item 5 leftover: after divergence_precursor halves
+        the cadence, the original comes back once the precursor stays
+        resolved for cadenceRestoreSeconds — and a flapping precursor
+        (new rollback mid-quiet) resets the clock instead of thrashing
+        the cadence."""
+        net = _mlp()
+        mon = HealthMonitor(
+            rules=[DivergencePrecursorRule(quietSeconds=5.0)],
+            eventLogPath=str(tmp_path / "events.jsonl"))
+        tr = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                  checkpointEveryN=8,
+                                  cadenceRestoreSeconds=60.0,
+                                  healthMonitor=mon)
+        tr._registerRemediations(mon)
+        c = get_registry().counter(
+            "dl4j_tpu_fault_nan_rollbacks_total",
+            "Divergence (NaN/Inf/threshold/solver) rollbacks to the "
+            "last good checkpoint")
+        mon.evaluate_once(now=0.0)
+        c.inc()
+        mon.evaluate_once(now=1.0)          # precursor fires -> tighten
+        assert tr.checkpointEveryN == 4
+        # while the precursor is OBSERVED firing, every boundary pins
+        # the quiet clock to "now" — the countdown can't start
+        tr._maybeRestoreCadence(now=2.0)
+        tr._maybeRestoreCadence(now=70.0)
+        assert tr.checkpointEveryN == 4
+        mon.evaluate_once(now=100.0)        # quietSeconds passed: resolved
+        assert "divergence_precursor" not in mon.firing
+        tr._maybeRestoreCadence(now=100.0)  # 30s since last pin: hold
+        assert tr.checkpointEveryN == 4
+        # hysteresis: a new rollback mid-quiet resets the clock
+        tr.stats["rollbacks"] += 1
+        tr._maybeRestoreCadence(now=110.0)  # disturbance: clock -> 110
+        tr._maybeRestoreCadence(now=169.0)  # 59 < 60: still tightened
+        assert tr.checkpointEveryN == 4
+        tr._maybeRestoreCadence(now=171.0)  # full quiet period elapsed
+        assert tr.checkpointEveryN == 8     # restored
+        # a later firing edge re-tightens from the restored cadence
+        c.inc()
+        mon.evaluate_once(now=180.0)
+        assert tr.checkpointEveryN == 4
+        tr.close()
+
+    def test_restore_disabled_keeps_tightened_cadence(self, tmp_path):
+        net = _mlp()
+        tr = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                  checkpointEveryN=8,
+                                  cadenceRestoreSeconds=None)
+        assert tr._remediateDivergence("divergence_precursor", "t")
+        assert tr.checkpointEveryN == 4
+        tr._maybeRestoreCadence(now=0.0)
+        tr._maybeRestoreCadence(now=1e9)
+        assert tr.checkpointEveryN == 4
+        tr.close()
 
 
 # ------------------------------------------------------------ fencing ----
@@ -1027,6 +1399,137 @@ print("STALE " + json.dumps({{
     "iterations": int(net.iterationCount),
 }}), flush=True)
 """
+
+
+_H0_LEADER_CRASH_SCRIPT = _POD_PREAMBLE + """
+import os
+from deeplearning4j_tpu.fault import SimulatedPreemption, arm_leader_crash
+coord = PodCoordinator(run, "h0", devices=[0, 1], leaseTimeout=1.0,
+                       heartbeatInterval=0.2)
+coord.start()
+coord.establish(["h0", "h1"], timeout=120)
+print("ESTABLISHED", coord.generation, flush=True)
+# the survivor must be FULLY established before the orphan lands, or
+# its establish() would adopt generation 2 directly and skip the
+# failover path this test exists to drive
+deadline = time.time() + 120
+while not os.path.exists(os.path.join(run, "h1_ready")):
+    if time.time() > deadline:
+        print("TIMEOUT waiting for h1_ready", flush=True)
+        sys.exit(2)
+    time.sleep(0.05)
+arm_leader_crash("h0")
+coord.setHealthyDevices([])     # every chip died: propose h1's devices
+crashed = False
+try:
+    coord.poll()                # publishes gen 2, dies before its ack
+except SimulatedPreemption:
+    crashed = True
+plan = coord.currentPlan() or {{}}
+print("CRASHED " + json.dumps({{
+    "crashed": crashed,
+    "generation": plan.get("generation"),
+    "proposedBy": plan.get("proposedBy"),
+    "deviceIds": plan.get("deviceIds"),
+}}), flush=True)
+os._exit(0)     # hard death: the heartbeat thread dies with us
+"""
+
+_H1_SURVIVOR_SCRIPT = _POD_PREAMBLE + """
+from deeplearning4j_tpu.telemetry import get_registry
+coord = PodCoordinator(run, "h1", devices=[2, 3], leaseTimeout=1.0,
+                       heartbeatInterval=0.2, barrierTimeout=60.0)
+coord.start()
+coord.establish(["h0", "h1"], timeout=120)
+with open(os.path.join(run, "h1_ready"), "w") as f:
+    f.write("ok")
+print("ESTABLISHED", coord.generation, flush=True)
+net = mlp()
+pw = ParallelWrapper(net, mesh=DeviceMesh(data=4,
+                                          devices=jax.devices()[:4]))
+es = ElasticSupervisor(pw, os.path.join(run, "ck_h1"),
+                       checkpointEveryN=2, keepLast=10, coordinator=coord)
+es.fit(batches(), epochs=2)
+fo = get_registry().get("dl4j_tpu_coord_leader_failovers_total")
+print("RESULT " + json.dumps({{
+    "generation": coord.generation,
+    "mesh": sorted(pw.mesh.deviceIds()),
+    "remeshes": [r["direction"] for r in es.stats["remeshes"]],
+    "iterations": int(net.iterationCount),
+    "loss": float(es.lastLoss),
+    "failovers": float(fo.value()) if fo is not None else 0.0,
+    "params": [round(float(v), 8)
+               for v in np.asarray(net.params().numpy()).ravel()],
+}}), flush=True)
+coord.stop()
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessLeaderFailover:
+    def test_kill_leader_mid_barrier_survivor_takes_over(self, tmp_path):
+        """ISSUE 14 acceptance, two REAL processes: the leader
+        publishes the gen-2 plan and dies before the barrier completes
+        (before even its own ack).  The survivor adopts the orphaned
+        plan (failover counter == 1, generation monotonic — never
+        re-voted), completes the barrier on the same digest, shrinks
+        onto the agreed devices, and its post-shrink trajectory matches
+        the equivalent single-process device-loss run."""
+        run_dir = str(tmp_path / "pod")
+        os.makedirs(run_dir, exist_ok=True)
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env.pop("DL4J_TPU_TELEMETRY_DIR", None)
+        procs = [subprocess.Popen(
+            [sys.executable, "-c",
+             textwrap.dedent(script).format(root=str(_ROOT),
+                                            run_dir=run_dir)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for script in (_H0_LEADER_CRASH_SCRIPT,
+                                    _H1_SURVIVOR_SCRIPT)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+        h0_out, h1_out = outs
+
+        crashed = json.loads(
+            [ln for ln in h0_out.splitlines()
+             if ln.startswith("CRASHED ")][0][len("CRASHED "):])
+        assert crashed["crashed"] is True
+        assert crashed["generation"] == 2       # the orphan is on disk
+        assert crashed["proposedBy"] == "h0"
+        assert crashed["deviceIds"] == [2, 3]
+
+        result = json.loads(
+            [ln for ln in h1_out.splitlines()
+             if ln.startswith("RESULT ")][0][len("RESULT "):])
+        # the survivor took the orphan over: exactly one failover, the
+        # generation counter monotonic (2 adopted, then 3 excludes the
+        # corpse — never a re-vote of 2)
+        assert result["failovers"] == 1.0
+        assert result["generation"] >= 2
+        assert result["mesh"] == [2, 3]
+        assert result["remeshes"] == ["shrink"]
+        assert result["iterations"] == 8
+
+        # trajectory parity with the equivalent single-process shrink
+        x, y = _toy()
+        ref = _mlp()
+        ref.init()
+        pr = ParallelWrapper(ref, mesh=DeviceMesh(
+            data=4, devices=jax.devices()[:4]))
+        tr_ref = ElasticSupervisor(pr, str(tmp_path / "ref"),
+                                   checkpointEveryN=2, keepLast=10)
+        with inject(DeviceLossAtStep(0, devices=(0, 1))):
+            tr_ref.fit(_batches(x, y), epochs=2)
+        assert sorted(pr.mesh.deviceIds()) == [2, 3]
+        assert result["loss"] == pytest.approx(tr_ref.lastLoss, abs=1e-5)
+        np.testing.assert_allclose(
+            np.array(result["params"], dtype=np.float64),
+            np.asarray(ref.params().numpy()).ravel().astype(np.float64),
+            rtol=2e-4, atol=2e-5)
+        tr_ref.close()
 
 
 @pytest.mark.slow

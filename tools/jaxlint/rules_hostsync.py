@@ -45,6 +45,7 @@ HOT_PATH_SUFFIXES = (
     "datavec/iterators.py",
     "fault/elastic.py",
     "fault/coordination.py",
+    "fault/chaos.py",
     "compile/aotcache.py",
 )
 
