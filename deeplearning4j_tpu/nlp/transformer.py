@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nn.conf.attention import KVCache, cached_attention
+from deeplearning4j_tpu.nn.conf.attention import (KVCache, cached_attention,
+                                                  paged_attention)
 
 __all__ = ["TransformerLMConfig", "TransformerLM"]
 
@@ -206,17 +207,23 @@ class TransformerLM:
         return self._prefillFn(self.params, tokens, start,
                                lengths is not None)
 
+    def _decode_math(self, params, tok, caches):
+        """One incremental step against dense caches: tok (b,) ->
+        ((b, vocab) logits, new caches).  The shared body of
+        ``_decodeFn`` and the draft-proposal scan."""
+        pos_ids = (caches[0].pos - caches[0].start)[:, None]  # (b, 1)
+        x = self._embed(params, tok[:, None], pos_ids)
+        new = []
+        for lp, cache in zip(params["layers"], caches):
+            x, cache = self._block_cached(lp, x, cache)
+            new.append(cache)
+        return self._logits(params, x)[:, 0], new
+
     @functools.cached_property
     def _decodeFn(self):
         def run(params, tok, caches):
             # tok: (b,) int32 — ONE new token per example
-            pos_ids = (caches[0].pos - caches[0].start)[:, None]  # (b, 1)
-            x = self._embed(params, tok[:, None], pos_ids)
-            new = []
-            for lp, cache in zip(params["layers"], caches):
-                x, cache = self._block_cached(lp, x, cache)
-                new.append(cache)
-            return self._logits(params, x)[:, 0], new
+            return self._decode_math(params, tok, caches)
         return jax.jit(run)
 
     def decodeStep(self, tok, caches):
@@ -226,12 +233,297 @@ class TransformerLM:
         return self._decodeFn(self.params, jnp.asarray(tok, jnp.int32),
                               caches)
 
+    # ------------------------------------------------------------------
+    # speculative decode: draft proposes, target verifies in ONE forward
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _verifyFn(self):
+        """Verify ``k`` proposed tokens in ONE batched forward: feeds all
+        k against the caches (``cached_attention`` handles tq > 1) and
+        returns the target's greedy token AFTER each prefix — the
+        accept-prefix comparison happens on the host."""
+        def run(params, toks, caches):
+            b, k = toks.shape
+            pos_ids = jnp.maximum(
+                (caches[0].pos - caches[0].start)[:, None] +
+                jnp.arange(k, dtype=jnp.int32)[None, :], 0)
+            x = self._embed(params, toks, pos_ids)
+            new = []
+            for lp, cache in zip(params["layers"], caches):
+                x, cache = self._block_cached(lp, x, cache)
+                new.append(cache)
+            greedy = jnp.argmax(self._logits(params, x),
+                                axis=-1).astype(jnp.int32)
+            return greedy, new
+        return jax.jit(run)
+
+    def verifySteps(self, toks, caches):
+        """Target-side verification: toks (b, k) int32 (the last emitted
+        token followed by the draft's proposals) -> ((b, k) greedy
+        tokens, caches advanced k).  Greedy token j is the target's
+        prediction after prefix ``toks[:, :j+1]`` — identical math to j
+        sequential :meth:`decodeStep` calls, ONE dispatch.  On a partial
+        accept the caller rolls back by rebuilding the caches with a
+        smaller ``pos`` (stale K/V past ``pos`` are overwritten before
+        they can ever be attended)."""
+        return self._verifyFn(self.params, jnp.asarray(toks, jnp.int32),
+                              caches)
+
+    def _proposeFn(self, k: int):
+        """Jitted draft proposal: ``k`` greedy tokens in ONE dispatch
+        (the per-token loop is a ``lax.scan`` INSIDE the executable, so
+        a cheap draft model is not billed k dispatch round-trips).  The
+        scan runs k+1 steps so the cache also holds K/V for the k-th
+        proposal — a full accept then needs no cache repair."""
+        fns = self.__dict__.setdefault("_proposeFns", {})
+        if k not in fns:
+            def run(params, tok, caches):
+                def body(carry, _):
+                    tok, caches = carry
+                    logits, caches = self._decode_math(params, tok, caches)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, caches), nxt
+                (_, caches), props = jax.lax.scan(
+                    body, (tok, caches), None, length=k + 1)
+                return jnp.transpose(props)[:, :k], caches
+            fns[k] = jax.jit(run)
+        return fns[k]
+
+    def proposeK(self, tok, caches, k: int):
+        """Draft entry point: (b,) last tokens -> ((b, k) proposals,
+        caches advanced k+1)."""
+        return self._proposeFn(int(k))(
+            self.params, jnp.asarray(tok, jnp.int32), caches)
+
+    def speculative_generate(self, draft: "TransformerLM", prompts,
+                             maxNewTokens: int, draftK: int = 4,
+                             lengths=None, returnStats: bool = False):
+        """Greedy decode accelerated by a small draft model — output is
+        BIT-IDENTICAL to :meth:`generate` (accept-prefix rule: every
+        emitted token is the target's own greedy argmax; the draft only
+        decides how many of them one verification dispatch yields).
+
+        Per round: the draft proposes ``draftK`` tokens in one fused
+        scan, the target verifies all of them in ONE batched forward,
+        and the longest matching prefix plus the target's first
+        correction are emitted — between 1 and ``draftK + 1`` tokens for
+        two dispatches, vs one token per dispatch for plain decode.
+
+        Serves ONE sequence per call (per-example accept lengths
+        diverge under batching; the continuous-batching scheduler's
+        per-slot page tables handle that case).  Requires
+        ``t + maxNewTokens + draftK <= maxLen``: a rejected round still
+        wrote its speculative K/V before the roll-back, so the cache
+        needs the extra headroom.
+        """
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim == 1:
+            prompts = prompts[None, :]
+        if prompts.shape[0] != 1:
+            raise ValueError(
+                "speculative_generate serves one sequence at a time "
+                "(per-example accept lengths diverge; use the "
+                "continuous-batching scheduler for batched speculation)")
+        draftK = int(draftK)
+        if draftK < 1:
+            raise ValueError("draftK must be >= 1")
+        if draft.config.vocabSize != self.config.vocabSize:
+            raise ValueError("draft and target must share a vocabulary")
+        t = prompts.shape[1]
+        if t + maxNewTokens + draftK > self.config.maxLen:
+            raise ValueError(
+                f"prompt {t} + maxNewTokens {maxNewTokens} + draftK "
+                f"{draftK} exceeds cache capacity {self.config.maxLen} "
+                "(speculative rounds write draftK tokens of K/V ahead)")
+        if t + maxNewTokens + draftK > draft.config.maxLen:
+            raise ValueError(
+                f"draft cache capacity {draft.config.maxLen} cannot hold "
+                f"prompt {t} + maxNewTokens {maxNewTokens} + draftK "
+                f"{draftK}")
+        logits, caches = self.prefill(prompts, lengths)
+        _, dcaches = draft.prefill(prompts, lengths)
+        # jaxlint: sync-ok -- the accept-prefix rule is a host decision: one small D2H per round by design
+        tok = int(np.argmax(np.asarray(logits[0])))
+        emitted = [tok]
+        proposed = accepted = rounds = 0
+        while len(emitted) < maxNewTokens:
+            # pre-propose/pre-verify write indices: the roll-back below
+            # rebuilds both cache sets relative to THESE (reading pos
+            # after the dispatch would bake the speculative advance in)
+            pos0 = caches[0].pos
+            dpos0 = dcaches[0].pos
+            props, dcaches = draft.proposeK(
+                np.asarray([tok], np.int32), dcaches, draftK)
+            # jaxlint: sync-ok -- proposals feed the verify batch through host concat (accept rule is host-side)
+            props = np.asarray(props)[0]                     # (draftK,)
+            verifyIn = np.concatenate(
+                [np.asarray([tok], np.int32), props])[None, :]
+            greedy, caches = self.verifySteps(verifyIn, caches)
+            # jaxlint: sync-ok -- greedy tokens ARE the output; comparison against proposals is host-side
+            greedy = np.asarray(greedy)[0]                   # (draftK+1,)
+            a = 0
+            while a < draftK and props[a] == greedy[a]:
+                a += 1
+            emitted.extend(int(g) for g in greedy[:a + 1])
+            tok = int(greedy[a])
+            proposed += draftK
+            accepted += a
+            rounds += 1
+            # roll back: only the accepted prefix (plus the verified
+            # input token) is real — stale K/V past pos are overwritten
+            # before any later query can attend to them
+            newPos = pos0 + a + 1
+            caches = [KVCache(c.k, c.v, newPos, c.start) for c in caches]
+            dcaches = [KVCache(c.k, c.v, dpos0 + a + 1, c.start)
+                       for c in dcaches]
+        out = np.asarray(emitted[:maxNewTokens], np.int32)[None, :]
+        if returnStats:
+            return out, {"proposed": proposed, "accepted": accepted,
+                         "rounds": rounds,
+                         "acceptRate": accepted / proposed if proposed
+                         else 0.0}
+        return out
+
+    # ------------------------------------------------------------------
+    # paged decode — the continuous-batching scheduler's executables
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _prefillRawFn(self):
+        """Prefill that returns the per-layer K/V heads STACKED
+        ((nLayers, b, h, t, d)) instead of materializing full-capacity
+        dense caches — the continuous scheduler copies them straight
+        into pool pages."""
+        def run(params, tokens, start):
+            b, t = tokens.shape
+            kpos = jnp.arange(t, dtype=jnp.int32)[None, :]
+            pos_ids = jnp.maximum(kpos - start[:, None], 0)
+            mask = (kpos >= start[:, None]).astype(jnp.float32)
+            x = self._embed(params, tokens, pos_ids)
+            ks, vs = [], []
+            for lp in params["layers"]:
+                x, (kh, vh) = self._block_full(lp, x, mask)
+                ks.append(kh)
+                vs.append(vh)
+            return (self._logits(params, x[:, -1:])[:, 0],
+                    jnp.stack(ks), jnp.stack(vs))
+        return jax.jit(run)
+
+    def prefillRaw(self, tokens, lengths=None):
+        """(b, t) LEFT-padded prompt -> (last logits (b, vocab),
+        kStack, vStack (nLayers, b, h, t, d)).  Always mask-padded (one
+        executable per prompt bucket regardless of raggedness)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        t = tokens.shape[1]
+        if t > self.config.maxLen:
+            raise ValueError(f"prompt length {t} exceeds cache capacity "
+                             f"{self.config.maxLen}")
+        if lengths is None:
+            start = jnp.zeros((tokens.shape[0],), jnp.int32)
+        else:
+            start = t - jnp.asarray(lengths, jnp.int32)
+        return self._prefillRawFn(self.params, tokens, start)
+
+    def _paged_block(self, lp, x, poolK, poolV, pageTable, pos, start):
+        """One transformer block against a paged pool layer (the
+        ``_block_cached`` math with :func:`paged_attention` in place of
+        the private dense cache)."""
+        h = self._ln(x, lp["ln1_g"], lp["ln1_b"])
+        qh = self._heads(jnp.matmul(h, lp["Wq"]))
+        kh = self._heads(jnp.matmul(h, lp["Wk"]))
+        vh = self._heads(jnp.matmul(h, lp["Wv"]))
+        ctx, poolK, poolV = paged_attention(qh, kh, vh, poolK, poolV,
+                                            pageTable, pos, start)
+        x = x + jnp.matmul(self._merge(ctx), lp["Wo"])
+        h = self._ln(x, lp["ln2_g"], lp["ln2_b"])
+        ff = jax.nn.gelu(jnp.matmul(h, lp["Wi"]) + lp["bi"])
+        return x + jnp.matmul(ff, lp["Wp"]) + lp["bp"], poolK, poolV
+
+    def _paged_step_math(self, params, poolK, poolV, toks, pageTable,
+                         pos, start):
+        """toks (S, tq) against the stacked pools (L, pages, h, ps, d):
+        returns ((S, tq) greedy tokens, pools).  Position-embedding ids
+        are clipped so a speculative over-write past ``maxLen`` (tokens
+        that will be discarded by the accept rule) can't index out of
+        the table."""
+        tq = toks.shape[1]
+        pos_ids = jnp.clip(
+            (pos - start)[:, None] + jnp.arange(tq, dtype=jnp.int32),
+            0, self.config.maxLen - 1)
+        x = params["emb"][toks] + params["pos"][pos_ids]
+        for li, lp in enumerate(params["layers"]):
+            x, pk, pv = self._paged_block(lp, x, poolK[li], poolV[li],
+                                          pageTable, pos, start)
+            poolK = poolK.at[li].set(pk)
+            poolV = poolV.at[li].set(pv)
+        greedy = jnp.argmax(self._logits(params, x),
+                            axis=-1).astype(jnp.int32)
+        return greedy, poolK, poolV
+
+    def buildPagedDecodeFn(self):
+        """FRESH jitted paged decode/verify step over a
+        ``KVCachePool``'s buffers: ``(params, poolK, poolV, toks (S,tq),
+        pageTable, pos, start) -> (greedy (S,tq), poolK, poolV)``.  tq=1
+        is the plain decode step; tq=draftK+1 the speculative verify.
+        Pool buffers are DONATED (the pool swaps in the returned
+        arrays).  A fresh function identity per build is deliberate:
+        JAX's jaxpr cache keys on function identity + avals, so reusing
+        one closure across a pool/plan rebuild could resurrect
+        constraints traced for the old layout — the scheduler pops and
+        rebuilds these on every pool/plan change."""
+        def step(params, poolK, poolV, toks, pageTable, pos, start):
+            return self._paged_step_math(params, poolK, poolV, toks,
+                                         pageTable, pos, start)
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def buildPagedProposeFn(self, draftK: int):
+        """FRESH jitted paged draft proposal: k greedy tokens per slot in
+        ONE dispatch (``lax.scan`` inside the executable; k+1 steps so
+        the k-th proposal's K/V is already paged in on a full accept).
+        Same donation and fresh-identity contract as
+        :meth:`buildPagedDecodeFn`."""
+        draftK = int(draftK)
+
+        def propose(params, poolK, poolV, tok, pageTable, pos, start):
+            def body(carry, _):
+                poolK, poolV, tok, pos = carry
+                greedy, poolK, poolV = self._paged_step_math(
+                    params, poolK, poolV, tok[:, None], pageTable, pos,
+                    start)
+                nxt = greedy[:, 0]
+                return (poolK, poolV, nxt, pos + 1), nxt
+            (poolK, poolV, _, _), props = jax.lax.scan(
+                body, (poolK, poolV, tok, pos), None, length=draftK + 1)
+            return jnp.transpose(props)[:, :draftK], poolK, poolV
+        return jax.jit(propose, donate_argnums=(1, 2))
+
+    def buildPagedPrefillWriteFn(self):
+        """FRESH jitted pool write: copy one sequence's stacked prefill
+        K/V ((L, h, Tp, d), Tp a page multiple) into the pages named by
+        ``pageIds`` ((Tp/pageSize,) int32).  One cache entry per prompt
+        bucket (warmed at start)."""
+        def write(poolK, poolV, kStack, vStack, pageIds):
+            L, h, Tp, d = kStack.shape
+            ps = poolK.shape[3]
+            nP = Tp // ps
+            kPages = kStack.reshape(L, h, nP, ps, d).transpose(
+                0, 2, 1, 3, 4)
+            vPages = vStack.reshape(L, h, nP, ps, d).transpose(
+                0, 2, 1, 3, 4)
+            poolK = poolK.at[:, pageIds].set(kPages.astype(poolK.dtype))
+            poolV = poolV.at[:, pageIds].set(vPages.astype(poolV.dtype))
+            return poolK, poolV
+        return jax.jit(write, donate_argnums=(0, 1))
+
     def compileCacheSize(self) -> int:
-        """Total jit-cache entries across the forward/prefill/decode
-        executables — the serving tier's compile hit/miss probe."""
+        """Total jit-cache entries across the forward/prefill/decode/
+        verify/propose executables — the serving tier's compile hit/miss
+        probe."""
         n = 0
-        for name in ("_fwd", "_prefillFn", "_decodeFn"):
-            fn = self.__dict__.get(name)
+        fns = [self.__dict__.get(name)
+               for name in ("_fwd", "_prefillFn", "_decodeFn",
+                            "_verifyFn", "_prefillRawFn")]
+        fns.extend(self.__dict__.get("_proposeFns", {}).values())
+        for fn in fns:
             if fn is not None:
                 try:
                     n += int(fn._cache_size())

@@ -25,7 +25,7 @@ from deeplearning4j_tpu.nn.weights import init_weight
 
 __all__ = ["SelfAttentionLayer", "LearnedSelfAttentionLayer",
            "RecurrentAttentionLayer", "KerasMultiHeadAttention",
-           "KVCache", "cached_attention"]
+           "KVCache", "cached_attention", "paged_attention"]
 
 
 def _mha(x_btn, Wq, Wk, Wv, Wo, nHeads, mask=None, q_btn=None, impl="auto",
@@ -120,6 +120,62 @@ def cached_attention(qh, kh_new, vh_new, cache: KVCache):
     w = jax.nn.softmax(s, axis=-1)
     ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(qh.dtype))
     return ctx, KVCache(k, v, pos + tq, cache.start)
+
+
+def paged_attention(qh, kh_new, vh_new, poolK, poolV, pageTable, pos,
+                    start):
+    """Causal attention of ``tq`` new positions against a PAGED KV pool.
+
+    Where :func:`cached_attention` owns a private fixed-capacity buffer
+    per batch, this is the pooled variant the continuous-batching
+    scheduler (``remote/scheduler.py``) decodes through: K/V live in a
+    shared pool of fixed-size pages and each decode SLOT addresses its
+    own pages through a page table, so sequences of wildly different
+    lengths share one preallocated buffer and admitting/retiring a
+    sequence is a host-side page-table edit — never a reallocation, and
+    never a new executable shape.
+
+    - ``qh``/``kh_new``/``vh_new``: (slots, heads, tq, headSize) for the
+      new positions only;
+    - ``poolK``/``poolV``: (numPages, heads, pageSize, headSize) — ONE
+      layer's shared page pool (page 0 is the scratch page inactive
+      slots write into);
+    - ``pageTable``: (slots, maxPagesPerSeq) int32 physical page ids in
+      logical order (unallocated tail entries point at the scratch
+      page and are masked out by ``pos``);
+    - ``pos``/``start``: (slots,) int32 — next write index and first
+      valid key index per slot (identical semantics to
+      ``KVCache.pos``/``KVCache.start``, but per slot instead of per
+      batch).
+
+    Writes the new K/V into their pages (``tq`` may span a page
+    boundary — each token's page/offset is computed independently),
+    gathers every slot's pages back in logical order and attends with
+    the same validity mask as :func:`cached_attention` (key index
+    within ``[start[s], pos[s]+i]`` for query ``i``).  Returns
+    ``(ctx, newPoolK, newPoolV)``.
+    """
+    S, h, tq, d = qh.shape
+    pageSize = poolK.shape[2]
+    wpos = pos[:, None] + jnp.arange(tq, dtype=jnp.int32)[None, :]
+    phys = jnp.take_along_axis(pageTable, wpos // pageSize, axis=1)
+    off = wpos % pageSize                                    # (S, tq)
+    poolK = poolK.at[phys, :, off, :].set(
+        kh_new.transpose(0, 2, 1, 3).astype(poolK.dtype))
+    poolV = poolV.at[phys, :, off, :].set(
+        vh_new.transpose(0, 2, 1, 3).astype(poolV.dtype))
+    cap = pageTable.shape[1] * pageSize
+    k = poolK[pageTable].transpose(0, 2, 1, 3, 4).reshape(S, h, cap, d)
+    v = poolV[pageTable].transpose(0, 2, 1, 3, 4).reshape(S, h, cap, d)
+    kpos = jnp.arange(cap, dtype=jnp.int32)
+    valid = (kpos[None, None, :] <= wpos[:, :, None]) & \
+        (kpos[None, None, :] >= start[:, None, None])        # (S, tq, cap)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, k.astype(qh.dtype))
+    s = s * (1.0 / jnp.sqrt(jnp.asarray(d, s.dtype)))
+    s = jnp.where(valid[:, None], s, jnp.asarray(-1e30, s.dtype))
+    w = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(qh.dtype))
+    return ctx, poolK, poolV
 
 
 @dataclasses.dataclass

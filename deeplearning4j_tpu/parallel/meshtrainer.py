@@ -51,7 +51,7 @@ from deeplearning4j_tpu.parallel.mesh import (DeviceMesh, activate_mesh,
 from deeplearning4j_tpu.parallel.zero import _leaf_spec
 
 __all__ = ["ShardingPlan", "MeshTrainer", "active_plan", "activate_plan",
-           "reshard_tree"]
+           "reshard_tree", "apply_inference_plan", "place_replica"]
 
 
 def _identity(tree):
@@ -97,6 +97,66 @@ def reshard_tree(tree, shardings):
                 # inputs, odd layouts) still reshards correctly below
                 pass
     return jax.device_put(tree, shardings)
+
+
+#: executables a raw-params model (TransformerLM-style) caches in its
+#: __dict__ — every inference-mode re-placement must pop these: JAX's
+#: jaxpr cache keys on function identity + avals (NOT shardings), so a
+#: reused closure would resurrect the previous placement's trace
+_INFERENCE_CACHE_KEYS = ("_fwd", "_prefillFn", "_prefillRawFn",
+                         "_decodeFn", "_verifyFn", "_proposeFns",
+                         "_outputFn", "_scoreFn", "_trainStep")
+
+
+def _pop_inference_caches(model) -> None:
+    for k in _INFERENCE_CACHE_KEYS:
+        model.__dict__.pop(k, None)
+
+
+def apply_inference_plan(model, plan: "ShardingPlan",
+                         tensorParallel: Optional[bool] = None):
+    """Inference-mode plan application — the serving tier's TP replica
+    path (ROADMAP item 1): place a raw-params model's weight pytree
+    (``model.params``, TransformerLM-style) onto ``plan``'s mesh and
+    drop its cached executables so the next dispatch traces against the
+    new placement.
+
+    Under tensor parallelism every 2D weight whose last dim divides the
+    model axis column-shards (the serving analogue of the training TP
+    rule); everything else replicates.  Committed input shardings are
+    all GSPMD needs — the jitted prefill/decode executables partition
+    themselves and insert the collectives, so a model too big for one
+    chip serves over several with no code change above this call.
+    ``tensorParallel`` overrides the plan's flag (a small DRAFT model
+    riding a TP mesh replicates instead).  Returns the model.
+    """
+    tp = plan.tensorParallel if tensorParallel is None \
+        else bool(tensorParallel)
+    jmesh = plan.mesh.mesh
+    msize = plan.mesh.modelSize
+    axis = plan.modelAxis
+
+    def sh(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if tp and msize > 1 and len(shape) == 2 and shape[1] % msize == 0:
+            return NamedSharding(jmesh, P(None, axis))
+        return NamedSharding(jmesh, P())
+
+    model.params = jax.device_put(model.params,
+                                  jax.tree.map(sh, model.params))
+    _pop_inference_caches(model)
+    return model
+
+
+def place_replica(model, device):
+    """DP replica placement: pin a raw-params model's weights to ONE
+    device (its executables then dispatch entirely on that chip — the
+    small-model fan-out where each replica owns a whole copy) and drop
+    cached executables.  Returns the model."""
+    model.params = jax.device_put(
+        model.params, jax.sharding.SingleDeviceSharding(device))
+    _pop_inference_caches(model)
+    return model
 
 
 #: the ShardingPlan the enclosing MeshTrainer step is compiling against —

@@ -1,0 +1,1129 @@
+"""Iteration-level continuous batching: paged KV-cache pool, admit/retire
+scheduler, speculative decode, and replica fan-out.
+
+PR 8's :class:`~deeplearning4j_tpu.remote.serving.BucketedExecutor` runs a
+whole ``generate()`` per coalesced group — one slow long prompt holds its
+batch hostage and occupancy collapses under ragged arrivals (ROADMAP
+item 1).  This module schedules at the DECODE-STEP boundary instead, the
+way ``SharedTrainingMaster``'s gradient sharing kept every training
+replica busy:
+
+- :class:`KVCachePool` — fixed-size pages over ONE preallocated device
+  buffer per model, with per-slot page tables.  Admitting or retiring a
+  sequence is a host-side free-list edit; the decode executable's shapes
+  (slots x page-table width x pool) never change, so churn never
+  re-traces (``nn/conf/attention.py paged_attention`` is the device-side
+  math).
+- :class:`ContinuousBatcher` — the iteration-level scheduler: a fixed
+  slot array steps through ONE shared decode executable; finished
+  sequences retire and queued ones admit BETWEEN steps (strict-FIFO
+  admission, so no request starves behind later arrivals), each new
+  token streams back to the waiting client as its step completes, and a
+  pool squeeze preempts the youngest slot (restart-with-skip) instead of
+  wedging.  With a small draft :class:`~deeplearning4j_tpu.nlp.
+  transformer.TransformerLM` attached, every step becomes a speculative
+  round: the draft proposes ``draftK`` tokens in one fused scan, the
+  target verifies all of them in ONE batched forward, and the
+  accept-prefix rule keeps the output BIT-IDENTICAL to target-only
+  greedy decode — between 1 and draftK+1 tokens for two dispatches.
+- :class:`ReplicaSet` — fan-out behind one
+  :class:`~deeplearning4j_tpu.remote.serving.ModelRegistry` route:
+  each replica is its own executor whose weights are placed by
+  ``parallel.meshtrainer.apply_inference_plan`` (TP-serve a model
+  partitioned over several chips, per arXiv:2004.13336's sharded-state
+  discipline) or ``place_replica`` (DP-serve small ones, one chip
+  each); requests route to the least-loaded replica, and
+  ``armAutoscale`` scales the set one replica up/down on the
+  ``serving_queue_depth`` alert's firing/resolved edges.
+
+Compile discipline: every executable (per-bucket prefill + pool write,
+the tq=1 decode step, the tq=draftK+1 verify step, the draft's proposal
+scan) is warmed at ``start()``; admit/retire churn in steady state must
+hold the jit-miss counter FLAT.  Pool or plan changes pop every cached
+step fn and rebuild fresh closures — JAX's jaxpr cache keys on function
+identity + avals, so a reused closure could resurrect the old layout's
+traced constraints.
+"""
+from __future__ import annotations
+
+import queue as _stdqueue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.remote.serving import (AdmissionControl,
+                                               BucketLadder,
+                                               ServiceOverloaded)
+from deeplearning4j_tpu.telemetry import ThresholdRule, serving_metrics
+
+__all__ = ["KVCachePool", "ContinuousBatcher", "ReplicaSet"]
+
+
+class KVCachePool:
+    """Paged KV memory for one model: ``(nLayers, numPages, nHeads,
+    pageSize, headSize)`` device buffers plus a host-side free list and
+    per-slot page tables.
+
+    Page 0 is the SCRATCH page: inactive slots' table entries point at
+    it, so the fixed-shape decode step can write their (ignored) K/V
+    somewhere harmless without a gather/scatter shape ever depending on
+    how many slots are live.  ``ensure``/``release`` are plain list
+    edits — allocation never reallocates device memory and never changes
+    an executable shape.
+    """
+
+    def __init__(self, nLayers: int, nHeads: int, headSize: int,
+                 pageSize: int = 8, numPages: int = 64, maxSlots: int = 4,
+                 maxPagesPerSeq: int = 8, dtype=jnp.float32,
+                 sharding=None):
+        self.pageSize = int(pageSize)
+        self.numPages = int(numPages)
+        self.maxSlots = int(maxSlots)
+        self.maxPagesPerSeq = int(maxPagesPerSeq)
+        if self.numPages < self.maxPagesPerSeq + 1:
+            # invariant the preemption path relies on: a LONE sequence
+            # always fits once everything else is evicted
+            raise ValueError(
+                f"numPages={self.numPages} must exceed maxPagesPerSeq="
+                f"{self.maxPagesPerSeq} (page 0 is reserved scratch)")
+        k = jnp.zeros((int(nLayers), self.numPages, int(nHeads),
+                       self.pageSize, int(headSize)), dtype)
+        v = jnp.zeros_like(k)
+        if sharding is not None:
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        self.k, self.v = k, v
+        self.pageTable = np.zeros((self.maxSlots, self.maxPagesPerSeq),
+                                  np.int32)
+        self._free = deque(range(1, self.numPages))
+        self._held: List[List[int]] = [[] for _ in range(self.maxSlots)]
+
+    def freePages(self) -> int:
+        return len(self._free)
+
+    def usedPages(self) -> int:
+        return (self.numPages - 1) - len(self._free)
+
+    def pagesFor(self, tokens: int) -> int:
+        # jaxlint: disable=host-sync -- token counts are Python ints (host bookkeeping), never device scalars
+        return -(-int(tokens) // self.pageSize)
+
+    def capacityTokens(self) -> int:
+        return self.maxPagesPerSeq * self.pageSize
+
+    def heldIds(self, slot: int) -> List[int]:
+        return list(self._held[slot])
+
+    def ensure(self, slot: int, upTo: int) -> bool:
+        """Grow ``slot``'s allocation to cover positions ``[0, upTo)``.
+        False when the free list (or the per-sequence table width)
+        can't — the scheduler then preempts or defers."""
+        want = self.pagesFor(upTo)
+        if want > self.maxPagesPerSeq:
+            return False
+        held = self._held[slot]
+        need = want - len(held)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            pid = self._free.popleft()
+            self.pageTable[slot, len(held)] = pid
+            held.append(pid)
+        return True
+
+    def release(self, slot: int) -> int:
+        """Free every page ``slot`` holds; returns how many."""
+        held = self._held[slot]
+        n = len(held)
+        self._free.extend(held)
+        held.clear()
+        self.pageTable[slot, :] = 0
+        return n
+
+
+class _Pending:
+    """One client request: its rows fan out to sequences; results
+    reassemble when the last row retires."""
+    __slots__ = ("rows", "quota", "doneRows", "error", "event", "t0")
+
+    def __init__(self, rows: int, quota: int):
+        self.rows = int(rows)
+        self.quota = int(quota)
+        self.doneRows = 0
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+        self.t0 = time.perf_counter()
+
+
+class _Seq:
+    """One sequence of a request: queued, then bound to a decode slot."""
+    __slots__ = ("tokens", "realLen", "bucket", "quota", "pages", "parent",
+                 "row", "emitted", "streamQ", "streamed", "streamSkip",
+                 "cancelled", "restarts")
+
+    def __init__(self, tokens: np.ndarray, bucket: int, quota: int,
+                 pages: int, parent: _Pending, row: int):
+        self.tokens = tokens            # (1, realLen) int32
+        self.realLen = int(tokens.shape[1])
+        self.bucket = int(bucket)
+        self.quota = int(quota)
+        self.pages = int(pages)
+        self.parent = parent
+        self.row = int(row)
+        self.emitted: List[int] = []
+        self.streamQ: Optional[_stdqueue.Queue] = None
+        self.streamed = 0               # tokens pushed to the stream, ever
+        self.streamSkip = 0             # re-emissions to swallow after a preempt
+        self.cancelled = False
+        self.restarts = 0
+
+
+class ContinuousBatcher:
+    """The iteration-level scheduler: one shared fixed-slot decode batch,
+    admit/retire between steps, token streaming, optional speculative
+    decode, paged KV memory.
+
+    Registry-compatible executor surface (``start``/``submit``/
+    ``submitStream``/``queuedRows``/``shutdown``), so it hosts behind
+    ``POST /v1/serving/<name>`` exactly like a
+    :class:`~deeplearning4j_tpu.remote.serving.BucketedExecutor` —
+    ``{"tokens": [...], "maxNewTokens": n}`` payloads, plus
+    ``{"stream": true}`` for per-token NDJSON streaming.
+    """
+
+    def __init__(self, lm, name: str = "default", draft=None,
+                 draftK: int = 4, pageSize: int = 8,
+                 numPages: Optional[int] = None, maxSlots: int = 4,
+                 ladder: Optional[BucketLadder] = None,
+                 admission: Optional[AdmissionControl] = None,
+                 eosToken: Optional[int] = None, plan=None, device=None):
+        self.lm = lm
+        self.draft = draft
+        self.draftK = int(draftK) if draft is not None else 0
+        if draft is not None:
+            if self.draftK < 1:
+                raise ValueError("draftK must be >= 1 with a draft model")
+            if draft.config.vocabSize != lm.config.vocabSize:
+                raise ValueError("draft and target must share a vocabulary")
+        self.name = str(name)
+        cfg = lm.config
+        self.pageSize = int(pageSize)
+        self._maxPagesPerSeq = -(-(cfg.maxLen + self.draftK)
+                                 // self.pageSize)
+        self._numPages = int(numPages) if numPages is not None else \
+            1 + int(maxSlots) * self._maxPagesPerSeq
+        self.maxSlots = int(maxSlots)
+        self.eosToken = int(eosToken) if eosToken is not None else None
+        self.admission = admission or AdmissionControl()
+        # the SMALLER cache bounds every admissible position when a
+        # draft rides along (both models ingest the same prompt)
+        effCap = cfg.maxLen if draft is None \
+            else min(cfg.maxLen, draft.config.maxLen)
+        if ladder is None:
+            ladder = BucketLadder(
+                batchSizes=(self.maxSlots,),
+                seqLens=tuple(
+                    s for s in (16, 32, 64, 128, 256, 512, 1024)
+                    if s <= max(effCap // 2, self.pageSize)
+                    and s % self.pageSize == 0) or (self.pageSize,))
+        for s in ladder.seqLens:
+            if s % self.pageSize:
+                raise ValueError(
+                    f"prompt bucket {s} is not a multiple of the page "
+                    f"size {self.pageSize} (prefill copies whole pages)")
+            if s >= effCap:
+                raise ValueError(
+                    f"prompt bucket {s} leaves no room to generate "
+                    f"within the capacity {effCap}"
+                    + (" (bounded by the draft model)"
+                       if draft is not None and
+                       draft.config.maxLen < cfg.maxLen else ""))
+        self.ladder = ladder
+        self.plan = None
+        self._device = device
+        # slot state — owned by the loop thread
+        self._slotSeq: List[Optional[_Seq]] = [None] * self.maxSlots
+        self._pos = np.zeros(self.maxSlots, np.int32)
+        self._start = np.zeros(self.maxSlots, np.int32)
+        self._tok = np.zeros(self.maxSlots, np.int32)
+        self._admitOrder: deque = deque()   # slots, oldest admission first
+        # request queue — guarded by _cv
+        self._queue: deque = deque()
+        self._queuedRows = 0
+        self._queuedPages = 0
+        self._cv = threading.Condition()
+        # request completion bookkeeping crosses threads (loop retires,
+        # shutdown drains) — its own lock, never held with _cv
+        self._finishLock = threading.Lock()
+        self._running = False
+        self._warmed = False
+        self._thread: Optional[threading.Thread] = None
+        self._retireLog: deque = deque(maxlen=64)   # (ts, pages freed)
+        self._stepFns: Dict[str, object] = {}
+        self._cacheSeen: Optional[int] = None
+        self._busySteps = 0.0
+        self._steps = 0
+        if plan is not None:
+            self.applyPlan(plan)            # shards params, builds pools
+        else:
+            if device is not None:
+                from deeplearning4j_tpu.parallel.meshtrainer import \
+                    place_replica
+                place_replica(lm, device)
+                if draft is not None:
+                    place_replica(draft, device)
+            self._buildPools()
+
+    # -- placement ------------------------------------------------------
+    def _poolSharding(self, nHeads: int):
+        if self.plan is None:
+            if self._device is not None:
+                return jax.sharding.SingleDeviceSharding(self._device)
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.plan.mesh
+        if mesh.modelSize > 1 and nHeads % mesh.modelSize == 0:
+            # pool heads live with their TP-sharded projection columns
+            return NamedSharding(mesh.mesh, P(None, None,
+                                              self.plan.modelAxis))
+        return NamedSharding(mesh.mesh, P())
+
+    def _buildPools(self) -> None:
+        cfg = self.lm.config
+        self.pool = KVCachePool(
+            cfg.nLayers, cfg.nHeads, cfg.headSize, self.pageSize,
+            self._numPages, self.maxSlots, self._maxPagesPerSeq,
+            sharding=self._poolSharding(cfg.nHeads))
+        if self.draft is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dc = self.draft.config
+            # the draft replicates on a TP mesh (its params do too)
+            dsh = NamedSharding(self.plan.mesh.mesh, P()) \
+                if self.plan is not None else self._poolSharding(dc.nHeads)
+            self.draftPool = KVCachePool(
+                dc.nLayers, dc.nHeads, dc.headSize, self.pageSize,
+                self._numPages, self.maxSlots, self._maxPagesPerSeq,
+                sharding=dsh)
+        else:
+            self.draftPool = None
+
+    def applyPlan(self, plan) -> None:
+        """Inference-mode :class:`~deeplearning4j_tpu.parallel.
+        meshtrainer.ShardingPlan` application — the TP replica path:
+        shard the target's weights over the plan's model axis, replicate
+        the draft's, rebuild both pools ON the mesh, and pop every
+        cached step executable so the next warm traces fresh closures
+        against the new placement."""
+        from deeplearning4j_tpu.parallel.meshtrainer import \
+            apply_inference_plan
+        apply_inference_plan(self.lm, plan)
+        if self.draft is not None:
+            apply_inference_plan(self.draft, plan, tensorParallel=False)
+        self.plan = plan
+        self._buildPools()
+        self._invalidateFns()
+
+    # -- executables ----------------------------------------------------
+    def _invalidateFns(self) -> None:
+        """Pool or plan changed: drop every cached step fn (and the
+        models' cached jits) so nothing re-dispatches a trace whose
+        constraints belong to the old layout."""
+        self._stepFns.clear()
+        for m in (self.lm, self.draft):
+            if m is None:
+                continue
+            for k in ("_fwd", "_prefillFn", "_prefillRawFn", "_decodeFn",
+                      "_verifyFn", "_proposeFns"):
+                m.__dict__.pop(k, None)
+        self._warmed = False
+        self._cacheSeen = None
+
+    def _ensureFns(self) -> None:
+        if "step" in self._stepFns:
+            return
+        self._stepFns["step"] = self.lm.buildPagedDecodeFn()
+        self._stepFns["write"] = self.lm.buildPagedPrefillWriteFn()
+        if self.draft is not None:
+            self._stepFns["propose"] = \
+                self.draft.buildPagedProposeFn(self.draftK)
+            self._stepFns["dwrite"] = self.draft.buildPagedPrefillWriteFn()
+
+    def compileCacheSize(self) -> int:
+        """Executable-cache entries across every model and scheduler fn
+        — the flat-across-churn acceptance probe."""
+        n = self.lm.compileCacheSize()
+        if self.draft is not None:
+            n += self.draft.compileCacheSize()
+        for fn in self._stepFns.values():
+            try:
+                n += int(fn._cache_size())
+            except Exception:
+                pass
+        return n
+
+    def warm(self) -> float:
+        """Compile every steady-state executable BEFORE traffic: one
+        prefill + pool write per prompt bucket (scratch pages take the
+        dummy writes), the tq=1 decode step, and with a draft the
+        tq=draftK+1 verify plus the proposal scan."""
+        if self._warmed:
+            return 0.0
+        sm = serving_metrics()
+        t0 = time.perf_counter()
+        before = self.compileCacheSize()
+        self._ensureFns()
+        S = self.maxSlots
+        zeros = jnp.zeros(S, jnp.int32)
+        pt = jnp.asarray(self.pool.pageTable)
+        step = self._stepFns["step"]
+        g, self.pool.k, self.pool.v = step(
+            self.lm.params, self.pool.k, self.pool.v,
+            jnp.zeros((S, 1), jnp.int32), pt, zeros, zeros)
+        if self.draft is not None:
+            g, self.pool.k, self.pool.v = step(
+                self.lm.params, self.pool.k, self.pool.v,
+                jnp.zeros((S, self.draftK + 1), jnp.int32), pt, zeros,
+                zeros)
+            dpt = jnp.asarray(self.draftPool.pageTable)
+            _p, self.draftPool.k, self.draftPool.v = \
+                self._stepFns["propose"](
+                    self.draft.params, self.draftPool.k, self.draftPool.v,
+                    zeros, dpt, zeros, zeros)
+        for Tp in self.ladder.seqLens:
+            dummy = np.zeros((1, Tp), np.int32)
+            ids = jnp.zeros(Tp // self.pageSize, jnp.int32)   # scratch
+            logits, ks, vs = self.lm.prefillRaw(dummy, lengths=[1])
+            self.pool.k, self.pool.v = self._stepFns["write"](
+                self.pool.k, self.pool.v, ks[:, 0], vs[:, 0], ids)
+            if self.draft is not None:
+                _l, dks, dvs = self.draft.prefillRaw(dummy, lengths=[1])
+                self.draftPool.k, self.draftPool.v = \
+                    self._stepFns["dwrite"](
+                        self.draftPool.k, self.draftPool.v,
+                        dks[:, 0], dvs[:, 0], ids)
+        jax.block_until_ready(self.pool.k)  # jaxlint: sync-ok -- warm-up fence: compile cost must land in warmup_seconds, not the first request
+        self._warmed = True
+        dt = time.perf_counter() - t0
+        sm.warmup_seconds().observe(dt, model=self.name)
+        sm.warmup_compiles().inc(max(0, self.compileCacheSize() - before),
+                                 model=self.name)
+        return dt
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        if self._running:
+            return self
+        sm = serving_metrics()
+        self.admission.bind(self.name)
+        sm.queue_depth().set(0, model=self.name)
+        sm.compile_hits().inc(0, model=self.name)
+        sm.compile_misses().inc(0, model=self.name)
+        self.warm()
+        self._updatePageGauges()
+        self._cacheSeen = self.compileCacheSize()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"cbatch-{self.name}")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        err = RuntimeError(f"continuous batcher {self.name!r} shut down")
+        with self._cv:
+            if not self._running:
+                return
+            self._running = False
+            drained = list(self._queue)
+            self._queue.clear()
+            self._queuedRows = 0
+            self._queuedPages = 0
+            self._cv.notify_all()
+        # registry/metric locks are only ever taken AFTER _cv is released
+        # (one scheduler -> registry lock order on every path)
+        for seq in drained:
+            self._finishSeq(seq, err)
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # the loop has exited: slot state is safe to touch from here
+        for slot, seq in enumerate(self._slotSeq):
+            if seq is not None:
+                self._retireSlot(slot, error=err)
+        serving_metrics().queue_depth().set(0, model=self.name)
+
+    def busy(self) -> bool:
+        return any(s is not None for s in self._slotSeq)
+
+    def queuedRows(self) -> int:
+        with self._cv:
+            return self._queuedRows
+
+    def occupancy(self) -> Optional[float]:
+        """Mean active-slots fraction over every decode step so far."""
+        return self._busySteps / self._steps if self._steps else None
+
+    # -- request path ---------------------------------------------------
+    def _makeSeqs(self, payload) -> Tuple[List[_Seq], _Pending]:
+        """Validate and split one request into per-row sequences.  Every
+        condition that could wedge or poison the shared decode batch is
+        rejected HERE (HTTP 400), never mid-flight: prompts above the
+        top bucket, quotas past the positional capacity, and quotas
+        whose pages can never fit the per-sequence KV budget."""
+        if not isinstance(payload, dict) or "tokens" not in payload:
+            raise ValueError('generative request needs {"tokens": [...]}')
+        # jaxlint: sync-ok -- request decode: token ids arrive as host JSON
+        toks = np.asarray(payload["tokens"], np.int32)
+        if toks.ndim == 1:
+            toks = toks[None, :]
+        if toks.ndim != 2 or toks.shape[0] < 1 or toks.shape[1] < 1:
+            raise ValueError(
+                f"tokens must be (t,) or (b, t) with b >= 1 and t >= 1; "
+                f"got shape {toks.shape}")
+        vocab = self.lm.config.vocabSize
+        if toks.min() < 0 or toks.max() >= vocab:
+            raise ValueError(f"token ids must be in [0, {vocab})")
+        n = int(payload.get("maxNewTokens", 16))
+        if n < 1:
+            raise ValueError("maxNewTokens must be >= 1")
+        Tp = self.ladder.seqBucket(toks.shape[1])    # 400 above top bucket
+        cap = self.lm.config.maxLen
+        if self.draft is not None:
+            # the draft ingests the same positions — the SMALLER cache
+            # bounds what is admissible (reject here, not on the loop
+            # thread inside draft.prefillRaw)
+            cap = min(cap, self.draft.config.maxLen)
+        if Tp + n > cap:
+            raise ValueError(
+                f"prompt bucket {Tp} + maxNewTokens {n} exceeds the "
+                f"positional capacity {cap}"
+                + (" (bounded by the draft model)" if self.draft is not None
+                   and self.draft.config.maxLen < self.lm.config.maxLen
+                   else ""))
+        pages = self.pool.pagesFor(Tp + n + self.draftK)
+        if pages > self.pool.maxPagesPerSeq:
+            raise ValueError(
+                f"prompt bucket {Tp} + maxNewTokens {n} can never fit "
+                f"the KV page budget ({pages} pages > "
+                f"{self.pool.maxPagesPerSeq} per sequence)")
+        parent = _Pending(toks.shape[0], n)
+        seqs = [_Seq(toks[i:i + 1], Tp, n, pages, parent, i)
+                for i in range(toks.shape[0])]
+        return seqs, parent
+
+    def _admitGate(self, rows: int, pages: int) -> None:
+        sm = serving_metrics()
+        queued = self.queuedRows()
+        sm.queue_depth().set(queued, model=self.name)
+        fired = self.admission.check(queued)
+        retryAfter = self.admission.retryAfter
+        if fired is None:
+            # page-headroom shed is about WEDGE risk, not backlog: a
+            # queued sequence holds no pages, so only a request that
+            # cannot fit the CURRENT free list sheds (backlog depth is
+            # the queue-depth rule's job)
+            kv = self.admission.checkKv(self.pool.freePages(), pages,
+                                        self._retireRate())
+            if kv is not None:
+                fired, retryAfter = kv[:2], kv[2]
+        if fired is not None:
+            rule, detail = fired
+            sm.shed().inc(model=self.name, rule=rule)
+            sm.requests().inc(model=self.name, outcome="shed")
+            raise ServiceOverloaded(detail, retryAfter)
+
+    def _enqueue(self, seqs: Sequence[_Seq]) -> None:
+        with self._cv:
+            if not self._running:
+                raise RuntimeError(
+                    f"continuous batcher {self.name!r} is not running")
+            for s in seqs:
+                self._queue.append(s)
+            self._queuedRows += len(seqs)
+            self._queuedPages += sum(s.pages for s in seqs)
+            depth = self._queuedRows
+            self._cv.notify()
+        serving_metrics().queue_depth().set(depth, model=self.name)
+
+    def submit(self, payload, timeout: Optional[float] = None):
+        """Validate, admit, enqueue, block until every row finished.
+        Returns (b, maxNewTokens) int32 (rows that hit ``eosToken``
+        early are padded with it).  Raises ``ValueError`` (HTTP 400) for
+        malformed payloads, :class:`ServiceOverloaded` (429) when
+        admission sheds."""
+        seqs, parent = self._makeSeqs(payload)
+        self._admitGate(len(seqs), sum(s.pages for s in seqs))
+        self._enqueue(seqs)
+        if not parent.event.wait(timeout):
+            # reap still-QUEUED rows now — left behind they would keep
+            # inflating _queuedRows (phantom backlog shedding live
+            # traffic) until each crawled to the FIFO head; rows already
+            # in a slot retire at the loop's next boundary
+            depth = None
+            with self._cv:
+                for s in seqs:
+                    s.cancelled = True
+                    if s in self._queue:
+                        self._queue.remove(s)
+                        self._queuedRows -= 1
+                        self._queuedPages -= s.pages
+                depth = self._queuedRows
+                self._cv.notify()
+            serving_metrics().queue_depth().set(depth, model=self.name)
+            raise TimeoutError(
+                f"continuous-batching request timed out after {timeout}s")
+        if parent.error is not None:
+            raise parent.error
+        pad = self.eosToken if self.eosToken is not None else 0
+        out = np.full((parent.rows, parent.quota), pad, np.int32)
+        for s in seqs:
+            # jaxlint: sync-ok -- response assembly from host-side emitted-token lists (already D2H'd per step)
+            row = np.asarray(s.emitted[:parent.quota], np.int32)
+            out[s.row, :len(row)] = row
+        return out
+
+    def submitStream(self, payload):
+        """Single-sequence streaming submit: validates + enqueues NOW
+        (so 400/429 surface before any token), returns a generator
+        yielding each token as its decode step completes.  Closing the
+        generator early cancels the sequence at the next step
+        boundary."""
+        seqs, parent = self._makeSeqs(payload)
+        if len(seqs) != 1:
+            raise ValueError("streaming serves a single sequence per "
+                             "request")
+        seq = seqs[0]
+        seq.streamQ = _stdqueue.Queue()
+        self._admitGate(1, seq.pages)
+        self._enqueue(seqs)
+
+        def gen():
+            try:
+                while True:
+                    item = seq.streamQ.get()
+                    if item is None:
+                        return
+                    if isinstance(item, BaseException):
+                        raise item
+                    # jaxlint: disable=host-sync -- stream items are host ints pushed by _emit
+                    yield int(item)
+            finally:
+                if not seq.parent.event.is_set():
+                    seq.cancelled = True
+        return gen()
+
+    def _retireRate(self) -> float:
+        """Mean page-retire rate (pages/sec) over the recent retire log
+        — the denominator of the KV-headroom Retry-After."""
+        log = list(self._retireLog)
+        if len(log) < 2:
+            return 0.0
+        dt = log[-1][0] - log[0][0]
+        if dt <= 0:
+            return 0.0
+        return sum(p for _, p in log[1:]) / dt
+
+    # -- scheduler loop -------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and self._queuedRows == 0 and \
+                        not any(s is not None for s in self._slotSeq):
+                    self._cv.wait(0.1)
+                if not self._running:
+                    return
+            try:
+                if not self._warmed:
+                    # a prior failure rebuilt the pools: re-warm before
+                    # serving (fresh fns against the fresh buffers)
+                    self.warm()
+                    self._cacheSeen = self.compileCacheSize()
+                self._admit()
+                if any(s is not None for s in self._slotSeq):
+                    self._stepOnce()
+            except Exception as e:
+                # the scheduler thread must survive ANY dispatch failure
+                # (device OOM, a jit error): fail the affected work, not
+                # every future request (cf. BucketedExecutor._loop)
+                self._failBatch(e)
+
+    def _failBatch(self, error: BaseException) -> None:
+        """Last-resort recovery for a failed shared step: error every
+        active slot, then rebuild pools and step fns — a dispatch that
+        raised may already have CONSUMED the donated pool buffers, so
+        the old arrays cannot be trusted (or even alive)."""
+        for slot, seq in enumerate(self._slotSeq):
+            if seq is not None:
+                self._retireSlot(slot, error=error)
+        self._buildPools()
+        self._invalidateFns()
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue head — strict FIFO, so a large
+        request defers later arrivals instead of being starved by them;
+        admission stops when the head's prefill pages don't fit yet."""
+        while True:
+            free = next((i for i, s in enumerate(self._slotSeq)
+                         if s is None), None)
+            seq = None
+            with self._cv:
+                if not self._queue:
+                    return
+                head = self._queue[0]
+                if not head.cancelled:
+                    if free is None:
+                        return
+                    want = self.pool.pagesFor(head.bucket)
+                    if self.pool.freePages() < want or (
+                            self.draftPool is not None and
+                            self.draftPool.freePages() < want):
+                        return
+                self._queue.popleft()
+                self._queuedRows -= 1
+                self._queuedPages -= head.pages
+                depth = self._queuedRows
+                seq = head
+            serving_metrics().queue_depth().set(depth, model=self.name)
+            if seq.cancelled:
+                self._finishSeq(seq, None)
+                continue
+            try:
+                self._admitSeq(free, seq)
+            except Exception as e:
+                # an admission that blows up (bad prefill, device error)
+                # fails ITS sequence only — free whatever the slot
+                # already holds and keep admitting
+                self.pool.release(free)
+                if self.draftPool is not None:
+                    self.draftPool.release(free)
+                if self._slotSeq[free] is seq:
+                    self._retireSlot(free, error=e)
+                else:
+                    self._finishSeq(seq, e)
+
+    def _admitSeq(self, slot: int, seq: _Seq) -> None:
+        sm = serving_metrics()
+        Tp = seq.bucket
+        self.pool.ensure(slot, Tp)
+        if self.draftPool is not None:
+            self.draftPool.ensure(slot, Tp)
+        padded = seq.tokens if seq.realLen == Tp else np.concatenate(
+            [np.zeros((1, Tp - seq.realLen), np.int32), seq.tokens],
+            axis=1)
+        nP = Tp // self.pageSize
+        logits, ks, vs = self.lm.prefillRaw(padded, lengths=[seq.realLen])
+        ids = jnp.asarray(self.pool.heldIds(slot)[:nP], jnp.int32)
+        self.pool.k, self.pool.v = self._stepFns["write"](
+            self.pool.k, self.pool.v, ks[:, 0], vs[:, 0], ids)
+        if self.draft is not None:
+            _l, dks, dvs = self.draft.prefillRaw(padded,
+                                                 lengths=[seq.realLen])
+            dids = jnp.asarray(self.draftPool.heldIds(slot)[:nP],
+                               jnp.int32)
+            self.draftPool.k, self.draftPool.v = self._stepFns["dwrite"](
+                self.draftPool.k, self.draftPool.v, dks[:, 0], dvs[:, 0],
+                dids)
+        # jaxlint: sync-ok -- the prefill's greedy token seeds the host-side slot state
+        first = int(np.argmax(np.asarray(logits[0])))
+        self._slotSeq[slot] = seq
+        self._pos[slot] = Tp
+        self._start[slot] = Tp - seq.realLen
+        self._tok[slot] = first
+        self._admitOrder.append(slot)
+        sm.sequences_admitted().inc(model=self.name)
+        self._updatePageGauges()
+        if self._emit(seq, first):
+            self._retireSlot(slot)
+
+    def _emit(self, seq: _Seq, tok: int) -> bool:
+        """Deliver one token; True when the sequence is finished.  After
+        a preemption the regenerated prefix is swallowed
+        (``streamSkip``) so a streaming client never sees a token
+        twice."""
+        seq.emitted.append(tok)
+        serving_metrics().decode_tokens().inc(model=self.name)
+        if seq.streamQ is not None:
+            if seq.streamSkip > 0:
+                seq.streamSkip -= 1
+            else:
+                seq.streamQ.put(tok)
+                seq.streamed += 1
+        if len(seq.emitted) >= seq.quota:
+            return True
+        return self.eosToken is not None and tok == self.eosToken
+
+    def _stepOnce(self) -> None:
+        sm = serving_metrics()
+        tq = self.draftK + 1 if self.draft is not None else 1
+        # page growth in ADMISSION-AGE order: a slot may only preempt
+        # YOUNGER slots, and when none are left it DEFERS one step
+        # instead — the oldest sequence therefore always progresses and
+        # finishes, so a pool squeeze degrades to serial service rather
+        # than two big sequences preempting each other forever
+        deferred = set()
+        for s in list(self._admitOrder):
+            if self._slotSeq[s] is None:
+                continue
+            need = int(self._pos[s]) + tq
+            while not (self.pool.ensure(s, need) and
+                       (self.draftPool is None or
+                        self.draftPool.ensure(s, need))):
+                order = list(self._admitOrder)
+                younger = order[order.index(s) + 1:]
+                victim = next((v for v in reversed(younger)
+                               if self._slotSeq[v] is not None), None)
+                if victim is None:
+                    deferred.add(s)
+                    break
+                self._preempt(victim)
+        active = [i for i, s in enumerate(self._slotSeq)
+                  if s is not None and i not in deferred]
+        if not active:
+            return
+        if deferred:
+            # mask deferred rows onto the scratch page with zeroed
+            # state: the fixed-shape step still computes them, but their
+            # writes land in scratch and their REAL page tables / slot
+            # state stay untouched for the next round
+            ptH = self.pool.pageTable.copy()
+            posH = self._pos.copy()
+            startH = self._start.copy()
+            tokH = self._tok.copy()
+            for s in deferred:
+                ptH[s, :] = 0
+                posH[s] = startH[s] = tokH[s] = 0
+        else:
+            ptH, posH, startH, tokH = (self.pool.pageTable, self._pos,
+                                       self._start, self._tok)
+        pt = jnp.asarray(ptH)
+        pos = jnp.asarray(posH)
+        startA = jnp.asarray(startH)
+        step = self._stepFns["step"]
+        if self.draft is not None:
+            dptH = self.draftPool.pageTable
+            if deferred:
+                dptH = dptH.copy()
+                for s in deferred:
+                    dptH[s, :] = 0
+            props, self.draftPool.k, self.draftPool.v = \
+                self._stepFns["propose"](
+                    self.draft.params, self.draftPool.k, self.draftPool.v,
+                    jnp.asarray(tokH), jnp.asarray(dptH), pos, startA)
+            # jaxlint: sync-ok -- proposals route through the host to form the verify batch (accept rule is host-side)
+            propsH = np.asarray(props)
+            verifyIn = np.concatenate([tokH[:, None], propsH], axis=1)
+            greedy, self.pool.k, self.pool.v = step(
+                self.lm.params, self.pool.k, self.pool.v,
+                jnp.asarray(verifyIn), pt, pos, startA)
+        else:
+            propsH = None
+            greedy, self.pool.k, self.pool.v = step(
+                self.lm.params, self.pool.k, self.pool.v,
+                jnp.asarray(tokH[:, None]), pt, pos, startA)
+        # jaxlint: sync-ok -- greedy tokens ARE the response payload (streamed per step)
+        g = np.asarray(greedy)
+        for s in active:
+            seq = self._slotSeq[s]
+            if seq is None:
+                continue
+            if seq.cancelled:
+                self._retireSlot(s)
+                continue
+            if propsH is not None:
+                a = 0
+                while a < self.draftK and propsH[s, a] == g[s, a]:
+                    a += 1
+                newToks = g[s, :a + 1]
+                sm.draft_proposed().inc(self.draftK, model=self.name)
+                sm.draft_accepted().inc(a, model=self.name)
+            else:
+                newToks = g[s, :1]
+            done = False
+            for t in newToks:
+                # jaxlint: disable=host-sync -- newToks is the already-materialized host copy of this step's greedy tokens
+                done = self._emit(seq, int(t))
+                if done:
+                    break
+            self._pos[s] += len(newToks)
+            self._tok[s] = int(newToks[-1])
+            if done:
+                self._retireSlot(s)
+        self._steps += 1
+        self._busySteps += len(active) / self.maxSlots
+        sm.decode_steps().inc(model=self.name)
+        sm.slot_occupancy().set(len(active) / self.maxSlots,
+                                model=self.name)
+        after = self.compileCacheSize()
+        if self._cacheSeen is not None and after > self._cacheSeen:
+            sm.compile_misses().inc(after - self._cacheSeen,
+                                    model=self.name)
+            self._cacheSeen = after
+        else:
+            sm.compile_hits().inc(model=self.name)
+
+    def _preempt(self, slot: int) -> None:
+        """Evict the youngest slot to free pages: release everything it
+        holds and requeue it at the FRONT.  Greedy decode is
+        deterministic, so the restart regenerates the identical prefix;
+        ``streamSkip`` swallows the re-emissions."""
+        seq = self._slotSeq[slot]
+        freed = self.pool.release(slot)
+        if self.draftPool is not None:
+            freed += self.draftPool.release(slot)
+        self._slotSeq[slot] = None
+        self._pos[slot] = self._start[slot] = self._tok[slot] = 0
+        self._admitOrder.remove(slot)
+        seq.restarts += 1
+        seq.streamSkip = seq.streamed
+        seq.emitted = []
+        with self._cv:
+            self._queue.appendleft(seq)
+            self._queuedRows += 1
+            self._queuedPages += seq.pages
+        sm = serving_metrics()
+        sm.preemptions().inc(model=self.name)
+        self._updatePageGauges()
+
+    def _retireSlot(self, slot: int, error: Optional[BaseException] = None
+                    ) -> None:
+        seq = self._slotSeq[slot]
+        freed = self.pool.release(slot)
+        if self.draftPool is not None:
+            freed += self.draftPool.release(slot)
+        self._slotSeq[slot] = None
+        self._pos[slot] = self._start[slot] = self._tok[slot] = 0
+        if slot in self._admitOrder:
+            self._admitOrder.remove(slot)
+        self._retireLog.append((time.monotonic(), freed))
+        sm = serving_metrics()
+        sm.sequences_retired().inc(model=self.name)
+        self._updatePageGauges()
+        self._finishSeq(seq, error)
+
+    def _finishSeq(self, seq: _Seq, error: Optional[BaseException]) -> None:
+        parent = seq.parent
+        if seq.streamQ is not None:
+            seq.streamQ.put(error)          # None = clean end sentinel
+        with self._finishLock:
+            parent.doneRows += 1
+            if error is not None and parent.error is None:
+                parent.error = error
+            last = parent.doneRows >= parent.rows
+        if last:
+            sm = serving_metrics()
+            sm.request_seconds().observe(time.perf_counter() - parent.t0,
+                                         model=self.name)
+            sm.requests().inc(model=self.name,
+                              outcome="error" if parent.error else "ok")
+            parent.event.set()
+
+    def _updatePageGauges(self) -> None:
+        sm = serving_metrics()
+        sm.kv_pages_in_use().set(self.pool.usedPages(), model=self.name,
+                                 pool="target")
+        sm.kv_pages_free().set(self.pool.freePages(), model=self.name,
+                               pool="target")
+        if self.draftPool is not None:
+            sm.kv_pages_in_use().set(self.draftPool.usedPages(),
+                                     model=self.name, pool="draft")
+            sm.kv_pages_free().set(self.draftPool.freePages(),
+                                   model=self.name, pool="draft")
+
+
+class _ReplicaQueueDepthRule(ThresholdRule):
+    """``serving_queue_depth`` rule evaluating the replica set's LIVE
+    queued rows (summed across replicas) and publishing them to the
+    set-level gauge.  The gauge alone is written when a submit
+    COMPLETES — during a cold burst every submit is still blocked (and
+    streaming submits never write it), so a gauge-only rule would read
+    0 at exactly the moment the autoscaler is needed."""
+
+    def __init__(self, rs: "ReplicaSet", threshold: float):
+        super().__init__("serving_queue_depth_high",
+                         "dl4j_tpu_serving_queue_depth", ">=", threshold,
+                         model=rs.name)
+        self._rs = rs
+
+    def evaluate(self, registry, now):
+        depth = float(self._rs.queuedRows())
+        serving_metrics().queue_depth().set(depth, model=self._rs.name)
+        if depth >= self.threshold:
+            return (f"dl4j_tpu_serving_queue_depth{{model="
+                    f"{self._rs.name!r}}} = {depth:g} >= "
+                    f"{self.threshold:g} (live replica-set backlog)")
+        return None
+
+
+class ReplicaSet:
+    """Fan one registry route out over N executor replicas.
+
+    ``factory(idx)`` builds replica ``idx`` (a
+    :class:`ContinuousBatcher` or ``BucketedExecutor`` whose weights the
+    factory has already placed — ``place_replica`` for one-chip DP
+    copies, ``apply_inference_plan`` for a TP-sharded replica spanning
+    several chips).  Requests route to the least-loaded live replica.
+    ``scaleUp``/``scaleDown`` move the set by one replica;
+    :meth:`armAutoscale` wires them to the ``serving_queue_depth``
+    alert's firing/resolved edges through
+    ``HealthMonitor.registerAction`` (counted in
+    ``dl4j_tpu_health_actions_total``)."""
+
+    def __init__(self, factory, name: str = "default", replicas: int = 1,
+                 minReplicas: int = 1, maxReplicas: int = 8):
+        self._factory = factory
+        self.name = str(name)
+        self.minReplicas = max(1, int(minReplicas))
+        self.maxReplicas = max(self.minReplicas, int(maxReplicas))
+        self._initial = max(self.minReplicas, int(replicas))
+        self._replicas: List = []
+        self._nextIdx = 0
+        self._pendingAdds = 0
+        self._lock = threading.Lock()
+        self._running = False
+        self._reapers: List[threading.Thread] = []
+
+    def start(self) -> "ReplicaSet":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        while self.replicaCount() < self._initial:
+            if self._addReplica() is None:
+                break
+        return self
+
+    def _addReplica(self):
+        """Build + start one replica.  The slow factory/warm work runs
+        OUTSIDE the lock; admission into the routing set re-checks
+        ``_running``/``maxReplicas`` under it, so a racing shutdown (or
+        a second concurrent scaleUp) can never leak a live replica or
+        overshoot the cap — a replica that loses the re-check is shut
+        down, not stranded."""
+        with self._lock:
+            if not self._running or \
+                    len(self._replicas) + self._pendingAdds >= \
+                    self.maxReplicas:
+                return None
+            self._pendingAdds += 1
+            idx = self._nextIdx
+            self._nextIdx += 1
+        ex = None
+        started = False
+        try:
+            ex = self._factory(idx)
+            if getattr(ex, "name", None) in (None, "default"):
+                ex.name = f"{self.name}/{idx}"
+            ex.start()
+            started = True
+        finally:
+            with self._lock:
+                self._pendingAdds -= 1
+                admitted = started and self._running and \
+                    len(self._replicas) < self.maxReplicas
+                if admitted:
+                    self._replicas.append(ex)
+                    n = len(self._replicas)
+        if not admitted:
+            if ex is not None:
+                ex.shutdown()
+            return None
+        serving_metrics().replicas().set(n, model=self.name)
+        return ex
+
+    def replicaCount(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def scaleUp(self) -> Optional[str]:
+        """One replica up (the queue-depth alert's firing-edge
+        remediation); None when already at ``maxReplicas`` or shut
+        down."""
+        if self._addReplica() is None:
+            return None
+        return f"scaled {self.name} up to {self.replicaCount()} replicas"
+
+    def scaleDown(self) -> Optional[str]:
+        """One replica down (the resolved-edge remediation): the replica
+        leaves the routing set immediately and a reaper thread drains
+        its backlog before shutdown; None at ``minReplicas``."""
+        with self._lock:
+            if not self._running or len(self._replicas) <= self.minReplicas:
+                return None
+            ex = self._replicas.pop()       # stops routing to it NOW
+            n = len(self._replicas)
+        serving_metrics().replicas().set(n, model=self.name)
+        th = threading.Thread(target=self._drainStop, args=(ex,),
+                              daemon=True,
+                              name=f"replica-reaper-{self.name}")
+        th.start()
+        self._reapers.append(th)
+        return f"scaled {self.name} down to {n} replicas"
+
+    def _drainStop(self, ex) -> None:
+        deadline = time.monotonic() + 30.0
+        busy = getattr(ex, "busy", None)
+        while time.monotonic() < deadline and (
+                ex.queuedRows() > 0 or (busy is not None and busy())):
+            time.sleep(0.05)
+        ex.shutdown()
+
+    def _pick(self):
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"replica set {self.name!r} has no live replicas")
+            return min(self._replicas, key=lambda e: e.queuedRows())
+
+    def submit(self, payload, timeout: Optional[float] = None):
+        out = self._pick().submit(payload, timeout)
+        serving_metrics().queue_depth().set(self.queuedRows(),
+                                            model=self.name)
+        return out
+
+    def submitStream(self, payload):
+        ex = self._pick()
+        if not hasattr(ex, "submitStream"):
+            raise ValueError(
+                f"replica set {self.name!r} does not stream")
+        return ex.submitStream(payload)
+
+    def queuedRows(self) -> int:
+        with self._lock:
+            return sum(e.queuedRows() for e in self._replicas)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._running = False
+            reps, self._replicas = self._replicas, []
+        for ex in reps:
+            ex.shutdown()
+        for th in self._reapers:
+            th.join(timeout=35.0)
+        self._reapers = []
+
+    def armAutoscale(self, monitor, highQueueRows: int = 64,
+                     rule: Optional[ThresholdRule] = None) -> ThresholdRule:
+        """Wire the self-healing loop (ROADMAP item 5's serving
+        remainder): a ``serving_queue_depth`` rule on ``monitor`` whose
+        FIRING edge scales one replica up and whose RESOLVED edge
+        scales one back down.  The default rule reads the set's LIVE
+        backlog (see :class:`_ReplicaQueueDepthRule`); pass ``rule`` to
+        watch something else."""
+        rule = rule or _ReplicaQueueDepthRule(self, highQueueRows)
+        monitor.rules.append(rule)
+
+        def scale_up(_rule, _detail):
+            return self.scaleUp()
+
+        def scale_down(_rule, _detail):
+            return self.scaleDown()
+
+        monitor.registerAction(rule.name, scale_up)
+        monitor.registerAction(rule.name, scale_down, on="resolved")
+        return rule

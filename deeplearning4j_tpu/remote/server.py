@@ -45,6 +45,48 @@ def reply_safely(handler, code: int, body: bytes, ctype: str,
         handler.close_connection = True
 
 
+def stream_ndjson(handler, items, final: Optional[dict] = None) -> None:
+    """Chunked NDJSON streaming response: one JSON object per line,
+    flushed as it is produced — the serving tier's token streaming
+    (``InferenceServer`` with ``{"stream": true}``), where each decode
+    step's token reaches the client before the next step runs.
+
+    Requires the handler to speak HTTP/1.1 (chunked transfer encoding).
+    An exception out of ``items`` mid-stream cannot become an HTTP
+    status any more (headers are gone) — it is delivered as a final
+    ``{"error": ...}`` line instead.  A client hanging up mid-stream
+    stops the iteration without killing the handler thread (and without
+    consuming the rest of the generator, so the producer can cancel the
+    work — same contract as :func:`reply_safely`).
+    """
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def chunk(obj) -> None:
+            data = json.dumps(obj).encode("utf-8") + b"\n"
+            handler.wfile.write(
+                f"{len(data):x}\r\n".encode("ascii") + data + b"\r\n")
+            handler.wfile.flush()
+
+        try:
+            for obj in items:
+                chunk(obj)
+        except Exception as e:
+            chunk({"error": f"{type(e).__name__}: {e}"})
+        else:
+            if final is not None:
+                chunk(final)
+        handler.wfile.write(b"0\r\n\r\n")
+    except (BrokenPipeError, ConnectionResetError):
+        handler.close_connection = True
+        close = getattr(items, "close", None)
+        if close is not None:
+            close()                 # tell the producer to cancel
+
+
 class JsonModelServer:
     """POST /v1/serving -> {"output": [...]} (reference endpoint shape).
 

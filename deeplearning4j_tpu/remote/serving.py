@@ -132,10 +132,14 @@ class AdmissionControl:
     def __init__(self, maxQueueRows: int = 256,
                  p99Threshold: Optional[float] = None,
                  retryAfter: float = 1.0,
-                 rules: Optional[Sequence[ThresholdRule]] = None):
+                 rules: Optional[Sequence[ThresholdRule]] = None,
+                 minFreePages: int = 0,
+                 maxKvRetryAfter: float = 30.0):
         self.maxQueueRows = int(maxQueueRows)
         self.p99Threshold = p99Threshold
         self.retryAfter = float(retryAfter)
+        self.minFreePages = int(minFreePages)
+        self.maxKvRetryAfter = float(maxKvRetryAfter)
         self._extra = list(rules or [])
         self._rules: List[ThresholdRule] = []
         self._latencyRules: List[ThresholdRule] = []
@@ -172,6 +176,39 @@ class AdmissionControl:
             if detail is not None:
                 return rule.name, detail
         return None
+
+    def checkKv(self, freePages: int, neededPages: int,
+                retireRate: float) -> Optional[Tuple[str, str, float]]:
+        """KV-page headroom shed for paged executors: reject a request
+        whose pages don't fit the pool's free list (beyond the
+        ``minFreePages`` reserve) BEFORE it queues — an admitted
+        sequence that can't grow its cache preempts its neighbours, so
+        page exhaustion must degrade at the door, not wedge the batch.
+
+        Returns ``(rule, detail, retryAfter)`` or None.  The
+        ``Retry-After`` is the page DEFICIT divided by the pool's
+        observed mean retire rate (pages/sec): the client backs off for
+        roughly as long as the pool needs to free the shortfall,
+        instead of a fixed guess — clamped to
+        [``retryAfter``, ``maxKvRetryAfter``].
+        """
+        # jaxlint: disable=host-sync -- page counts and retire rates are host-side free-list bookkeeping, not device scalars
+        headroom = int(freePages) - self.minFreePages
+        needed = int(neededPages)  # jaxlint: disable=host-sync -- host page count
+        if needed <= headroom:
+            return None
+        deficit = needed - max(headroom, 0)
+        if retireRate and retireRate > 0:
+            wait = deficit / float(retireRate)  # jaxlint: disable=host-sync -- host-measured pages/sec
+        else:
+            wait = self.maxKvRetryAfter     # nothing retiring yet: back
+            # off hard rather than hammering an empty free list
+        wait = min(max(wait, self.retryAfter), self.maxKvRetryAfter)
+        return ("serving_kv_exhausted",
+                f"kv page headroom exhausted: request needs {needed} "
+                f"pages, {max(headroom, 0)} free past the "
+                f"{self.minFreePages}-page reserve (mean retire rate "
+                f"{float(retireRate):.2f} pages/s)", wait)  # jaxlint: disable=host-sync -- host-measured pages/sec
 
 
 class _Request:
@@ -224,6 +261,12 @@ class ForwardServing:
         if xv.ndim < 2:
             raise ValueError(
                 f"features must include a batch axis; got shape {xv.shape}")
+        if xv.shape[0] < 1:
+            # a zero-row request must be ITS OWN 400: coalesced into a
+            # batch it yields an empty dispatch that poisons every
+            # neighbour's request with the concat error
+            raise ValueError("features batch must contain at least one "
+                             "row")
         if self.inputShape is not None:
             want = self.inputShape
             got = xv.shape[1:]
@@ -365,9 +408,12 @@ class GenerativeServing:
         toks = np.asarray(payload["tokens"], np.int32)
         if toks.ndim == 1:
             toks = toks[None, :]
-        if toks.ndim != 2 or toks.shape[1] < 1:
-            raise ValueError(f"tokens must be (t,) or (b, t) with t >= 1; "
-                             f"got shape {toks.shape}")
+        if toks.ndim != 2 or toks.shape[0] < 1 or toks.shape[1] < 1:
+            # enqueue-time rejection (offender-only 400): a zero-row or
+            # empty prompt coalesced into a group would fail mid-dispatch
+            # and poison every neighbour's request
+            raise ValueError(f"tokens must be (t,) or (b, t) with b >= 1 "
+                             f"and t >= 1; got shape {toks.shape}")
         vocab = self.lm.config.vocabSize
         if toks.min() < 0 or toks.max() >= vocab:
             raise ValueError(f"token ids must be in [0, {vocab})")
@@ -740,10 +786,19 @@ class ModelRegistry:
 
     def register(self, name: str, serving,
                  admission: Optional[AdmissionControl] = None,
-                 workers: int = 1) -> BucketedExecutor:
+                 workers: int = 1):
         """``serving`` is a model adapter (:class:`ForwardServing` /
-        :class:`GenerativeServing`) or an already-built executor."""
+        :class:`GenerativeServing`, wrapped in a fresh
+        :class:`BucketedExecutor`) or an already-built executor-like —
+        anything with ``start``/``submit``/``shutdown`` (a
+        ``BucketedExecutor``, a continuous-batching
+        ``scheduler.ContinuousBatcher``, a ``scheduler.ReplicaSet``)
+        hosts as-is behind the route."""
         if isinstance(serving, BucketedExecutor):
+            ex = serving
+            ex.name = name
+        elif hasattr(serving, "submit") and hasattr(serving, "start") \
+                and not hasattr(serving, "makeRequest"):
             ex = serving
             ex.name = name
         else:
@@ -800,6 +855,11 @@ class InferenceServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 so token streaming can use chunked transfer
+            # encoding (every non-streaming reply carries an exact
+            # Content-Length via reply_safely, as 1.1 keep-alive needs)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
@@ -857,6 +917,25 @@ class InferenceServer:
                         body, code = {"output": np.asarray(out).tolist()}, \
                             200
                     elif "tokens" in payload:
+                        if payload.get("stream"):
+                            if not hasattr(ex, "submitStream"):
+                                # an explicit 400 beats silently
+                                # answering a different response shape
+                                self._reply_json(400, {
+                                    "error": f"model {ex.name!r} does "
+                                    "not support streaming"})
+                                return
+                            # validation/shed errors surface HERE (the
+                            # call enqueues eagerly) as normal 400/429
+                            # replies; once the generator exists, tokens
+                            # stream out as each decode step completes
+                            gen = ex.submitStream(payload)
+                            from deeplearning4j_tpu.remote.server import \
+                                stream_ndjson
+                            stream_ndjson(self,
+                                          ({"token": t} for t in gen),
+                                          final={"done": True})
+                            return
                         out = ex.submit(payload)
                         # jaxlint: sync-ok -- response serialization: the result leaves as JSON
                         body = {"tokens": np.asarray(out).tolist()}

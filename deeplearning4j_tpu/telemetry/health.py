@@ -411,42 +411,52 @@ class HealthMonitor:
             "Watchdog firing/resolved edges",
             labelnames=("rule", "state")).inc(rule=rule, state=state)
         self._enqueueWebhook(record)
-        if state == "firing":
-            self._dispatchActions(rule, detail)
+        if state in ("firing", "resolved"):
+            self._dispatchActions(rule, detail, state)
 
     # -- alert -> action remediations ------------------------------------
-    def registerAction(self, rule: str, action) -> None:
+    def registerAction(self, rule: str, action,
+                       on: str = "firing") -> None:
         """Register a remediation for ``rule``: ``action(rule, detail)``
-        runs on the FIRING edge (once per transition, not per refresh),
-        on the evaluating thread.  It returns a short outcome string
-        (logged as an ``action`` event) or None for "not applicable".
-        Actions must be quick and thread-safe — heavyweight work should
-        set a flag the owning loop consumes (see
+        runs on the chosen transition edge — ``on="firing"`` (the
+        default) or ``on="resolved"`` — once per transition, not per
+        refresh, on the evaluating thread.  Resolved-edge actions are
+        how a remediation UNWINDS when the condition clears (e.g. the
+        serving queue-depth rule scales replica fan-out up on firing and
+        back down on resolved).  The action returns a short outcome
+        string (logged as an ``action`` event) or None for "not
+        applicable".  Actions must be quick and thread-safe —
+        heavyweight work should set a flag the owning loop consumes (see
         ``PrefetchingDataSetIterator.requestRestart``)."""
+        if on not in ("firing", "resolved"):
+            raise ValueError(f"on must be 'firing' or 'resolved', "
+                             f"got {on!r}")
         with self._actions_lock:
-            self._actions.setdefault(str(rule), []).append(action)
+            self._actions.setdefault(str(rule), []).append((action, on))
 
     def unregisterAction(self, rule: str, action=None) -> None:
-        """Remove ``action`` for ``rule`` (all of the rule's actions
-        when ``action`` is None)."""
+        """Remove ``action`` for ``rule`` on every edge (all of the
+        rule's actions when ``action`` is None)."""
         with self._actions_lock:
             if action is None:
                 self._actions.pop(str(rule), None)
                 return
             lst = self._actions.get(str(rule), [])
-            if action in lst:
-                lst.remove(action)
+            self._actions[str(rule)] = [(a, on) for a, on in lst
+                                        if a is not action]
 
-    def _dispatchActions(self, rule: str, detail: str) -> None:
+    def _dispatchActions(self, rule: str, detail: str,
+                         state: str = "firing") -> None:
         with self._actions_lock:
-            actions = list(self._actions.get(rule, ()))
+            actions = [a for a, on in self._actions.get(rule, ())
+                       if on == state]
         if not actions:
             return
         from deeplearning4j_tpu.telemetry.federation import host_id
         counter = self._reg().counter(
             "dl4j_tpu_health_actions_total",
-            "Remediation actions dispatched on alert firing edges, by "
-            "rule and outcome (ok / noop / failed)",
+            "Remediation actions dispatched on alert firing/resolved "
+            "edges, by rule and outcome (ok / noop / failed)",
             labelnames=("rule", "outcome"))
         for action in actions:
             name = getattr(action, "__name__", type(action).__name__)

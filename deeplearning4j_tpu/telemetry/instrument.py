@@ -373,6 +373,77 @@ class ServingMetrics:
             "server-start-to-ready cost, per model",
             labelnames=("model",), buckets=SERVING_WARMUP_BUCKETS)
 
+    # -- continuous batching (remote/scheduler.py) -----------------------
+    def slot_occupancy(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_slot_occupancy",
+            "Active decode slots / total slots of the continuous "
+            "batcher's shared step (1.0 = every slot busy; the "
+            "iteration-level scheduler's primary efficiency signal)",
+            labelnames=("model",))
+
+    def kv_pages_in_use(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_kv_pages_in_use",
+            "KV-cache pages currently allocated to admitted sequences, "
+            "per model and pool (target / draft)",
+            labelnames=("model", "pool"))
+
+    def kv_pages_free(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_kv_pages_free",
+            "KV-cache pages on the free list, per model and pool — the "
+            "admission controller's page-headroom signal",
+            labelnames=("model", "pool"))
+
+    def preemptions(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_preemptions_total",
+            "Decode slots evicted mid-generation to free KV pages "
+            "(restart-with-skip; the sequence requeues at the front)",
+            labelnames=("model",))
+
+    def sequences_admitted(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_sequences_admitted_total",
+            "Sequences admitted into a decode slot between steps",
+            labelnames=("model",))
+
+    def sequences_retired(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_sequences_retired_total",
+            "Sequences retired from a decode slot (finished, errored "
+            "or cancelled) with all their pages freed",
+            labelnames=("model",))
+
+    def decode_steps(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_decode_steps_total",
+            "Shared decode steps dispatched by the continuous batcher "
+            "(one fixed-shape executable call per step)",
+            labelnames=("model",))
+
+    def draft_proposed(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_draft_tokens_proposed_total",
+            "Tokens proposed by the speculative-decode draft model, "
+            "per slot-round",
+            labelnames=("model",))
+
+    def draft_accepted(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_draft_tokens_accepted_total",
+            "Draft proposals accepted by the target model's verify "
+            "forward (accept rate = accepted / proposed)",
+            labelnames=("model",))
+
+    def replicas(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_replicas",
+            "Live executor replicas behind the named registry route "
+            "(scaled by the serving_queue_depth remediation)",
+            labelnames=("model",))
+
 
 _SERVING_METRICS = ServingMetrics()
 

@@ -81,6 +81,10 @@ def pytest_configure(config):
         "fault schedules over a coordinated training run: leader "
         "failover, barrier deaths, partitions, corrupt/torn state — "
         "with the standing lineage/trajectory/delivery/jit invariants)")
+    config.addinivalue_line(
+        "markers", "cbatch: iteration-level continuous-batching tests "
+        "(paged KV pool, admit/retire scheduler, token streaming, "
+        "speculative decode bit-identity, replica fan-out)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,570 @@
+"""Iteration-level continuous batching (ISSUE 15): paged KV pool,
+admit/retire scheduler invariants, token streaming, speculative-decode
+bit-identity, KV-headroom admission, replica fan-out (TP + DP) and the
+queue-depth autoscale remediation."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.transformer import TransformerLM
+from deeplearning4j_tpu.remote import (AdmissionControl, ContinuousBatcher,
+                                       GenerativeServing, InferenceServer,
+                                       ModelRegistry, ReplicaSet,
+                                       ServiceOverloaded)
+from deeplearning4j_tpu.telemetry import get_registry, serving_metrics
+
+pytestmark = pytest.mark.cbatch
+
+
+def _lm(layers=1, maxLen=64, seed=5, vocab=40):
+    return TransformerLM(vocabSize=vocab, nLayers=layers, nHeads=2,
+                         headSize=8, maxLen=maxLen, seed=seed)
+
+
+def _post(port, path, obj, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ------------------------------------------------- paged attention ----
+
+def test_paged_attention_matches_cached_attention():
+    """The pooled page-table lookup is numerically the same attention as
+    the dense per-batch KVCache (same validity mask, same math)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.attention import (KVCache,
+                                                      cached_attention,
+                                                      paged_attention)
+    rng = np.random.RandomState(0)
+    S, h, d, ps, P = 2, 2, 4, 4, 3          # capacity = 12
+    qh = jnp.asarray(rng.randn(S, h, 1, d), jnp.float32)
+    kh = jnp.asarray(rng.randn(S, h, 1, d), jnp.float32)
+    vh = jnp.asarray(rng.randn(S, h, 1, d), jnp.float32)
+    hist_k = rng.randn(S, h, 12, d).astype(np.float32)
+    hist_v = rng.randn(S, h, 12, d).astype(np.float32)
+    pos, start = 7, 2
+    # dense reference
+    cache = KVCache(jnp.asarray(hist_k), jnp.asarray(hist_v),
+                    jnp.asarray(pos, jnp.int32),
+                    jnp.full((S,), start, jnp.int32))
+    ref, _ = cached_attention(qh, kh, vh, cache)
+    # paged: the same history sliced into pages (0 = scratch; slot 0
+    # gets pages 1..3, slot 1 pages 4..6 — hist (h, cap, d) slices
+    # straight into the (h, ps, d) page layout)
+    poolK = np.zeros((8, h, ps, d), np.float32)
+    poolV = np.zeros((8, h, ps, d), np.float32)
+    table = np.zeros((S, P), np.int32)
+    for s in range(S):
+        table[s] = [1 + s * P + i for i in range(P)]
+        for i, pid in enumerate(table[s]):
+            poolK[pid] = hist_k[s][:, i * ps:(i + 1) * ps]
+            poolV[pid] = hist_v[s][:, i * ps:(i + 1) * ps]
+    got, pk, pv = paged_attention(
+        qh, kh, vh, jnp.asarray(poolK), jnp.asarray(poolV),
+        jnp.asarray(table), jnp.full((S,), pos, jnp.int32),
+        jnp.full((S,), start, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # the new K/V landed in the right page slot
+    pagedK = np.asarray(pk)[table[0, pos // ps], :, pos % ps, :]
+    np.testing.assert_allclose(pagedK, np.asarray(kh)[0, :, 0, :])
+
+
+# --------------------------------------- scheduler core invariants ----
+
+def test_continuous_batching_matches_generate_with_flat_misses():
+    """One batcher lifecycle: ragged concurrent requests match
+    ``lm.generate`` token-for-token, streaming yields the same tokens
+    incrementally, admit/retire churn never compiles a new executable
+    after warm-up, and retirement returns every page to the free
+    list."""
+    lm = _lm(layers=1)
+    ref_lm = _lm(layers=1)      # references compile on a SEPARATE
+    # instance so the flat-miss probe sees only the batcher's own fns
+    cb = ContinuousBatcher(lm, name="cb-core", pageSize=8,
+                           maxSlots=3).start()
+    try:
+        rng = np.random.RandomState(0)
+        seen = cb.compileCacheSize()
+        assert seen > 0                       # the warm ladder compiled
+        # ragged lengths from a SMALL set: the reference's dense prefill
+        # compiles per exact length, and that cost is the test's tail
+        lens = (5, 9, 14, 23)
+        prompts = [rng.randint(1, 40, (1, lens[int(rng.randint(4))])
+                               ).astype(np.int32) for _ in range(7)]
+        quotas = [int(rng.randint(2, 10)) for _ in range(7)]
+        outs = [None] * 7
+
+        def run(i):
+            outs[i] = cb.submit({"tokens": prompts[i][0].tolist(),
+                                 "maxNewTokens": quotas[i]}, timeout=120)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(7)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=180)
+        assert all(not th.is_alive() for th in threads)  # bounded wait
+        for i in range(7):
+            np.testing.assert_array_equal(
+                outs[i], ref_lm.generate(prompts[i], quotas[i]))
+        # a multi-row request fans out and reassembles in row order
+        pb = rng.randint(1, 40, (2, 9)).astype(np.int32)
+        np.testing.assert_array_equal(
+            cb.submit({"tokens": pb.tolist(), "maxNewTokens": 5},
+                      timeout=120),
+            ref_lm.generate(pb, 5))
+        # streaming delivers the same tokens, incrementally
+        ps = rng.randint(1, 40, (1, 9)).astype(np.int32)
+        toks = list(cb.submitStream({"tokens": ps[0].tolist(),
+                                     "maxNewTokens": 6}))
+        assert toks == ref_lm.generate(ps, 6)[0].tolist()
+        # invariants: flat jit misses across all that churn, every page
+        # back on the free list, zero recorded compile misses
+        assert cb.compileCacheSize() == seen
+        assert cb.pool.freePages() == cb.pool.numPages - 1
+        assert serving_metrics().compile_misses().value(
+            model="cb-core") == 0
+        assert serving_metrics().sequences_retired().value(
+            model="cb-core") >= 10
+    finally:
+        cb.shutdown()
+
+
+def test_admit_mid_decode_never_changes_earlier_tokens():
+    """Admitting B while A decodes must not perturb A's token stream —
+    slots are independent rows of the shared fixed-shape step."""
+    lm = _lm(layers=1)
+    rng = np.random.RandomState(3)
+    pa = rng.randint(1, 40, (1, 11)).astype(np.int32)
+    pb = rng.randint(1, 40, (1, 4)).astype(np.int32)
+    refA = lm.generate(pa, 24)
+    refB = lm.generate(pb, 5)
+    from deeplearning4j_tpu.remote import BucketLadder
+    cb = ContinuousBatcher(lm, name="cb-admit", pageSize=8, maxSlots=2,
+                           ladder=BucketLadder(batchSizes=(2,),
+                                               seqLens=(16,))).start()
+    try:
+        outA = [None]
+        ta = threading.Thread(target=lambda: outA.__setitem__(
+            0, cb.submit({"tokens": pa[0].tolist(), "maxNewTokens": 24},
+                         timeout=120)))
+        ta.start()
+        time.sleep(0.05)                      # A is mid-decode
+        outB = cb.submit({"tokens": pb[0].tolist(), "maxNewTokens": 5},
+                         timeout=120)
+        ta.join(timeout=120)
+        np.testing.assert_array_equal(outA[0], refA)
+        np.testing.assert_array_equal(outB, refB)
+    finally:
+        cb.shutdown()
+
+
+def test_preemption_restarts_and_recovers_bit_identical():
+    """A pool too small for two full sequences: the younger slot is
+    preempted (pages freed, requeued at the front), restarts, and still
+    produces the exact greedy stream; the oldest slot always progresses
+    (no ping-pong livelock)."""
+    lm = _lm(layers=1, maxLen=48, seed=6)
+    cb = ContinuousBatcher(lm, name="cb-preempt", pageSize=8, numPages=9,
+                           maxSlots=2).start()
+    try:
+        rng = np.random.RandomState(1)
+        pa = rng.randint(1, 40, (1, 12)).astype(np.int32)
+        pb = rng.randint(1, 40, (1, 12)).astype(np.int32)
+        res = [None, None]
+        ths = [threading.Thread(target=lambda i=i, p=p: res.__setitem__(
+            i, cb.submit({"tokens": p[0].tolist(), "maxNewTokens": 30},
+                         timeout=120)))
+            for i, p in enumerate((pa, pb))]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=120)
+        np.testing.assert_array_equal(res[0], lm.generate(pa, 30))
+        np.testing.assert_array_equal(res[1], lm.generate(pb, 30))
+        assert serving_metrics().preemptions().value(
+            model="cb-preempt") >= 1
+        assert cb.pool.freePages() == cb.pool.numPages - 1
+    finally:
+        cb.shutdown()
+
+
+# ------------------------------------------------ speculative decode ----
+
+def test_speculative_decode_bit_identical_to_greedy():
+    """Accept-prefix speculative decode == target-only greedy, exactly:
+    standalone (dense caches) and through the continuous batcher (paged
+    pools, per-slot accept lengths), with an arbitrary draft AND a
+    zero-tail draft that accepts everything."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.remote import BucketLadder
+    target = _lm(layers=2, seed=7)
+    draft = _lm(layers=1, seed=9)
+    rng = np.random.RandomState(0)
+    p = rng.randint(1, 40, (1, 10)).astype(np.int32)
+    ref = target.generate(p, 12)
+    out, stats = target.speculative_generate(draft, p, 12, draftK=4,
+                                             returnStats=True)
+    np.testing.assert_array_equal(out, ref)
+    assert stats["proposed"] > 0
+    # zero-tail: REUSE the same instances (params are executable args,
+    # so same-shaped swaps recompile nothing) — target's second layer
+    # contributes nothing and the draft IS its first layer: logits
+    # identical => acceptance is total
+    lp = target.params["layers"][1]
+    lp["Wo"] = jnp.zeros_like(lp["Wo"])
+    lp["Wp"] = jnp.zeros_like(lp["Wp"])
+    lp["bp"] = jnp.zeros_like(lp["bp"])
+    draft.params = {"emb": target.params["emb"],
+                    "pos": target.params["pos"],
+                    "lnf_g": target.params["lnf_g"],
+                    "lnf_b": target.params["lnf_b"],
+                    "layers": [target.params["layers"][0]]}
+    out2, st2 = target.speculative_generate(draft, p, 16, draftK=4,
+                                            returnStats=True)
+    np.testing.assert_array_equal(out2, target.generate(p, 16))
+    assert st2["acceptRate"] == 1.0
+    # continuous batcher with the draft: concurrent ragged requests,
+    # per-slot accept lengths, still bit-identical
+    cb = ContinuousBatcher(target, name="cb-spec", draft=draft, draftK=3,
+                           pageSize=8, maxSlots=2,
+                           ladder=BucketLadder(batchSizes=(2,),
+                                               seqLens=(16,))).start()
+    try:
+        prompts = [rng.randint(1, 40, (1, int(rng.randint(3, 15)))
+                               ).astype(np.int32) for _ in range(3)]
+        outs = [None] * 3
+        ths = [threading.Thread(target=lambda i=i: outs.__setitem__(
+            i, cb.submit({"tokens": prompts[i][0].tolist(),
+                          "maxNewTokens": 8}, timeout=120)))
+            for i in range(3)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=120)
+        for i in range(3):
+            np.testing.assert_array_equal(outs[i],
+                                          target.generate(prompts[i], 8))
+        sm = serving_metrics()
+        assert sm.draft_proposed().value(model="cb-spec") > 0
+    finally:
+        cb.shutdown()
+
+
+# --------------------------------- admission + enqueue-time rejection ----
+
+def test_kv_headroom_sheds_and_enqueue_rejects():
+    """Page exhaustion degrades at the door: a request whose pages can't
+    fit the free list sheds 429 with a Retry-After derived from the
+    retire rate; impossible requests (prompt above the top bucket,
+    quota past the page budget, zero rows) are offender-only 400s at
+    enqueue time — they can never wedge or poison the shared batch."""
+    lm = _lm(layers=1, maxLen=48, seed=6)
+    cb = ContinuousBatcher(lm, name="cb-shed", pageSize=8, numPages=9,
+                           maxSlots=2,
+                           admission=AdmissionControl(retryAfter=0.5)
+                           ).start()
+    try:
+        # enqueue-time 400s — before any queueing
+        with pytest.raises(ValueError, match="exceeds the top bucket"):
+            cb.submit({"tokens": list(range(1, 30)) * 2,
+                       "maxNewTokens": 4})
+        with pytest.raises(ValueError, match="positional capacity"):
+            cb.submit({"tokens": [1, 2, 3], "maxNewTokens": 45})
+        with pytest.raises(ValueError, match="b >= 1"):
+            cb.submit({"tokens": np.zeros((0, 4), np.int32).tolist()})
+        with pytest.raises(ValueError, match="maxNewTokens"):
+            cb.submit({"tokens": [1, 2], "maxNewTokens": 0})
+        # KV headroom: two admissible requests whose combined pages
+        # exceed the pool shed the SECOND while it is still queued
+        rng = np.random.RandomState(1)
+        pa = rng.randint(1, 40, (1, 12)).astype(np.int32)
+        outA = [None]
+        got429 = []
+
+        def first():
+            outA[0] = cb.submit({"tokens": pa[0].tolist(),
+                                 "maxNewTokens": 30}, timeout=120)
+
+        ta = threading.Thread(target=first)
+        ta.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not got429:
+            try:
+                cb.submit({"tokens": pa[0].tolist(),
+                           "maxNewTokens": 30}, timeout=120)
+                break                          # pool drained: admitted
+            except ServiceOverloaded as e:
+                got429.append(e.retryAfter)
+                break
+        ta.join(timeout=120)
+        np.testing.assert_array_equal(outA[0], lm.generate(pa, 30))
+        if got429:                             # shed carried a real hint
+            assert got429[0] > 0
+            assert serving_metrics().shed().value(
+                model="cb-shed", rule="serving_kv_exhausted") >= 1
+    finally:
+        cb.shutdown()
+
+
+def test_generative_serving_enqueue_rejection_regression():
+    """The group-at-a-time path keeps the same discipline: oversized
+    prompts / impossible quotas / zero-row batches 400 at enqueue, and
+    an offender never poisons a coalesced batch (ISSUE 15 satellite)."""
+    lm = _lm(layers=1, maxLen=64)
+    gs = GenerativeServing(lm)
+    with pytest.raises(ValueError, match="exceeds the top bucket"):
+        gs.makeRequest({"tokens": list(range(1, 36))})   # top bucket 32
+    with pytest.raises(ValueError, match="capacity"):
+        gs.makeRequest({"tokens": [1, 2, 3], "maxNewTokens": 60})
+    with pytest.raises(ValueError, match="b >= 1"):
+        gs.makeRequest({"tokens": np.zeros((0, 4), np.int32).tolist()})
+    # ForwardServing shares the zero-row guard
+    from deeplearning4j_tpu.remote import ForwardServing
+    fs = ForwardServing(object(), inputShape=(4,))
+    with pytest.raises(ValueError, match="at least one row"):
+        fs.makeRequest(np.zeros((0, 4), np.float32))
+
+
+def test_step_failure_recovers_and_draft_bounds_capacity():
+    """A dispatch failure mid-step errors the affected sequences and the
+    scheduler thread SURVIVES (pools rebuilt — the failed call may have
+    consumed the donated buffers — and re-warmed); a draft with a
+    smaller cache bounds admissible requests at enqueue time; a
+    timed-out submit reaps its queued rows instead of leaving phantom
+    backlog."""
+    from deeplearning4j_tpu.remote import BucketLadder
+    lm = _lm(layers=1)
+    cb = ContinuousBatcher(lm, name="cb-fail", pageSize=8, maxSlots=2,
+                           ladder=BucketLadder(batchSizes=(2,),
+                                               seqLens=(16,))).start()
+    try:
+        real = cb._stepFns["step"]
+        state = {"n": 0}
+
+        def bad(*a, **k):
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("injected device failure")
+            return real(*a, **k)
+
+        cb._stepFns["step"] = bad
+        p = np.random.RandomState(0).randint(1, 40, (1, 8)
+                                             ).astype(np.int32)
+        with pytest.raises(RuntimeError, match="injected"):
+            cb.submit({"tokens": p[0].tolist(), "maxNewTokens": 5},
+                      timeout=60)
+        out = cb.submit({"tokens": p[0].tolist(), "maxNewTokens": 5},
+                        timeout=60)            # recovered, still exact
+        np.testing.assert_array_equal(out, _lm(layers=1).generate(p, 5))
+        # timeout reap: no phantom queued rows afterwards
+        with pytest.raises(TimeoutError):
+            cb.submit({"tokens": p[0].tolist(), "maxNewTokens": 20},
+                      timeout=1e-4)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and (cb.queuedRows() or
+                                               cb.busy()):
+            time.sleep(0.02)
+        assert cb.queuedRows() == 0
+    finally:
+        cb.shutdown()
+    # draft with a smaller cache: ladder and admission bound by it
+    draft = _lm(layers=1, maxLen=32, seed=9)
+    cb2 = ContinuousBatcher(_lm(layers=1, maxLen=128), name="cb-cap",
+                            draft=draft, draftK=2, pageSize=8,
+                            maxSlots=2)
+    assert max(cb2.ladder.seqLens) < 32
+    with pytest.raises(ValueError, match="draft"):
+        cb2._makeSeqs({"tokens": [1, 2, 3], "maxNewTokens": 25})
+
+
+# ----------------------------------------------- replica fan-out ------
+
+def test_tp_replica_serves_through_registry_with_streaming():
+    """A ShardingPlan-TP replica partitioned over 2 proxy devices serves
+    through the same ModelRegistry route, bit-identical to the
+    unsharded model — plus HTTP streaming and HTTP 400 routing."""
+    import jax
+    from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+    from deeplearning4j_tpu.parallel.meshtrainer import ShardingPlan
+    from deeplearning4j_tpu.remote import BucketLadder
+    ref_lm = _lm(layers=1)
+    rng = np.random.RandomState(0)
+    p = rng.randint(1, 40, (1, 10)).astype(np.int32)
+    ref = ref_lm.generate(p, 8)
+    lm = _lm(layers=1)
+    plan = ShardingPlan(DeviceMesh(data=1, model=2,
+                                   devices=jax.devices()[:2]),
+                        tensorParallel=True)
+    cb = ContinuousBatcher(lm, name="tp", pageSize=8, maxSlots=2,
+                           plan=plan,
+                           ladder=BucketLadder(batchSizes=(2,),
+                                               seqLens=(16,)))
+    spans = {len(leaf.sharding.device_set)
+             for leaf in jax.tree_util.tree_leaves(lm.params)}
+    assert max(spans) >= 2                    # genuinely partitioned
+    reg = ModelRegistry()
+    reg.register("tp", cb)
+    srv = InferenceServer(reg, port=0).start()
+    try:
+        _, out = _post(srv.port, "/v1/serving/tp",
+                       {"tokens": p[0].tolist(), "maxNewTokens": 8})
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), ref)
+        # streaming: NDJSON lines, one token per decode step
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/serving/tp",
+            data=json.dumps({"tokens": p[0].tolist(), "maxNewTokens": 6,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers.get("Content-Type") == \
+                "application/x-ndjson"
+            lines = [json.loads(line) for line in resp]
+        assert [ln["token"] for ln in lines if "token" in ln] == \
+            ref[0][:6].tolist()
+        assert lines[-1] == {"done": True}
+        # enqueue-time rejection travels as HTTP 400 with the reason
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.port, "/v1/serving/tp",
+                  {"tokens": [1, 2, 3], "maxNewTokens": 1000})
+        assert ei.value.code == 400
+        assert "capacity" in json.loads(ei.value.read())["error"]
+        # stream:true against a non-streaming executor is an explicit
+        # 400, never a silently different response shape
+        class _NoStream:
+            name = "nostream"
+
+            def start(self):
+                return self
+
+            def submit(self, payload, timeout=None):
+                return np.zeros((1, 1), np.int32)
+
+            def queuedRows(self):
+                return 0
+
+            def shutdown(self):
+                pass
+        reg.register("nostream", _NoStream())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.port, "/v1/serving/nostream",
+                  {"tokens": [1], "maxNewTokens": 1, "stream": True})
+        assert ei.value.code == 400
+        assert "streaming" in json.loads(ei.value.read())["error"]
+    finally:
+        srv.stop()
+
+
+def test_dp_replica_fanout_scales_on_queue_depth_edges():
+    """ReplicaSet: DP replicas placed per device serve identically; the
+    serving_queue_depth rule's FIRING edge scales one replica up and the
+    RESOLVED edge scales back down, both counted in
+    dl4j_tpu_health_actions_total."""
+    import jax
+    from deeplearning4j_tpu.telemetry.health import HealthMonitor
+    rng = np.random.RandomState(0)
+    p = rng.randint(1, 40, (1, 10)).astype(np.int32)
+    ref = _lm(layers=1).generate(p, 6)
+    devices = jax.devices()
+
+    from deeplearning4j_tpu.remote import BucketLadder
+
+    def factory(idx):
+        m = _lm(layers=1)
+        return ContinuousBatcher(m, name=f"dp/{idx}", pageSize=8,
+                                 maxSlots=2,
+                                 ladder=BucketLadder(batchSizes=(2,),
+                                                     seqLens=(16,)),
+                                 device=devices[idx % len(devices)])
+
+    rs = ReplicaSet(factory, name="dp", replicas=1, maxReplicas=3)
+    rs.start()
+    try:
+        np.testing.assert_array_equal(
+            rs.submit({"tokens": p[0].tolist(), "maxNewTokens": 6},
+                      timeout=120), ref)
+        mon = HealthMonitor(rules=[])
+        rs.armAutoscale(mon, highQueueRows=3)
+        # REAL backlog (the rule reads live queued rows — a gauge
+        # written at submit completion is blind during a cold burst):
+        # 8 requests against 2 slots leaves >= 3 queued
+        threads = [threading.Thread(target=lambda: rs.submit(
+            {"tokens": p[0].tolist(), "maxNewTokens": 30}, timeout=120))
+            for _ in range(8)]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and rs.queuedRows() < 3:
+            time.sleep(0.005)
+        assert rs.queuedRows() >= 3
+        mon.evaluate_once(now=100.0)
+        assert rs.replicaCount() == 2          # firing edge: +1 replica
+        for th in threads:
+            th.join(timeout=120)
+        assert rs.queuedRows() == 0
+        mon.evaluate_once(now=200.0)           # backlog gone: resolves
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and rs.replicaCount() != 1:
+            time.sleep(0.05)
+        assert rs.replicaCount() == 1          # resolved edge: -1
+        # the surviving replica still serves
+        np.testing.assert_array_equal(
+            rs.submit({"tokens": p[0].tolist(), "maxNewTokens": 6},
+                      timeout=120), ref)
+        acted = get_registry().get("dl4j_tpu_health_actions_total")
+        cells = dict((tuple(k), v) for k, v in acted.data()["cells"])
+        assert cells.get(("serving_queue_depth_high", "ok"), 0) >= 2
+    finally:
+        rs.shutdown()
+
+
+# ------------------------------------------------------- slow soak ----
+
+@pytest.mark.slow
+def test_ragged_arrival_soak_occupancy_and_flat_misses():
+    """Sustained ragged traffic: decode-slot occupancy stays >= 0.9
+    while demand exists, the jit-miss counter stays flat across ~dozens
+    of admit/retire cycles, and every result is bit-identical."""
+    lm = _lm(layers=1)
+    ref_lm = _lm(layers=1)
+    cb = ContinuousBatcher(lm, name="cb-soak", pageSize=8,
+                           maxSlots=4).start()
+    try:
+        rng = np.random.RandomState(0)
+        seen = cb.compileCacheSize()
+        n = 32
+        prompts = [rng.randint(1, 40, (1, int(rng.randint(3, 30)))
+                               ).astype(np.int32) for _ in range(n)]
+        quotas = [int(rng.randint(4, 14)) for _ in range(n)]
+        outs = [None] * n
+
+        def run(i):
+            outs[i] = cb.submit({"tokens": prompts[i][0].tolist(),
+                                 "maxNewTokens": quotas[i]}, timeout=300)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        assert all(not th.is_alive() for th in threads)
+        for i in range(n):
+            np.testing.assert_array_equal(
+                outs[i], ref_lm.generate(prompts[i], quotas[i]))
+        assert cb.compileCacheSize() == seen          # flat across churn
+        assert cb.occupancy() is not None and cb.occupancy() >= 0.9
+        assert cb.pool.freePages() == cb.pool.numPages - 1
+    finally:
+        cb.shutdown()

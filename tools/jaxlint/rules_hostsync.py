@@ -37,6 +37,7 @@ HOT_PATH_SUFFIXES = (
     "models/multilayer.py",
     "models/graph.py",
     "remote/serving.py",
+    "remote/scheduler.py",
     "parallel/inference.py",
     "parallel/meshtrainer.py",
     "parallel/zero.py",
