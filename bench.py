@@ -478,6 +478,152 @@ def bench_mesh(steps: int = 12, batch: int = 64, width: int = 512,
     }
 
 
+def bench_recsys(steps: int = 8, batch: int = 256,
+                 tableRows: int = 131072, dim: int = 64) -> dict:
+    """Recommender-tier bench (ISSUE 16 acceptance): embedding-lookup
+    throughput, the table-parallel train step for a table bigger than
+    one proxy device's replicated share, and top-k retrieval p50/p99
+    through the continuous batcher.
+
+    Three sections, one JSON line:
+
+    - **lookup**: jitted two-phase ``bag_lookup_dedup`` rows/sec (raw
+      id gathers per second) plus the host-observed dedup ratio and the
+      static all-to-all bytes one table-parallel lookup would move;
+    - **train**: ``ParallelWrapper.fitDataSet`` step time under
+      DP x table-parallel (``data=2, model=4``) with the
+      ``tableRows x dim`` f32 table row-sharded over ``model`` — on the
+      8-device proxy each device holds 1/4 of the table instead of a
+      full replica per device; ``jit_cache_misses_steady`` must be 0;
+    - **serving**: top-k retrieval latency through ``ContinuousBatcher``
+      (single-step sequences), p50/p99 over the request wall times.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.models.recsys import (DotProductScorer,
+                                                  RetrievalLM,
+                                                  topk_retrieve)
+    from deeplearning4j_tpu.nn.conf import (InputType,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.embedding import (
+        ShardedEmbeddingBag, alltoall_bytes_per_lookup, bag_lookup_dedup)
+    from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+    from deeplearning4j_tpu.remote import BucketLadder, ContinuousBatcher
+    from deeplearning4j_tpu.telemetry import get_registry, recsys_metrics
+
+    n_dev = len(jax.devices())
+    fields, bag = 2, 8
+    rng = np.random.RandomState(0)
+
+    # -- lookup throughput ------------------------------------------------
+    lk = jax.jit(lambda W, ids, w: bag_lookup_dedup(W, ids, w))
+    W = jnp.asarray(rng.randn(32768, dim).astype(np.float32))
+    ids = jnp.asarray(rng.zipf(1.3, (4096, 16)).clip(0, 32767)
+                      .astype(np.int32))      # skewed, like real traffic
+    wts = jnp.ones((4096, 16), jnp.float32)
+    lk(W, ids, wts).block_until_ready()       # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lk(W, ids, wts).block_until_ready()
+    lookup_s = time.perf_counter() - t0
+    raw = int(ids.size) * steps
+    uniqPerBatch = int(np.unique(np.asarray(ids)).size)
+    rm = recsys_metrics()
+    rm.lookup_rows().inc(raw, phase="raw")
+    rm.lookup_rows().inc(uniqPerBatch * steps, phase="stored")
+    rm.dedup_ratio().set(uniqPerBatch / ids.size)
+    a2a = alltoall_bytes_per_lookup(4, uniqPerBatch, dim)
+    rm.alltoall_bytes().inc(a2a * steps)
+    rows_per_sec = raw / lookup_s
+
+    # -- table-parallel train step ---------------------------------------
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(ShardedEmbeddingBag.builder()
+                   .numEmbeddings(tableRows).embeddingDim(dim)
+                   .numFields(fields).build())
+            .layer(DotProductScorer.builder().embeddingDim(dim).build())
+            .setInputType(InputType.feedForward(fields * bag)).build())
+    net = MultiLayerNetwork(conf).init()
+    mesh_axes = dict(data=max(n_dev // 4, 1), model=min(4, n_dev))
+    pw = ParallelWrapper(net, mesh=DeviceMesh(**mesh_axes),
+                         tensorParallel=True)
+    pool = [DataSet(rng.randint(0, tableRows, (batch, fields * bag))
+                    .astype(np.float32),
+                    rng.randint(0, 2, (batch, 1)).astype(np.float32))
+            for _ in range(2)]
+    reg = get_registry()
+
+    def misses():
+        c = reg.get("dl4j_tpu_mesh_jit_cache_misses_total")
+        return c.value() if c is not None else 0.0
+
+    pw.fitDataSet(pool[0])      # compile
+    pw.fitDataSet(pool[1])
+    net.score()
+    m0 = misses()
+    t0 = time.perf_counter()
+    for i in range(steps):
+        pw.fitDataSet(pool[i % len(pool)])
+    net.score()
+    train_s = time.perf_counter() - t0
+    table_bytes = tableRows * dim * 4
+
+    # -- top-k serving ----------------------------------------------------
+    vocab = 8192
+    lm = RetrievalLM(rng.randn(vocab, dim).astype(np.float32),
+                     rng.randn(vocab, dim).astype(np.float32),
+                     maxLen=64)
+    cb = ContinuousBatcher(lm, name="bench-recsys", pageSize=8,
+                           maxSlots=4,
+                           ladder=BucketLadder(batchSizes=(4,),
+                                               seqLens=(16,))).start()
+    lats = []
+    try:
+        prompts = [rng.randint(0, vocab, (12,)).astype(np.int32)
+                   for _ in range(48)]
+        topk_retrieve(cb, prompts[0][None, :], 10, timeout=120)  # warm
+        for p in prompts[1:]:
+            t0 = time.perf_counter()
+            topk_retrieve(cb, p[None, :], 10, timeout=120)
+            lats.append(time.perf_counter() - t0)
+    finally:
+        cb.shutdown()
+    lats = np.asarray(lats)
+
+    return {
+        "metric": "recsys_lookup_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "devices": n_dev,
+        "cpu_proxy": jax.default_backend() == "cpu",
+        "dedup_ratio": round(uniqPerBatch / ids.size, 4),
+        "alltoall_bytes_per_lookup": int(a2a),
+        "train": {
+            "mesh": {k: int(v) for k, v in mesh_axes.items()},
+            "table_rows": tableRows,
+            "table_bytes": table_bytes,
+            # the acceptance framing: the per-device share under
+            # model=4 vs the full replica an unsharded table would pin
+            "per_device_table_bytes": table_bytes // mesh_axes["model"],
+            "step_ms": round(train_s / steps * 1e3, 3),
+            "examples_per_sec": round(batch * steps / train_s, 1),
+            "jit_cache_misses_steady": int(misses() - m0),
+        },
+        "serving": {
+            "requests": len(lats),
+            "topk_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+            "topk_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        },
+        "batch": batch,
+        "steps": steps,
+    }
+
+
 def bench_serving(clients: int = 8, duration: float = 4.0,
                   warmup: float = 1.0, nIn: int = 32,
                   decodeTokens: int = 48) -> dict:
@@ -925,6 +1071,14 @@ def main() -> None:
         steps = int(args[0]) if args else 12
         batch = int(args[1]) if len(args) > 1 else 64
         print(json.dumps(bench_mesh(steps, batch)))
+        return
+
+    if "--recsys" in sys.argv:
+        _reexec_cpu_mesh(8)
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        steps = int(args[0]) if args else 8
+        batch = int(args[1]) if len(args) > 1 else 256
+        print(json.dumps(bench_recsys(steps, batch)))
         return
 
     import jax

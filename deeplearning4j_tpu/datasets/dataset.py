@@ -20,11 +20,16 @@ def _nd(x) -> Optional[NDArray]:
 
 class DataSet:
     def __init__(self, features=None, labels=None,
-                 featuresMask=None, labelsMask=None):
+                 featuresMask=None, labelsMask=None, offsets=None):
         self.features = _nd(features)
         self.labels = _nd(labels)
         self.featuresMask = _nd(featuresMask)
         self.labelsMask = _nd(labelsMask)
+        # ragged-batch sidecar (no DL4J counterpart): CSR row offsets of
+        # the pre-padding ragged feature values, carried by the
+        # recommender-tier RaggedFeatureReader for exactly-once
+        # accounting — optional, host-only
+        self.offsets = _nd(offsets)
 
     # DL4J accessors
     def getFeatures(self) -> NDArray:
@@ -38,6 +43,9 @@ class DataSet:
 
     def getLabelsMaskArray(self):
         return self.labelsMask
+
+    def getOffsets(self):
+        return self.offsets
 
     def numExamples(self) -> int:
         return self.features.shape[0] if self.features is not None else 0

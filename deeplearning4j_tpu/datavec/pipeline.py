@@ -67,9 +67,17 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 log = logging.getLogger(__name__)
 
 __all__ = ["ShardSpec", "PrefetchingDataSetIterator", "ProducerWorkerError",
-           "maybe_prefetch", "default_host_spec", "stage_batch"]
+           "RaggedFeatureReader", "hash_feature", "maybe_prefetch",
+           "default_host_spec", "stage_batch"]
 
 _FIELDS = ("features", "labels", "featuresMask", "labelsMask")
+
+# every array a batch carries across the process/device boundary: the
+# DL4J quadruple plus the ragged-batch offsets sidecar.  Workers and the
+# staging ring must transfer ALL of these — the queue-pickle fallback
+# for oversized batches once serialized only _FIELDS and silently
+# dropped the offsets a RaggedFeatureReader attaches.
+_XFER_FIELDS = _FIELDS + ("offsets",)
 
 
 # ----------------------------------------------------------- sharding ----
@@ -219,7 +227,7 @@ def _worker_main(sourceBlob: bytes, spec: ShardSpec, shmNames, shmBytes: int,
         for ds in _iter_batches(it):
             if stopEvt.is_set():
                 break
-            fields = [_to_np(getattr(ds, f, None)) for f in _FIELDS]
+            fields = [_to_np(getattr(ds, f, None)) for f in _XFER_FIELDS]
             nbytes = sum(a.nbytes for a in fields if a is not None)
             if nbytes > shmBytes:
                 # oversized batch: pickle through the queue (slower, but
@@ -327,7 +335,7 @@ def stage_batch(ds, device) -> _StagedBatch:
     ``AsyncDataSetIterator`` so its thread-prefetch path gets the same
     direct-to-shard H2D routing as the producer pool."""
     fields = []
-    for name in _FIELDS:
+    for name in _XFER_FIELDS:
         a = getattr(ds, name, None)
         fields.append(None if a is None
                       else (a.jax if hasattr(a, "jax") else a))
@@ -815,6 +823,166 @@ class PrefetchingDataSetIterator(DataSetIterator):
 
     def streaming(self) -> bool:
         return False        # already prefetched: never wrap twice
+
+
+# ------------------------------------------------ ragged ingestion ----
+
+# Knuth multiplicative hash constants (golden-ratio / 2^64 + the
+# splitmix64 finalizer) — cheap, stateless, and identical across
+# processes, so ETL workers and the serving tier hash raw feature
+# values to the same embedding-table rows.
+_HASH_MULT = 0x9E3779B97F4A7C15
+_HASH_MIX = 0xBF58476D1CE4E5B9
+
+
+def hash_feature(values, numEmbeddings: int) -> np.ndarray:
+    """Hash raw categorical feature values into ``[0, numEmbeddings)``.
+
+    Pure numpy (ETL workers must never import jax).  Accepts any
+    integer array-like; returns int64 hashed ids of the same shape.
+    """
+    v = np.asarray(values, dtype=np.uint64)  # jaxlint: sync-ok -- host-side ETL hashing of raw python/numpy ids, no device buffers
+    with np.errstate(over="ignore"):    # wraparound IS the hash
+        h = (v + np.uint64(1)) * np.uint64(_HASH_MULT)
+        h ^= h >> np.uint64(29)
+        h *= np.uint64(_HASH_MIX)
+        h ^= h >> np.uint64(32)
+    return (h % np.uint64(numEmbeddings)).astype(np.int64)
+
+
+class RaggedFeatureReader(DataSetIterator):
+    """Streaming ragged/hashed-feature ingestion for the recommender
+    tier (feeds ``ShardedEmbeddingBag``).
+
+    Records are ``(values, label)`` pairs where ``values`` is one
+    ragged list of raw categorical ids (``numFields == 1``) or a tuple
+    of ``numFields`` such lists.  Each batch:
+
+    - hashes raw ids into ``[0, numEmbeddings)`` (:func:`hash_feature`),
+    - dedups ids PER ROW host-side (phase 1 of the two-phase sparse
+      lookup: ``np.unique`` with counts — the duplicate multiplicity
+      moves into the ``featuresMask`` weights, so sum-pooling is
+      unchanged and only unique ids cross the interconnect),
+    - pads every bag to the smallest bucket in ``bagBuckets`` that fits
+      the batch's longest bag (id 0 / weight 0).  Raggedness therefore
+      maps to a FINITE set of batch shapes — the fused train step
+      compiles one executable per bucket and never re-traces on
+      per-batch raggedness.
+
+    The emitted DataSet carries features ``(b, numFields*bucket)``
+    (float-encoded ids), featuresMask weights of the same shape,
+    one-hot labels, and an ``offsets`` sidecar — the CSR row offsets of
+    the PRE-dedup ragged values (``numFields*b + 1`` int64) used for
+    exactly-once accounting across pool restarts.  Deterministic:
+    record order fully determines every batch, which is what the pool's
+    replay fast-forward needs.
+    """
+
+    def __init__(self, records, batchSize: int, numEmbeddings: int,
+                 numClasses: int, bagBuckets=(4, 8, 16, 32, 64, 128),
+                 numFields: int = 1, hashInputs: bool = True):
+        self.records = list(records)
+        self.batchSize = int(batchSize)
+        self.numEmbeddings = int(numEmbeddings)
+        self.numClasses = int(numClasses)
+        self.bagBuckets = tuple(sorted(int(b) for b in bagBuckets))
+        self.numFields = int(numFields)
+        self.hashInputs = bool(hashInputs)
+        self._i = 0
+
+    # -- SPI ------------------------------------------------------------
+    def hasNext(self) -> bool:
+        return self._i < len(self.records)
+
+    def next(self, num: int = 0) -> DataSet:
+        n = num or self.batchSize
+        rows = self.records[self._i:self._i + n]
+        if not rows:
+            raise StopIteration("reader exhausted: call reset() first")
+        self._i += len(rows)
+        bags, labels, rawLens = [], [], []
+        for values, label in rows:
+            fields = values if self.numFields > 1 else (values,)
+            if len(fields) != self.numFields:
+                raise ValueError(
+                    f"record has {len(fields)} fields, expected "
+                    f"{self.numFields}")
+            for vals in fields:
+                ids = hash_feature(vals, self.numEmbeddings) \
+                    if self.hashInputs \
+                    else np.asarray(vals, dtype=np.int64)  # jaxlint: sync-ok -- host-side ingestion of raw record ids
+                uniq, counts = np.unique(ids, return_counts=True)
+                bags.append((uniq, counts.astype(np.float32)))
+                rawLens.append(len(ids))
+            labels.append(label)
+        bucket = self._bucket_for(max(len(u) for u, _ in bags))
+        b = len(rows)
+        f = np.zeros((b, self.numFields * bucket), dtype=np.float32)
+        w = np.zeros((b, self.numFields * bucket), dtype=np.float32)
+        for j, (uniq, counts) in enumerate(bags):
+            row, field = divmod(j, self.numFields)
+            off = field * bucket
+            f[row, off:off + len(uniq)] = uniq
+            w[row, off:off + len(uniq)] = counts
+        l = np.zeros((b, self.numClasses), dtype=np.float32)
+        l[np.arange(b), np.asarray(labels, dtype=np.int64)] = 1.0  # jaxlint: sync-ok -- host-side one-hot of python record labels
+        offsets = np.zeros(len(bags) + 1, dtype=np.int64)
+        np.cumsum(rawLens, out=offsets[1:])
+        self._note_batch(int(offsets[-1]), sum(len(u) for u, _ in bags))
+        return self._applyPre(
+            DataSet(f, l, featuresMask=w, offsets=offsets))
+
+    def _bucket_for(self, longest: int) -> int:
+        for bkt in self.bagBuckets:
+            if longest <= bkt:
+                return bkt
+        raise ValueError(
+            f"bag of {longest} unique ids exceeds the largest bucket "
+            f"{self.bagBuckets[-1]} — raise bagBuckets (silent "
+            "truncation would violate exactly-once ingestion)")
+
+    def _note_batch(self, raw: int, stored: int) -> None:
+        # ingestion telemetry — but ONLY in the parent process: a pool
+        # worker must not import jax-adjacent modules, and its registry
+        # would be discarded anyway
+        from deeplearning4j_tpu.ops.ndarray import host_only_arrays
+        if host_only_arrays():
+            return
+        from deeplearning4j_tpu.telemetry import recsys_metrics
+        rm = recsys_metrics()
+        rm.lookup_rows().inc(raw, phase="raw")
+        rm.lookup_rows().inc(stored, phase="stored")
+        rm.dedup_ratio().set(stored / max(raw, 1))
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def batch(self) -> int:
+        return self.batchSize
+
+    def totalOutcomes(self) -> int:
+        return self.numClasses
+
+    def inputColumns(self) -> int:
+        return self.numFields
+
+    def streaming(self) -> bool:
+        return True         # per-record hash+dedup is real host work
+
+    def setEpoch(self, epoch: int) -> None:
+        pass                # deterministic: no per-epoch randomness
+
+    def shard(self, index: int, count: int) -> "RaggedFeatureReader":
+        """Deterministic 1-of-``count`` record shard (producer-pool
+        worker contract)."""
+        out = RaggedFeatureReader(
+            self.records[index::count], self.batchSize,
+            self.numEmbeddings, self.numClasses,
+            bagBuckets=self.bagBuckets, numFields=self.numFields,
+            hashInputs=self.hashInputs)
+        if self.getPreProcessor() is not None:
+            out.setPreProcessor(self.getPreProcessor())
+        return out
 
 
 # ------------------------------------------------------- auto-selection ----

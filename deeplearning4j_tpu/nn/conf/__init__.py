@@ -21,6 +21,7 @@ import deeplearning4j_tpu.nn.conf.convolutional  # noqa: F401
 from deeplearning4j_tpu.nn.conf.samediff_layer import (  # noqa: F401
     SameDiffLambdaLayer, SameDiffLayer, SDLayerParams)
 import deeplearning4j_tpu.nn.conf.convolutional3d  # noqa: F401
+import deeplearning4j_tpu.nn.conf.embedding  # noqa: F401
 import deeplearning4j_tpu.nn.conf.misc  # noqa: F401
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     Cnn3DToFeedForwardPreProcessor, CnnToFeedForwardPreProcessor,

@@ -1,0 +1,246 @@
+"""Sharded embedding-bag layer for the recommender tier (ROADMAP item 1).
+
+Recommender traffic's defining workload is an embedding table too large
+for any single device: the table row-shards over the mesh's ``model``
+axis and every lookup becomes a *sparse* collective.  Following the
+scaling characterization of sparse communication (arXiv:1810.11112) the
+lookup is two-phase — ids are deduplicated FIRST (host-side per row in
+``RaggedFeatureReader``, then batch-wide with a fixed-size ``unique``
+here), and only unique rows cross the interconnect:
+
+  phase 1  dedup     ids (B, S) → uniq (U,) + inverse map   (no comms)
+  phase 2  exchange  each rank resolves a chunk of ``uniq`` by asking
+                     the owning shard via ``lax.all_to_all`` (the same
+                     dispatch machinery as ``moe_apply_expert_parallel``
+                     with ids instead of token activations), then
+                     ``all_gather`` of the resolved rows
+  pooling  segment-sum over each bag with the per-id weights (mask /
+           multiplicity counts) — sum or mean combiner
+
+Two implementations share bit-identical numerics:
+
+* ``ShardedEmbeddingBag.forward`` uses the dense fixed-shape path
+  (``bag_lookup_dedup``).  Under ``MeshTrainer`` the table carries
+  ``P("model")`` via the ``rowShardedParamKeys`` plan rule and GSPMD
+  partitions the gather/scatter itself — DP × table-parallel × ZeRO-1
+  compose in the ONE fused step executable with zero steady-state
+  recompiles, and the optimizer moments shard alongside the table
+  (arXiv:2004.13336 weight-update sharding) because ``opt_shardings``
+  mirrors any moment tensor shaped like its param.
+* ``embedding_lookup_table_parallel`` is the explicit ``shard_map``
+  spelling of phase 2 for when manual placement is required (serving
+  meshes, comms benchmarking); it is equivalence-tested against the
+  dense path.
+
+Reference analogue: ``org/deeplearning4j/nn/conf/layers/
+EmbeddingLayer.java`` bag-pooled; the sharding has no DL4J counterpart.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.weights import init_weight
+# canonical hash lives with the ingestion pipeline (pure numpy — ETL
+# workers must never import jax, and THIS module imports jax); re-export
+# so layer users get hashing and lookup from one place
+from deeplearning4j_tpu.datavec.pipeline import hash_feature  # noqa: F401
+
+__all__ = ["ShardedEmbeddingBag", "bag_lookup", "bag_lookup_dedup",
+           "embedding_lookup_table_parallel", "hash_feature",
+           "alltoall_bytes_per_lookup"]
+
+
+def _pool(e, weights, combiner: str):
+    """Weighted segment-sum over the bag axis: ``e`` (R, S, D) ×
+    ``weights`` (R, S) → (R, D).  Weights carry both the padding mask
+    and host-side dedup multiplicity counts."""
+    pooled = (e * weights[..., None]).sum(axis=1)
+    if combiner == "mean":
+        pooled = pooled / jnp.maximum(
+            weights.sum(axis=1), 1.0)[..., None]
+    return pooled
+
+
+def bag_lookup(W, ids, weights, combiner: str = "sum"):
+    """Naive reference lookup: gather every id, pool.  (R, S) ids →
+    (R, D).  The dedup'd paths are equivalence-tested against this."""
+    return _pool(W[ids], weights, combiner)
+
+
+def bag_lookup_dedup(W, ids, weights, combiner: str = "sum",
+                     dedupSize: int = 0):
+    """Two-phase dense lookup: batch-wide fixed-size dedup, gather only
+    unique rows, scatter back through the inverse map, pool.
+
+    ``dedupSize`` bounds the unique-id buffer (static shape — the jit
+    cache never re-traces on the actual duplicate ratio).  0 means
+    ``ids.size`` (always lossless); a smaller value trades memory /
+    gather volume against a hard cap that MUST be >= the true number of
+    distinct ids in the batch, or rows are silently dropped.
+
+    Bit-identical to ``bag_lookup``: ``W[uniq][inv]`` gathers exactly
+    the rows ``W[ids]`` would, and the pooling sum runs in the same
+    order.
+    """
+    flat = ids.reshape(-1)
+    size = min(int(dedupSize), flat.shape[0]) if dedupSize else flat.shape[0]  # jaxlint: sync-ok -- dedupSize is static layer config, sizes the unique buffer at trace time
+    uniq, inv = jnp.unique(flat, size=size, fill_value=0,
+                           return_inverse=True)
+    e = W[uniq][inv].reshape(*ids.shape, -1)
+    return _pool(e, weights, combiner)
+
+
+def alltoall_bytes_per_lookup(numRanks: int, uniqSize: int,
+                              embeddingDim: int,
+                              rowBytes: int = 4, idBytes: int = 4) -> int:
+    """Interconnect bytes one table-parallel lookup moves (per model
+    group): the id request all-to-all + the resolved-row all-to-all +
+    the row all-gather.  Static — feeds the
+    ``dl4j_tpu_recsys_alltoall_bytes_total`` counter without touching
+    device buffers."""
+    ids_phase = numRanks * uniqSize * idBytes
+    rows_phase = numRanks * uniqSize * embeddingDim * rowBytes
+    gather_phase = numRanks * uniqSize * embeddingDim * rowBytes
+    return ids_phase + rows_phase + gather_phase
+
+
+def embedding_lookup_table_parallel(mesh, W, ids, weights=None,
+                                    combiner: str = "sum",
+                                    dedupSize: int = 0,
+                                    axis_name: str = "model",
+                                    data_axis: str = "data"):
+    """Explicit table-parallel bag lookup: ``W`` (N, D) row-sharded over
+    ``axis_name``, ``ids``/``weights`` (B, S) batch-sharded over
+    ``data_axis``.  Generalizes ``moe_apply_expert_parallel``'s
+    dispatch: the one-hot-cumsum position computation that packs tokens
+    into per-expert capacity buckets here packs unique *ids* into
+    per-owner request buckets, and the same paired ``lax.all_to_all``
+    moves requests out and resolved rows back.  Capacity per owner
+    equals the chunk size, so the exchange is lossless (at most C ids
+    of a C-chunk can land on one owner).
+
+    Returns pooled bags (B, D), replicated over ``axis_name`` and
+    sharded over ``data_axis`` like the inputs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    jmesh = getattr(mesh, "mesh", mesh)
+    m = jmesh.shape[axis_name]
+    N, D = W.shape
+    if N % m:
+        raise ValueError(
+            f"table rows {N} not divisible by {axis_name} axis size {m}")
+    rowsPerShard = N // m
+    # static per-rank unique buffer, padded to a multiple of the axis
+    # size so every rank resolves an equal chunk
+    localB = ids.shape[0] // jmesh.shape[data_axis]
+    T = localB * ids.shape[1]
+    U = min(int(dedupSize), T) if dedupSize else T  # jaxlint: sync-ok -- dedupSize is a static python argument sizing the trace-time buffer
+    U = -(-U // m) * m
+    C = U // m
+    if weights is None:
+        weights = jnp.ones(ids.shape, W.dtype)
+
+    def _lookup(W_loc, ids_loc, w_loc):
+        r = lax.axis_index(axis_name)
+        flat = ids_loc.reshape(-1)
+        # phase 1: batch-wide dedup (fixed size — shape-static under jit)
+        uniq, inv = jnp.unique(flat, size=U, fill_value=0,
+                               return_inverse=True)
+        # phase 2: this rank resolves chunk r of the unique ids
+        chunk = lax.dynamic_slice_in_dim(uniq, r * C, C)
+        owner = jnp.clip(chunk // rowsPerShard, 0, m - 1)
+        onehot = jax.nn.one_hot(owner, m, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot
+        slot = pos.sum(-1) - 1                       # position in owner bucket
+        disp = jnp.full((m, C), 0, dtype=chunk.dtype)
+        disp = disp.at[owner, slot].set(chunk)
+        req = lax.all_to_all(disp, axis_name, split_axis=0, concat_axis=0)
+        lo = r * rowsPerShard
+        served = W_loc[jnp.clip(req - lo, 0, rowsPerShard - 1)]
+        resp = lax.all_to_all(served, axis_name, split_axis=0, concat_axis=0)
+        emb_chunk = resp[owner, slot]                # (C, D) rows for my chunk
+        emb_uniq = lax.all_gather(emb_chunk, axis_name, axis=0, tiled=True)
+        e = emb_uniq[inv].reshape(*ids_loc.shape, -1)
+        return _pool(e, w_loc, combiner)
+
+    fn = jax.shard_map(
+        _lookup, mesh=jmesh,
+        in_specs=(P(axis_name), P(data_axis), P(data_axis)),
+        out_specs=P(data_axis), check_vma=False)
+    return fn(W, ids, weights)
+
+
+@register_layer
+@dataclasses.dataclass
+class ShardedEmbeddingBag(BaseLayer):
+    """Pooled embedding lookup over bags of hashed feature ids, with a
+    table that row-shards across the mesh ``model`` axis.
+
+    Input (FF): (b, numFields * bagSize) float-encoded int ids (the
+    fit path casts features to float32; ids survive exactly up to
+    2**24).  ``featuresMask`` of the same shape carries per-id weights:
+    0 pads ragged bags, >1 carries host-side dedup multiplicity from
+    ``RaggedFeatureReader``.  Output: (b, numFields * embeddingDim)
+    pooled field embeddings.
+
+    ``rowShardedParamKeys`` is the ``ShardingPlan`` hook (mirror of the
+    MoE ``expertParamKeys`` rule): when the table's leading dim divides
+    the model-axis size the plan places ``P("model")`` on it, GSPMD
+    partitions the lookup inside the single fused step, and the Adam
+    moments shard alongside the rows.
+    """
+    numEmbeddings: int = 0
+    embeddingDim: int = 0
+    numFields: int = 1
+    bagSize: int = 0
+    combiner: str = "sum"          # | "mean"
+    dedupSize: int = 0             # 0 = lossless (ids.size) unique buffer
+
+    acceptsMask = True             # featuresMask = per-id bag weights
+
+    def preferredFormat(self):
+        return "FF"
+
+    def inferNIn(self, inputType):
+        if not self.bagSize:
+            if inputType.size % self.numFields:
+                raise ValueError(
+                    f"input size {inputType.size} not divisible by "
+                    f"numFields {self.numFields}")
+            self.bagSize = inputType.size // self.numFields
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.numFields * self.embeddingDim)
+
+    def initParams(self, key, inputType, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        return {"W": init_weight(
+            kW, (self.numEmbeddings, self.embeddingDim),
+            self.numEmbeddings, self.embeddingDim,
+            self.weightInit or "XAVIER", dtype)}
+
+    def rowShardedParamKeys(self):
+        """Params whose LEADING dim row-shards over the model axis."""
+        return ("W",)
+
+    def forward(self, params, x, train, key, state, mask=None):
+        ids = x.astype(jnp.int32)
+        b = x.shape[0]
+        w = mask.astype(x.dtype) if mask is not None \
+            else jnp.ones(x.shape, x.dtype)
+        # bag width comes from the BATCH, not the config: the ragged
+        # reader pads each batch to the smallest bucket that fits, so
+        # one stream legitimately spans several widths (one executable
+        # per bucket); ``bagSize`` is only the declared/inferred default
+        ids2 = ids.reshape(b * self.numFields, -1)
+        w2 = w.reshape(b * self.numFields, -1)
+        pooled = bag_lookup_dedup(params["W"], ids2, w2, self.combiner,
+                                  self.dedupSize)
+        return pooled.reshape(b, self.numFields * self.embeddingDim), state

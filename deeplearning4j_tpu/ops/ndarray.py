@@ -23,7 +23,8 @@ import numpy as np
 
 from deeplearning4j_tpu.ops.dtype import DataType, from_np, promote
 
-__all__ = ["NDArray", "NDArrayIndex", "set_host_only_arrays"]
+__all__ = ["NDArray", "NDArrayIndex", "host_only_arrays",
+           "set_host_only_arrays"]
 
 # When True, NDArray keeps numpy values as numpy instead of converting
 # through ``jnp.asarray``.  Set (process-locally) by the ETL producer-pool
@@ -38,6 +39,12 @@ _HOST_ONLY = False
 def set_host_only_arrays(on: bool = True) -> None:
     global _HOST_ONLY
     _HOST_ONLY = bool(on)
+
+
+def host_only_arrays() -> bool:
+    """True inside an ETL producer-pool worker (no jax, no parent
+    telemetry) — readers use this to skip metric reporting there."""
+    return _HOST_ONLY
 
 
 class NDArrayIndex:

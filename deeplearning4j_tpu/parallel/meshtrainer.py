@@ -209,6 +209,9 @@ class ShardingPlan:
       shard over ``model`` (TP) when divisible;
     - expert layers (``expertParamKeys``) shard their leading expert dim
       over ``model`` (EP — ``model`` doubles as the expert axis);
+    - embedding tables (``rowShardedParamKeys``) row-shard their leading
+      dim over ``model`` (table parallelism — the recommender tier's
+      too-big-for-one-device tables), moments following the rows;
     - with ``zero1``, optimizer-state leaves shard their largest
       divisible dim over ``data`` (the arXiv:2004.13336 sharded weight
       update: gradients reduce-scatter into the sharded updater math,
@@ -247,6 +250,13 @@ class ShardingPlan:
                     and shape[0] % msize == 0:
                 # EP: leading expert dim over the model axis — each
                 # device group materializes only its own experts
+                return P(self.modelAxis)
+            rkeys = getattr(layer, "rowShardedParamKeys", None)
+            if rkeys is not None and pname in rkeys() and shape \
+                    and shape[0] % msize == 0:
+                # table-parallel embeddings: rows over the model axis;
+                # opt_shardings mirrors the spec onto the Adam moments,
+                # so the optimizer rows shard alongside the table
                 return P(self.modelAxis)
             if self.tensorParallel:
                 spec = _dense_tp_spec(pname, shape, self.modelAxis)

@@ -517,7 +517,8 @@ class ContinuousBatcher:
                 for i in range(toks.shape[0])]
         return seqs, parent
 
-    def _admitGate(self, rows: int, pages: int) -> None:
+    def _admitGate(self, rows: int, pages: int,
+                   singleStep: bool = False) -> None:
         sm = serving_metrics()
         queued = self.queuedRows()
         sm.queue_depth().set(queued, model=self.name)
@@ -527,9 +528,13 @@ class ContinuousBatcher:
             # page-headroom shed is about WEDGE risk, not backlog: a
             # queued sequence holds no pages, so only a request that
             # cannot fit the CURRENT free list sheds (backlog depth is
-            # the queue-depth rule's job)
+            # the queue-depth rule's job).  Single-step retrieval
+            # sequences (quota == 1) emit at admission and retire before
+            # any decode step — they never hold pages, so the deficit
+            # shed does not apply to them.
             kv = self.admission.checkKv(self.pool.freePages(), pages,
-                                        self._retireRate())
+                                        self._retireRate(),
+                                        holdsPages=not singleStep)
             if kv is not None:
                 fired, retryAfter = kv[:2], kv[2]
         if fired is not None:
@@ -558,7 +563,8 @@ class ContinuousBatcher:
         malformed payloads, :class:`ServiceOverloaded` (429) when
         admission sheds."""
         seqs, parent = self._makeSeqs(payload)
-        self._admitGate(len(seqs), sum(s.pages for s in seqs))
+        self._admitGate(len(seqs), sum(s.pages for s in seqs),
+                        singleStep=(parent.quota == 1))
         self._enqueue(seqs)
         if not parent.event.wait(timeout):
             # reap still-QUEUED rows now — left behind they would keep
@@ -600,7 +606,7 @@ class ContinuousBatcher:
                              "request")
         seq = seqs[0]
         seq.streamQ = _stdqueue.Queue()
-        self._admitGate(1, seq.pages)
+        self._admitGate(1, seq.pages, singleStep=(seq.quota == 1))
         self._enqueue(seqs)
 
         def gen():

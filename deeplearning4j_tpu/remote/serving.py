@@ -178,12 +178,20 @@ class AdmissionControl:
         return None
 
     def checkKv(self, freePages: int, neededPages: int,
-                retireRate: float) -> Optional[Tuple[str, str, float]]:
+                retireRate: float,
+                holdsPages: bool = True) -> Optional[Tuple[str, str, float]]:
         """KV-page headroom shed for paged executors: reject a request
         whose pages don't fit the pool's free list (beyond the
         ``minFreePages`` reserve) BEFORE it queues — an admitted
         sequence that can't grow its cache preempts its neighbours, so
         page exhaustion must degrade at the door, not wedge the batch.
+
+        ``holdsPages=False`` bypasses the shed entirely: single-step
+        retrieval sequences (top-k recommender lookups, quota == 1)
+        emit their whole answer at admission and retire before any
+        decode step, so they never occupy KV pages and cannot wedge the
+        batch — a page deficit must not 429 them.  Queue-depth rules
+        (``check``) still apply.
 
         Returns ``(rule, detail, retryAfter)`` or None.  The
         ``Retry-After`` is the page DEFICIT divided by the pool's
@@ -192,6 +200,8 @@ class AdmissionControl:
         instead of a fixed guess — clamped to
         [``retryAfter``, ``maxKvRetryAfter``].
         """
+        if not holdsPages:
+            return None
         # jaxlint: disable=host-sync -- page counts and retire rates are host-side free-list bookkeeping, not device scalars
         headroom = int(freePages) - self.minFreePages
         needed = int(neededPages)  # jaxlint: disable=host-sync -- host page count

@@ -42,10 +42,11 @@ from deeplearning4j_tpu.telemetry.health import (  # noqa: F401
     health_summary)
 from deeplearning4j_tpu.telemetry.instrument import (  # noqa: F401
     AotCacheMetrics, CoordMetrics, ElasticMetrics, EtlMetrics, MeshMetrics,
-    ReplicaTimingListener, ServingMetrics, aot_metrics, coord_metrics,
-    elastic_metrics, etl_fetch, etl_metrics, in_microbatch, mesh_metrics,
-    microbatch_scope, note_etl_wait, record_crash, record_logical_step,
-    replica_step_gauge, serving_metrics, supervised_scope, train_step_span)
+    RecsysMetrics, ReplicaTimingListener, ServingMetrics, aot_metrics,
+    coord_metrics, elastic_metrics, etl_fetch, etl_metrics, in_microbatch,
+    mesh_metrics, microbatch_scope, note_etl_wait, record_crash,
+    record_logical_step, recsys_metrics, replica_step_gauge, serving_metrics,
+    supervised_scope, train_step_span)
 from deeplearning4j_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
     get_registry, set_registry)

@@ -722,6 +722,63 @@ def aot_metrics() -> AotCacheMetrics:
     return _AOT_METRICS
 
 
+#: a top-k retrieval request is one prefill (+ k-1 fixed-shape decode
+#: steps): sub-ms warm on the CPU proxy to tens of ms under queueing —
+#: resolution concentrated under 100 ms where the serving SLO lives
+RECSYS_TOPK_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5)
+
+
+class RecsysMetrics:
+    """The ``dl4j_tpu_recsys_*`` namespace, registered from ONE site.
+
+    The recommender tier reports here: ingestion volume and dedup
+    effectiveness from ``RaggedFeatureReader`` (host-side per-row
+    unique of hashed ids), the interconnect bytes a table-parallel
+    lookup moves (computed statically from the exchange shapes — no
+    device sync), and end-to-end top-k retrieval latency through
+    ``ContinuousBatcher``.  Accessors re-resolve through
+    :func:`get_registry` on every call (tests swap the registry).
+    """
+
+    def lookup_rows(self):
+        return get_registry().counter(
+            "dl4j_tpu_recsys_lookup_rows_total",
+            "Embedding ids ingested for lookup, by pipeline phase "
+            "(raw = before host-side dedup, stored = after)",
+            labelnames=("phase",))
+
+    def alltoall_bytes(self):
+        return get_registry().counter(
+            "dl4j_tpu_recsys_alltoall_bytes_total",
+            "Interconnect bytes moved by table-parallel sparse "
+            "lookups (id requests + resolved rows + row all-gather), "
+            "computed from static exchange shapes")
+
+    def dedup_ratio(self):
+        return get_registry().gauge(
+            "dl4j_tpu_recsys_dedup_ratio",
+            "stored/raw id ratio of the last ingested ragged batch "
+            "(1.0 = no duplicates; lower is better)")
+
+    def topk_latency(self):
+        return get_registry().histogram(
+            "dl4j_tpu_recsys_topk_latency_seconds",
+            "End-to-end top-k retrieval latency through the "
+            "continuous batcher (submit to ranked ids)",
+            buckets=RECSYS_TOPK_BUCKETS)
+
+
+_RECSYS_METRICS = RecsysMetrics()
+
+
+def recsys_metrics() -> RecsysMetrics:
+    """Accessor for the shared recommender-tier metric namespace (see
+    :class:`RecsysMetrics`)."""
+    return _RECSYS_METRICS
+
+
 def note_etl_wait(seconds: float, owner) -> None:
     """Record blocking ETL wait incurred outside ``next()``
     (AsyncDataSetIterator blocks in ``hasNext()`` to populate its peek),

@@ -1,6 +1,7 @@
 """Model zoo (reference: deeplearning4j-zoo org/deeplearning4j/zoo)."""
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
-    AlexNet, LeNet, ResNet50, SimpleCNN, VGG16, ZooModel)
+    DLRM, AlexNet, LeNet, ResNet50, SimpleCNN, TwoTowerRecommender, VGG16,
+    ZooModel)
 from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertConfig  # noqa: F401
 from deeplearning4j_tpu.zoo.models2 import (  # noqa: F401
     C3D, Darknet19, InceptionResNetV1, SqueezeNet, TinyYOLO, UNet, VGG19,

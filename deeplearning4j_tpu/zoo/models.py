@@ -247,3 +247,76 @@ class ResNet50(ZooModel):
         net = ComputationGraph(self.graphBuilder().build())
         net.init()
         return net
+
+
+@dataclasses.dataclass
+class TwoTowerRecommender(ZooModel):
+    """Two-tower retrieval model over a shared hashed-id embedding
+    table (recommender tier, ROADMAP item 1): user-feature bag and
+    item-feature bag pool through ONE ``ShardedEmbeddingBag`` (the
+    table row-shards over the mesh ``model`` axis when trained under a
+    ``ShardingPlan``), scored by the dot-product affinity head with
+    binary cross-entropy.  Input: (b, 2*bagSize) float-encoded hashed
+    ids — user bag | item bag; labels (b, 1) click/no-click.  Serve
+    with ``RetrievalLM.from_two_tower(net)``."""
+    numClasses: int = 1
+    numEmbeddings: int = 8192
+    embeddingDim: int = 16
+    bagSize: int = 16
+
+    def init(self) -> MultiLayerNetwork:
+        from deeplearning4j_tpu.models.recsys import DotProductScorer
+        from deeplearning4j_tpu.nn.conf.embedding import ShardedEmbeddingBag
+        conf = (NeuralNetConfiguration.builder().seed(self.seed)
+                .updater(Adam(1e-3)).weightInit("XAVIER")
+                .list()
+                .layer(ShardedEmbeddingBag.builder()
+                       .numEmbeddings(self.numEmbeddings)
+                       .embeddingDim(self.embeddingDim)
+                       .numFields(2).build())
+                .layer(DotProductScorer.builder()
+                       .embeddingDim(self.embeddingDim).build())
+                .setInputType(InputType.feedForward(2 * self.bagSize))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+
+@dataclasses.dataclass
+class DLRM(ZooModel):
+    """DLRM-style ranking model (recommender tier): sharded embedding
+    bags per categorical field, pairwise-dot feature interaction, dense
+    MLP head.  Input: (b, numFields*bagSize) hashed ids; labels
+    (b, numClasses) one-hot."""
+    numClasses: int = 2
+    numEmbeddings: int = 8192
+    embeddingDim: int = 16
+    numFields: int = 4
+    bagSize: int = 8
+    denseUnits: Tuple[int, ...] = (64, 32)
+
+    def init(self) -> MultiLayerNetwork:
+        from deeplearning4j_tpu.models.recsys import FeatureInteractionLayer
+        from deeplearning4j_tpu.nn.conf.embedding import ShardedEmbeddingBag
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER")
+             .list()
+             .layer(ShardedEmbeddingBag.builder()
+                    .numEmbeddings(self.numEmbeddings)
+                    .embeddingDim(self.embeddingDim)
+                    .numFields(self.numFields).build())
+             .layer(FeatureInteractionLayer.builder()
+                    .numFields(self.numFields).build()))
+        for nOut in self.denseUnits:
+            b.layer(DenseLayer.builder().nOut(nOut)
+                    .activation("relu").build())
+        conf = (b.layer(OutputLayer.builder("mcxent")
+                        .nOut(self.numClasses).activation("softmax")
+                        .build())
+                .setInputType(InputType.feedForward(
+                    self.numFields * self.bagSize))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net

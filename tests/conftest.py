@@ -85,6 +85,11 @@ def pytest_configure(config):
         "markers", "cbatch: iteration-level continuous-batching tests "
         "(paged KV pool, admit/retire scheduler, token streaming, "
         "speculative decode bit-identity, replica fan-out)")
+    config.addinivalue_line(
+        "markers", "recsys: recommender-tier tests (sharded embedding "
+        "tables, two-phase dedup'd sparse lookup, ragged ingestion "
+        "exactly-once, elastic re-mesh of a row-sharded table, top-k "
+        "retrieval serving through the continuous batcher)")
 
 
 def pytest_collection_modifyitems(config, items):

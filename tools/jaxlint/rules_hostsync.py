@@ -42,6 +42,8 @@ HOT_PATH_SUFFIXES = (
     "parallel/meshtrainer.py",
     "parallel/zero.py",
     "parallel/moe.py",
+    "nn/conf/embedding.py",
+    "models/recsys.py",
     "datavec/pipeline.py",
     "datavec/iterators.py",
     "fault/elastic.py",
