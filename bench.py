@@ -752,6 +752,7 @@ def bench_serving(clients: int = 8, duration: float = 4.0,
 
     cbatch = _bench_continuous_batching()
     spec = _bench_speculative()
+    failover = _bench_serving_failover()
 
     window = t_end - marks.get("t0", t_start)
     lat.sort()
@@ -784,6 +785,7 @@ def bench_serving(clients: int = 8, duration: float = 4.0,
         "window_seconds": round(window, 2),
         **cbatch,
         **spec,
+        **failover,
     }
 
 
@@ -857,6 +859,105 @@ def _bench_continuous_batching(duration: float = 4.0, maxSlots: int = 8,
         "cbatch_jit_cache_misses_steady": int(misses),
         "cbatch_slots": maxSlots,
         "cbatch_clients": clients,
+    }
+
+
+def _bench_serving_failover(replicas: int = 3, clients: int = 6,
+                            maxNewTokens: int = 24) -> dict:
+    """Serving fault-tolerance benchmark (ISSUE 17 acceptance):
+    streaming clients against a :class:`ReplicaSet` while one replica
+    is CRASHED mid-window (probe retirement + in-flight failover
+    replay) and, after the window, a second is drained via
+    ``scaleDown``.  Reported: failover count, request p99 during the
+    crash window, drain p99 (the ``dl4j_tpu_serving_drain_seconds``
+    histogram), and whether every stream matched the fault-free
+    reference bit-for-bit — exactly-once delivery ACROSS the crash is
+    part of the measurement, not a separate test."""
+    from deeplearning4j_tpu.fault import injection as _inj
+    from deeplearning4j_tpu.nlp.transformer import TransformerLM
+    from deeplearning4j_tpu.remote import ContinuousBatcher, ReplicaSet
+    from deeplearning4j_tpu.remote.serving import histogram_quantile
+    from deeplearning4j_tpu.telemetry import get_registry, serving_metrics
+
+    def lm():
+        # identical weights per replica: greedy replay on a survivor is
+        # bit-identical, so "streams exact" witnesses exactly-once
+        return TransformerLM(vocabSize=64, nLayers=1, nHeads=2,
+                             headSize=8, maxLen=96, seed=7)
+
+    rs = ReplicaSet(lambda idx: ContinuousBatcher(lm(), maxSlots=2,
+                                                  pageSize=8),
+                    name="fobench", replicas=replicas,
+                    maxReplicas=replicas, probeInterval=0.05,
+                    probeTimeout=2.0, probeFailThreshold=2,
+                    drainTimeout=10.0, seed=0).start()
+    ref = lm()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 64, (int(rng.randint(4, 12)),)
+                           ).astype(np.int32) for _ in range(clients)]
+    refs = [[int(t) for t in ref.generate(p[None, :], maxNewTokens)[0]]
+            for p in prompts]
+    lat: list = []
+    exact: list = []
+    import threading as _th
+    lock = _th.Lock()
+
+    def client(i):
+        t0 = time.perf_counter()
+        try:
+            got = [t for t in rs.submitStream(
+                {"tokens": prompts[i].tolist(),
+                 "maxNewTokens": maxNewTokens}) if isinstance(t, int)]
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+                exact.append(got == refs[i])
+        except Exception:
+            with lock:
+                exact.append(False)
+
+    try:
+        # slow decode slightly so the crash lands mid-stream, not after
+        for idx in range(replicas):
+            _inj.set_replica_slowdown(f"fobench/{idx}", 0.01)
+        threads = [_th.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        for th in threads:
+            th.start()
+        time.sleep(0.05)
+        _inj.arm_replica_crash("fobench/1")
+        for th in threads:
+            th.join(timeout=120)
+        _inj.clear_serving_faults()
+        # graceful drain of one more replica, now that streams are done
+        rs.scaleDown()
+        drain_p99 = None
+        end = time.monotonic() + 15.0
+        while time.monotonic() < end:
+            drain_p99 = histogram_quantile(
+                serving_metrics().drain_seconds(), 0.99, model="fobench")
+            if drain_p99 is not None:
+                break
+            time.sleep(0.05)
+        fo = get_registry().get("dl4j_tpu_serving_failovers_total")
+        try:
+            failovers = int(fo.value(model="fobench")) if fo else 0
+        except ValueError:
+            failovers = 0
+    finally:
+        _inj.clear_serving_faults()
+        rs.shutdown()
+    lat.sort()
+    p99 = round(lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3, 2) \
+        if lat else None
+    return {
+        "failover_count": failovers,
+        "failover_crash_window_p99_ms": p99,
+        "failover_drain_p99_s": round(drain_p99, 4)
+        if drain_p99 is not None else None,
+        "failover_streams_exact": bool(exact) and all(exact),
+        "failover_clients": clients,
+        "failover_replicas": replicas,
     }
 
 

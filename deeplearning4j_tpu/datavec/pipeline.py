@@ -57,7 +57,7 @@ import threading
 import time
 import weakref
 from multiprocessing import shared_memory as _shm
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -880,7 +880,9 @@ class RaggedFeatureReader(DataSetIterator):
 
     def __init__(self, records, batchSize: int, numEmbeddings: int,
                  numClasses: int, bagBuckets=(4, 8, 16, 32, 64, 128),
-                 numFields: int = 1, hashInputs: bool = True):
+                 numFields: int = 1, hashInputs: bool = True,
+                 collisionSampleEvery: int = 8,
+                 collisionSampleSize: int = 4096):
         self.records = list(records)
         self.batchSize = int(batchSize)
         self.numEmbeddings = int(numEmbeddings)
@@ -888,6 +890,16 @@ class RaggedFeatureReader(DataSetIterator):
         self.bagBuckets = tuple(sorted(int(b) for b in bagBuckets))
         self.numFields = int(numFields)
         self.hashInputs = bool(hashInputs)
+        # sampled collision estimator: hashed rows whose id falls on
+        # the sample stride remember the FIRST raw value seen; a later
+        # DIFFERENT raw value on the same row is a witnessed collision
+        # (counted once per distinct pair).  Both dicts are bounded —
+        # the estimator must never grow with stream length.
+        # 0 disables sampling entirely.
+        self.collisionSampleEvery = int(collisionSampleEvery)
+        self.collisionSampleSize = int(collisionSampleSize)
+        self._collisionSeen: Dict[int, int] = {}
+        self._collisionHits: set = set()
         self._i = 0
 
     # -- SPI ------------------------------------------------------------
@@ -901,6 +913,7 @@ class RaggedFeatureReader(DataSetIterator):
             raise StopIteration("reader exhausted: call reset() first")
         self._i += len(rows)
         bags, labels, rawLens = [], [], []
+        collisions = 0
         for values, label in rows:
             fields = values if self.numFields > 1 else (values,)
             if len(fields) != self.numFields:
@@ -908,9 +921,14 @@ class RaggedFeatureReader(DataSetIterator):
                     f"record has {len(fields)} fields, expected "
                     f"{self.numFields}")
             for vals in fields:
-                ids = hash_feature(vals, self.numEmbeddings) \
-                    if self.hashInputs \
-                    else np.asarray(vals, dtype=np.int64)  # jaxlint: sync-ok -- host-side ingestion of raw record ids
+                if self.hashInputs:
+                    ids = hash_feature(vals, self.numEmbeddings)
+                    if self.collisionSampleEvery > 0:
+                        collisions += self._sampleCollisions(
+                            ids,
+                            np.asarray(vals, dtype=np.int64))  # jaxlint: sync-ok -- host-side raw record ids
+                else:
+                    ids = np.asarray(vals, dtype=np.int64)  # jaxlint: sync-ok -- host-side ingestion of raw record ids
                 uniq, counts = np.unique(ids, return_counts=True)
                 bags.append((uniq, counts.astype(np.float32)))
                 rawLens.append(len(ids))
@@ -928,9 +946,38 @@ class RaggedFeatureReader(DataSetIterator):
         l[np.arange(b), np.asarray(labels, dtype=np.int64)] = 1.0  # jaxlint: sync-ok -- host-side one-hot of python record labels
         offsets = np.zeros(len(bags) + 1, dtype=np.int64)
         np.cumsum(rawLens, out=offsets[1:])
-        self._note_batch(int(offsets[-1]), sum(len(u) for u, _ in bags))
+        self._note_batch(int(offsets[-1]), sum(len(u) for u, _ in bags),
+                         collisions)
         return self._applyPre(
             DataSet(f, l, featuresMask=w, offsets=offsets))
+
+    def _sampleCollisions(self, hashed: np.ndarray,
+                          raw: np.ndarray) -> int:
+        """Count NEWLY witnessed hash collisions among the sampled
+        stride of this bag.  A collision is two distinct raw ids on one
+        hashed row — silent by construction (the lookup math is
+        perfectly happy serving both users one embedding), so witnessing
+        is the only detection there is.  Sampling ``1/sampleEvery`` of
+        rows keeps the memory and per-batch cost bounded; scale the
+        counter by ``sampleEvery`` for a population estimate."""
+        sel = hashed % self.collisionSampleEvery == 0
+        if not sel.any():
+            return 0
+        count = 0
+        seen = self._collisionSeen
+        for h, r in zip(hashed[sel].tolist(), raw[sel].tolist()):
+            first = seen.get(h)
+            if first is None:
+                if len(seen) < self.collisionSampleSize:
+                    seen[h] = r
+            elif first != r:
+                key = (h, r)
+                if key not in self._collisionHits and \
+                        len(self._collisionHits) < \
+                        self.collisionSampleSize:
+                    self._collisionHits.add(key)
+                    count += 1
+        return count
 
     def _bucket_for(self, longest: int) -> int:
         for bkt in self.bagBuckets:
@@ -941,7 +988,8 @@ class RaggedFeatureReader(DataSetIterator):
             f"{self.bagBuckets[-1]} — raise bagBuckets (silent "
             "truncation would violate exactly-once ingestion)")
 
-    def _note_batch(self, raw: int, stored: int) -> None:
+    def _note_batch(self, raw: int, stored: int,
+                    collisions: int = 0) -> None:
         # ingestion telemetry — but ONLY in the parent process: a pool
         # worker must not import jax-adjacent modules, and its registry
         # would be discarded anyway
@@ -953,6 +1001,8 @@ class RaggedFeatureReader(DataSetIterator):
         rm.lookup_rows().inc(raw, phase="raw")
         rm.lookup_rows().inc(stored, phase="stored")
         rm.dedup_ratio().set(stored / max(raw, 1))
+        if collisions:
+            rm.hash_collisions().inc(collisions)
 
     def reset(self) -> None:
         self._i = 0
@@ -979,7 +1029,9 @@ class RaggedFeatureReader(DataSetIterator):
             self.records[index::count], self.batchSize,
             self.numEmbeddings, self.numClasses,
             bagBuckets=self.bagBuckets, numFields=self.numFields,
-            hashInputs=self.hashInputs)
+            hashInputs=self.hashInputs,
+            collisionSampleEvery=self.collisionSampleEvery,
+            collisionSampleSize=self.collisionSampleSize)
         if self.getPreProcessor() is not None:
             out.setPreProcessor(self.getPreProcessor())
         return out

@@ -6,18 +6,21 @@ exercises every path (NaN at step k, simulated preemption, checkpoint
 corruption, device OOM, slow/failing data fetches).
 """
 from deeplearning4j_tpu.fault.injection import (  # noqa: F401
-    CorruptCheckpointAtStep, DelayedHeartbeat, DeviceLossAtStep,
-    FailingFetch, Fault, FaultInjector, InjectedDeviceLoss, InjectedOOM,
+    ClientHangupAtToken, CorruptCheckpointAtStep, DeadlineStorm,
+    DelayedHeartbeat, DeviceLossAtStep, FailingFetch, Fault,
+    FaultInjector, InjectedDeviceLoss, InjectedOOM, InjectedReplicaCrash,
     KillAtBarrier, LeaderCrashMidBarrier, NaNAtStep, OOMAtStep,
-    PartitionedHost, PreemptAtStep, RestoreCapacityAtStep,
-    SimulatedPreemption, SlowFetch, StallAtStep, StragglerReplica,
-    arm_barrier_kill, arm_leader_crash, clear_barrier_kills,
+    PartitionedHost, PreemptAtStep, ReplicaCrashAtStep,
+    RestoreCapacityAtStep, SimulatedPreemption, SlowFetch, SlowReplica,
+    StallAtStep, StragglerReplica, arm_barrier_kill, arm_leader_crash,
+    arm_replica_crash, check_replica_crash, clear_barrier_kills,
     clear_heartbeat_delays, clear_injector, clear_leader_crashes,
-    clear_lost_devices, clear_partitioned_hosts, consume_barrier_kill,
-    consume_leader_crash, corrupt_checkpoint, get_injector, heal_host,
-    heartbeat_delay, inject, lose_devices, lost_device_ids,
-    partition_host, partitioned_host_ids, restore_devices,
-    set_heartbeat_delay, set_injector)
+    clear_lost_devices, clear_partitioned_hosts, clear_serving_faults,
+    consume_barrier_kill, consume_leader_crash, corrupt_checkpoint,
+    get_injector, heal_host, heartbeat_delay, inject, lose_devices,
+    lost_device_ids, partition_host, partitioned_host_ids, replica_dead,
+    replica_slowdown, restore_devices, revive_replica,
+    set_heartbeat_delay, set_injector, set_replica_slowdown)
 from deeplearning4j_tpu.fault.supervisor import (  # noqa: F401
     FaultTolerantTrainer, TrainingDivergedError, is_oom_error)
 from deeplearning4j_tpu.fault.elastic import (  # noqa: F401
@@ -27,4 +30,4 @@ from deeplearning4j_tpu.fault.coordination import (  # noqa: F401
     CoordinationError, GenerationFence, HeartbeatLease, PodCoordinator,
     PodEvictedError, ReadmissionPolicy, StaleGenerationError)
 from deeplearning4j_tpu.fault.chaos import (  # noqa: F401
-    ChaosSoak, build_schedule)
+    ChaosSoak, ServingChaosSoak, build_schedule, build_serving_schedule)

@@ -58,7 +58,9 @@ import numpy as np
 from deeplearning4j_tpu.fault import injection as _inj
 from deeplearning4j_tpu.telemetry import coord_metrics, get_registry
 
-__all__ = ["ChaosSoak", "build_schedule", "EVENT_KINDS"]
+__all__ = ["ChaosSoak", "build_schedule", "EVENT_KINDS",
+           "ServingChaosSoak", "build_serving_schedule",
+           "SERVING_EVENT_KINDS"]
 
 log = logging.getLogger(__name__)
 
@@ -690,3 +692,372 @@ class ChaosSoak:
             except Exception:
                 inv["torn_snapshot_skipped"] = False
         return inv
+
+
+# ===================================================================
+# Serving-tier chaos soak (ISSUE 17)
+# ===================================================================
+
+#: serving event kinds the serving scheduler draws from
+SERVING_EVENT_KINDS = ("replica_crash", "slow_replica", "client_hangup",
+                       "deadline_storm")
+
+#: per-schedule caps — one crash and one brownout keep the retirement
+#: count assertable; two hangups and one storm exercise cancellation
+#: without starving the exactly-once clients of decode slots
+_SERVING_CAPS = {"replica_crash": 1, "slow_replica": 1,
+                 "client_hangup": 2, "deadline_storm": 1}
+
+#: the replica index the crash always targets / the brownout always
+#: targets — fixed (not drawn) so replica 0 always survives to adopt
+#: failovers and the invariants stay assertable for every seed
+_CRASH_REPLICA_IDX = 1
+_SLOW_REPLICA_IDX = 2
+
+
+def build_serving_schedule(seed: int, totalTicks: int,
+                           events: int = 4) -> List[dict]:
+    """The seeded serving-fault schedule: a PURE function of its
+    arguments (``np.random.RandomState``), same replayability contract
+    as :func:`build_schedule`.  Every draw lands in the FIRST HALF of
+    the soak's tick budget so its recovery (probe retirement, failover
+    replay, drain) completes inside the run."""
+    # jaxlint: sync-ok -- seed/ticks/events are Python ints, not device scalars
+    rng = np.random.RandomState(int(seed))
+    counts: Dict[str, int] = {k: 0 for k in SERVING_EVENT_KINDS}
+    out: List[dict] = []
+    events = max(0, int(events))  # jaxlint: sync-ok -- Python int argument
+    totalTicks = max(2, int(totalTicks))  # jaxlint: sync-ok -- Python int argument
+    guard = 0
+    while sum(counts.values()) < events and guard < 200:
+        guard += 1
+        kind = SERVING_EVENT_KINDS[int(
+            rng.randint(len(SERVING_EVENT_KINDS)))]
+        if counts[kind] >= _SERVING_CAPS[kind]:
+            continue
+        tick = int(rng.randint(1, max(2, totalTicks // 2)))
+        if kind == "replica_crash":
+            out.append({"step": tick, "kind": kind,
+                        "replica": _CRASH_REPLICA_IDX})
+        elif kind == "slow_replica":
+            out.append({"step": tick, "kind": kind,
+                        "replica": _SLOW_REPLICA_IDX,
+                        "seconds": round(float(rng.uniform(0.05, 0.15)),
+                                         3),
+                        "untilStep": tick + 6 + int(rng.randint(0, 6))})
+        elif kind == "client_hangup":
+            out.append({"step": tick, "kind": kind,
+                        "token": int(rng.randint(1, 4))})
+        elif kind == "deadline_storm":
+            out.append({"step": tick, "kind": kind,
+                        "requests": int(rng.randint(2, 5))})
+        counts[kind] += 1
+    drawn = sum(counts.values())
+    if drawn < events:
+        log.warning("serving chaos schedule capped at %d primary events "
+                    "(%d requested): per-kind caps %s exhausted",
+                    drawn, events, dict(_SERVING_CAPS))
+    out.sort(key=lambda e: (int(e["step"]), str(e["kind"])))
+    return out
+
+
+class ServingChaosSoak:
+    """One seeded serving chaos soak: ragged streaming clients against a
+    3-replica :class:`~deeplearning4j_tpu.remote.scheduler.ReplicaSet`
+    while the schedule crashes one replica, browns out another, hangs
+    up clients mid-stream and fires a burst of already-expired
+    requests.  Invariants:
+
+    1. **exactly-once tokens** — every surviving client's stream equals
+       the uninterrupted single-model reference bit-for-bit: zero
+       dropped and zero duplicated tokens across the failover replay;
+    2. **all KV pages freed** — every surviving replica's pool drains
+       back to fully free (crashed streams, hangups and sheds
+       included);
+    3. **flat steady-state jit-miss counter** on every survivor —
+       failover replay and probe traffic compiled nothing new;
+    4. **p99 bounded** while the replica died (generous cap — this
+       asserts no wedge, not a latency SLO);
+    5. **deadline storm shed 504** — every expired request raised
+       ``DeadlineExceeded`` at admission and none ever held a slot.
+
+    The scheduler module is imported lazily: ``fault/__init__`` imports
+    this module at package import, and ``remote.scheduler`` imports
+    ``fault.injection`` — a top-level import here would cycle."""
+
+    def __init__(self, seed: int, *, replicas: int = 3, clients: int = 6,
+                 events: int = 4, totalTicks: int = 40,
+                 maxNewTokens: int = 8, vocab: int = 48, maxLen: int = 64,
+                 tickSeconds: float = 0.02, maxSeconds: float = 120.0):
+        self.seed = int(seed)
+        self.replicas = max(2, int(replicas))
+        self.clients = int(clients)
+        self.events = int(events)
+        self.totalTicks = int(totalTicks)
+        self.maxNewTokens = int(maxNewTokens)
+        self.vocab = int(vocab)
+        self.maxLen = int(maxLen)
+        self.tickSeconds = float(tickSeconds)
+        self.maxSeconds = float(maxSeconds)
+        self.name = f"soak{self.seed}"
+
+    def schedule(self) -> List[dict]:
+        return build_serving_schedule(self.seed, self.totalTicks,
+                                      events=self.events)
+
+    # -- model -----------------------------------------------------------
+    def _lm(self):
+        from deeplearning4j_tpu.nlp.transformer import TransformerLM
+        # every replica gets its OWN instance with IDENTICAL weights
+        # (same seed): greedy decode then replays bit-identically on a
+        # survivor, and each instance owns its own jit cache — required
+        # for the flat-jit-miss invariant, since a crashed replica's
+        # _invalidateFns pops caches on ITS model only
+        return TransformerLM(vocabSize=self.vocab, nLayers=1, nHeads=2,
+                             headSize=8, maxLen=self.maxLen, seed=11)
+
+    def _factory(self, idx: int):
+        from deeplearning4j_tpu.remote.scheduler import ContinuousBatcher
+        return ContinuousBatcher(self._lm(), maxSlots=2, pageSize=8)
+
+    def _prompts(self) -> List[np.ndarray]:
+        rng = np.random.RandomState(self.seed + 1)
+        out = []
+        for _ in range(self.clients):
+            n = int(rng.randint(3, 11))
+            out.append(rng.randint(0, self.vocab,
+                                   size=(n,)).astype(np.int32))
+        return out
+
+    # -- scheduled actions ----------------------------------------------
+    def _launchHangup(self, rs, prompts, rng, threads, k: int) -> None:
+        """A doomed streaming client: reads ``k`` tokens, hangs up.  Its
+        sequence must cancel at the next step boundary and free its
+        pages — the page invariant is the witness."""
+        prompt = prompts[int(rng.randint(len(prompts)))]
+
+        def run():
+            try:
+                gen = rs.submitStream({
+                    "tokens": prompt.tolist(),
+                    "maxNewTokens": self.maxNewTokens,
+                    "keepAliveSeconds": 0.05})
+                got = 0
+                try:
+                    for tok in gen:
+                        if not isinstance(tok, int):
+                            continue            # keep-alive sentinel
+                        got += 1
+                        if got >= k:
+                            break
+                finally:
+                    gen.close()
+            except Exception:
+                pass        # a doomed client's errors are expected noise
+        th = threading.Thread(target=run, daemon=True,
+                              name="soak-hangup-client")
+        th.start()
+        threads.append(th)
+
+    def _fireStorm(self, rs, prompts, rng, results, n: int) -> None:
+        """``n`` already-expired requests: each must shed 504
+        (``DeadlineExceeded``) at admission, never holding a slot."""
+        from deeplearning4j_tpu.remote.serving import DeadlineExceeded
+        prompt = prompts[int(rng.randint(len(prompts)))]
+        for _ in range(n):
+            try:
+                rs.submit({"tokens": prompt.tolist(),
+                           "maxNewTokens": self.maxNewTokens,
+                           "deadlineSeconds": 0.0})
+                results.append(False)       # served an expired request
+            except DeadlineExceeded:
+                results.append(True)
+            except Exception:
+                results.append(False)
+
+    def _buildFaults(self, rs, prompts, rng, hangupThreads, stormResults,
+                     firedLog: List[str]) -> List[_inj.Fault]:
+        faults: List[_inj.Fault] = []
+        for e in self.schedule():
+            kind = e["kind"]
+            if kind == "replica_crash":
+                faults.append(_TrackedFault(kind, _inj.ReplicaCrashAtStep(
+                    f"{self.name}/{e['replica']}", step=e["step"]),
+                    firedLog))
+            elif kind == "slow_replica":
+                faults.append(_TrackedFault(kind, _inj.SlowReplica(
+                    f"{self.name}/{e['replica']}", seconds=e["seconds"],
+                    step=e["step"], untilStep=e["untilStep"]), firedLog))
+            elif kind == "client_hangup":
+                faults.append(_TrackedFault(kind, _inj.ClientHangupAtToken(
+                    e["step"], token=e["token"],
+                    action=lambda k: self._launchHangup(
+                        rs, prompts, rng, hangupThreads, k)), firedLog))
+            elif kind == "deadline_storm":
+                faults.append(_TrackedFault(kind, _inj.DeadlineStorm(
+                    e["step"], requests=e["requests"],
+                    action=lambda n: self._fireStorm(
+                        rs, prompts, rng, stormResults, n)), firedLog))
+            else:
+                raise ValueError(f"unknown serving event kind {kind!r}")
+        return faults
+
+    # -- metric helpers --------------------------------------------------
+    @staticmethod
+    def _sumCells(name: str, **match) -> float:
+        """Sum a labeled metric's cells matching ``match`` — the soak
+        reads per-replica models (``soakN/0`` ...) without enumerating
+        them."""
+        m = get_registry().get(name)
+        if m is None:
+            return 0.0
+        d = m.data()
+        names = d["labelnames"]
+        total = 0.0
+        for labelvalues, value in d["cells"]:
+            cell = dict(zip(names, labelvalues))
+            if all(cell.get(k) == v for k, v in match.items()):
+                total += float(value)  # jaxlint: sync-ok -- registry cell values are host floats
+        return total
+
+    # -- the run ---------------------------------------------------------
+    def run(self) -> dict:
+        from deeplearning4j_tpu.remote.scheduler import ReplicaSet
+
+        schedule = self.schedule()
+        firedLog: List[str] = []
+        prompts = self._prompts()
+        rng = np.random.RandomState(self.seed + 2)
+
+        # the uninterrupted reference: ONE fault-free model decodes every
+        # prompt — greedy decode is deterministic, so this is the oracle
+        # every surviving stream must match bit-for-bit
+        refLm = self._lm()
+        # jaxlint: sync-ok -- reference-run readback for the invariant oracle, not the serving path
+        refs = [[int(t) for t in
+                 refLm.generate(p[None, :], self.maxNewTokens)[0]]
+                for p in prompts]
+
+        rs = ReplicaSet(self._factory, name=self.name,
+                        replicas=self.replicas,
+                        maxReplicas=self.replicas + 1,
+                        drainTimeout=5.0, probeInterval=0.05,
+                        probeTimeout=2.0, probeFailThreshold=2,
+                        seed=self.seed)
+        report = {"seed": self.seed, "ticks": self.totalTicks,
+                  "clients": self.clients, "replicas": self.replicas,
+                  "events": len(schedule), "schedule": schedule}
+        results: List[Optional[List[int]]] = [None] * self.clients
+        errors: List[str] = []
+        latencies: List[float] = []
+        hangupThreads: List[threading.Thread] = []
+        stormResults: List[bool] = []
+        clientThreads: List[threading.Thread] = []
+        t0 = time.perf_counter()
+        try:
+            rs.start()
+            miss0 = self._sumCells(
+                "dl4j_tpu_serving_compile_cache_misses_total")
+            failovers0 = self._sumCells(
+                "dl4j_tpu_serving_failovers_total", model=self.name)
+            sheds0 = self._sumCells(
+                "dl4j_tpu_serving_deadline_sheds_total",
+                stage="admission")
+
+            def client(i: int, delay: float):
+                time.sleep(delay)
+                c0 = time.perf_counter()
+                try:
+                    gen = rs.submitStream({
+                        "tokens": prompts[i].tolist(),
+                        "maxNewTokens": self.maxNewTokens,
+                        "keepAliveSeconds": 0.1})
+                    got = [t for t in gen if isinstance(t, int)]
+                    results[i] = got
+                    latencies.append(time.perf_counter() - c0)
+                except Exception as e:
+                    errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+            # ragged arrivals: clients land spread over the first half
+            # of the tick budget, overlapping the scheduled faults
+            for i in range(self.clients):
+                delay = float(rng.uniform(
+                    0, self.totalTicks * self.tickSeconds * 0.5))
+                th = threading.Thread(target=client, args=(i, delay),
+                                      daemon=True,
+                                      name=f"soak-client-{i}")
+                th.start()
+                clientThreads.append(th)
+
+            faults = self._buildFaults(rs, prompts, rng, hangupThreads,
+                                       stormResults, firedLog)
+            hardStop = time.monotonic() + self.maxSeconds
+            with _inj.inject(*faults) as inj:
+                tick = 0
+                while (tick < self.totalTicks or
+                       any(th.is_alive() for th in clientThreads)):
+                    if time.monotonic() > hardStop:
+                        errors.append("soak exceeded maxSeconds")
+                        break
+                    tick += 1
+                    inj.before_step(tick, None, None)
+                    time.sleep(self.tickSeconds)
+                for th in clientThreads + hangupThreads:
+                    th.join(timeout=30.0)
+                # settle: hangup cancellations retire at the next step
+                # boundary; wait for every survivor to go idle so the
+                # page invariant reads quiesced state
+                settleEnd = time.monotonic() + 10.0
+                while time.monotonic() < settleEnd:
+                    with rs._lock:
+                        live = list(rs._replicas)
+                    if all(not ex.busy() and ex.queuedRows() == 0
+                           for ex in live):
+                        break
+                    time.sleep(0.05)
+
+            inv: Dict[str, bool] = {}
+            crashFired = "replica_crash" in firedLog
+            inv["exactly_once_tokens"] = bool(
+                not errors and
+                all(results[i] == refs[i] for i in range(self.clients)))
+            with rs._lock:
+                live = list(rs._replicas)
+            inv["all_pages_freed"] = bool(live) and all(
+                ex.pool.freePages() == ex.pool.numPages - 1
+                for ex in live)
+            inv["flat_jit_misses"] = self._sumCells(
+                "dl4j_tpu_serving_compile_cache_misses_total") == miss0
+            # jaxlint: sync-ok -- latencies are host-side wall-clock floats
+            p99 = float(np.percentile(latencies, 99)) \
+                if latencies else float("inf")
+            inv["p99_bounded"] = p99 <= self.maxSeconds / 2
+            if crashFired:
+                inv["crashed_replica_retired"] = \
+                    rs.replicaCount() == self.replicas - 1
+            if "deadline_storm" in firedLog:
+                inv["deadline_shed_504"] = bool(
+                    stormResults and all(stormResults) and
+                    self._sumCells(
+                        "dl4j_tpu_serving_deadline_sheds_total",
+                        stage="admission") - sheds0
+                    >= len(stormResults))
+            report["invariants"] = inv
+            report["fired"] = list(firedLog)
+            report["errors"] = list(errors)
+            report["p99_seconds"] = round(p99, 4) if latencies else None
+            report["failovers"] = self._sumCells(
+                "dl4j_tpu_serving_failovers_total",
+                model=self.name) - failovers0
+            report["ok"] = bool(all(inv.values()) and not errors)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            report["invariants"] = {}
+            report["error"] = f"{type(e).__name__}: {e}"
+            report["fired"] = list(firedLog)
+            report["ok"] = False
+        finally:
+            report["seconds"] = round(time.perf_counter() - t0, 3)
+            rs.shutdown()
+        return report

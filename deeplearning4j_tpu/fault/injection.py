@@ -45,7 +45,11 @@ __all__ = [
     "clear_partitioned_hosts", "set_heartbeat_delay", "heartbeat_delay",
     "clear_heartbeat_delays", "arm_leader_crash", "consume_leader_crash",
     "clear_leader_crashes", "arm_barrier_kill", "consume_barrier_kill",
-    "clear_barrier_kills",
+    "clear_barrier_kills", "InjectedReplicaCrash", "ReplicaCrashAtStep",
+    "SlowReplica", "ClientHangupAtToken", "DeadlineStorm",
+    "arm_replica_crash", "check_replica_crash", "replica_dead",
+    "revive_replica", "set_replica_slowdown", "replica_slowdown",
+    "clear_serving_faults",
 ]
 
 
@@ -209,6 +213,79 @@ def consume_barrier_kill(hostId) -> bool:
 
 def clear_barrier_kills() -> None:
     _BARRIER_KILLS.clear()
+
+
+class InjectedReplicaCrash(RuntimeError):
+    """Shaped like XLA's unavailable-backend error so the serving tier's
+    failure path treats an injected replica crash exactly like a real
+    dead accelerator behind a batcher."""
+
+    def __init__(self, replica: str, note: str = "injected"):
+        self.replica = str(replica)
+        super().__init__(
+            f"UNAVAILABLE: serving replica {replica!r} lost ({note}); "
+            f"its device is permanently unreachable")
+
+
+# -- simulated serving-replica failures --------------------------------------
+# Serving-tier analogues of the lost-device registry.  A CRASH is armed
+# per replica name and consumed by the continuous batcher at its next
+# decode step (the dispatch raises InjectedReplicaCrash and the replica
+# joins the dead set, where the health probe sees it); a SLOWDOWN delays
+# every decode step and probe by a fixed amount (the wedged-but-alive
+# replica whose probe must time out).  Cleared on inject() exit like
+# every other registry here.
+
+_REPLICA_CRASHES: set = set()
+_DEAD_REPLICAS: set = set()
+_REPLICA_SLOWDOWNS: dict = {}
+
+
+def arm_replica_crash(replica) -> None:
+    """Arm ``replica`` (a batcher name) to crash at its next decode
+    step and stay dead until :func:`revive_replica`."""
+    _REPLICA_CRASHES.add(str(replica))
+
+
+def check_replica_crash(replica) -> bool:
+    """One-shot check-and-clear, consulted by the batcher's step loop;
+    a consumed crash moves the replica to the dead set (its probe fails
+    from now on)."""
+    name = str(replica)
+    if name not in _REPLICA_CRASHES:
+        return False
+    _REPLICA_CRASHES.discard(name)
+    _DEAD_REPLICAS.add(name)
+    return True
+
+
+def replica_dead(replica) -> bool:
+    return str(replica) in _DEAD_REPLICAS
+
+
+def revive_replica(replica) -> None:
+    _DEAD_REPLICAS.discard(str(replica))
+    _REPLICA_CRASHES.discard(str(replica))
+
+
+def set_replica_slowdown(replica, seconds: float) -> None:
+    """Delay every decode step and health probe of ``replica`` by
+    ``seconds`` (0 clears).  Above the probe timeout, the probe's
+    consecutive-failure threshold evicts the replica."""
+    if float(seconds) <= 0.0:
+        _REPLICA_SLOWDOWNS.pop(str(replica), None)
+    else:
+        _REPLICA_SLOWDOWNS[str(replica)] = float(seconds)
+
+
+def replica_slowdown(replica) -> float:
+    return float(_REPLICA_SLOWDOWNS.get(str(replica), 0.0))
+
+
+def clear_serving_faults() -> None:
+    _REPLICA_CRASHES.clear()
+    _DEAD_REPLICAS.clear()
+    _REPLICA_SLOWDOWNS.clear()
 
 
 class Fault:
@@ -480,6 +557,92 @@ class SlowFetch(Fault):
             time.sleep(self.delay)
 
 
+class ReplicaCrashAtStep(Fault):
+    """Arm ``replica`` (a continuous batcher name) to crash at its next
+    decode step once the consulted step count reaches ``step`` — the
+    serving soak's stand-in for a replica losing its accelerator
+    mid-generation.  The batcher's step raises
+    :class:`InjectedReplicaCrash`, its in-flight sequences hand over to
+    the failover path, and the replica stays dead (probe-visible) until
+    revived.  One-shot."""
+
+    def __init__(self, replica: str, step: int = 0):
+        self.replica = str(replica)
+        self.step = int(step)
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step >= self.step:
+            self.fired = True
+            arm_replica_crash(self.replica)
+
+
+class SlowReplica(Fault):
+    """Slow ``replica``'s every decode step and health probe by
+    ``seconds`` from step ``step`` on (optionally healing at
+    ``untilStep``) — the wedged-but-alive replica: requests on it crawl,
+    the probe times out, and the consecutive-failure threshold must
+    evict it with its sequences failed over, not errored."""
+
+    def __init__(self, replica: str, seconds: float = 0.5,
+                 step: int = 0, untilStep: Optional[int] = None):
+        self.replica = str(replica)
+        self.seconds = float(seconds)
+        self.step = int(step)
+        self.untilStep = None if untilStep is None else int(untilStep)
+        self.fired = False
+        self.healed = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step >= self.step:
+            self.fired = True
+            set_replica_slowdown(self.replica, self.seconds)
+        if (self.fired and not self.healed and self.untilStep is not None
+                and step >= self.untilStep):
+            self.healed = True
+            set_replica_slowdown(self.replica, 0.0)
+
+
+class ClientHangupAtToken(Fault):
+    """At step ``step``, launch a doomed streaming client that reads
+    ``token`` tokens and hangs up — the serving soak binds ``action`` to
+    the launch (the hangup itself is client-side behavior, not a server
+    registry).  The server must treat the mid-stream disconnect as a
+    cancellation: slot retired between steps, pages freed, no error
+    surfaced to anyone else.  One-shot."""
+
+    def __init__(self, step: int, token: int = 3, action=None):
+        self.step = int(step)
+        self.token = int(token)
+        self.action = action
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step >= self.step:
+            self.fired = True
+            if self.action is not None:
+                self.action(self.token)
+
+
+class DeadlineStorm(Fault):
+    """At step ``step``, fire a burst of ``requests`` already-expired
+    requests (deadline ~0) — every one must shed 504 at admission
+    without ever holding a decode slot.  The soak binds ``action`` to
+    the burst.  One-shot."""
+
+    def __init__(self, step: int, requests: int = 4, action=None):
+        self.step = int(step)
+        self.requests = int(requests)
+        self.action = action
+        self.fired = False
+
+    def before_step(self, step, net, ds):
+        if not self.fired and step >= self.step:
+            self.fired = True
+            if self.action is not None:
+                self.action(self.requests)
+
+
 class FaultInjector:
     """An ordered collection of faults consulted at each injection site."""
 
@@ -540,6 +703,7 @@ def inject(*faults: Fault):
         clear_heartbeat_delays()
         clear_leader_crashes()
         clear_barrier_kills()
+        clear_serving_faults()
 
 
 def check_fetch_fault(what: str) -> None:

@@ -423,6 +423,20 @@ class TransformerLM:
             start = t - jnp.asarray(lengths, jnp.int32)
         return self._prefillRawFn(self.params, tokens, start)
 
+    def restartFromPrompt(self, tokens, lengths=None):
+        """Restart hook for preemption and serving failover: rebuild a
+        sequence's KV state from its ORIGINAL prompt, with exactly the
+        dispatch the first admission used (same executable, same bucket
+        shape), so the step-by-step replay that follows regenerates the
+        identical token prefix — greedy decode is deterministic given
+        identical ops on identical shapes.  The continuous batcher
+        additionally teacher-forces the already-delivered tokens during
+        replay, so the prefix a client sees never depends on bit-wise
+        reproducibility across replicas (a quantized or differently
+        placed survivor can override this hook and still satisfy the
+        exactly-once contract)."""
+        return self.prefillRaw(tokens, lengths=lengths)
+
     def _paged_block(self, lp, x, poolK, poolV, pageTable, pos, start):
         """One transformer block against a paged pool layer (the
         ``_block_cached`` math with :func:`paged_attention` in place of

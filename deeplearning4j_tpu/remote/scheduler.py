@@ -47,6 +47,7 @@ traced constraints.
 from __future__ import annotations
 
 import queue as _stdqueue
+import random
 import threading
 import time
 from collections import deque
@@ -56,12 +57,31 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# injection registries only — fault/chaos.py imports THIS module lazily,
+# so the package-level import here cannot cycle
+from deeplearning4j_tpu.fault import injection as _inj
 from deeplearning4j_tpu.remote.serving import (AdmissionControl,
                                                BucketLadder,
+                                               DeadlineExceeded,
+                                               NoHealthyReplicas,
                                                ServiceOverloaded)
 from deeplearning4j_tpu.telemetry import ThresholdRule, serving_metrics
 
 __all__ = ["KVCachePool", "ContinuousBatcher", "ReplicaSet"]
+
+
+_PROBE_FN = None
+
+
+def _probe_fn():
+    """Process-wide tiny jitted dispatch for replica health probes —
+    compiled ONCE outside every batcher's ``compileCacheSize``
+    accounting, so probing never moves the steady-state jit-miss
+    counter (the flat-across-churn invariant)."""
+    global _PROBE_FN
+    if _PROBE_FN is None:
+        _PROBE_FN = jax.jit(lambda x: x + 1)
+    return _PROBE_FN
 
 
 class KVCachePool:
@@ -150,26 +170,33 @@ class KVCachePool:
 
 class _Pending:
     """One client request: its rows fan out to sequences; results
-    reassemble when the last row retires."""
-    __slots__ = ("rows", "quota", "doneRows", "error", "event", "t0")
+    reassemble when the last row retires.  Completion bookkeeping uses a
+    PER-REQUEST lock, not a per-batcher one: after a failover the rows
+    of one request can retire on DIFFERENT replicas concurrently."""
+    __slots__ = ("rows", "quota", "doneRows", "error", "event", "t0",
+                 "deadline", "lock")
 
-    def __init__(self, rows: int, quota: int):
+    def __init__(self, rows: int, quota: int,
+                 deadline: Optional[float] = None):
         self.rows = int(rows)
         self.quota = int(quota)
         self.doneRows = 0
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
         self.t0 = time.perf_counter()
+        self.deadline = deadline        # absolute time.monotonic(), or None
+        self.lock = threading.Lock()
 
 
 class _Seq:
     """One sequence of a request: queued, then bound to a decode slot."""
     __slots__ = ("tokens", "realLen", "bucket", "quota", "pages", "parent",
                  "row", "emitted", "streamQ", "streamed", "streamSkip",
-                 "cancelled", "restarts")
+                 "cancelled", "restarts", "deadline", "forced")
 
     def __init__(self, tokens: np.ndarray, bucket: int, quota: int,
-                 pages: int, parent: _Pending, row: int):
+                 pages: int, parent: _Pending, row: int,
+                 deadline: Optional[float] = None):
         self.tokens = tokens            # (1, realLen) int32
         self.realLen = int(tokens.shape[1])
         self.bucket = int(bucket)
@@ -183,6 +210,34 @@ class _Seq:
         self.streamSkip = 0             # re-emissions to swallow after a preempt
         self.cancelled = False
         self.restarts = 0
+        self.deadline = deadline        # absolute time.monotonic(), or None
+        # the already-computed token prefix, teacher-forced during a
+        # replay so the prefix a client sees never depends on bit-wise
+        # reproducibility across the replica that adopts the sequence
+        self.forced: List[int] = []
+
+
+def _finish_seq(seq: _Seq, error: Optional[BaseException],
+                model: str) -> None:
+    """Deliver a sequence's final verdict to its request.  Module-level
+    (not a batcher method) because after a failover the finishing
+    replica is not the admitting one — and the replica set itself
+    finishes orphans when no survivor can adopt them."""
+    parent = seq.parent
+    if seq.streamQ is not None:
+        seq.streamQ.put(error)          # None = clean end sentinel
+    with parent.lock:
+        parent.doneRows += 1
+        if error is not None and parent.error is None:
+            parent.error = error
+        last = parent.doneRows >= parent.rows
+    if last:
+        sm = serving_metrics()
+        sm.request_seconds().observe(time.perf_counter() - parent.t0,
+                                     model=model)
+        sm.requests().inc(model=model,
+                          outcome="error" if parent.error else "ok")
+        parent.event.set()
 
 
 class ContinuousBatcher:
@@ -203,7 +258,8 @@ class ContinuousBatcher:
                  numPages: Optional[int] = None, maxSlots: int = 4,
                  ladder: Optional[BucketLadder] = None,
                  admission: Optional[AdmissionControl] = None,
-                 eosToken: Optional[int] = None, plan=None, device=None):
+                 eosToken: Optional[int] = None, plan=None, device=None,
+                 retireLogSize: int = 64):
         self.lm = lm
         self.draft = draft
         self.draftK = int(draftK) if draft is not None else 0
@@ -259,13 +315,17 @@ class ContinuousBatcher:
         self._queuedRows = 0
         self._queuedPages = 0
         self._cv = threading.Condition()
-        # request completion bookkeeping crosses threads (loop retires,
-        # shutdown drains) — its own lock, never held with _cv
-        self._finishLock = threading.Lock()
         self._running = False
         self._warmed = False
         self._thread: Optional[threading.Thread] = None
-        self._retireLog: deque = deque(maxlen=64)   # (ts, pages freed)
+        # bounded ring of (ts, pages freed): _retireRate() only ever
+        # needs the recent window, and an unbounded log on a long-lived
+        # replica would grow its Retry-After bookkeeping forever
+        self._retireLog: deque = deque(maxlen=max(2, int(retireLogSize)))
+        # set by ReplicaSet: called with (batcher, seqs, error) when a
+        # shared step fails with sequences in flight — the failover
+        # path.  None (standalone batcher) errors the sequences instead.
+        self.onSequenceFailure = None
         self._stepFns: Dict[str, object] = {}
         self._cacheSeen: Optional[int] = None
         self._busySteps = 0.0
@@ -512,14 +572,31 @@ class ContinuousBatcher:
                 f"prompt bucket {Tp} + maxNewTokens {n} can never fit "
                 f"the KV page budget ({pages} pages > "
                 f"{self.pool.maxPagesPerSeq} per sequence)")
-        parent = _Pending(toks.shape[0], n)
-        seqs = [_Seq(toks[i:i + 1], Tp, n, pages, parent, i)
+        deadline = None
+        dl = payload.get("deadlineSeconds")
+        if dl is not None:
+            dl = float(dl)  # jaxlint: sync-ok -- deadlineSeconds arrives as host JSON, not a device scalar
+            if not dl >= 0.0:           # also rejects NaN
+                raise ValueError("deadlineSeconds must be >= 0")
+            deadline = time.monotonic() + dl
+        parent = _Pending(toks.shape[0], n, deadline=deadline)
+        seqs = [_Seq(toks[i:i + 1], Tp, n, pages, parent, i,
+                     deadline=deadline)
                 for i in range(toks.shape[0])]
         return seqs, parent
 
     def _admitGate(self, rows: int, pages: int,
-                   singleStep: bool = False) -> None:
+                   singleStep: bool = False,
+                   deadline: Optional[float] = None) -> None:
         sm = serving_metrics()
+        if deadline is not None and time.monotonic() >= deadline:
+            # end-to-end deadline already spent (queueing upstream, a
+            # slow hop): shed NOW rather than burn a decode slot on a
+            # response nobody is waiting for (tail-at-scale discipline)
+            sm.deadline_sheds().inc(model=self.name, stage="admission")
+            sm.requests().inc(model=self.name, outcome="deadline")
+            raise DeadlineExceeded(
+                "end-to-end deadline expired before admission")
         queued = self.queuedRows()
         sm.queue_depth().set(queued, model=self.name)
         fired = self.admission.check(queued)
@@ -543,13 +620,19 @@ class ContinuousBatcher:
             sm.requests().inc(model=self.name, outcome="shed")
             raise ServiceOverloaded(detail, retryAfter)
 
-    def _enqueue(self, seqs: Sequence[_Seq]) -> None:
+    def _enqueue(self, seqs: Sequence[_Seq], front: bool = False) -> None:
         with self._cv:
             if not self._running:
                 raise RuntimeError(
                     f"continuous batcher {self.name!r} is not running")
-            for s in seqs:
-                self._queue.append(s)
+            if front:
+                # failed-over sequences adopt the survivor's FIFO head:
+                # they already waited their turn on the dead replica
+                for s in reversed(list(seqs)):
+                    self._queue.appendleft(s)
+            else:
+                for s in seqs:
+                    self._queue.append(s)
             self._queuedRows += len(seqs)
             self._queuedPages += sum(s.pages for s in seqs)
             depth = self._queuedRows
@@ -564,7 +647,8 @@ class ContinuousBatcher:
         admission sheds."""
         seqs, parent = self._makeSeqs(payload)
         self._admitGate(len(seqs), sum(s.pages for s in seqs),
-                        singleStep=(parent.quota == 1))
+                        singleStep=(parent.quota == 1),
+                        deadline=parent.deadline)
         self._enqueue(seqs)
         if not parent.event.wait(timeout):
             # reap still-QUEUED rows now — left behind they would keep
@@ -606,13 +690,29 @@ class ContinuousBatcher:
                              "request")
         seq = seqs[0]
         seq.streamQ = _stdqueue.Queue()
-        self._admitGate(1, seq.pages, singleStep=(seq.quota == 1))
+        self._admitGate(1, seq.pages, singleStep=(seq.quota == 1),
+                        deadline=parent.deadline)
+        heartbeat = payload.get("keepAliveSeconds")
+        if heartbeat is not None:
+            heartbeat = float(heartbeat)  # jaxlint: sync-ok -- keepAliveSeconds arrives as host JSON, not a device scalar
+            if not heartbeat > 0.0:
+                raise ValueError("keepAliveSeconds must be > 0")
         self._enqueue(seqs)
 
         def gen():
+            from deeplearning4j_tpu.remote.server import KEEPALIVE
             try:
                 while True:
-                    item = seq.streamQ.get()
+                    try:
+                        item = seq.streamQ.get(timeout=heartbeat)
+                    except _stdqueue.Empty:
+                        # decode gap (big batch, failover replay, a slow
+                        # replica): yield the sentinel so the transport
+                        # writes a comment line — a client that hung up
+                        # fails THAT write and cancels the sequence just
+                        # like a failed token write would
+                        yield KEEPALIVE
+                        continue
                     if item is None:
                         return
                     if isinstance(item, BaseException):
@@ -645,6 +745,14 @@ class ContinuousBatcher:
                 if not self._running:
                     return
             try:
+                if _inj.replica_dead(self.name):
+                    # a crashed replica's loop idles instead of serving:
+                    # the health probe (not this thread) is what removes
+                    # it from routing
+                    time.sleep(0.02)
+                    continue
+                if _inj.check_replica_crash(self.name):
+                    raise _inj.InjectedReplicaCrash(self.name)
                 if not self._warmed:
                     # a prior failure rebuilt the pools: re-warm before
                     # serving (fresh fns against the fresh buffers)
@@ -660,15 +768,34 @@ class ContinuousBatcher:
                 self._failBatch(e)
 
     def _failBatch(self, error: BaseException) -> None:
-        """Last-resort recovery for a failed shared step: error every
-        active slot, then rebuild pools and step fns — a dispatch that
-        raised may already have CONSUMED the donated pool buffers, so
-        the old arrays cannot be trusted (or even alive)."""
+        """Last-resort recovery for a failed shared step: hand every
+        in-flight sequence to the replica set's failover handler when
+        one is wired (reset for a from-prompt replay on a survivor),
+        else error it; then rebuild pools and step fns — a dispatch
+        that raised may already have CONSUMED the donated pool buffers,
+        so the old arrays cannot be trusted (or even alive)."""
+        handler = self.onSequenceFailure
+        handed: List[_Seq] = []
         for slot, seq in enumerate(self._slotSeq):
-            if seq is not None:
+            if seq is None:
+                continue
+            if handler is not None and not seq.cancelled:
+                self.pool.release(slot)
+                if self.draftPool is not None:
+                    self.draftPool.release(slot)
+                self._slotSeq[slot] = None
+                self._pos[slot] = self._start[slot] = self._tok[slot] = 0
+                if slot in self._admitOrder:
+                    self._admitOrder.remove(slot)
+                self._resetForReplay(seq)
+                handed.append(seq)
+            else:
                 self._retireSlot(slot, error=error)
         self._buildPools()
         self._invalidateFns()
+        self._updatePageGauges()
+        if handed:
+            handler(self, handed, error)
 
     def _admit(self) -> None:
         """Fill free slots from the queue head — strict FIFO, so a large
@@ -682,7 +809,9 @@ class ContinuousBatcher:
                 if not self._queue:
                     return
                 head = self._queue[0]
-                if not head.cancelled:
+                expired = head.deadline is not None and \
+                    time.monotonic() >= head.deadline
+                if not head.cancelled and not expired:
                     if free is None:
                         return
                     want = self.pool.pagesFor(head.bucket)
@@ -698,6 +827,14 @@ class ContinuousBatcher:
             serving_metrics().queue_depth().set(depth, model=self.name)
             if seq.cancelled:
                 self._finishSeq(seq, None)
+                continue
+            if expired:
+                # its deadline ran out while it waited in line: it never
+                # gets a slot, never holds a page
+                serving_metrics().deadline_sheds().inc(model=self.name,
+                                                       stage="queued")
+                self._finishSeq(seq, DeadlineExceeded(
+                    "end-to-end deadline expired while queued"))
                 continue
             try:
                 self._admitSeq(free, seq)
@@ -723,7 +860,14 @@ class ContinuousBatcher:
             [np.zeros((1, Tp - seq.realLen), np.int32), seq.tokens],
             axis=1)
         nP = Tp // self.pageSize
-        logits, ks, vs = self.lm.prefillRaw(padded, lengths=[seq.realLen])
+        # a restart (preemption OR failover onto this replica) goes
+        # through the model's restart hook — same executable + bucket as
+        # a first admission, but the hook is the seam a survivor with
+        # different numerics can override
+        prefill = getattr(self.lm, "restartFromPrompt",
+                          self.lm.prefillRaw) \
+            if seq.restarts > 0 else self.lm.prefillRaw
+        logits, ks, vs = prefill(padded, lengths=[seq.realLen])
         ids = jnp.asarray(self.pool.heldIds(slot)[:nP], jnp.int32)
         self.pool.k, self.pool.v = self._stepFns["write"](
             self.pool.k, self.pool.v, ks[:, 0], vs[:, 0], ids)
@@ -737,6 +881,13 @@ class ContinuousBatcher:
                 dids)
         # jaxlint: sync-ok -- the prefill's greedy token seeds the host-side slot state
         first = int(np.argmax(np.asarray(logits[0])))
+        if seq.forced and len(seq.emitted) < len(seq.forced):
+            # teacher-forced replay: the first token was already
+            # computed (and maybe delivered) before the move — force it
+            # so the delivered prefix survives any cross-replica
+            # numeric drift, and so the KV the step writes next is
+            # conditioned on the prefix the client actually saw
+            first = int(seq.forced[0])
         self._slotSeq[slot] = seq
         self._pos[slot] = Tp
         self._start[slot] = Tp - seq.realLen
@@ -766,6 +917,18 @@ class ContinuousBatcher:
 
     def _stepOnce(self) -> None:
         sm = serving_metrics()
+        delay = _inj.replica_slowdown(self.name)
+        if delay:
+            time.sleep(delay)           # injected brownout (SlowReplica)
+        now = time.monotonic()
+        for s, seq in enumerate(self._slotSeq):
+            # deadline sweep BETWEEN steps: an expired sequence's pages
+            # go back to the free list before the next dispatch
+            if seq is not None and seq.deadline is not None and \
+                    now >= seq.deadline:
+                sm.deadline_sheds().inc(model=self.name, stage="decode")
+                self._retireSlot(s, error=DeadlineExceeded(
+                    "end-to-end deadline expired mid-decode"))
         tq = self.draftK + 1 if self.draft is not None else 1
         # page growth in ADMISSION-AGE order: a slot may only preempt
         # YOUNGER slots, and when none are left it DEFERS one step
@@ -841,7 +1004,8 @@ class ContinuousBatcher:
             if seq.cancelled:
                 self._retireSlot(s)
                 continue
-            if propsH is not None:
+            remForced = len(seq.forced) - len(seq.emitted)
+            if propsH is not None and remForced <= 0:
                 a = 0
                 while a < self.draftK and propsH[s, a] == g[s, a]:
                     a += 1
@@ -850,6 +1014,16 @@ class ContinuousBatcher:
                 sm.draft_accepted().inc(a, model=self.name)
             else:
                 newToks = g[s, :1]
+            if remForced > 0:
+                # teacher-forced replay: override the computed token
+                # with the one the sequence already produced before the
+                # move.  Capped to ONE token per step even in
+                # speculative mode — the unaccepted proposals' KV
+                # writes get overwritten by the existing partial-accept
+                # semantics, exactly as on a short accept.
+                # jaxlint: sync-ok -- forced tokens are host-side replay state, never device values
+                newToks = np.asarray(
+                    [int(seq.forced[len(seq.emitted)])], np.int32)
             done = False
             for t in newToks:
                 # jaxlint: disable=host-sync -- newToks is the already-materialized host copy of this step's greedy tokens
@@ -885,9 +1059,7 @@ class ContinuousBatcher:
         self._slotSeq[slot] = None
         self._pos[slot] = self._start[slot] = self._tok[slot] = 0
         self._admitOrder.remove(slot)
-        seq.restarts += 1
-        seq.streamSkip = seq.streamed
-        seq.emitted = []
+        self._resetForReplay(seq)
         with self._cv:
             self._queue.appendleft(seq)
             self._queuedRows += 1
@@ -895,6 +1067,110 @@ class ContinuousBatcher:
         sm = serving_metrics()
         sm.preemptions().inc(model=self.name)
         self._updatePageGauges()
+
+    @staticmethod
+    def _resetForReplay(seq: _Seq) -> None:
+        """Rewind a sequence to restart-from-prompt state (preemption or
+        failover): record the computed prefix for teacher-forcing, arm
+        ``streamSkip`` so the re-emission is swallowed, clear the
+        emitted list.  Exactly-once delivery follows: every token a
+        client saw is in ``forced`` and will be re-emitted (skipped) in
+        the same order; every token it hasn't seen streams once."""
+        if len(seq.emitted) > len(seq.forced):
+            seq.forced = list(seq.emitted)
+        seq.restarts += 1
+        seq.streamSkip = seq.streamed
+        seq.emitted = []
+
+    def probe(self) -> bool:
+        """Replica liveness check for the health prober: the injected
+        fault registries (a chaos schedule's crash/brownout), the loop
+        thread's liveness, and one tiny REAL device dispatch.  Runs a
+        module-level jitted fn compiled once per process — NOT counted
+        by ``compileCacheSize`` — so probing keeps the steady-state
+        jit-miss counter flat.  Decode-path health is covered
+        separately: a crashed step raises into ``_failBatch`` and the
+        failover handler, it doesn't wait for a probe."""
+        if _inj.replica_dead(self.name):
+            return False
+        if _inj.check_replica_crash(self.name):
+            # an armed crash with no traffic to trip it: an IDLE crashed
+            # replica must still go unhealthy (the loop's check only
+            # runs when there is work)
+            return False
+        delay = _inj.replica_slowdown(self.name)
+        if delay:
+            time.sleep(delay)           # a browned-out replica probes slow
+        if self._thread is not None and not self._thread.is_alive():
+            return False
+        x = jax.device_put(1, self._device) \
+            if self._device is not None else 1
+        # jaxlint: sync-ok -- the probe EXISTS to synchronize: its round-trip latency is the health signal
+        out = jax.block_until_ready(_probe_fn()(x))
+        # jaxlint: sync-ok -- probe verdict readback, off the decode path
+        return int(out) == 2
+
+    def evacuate(self) -> List[_Seq]:
+        """Pull every queued AND in-flight sequence off this replica for
+        failover, stopping the loop.  Returns the sequences reset for a
+        from-prompt replay (cancelled ones are finished here instead).
+        In-flight slots are stolen only after the loop thread actually
+        JOINED — a wedged thread mid-``_stepOnce`` still owns its slot
+        state, so a reaper thread waits it out and errors the leftovers
+        (exactly-once beats availability: a maybe-double-delivered
+        sequence is worse than a failed one)."""
+        with self._cv:
+            self._running = False
+            queued = list(self._queue)
+            self._queue.clear()
+            self._queuedRows = 0
+            self._queuedPages = 0
+            self._cv.notify_all()
+        inflight: List[_Seq] = []
+        joined = True
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            joined = not self._thread.is_alive()
+        if joined:
+            self._thread = None
+            for slot in list(self._admitOrder):
+                seq = self._slotSeq[slot]
+                if seq is None:
+                    continue
+                self.pool.release(slot)
+                if self.draftPool is not None:
+                    self.draftPool.release(slot)
+                self._slotSeq[slot] = None
+                self._pos[slot] = self._start[slot] = 0
+                self._tok[slot] = 0
+                inflight.append(seq)
+            self._admitOrder.clear()
+            self._updatePageGauges()
+        else:
+            # wedged mid-step: its slots cannot be failed over safely
+            # (the step may still emit).  A reaper outlives the wedge
+            # and errors whatever is left.
+            wedged = self._thread
+
+            def reap():
+                wedged.join()
+                for slot, seq in enumerate(self._slotSeq):
+                    if seq is not None:
+                        self._retireSlot(slot, error=RuntimeError(
+                            f"replica {self.name!r} evacuated while "
+                            f"wedged mid-step"))
+            threading.Thread(target=reap, daemon=True,
+                             name=f"cbatch-wedge-reap-{self.name}"
+                             ).start()
+        out: List[_Seq] = []
+        for seq in inflight + queued:
+            if seq.cancelled:
+                self._finishSeq(seq, None)
+                continue
+            self._resetForReplay(seq)
+            out.append(seq)
+        serving_metrics().queue_depth().set(0, model=self.name)
+        return out
 
     def _retireSlot(self, slot: int, error: Optional[BaseException] = None
                     ) -> None:
@@ -913,21 +1189,7 @@ class ContinuousBatcher:
         self._finishSeq(seq, error)
 
     def _finishSeq(self, seq: _Seq, error: Optional[BaseException]) -> None:
-        parent = seq.parent
-        if seq.streamQ is not None:
-            seq.streamQ.put(error)          # None = clean end sentinel
-        with self._finishLock:
-            parent.doneRows += 1
-            if error is not None and parent.error is None:
-                parent.error = error
-            last = parent.doneRows >= parent.rows
-        if last:
-            sm = serving_metrics()
-            sm.request_seconds().observe(time.perf_counter() - parent.t0,
-                                         model=self.name)
-            sm.requests().inc(model=self.name,
-                              outcome="error" if parent.error else "ok")
-            parent.event.set()
+        _finish_seq(seq, error, self.name)
 
     def _updatePageGauges(self) -> None:
         sm = serving_metrics()
@@ -981,18 +1243,41 @@ class ReplicaSet:
     ``dl4j_tpu_health_actions_total``)."""
 
     def __init__(self, factory, name: str = "default", replicas: int = 1,
-                 minReplicas: int = 1, maxReplicas: int = 8):
+                 minReplicas: int = 1, maxReplicas: int = 8,
+                 drainTimeout: float = 30.0, probeInterval: float = 0.5,
+                 probeTimeout: float = 2.0, probeFailThreshold: int = 2,
+                 submitRetries: int = 2, retryBackoff: float = 0.05,
+                 retryMaxBackoff: float = 1.0, retryJitter: float = 0.5,
+                 retryAfter: float = 1.0, seed: Optional[int] = None):
         self._factory = factory
         self.name = str(name)
         self.minReplicas = max(1, int(minReplicas))
         self.maxReplicas = max(self.minReplicas, int(maxReplicas))
         self._initial = max(self.minReplicas, int(replicas))
+        self.drainTimeout = float(drainTimeout)
+        # health probing (0 disables): a replica failing
+        # probeFailThreshold CONSECUTIVE probes — each bounded by
+        # probeTimeout on its own thread, so a wedged probe can't wedge
+        # the prober — leaves routing; one healthy pass resets the run
+        self.probeInterval = float(probeInterval)
+        self.probeTimeout = float(probeTimeout)
+        self.probeFailThreshold = max(1, int(probeFailThreshold))
+        # submit retry-against-another-replica policy: exponential
+        # backoff with seeded jitter, bounded by the request's
+        # remaining deadline budget
+        self.submitRetries = max(0, int(submitRetries))
+        self.retryBackoff = float(retryBackoff)
+        self.retryMaxBackoff = float(retryMaxBackoff)
+        self.retryJitter = float(retryJitter)
+        self.retryAfter = float(retryAfter)
+        self._rng = random.Random(seed)
         self._replicas: List = []
         self._nextIdx = 0
         self._pendingAdds = 0
         self._lock = threading.Lock()
         self._running = False
         self._reapers: List[threading.Thread] = []
+        self._probes: List[threading.Thread] = []
 
     def start(self) -> "ReplicaSet":
         with self._lock:
@@ -1004,17 +1289,20 @@ class ReplicaSet:
                 break
         return self
 
-    def _addReplica(self):
+    def _addReplica(self, force: bool = False):
         """Build + start one replica.  The slow factory/warm work runs
         OUTSIDE the lock; admission into the routing set re-checks
         ``_running``/``maxReplicas`` under it, so a racing shutdown (or
         a second concurrent scaleUp) can never leak a live replica or
         overshoot the cap — a replica that loses the re-check is shut
-        down, not stranded."""
+        down, not stranded.  ``force`` lifts the cap check for
+        :meth:`swap`, which adds the green replica BEFORE removing the
+        blue one (momentarily maxReplicas + 1)."""
         with self._lock:
-            if not self._running or \
-                    len(self._replicas) + self._pendingAdds >= \
-                    self.maxReplicas:
+            if not self._running or (
+                    not force and
+                    len(self._replicas) + self._pendingAdds >=
+                    self.maxReplicas):
                 return None
             self._pendingAdds += 1
             idx = self._nextIdx
@@ -1025,13 +1313,15 @@ class ReplicaSet:
             ex = self._factory(idx)
             if getattr(ex, "name", None) in (None, "default"):
                 ex.name = f"{self.name}/{idx}"
+            # ex.start() warms every executable BEFORE the replica can
+            # be routed to — a swapped-in replica never serves cold
             ex.start()
             started = True
         finally:
             with self._lock:
                 self._pendingAdds -= 1
-                admitted = started and self._running and \
-                    len(self._replicas) < self.maxReplicas
+                admitted = started and self._running and (
+                    force or len(self._replicas) < self.maxReplicas)
                 if admitted:
                     self._replicas.append(ex)
                     n = len(self._replicas)
@@ -1039,8 +1329,133 @@ class ReplicaSet:
             if ex is not None:
                 ex.shutdown()
             return None
-        serving_metrics().replicas().set(n, model=self.name)
+        if hasattr(ex, "onSequenceFailure"):
+            # the in-flight failover seam: a failed shared step hands
+            # its live sequences here instead of erroring them
+            ex.onSequenceFailure = self._onBatchFailure
+        sm = serving_metrics()
+        sm.replicas().set(n, model=self.name)
+        sm.replica_health().set(1, model=self.name,
+                                replica=getattr(ex, "name", str(idx)))
+        self._startProbe(ex)
         return ex
+
+    # -- health probing -------------------------------------------------
+    def _startProbe(self, ex) -> None:
+        if self.probeInterval <= 0 or not hasattr(ex, "probe"):
+            return
+        th = threading.Thread(
+            target=self._probeLoop, args=(ex,), daemon=True,
+            name=f"replica-probe-{getattr(ex, 'name', '?')}")
+        th.start()
+        with self._lock:
+            self._probes.append(th)
+
+    def _probeOnce(self, ex) -> bool:
+        """One probe attempt, bounded by ``probeTimeout`` on its OWN
+        short-lived thread — a wedged device dispatch hangs that thread,
+        not the prober (the DeviceHealthProbe discipline)."""
+        result: List[bool] = []
+
+        def attempt():
+            try:
+                result.append(bool(ex.probe()))
+            except Exception:
+                result.append(False)
+        t = threading.Thread(target=attempt, daemon=True,
+                             name=f"probe-once-{getattr(ex, 'name', '?')}")
+        t.start()
+        t.join(self.probeTimeout)
+        return bool(result) and result[0]
+
+    def _probeLoop(self, ex) -> None:
+        fails = 0
+        sm = serving_metrics()
+        rname = getattr(ex, "name", "?")
+        while True:
+            with self._lock:
+                if not self._running or ex not in self._replicas:
+                    return
+            if self._probeOnce(ex):
+                fails = 0
+                sm.replica_health().set(1, model=self.name,
+                                        replica=rname)
+            else:
+                fails += 1
+                if fails >= self.probeFailThreshold:
+                    sm.replica_health().set(0, model=self.name,
+                                            replica=rname)
+                    self._retireReplica(
+                        ex, reason=f"{fails} consecutive probe failures")
+                    return
+            time.sleep(self.probeInterval)
+
+    def _retireReplica(self, ex, reason: str = "") -> None:
+        """Remove an UNHEALTHY replica from routing and fail its work
+        over to survivors.  Health retirement ignores ``minReplicas`` —
+        keeping a dead replica in the route to satisfy a floor just
+        converts every Nth request into an error."""
+        with self._lock:
+            if ex not in self._replicas:
+                return
+            self._replicas.remove(ex)
+            n = len(self._replicas)
+        sm = serving_metrics()
+        sm.replicas().set(n, model=self.name)
+        sm.replica_health().set(0, model=self.name,
+                                replica=getattr(ex, "name", "?"))
+        if hasattr(ex, "evacuate"):
+            seqs = ex.evacuate()
+            if seqs:
+                self._failover(seqs, note=reason)
+        # the dead replica's shutdown can block (a wedged loop thread):
+        # reap it off-path so retirement itself never wedges
+        th = threading.Thread(target=ex.shutdown, daemon=True,
+                              name=f"replica-reaper-{self.name}")
+        th.start()
+        with self._lock:
+            self._reapers.append(th)
+
+    def _failover(self, seqs: Sequence[_Seq], note: str = "",
+                  exclude=None) -> None:
+        """Re-home evacuated sequences on survivors: each lands at a
+        survivor's FIFO head (it already waited its turn) and replays
+        from the prompt, ``streamSkip``/``forced`` making the move
+        invisible to the client.  A sequence whose deadline already
+        expired — or with no survivor to take it — finishes with the
+        error instead."""
+        sm = serving_metrics()
+        for seq in seqs:
+            if seq.deadline is not None and \
+                    time.monotonic() >= seq.deadline:
+                sm.deadline_sheds().inc(model=self.name, stage="failover")
+                _finish_seq(seq, DeadlineExceeded(
+                    "end-to-end deadline expired during failover"),
+                    self.name)
+                continue
+            with self._lock:
+                live = list(self._replicas)
+            cands = [e for e in live
+                     if hasattr(e, "_enqueue") and e is not exclude] or \
+                    [e for e in live if hasattr(e, "_enqueue")]
+            target = min(cands, key=lambda e: e.queuedRows()) \
+                if cands else None
+            if target is None:
+                _finish_seq(seq, NoHealthyReplicas(
+                    f"no survivor to adopt sequence after failover"
+                    f"{' (' + note + ')' if note else ''}",
+                    retryAfter=self.retryAfter), self.name)
+                continue
+            try:
+                target._enqueue([seq], front=True)
+                sm.failovers().inc(model=self.name)
+            except Exception as e:
+                _finish_seq(seq, e, self.name)
+
+    def _onBatchFailure(self, source, seqs, error) -> None:
+        self._failover(seqs,
+                       note=f"{type(error).__name__}: {error}",
+                       exclude=source)
 
     def replicaCount(self) -> int:
         with self._lock:
@@ -1063,41 +1478,160 @@ class ReplicaSet:
                 return None
             ex = self._replicas.pop()       # stops routing to it NOW
             n = len(self._replicas)
-        serving_metrics().replicas().set(n, model=self.name)
+        sm = serving_metrics()
+        sm.replicas().set(n, model=self.name)
+        sm.replica_health().set(0, model=self.name,
+                                replica=getattr(ex, "name", "?"))
         th = threading.Thread(target=self._drainStop, args=(ex,),
                               daemon=True,
                               name=f"replica-reaper-{self.name}")
         th.start()
-        self._reapers.append(th)
+        with self._lock:
+            self._reapers.append(th)
         return f"scaled {self.name} down to {n} replicas"
 
     def _drainStop(self, ex) -> None:
-        deadline = time.monotonic() + 30.0
+        """Graceful drain: the replica is already out of routing, so its
+        backlog only shrinks — let every in-flight sequence finish,
+        bounded by ``drainTimeout``; stragglers past the bound are
+        evacuated and failed over to survivors (not dropped)."""
+        t0 = time.monotonic()
+        deadline = t0 + self.drainTimeout
         busy = getattr(ex, "busy", None)
         while time.monotonic() < deadline and (
                 ex.queuedRows() > 0 or (busy is not None and busy())):
             time.sleep(0.05)
+        if hasattr(ex, "evacuate") and (
+                ex.queuedRows() > 0 or (busy is not None and busy())):
+            stragglers = ex.evacuate()
+            if stragglers:
+                self._failover(stragglers, note="drain timeout",
+                               exclude=ex)
         ex.shutdown()
+        serving_metrics().drain_seconds().observe(
+            time.monotonic() - t0, model=self.name)
+
+    def swap(self, factory=None) -> Optional[str]:
+        """Blue/green rollover (ROADMAP item 4's serving primitive):
+        for each current replica, build + WARM a replacement from
+        ``factory`` (default: the current one), route to it, then drain
+        and retire the old replica through the ``scaleDown`` reaper
+        path.  In-flight streams on the old replica finish (or fail
+        over past ``drainTimeout``); new requests land on the
+        replacement, which entered the route fully warmed from the AOT
+        cache — no cold-compile window."""
+        if factory is not None:
+            self._factory = factory
+        with self._lock:
+            olds = list(self._replicas)
+        swapped = 0
+        for old in olds:
+            new = self._addReplica(force=True)
+            if new is None:
+                break
+            with self._lock:
+                if old not in self._replicas:   # crashed/retired already
+                    continue
+                self._replicas.remove(old)
+                n = len(self._replicas)
+            sm = serving_metrics()
+            sm.replicas().set(n, model=self.name)
+            sm.replica_health().set(0, model=self.name,
+                                    replica=getattr(old, "name", "?"))
+            th = threading.Thread(target=self._drainStop, args=(old,),
+                                  daemon=True,
+                                  name=f"replica-reaper-{self.name}")
+            th.start()
+            with self._lock:
+                self._reapers.append(th)
+            swapped += 1
+        if swapped == 0:
+            return None
+        return f"swapped {swapped} replica(s) behind {self.name}"
 
     def _pick(self):
         with self._lock:
             if not self._replicas:
-                raise RuntimeError(
-                    f"replica set {self.name!r} has no live replicas")
+                raise NoHealthyReplicas(
+                    f"replica set {self.name!r} has no live replicas",
+                    retryAfter=self.retryAfter)
             return min(self._replicas, key=lambda e: e.queuedRows())
 
+    def _retryDelay(self, attempt: int,
+                    deadline: Optional[float]) -> float:
+        """Bounded exponential backoff with seeded jitter, clipped to
+        the request's remaining deadline budget (raises when none is
+        left — retrying past the deadline only wastes a survivor's
+        slot)."""
+        delay = min(self.retryBackoff * (2 ** attempt),
+                    self.retryMaxBackoff)
+        delay *= 1.0 + self.retryJitter * self._rng.random()
+        if deadline is not None and \
+                time.monotonic() + delay >= deadline:
+            sm = serving_metrics()
+            sm.deadline_sheds().inc(model=self.name, stage="retry")
+            raise DeadlineExceeded(
+                "end-to-end deadline leaves no budget for a retry")
+        return delay
+
+    @staticmethod
+    def _requestDeadline(payload) -> Optional[float]:
+        dl = payload.get("deadlineSeconds") \
+            if isinstance(payload, dict) else None
+        if dl is None:
+            return None
+        dl = float(dl)  # jaxlint: sync-ok -- deadlineSeconds arrives as host JSON, not a device scalar
+        if not dl >= 0.0:
+            raise ValueError("deadlineSeconds must be >= 0")
+        return time.monotonic() + dl
+
     def submit(self, payload, timeout: Optional[float] = None):
-        out = self._pick().submit(payload, timeout)
-        serving_metrics().queue_depth().set(self.queuedRows(),
-                                            model=self.name)
-        return out
+        """Route to the least-loaded replica; a replica-side FAILURE
+        (not a client error, not an admission shed, not a deadline)
+        retries against another replica with backoff + jitter, honoring
+        the remaining deadline budget."""
+        deadline = self._requestDeadline(payload)
+        attempt = 0
+        while True:
+            ex = self._pick()
+            try:
+                out = ex.submit(payload, timeout)
+            except (ServiceOverloaded, NoHealthyReplicas,
+                    DeadlineExceeded, TimeoutError, ValueError,
+                    TypeError):
+                raise               # deterministic / client-owned: no retry
+            except Exception:
+                if attempt >= self.submitRetries:
+                    raise
+                time.sleep(self._retryDelay(attempt, deadline))
+                attempt += 1
+                continue
+            serving_metrics().queue_depth().set(self.queuedRows(),
+                                                model=self.name)
+            return out
 
     def submitStream(self, payload):
-        ex = self._pick()
-        if not hasattr(ex, "submitStream"):
-            raise ValueError(
-                f"replica set {self.name!r} does not stream")
-        return ex.submitStream(payload)
+        """Streaming route with the same retry policy around CREATION
+        (validate + enqueue happen eagerly, before any token, so a
+        failed submit here never half-delivered anything)."""
+        deadline = self._requestDeadline(payload)
+        attempt = 0
+        while True:
+            ex = self._pick()
+            if not hasattr(ex, "submitStream"):
+                raise ValueError(
+                    f"replica set {self.name!r} does not stream")
+            try:
+                return ex.submitStream(payload)
+            except (ServiceOverloaded, NoHealthyReplicas,
+                    DeadlineExceeded, TimeoutError, ValueError,
+                    TypeError):
+                raise
+            except Exception:
+                if attempt >= self.submitRetries:
+                    raise
+                time.sleep(self._retryDelay(attempt, deadline))
+                attempt += 1
 
     def queuedRows(self) -> int:
         with self._lock:
@@ -1109,8 +1643,12 @@ class ReplicaSet:
             reps, self._replicas = self._replicas, []
         for ex in reps:
             ex.shutdown()
-        for th in self._reapers:
-            th.join(timeout=35.0)
+        for pth in self._probes:
+            pth.join(timeout=max(5.0, self.probeTimeout +
+                                 self.probeInterval + 1.0))
+        for rth in self._reapers:
+            rth.join(timeout=35.0)
+        self._probes = []
         self._reapers = []
 
     def armAutoscale(self, monitor, highQueueRows: int = 64,

@@ -45,6 +45,19 @@ def reply_safely(handler, code: int, body: bytes, ctype: str,
         handler.close_connection = True
 
 
+# Producers yield this sentinel (instead of a JSON-able object) to ask
+# stream_ndjson for a keep-alive comment line: a decode gap is in
+# progress, write SOMETHING so an idle proxy doesn't reap the stream.
+KEEPALIVE = object()
+
+# The keep-alive line itself.  NDJSON has no comment syntax; by the SSE
+# convention a line starting with ':' is a comment, and every client of
+# this endpoint (JsonRemoteInference, tests, curl | jq with a grep -v)
+# skips non-'{' lines.  It is a full chunked-encoding frame so proxies
+# see forward progress on the wire.
+_KEEPALIVE_LINE = b": keep-alive\n"
+
+
 def stream_ndjson(handler, items, final: Optional[dict] = None) -> None:
     """Chunked NDJSON streaming response: one JSON object per line,
     flushed as it is produced — the serving tier's token streaming
@@ -58,6 +71,12 @@ def stream_ndjson(handler, items, final: Optional[dict] = None) -> None:
     stops the iteration without killing the handler thread (and without
     consuming the rest of the generator, so the producer can cancel the
     work — same contract as :func:`reply_safely`).
+
+    When ``items`` yields the :data:`KEEPALIVE` sentinel, a comment line
+    is written instead of JSON (idle-stream heartbeat during decode
+    gaps).  A client that hangs up during a keep-alive write cancels the
+    sequence exactly like a hangup during a token write — the write
+    raises, the generator is closed, the producer reaps the slot.
     """
     try:
         handler.send_response(200)
@@ -65,15 +84,25 @@ def stream_ndjson(handler, items, final: Optional[dict] = None) -> None:
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
 
-        def chunk(obj) -> None:
-            data = json.dumps(obj).encode("utf-8") + b"\n"
+        def frame(data: bytes) -> None:
             handler.wfile.write(
                 f"{len(data):x}\r\n".encode("ascii") + data + b"\r\n")
             handler.wfile.flush()
 
+        def chunk(obj) -> None:
+            frame(json.dumps(obj).encode("utf-8") + b"\n")
+
         try:
             for obj in items:
-                chunk(obj)
+                if obj is KEEPALIVE:
+                    frame(_KEEPALIVE_LINE)
+                else:
+                    chunk(obj)
+        except (BrokenPipeError, ConnectionResetError):
+            # the CLIENT hung up (token or keep-alive write alike):
+            # don't write an error line into a dead socket — let the
+            # outer handler close the producer so it can cancel
+            raise
         except Exception as e:
             chunk({"error": f"{type(e).__name__}: {e}"})
         else:

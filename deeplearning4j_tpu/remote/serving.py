@@ -45,14 +45,32 @@ import numpy as np
 from deeplearning4j_tpu.telemetry import (ThresholdRule, get_registry,
                                           serving_metrics)
 
-__all__ = ["BucketLadder", "ServiceOverloaded", "AdmissionControl",
-           "ForwardServing", "GenerativeServing", "BucketedExecutor",
-           "ModelRegistry", "InferenceServer", "histogram_quantile"]
+__all__ = ["BucketLadder", "ServiceOverloaded", "DeadlineExceeded",
+           "NoHealthyReplicas", "AdmissionControl", "ForwardServing",
+           "GenerativeServing", "BucketedExecutor", "ModelRegistry",
+           "InferenceServer", "histogram_quantile"]
 
 
 class ServiceOverloaded(RuntimeError):
     """Admission control rejected the request (HTTP 429).  ``retryAfter``
     is the server's backoff hint in seconds."""
+
+    def __init__(self, detail: str, retryAfter: float = 1.0):
+        super().__init__(detail)
+        self.retryAfter = float(retryAfter)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's end-to-end deadline expired (HTTP 504) — shed at
+    admission before it ever held a decode slot, or cancelled between
+    decode steps with its KV pages freed."""
+
+
+class NoHealthyReplicas(RuntimeError):
+    """Every replica behind the route has been removed by health probing
+    or scale-down (HTTP 503 + ``Retry-After``, NOT a bare 500: the
+    condition is transient — autoscaling or a swap will repopulate the
+    route — so clients should back off and retry, not alert)."""
 
     def __init__(self, detail: str, retryAfter: float = 1.0):
         super().__init__(detail)
@@ -940,11 +958,13 @@ class InferenceServer:
                             # replies; once the generator exists, tokens
                             # stream out as each decode step completes
                             gen = ex.submitStream(payload)
-                            from deeplearning4j_tpu.remote.server import \
-                                stream_ndjson
-                            stream_ndjson(self,
-                                          ({"token": t} for t in gen),
-                                          final={"done": True})
+                            from deeplearning4j_tpu.remote.server import (
+                                KEEPALIVE, stream_ndjson)
+                            stream_ndjson(
+                                self,
+                                (t if t is KEEPALIVE else {"token": t}
+                                 for t in gen),
+                                final={"done": True})
                             return
                         out = ex.submit(payload)
                         # jaxlint: sync-ok -- response serialization: the result leaves as JSON
@@ -961,6 +981,17 @@ class InferenceServer:
                         headers={"Retry-After":
                                  str(max(1, int(math.ceil(e.retryAfter))))})
                     return
+                except NoHealthyReplicas as e:
+                    # transient fleet state, not a server bug: 503 tells
+                    # the client to back off, 500 would page someone
+                    self._reply_json(
+                        503, {"error": f"no healthy replicas: {e}",
+                              "retry_after": e.retryAfter},
+                        headers={"Retry-After":
+                                 str(max(1, int(math.ceil(e.retryAfter))))})
+                    return
+                except DeadlineExceeded as e:
+                    body, code = {"error": f"deadline exceeded: {e}"}, 504
                 except (ValueError, TypeError) as e:
                     body, code = {"error": f"{type(e).__name__}: {e}"}, 400
                 except Exception as e:

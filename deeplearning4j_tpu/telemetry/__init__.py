@@ -39,7 +39,7 @@ from deeplearning4j_tpu.telemetry.flight import (  # noqa: F401
 from deeplearning4j_tpu.telemetry.health import (  # noqa: F401
     AlertRule, DivergencePrecursorRule, EtlStarvationRule, HealthMonitor,
     ReplicaStragglerRule, ThresholdRule, TrainingStallRule, default_rules,
-    health_summary)
+    health_summary, recsys_hash_collision_rule)
 from deeplearning4j_tpu.telemetry.instrument import (  # noqa: F401
     AotCacheMetrics, CoordMetrics, ElasticMetrics, EtlMetrics, MeshMetrics,
     RecsysMetrics, ReplicaTimingListener, ServingMetrics, aot_metrics,

@@ -38,7 +38,7 @@ from deeplearning4j_tpu.telemetry.registry import (MetricsRegistry,
 __all__ = ["AlertRule", "ThresholdRule", "TrainingStallRule",
            "ReplicaStragglerRule", "EtlStarvationRule",
            "DivergencePrecursorRule", "HealthMonitor", "default_rules",
-           "health_summary"]
+           "health_summary", "recsys_hash_collision_rule"]
 
 _process_start = time.time()
 
@@ -254,6 +254,19 @@ def default_rules(stallTimeout: float = 120.0, stragglerRatio: float = 2.0,
             ReplicaStragglerRule(ratio=stragglerRatio),
             EtlStarvationRule(forSeconds=starvationSeconds),
             DivergencePrecursorRule(quietSeconds=divergenceQuietSeconds)]
+
+
+def recsys_hash_collision_rule(threshold: float = 1.0) -> ThresholdRule:
+    """Fire when the :class:`~deeplearning4j_tpu.datavec.pipeline.
+    RaggedFeatureReader` sampled estimator has observed ``threshold``
+    or more distinct raw ids sharing a hashed embedding row.  Hash
+    collisions never error — two users silently share an embedding and
+    ranking quality degrades — so the counter (and this rule) is the
+    only way the condition pages anyone before an offline metric drifts
+    (ISSUE 17 closing ISSUE 16's gap)."""
+    return ThresholdRule("recsys_hash_collision",
+                         "dl4j_tpu_recsys_hash_collisions_total", ">=",
+                         threshold)
 
 
 class HealthMonitor:
@@ -628,10 +641,25 @@ def health_summary(registry: Optional[MetricsRegistry] = None) -> dict:
             last_step_age = now - _progress["t"]
     firing = reg.get("dl4j_tpu_health_alerts_firing")
     n_firing = int(firing.value()) if firing is not None else 0
-    return {"status": "alerting" if n_firing else "ok",
-            "uptime_seconds": round(time.time() - _process_start, 3),
-            "steps_total": total,
-            "last_step_age_seconds": None if last_step_age is None
-            else round(last_step_age, 3),
-            "firing_alerts": n_firing,
-            "pid": os.getpid()}
+    out = {"status": "alerting" if n_firing else "ok",
+           "uptime_seconds": round(time.time() - _process_start, 3),
+           "steps_total": total,
+           "last_step_age_seconds": None if last_step_age is None
+           else round(last_step_age, 3),
+           "firing_alerts": n_firing,
+           "pid": os.getpid()}
+    # serving replica health, when a ReplicaSet's prober publishes it:
+    # {model: {replica: 0|1}} — the scrape an operator (or a
+    # blue/green rollout script) reads before trusting a route
+    health = reg.get("dl4j_tpu_serving_replica_health")
+    if health is not None:
+        d = health.data()
+        names = d["labelnames"]
+        byModel: dict = {}
+        for labelvalues, value in d["cells"]:
+            cell = dict(zip(names, labelvalues))
+            byModel.setdefault(cell.get("model", ""), {})[
+                cell.get("replica", "")] = int(value)
+        if byModel:
+            out["replica_health"] = byModel
+    return out

@@ -444,6 +444,40 @@ class ServingMetrics:
             "(scaled by the serving_queue_depth remediation)",
             labelnames=("model",))
 
+    def failovers(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_failovers_total",
+            "Sequences failed over from an unhealthy/crashed replica to "
+            "a survivor, replayed from the prompt with streamSkip hiding "
+            "the re-emission (exactly-once delivery across the move)",
+            labelnames=("model",))
+
+    def deadline_sheds(self):
+        return get_registry().counter(
+            "dl4j_tpu_serving_deadline_sheds_total",
+            "Requests shed because their end-to-end deadline expired — "
+            "stage=admission never entered a decode slot (HTTP 504); "
+            "stage=queued/decode were cancelled between steps with their "
+            "KV pages freed",
+            labelnames=("model", "stage"))
+
+    def drain_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_drain_seconds",
+            "Graceful-drain duration when a replica leaves the route "
+            "(scaleDown/swap): admission stopped, in-flight sequences "
+            "run to completion bounded by drainTimeout, stragglers "
+            "failed over to survivors",
+            buckets=SERVING_WARMUP_BUCKETS, labelnames=("model",))
+
+    def replica_health(self):
+        return get_registry().gauge(
+            "dl4j_tpu_serving_replica_health",
+            "Per-replica probe verdict: 1 healthy (probe within timeout "
+            "under the consecutive-failure threshold), 0 removed from "
+            "routing — surfaced in /healthz",
+            labelnames=("model", "replica"))
+
 
 _SERVING_METRICS = ServingMetrics()
 
@@ -768,6 +802,14 @@ class RecsysMetrics:
             "End-to-end top-k retrieval latency through the "
             "continuous batcher (submit to ranked ids)",
             buckets=RECSYS_TOPK_BUCKETS)
+
+    def hash_collisions(self):
+        return get_registry().counter(
+            "dl4j_tpu_recsys_hash_collisions_total",
+            "Distinct raw feature values observed mapping to the same "
+            "hashed embedding row (sampled estimator in "
+            "RaggedFeatureReader; silent collisions degrade ranking "
+            "quality without ever erroring)")
 
 
 _RECSYS_METRICS = RecsysMetrics()

@@ -90,6 +90,11 @@ def pytest_configure(config):
         "tables, two-phase dedup'd sparse lookup, ragged ingestion "
         "exactly-once, elastic re-mesh of a row-sharded table, top-k "
         "retrieval serving through the continuous batcher)")
+    config.addinivalue_line(
+        "markers", "servfault: serving fault-tolerance tests (replica "
+        "health probing, in-flight failover with exactly-once token "
+        "delivery, end-to-end deadlines, graceful drain/swap, the "
+        "serving chaos soak)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,494 @@
+"""Serving-tier fault tolerance (ISSUE 17): replica health probing,
+in-flight failover with exactly-once token delivery, end-to-end
+deadlines (admission shed, queued shed, mid-decode cancel), graceful
+drain/swap, keep-alive streaming, the bounded retire log, the sampled
+hash-collision estimator and the seeded serving chaos soak."""
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.fault.chaos import (_SERVING_CAPS,
+                                            SERVING_EVENT_KINDS,
+                                            ServingChaosSoak,
+                                            build_serving_schedule)
+from deeplearning4j_tpu.nlp.transformer import TransformerLM
+from deeplearning4j_tpu.remote import (ContinuousBatcher, InferenceServer,
+                                       ModelRegistry, ReplicaSet)
+from deeplearning4j_tpu.remote.serving import (DeadlineExceeded,
+                                               NoHealthyReplicas,
+                                               histogram_quantile)
+from deeplearning4j_tpu.telemetry import (MetricsRegistry, get_registry,
+                                          serving_metrics)
+
+pytestmark = pytest.mark.servfault
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = telemetry.set_registry(MetricsRegistry())
+    yield
+    _inj.clear_serving_faults()
+    telemetry.set_registry(prev)
+
+
+def _lm(maxLen=64, seed=5, vocab=40):
+    return TransformerLM(vocabSize=vocab, nLayers=1, nHeads=2,
+                         headSize=8, maxLen=maxLen, seed=seed)
+
+
+def _post(port, path, obj, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _metric(name, **labels):
+    m = get_registry().get(name)
+    if m is None:
+        return 0.0
+    try:
+        return float(m.value(**labels))
+    except (ValueError, AttributeError):
+        return 0.0
+
+
+def _wait(pred, timeout=15.0, interval=0.02):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ----------------------------------------------- end-to-end deadlines ----
+
+def test_deadline_shed_at_admission_holds_nothing():
+    """An already-expired request sheds 504 at the admission gate: no
+    slot, no page, no queue entry — and the shed is counted."""
+    cb = ContinuousBatcher(_lm(), name="dl-admit", maxSlots=2,
+                           pageSize=8).start()
+    try:
+        free0 = cb.pool.freePages()
+        with pytest.raises(DeadlineExceeded):
+            cb.submit({"tokens": [1, 2, 3], "maxNewTokens": 4,
+                       "deadlineSeconds": 0.0})
+        assert cb.pool.freePages() == free0
+        assert cb.queuedRows() == 0 and not cb.busy()
+        assert _metric("dl4j_tpu_serving_deadline_sheds_total",
+                       model="dl-admit", stage="admission") == 1
+        # validation: a negative budget is the caller's bug, not a shed
+        with pytest.raises(ValueError):
+            cb.submit({"tokens": [1, 2, 3], "maxNewTokens": 4,
+                       "deadlineSeconds": -1.0})
+    finally:
+        cb.shutdown()
+
+
+def test_deadline_expires_mid_decode_and_frees_pages():
+    """A deadline that runs out between decode steps cancels the
+    sequence at the next boundary: the stream raises 504, the slot
+    retires, every page returns to the free list."""
+    cb = ContinuousBatcher(_lm(), name="dl-mid", maxSlots=2,
+                           pageSize=8).start()
+    try:
+        _inj.set_replica_slowdown("dl-mid", 0.05)
+        gen = cb.submitStream({"tokens": [1, 2, 3], "maxNewTokens": 40,
+                               "deadlineSeconds": 0.25})
+        got = []
+        with pytest.raises(DeadlineExceeded):
+            for tok in gen:
+                got.append(tok)
+        assert len(got) < 40            # it really died mid-decode
+        _inj.clear_serving_faults()
+        assert _wait(lambda: cb.pool.freePages() == cb.pool.numPages - 1)
+        assert _metric("dl4j_tpu_serving_deadline_sheds_total",
+                       model="dl-mid", stage="decode") >= 1
+    finally:
+        _inj.clear_serving_faults()
+        cb.shutdown()
+
+
+# ------------------------------------------ crash -> probe -> failover ----
+
+def test_replica_crash_fails_over_stream_exactly_once():
+    """The tentpole end-to-end: a replica dies mid-stream, the probe
+    retires it, its in-flight sequence replays on a survivor with
+    ``streamSkip`` hiding the replay — the client sees the reference
+    token sequence exactly once, no drops, no duplicates."""
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    ref = _lm()
+    prompt = [3, 1, 4, 1, 5]
+    quota = 12
+    want = [int(t) for t in ref.generate(
+        np.asarray([prompt], np.int32), quota)[0]]
+    rs = ReplicaSet(factory, name="fo", replicas=2, maxReplicas=2,
+                    probeInterval=0.05, probeTimeout=2.0,
+                    probeFailThreshold=1, seed=0).start()
+    try:
+        for nm in ("fo/0", "fo/1"):     # slow decode so the crash can
+            _inj.set_replica_slowdown(nm, 0.03)     # land mid-stream
+        gen = rs.submitStream({"tokens": prompt, "maxNewTokens": quota})
+        got = [next(gen), next(gen)]
+        with rs._lock:
+            busy = [ex for ex in rs._replicas if ex.busy()]
+        assert busy, "stream should hold a slot on some replica"
+        _inj.arm_replica_crash(busy[0].name)
+        got.extend(t for t in gen if isinstance(t, int))
+        assert got == want
+        assert _wait(lambda: rs.replicaCount() == 1)
+        assert _metric("dl4j_tpu_serving_failovers_total",
+                       model="fo") >= 1
+        assert _metric("dl4j_tpu_serving_replica_health",
+                       model="fo", replica=busy[0].name) == 0
+    finally:
+        _inj.clear_serving_faults()
+        rs.shutdown()
+
+
+# --------------------------------------------------- drain and swap ----
+
+def test_scaledown_drains_active_stream_token_for_token():
+    """``scaleDown`` while a stream is active: the replica leaves
+    routing immediately, but the in-flight stream finishes on it
+    token-for-token before shutdown (the graceful half of drain)."""
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    ref = _lm()
+    prompt = [7, 2, 9]
+    quota = 10
+    want = [int(t) for t in ref.generate(
+        np.asarray([prompt], np.int32), quota)[0]]
+    rs = ReplicaSet(factory, name="drain", replicas=2, maxReplicas=2,
+                    drainTimeout=20.0, probeInterval=0, seed=0).start()
+    try:
+        with rs._lock:
+            victim = rs._replicas[-1]   # scaleDown pops the LAST one
+        _inj.set_replica_slowdown(victim.name, 0.02)
+        gen = victim.submitStream({"tokens": prompt,
+                                   "maxNewTokens": quota})
+        got = [next(gen)]
+        assert rs.scaleDown() is not None
+        assert rs.replicaCount() == 1   # out of routing NOW
+        got.extend(t for t in gen if isinstance(t, int))
+        assert got == want              # drained, not dropped
+        assert _wait(lambda: histogram_quantile(
+            serving_metrics().drain_seconds(), 0.5, model="drain")
+            is not None)
+    finally:
+        _inj.clear_serving_faults()
+        rs.shutdown()
+
+
+def test_scaledown_straggler_fails_over_past_drain_timeout():
+    """The bounded half of drain: a stream too slow to finish inside
+    ``drainTimeout`` is evacuated and replayed on a survivor — still
+    exactly once, never dropped."""
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    ref = _lm()
+    prompt = [8, 8, 3, 2]
+    quota = 16
+    want = [int(t) for t in ref.generate(
+        np.asarray([prompt], np.int32), quota)[0]]
+    rs = ReplicaSet(factory, name="strag", replicas=2, maxReplicas=2,
+                    drainTimeout=0.2, probeInterval=0, seed=0).start()
+    try:
+        with rs._lock:
+            victim = rs._replicas[-1]
+        # too slow to emit 16 tokens inside the 0.2s drain budget
+        _inj.set_replica_slowdown(victim.name, 0.1)
+        gen = victim.submitStream({"tokens": prompt,
+                                   "maxNewTokens": quota})
+        got = [next(gen)]
+        assert rs.scaleDown() is not None
+        got.extend(t for t in gen if isinstance(t, int))
+        assert got == want
+        assert _metric("dl4j_tpu_serving_failovers_total",
+                       model="strag") >= 1
+    finally:
+        _inj.clear_serving_faults()
+        rs.shutdown()
+
+
+def test_swap_replaces_replica_blue_green():
+    """``swap`` warms the green replica BEFORE the blue one leaves
+    routing: the set never dips to zero and serves identically after."""
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    ref = _lm()
+    prompt = [5, 6, 7]
+    rs = ReplicaSet(factory, name="swap", replicas=1, maxReplicas=1,
+                    drainTimeout=5.0, probeInterval=0, seed=0).start()
+    try:
+        out = rs.swap()
+        assert out is not None and "swapped 1" in out
+        assert rs.replicaCount() == 1
+        with rs._lock:
+            newName = rs._replicas[0].name
+        assert newName == "swap/1"      # the green replica, not blue
+        got = rs.submit({"tokens": prompt, "maxNewTokens": 6},
+                        timeout=60)
+        np.testing.assert_array_equal(
+            got, ref.generate(np.asarray([prompt], np.int32), 6))
+        assert _metric("dl4j_tpu_serving_replica_health",
+                       model="swap", replica="swap/0") == 0
+    finally:
+        rs.shutdown()
+
+
+# ------------------------------------------- HTTP front: 503/504/healthz ----
+
+def test_http_504_503_and_healthz_probe_state():
+    """The status split over HTTP: expired deadline = 504; zero healthy
+    replicas = 503 + Retry-After (never a bare 500); and /healthz
+    carries the prober's per-replica 0/1 map."""
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    rs = ReplicaSet(factory, name="ft", replicas=1, maxReplicas=1,
+                    probeInterval=0.05, probeTimeout=2.0,
+                    probeFailThreshold=1, retryAfter=7.0, seed=0)
+    registry = ModelRegistry()
+    registry.register("ft", rs)
+    server = InferenceServer(registry).start()
+    try:
+        code, body, _ = _post(server.port, "/v1/serving/ft",
+                              {"tokens": [1, 2, 3], "maxNewTokens": 4,
+                               "deadlineSeconds": 0.0})
+        assert code == 504 and "deadline" in body["error"]
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz",
+                timeout=30) as resp:
+            hz = json.loads(resp.read())
+        assert hz["replica_health"]["ft"]["ft/0"] == 1
+
+        _inj.arm_replica_crash("ft/0")
+        assert _wait(lambda: rs.replicaCount() == 0)
+        code, body, headers = _post(server.port, "/v1/serving/ft",
+                                    {"tokens": [1, 2, 3],
+                                     "maxNewTokens": 4})
+        assert code == 503
+        assert headers["Retry-After"] == "7"
+        assert body["retry_after"] == 7.0
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz",
+                timeout=30) as resp:
+            hz = json.loads(resp.read())
+        assert hz["replica_health"]["ft"]["ft/0"] == 0
+    finally:
+        _inj.clear_serving_faults()
+        server.stop()
+        registry.shutdown()
+
+
+def test_no_healthy_replicas_raises_with_retry_after():
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    rs = ReplicaSet(factory, name="nhr", replicas=1, maxReplicas=1,
+                    probeInterval=0.05, probeFailThreshold=1,
+                    retryAfter=3.0, seed=0).start()
+    try:
+        _inj.arm_replica_crash("nhr/0")
+        assert _wait(lambda: rs.replicaCount() == 0)
+        with pytest.raises(NoHealthyReplicas) as ei:
+            rs.submit({"tokens": [1, 2], "maxNewTokens": 2}, timeout=30)
+        assert ei.value.retryAfter == 3.0
+    finally:
+        _inj.clear_serving_faults()
+        rs.shutdown()
+
+
+# --------------------------------------- keep-alive + hangup + transport ----
+
+def test_keepalive_sentinel_during_decode_gaps_and_hangup_frees_pages():
+    from deeplearning4j_tpu.remote.server import KEEPALIVE
+    cb = ContinuousBatcher(_lm(), name="ka", maxSlots=2,
+                           pageSize=8).start()
+    try:
+        ref = _lm()
+        prompt = [4, 4, 2]
+        _inj.set_replica_slowdown("ka", 0.08)
+        gen = cb.submitStream({"tokens": prompt, "maxNewTokens": 5,
+                               "keepAliveSeconds": 0.02})
+        items = list(gen)
+        assert any(it is KEEPALIVE for it in items)  # gaps heartbeat
+        got = [it for it in items if isinstance(it, int)]
+        want = [int(t) for t in ref.generate(
+            np.asarray([prompt], np.int32), 5)[0]]
+        assert got == want              # sentinels never displace tokens
+        with pytest.raises(ValueError):
+            cb.submitStream({"tokens": prompt, "maxNewTokens": 2,
+                             "keepAliveSeconds": 0.0})
+        # hangup: closing the generator cancels at the next boundary
+        gen2 = cb.submitStream({"tokens": prompt, "maxNewTokens": 30})
+        next(gen2)
+        gen2.close()
+        _inj.clear_serving_faults()
+        assert _wait(lambda: cb.pool.freePages() == cb.pool.numPages - 1)
+    finally:
+        _inj.clear_serving_faults()
+        cb.shutdown()
+
+
+def test_stream_ndjson_writes_keepalive_comment_and_hangup_cancels():
+    """Transport level: the KEEPALIVE sentinel becomes an SSE-style
+    comment line (a full chunked frame), and a client that hangs up
+    during the keep-alive write closes the producer like any failed
+    token write."""
+    from deeplearning4j_tpu.remote.server import (KEEPALIVE,
+                                                  stream_ndjson)
+
+    class Handler:
+        def __init__(self, failOn=None):
+            self.wfile = self
+            self.buf = io.BytesIO()
+            self.failOn = failOn
+            self.close_connection = False
+
+        def send_response(self, code):
+            pass
+
+        def send_header(self, k, v):
+            pass
+
+        def end_headers(self):
+            pass
+
+        def write(self, data):
+            if self.failOn is not None and self.failOn in data:
+                raise BrokenPipeError("client hung up")
+            self.buf.write(data)
+
+        def flush(self):
+            pass
+
+    h = Handler()
+    stream_ndjson(h, iter([{"token": 1}, KEEPALIVE, {"token": 2}]),
+                  final={"done": True})
+    raw = h.buf.getvalue()
+    assert b": keep-alive\n" in raw
+    lines = [json.loads(ln) for ln in raw.split(b"\r\n")
+             if ln.startswith(b"{")]
+    assert lines == [{"token": 1}, {"token": 2}, {"done": True}]
+
+    closed = []
+
+    def items():
+        try:
+            yield {"token": 1}
+            yield KEEPALIVE
+            yield {"token": 2}
+        finally:
+            closed.append(True)
+
+    h2 = Handler(failOn=b": keep-alive\n")
+    stream_ndjson(h2, items(), final={"done": True})
+    assert closed == [True] and h2.close_connection
+
+
+# ------------------------------------------------- bounded retire log ----
+
+def test_retire_log_is_bounded():
+    cb = ContinuousBatcher(_lm(), name="rlog", maxSlots=2, pageSize=8,
+                           retireLogSize=4).start()
+    try:
+        for i in range(6):
+            cb.submit({"tokens": [1 + i, 2, 3], "maxNewTokens": 2},
+                      timeout=60)
+        assert len(cb._retireLog) <= 4
+        assert cb._retireRate() >= 0.0  # the rate still reads fine
+    finally:
+        cb.shutdown()
+
+
+# --------------------------------------- hash-collision estimator ----
+
+def test_hash_collision_estimator_feeds_health_rule():
+    """Satellite d: cranking distinct raw ids through a tiny hashed
+    vocabulary witnesses collisions; the sampled estimator feeds the
+    counter and the ``recsys_hash_collision`` rule fires on it."""
+    from deeplearning4j_tpu.datavec.pipeline import RaggedFeatureReader
+    from deeplearning4j_tpu.telemetry.health import (
+        HealthMonitor, recsys_hash_collision_rule)
+
+    recs = [([i], i % 2) for i in range(64)]
+    r = RaggedFeatureReader(recs, batchSize=16, numEmbeddings=3,
+                            numClasses=2, collisionSampleEvery=1)
+    while r.hasNext():
+        r.next()
+    seen = _metric("dl4j_tpu_recsys_hash_collisions_total")
+    assert seen >= 1                    # 64 ids into 3 rows MUST collide
+    mon = HealthMonitor(rules=[recsys_hash_collision_rule()],
+                        interval=3600)
+    firing = mon.evaluate_once(now=0.0)
+    assert "recsys_hash_collision" in firing
+
+    # sampling disabled: zero overhead, zero counts
+    r0 = RaggedFeatureReader(recs, batchSize=16, numEmbeddings=3,
+                             numClasses=2, collisionSampleEvery=0)
+    while r0.hasNext():
+        r0.next()
+    assert _metric("dl4j_tpu_recsys_hash_collisions_total") == seen
+
+
+# ------------------------------------------------- serving chaos soak ----
+
+def test_serving_schedule_pure_capped_first_half():
+    a = build_serving_schedule(7, 30, events=4)
+    b = build_serving_schedule(7, 30, events=4)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a
+    assert len({json.dumps(build_serving_schedule(s, 30, events=4),
+                           sort_keys=True) for s in range(8)}) > 1
+    for seed in range(16):
+        sch = build_serving_schedule(seed, 30, events=4)
+        steps = [e["step"] for e in sch]
+        assert steps == sorted(steps)
+        counts = {}
+        for e in sch:
+            assert e["kind"] in SERVING_EVENT_KINDS
+            assert e["step"] < 15       # first half: recovery fits
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        for kind, cap in _SERVING_CAPS.items():
+            assert counts.get(kind, 0) <= cap, (seed, kind)
+
+
+def test_serving_chaos_soak_green():
+    """The acceptance gate: seed 0 draws all four fault kinds (crash,
+    brownout, hangup, storm) and every standing invariant holds."""
+    report = ServingChaosSoak(0, replicas=3, clients=4, events=4,
+                              totalTicks=30, maxNewTokens=6).run()
+    assert report["ok"], report
+    fired = set(report["fired"])
+    assert {"replica_crash", "slow_replica", "client_hangup",
+            "deadline_storm"} <= fired
+    inv = report["invariants"]
+    assert inv["exactly_once_tokens"]
+    assert inv["all_pages_freed"]
+    assert inv["flat_jit_misses"]
+    assert inv["crashed_replica_retired"]
+    assert inv["deadline_shed_504"]
+    assert report["failovers"] >= 1

@@ -23,7 +23,8 @@ BUILTIN = {
 # from conftest registration, `-m <marker>` selects nothing and that whole
 # subsystem's coverage evaporates without a red test
 REQUIRED = {"tpu", "slow", "fault", "telemetry", "etl", "serving", "lint",
-            "mesh", "elastic", "coord", "aot", "chaos", "cbatch", "recsys"}
+            "mesh", "elastic", "coord", "aot", "chaos", "cbatch", "recsys",
+            "servfault"}
 
 MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_]\w*)")
 REGISTER_RE = re.compile(
