@@ -850,12 +850,28 @@ def _bench_continuous_batching(duration: float = 4.0, maxSlots: int = 8,
     lat.sort()
     p99 = round(lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3, 2) \
         if lat else None
+    # latency decomposition off the serving histograms the batcher
+    # observed under model="cbatch": time-to-first-token (admission +
+    # prefill cost the client feels) vs inter-token gap (decode step
+    # cadence) — the end-to-end p99 above conflates the two
+    from deeplearning4j_tpu.remote.serving import histogram_quantile
+    from deeplearning4j_tpu.telemetry import get_registry
+    latq = {}
+    for metric, key in (("dl4j_tpu_serving_ttft_seconds", "ttft"),
+                        ("dl4j_tpu_serving_inter_token_seconds", "itl")):
+        hist = get_registry().get(metric)
+        for q, tag in ((0.5, "p50"), (0.99, "p99")):
+            v = histogram_quantile(hist, q, model="cbatch") \
+                if hist is not None else None
+            latq[f"cbatch_{key}_{tag}_ms"] = \
+                round(v * 1e3, 3) if v is not None else None
     return {
         "cbatch_occupancy": round(occ, 4) if occ is not None else None,
         "cbatch_goodput_tokens_per_sec": round(done["tokens"] / window, 1),
         "cbatch_requests_ok": done["requests"],
         "cbatch_requests_shed": done["shed"],
         "cbatch_p99_ms": p99,
+        **latq,
         "cbatch_jit_cache_misses_steady": int(misses),
         "cbatch_slots": maxSlots,
         "cbatch_clients": clients,

@@ -48,6 +48,7 @@ or, from a shell, ``python tools/chaos.py --seed 7``.
 from __future__ import annotations
 
 import logging
+import math
 import os
 import threading
 import time
@@ -920,6 +921,41 @@ class ServingChaosSoak:
                 total += float(value)  # jaxlint: sync-ok -- registry cell values are host floats
         return total
 
+    @staticmethod
+    def _latencyQuantile(name: str, q: float,
+                         modelPrefix: str) -> Optional[float]:
+        """Quantile over a latency histogram's buckets MERGED across
+        every cell whose model label starts with ``modelPrefix`` — the
+        soak's replicas observe under per-replica names (``soakN/0``
+        ...), and the report wants the fleet-wide TTFT/ITL, not one
+        replica's.  Upper-bound attribution, same convention as
+        ``serving.histogram_quantile``."""
+        m = get_registry().get(name)
+        if m is None:
+            return None
+        d = m.data()
+        names = d["labelnames"]
+        # jaxlint: sync-ok -- registry bucket bounds are host floats
+        buckets = [float(b) for b in d.get("buckets", ())]
+        agg = [0] * (len(buckets) + 1)
+        for labelvalues, cell in d["cells"]:
+            labels = dict(zip(names, labelvalues))
+            if not str(labels.get("model", "")).startswith(modelPrefix):
+                continue
+            for i, c in enumerate(cell.get("counts", [])[:len(agg)]):
+                agg[i] += int(c)  # jaxlint: sync-ok -- registry bucket counts are host ints
+        total = sum(agg)
+        if total <= 0:
+            return None
+        rank = q * total
+        cum, prev = 0, 0.0
+        for bound, c in zip(buckets + [float("inf")], agg):
+            cum += c
+            if cum >= rank:
+                return bound if not math.isinf(bound) else prev
+            prev = bound
+        return prev
+
     # -- the run ---------------------------------------------------------
     def run(self) -> dict:
         from deeplearning4j_tpu.remote.scheduler import ReplicaSet
@@ -1049,6 +1085,16 @@ class ServingChaosSoak:
             report["failovers"] = self._sumCells(
                 "dl4j_tpu_serving_failovers_total",
                 model=self.name) - failovers0
+            # latency decomposition across the soak's replicas: the
+            # fleet-wide TTFT and inter-token gaps the chaos actually
+            # cost (the ITL p99 CONTAINS any failover gap by design)
+            for metric, key in (
+                    ("dl4j_tpu_serving_ttft_seconds", "ttft"),
+                    ("dl4j_tpu_serving_inter_token_seconds", "itl")):
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    v = self._latencyQuantile(metric, q, self.name)
+                    report[f"{key}_{tag}_seconds"] = \
+                        round(v, 6) if v is not None else None
             report["ok"] = bool(all(inv.values()) and not errors)
         except (KeyboardInterrupt, SystemExit):
             raise

@@ -65,7 +65,10 @@ from deeplearning4j_tpu.remote.serving import (AdmissionControl,
                                                DeadlineExceeded,
                                                NoHealthyReplicas,
                                                ServiceOverloaded)
-from deeplearning4j_tpu.telemetry import ThresholdRule, serving_metrics
+from deeplearning4j_tpu.telemetry import (RequestContext, ThresholdRule,
+                                          current_context, flight_recorder,
+                                          observe_exemplar, serving_metrics,
+                                          timeline_store, tracer)
 
 __all__ = ["KVCachePool", "ContinuousBatcher", "ReplicaSet"]
 
@@ -174,10 +177,11 @@ class _Pending:
     PER-REQUEST lock, not a per-batcher one: after a failover the rows
     of one request can retire on DIFFERENT replicas concurrently."""
     __slots__ = ("rows", "quota", "doneRows", "error", "event", "t0",
-                 "deadline", "lock")
+                 "deadline", "lock", "ctx", "firstTokenAt")
 
     def __init__(self, rows: int, quota: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 ctx: Optional[RequestContext] = None):
         self.rows = int(rows)
         self.quota = int(quota)
         self.doneRows = 0
@@ -186,13 +190,18 @@ class _Pending:
         self.t0 = time.perf_counter()
         self.deadline = deadline        # absolute time.monotonic(), or None
         self.lock = threading.Lock()
+        # request-scoped observability: ONE context for the request's
+        # whole life, shared by every row and surviving failover hops
+        self.ctx = ctx
+        self.firstTokenAt: Optional[float] = None   # TTFT observed once
 
 
 class _Seq:
     """One sequence of a request: queued, then bound to a decode slot."""
     __slots__ = ("tokens", "realLen", "bucket", "quota", "pages", "parent",
                  "row", "emitted", "streamQ", "streamed", "streamSkip",
-                 "cancelled", "restarts", "deadline", "forced")
+                 "cancelled", "restarts", "deadline", "forced", "ctx",
+                 "enqT", "lastTokT")
 
     def __init__(self, tokens: np.ndarray, bucket: int, quota: int,
                  pages: int, parent: _Pending, row: int,
@@ -215,6 +224,9 @@ class _Seq:
         # replay so the prefix a client sees never depends on bit-wise
         # reproducibility across the replica that adopts the sequence
         self.forced: List[int] = []
+        self.ctx = parent.ctx           # the request's one trace context
+        self.enqT: Optional[float] = None    # perf_counter at last enqueue
+        self.lastTokT: Optional[float] = None  # last FRESH token's time
 
 
 def _finish_seq(seq: _Seq, error: Optional[BaseException],
@@ -231,12 +243,23 @@ def _finish_seq(seq: _Seq, error: Optional[BaseException],
         if error is not None and parent.error is None:
             parent.error = error
         last = parent.doneRows >= parent.rows
+    tid = parent.ctx.traceId if parent.ctx is not None else None
+    timeline_store().note(tid, "serving.retire", replica=model,
+                          row=seq.row, tokens=len(seq.emitted),
+                          error=type(error).__name__ if error else None)
     if last:
         sm = serving_metrics()
         sm.request_seconds().observe(time.perf_counter() - parent.t0,
                                      model=model)
         sm.requests().inc(model=model,
                           outcome="error" if parent.error else "ok")
+        if parent.error is not None and tid is not None:
+            # a failed request's whole timeline lands in the crash ring
+            # so the post-mortem has the trace without racing eviction
+            flight_recorder().record(
+                kind="serving_request_failure", trace_id=tid, model=model,
+                error=f"{type(parent.error).__name__}: {parent.error}",
+                timeline=timeline_store().events(tid))
         parent.event.set()
 
 
@@ -485,6 +508,12 @@ class ContinuousBatcher:
         sm.queue_depth().set(0, model=self.name)
         sm.compile_hits().inc(0, model=self.name)
         sm.compile_misses().inc(0, model=self.name)
+        # register the latency-decomposition histograms up front so the
+        # hot path's observe_exemplar() finds them already constructed
+        sm.ttft_seconds()
+        sm.inter_token_seconds()
+        sm.queue_wait_seconds()
+        sm.prefill_seconds()
         self.warm()
         self._updatePageGauges()
         self._cacheSeen = self.compileCacheSize()
@@ -579,7 +608,13 @@ class ContinuousBatcher:
             if not dl >= 0.0:           # also rejects NaN
                 raise ValueError("deadlineSeconds must be >= 0")
             deadline = time.monotonic() + dl
-        parent = _Pending(toks.shape[0], n, deadline=deadline)
+        # adopt the ingress-thread's ambient trace context (the HTTP
+        # handler parsed/minted it and enqueues synchronously on this
+        # same thread); a direct caller without one gets a fresh trace
+        ctx = current_context()
+        if ctx is None:
+            ctx = RequestContext.new(deadline=deadline)
+        parent = _Pending(toks.shape[0], n, deadline=deadline, ctx=ctx)
         seqs = [_Seq(toks[i:i + 1], Tp, n, pages, parent, i,
                      deadline=deadline)
                 for i in range(toks.shape[0])]
@@ -587,14 +622,18 @@ class ContinuousBatcher:
 
     def _admitGate(self, rows: int, pages: int,
                    singleStep: bool = False,
-                   deadline: Optional[float] = None) -> None:
+                   deadline: Optional[float] = None,
+                   ctx: Optional[RequestContext] = None) -> None:
         sm = serving_metrics()
+        tid = ctx.traceId if ctx is not None else None
         if deadline is not None and time.monotonic() >= deadline:
             # end-to-end deadline already spent (queueing upstream, a
             # slow hop): shed NOW rather than burn a decode slot on a
             # response nobody is waiting for (tail-at-scale discipline)
             sm.deadline_sheds().inc(model=self.name, stage="admission")
             sm.requests().inc(model=self.name, outcome="deadline")
+            timeline_store().note(tid, "serving.shed", replica=self.name,
+                                  stage="admission")
             raise DeadlineExceeded(
                 "end-to-end deadline expired before admission")
         queued = self.queuedRows()
@@ -618,9 +657,12 @@ class ContinuousBatcher:
             rule, detail = fired
             sm.shed().inc(model=self.name, rule=rule)
             sm.requests().inc(model=self.name, outcome="shed")
+            timeline_store().note(tid, "serving.shed", replica=self.name,
+                                  stage="admission", rule=rule)
             raise ServiceOverloaded(detail, retryAfter)
 
     def _enqueue(self, seqs: Sequence[_Seq], front: bool = False) -> None:
+        now = time.perf_counter()
         with self._cv:
             if not self._running:
                 raise RuntimeError(
@@ -633,11 +675,18 @@ class ContinuousBatcher:
             else:
                 for s in seqs:
                     self._queue.append(s)
+            for s in seqs:
+                s.enqT = now        # queue wait restarts on every hop
             self._queuedRows += len(seqs)
             self._queuedPages += sum(s.pages for s in seqs)
             depth = self._queuedRows
             self._cv.notify()
         serving_metrics().queue_depth().set(depth, model=self.name)
+        ts = timeline_store()
+        for s in seqs:
+            ts.note(s.ctx.traceId if s.ctx is not None else None,
+                    "serving.enqueue", replica=self.name, row=s.row,
+                    front=front, restarts=s.restarts)
 
     def submit(self, payload, timeout: Optional[float] = None):
         """Validate, admit, enqueue, block until every row finished.
@@ -648,7 +697,7 @@ class ContinuousBatcher:
         seqs, parent = self._makeSeqs(payload)
         self._admitGate(len(seqs), sum(s.pages for s in seqs),
                         singleStep=(parent.quota == 1),
-                        deadline=parent.deadline)
+                        deadline=parent.deadline, ctx=parent.ctx)
         self._enqueue(seqs)
         if not parent.event.wait(timeout):
             # reap still-QUEUED rows now — left behind they would keep
@@ -691,7 +740,7 @@ class ContinuousBatcher:
         seq = seqs[0]
         seq.streamQ = _stdqueue.Queue()
         self._admitGate(1, seq.pages, singleStep=(seq.quota == 1),
-                        deadline=parent.deadline)
+                        deadline=parent.deadline, ctx=parent.ctx)
         heartbeat = payload.get("keepAliveSeconds")
         if heartbeat is not None:
             heartbeat = float(heartbeat)  # jaxlint: sync-ok -- keepAliveSeconds arrives as host JSON, not a device scalar
@@ -795,6 +844,11 @@ class ContinuousBatcher:
         self._invalidateFns()
         self._updatePageGauges()
         if handed:
+            ts = timeline_store()
+            for seq in handed:
+                ts.note(seq.ctx.traceId if seq.ctx is not None else None,
+                        "serving.evacuate", replica=self.name,
+                        reason=f"{type(error).__name__}: {error}")
             handler(self, handed, error)
 
     def _admit(self) -> None:
@@ -833,6 +887,9 @@ class ContinuousBatcher:
                 # gets a slot, never holds a page
                 serving_metrics().deadline_sheds().inc(model=self.name,
                                                        stage="queued")
+                timeline_store().note(
+                    seq.ctx.traceId if seq.ctx is not None else None,
+                    "serving.shed", replica=self.name, stage="queued")
                 self._finishSeq(seq, DeadlineExceeded(
                     "end-to-end deadline expired while queued"))
                 continue
@@ -852,6 +909,12 @@ class ContinuousBatcher:
 
     def _admitSeq(self, slot: int, seq: _Seq) -> None:
         sm = serving_metrics()
+        tid = seq.ctx.traceId if seq.ctx is not None else None
+        admitT = time.perf_counter()
+        queueWait = admitT - seq.enqT if seq.enqT is not None else None
+        if queueWait is not None:
+            observe_exemplar("dl4j_tpu_serving_queue_wait_seconds",
+                             queueWait, trace_id=tid, model=self.name)
         Tp = seq.bucket
         self.pool.ensure(slot, Tp)
         if self.draftPool is not None:
@@ -864,6 +927,7 @@ class ContinuousBatcher:
         # through the model's restart hook — same executable + bucket as
         # a first admission, but the hook is the seam a survivor with
         # different numerics can override
+        prefillT0 = time.perf_counter()
         prefill = getattr(self.lm, "restartFromPrompt",
                           self.lm.prefillRaw) \
             if seq.restarts > 0 else self.lm.prefillRaw
@@ -888,12 +952,25 @@ class ContinuousBatcher:
             # numeric drift, and so the KV the step writes next is
             # conditioned on the prefix the client actually saw
             first = int(seq.forced[0])
+        prefillDt = time.perf_counter() - prefillT0
+        observe_exemplar("dl4j_tpu_serving_prefill_seconds", prefillDt,
+                         trace_id=tid, model=self.name)
+        tracer().record_complete(
+            "serving.prefill", prefillT0, prefillDt,
+            args={"replica": self.name, "slot": slot, "bucket": Tp,
+                  "trace_id": tid})
         self._slotSeq[slot] = seq
         self._pos[slot] = Tp
         self._start[slot] = Tp - seq.realLen
         self._tok[slot] = first
         self._admitOrder.append(slot)
         sm.sequences_admitted().inc(model=self.name)
+        timeline_store().note(
+            tid, "serving.admit", replica=self.name, slot=slot, row=seq.row,
+            restarts=seq.restarts,
+            queue_wait_s=round(queueWait, 6) if queueWait is not None
+            else None,
+            prefill_s=round(prefillDt, 6))
         self._updatePageGauges()
         if self._emit(seq, first):
             self._retireSlot(slot)
@@ -905,6 +982,33 @@ class ContinuousBatcher:
         twice."""
         seq.emitted.append(tok)
         serving_metrics().decode_tokens().inc(model=self.name)
+        # latency decomposition observes FRESH tokens only: a replayed
+        # prefix (len(emitted) <= len(forced)) was already delivered, so
+        # re-observing it would double-count.  lastTokT deliberately
+        # survives the replay — the first fresh post-failover token's
+        # inter-token gap then CONTAINS the failover, which is exactly
+        # what the client experienced.
+        if len(seq.emitted) > len(seq.forced):
+            now = time.perf_counter()
+            tid = seq.ctx.traceId if seq.ctx is not None else None
+            parent = seq.parent
+            if parent.firstTokenAt is None:
+                with parent.lock:
+                    isFirst = parent.firstTokenAt is None
+                    if isFirst:
+                        parent.firstTokenAt = now
+                if isFirst:
+                    observe_exemplar("dl4j_tpu_serving_ttft_seconds",
+                                     now - parent.t0, trace_id=tid,
+                                     model=self.name)
+                    timeline_store().note(
+                        tid, "serving.first_token", replica=self.name,
+                        row=seq.row, ttft_s=round(now - parent.t0, 6))
+            if seq.lastTokT is not None:
+                observe_exemplar("dl4j_tpu_serving_inter_token_seconds",
+                                 now - seq.lastTokT, trace_id=tid,
+                                 model=self.name)
+            seq.lastTokT = now
         if seq.streamQ is not None:
             if seq.streamSkip > 0:
                 seq.streamSkip -= 1
@@ -929,6 +1033,7 @@ class ContinuousBatcher:
                 sm.deadline_sheds().inc(model=self.name, stage="decode")
                 self._retireSlot(s, error=DeadlineExceeded(
                     "end-to-end deadline expired mid-decode"))
+        stepT0 = time.perf_counter()
         tq = self.draftK + 1 if self.draft is not None else 1
         # page growth in ADMISSION-AGE order: a slot may only preempt
         # YOUNGER slots, and when none are left it DEFERS one step
@@ -1032,10 +1137,17 @@ class ContinuousBatcher:
                     break
             self._pos[s] += len(newToks)
             self._tok[s] = int(newToks[-1])
+            timeline_store().note(
+                seq.ctx.traceId if seq.ctx is not None else None,
+                "serving.decode.step", replica=self.name, slot=s,
+                tokens=len(seq.emitted))
             if done:
                 self._retireSlot(s)
         self._steps += 1
         self._busySteps += len(active) / self.maxSlots
+        tracer().record_complete(
+            "serving.decode.step", stepT0, time.perf_counter() - stepT0,
+            args={"replica": self.name, "active": len(active)})
         sm.decode_steps().inc(model=self.name)
         sm.slot_occupancy().set(len(active) / self.maxSlots,
                                 model=self.name)
@@ -1066,6 +1178,10 @@ class ContinuousBatcher:
             self._queuedPages += seq.pages
         sm = serving_metrics()
         sm.preemptions().inc(model=self.name)
+        timeline_store().note(
+            seq.ctx.traceId if seq.ctx is not None else None,
+            "serving.preempt", replica=self.name, slot=slot,
+            tokens_kept=len(seq.forced))
         self._updatePageGauges()
 
     @staticmethod
@@ -1163,11 +1279,15 @@ class ContinuousBatcher:
                              name=f"cbatch-wedge-reap-{self.name}"
                              ).start()
         out: List[_Seq] = []
+        ts = timeline_store()
         for seq in inflight + queued:
             if seq.cancelled:
                 self._finishSeq(seq, None)
                 continue
             self._resetForReplay(seq)
+            ts.note(seq.ctx.traceId if seq.ctx is not None else None,
+                    "serving.evacuate", replica=self.name,
+                    reason="replica evacuated")
             out.append(seq)
         serving_metrics().queue_depth().set(0, model=self.name)
         return out
@@ -1425,10 +1545,14 @@ class ReplicaSet:
         expired — or with no survivor to take it — finishes with the
         error instead."""
         sm = serving_metrics()
+        ts = timeline_store()
         for seq in seqs:
+            tid = seq.ctx.traceId if seq.ctx is not None else None
             if seq.deadline is not None and \
                     time.monotonic() >= seq.deadline:
                 sm.deadline_sheds().inc(model=self.name, stage="failover")
+                ts.note(tid, "serving.shed", replica=self.name,
+                        stage="failover")
                 _finish_seq(seq, DeadlineExceeded(
                     "end-to-end deadline expired during failover"),
                     self.name)
@@ -1449,6 +1573,9 @@ class ReplicaSet:
             try:
                 target._enqueue([seq], front=True)
                 sm.failovers().inc(model=self.name)
+                ts.note(tid, "serving.failover",
+                        to=getattr(target, "name", "?"),
+                        note=note or None)
             except Exception as e:
                 _finish_seq(seq, e, self.name)
 

@@ -58,7 +58,8 @@ KEEPALIVE = object()
 _KEEPALIVE_LINE = b": keep-alive\n"
 
 
-def stream_ndjson(handler, items, final: Optional[dict] = None) -> None:
+def stream_ndjson(handler, items, final: Optional[dict] = None,
+                  headers: Optional[Dict[str, str]] = None) -> None:
     """Chunked NDJSON streaming response: one JSON object per line,
     flushed as it is produced — the serving tier's token streaming
     (``InferenceServer`` with ``{"stream": true}``), where each decode
@@ -82,6 +83,8 @@ def stream_ndjson(handler, items, final: Optional[dict] = None) -> None:
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("Transfer-Encoding", "chunked")
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
 
         def frame(data: bytes) -> None:
@@ -324,11 +327,18 @@ class JsonRemoteInference:
         {name: ndarray} dict (mirroring the server's response shape)."""
         data = json.dumps({"features": np.asarray(features).tolist()}
                           ).encode("utf-8")
+        # propagate the caller's trace context (W3C traceparent) so the
+        # server's timeline joins the distributed trace instead of
+        # minting a fresh id per hop
+        from deeplearning4j_tpu.telemetry import current_context
+        ctx = current_context()
+        reqHeaders = {"Content-Type": "application/json"}
+        if ctx is not None:
+            reqHeaders["traceparent"] = ctx.to_traceparent()
         last_err: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
             req = urllib.request.Request(
-                self.url, data=data,
-                headers={"Content-Type": "application/json"})
+                self.url, data=data, headers=dict(reqHeaders))
             try:
                 with urllib.request.urlopen(req,
                                             timeout=self.timeout) as resp:

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -42,8 +43,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.telemetry import (ThresholdRule, get_registry,
-                                          serving_metrics)
+from deeplearning4j_tpu.telemetry import (RequestContext, ThresholdRule,
+                                          current_context, get_registry,
+                                          parse_traceparent, request_context,
+                                          serving_metrics, timeline_store)
 
 __all__ = ["BucketLadder", "ServiceOverloaded", "DeadlineExceeded",
            "NoHealthyReplicas", "AdmissionControl", "ForwardServing",
@@ -240,7 +243,7 @@ class AdmissionControl:
 
 
 class _Request:
-    __slots__ = ("payload", "rows", "event", "result", "error", "t0")
+    __slots__ = ("payload", "rows", "event", "result", "error", "t0", "ctx")
 
     def __init__(self, payload, rows: int):
         self.payload = payload
@@ -249,6 +252,10 @@ class _Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        # the ingress request context (trace id) rides on the request so
+        # the executor's lifecycle notes land in the SAME timeline the
+        # continuous-batching tier writes
+        self.ctx: Optional[RequestContext] = current_context()
 
 
 # ---------------------------------------------------------------------------
@@ -525,6 +532,58 @@ class GenerativeServing:
             return None
 
 
+# ---------------------------------------------------------------------------
+# access log
+# ---------------------------------------------------------------------------
+
+_ACCESS_LOG_ENV = "DL4J_TPU_ACCESS_LOG"
+_ACCESS_LOG_LOCK = threading.Lock()
+
+
+def _timeline_summary(trace_id: Optional[str]) -> dict:
+    """Roll one request's timeline events up into the access-log fields:
+    time-to-first-token, emitted token count, shed/failover flags."""
+    out = {"ttft_s": None, "tokens": 0, "shed": False, "failover": False}
+    got = timeline_store().get(trace_id) if trace_id else None
+    if got is None:
+        return out
+    for ev in got.get("events", []):
+        kind = ev.get("event")
+        if kind == "serving.first_token" and out["ttft_s"] is None:
+            out["ttft_s"] = ev.get("ttft_s")
+        elif kind == "serving.retire":
+            out["tokens"] += int(ev.get("tokens", 0) or 0)
+        elif kind == "serving.shed":
+            out["shed"] = True
+        elif kind == "serving.failover":
+            out["failover"] = True
+    return out
+
+
+def _write_access_line(ctx: Optional[RequestContext], route: str,
+                       status: Optional[int], model: Optional[str],
+                       total_s: float) -> None:
+    """Append one NDJSON access-log line when ``DL4J_TPU_ACCESS_LOG`` is
+    set.  Open-append-close per line: a rotation (rename + recreate)
+    between lines lands the next line in the fresh file, never a held-
+    open stale inode.  Logging failures never fail the request."""
+    path = os.environ.get(_ACCESS_LOG_ENV, "").strip()
+    if not path:
+        return
+    tid = ctx.traceId if ctx is not None else None
+    record = {"ts": time.time(), "trace_id": tid, "model": model,
+              "route": route, "status": status,
+              "total_s": round(total_s, 6)}
+    record.update(_timeline_summary(tid))
+    line = json.dumps(record) + "\n"
+    try:
+        with _ACCESS_LOG_LOCK:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line)
+    except OSError:
+        pass
+
+
 # the adapter dispatch runs on executor worker threads; the model name
 # they report metrics under travels in a context-local
 class _ModelName(threading.local):
@@ -664,6 +723,7 @@ class BucketedExecutor:
         :class:`ServiceOverloaded` when admission sheds (HTTP 429)."""
         sm = serving_metrics()
         req = self.serving.makeRequest(payload)      # offender-only 400
+        tid = req.ctx.traceId if req.ctx is not None else None
         queued = self.queuedRows()
         # re-sync the depth gauge from the live count BEFORE admission
         # reads it: gauge writes happen outside _cv (lock discipline —
@@ -677,6 +737,8 @@ class BucketedExecutor:
             rule, detail = fired
             sm.shed().inc(model=self.name, rule=rule)
             sm.requests().inc(model=self.name, outcome="shed")
+            timeline_store().note(tid, "serving.shed", model=self.name,
+                                  stage="admission", rule=rule)
             raise ServiceOverloaded(detail, self.admission.retryAfter)
         key = self.serving.groupKey(req)
         with self._cv:
@@ -690,6 +752,8 @@ class BucketedExecutor:
         # gauge write AFTER releasing _cv (scheduler -> registry lock
         # order; see shutdown)
         sm.queue_depth().set(depth, model=self.name)
+        timeline_store().note(tid, "serving.enqueue", model=self.name,
+                              rows=req.rows)
         if not req.event.wait(timeout):
             # pull the abandoned request back OUT of the queue — left
             # behind it would still be dispatched at full device cost
@@ -708,10 +772,18 @@ class BucketedExecutor:
             if depth is not None:
                 sm.queue_depth().set(depth, model=self.name)
             if not req.event.is_set():   # not completed while cancelling
+                timeline_store().note(tid, "serving.retire",
+                                      model=self.name, rows=req.rows,
+                                      error="TimeoutError")
                 raise TimeoutError(
                     f"serving request timed out after {timeout}s")
         if req.error is not None:
+            timeline_store().note(tid, "serving.retire", model=self.name,
+                                  rows=req.rows,
+                                  error=type(req.error).__name__)
             raise req.error
+        timeline_store().note(tid, "serving.retire", model=self.name,
+                              rows=req.rows, error=None)
         return req.result
 
     # -- scheduler -------------------------------------------------------
@@ -879,6 +951,13 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "InferenceServer":
+        # observability side-cars: the in-process retention ring backing
+        # /metrics/query always runs with a server; the OTLP exporter
+        # only when DL4J_TPU_OTLP_ENDPOINT points at a collector
+        from deeplearning4j_tpu.telemetry import (ensure_otlp_exporter,
+                                                  ensure_retention)
+        ensure_retention()
+        ensure_otlp_exporter()
         self.registry.start()
         server = self
 
@@ -894,10 +973,20 @@ class InferenceServer:
             def _reply(self, code: int, body: bytes, ctype: str,
                        headers: Optional[Dict[str, str]] = None) -> None:
                 from deeplearning4j_tpu.remote.server import reply_safely
+                ctx = getattr(self, "_ctx", None)
+                if ctx is not None:
+                    headers = dict(headers or {})
+                    headers.setdefault("X-Trace-Id", ctx.traceId)
                 reply_safely(self, code, body, ctype, headers)
 
             def _reply_json(self, code: int, obj,
                             headers: Optional[Dict[str, str]] = None):
+                # every error body carries the trace id so a client's
+                # log line alone is enough to pull /v1/requests/<id>
+                ctx = getattr(self, "_ctx", None)
+                if ctx is not None and code >= 400 and isinstance(obj,
+                                                                  dict):
+                    obj.setdefault("trace_id", ctx.traceId)
                 self._reply(code, json.dumps(obj).encode("utf-8"),
                             "application/json", headers)
 
@@ -915,6 +1004,28 @@ class InferenceServer:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                # ONE trace context per request, minted here or parsed
+                # from the caller's W3C traceparent; every continuation
+                # (executor enqueue, batcher admission, failover replay)
+                # reads it off the contextvar, so the whole life of the
+                # request shares one trace id
+                t0 = time.perf_counter()
+                ctx = parse_traceparent(
+                    self.headers.get("traceparent")) \
+                    or RequestContext.new()
+                self._ctx = ctx
+                route = self.path
+                status, model = None, None
+                try:
+                    with request_context(ctx):
+                        status, model = self._serve_post(ctx)
+                finally:
+                    _write_access_line(ctx, route, status, model,
+                                       time.perf_counter() - t0)
+
+            def _serve_post(self, ctx):
+                """Dispatch one POST; returns ``(status, model)`` for the
+                access log (the reply has already been written)."""
                 name = None
                 path = self.path.rstrip("/")
                 if path == "/v1/serving":
@@ -924,20 +1035,21 @@ class InferenceServer:
                 else:
                     self._reply_json(404,
                                      {"error": f"no route {self.path}"})
-                    return
+                    return 404, None
                 ex = server.registry.get(name)
                 if ex is None:
                     self._reply_json(404, {
                         "error": f"unknown model {name!r}; hosted: "
                                  f"{server.registry.names()}"})
-                    return
+                    return 404, name
+                model = getattr(ex, "name", name)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                 except Exception as e:
                     self._reply_json(400,
                                      {"error": f"{type(e).__name__}: {e}"})
-                    return
+                    return 400, model
                 try:
                     if "features" in payload:
                         out = ex.submit(payload["features"])
@@ -952,7 +1064,7 @@ class InferenceServer:
                                 self._reply_json(400, {
                                     "error": f"model {ex.name!r} does "
                                     "not support streaming"})
-                                return
+                                return 400, model
                             # validation/shed errors surface HERE (the
                             # call enqueues eagerly) as normal 400/429
                             # replies; once the generator exists, tokens
@@ -964,8 +1076,9 @@ class InferenceServer:
                                 self,
                                 (t if t is KEEPALIVE else {"token": t}
                                  for t in gen),
-                                final={"done": True})
-                            return
+                                final={"done": True},
+                                headers={"X-Trace-Id": ctx.traceId})
+                            return 200, model
                         out = ex.submit(payload)
                         # jaxlint: sync-ok -- response serialization: the result leaves as JSON
                         body = {"tokens": np.asarray(out).tolist()}
@@ -980,7 +1093,7 @@ class InferenceServer:
                               "retry_after": e.retryAfter},
                         headers={"Retry-After":
                                  str(max(1, int(math.ceil(e.retryAfter))))})
-                    return
+                    return 429, model
                 except NoHealthyReplicas as e:
                     # transient fleet state, not a server bug: 503 tells
                     # the client to back off, 500 would page someone
@@ -989,7 +1102,7 @@ class InferenceServer:
                               "retry_after": e.retryAfter},
                         headers={"Retry-After":
                                  str(max(1, int(math.ceil(e.retryAfter))))})
-                    return
+                    return 503, model
                 except DeadlineExceeded as e:
                     body, code = {"error": f"deadline exceeded: {e}"}, 504
                 except (ValueError, TypeError) as e:
@@ -997,6 +1110,7 @@ class InferenceServer:
                 except Exception as e:
                     body, code = {"error": f"{type(e).__name__}: {e}"}, 500
                 self._reply_json(code, body)
+                return code, model
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]

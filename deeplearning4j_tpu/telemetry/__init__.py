@@ -28,6 +28,9 @@ One place every layer reports through (SURVEY.md §5.1's ``OpProfiler`` /
 Metric naming convention (linted by ``tools/lint_telemetry.py``):
 ``dl4j_tpu_<subsystem>_<name>``; counters end ``_total``.
 """
+from deeplearning4j_tpu.telemetry.context import (  # noqa: F401
+    RequestContext, TimelineStore, current_context, parse_traceparent,
+    request_context, set_timeline_store, timeline_store)
 from deeplearning4j_tpu.telemetry.export import (  # noqa: F401
     install_export_handlers, uninstall_export_handlers,
     write_final_snapshot)
@@ -43,13 +46,18 @@ from deeplearning4j_tpu.telemetry.health import (  # noqa: F401
 from deeplearning4j_tpu.telemetry.instrument import (  # noqa: F401
     AotCacheMetrics, CoordMetrics, ElasticMetrics, EtlMetrics, MeshMetrics,
     RecsysMetrics, ReplicaTimingListener, ServingMetrics, aot_metrics,
-    coord_metrics, elastic_metrics, etl_fetch, etl_metrics, in_microbatch,
-    mesh_metrics, microbatch_scope, note_etl_wait, record_crash,
+    clear_exemplars, coord_metrics, elastic_metrics, etl_fetch, etl_metrics,
+    exemplar_for, in_microbatch, latency_exemplars, mesh_metrics,
+    microbatch_scope, note_etl_wait, observe_exemplar, record_crash,
     record_logical_step, recsys_metrics, replica_step_gauge, serving_metrics,
     supervised_scope, train_step_span)
+from deeplearning4j_tpu.telemetry.otlp import (  # noqa: F401
+    OtlpExporter, ensure_otlp_exporter, otlp_exporter, set_otlp_exporter)
 from deeplearning4j_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
     get_registry, set_registry)
+from deeplearning4j_tpu.telemetry.timeseries import (  # noqa: F401
+    MetricsRetention, ensure_retention, retention, set_retention)
 from deeplearning4j_tpu.telemetry.tracing import (  # noqa: F401
     Tracer, device_trace_active, set_device_trace_active, set_tracer,
     tracer)

@@ -286,13 +286,64 @@ class TelemetryAggregator:
 
     def __init__(self, runDir: str,
                  localRegistry: Optional[MetricsRegistry] = None,
-                 localHost: Optional[str] = None):
+                 localHost: Optional[str] = None,
+                 gcMaxAge: Optional[float] = None):
         self.runDir = str(runDir)
         self._local = localRegistry
         self._localHost = localHost or host_id()
+        #: snapshot files whose mtime is older than this are unlinked on
+        #: load (None = follow the retention ring's window; GC disabled
+        #: when neither is configured).  Live writers refresh their
+        #: file's mtime every interval, so only DEAD workers age out.
+        self.gcMaxAge = gcMaxAge
         self.skipped: List[str] = []
         self.skippedFiles: List[str] = []
+        self.gcFiles: List[str] = []
         self.hosts: List[str] = []
+
+    def _gc_max_age(self) -> Optional[float]:
+        if self.gcMaxAge is not None:
+            return float(self.gcMaxAge)
+        from deeplearning4j_tpu.telemetry.timeseries import retention
+        ring = retention()
+        return float(ring.window) if ring is not None else None
+
+    def gc_stale(self) -> List[str]:
+        """Unlink snapshot files older than the retention window so a
+        long-lived run directory doesn't serve month-dead hosts forever
+        (and the federated view matches what ``/metrics/query`` can
+        still answer).  Removals are counted in
+        ``dl4j_tpu_federation_snapshots_gc_total``; returns the removed
+        filenames."""
+        maxAge = self._gc_max_age()
+        self.gcFiles = []
+        if maxAge is None:
+            return []
+        cutoff = time.time() - maxAge
+        try:
+            names = sorted(os.listdir(self.runDir))
+        except OSError:
+            return []
+        for fn in names:
+            if not (fn.startswith(_SNAPSHOT_PREFIX) and
+                    fn.endswith(".json")):
+                continue
+            p = os.path.join(self.runDir, fn)
+            try:
+                if os.path.getmtime(p) < cutoff:
+                    os.unlink(p)
+                    self.gcFiles.append(fn)
+            except OSError:
+                continue          # raced a writer/another GC: fine
+        if self.gcFiles:
+            reg = self._local if self._local is not None else \
+                get_registry()
+            reg.counter(
+                "dl4j_tpu_federation_snapshots_gc_total",
+                "Stale per-worker snapshot files unlinked by the "
+                "aggregator (mtime older than the retention "
+                "window)").inc(len(self.gcFiles))
+        return self.gcFiles
 
     def load(self) -> List[dict]:
         """All parseable snapshots, oldest write first (stable merge
@@ -304,6 +355,7 @@ class TelemetryAggregator:
         host."""
         snaps = []
         self.skippedFiles = []
+        self.gc_stale()
         try:
             names = sorted(os.listdir(self.runDir))
         except OSError:

@@ -662,4 +662,20 @@ def health_summary(registry: Optional[MetricsRegistry] = None) -> dict:
                 cell.get("replica", "")] = int(value)
         if byModel:
             out["replica_health"] = byModel
+    # observability side-cars: is /metrics/query live, and is the OTLP
+    # exporter keeping up (drop count is the signal a collector outage
+    # leaves behind — the hot path never blocks on it)
+    from deeplearning4j_tpu.telemetry.otlp import otlp_exporter
+    from deeplearning4j_tpu.telemetry.timeseries import retention
+    ring = retention()
+    out["retention"] = None if ring is None else {
+        "window_seconds": ring.window, "interval_seconds": ring.interval,
+        "samples": ring.sample_count()}
+    exp = otlp_exporter()
+    if exp is not None:
+        drops = reg.get("dl4j_tpu_otlp_dropped_total")
+        out["otlp"] = {"endpoint": exp.endpoint,
+                       "interval_seconds": exp.interval,
+                       "dropped_total": _total_value(drops)
+                       if drops is not None else 0.0}
     return out

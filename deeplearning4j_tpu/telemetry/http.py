@@ -1,13 +1,16 @@
 """Shared GET routing for the observability endpoints.
 
-``JsonModelServer`` and ``UIServer`` expose the same three surfaces —
-``/metrics``, ``/metrics/federated``, ``/healthz``.  One routing function
-keeps the status codes, content types, and the federation hint text from
-drifting between two hand-maintained handler copies.
+``JsonModelServer``, ``InferenceServer`` and ``UIServer`` expose the
+same observability surfaces — ``/metrics``, ``/metrics/federated``,
+``/metrics/query``, ``/healthz``, ``/v1/requests/<traceId>``.  One
+routing function keeps the status codes, content types, and the
+federation hint text from drifting between hand-maintained handler
+copies.
 """
 from __future__ import annotations
 
 import json
+import urllib.parse
 from typing import Optional, Tuple
 
 __all__ = ["observability_route", "PROMETHEUS_CTYPE"]
@@ -25,12 +28,39 @@ def observability_route(path: str) -> Optional[Tuple[int, bytes, str]]:
       run dir merged (counters summed, gauges/histograms host-labeled);
       404 with a configuration hint when federation is unconfigured;
     - ``/healthz`` — liveness JSON (uptime, last-step age, firing alert
-      count).
+      count);
+    - ``/metrics/query?metric=...&fn=rate|increase|latest`` — windowed
+      queries over the in-process retention ring
+      (:mod:`~deeplearning4j_tpu.telemetry.timeseries`);
+    - ``/v1/requests/<traceId>`` — one request's lifecycle timeline from
+      the :class:`~deeplearning4j_tpu.telemetry.context.TimelineStore`.
     """
     from deeplearning4j_tpu.telemetry.federation import \
         federated_exposition
     from deeplearning4j_tpu.telemetry.health import health_summary
     from deeplearning4j_tpu.telemetry.registry import get_registry
+    if path.startswith("/metrics/query"):
+        from deeplearning4j_tpu.telemetry.timeseries import retention
+        ring = retention()
+        if ring is None:
+            return (503, json.dumps(
+                {"error": "retention ring not running: start an "
+                 "InferenceServer or call telemetry.timeseries."
+                 "ensure_retention()"}).encode("utf-8"),
+                "application/json")
+        qs = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+        status, doc = ring.http_query({k: v[-1] for k, v in qs.items()})
+        return status, json.dumps(doc).encode("utf-8"), "application/json"
+    if path.startswith("/v1/requests/"):
+        from deeplearning4j_tpu.telemetry.context import timeline_store
+        trace_id = path[len("/v1/requests/"):].split("?", 1)[0]
+        got = timeline_store().get(trace_id) if trace_id else None
+        if got is None:
+            return (404, json.dumps(
+                {"error": "unknown trace id (evicted or never seen)",
+                 "trace_id": trace_id}).encode("utf-8"),
+                "application/json")
+        return 200, json.dumps(got).encode("utf-8"), "application/json"
     if path == "/metrics":
         return (200, get_registry().exposition().encode("utf-8"),
                 PROMETHEUS_CTYPE)

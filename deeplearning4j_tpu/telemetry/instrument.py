@@ -19,6 +19,7 @@ Everything here is designed to be safe in the fused-step hot loop:
 """
 from __future__ import annotations
 
+import bisect
 import contextlib
 import threading
 import time
@@ -35,7 +36,9 @@ __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "EtlMetrics", "ServingMetrics", "serving_metrics",
            "MeshMetrics", "mesh_metrics", "ElasticMetrics",
            "elastic_metrics", "CoordMetrics", "coord_metrics",
-           "AotCacheMetrics", "aot_metrics", "replica_step_gauge"]
+           "AotCacheMetrics", "aot_metrics", "replica_step_gauge",
+           "observe_exemplar", "exemplar_for", "latency_exemplars",
+           "clear_exemplars"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -478,6 +481,38 @@ class ServingMetrics:
             "routing — surfaced in /healthz",
             labelnames=("model", "replica"))
 
+    # -- per-stage latency decomposition (request-scoped observability) --
+    def ttft_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_ttft_seconds",
+            "Time to first token: request enqueue to the first token "
+            "emitted to the client, per model (queue wait + prefill + "
+            "first sampling step; failover restarts extend it)",
+            labelnames=("model",), buckets=SERVING_LATENCY_BUCKETS)
+
+    def inter_token_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_inter_token_seconds",
+            "Gap between consecutive NEW tokens of one sequence "
+            "(replayed tokens hidden by streamSkip do not observe; a "
+            "failover's replay gap lands here by design), per model",
+            labelnames=("model",), buckets=SERVING_LATENCY_BUCKETS)
+
+    def queue_wait_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_queue_wait_seconds",
+            "Enqueue to decode-slot admission, per model — the queueing "
+            "share of TTFT (attributes p99 regressions to queueing vs "
+            "compute)",
+            labelnames=("model",), buckets=SERVING_LATENCY_BUCKETS)
+
+    def prefill_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_serving_prefill_seconds",
+            "Prompt prefill wall time (bucketed forward + KV pool write "
+            "+ first-token argmax) inside slot admission, per model",
+            labelnames=("model",), buckets=SERVING_LATENCY_BUCKETS)
+
 
 _SERVING_METRICS = ServingMetrics()
 
@@ -486,6 +521,62 @@ def serving_metrics() -> ServingMetrics:
     """Accessor for the shared serving metric namespace (see
     :class:`ServingMetrics`)."""
     return _SERVING_METRICS
+
+
+# -- histogram exemplars --------------------------------------------------
+# Prometheus-style exemplars: each (histogram, label set) remembers the
+# trace id of the observation that landed in its highest bucket so far,
+# so a p99 spike on a latency dashboard links DIRECTLY to one request's
+# timeline (`/v1/requests/<traceId>`).  The store is tiny (one record
+# per cell) and updated under one lock — hot-loop safe.
+_EXEMPLARS: dict = {}
+_EXEMPLAR_LOCK = threading.Lock()
+
+
+def observe_exemplar(name, value, trace_id=None, **labels):
+    """Observe ``value`` into the ALREADY-REGISTERED histogram ``name``
+    and attach ``trace_id`` as the exemplar when this observation is as
+    slow as (or slower than) the cell's current exemplar.  A literal,
+    registered metric name is required — jaxlint's telemetry-exemplar
+    rule cross-checks call sites against registration sites."""
+    hist = get_registry().get(name)
+    if hist is None or not hasattr(hist, "buckets"):
+        return
+    hist.observe(value, **labels)
+    if not trace_id:
+        return
+    bucket = bisect.bisect_left(hist.buckets, value)
+    key = (name, tuple(sorted(labels.items())))
+    with _EXEMPLAR_LOCK:
+        cur = _EXEMPLARS.get(key)
+        if cur is None or bucket >= cur["bucket"]:
+            _EXEMPLARS[key] = {"trace_id": trace_id, "value": value,
+                               "bucket": bucket}
+
+
+def exemplar_for(name, **labels):
+    """The slowest-bucket exemplar recorded for one histogram cell:
+    ``{"trace_id", "value", "bucket"}`` or None."""
+    key = (name, tuple(sorted(labels.items())))
+    with _EXEMPLAR_LOCK:
+        got = _EXEMPLARS.get(key)
+        return dict(got) if got else None
+
+
+def latency_exemplars():
+    """Every recorded exemplar, keyed ``{metric: {label tuple: record}}``
+    — what the README's worked example walks from a p99 spike to a
+    trace id."""
+    with _EXEMPLAR_LOCK:
+        out: dict = {}
+        for (name, lkey), rec in _EXEMPLARS.items():
+            out.setdefault(name, {})[lkey] = dict(rec)
+        return out
+
+
+def clear_exemplars():
+    with _EXEMPLAR_LOCK:
+        _EXEMPLARS.clear()
 
 
 class MeshMetrics:

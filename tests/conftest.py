@@ -95,6 +95,11 @@ def pytest_configure(config):
         "health probing, in-flight failover with exactly-once token "
         "delivery, end-to-end deadlines, graceful drain/swap, the "
         "serving chaos soak)")
+    config.addinivalue_line(
+        "markers", "obsreq: request-scoped observability tests (trace "
+        "propagation across failover, TTFT/ITL decomposition, the "
+        "request timeline endpoint, metrics retention queries, OTLP "
+        "export, the NDJSON access log)")
 
 
 def pytest_collection_modifyitems(config, items):

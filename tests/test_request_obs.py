@@ -1,0 +1,466 @@
+"""Request-scoped serving observability (ISSUE 18).
+
+Covers: W3C traceparent round-trip at the InferenceServer ingress, ONE
+trace id surviving a mid-decode replica crash with a contiguous
+lifecycle timeline served from ``GET /v1/requests/<traceId>``, TTFT /
+inter-token latency decomposition against a hand-timed reference, the
+in-process retention ring's rate()/increase() vs known counter deltas,
+OTLP export against a dead collector (drops counted, decode never
+stalls), and the NDJSON access log's schema + rotation safety.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.fault import injection as _inj
+from deeplearning4j_tpu.nlp.transformer import TransformerLM
+from deeplearning4j_tpu.remote import (ContinuousBatcher, InferenceServer,
+                                       ModelRegistry, ReplicaSet)
+from deeplearning4j_tpu.telemetry import (MetricsRegistry, MetricsRetention,
+                                          OtlpExporter, RequestContext,
+                                          clear_exemplars, exemplar_for,
+                                          get_registry, parse_traceparent,
+                                          request_context, timeline_store,
+                                          tracer)
+
+pytestmark = pytest.mark.obsreq
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = telemetry.set_registry(MetricsRegistry())
+    timeline_store().clear()
+    clear_exemplars()
+    yield
+    _inj.clear_serving_faults()
+    timeline_store().clear()
+    clear_exemplars()
+    telemetry.set_registry(prev)
+
+
+def _lm(maxLen=64, seed=5, vocab=40):
+    return TransformerLM(vocabSize=vocab, nLayers=1, nHeads=2,
+                         headSize=8, maxLen=maxLen, seed=seed)
+
+
+def _post(port, path, obj, headers=None, timeout=60):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode("utf-8"), headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _hist_cell(name, **labels):
+    """(count, sum) of one histogram cell, (0, 0.0) when absent."""
+    m = get_registry().get(name)
+    if m is None:
+        return 0, 0.0
+    d = m.data()
+    names = d["labelnames"]
+    for key, cell in d["cells"]:
+        if dict(zip(names, key)) == labels:
+            return int(cell["count"]), float(cell["sum"])
+    return 0, 0.0
+
+
+def _wait(pred, timeout=15.0, interval=0.02):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------ traceparent round-trip ----
+
+def test_traceparent_round_trip():
+    ctx = RequestContext.new(tenant="t1")
+    header = ctx.to_traceparent()
+    assert header == f"00-{ctx.traceId}-{ctx.spanId}-01"
+    back = parse_traceparent(header)
+    assert back is not None
+    assert back.traceId == ctx.traceId
+    assert back.spanId == ctx.spanId
+    # malformed / forbidden headers parse to None, never raise
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-xyz-abc-01") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "a" * 16 + "-01") \
+        is None                                     # all-zero trace id
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "0" * 16 + "-01") \
+        is None                                     # all-zero span id
+    # uppercase hex is normalized, not rejected
+    up = parse_traceparent("00-" + "A" * 32 + "-" + "B" * 16 + "-01")
+    assert up is not None and up.traceId == "a" * 32
+    # a child span keeps the trace id, changes the span id
+    kid = ctx.child()
+    assert kid.traceId == ctx.traceId and kid.spanId != ctx.spanId
+    assert kid.baggage.get("tenant") == "t1"
+
+
+# --------------------- one trace across a mid-decode replica crash ----
+
+def test_trace_id_survives_failover_with_one_timeline():
+    """The tentpole acceptance: a traceparent-carrying streaming request
+    crashes its replica mid-decode; the SAME trace id covers admission
+    on A, evacuation, failover, replay on B, and retirement — readable
+    as one timeline from ``GET /v1/requests/<traceId>``."""
+    def factory(idx):
+        return ContinuousBatcher(_lm(), maxSlots=2, pageSize=8)
+
+    ref = _lm()
+    prompt = [3, 1, 4, 1, 5]
+    quota = 12
+    want = [int(t) for t in ref.generate(
+        np.asarray([prompt], np.int32), quota)[0]]
+    ctx = RequestContext.new()
+    rs = ReplicaSet(factory, name="obs", replicas=2, maxReplicas=2,
+                    probeInterval=0.05, probeTimeout=2.0,
+                    probeFailThreshold=1, seed=0)
+    registry = ModelRegistry()
+    registry.register("obs", rs)
+    srv = InferenceServer(registry, port=0).start()
+    try:
+        for nm in ("obs/0", "obs/1"):   # slow decode so the crash can
+            _inj.set_replica_slowdown(nm, 0.03)     # land mid-stream
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/serving/obs",
+            data=json.dumps({"tokens": prompt, "maxNewTokens": quota,
+                             "stream": True}).encode("utf-8"),
+            headers={"Content-Type": "application/json",
+                     "traceparent": ctx.to_traceparent()})
+        got = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            # the streaming response echoes the caller's trace id
+            assert resp.headers.get("X-Trace-Id") == ctx.traceId
+            crashed = False
+            for raw in resp:
+                line = raw.strip()
+                if not line.startswith(b"{"):
+                    continue            # keep-alive comment line
+                obj = json.loads(line)
+                if "token" in obj:
+                    got.append(obj["token"])
+                if len(got) == 2 and not crashed:
+                    crashed = True
+                    with rs._lock:
+                        busy = [ex for ex in rs._replicas if ex.busy()]
+                    assert busy, "stream should hold a slot somewhere"
+                    _inj.arm_replica_crash(busy[0].name)
+        assert got == want              # exactly once across the crash
+
+        status, doc = _get(srv.port, f"/v1/requests/{ctx.traceId}")
+        assert status == 200
+        assert doc["trace_id"] == ctx.traceId
+        kinds = [e["event"] for e in doc["events"]]
+        # the whole life, in order, in ONE timeline
+        for kind in ("serving.enqueue", "serving.admit",
+                     "serving.first_token", "serving.decode.step",
+                     "serving.evacuate", "serving.failover",
+                     "serving.retire"):
+            assert kind in kinds, f"timeline missing {kind}: {kinds}"
+        admits = [e for e in doc["events"]
+                  if e["event"] == "serving.admit"]
+        assert len({a["replica"] for a in admits}) == 2, \
+            "request must have been admitted on BOTH replicas"
+        order = {k: kinds.index(k) for k in set(kinds)}
+        assert order["serving.admit"] < order["serving.evacuate"] \
+            < kinds.index("serving.failover") \
+            < max(i for i, k in enumerate(kinds)
+                  if k == "serving.admit") \
+            < max(i for i, k in enumerate(kinds)
+                  if k == "serving.retire")
+        # the prefill spans in the Chrome trace carry the same trace id
+        prefills = [e for e in tracer().events()
+                    if e.get("name") == "serving.prefill" and
+                    (e.get("args") or {}).get("trace_id") == ctx.traceId]
+        assert len(prefills) >= 2       # once on A, once on the replay
+        # an unknown id is an explicit 404, not an empty 200
+        status, doc = _get(srv.port, "/v1/requests/" + "f" * 32)
+        assert status == 404 and doc["trace_id"] == "f" * 32
+    finally:
+        _inj.clear_serving_faults()
+        srv.stop()
+
+
+# --------------------------------- TTFT / ITL latency decomposition ----
+
+def test_ttft_and_itl_match_hand_timed_reference():
+    """The decomposition histograms agree with a client-side stopwatch:
+    exactly ONE time-to-first-token observation per request, exactly
+    ``quota - 1`` inter-token observations, each bounded by what the
+    client measured around the stream."""
+    quota = 6
+    cb = ContinuousBatcher(_lm(), name="lat", maxSlots=2,
+                           pageSize=8).start()
+    try:
+        _inj.set_replica_slowdown("lat", 0.05)
+        ctx = RequestContext.new()
+        t0 = time.perf_counter()
+        with request_context(ctx):
+            gen = cb.submitStream({"tokens": [1, 2, 3],
+                                   "maxNewTokens": quota})
+        stamps = []
+        for tok in gen:
+            if isinstance(tok, int):
+                stamps.append(time.perf_counter())
+        assert len(stamps) == quota
+        client_ttft = stamps[0] - t0
+        client_gap_sum = stamps[-1] - stamps[0]
+
+        n, s = _hist_cell("dl4j_tpu_serving_ttft_seconds", model="lat")
+        assert n == 1                   # one first token per request
+        # the server stamps the first token BEFORE the client receives
+        # it, and both clocks start at submit: server <= client (+eps)
+        assert 0.0 < s <= client_ttft + 0.05
+        n, s = _hist_cell("dl4j_tpu_serving_inter_token_seconds",
+                          model="lat")
+        assert n == quota - 1
+        assert s >= (quota - 1) * 0.04  # each gap contains the slowdown
+        assert s <= client_gap_sum + 0.1
+        n, _ = _hist_cell("dl4j_tpu_serving_queue_wait_seconds",
+                          model="lat")
+        assert n == 1
+        n, s = _hist_cell("dl4j_tpu_serving_prefill_seconds",
+                          model="lat")
+        assert n == 1 and 0.0 < s <= client_ttft + 0.05
+        # the slowest-bucket exemplar points back at this request
+        ex = exemplar_for("dl4j_tpu_serving_ttft_seconds", model="lat")
+        assert ex is not None and ex["trace_id"] == ctx.traceId
+    finally:
+        _inj.clear_serving_faults()
+        cb.shutdown()
+
+
+# ------------------------------------ retention ring: rate/increase ----
+
+def test_retention_rate_matches_counter_deltas():
+    """Driven with injected timestamps: increase() over the ring equals
+    the known counter delta, rate() equals delta/elapsed, and a counter
+    RESET contributes the post-reset value, never a negative rate."""
+    reg = MetricsRegistry()
+    ring = MetricsRetention(interval=5.0, window=60.0, registry=reg)
+    c = reg.counter("dl4j_tpu_obs_ticks_total", "test ticks",
+                    labelnames=("kind",))
+    c.inc(5, kind="a")
+    ring.sample_now(ts=100.0)
+    c.inc(10, kind="a")
+    ring.sample_now(ts=110.0)
+    assert ring.increase("dl4j_tpu_obs_ticks_total", kind="a") == 10.0
+    assert ring.rate("dl4j_tpu_obs_ticks_total",
+                     kind="a") == pytest.approx(1.0)
+    assert ring.latest("dl4j_tpu_obs_ticks_total", kind="a") == 15.0
+    # histograms retain their cumulative count (+ :sum pseudo-metric)
+    h = reg.histogram("dl4j_tpu_obs_lat_seconds", "test latency",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    ring.sample_now(ts=120.0)
+    assert ring.latest("dl4j_tpu_obs_lat_seconds") == 2.0
+    assert ring.latest("dl4j_tpu_obs_lat_seconds:sum") == \
+        pytest.approx(0.55)
+    # counter reset: a fresh registry cell restarting from 3 counts 3
+    reg2 = MetricsRegistry()
+    ring2 = MetricsRetention(interval=5.0, window=60.0, registry=reg2)
+    c2 = reg2.counter("dl4j_tpu_obs_ticks_total", "test ticks")
+    c2.inc(50)
+    ring2.sample_now(ts=10.0)
+    c2._cells.clear()                   # simulate the process restart
+    c2.inc(3)
+    ring2.sample_now(ts=20.0)
+    assert ring2.increase("dl4j_tpu_obs_ticks_total") == 3.0
+    assert ring2.rate("dl4j_tpu_obs_ticks_total") >= 0.0
+    # the window trims: samples far in the past age out
+    for i in range(50):
+        ring.sample_now(ts=200.0 + i * 10.0)
+    assert ring.sample_count() <= 60.0 / 5.0 + 2
+    # the http_query shape the /metrics/query endpoint serves
+    status, doc = ring.http_query({"metric": "dl4j_tpu_obs_ticks_total",
+                                   "fn": "increase", "kind": "a"})
+    assert status == 200 and doc["fn"] == "increase"
+    status, doc = ring.http_query({"metric": ""})
+    assert status == 400
+    status, doc = ring.http_query({"metric": "x", "fn": "bogus"})
+    assert status == 400
+
+
+# ------------------------- OTLP: dead collector, bounded, no stall ----
+
+def test_otlp_dead_collector_drops_counted_without_stalling_decode():
+    """Exporting to a dead collector: every flush fails fast, the
+    dropped items are COUNTED, and a concurrent decode stream finishes
+    untouched — the exporter can never backpressure the hot path."""
+    cb = ContinuousBatcher(_lm(), name="otlp", maxSlots=2,
+                           pageSize=8).start()
+    exp = OtlpExporter("http://127.0.0.1:9", interval=60.0,
+                       timeout=0.25)
+    try:
+        gen = cb.submitStream({"tokens": [1, 2, 3], "maxNewTokens": 8})
+        got = []
+        flushes = 0
+        t0 = time.perf_counter()
+        for tok in gen:
+            if isinstance(tok, int):
+                got.append(tok)
+            outcomes = exp.export_now()     # mid-decode, every token
+            flushes += 1
+            assert outcomes["metrics"] == "error"
+        assert len(got) == 8                # decode finished normally
+        assert time.perf_counter() - t0 < 30.0
+        drops = get_registry().get("dl4j_tpu_otlp_dropped_total")
+        d = drops.data()
+        by_signal = {key[0]: v for key, v in d["cells"]}
+        assert by_signal.get("metrics", 0) > 0
+        exports = get_registry().get("dl4j_tpu_otlp_exports_total")
+        d = exports.data()
+        names = d["labelnames"]
+        errs = sum(v for key, v in d["cells"]
+                   if dict(zip(names, key))["outcome"] == "error")
+        assert errs >= flushes              # every flush counted
+    finally:
+        exp.stop()
+        cb.shutdown()
+
+
+def test_otlp_span_queue_bounded_and_payload_shape():
+    """The span queue is bounded at maxQueue per flush (overflow counted
+    dropped, oldest first) and the OTLP JSON carries the tracer's span
+    names + trace ids."""
+    from deeplearning4j_tpu.telemetry import Tracer
+    reg = MetricsRegistry()
+    prev = telemetry.set_registry(reg)
+    try:
+        tr = Tracer()
+        tid = "ab" * 16
+        base = time.perf_counter()
+        for i in range(6):
+            tr.record_complete("serving.decode.step", base, 0.001,
+                               args={"trace_id": tid, "i": i})
+        exp = OtlpExporter("http://127.0.0.1:9", maxQueue=4,
+                           timeout=0.25, registry=reg, trace=tr)
+        payload = exp._spans_payload()
+        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(spans) == 4              # bounded, newest kept
+        assert all(s["traceId"] == tid for s in spans)
+        assert all(s["name"] == "serving.decode.step" for s in spans)
+        dropped = reg.get("dl4j_tpu_otlp_dropped_total")
+        assert dropped is not None and \
+            dict((tuple(k), v) for k, v in
+                 dropped.data()["cells"])[("spans",)] == 2
+        # high-water mark: a second flush sees nothing new
+        assert exp._spans_payload() is None
+    finally:
+        telemetry.set_registry(prev)
+
+
+# ------------------------------------------------ NDJSON access log ----
+
+def test_access_log_schema_and_rotation(tmp_path, monkeypatch):
+    log = tmp_path / "access.ndjson"
+    monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", str(log))
+    cb = ContinuousBatcher(_lm(), name="alog", maxSlots=2, pageSize=8)
+    registry = ModelRegistry()
+    registry.register("alog", cb)
+    srv = InferenceServer(registry, port=0).start()
+    try:
+        ctx = RequestContext.new()
+        status, body, headers = _post(
+            srv.port, "/v1/serving/alog",
+            {"tokens": [1, 2, 3], "maxNewTokens": 4},
+            headers={"traceparent": ctx.to_traceparent()})
+        assert status == 200
+        assert headers.get("X-Trace-Id") == ctx.traceId
+        # the access line lands AFTER the reply is flushed — wait for it
+        assert _wait(lambda: log.exists() and log.read_text().strip())
+        lines = [json.loads(ln) for ln in
+                 log.read_text().strip().splitlines()]
+        assert len(lines) == 1
+        rec = lines[0]
+        assert rec["trace_id"] == ctx.traceId
+        assert rec["model"] == "alog"
+        assert rec["route"] == "/v1/serving/alog"
+        assert rec["status"] == 200
+        assert rec["total_s"] > 0 and rec["ts"] > 0
+        assert rec["tokens"] >= 1           # summed off the timeline
+        assert rec["ttft_s"] is not None and rec["ttft_s"] > 0
+        assert rec["shed"] is False and rec["failover"] is False
+        # rotation safety: rename the file; the next line lands in a
+        # FRESH file at the configured path, not the rotated inode
+        os.replace(str(log), str(tmp_path / "access.ndjson.1"))
+        status, body, headers = _post(
+            srv.port, "/v1/serving/alog", {"bogus": True})
+        assert status == 400
+        # a 400 body carries the trace id the header announced
+        assert body["trace_id"] == headers["X-Trace-Id"]
+        assert _wait(lambda: log.exists() and log.read_text().strip())
+        lines = [json.loads(ln) for ln in
+                 log.read_text().strip().splitlines()]
+        assert len(lines) == 1 and lines[0]["status"] == 400
+        assert lines[0]["trace_id"] == headers["X-Trace-Id"]
+    finally:
+        srv.stop()
+
+
+# --------------------------------------- /metrics/query end-to-end ----
+
+def test_metrics_query_endpoint_over_http():
+    """No external scrape: two retention samples bracketing real serving
+    traffic make ``GET /metrics/query?...&fn=increase`` answer the
+    counter delta over the window."""
+    from deeplearning4j_tpu.telemetry.timeseries import retention
+    cb = ContinuousBatcher(_lm(), name="mq", maxSlots=2, pageSize=8)
+    registry = ModelRegistry()
+    registry.register("mq", cb)
+    srv = InferenceServer(registry, port=0).start()
+    try:
+        ring = retention()
+        assert ring is not None             # the server ensured it
+        # retention cells materialize lazily: one request FIRST so the
+        # counter cell exists in the opening sample of the window
+        status, _body, _h = _post(srv.port, "/v1/serving/mq",
+                                  {"tokens": [1, 2], "maxNewTokens": 3})
+        assert status == 200
+        ring.sample_now()
+        for _ in range(2):
+            status, _body, _h = _post(srv.port, "/v1/serving/mq",
+                                      {"tokens": [1, 2], "maxNewTokens": 3})
+            assert status == 200
+        ring.sample_now()
+        status, doc = _get(
+            srv.port, "/metrics/query?metric=dl4j_tpu_serving_requests_"
+            "total&fn=increase&model=mq&outcome=ok")
+        assert status == 200
+        total = sum(s["value"] for s in doc["series"])
+        assert total == 2.0
+        status, doc = _get(srv.port, "/metrics/query?fn=rate")
+        assert status == 400                # metric is required
+        # /healthz surfaces the ring's state
+        status, doc = _get(srv.port, "/healthz")
+        assert status == 200
+        assert doc["retention"] is not None
+        assert doc["retention"]["samples"] >= 2
+    finally:
+        srv.stop()

@@ -50,6 +50,11 @@ HOT_PATH_SUFFIXES = (
     "fault/coordination.py",
     "fault/chaos.py",
     "compile/aotcache.py",
+    # request-scoped observability rides the serving hot path: a sync
+    # inside a timeline note or retention sample stalls the decode loop
+    "telemetry/context.py",
+    "telemetry/timeseries.py",
+    "telemetry/otlp.py",
 )
 
 _SYNC_ATTRS = {"item", "block_until_ready"}

@@ -170,3 +170,93 @@ class TelemetryRule(Rule):
                     "the shared metric to one module both import"))
 
 
+#: span names are dot.separated lowercase segments — Chrome trace and
+#: OTLP group on them, and a stray CamelCase or space-bearing name
+#: fragments the grouping.  Single-segment legacy names ("step",
+#: "compile") stay valid.
+SPAN_NAME_PATTERN = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+#: tracer entry points whose FIRST argument is the span name
+_SPAN_FUNCS = ("span", "record_complete", "instant")
+
+
+@register_rule
+class SpanNameRule(Rule):
+    """Literal span names passed to ``tracer().span("…")`` /
+    ``record_complete`` / ``instant`` must be dot.separated lowercase
+    (``serving.decode.step``) — non-literal names can't be checked
+    statically and are accepted."""
+
+    id = "span-name"
+    summary = ("span names must be dot.separated lowercase segments "
+               "([a-z0-9_], dots between)")
+
+    def visit(self, src, report) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and
+                    f.attr in _SPAN_FUNCS):
+                continue
+            if not node.args:
+                continue
+            name = node.args[0]
+            if not (isinstance(name, ast.Constant) and
+                    isinstance(name.value, str)):
+                continue
+            if not SPAN_NAME_PATTERN.match(name.value):
+                report(Finding(
+                    self.id, src.relpath, node.lineno, node.col_offset,
+                    f"span name {name.value!r} must be dot.separated "
+                    "lowercase segments (e.g. 'serving.decode.step') — "
+                    "trace viewers and the OTLP exporter group on the "
+                    "name, and mixed casings fragment the grouping"))
+
+
+@register_rule
+class ExemplarRegisteredRule(Rule):
+    """``observe_exemplar("metric", …)`` sites must name a metric some
+    module REGISTERS (``.counter/.gauge/.histogram`` with the same
+    literal) — the helper silently no-ops on unregistered names, so a
+    typo'd metric would drop every observation without an error."""
+
+    id = "exemplar-registered"
+    summary = ("observe_exemplar() metric names must match a literal "
+               "registration somewhere in the tree")
+
+    def __init__(self):
+        self.registered: set = set()
+        # (metric name, relpath, line, col)
+        self.observed: List[Tuple[str, str, int, int]] = []
+
+    def visit(self, src, report) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind, name = _registration(node)
+            if kind:
+                self.registered.add(name)
+                continue
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if fname != "observe_exemplar" or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                self.observed.append((arg.value, src.relpath,
+                                      node.lineno, node.col_offset))
+
+    def finalize(self, report) -> None:
+        for name, path, line, col in self.observed:
+            if name in self.registered:
+                continue
+            report(Finding(
+                self.id, path, line, col,
+                f"observe_exemplar({name!r}, …) names a metric no "
+                "module registers — the helper no-ops on unknown "
+                "names, so every observation here is silently lost"))
+
+
