@@ -399,6 +399,9 @@ class ComputationGraph:
             jnp.asarray(self.epochCount), fmask, carries,
             jnp.asarray(self._lrScale, jnp.float32))
         if new_state:
+            # jaxlint: disable=donation-use-after -- update() replaces
+            # every donated leaf with the freshly returned new_state
+            # values; no stale buffer survives the in-place refresh
             self.state_.update(new_state)
         # Async device scalar; score() materializes lazily (see multilayer).
         self._scoreArr = loss

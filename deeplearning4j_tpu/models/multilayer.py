@@ -600,6 +600,9 @@ class MultiLayerNetwork:
             jnp.asarray(self.epochCount), carries,
             jnp.asarray(self._lrScale, jnp.float32))
         if new_state:
+            # jaxlint: disable=donation-use-after -- update() replaces
+            # every donated leaf with the freshly returned new_state
+            # values; no stale buffer survives the in-place refresh
             self.state_.update(new_state)
         # Keep the loss as an async device scalar: syncing it here would
         # serialize every step on a host round-trip (fatal over a TPU

@@ -96,6 +96,10 @@ def reshard_tree(tree, shardings):
                 # an out_shardings the compiler rejects (uncommitted
                 # inputs, odd layouts) still reshards correctly below
                 pass
+    # jaxlint: disable=donation-use-after -- the only donating call is
+    # the jit dispatch above, and it can only raise at COMPILE time,
+    # before any buffer is consumed; a successful dispatch returns, so
+    # this line never sees a donated-and-freed tree
     return jax.device_put(tree, shardings)
 
 

@@ -675,7 +675,9 @@ class TestCliAndReporters:
         for rid in ("retrace-loop", "retrace-closure",
                     "retrace-static-args", "host-sync", "lock-order",
                     "lock-blocking-call", "thread-daemon", "thread-join",
-                    "telemetry-name", "telemetry-dup-module"):
+                    "telemetry-name", "telemetry-dup-module",
+                    "donation-use-after", "resource-leak",
+                    "tracer-escape", "metric-cardinality"):
             assert rid in out
 
     def test_parse_error_is_a_finding(self, tmp_path):
@@ -711,7 +713,9 @@ class TestRealTree:
                     "lock-blocking-call", "thread-daemon", "thread-join",
                     "telemetry-name", "telemetry-buckets",
                     "telemetry-counter-total", "telemetry-unit",
-                    "telemetry-help", "telemetry-dup-module"):
+                    "telemetry-help", "telemetry-dup-module",
+                    "donation-use-after", "resource-leak",
+                    "tracer-escape", "metric-cardinality"):
             assert rid in ids
 
     def test_check_markers_requires_lint_marker(self):
@@ -723,3 +727,611 @@ class TestRealTree:
         finally:
             sys.path.pop(0)
         assert "lint" in cm.REQUIRED
+
+
+# ---------------------------------------------------------------- dataflow --
+
+class TestDataflowEngine:
+    """The CFG/def-use engine itself (tools/jaxlint/dataflow.py)."""
+
+    @staticmethod
+    def _cfg(code):
+        import ast as _ast
+        from tools.jaxlint import dataflow as df
+        fn = _ast.parse(textwrap.dedent(code)).body[0]
+        return df, df.build_cfg(fn)
+
+    def test_if_else_assignments_join_at_use(self):
+        df, cfg = self._cfg("""
+            def f(c, x):
+                if c:
+                    y = x
+                else:
+                    y = 2
+                return y
+        """)
+        sites = set()
+
+        def transfer(state, ev, _b):
+            if ev.kind == df.ASSIGN and ev.text == "y":
+                state["y"] = frozenset({ev.node.lineno})
+            elif ev.kind == df.USE and ev.text == "y":
+                sites.update(state.get("y", ()))
+
+        df.run_forward(cfg, transfer)
+        # BOTH branch definitions reach the return's read of y
+        assert len(sites) == 2
+
+    def test_loop_back_edge_joins_header(self):
+        df, cfg = self._cfg("""
+            def f(xs):
+                acc = 0
+                for x in xs:
+                    acc = acc + x
+                return acc
+        """)
+        sites = set()
+
+        def transfer(state, ev, _b):
+            if ev.kind == df.ASSIGN and ev.text == "acc":
+                state["acc"] = frozenset({ev.node.lineno})
+            elif ev.kind == df.USE and ev.text == "acc":
+                sites.update(state.get("acc", ()))
+
+        df.run_forward(cfg, transfer)
+        # the body's read of acc sees the init AND the back-edge def
+        assert len(sites) == 2
+
+    def test_exception_edge_leaves_mid_statement(self):
+        # the PR 15 hazard ordering: a `a, b = f(a, b)` inside try
+        # raises AFTER f consumed the args but BEFORE the targets are
+        # rebound — the handler must see the pre-assignment state
+        df, cfg = self._cfg("""
+            def f(self, x):
+                try:
+                    a = work(x)
+                except Exception:
+                    rescue()
+        """)
+        handler_state = {}
+
+        def transfer(state, ev, _b):
+            if ev.kind == df.CALL and df.expr_text(ev.node.func) == "work":
+                state["called"] = frozenset({1})
+            elif ev.kind == df.ASSIGN and ev.text == "a":
+                state.pop("called", None)
+            elif ev.kind == df.CALL and \
+                    df.expr_text(ev.node.func) == "rescue":
+                handler_state.update(state)
+
+        df.run_forward(cfg, transfer)
+        # in the handler the call HAS happened, the assignment has NOT
+        assert "called" in handler_state
+
+
+# -------------------------------------------------------- donation-use-after --
+
+class TestDonationUseAfter:
+    def test_read_after_donating_call_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            import jax
+            def step(p, x):
+                return p
+            def fit(p, x):
+                f = jax.jit(step, donate_argnums=(0,))
+                out = f(p, x)
+                return p + out
+        """}, rules=["donation-use-after"])
+        assert rule_ids(res) == ["donation-use-after"]
+        assert "'p'" in res.findings[0].message
+
+    def test_rebinding_the_result_is_clean(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            import jax
+            def step(p, x):
+                return p
+            def fit(p, xs):
+                f = jax.jit(step, donate_argnums=(0,))
+                for x in xs:
+                    p = f(p, x)
+                return p
+        """}, rules=["donation-use-after"])
+        assert res.findings == []
+
+    def test_donate_argnames_resolved_through_signature(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            import jax
+            def step(params, batch):
+                return params
+            def fit(p, x):
+                f = jax.jit(step, donate_argnames=("params",))
+                out = f(p, x)
+                return p
+        """}, rules=["donation-use-after"])
+        assert rule_ids(res) == ["donation-use-after"]
+
+    def test_except_edge_reuse_fires_normal_path_clean(self, tmp_path):
+        # the PR 15 shape, inline: the tuple rebind never happened on
+        # the exception edge, so the handler's read sees consumed pools
+        files = {"m.py": """
+            import jax
+            class B:
+                def build(self, step):
+                    self.stepFn = jax.jit(step, donate_argnums=(0, 1))
+                def loop(self, tok):
+                    try:
+                        self.poolK, self.poolV = self.stepFn(
+                            self.poolK, self.poolV)
+                    except Exception:
+                        return self.poolK
+                    return tok
+        """}
+        res = lint(tmp_path, files, rules=["donation-use-after"])
+        assert rule_ids(res) == ["donation-use-after"]
+        assert "self.poolK" in res.findings[0].message
+        # drop the handler read: the tuple rebind kills on the normal
+        # path and nothing reads on the exception edge
+        clean = files["m.py"].replace("return self.poolK", "raise")
+        res2 = lint(tmp_path, {"n.py": clean},
+                    rules=["donation-use-after"])
+        assert res2.findings == []
+
+    def test_failbatch_helper_buggy_flagged_fixed_passes(self, tmp_path):
+        # interprocedural: the handler delegates to a helper; the buggy
+        # helper reads the donated pool, the fixed one rebuilds first
+        res = lint(tmp_path, {"m.py": """
+            import jax
+            def buildPagedDecodeFn():
+                def step(k, v, tok):
+                    return k, v, tok
+                return jax.jit(step, donate_argnums=(0, 1))
+            class Batcher:
+                def __init__(self):
+                    self.stepFn = buildPagedDecodeFn()
+                def _buildPools(self):
+                    self.poolK = alloc()
+                    self.poolV = alloc()
+                def _failBatchBad(self, e):
+                    print(self.poolK)
+                def _failBatchGood(self, e):
+                    self._buildPools()
+                    print(self.poolK)
+                def loop_bad(self, tok):
+                    try:
+                        self.poolK, self.poolV, out = self.stepFn(
+                            self.poolK, self.poolV, tok)
+                    except Exception as e:
+                        self._failBatchBad(e)
+                def loop_good(self, tok):
+                    try:
+                        self.poolK, self.poolV, out = self.stepFn(
+                            self.poolK, self.poolV, tok)
+                    except Exception as e:
+                        self._failBatchGood(e)
+        """}, rules=["donation-use-after"])
+        assert rule_ids(res) == ["donation-use-after"]
+        f = res.findings[0]
+        assert "_failBatchBad" in f.message
+        # the finding anchors in loop_bad's handler, not loop_good
+        assert "self._failBatchBad(e)" in f.context
+
+    def test_aotdispatch_wrapper_preserves_donation(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            import jax
+            def makeStep(step):
+                return AotDispatch(jax.jit(step, donate_argnums=(0,)))
+            class T:
+                def build(self, step):
+                    self.fn = makeStep(step)
+                def go(self, p):
+                    out = self.fn(p)
+                    return p
+        """}, rules=["donation-use-after"])
+        assert rule_ids(res) == ["donation-use-after"]
+
+    def test_suppression_and_baseline_roundtrip(self, tmp_path):
+        bad = """
+            import jax
+            def step(p):
+                return p
+            def fit(p):
+                f = jax.jit(step, donate_argnums=(0,))
+                out = f(p)
+                return p
+        """
+        res = lint(tmp_path, {"m.py": bad}, rules=["donation-use-after"])
+        assert rule_ids(res) == ["donation-use-after"]
+        bl = tmp_path / "bl.json"
+        save_baseline(bl, res.findings)
+        res2 = lint(tmp_path, {"m.py": bad}, rules=["donation-use-after"],
+                    baseline=load_baseline(bl))
+        assert res2.findings == [] and len(res2.baselined) == 1
+        res3 = lint(tmp_path, {"n.py": """
+            import jax
+            def step(p):
+                return p
+            def fit(p):
+                f = jax.jit(step, donate_argnums=(0,))
+                out = f(p)
+                # jaxlint: disable=donation-use-after -- fixture: buffer provably survives
+                return p
+        """}, rules=["donation-use-after"])
+        assert res3.findings == []
+        assert [f.rule for f in res3.suppressed] == ["donation-use-after"]
+
+    def test_orbax_restore_aot_donate_path_clean(self):
+        # satellite: the PR 13 fix (_refreshForAot rebuys XLA-owned
+        # buffers before the AOT cache can donate restored aliases)
+        # keeps the restore path clean under the new rule
+        res = run(paths=[REPO / "deeplearning4j_tpu/utils/"
+                                "sharded_checkpoint.py"],
+                  root=REPO, rules=["donation-use-after"])
+        assert res.findings == []
+
+    def test_meshtrainer_donated_reshard_is_reason_suppressed(self):
+        res = run(paths=[REPO / "deeplearning4j_tpu/parallel/"
+                                "meshtrainer.py"],
+                  root=REPO, rules=["donation-use-after"])
+        assert res.findings == []
+        assert any(f.rule == "donation-use-after"
+                   for f in res.suppressed)
+
+    def test_train_step_state_refresh_is_reason_suppressed(self):
+        for rel in ("deeplearning4j_tpu/models/multilayer.py",
+                    "deeplearning4j_tpu/models/graph.py"):
+            res = run(paths=[REPO / rel], root=REPO,
+                      rules=["donation-use-after"])
+            assert res.findings == [], rel
+            assert any(f.rule == "donation-use-after"
+                       for f in res.suppressed), rel
+
+
+# ------------------------------------------------------------ resource-leak --
+
+class TestResourceLeak:
+    def test_slot_dropped_on_early_return_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            class Pool:
+                def admit(self, seq):
+                    slot = self._freeSlots.get()
+                    if seq.bad:
+                        return None
+                    self._active[seq.sid] = slot
+                    return slot
+        """}, rules=["resource-leak"])
+        assert rule_ids(res) == ["resource-leak"]
+        assert "'slot'" in res.findings[0].message
+
+    def test_try_finally_release_is_clean(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            class Pool:
+                def admit(self, seq):
+                    slot = self._freeSlots.get()
+                    try:
+                        if seq.bad:
+                            return None
+                        self._active[seq.sid] = slot
+                        return seq.sid
+                    finally:
+                        self._freeSlots.put(slot)
+        """}, rules=["resource-leak"])
+        assert res.findings == []
+
+    def test_pool_ensure_without_release_on_branch_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            class KV:
+                def grab(self, h, n):
+                    self.kvPool.ensure(h, n)
+                    if n == 0:
+                        return
+                    self.kvPool.release(h)
+        """}, rules=["resource-leak"])
+        assert rule_ids(res) == ["resource-leak"]
+
+    def test_handoff_to_owner_field_is_clean(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            class KV:
+                def grab(self, h, n):
+                    self.kvPool.ensure(h, n)
+                    self.owned[h.sid] = h
+        """}, rules=["resource-leak"])
+        assert res.findings == []
+
+    def test_suppression_and_baseline_roundtrip(self, tmp_path):
+        bad = """
+            class Pool:
+                def admit(self, seq):
+                    slot = self._freeSlots.get()
+                    if seq.bad:
+                        return None
+                    return slot
+        """
+        res = lint(tmp_path, {"m.py": bad}, rules=["resource-leak"])
+        assert rule_ids(res) == ["resource-leak"]
+        bl = tmp_path / "bl.json"
+        save_baseline(bl, res.findings)
+        res2 = lint(tmp_path, {"m.py": bad}, rules=["resource-leak"],
+                    baseline=load_baseline(bl))
+        assert res2.findings == [] and len(res2.baselined) == 1
+        res3 = lint(tmp_path, {"n.py": """
+            class Pool:
+                def admit(self, seq):
+                    # jaxlint: disable=resource-leak -- fixture: caller owns the slot
+                    slot = self._freeSlots.get()
+                    if seq.bad:
+                        return None
+                    return slot
+        """}, rules=["resource-leak"])
+        assert res3.findings == []
+        assert [f.rule for f in res3.suppressed] == ["resource-leak"]
+
+
+# ------------------------------------------------------------ tracer-escape --
+
+class TestTracerEscape:
+    def test_jit_body_appends_traced_to_module_global_fires(
+            self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            import jax
+            _TRACE = []
+            def make():
+                @jax.jit
+                def body(x):
+                    y = x + 1
+                    _TRACE.append(y)
+                    return y
+                return body
+        """}, rules=["tracer-escape"])
+        assert rule_ids(res) == ["tracer-escape"]
+        assert "_TRACE" in res.findings[0].message
+
+    def test_scan_body_writing_self_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            from jax import lax
+            class M:
+                def roll(self, xs):
+                    def step(carry, x):
+                        self.last = carry
+                        return carry + x, x
+                    return lax.scan(step, 0, xs)
+        """}, rules=["tracer-escape"])
+        assert rule_ids(res) == ["tracer-escape"]
+
+    def test_pure_body_and_static_arg_write_are_clean(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            import functools
+            import jax
+            _MODES = []
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def body(x, mode):
+                if mode == "fast":
+                    _MODES.append(mode)
+                return x + 1
+        """}, rules=["tracer-escape"])
+        # mode is static (a real Python value), not a tracer
+        assert res.findings == []
+
+    def test_suppression_and_baseline_roundtrip(self, tmp_path):
+        bad = """
+            import jax
+            _TRACE = []
+            @jax.jit
+            def body(x):
+                _TRACE.append(x)
+                return x
+        """
+        res = lint(tmp_path, {"m.py": bad}, rules=["tracer-escape"])
+        assert rule_ids(res) == ["tracer-escape"]
+        bl = tmp_path / "bl.json"
+        save_baseline(bl, res.findings)
+        res2 = lint(tmp_path, {"m.py": bad}, rules=["tracer-escape"],
+                    baseline=load_baseline(bl))
+        assert res2.findings == [] and len(res2.baselined) == 1
+        res3 = lint(tmp_path, {"n.py": """
+            import jax
+            _TRACE = []
+            @jax.jit
+            def body(x):
+                # jaxlint: disable=tracer-escape -- fixture: debug capture, removed before ship
+                _TRACE.append(x)
+                return x
+        """}, rules=["tracer-escape"])
+        assert res3.findings == []
+        assert [f.rule for f in res3.suppressed] == ["tracer-escape"]
+
+
+# ------------------------------------------------------- metric-cardinality --
+
+class TestMetricCardinality:
+    def test_exception_text_label_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def rec(m, work):
+                try:
+                    work()
+                except Exception as e:
+                    m.errors.inc(error=str(e))
+        """}, rules=["metric-cardinality"])
+        assert rule_ids(res) == ["metric-cardinality"]
+        assert "'error'" in res.findings[0].message
+
+    def test_raw_request_field_label_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def rec(m, payload):
+                m.hits.inc(route=payload["path"])
+        """}, rules=["metric-cardinality"])
+        assert rule_ids(res) == ["metric-cardinality"]
+
+    def test_hash_output_label_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def rec(m, key):
+                m.lookups.inc(bucket=hash(key))
+        """}, rules=["metric-cardinality"])
+        assert rule_ids(res) == ["metric-cardinality"]
+
+    def test_bounded_labels_are_clean(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def rec(m, work, host, replica_id):
+                try:
+                    work()
+                except Exception as e:
+                    m.errors.inc(kind=type(e).__name__)
+                m.steps.inc(host=host, replica=replica_id)
+        """}, rules=["metric-cardinality"])
+        assert res.findings == []
+
+    def test_exemplar_trace_id_is_exempt(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def rec(m, secs, ctx):
+                m.latency.observe_exemplar(secs, trace_id=ctx.trace_id)
+        """}, rules=["metric-cardinality"])
+        assert res.findings == []
+
+    def test_suppression_and_baseline_roundtrip(self, tmp_path):
+        bad = """
+            def rec(m, payload):
+                m.hits.inc(route=payload["path"])
+        """
+        res = lint(tmp_path, {"m.py": bad},
+                   rules=["metric-cardinality"])
+        assert rule_ids(res) == ["metric-cardinality"]
+        bl = tmp_path / "bl.json"
+        save_baseline(bl, res.findings)
+        res2 = lint(tmp_path, {"m.py": bad},
+                    rules=["metric-cardinality"],
+                    baseline=load_baseline(bl))
+        assert res2.findings == [] and len(res2.baselined) == 1
+        res3 = lint(tmp_path, {"n.py": """
+            def rec(m, payload):
+                # jaxlint: disable=metric-cardinality -- fixture: route set is a 4-entry enum
+                m.hits.inc(route=payload["path"])
+        """}, rules=["metric-cardinality"])
+        assert res3.findings == []
+        assert [f.rule for f in res3.suppressed] == \
+            ["metric-cardinality"]
+
+
+# ------------------------------------------------------------ changed mode --
+
+BAD_THREAD = """
+import threading
+def go(fn):
+    threading.Thread(target=fn).start()
+"""
+
+
+def _git(cwd, *args):
+    import subprocess
+    subprocess.run(
+        ["git", "-C", str(cwd), "-c", "user.email=t@example.com",
+         "-c", "user.name=t", *args],
+        check=True, capture_output=True)
+
+
+class TestChangedMode:
+    def _repo(self, tmp_path):
+        (tmp_path / "a.py").write_text(
+            "import b\n" + BAD_THREAD, encoding="utf-8")
+        (tmp_path / "b.py").write_text("x = 1\n", encoding="utf-8")
+        (tmp_path / "c.py").write_text(BAD_THREAD, encoding="utf-8")
+        _git(tmp_path, "init", "-q")
+        _git(tmp_path, "add", ".")
+        _git(tmp_path, "commit", "-qm", "seed")
+        return tmp_path
+
+    def test_changed_scopes_to_module_closure(self, tmp_path, capsys):
+        repo = self._repo(tmp_path)
+        # touch a.py only: the scan set is a + its import closure (b),
+        # NOT c — but a's findings match the full run exactly
+        (repo / "a.py").write_text(
+            "import b\n# touched\n" + BAD_THREAD, encoding="utf-8")
+        rc = jaxlint_main(["--changed", "--root", str(repo),
+                           "--no-baseline", "--json"])
+        assert rc == 1
+        changed_doc = json.loads(capsys.readouterr().out)
+        assert changed_doc["files_scanned"] == 2
+        assert all(f["path"] == "a.py"
+                   for f in changed_doc["findings"])
+        jaxlint_main([str(repo), "--root", str(repo),
+                      "--no-baseline", "--json"])
+        full_doc = json.loads(capsys.readouterr().out)
+        assert full_doc["files_scanned"] == 3
+        pick = lambda doc: sorted(
+            (f["rule"], f["path"], f["line"], f["message"])
+            for f in doc["findings"] if f["path"] == "a.py")
+        assert pick(changed_doc) == pick(full_doc)
+        # the full run also sees c.py's finding; changed mode must not
+        assert any(f["path"] == "c.py" for f in full_doc["findings"])
+
+    def test_changed_with_clean_tree_is_ok(self, tmp_path, capsys):
+        repo = self._repo(tmp_path)
+        rc = jaxlint_main(["--changed", "--root", str(repo),
+                           "--no-baseline"])
+        assert rc == 0
+        assert "no changed Python files" in capsys.readouterr().out
+
+    def test_changed_picks_up_untracked_files(self, tmp_path, capsys):
+        repo = self._repo(tmp_path)
+        (repo / "d.py").write_text(BAD_THREAD, encoding="utf-8")
+        rc = jaxlint_main(["--changed", "--root", str(repo),
+                           "--no-baseline", "--json"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert [f["path"] for f in doc["findings"]] == ["d.py"]
+
+
+# ------------------------------------------------- stats + baseline hygiene --
+
+class TestStatsAndBaselineHygiene:
+    def test_timings_populated_and_rendered(self, tmp_path, capsys):
+        res = lint(tmp_path, {"m.py": "x = 1\n"})
+        t = res.timings
+        assert set(t) == {"parse_s", "per_rule_s", "total_s"}
+        assert t["total_s"] >= t["parse_s"] >= 0
+        assert set(t["per_rule_s"]) == set(res.rules_run)
+        out = render_text(res, stats=True)
+        assert "stats: total" in out and "stats: parse" in out
+        doc = render_json(res)
+        assert doc["timings"]["total_s"] == t["total_s"]
+        f = tmp_path / "m.py"
+        assert jaxlint_main([str(f), "--no-baseline", "--stats"]) == 0
+        assert "stats: total" in capsys.readouterr().out
+
+    def test_dead_entry_file_deleted_warns_then_strict_fails(
+            self, tmp_path, capsys):
+        f = tmp_path / "m.py"
+        f.write_text(BAD_THREAD, encoding="utf-8")
+        bl = tmp_path / "bl.json"
+        assert jaxlint_main([str(f), "--baseline", str(bl),
+                             "--baseline-update"]) == 0
+        f.unlink()
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n", encoding="utf-8")
+        capsys.readouterr()
+        # default: warning, still exit 0
+        assert jaxlint_main([str(ok), "--baseline", str(bl)]) == 0
+        assert "dead entry" in capsys.readouterr().out
+        # strict: the same run fails
+        assert jaxlint_main([str(ok), "--baseline", str(bl),
+                             "--baseline-strict"]) == 1
+        # --baseline-update prunes the dead entry even though the
+        # deleted file is out of the update's scan scope
+        assert jaxlint_main([str(ok), "--baseline", str(bl),
+                             "--baseline-update"]) == 0
+        assert sum(load_baseline(bl).values()) == 0
+
+    def test_dead_entry_line_text_gone_detected(self, tmp_path, capsys):
+        f = tmp_path / "m.py"
+        f.write_text(BAD_THREAD, encoding="utf-8")
+        bl = tmp_path / "bl.json"
+        assert jaxlint_main([str(f), "--baseline", str(bl),
+                             "--baseline-update"]) == 0
+        f.write_text("x = 1\n", encoding="utf-8")
+        capsys.readouterr()
+        rc = jaxlint_main([str(f), "--baseline", str(bl),
+                           "--baseline-strict"])
+        assert rc == 1
+        assert "line text no longer present" in capsys.readouterr().out
+
+    def test_committed_baseline_has_no_dead_entries(self):
+        result = run()
+        assert result.dead_baseline == []

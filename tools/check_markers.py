@@ -75,12 +75,42 @@ def main(argv) -> int:
     sys.path.insert(0, str(repo))
     try:
         from tools.jaxlint import render_text, run
+        from tools.jaxlint.core import render_json
         result = run(paths=[pkg_dir], root=repo)
     finally:
         sys.path.pop(0)
-    out = render_text(result)
+    out = render_text(result, stats=True)
     print(out) if result.exit_code == 0 else print(out, file=sys.stderr)
-    return result.exit_code
+    if result.exit_code != 0:
+        return result.exit_code
+    # time budget: rule growth must not silently bloat the tier-1 gate —
+    # the dataflow rules brought CFG construction per function, and the
+    # next rule family should pay attention to this number too
+    total_s = float(result.timings.get("total_s", 0.0))
+    if total_s > 60.0:
+        print(f"check_markers: jaxlint took {total_s:.1f}s (> 60s "
+              "budget) — profile with --stats and cache or scope the "
+              "slow rule", file=sys.stderr)
+        return 1
+    # JSON schema sanity: machine consumers key on these fields, and
+    # every dataflow-family rule id must be active in a default run
+    doc = render_json(result)
+    schema_keys = {"version", "files_scanned", "rules", "findings",
+                   "suppressed", "baselined", "stale_baseline",
+                   "dead_baseline", "timings", "exit_code"}
+    missing_keys = schema_keys - set(doc)
+    new_ids = {"donation-use-after", "resource-leak", "tracer-escape",
+               "metric-cardinality"}
+    missing_ids = new_ids - set(doc["rules"])
+    if missing_keys or missing_ids:
+        for k in sorted(missing_keys):
+            print(f"check_markers: jaxlint --json schema lost key "
+                  f"{k!r}", file=sys.stderr)
+        for r in sorted(missing_ids):
+            print(f"check_markers: dataflow rule {r!r} missing from a "
+                  "default jaxlint run", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -3,8 +3,11 @@
 Rules: retrace hazards (retrace-loop / retrace-closure /
 retrace-static-args), hidden host syncs on declared hot paths
 (host-sync), lock discipline (lock-order / lock-blocking-call), thread
-lifecycle (thread-daemon / thread-join), and the telemetry metric
-namespace (telemetry-*, re-based from tools/lint_telemetry.py).
+lifecycle (thread-daemon / thread-join), the telemetry metric
+namespace (telemetry-*, re-based from tools/lint_telemetry.py) plus
+metric label cardinality (metric-cardinality), and the flow-sensitive
+dataflow families over a per-function CFG (donation-use-after /
+resource-leak / tracer-escape, tools/jaxlint/dataflow.py).
 
 Run ``python -m tools.jaxlint --help``; the full catalog with examples
 lives in ``tools/jaxlint/RULES.md``.

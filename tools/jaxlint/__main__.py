@@ -1,15 +1,25 @@
 """CLI: ``python -m tools.jaxlint [paths...] [options]``.
 
-Exit codes: 0 clean (no unsuppressed, unbaselined findings), 1 findings,
-2 usage error.  Invoked by ``tools/check_markers.py`` ahead of pytest,
-so a hazard fails tier-1 exactly like a failing test.
+Exit codes: 0 clean (no unsuppressed, unbaselined findings), 1 findings
+(or dead baseline entries under ``--baseline-strict``), 2 usage error.
+Invoked by ``tools/check_markers.py`` ahead of pytest, so a hazard fails
+tier-1 exactly like a failing test.
+
+``--changed`` scopes the run to the files ``git diff`` (plus untracked)
+reports, expanded to their local-import closure so interprocedural
+summaries (donation builders, lock orders) see the modules that define
+what a changed file calls.  Findings for the changed files are identical
+to a full-tree run; cross-file rules see only the closure.
 """
 from __future__ import annotations
 
 import argparse
+import ast
 import json
+import subprocess
 import sys
 from pathlib import Path
+from typing import List, Optional, Set
 
 from tools.jaxlint.core import (Linter, load_baseline, make_rules,
                                 render_json, render_text, save_baseline)
@@ -17,6 +27,77 @@ from tools.jaxlint.core import (Linter, load_baseline, make_rules,
 _HERE = Path(__file__).resolve().parent
 _REPO = _HERE.parents[1]
 DEFAULT_BASELINE = _HERE / "baseline.json"
+
+
+def _git_changed_py(root: Path) -> Optional[List[Path]]:
+    """Changed-vs-HEAD plus untracked ``.py`` files, repo-relative.
+    ``None`` when git itself fails (not a repo, no HEAD yet)."""
+    names: Set[str] = set()
+    for cmd in (["diff", "--name-only", "HEAD"],
+                ["ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(["git", "-C", str(root)] + cmd,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            return None
+        names.update(ln.strip() for ln in proc.stdout.splitlines()
+                     if ln.strip())
+    out = []
+    for n in sorted(names):
+        if n.endswith(".py") and (root / n).is_file():
+            out.append(root / n)
+    return out
+
+
+def _local_imports(path: Path, root: Path) -> List[Path]:
+    """Files under ``root`` that ``path`` imports (absolute or
+    relative), for the --changed module closure."""
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return []
+    mods: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative: resolve against this file's package
+                pkg_parts = path.resolve().relative_to(
+                    root.resolve()).parts[:-1]
+                if node.level - 1 <= len(pkg_parts):
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    stem = ".".join(base)
+                    mod = f"{stem}.{node.module}" if node.module else stem
+                else:
+                    continue
+            else:
+                mod = node.module or ""
+            if mod:
+                mods.add(mod)
+                mods.update(f"{mod}.{a.name}" for a in node.names)
+    out = []
+    for mod in sorted(mods):
+        rel = mod.replace(".", "/")
+        for cand in (root / (rel + ".py"), root / rel / "__init__.py"):
+            if cand.is_file():
+                out.append(cand)
+                break
+    return out
+
+
+def _module_closure(changed: List[Path], root: Path) -> List[Path]:
+    """Transitive local-import closure of the changed files."""
+    seen: Set[Path] = set()
+    work = [p.resolve() for p in changed]
+    while work:
+        p = work.pop()
+        if p in seen:
+            continue
+        seen.add(p)
+        for dep in _local_imports(p, root):
+            if dep.resolve() not in seen:
+                work.append(dep.resolve())
+    return sorted(seen)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -41,6 +122,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--baseline-update", action="store_true",
                    help="rewrite the baseline from the current "
                         "unsuppressed findings and exit 0")
+    p.add_argument("--baseline-strict", action="store_true",
+                   help="dead baseline entries (file deleted or line "
+                        "text gone) fail the run instead of warning")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs git HEAD (plus "
+                        "untracked), expanded to their local-import "
+                        "closure for summary correctness")
+    p.add_argument("--stats", action="store_true",
+                   help="append parse/per-rule/total timing lines to "
+                        "the report")
+    p.add_argument("--root", default=str(_REPO),
+                   help="repository root for relative paths, git, and "
+                        f"default scan scope (default: {_REPO})")
     p.add_argument("--verbose", action="store_true",
                    help="also list suppressed/baselined findings")
     return p
@@ -57,8 +151,29 @@ def main(argv=None) -> int:
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
-    paths = [Path(p) for p in args.paths] or \
-        [_REPO / "deeplearning4j_tpu"]
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"jaxlint: no such root {root}", file=sys.stderr)
+        return 2
+    if args.changed:
+        changed = _git_changed_py(root)
+        if changed is None:
+            print(f"jaxlint: --changed needs a git checkout at {root}",
+                  file=sys.stderr)
+            return 2
+        if args.paths:
+            scope = {Path(p).resolve() for p in args.paths}
+            changed = [c for c in changed
+                       if any(s == c.resolve() or
+                              s in c.resolve().parents for s in scope)]
+        if not changed:
+            print("jaxlint: OK (no changed Python files)")
+            return 0
+        paths = _module_closure(changed, root)
+    else:
+        default = root / "deeplearning4j_tpu"
+        paths = [Path(p) for p in args.paths] or \
+            [default if default.is_dir() else root]
     for p in paths:
         if not p.exists():
             print(f"jaxlint: no such path {p}", file=sys.stderr)
@@ -72,7 +187,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     try:
-        linter = Linter(_REPO, rules=rules, baseline=baseline)
+        linter = Linter(root, rules=rules, baseline=baseline)
     except ValueError as e:
         print(f"jaxlint: {e}", file=sys.stderr)
         return 2
@@ -90,8 +205,27 @@ def main(argv=None) -> int:
             existing = load_baseline(baseline_path)
         except (ValueError, KeyError):
             existing = {}
+        # dead entries (file deleted / line text gone) are rot, never
+        # "out of scope" — prune them even from a filtered update
+        # (the update run is baseline-less, so re-derive deadness here)
+        dead = set()
+        for k in existing:
+            _rule, relpath, context = k
+            fp = root / relpath
+            if not fp.is_file():
+                dead.add(k)
+                continue
+            try:
+                stripped = {ln.strip() for ln in
+                            fp.read_text(encoding="utf-8").splitlines()}
+            except OSError:
+                dead.add(k)
+                continue
+            if context and context not in stripped:
+                dead.add(k)
         preserved = [k for k, n in sorted(existing.items())
-                     if not (k[1] in scanned and k[0] in result.active_ids)
+                     if k not in dead and
+                     not (k[1] in scanned and k[0] in result.active_ids)
                      for _ in range(n)]
         save_baseline(baseline_path, entries, extra_keys=preserved)
         blocked = [f for f in result.findings if f.rule in META_RULES]
@@ -106,7 +240,10 @@ def main(argv=None) -> int:
     if args.as_json:
         print(json.dumps(render_json(result), indent=1))
     else:
-        print(render_text(result, verbose=args.verbose))
+        print(render_text(result, verbose=args.verbose,
+                          stats=args.stats))
+    if args.baseline_strict and result.dead_baseline:
+        return 1
     return result.exit_code
 
 

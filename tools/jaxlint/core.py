@@ -35,6 +35,7 @@ from __future__ import annotations
 import ast
 import json
 import re
+import time
 from collections import Counter
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -263,9 +264,9 @@ def make_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
 
 def _ensure_builtin_rules() -> None:
     # import side effect registers the built-in rule set exactly once
-    from tools.jaxlint import (rules_hostsync, rules_locks,  # noqa: F401
-                               rules_retrace, rules_telemetry,
-                               rules_threads)
+    from tools.jaxlint import (rules_dataflow, rules_hostsync,  # noqa: F401
+                               rules_locks, rules_retrace,
+                               rules_telemetry, rules_threads)
 
 
 # -- baseline -------------------------------------------------------------
@@ -315,11 +316,17 @@ class RunResult:
         self.suppressed: List[Finding] = []
         self.baselined: List[Finding] = []
         self.stale_baseline: List[Tuple[str, str, str]] = []
+        #: baseline entries whose code is GONE — file deleted, or the
+        #: recorded line text no longer present anywhere in the file.
+        #: Warnings by default, errors under --baseline-strict.
+        self.dead_baseline: List[Tuple[Tuple[str, str, str], str]] = []
         self.files_scanned = 0
         self.scanned_relpaths: List[str] = []
         self.rules_run: List[str] = []
         self.active_ids: set = set()
         self.stats: Dict[str, object] = {}      # rule-contributed counters
+        #: wall-clock decomposition: {"parse_s", "per_rule_s", "total_s"}
+        self.timings: Dict[str, object] = {}
 
     @property
     def exit_code(self) -> int:
@@ -344,6 +351,7 @@ class Linter:
         self.baseline = baseline if baseline is not None else Counter()
 
     def run(self, paths: Sequence[Path]) -> RunResult:
+        t_start = time.perf_counter()
         result = RunResult()
         result.rules_run = [r.id for r in self.rules]
         result.active_ids = set(self.active_ids)
@@ -351,8 +359,12 @@ class Linter:
         raw: List[Finding] = []
         sources: List[SourceFile] = []
         known_ids = all_rule_ids()
+        parse_s = 0.0
+        rule_s: Dict[str, float] = {r.id: 0.0 for r in self.rules}
         for path in files:
+            t0 = time.perf_counter()
             src = SourceFile(path, self.root)
+            parse_s += time.perf_counter() - t0
             sources.append(src)
             result.files_scanned += 1
             result.scanned_relpaths.append(src.relpath)
@@ -365,14 +377,59 @@ class Linter:
                     f"syntax error: {e.msg}", src.line_text(e.lineno or 1)))
                 continue
             for rule in self.rules:
+                t0 = time.perf_counter()
                 rule.visit(src, raw.append)
+                rule_s[rule.id] += time.perf_counter() - t0
         for rule in self.rules:
+            t0 = time.perf_counter()
             rule.finalize(raw.append)
+            rule_s[rule.id] += time.perf_counter() - t0
             stats = getattr(rule, "collect_stats", None)
             if stats is not None:
                 result.stats.update(stats())
         self._filter(raw, sources, result)
+        self._check_dead_baseline(sources, result)
+        result.timings = {
+            "parse_s": round(parse_s, 4),
+            "per_rule_s": {k: round(v, 4)
+                           for k, v in sorted(rule_s.items())},
+            "total_s": round(time.perf_counter() - t_start, 4),
+        }
         return result
+
+    def _check_dead_baseline(self, sources: List[SourceFile],
+                             result: RunResult) -> None:
+        """Baseline hygiene: an entry whose file is gone, or whose
+        recorded line text no longer appears anywhere in the file, is
+        grandfathering code that no longer exists.  Checked against the
+        WHOLE baseline (not just this run's scope) so a path-filtered
+        run still surfaces rot."""
+        by_rel = {s.relpath: s for s in sources}
+        line_cache: Dict[str, Optional[set]] = {}
+        for key in sorted(set(self.baseline)):
+            rule, relpath, context = key
+            stripped = line_cache.get(relpath)
+            if stripped is None and relpath not in line_cache:
+                src = by_rel.get(relpath)
+                if src is not None:
+                    stripped = {ln.strip() for ln in src.lines}
+                else:
+                    p = self.root / relpath
+                    if p.is_file():
+                        try:
+                            stripped = {
+                                ln.strip() for ln in
+                                p.read_text(encoding="utf-8").splitlines()}
+                        except OSError:
+                            stripped = None
+                    else:
+                        stripped = None
+                line_cache[relpath] = stripped
+            if stripped is None:
+                result.dead_baseline.append((key, "file deleted"))
+            elif context and context not in stripped:
+                result.dead_baseline.append(
+                    (key, "line text no longer present in the file"))
 
     def _collect(self, paths: Sequence[Path]) -> List[Path]:
         out: List[Path] = []
@@ -435,7 +492,8 @@ class Linter:
 
 # -- reporters ------------------------------------------------------------
 
-def render_text(result: RunResult, verbose: bool = False) -> str:
+def render_text(result: RunResult, verbose: bool = False,
+                stats: bool = False) -> str:
     lines = []
     for f in result.findings:
         lines.append(f"{f.location()}: {f.rule}: {f.message}")
@@ -444,12 +502,26 @@ def render_text(result: RunResult, verbose: bool = False) -> str:
             "baseline: stale entry "
             f"{key[0]} @ {key[1]} ({key[2]!r}) no longer matches any "
             "finding — run --baseline-update to prune")
+    for key, why in result.dead_baseline:
+        lines.append(
+            "baseline: dead entry "
+            f"{key[0]} @ {key[1]} ({key[2]!r}): {why} — run "
+            "--baseline-update to prune (errors under --baseline-strict)")
     n_act = len(result.findings)
     lines.append(
         f"jaxlint: {'FAIL' if n_act else 'OK'} "
         f"({result.files_scanned} files, {len(result.rules_run)} rules, "
         f"{n_act} findings, {len(result.suppressed)} suppressed, "
         f"{len(result.baselined)} baselined)")
+    if stats and result.timings:
+        lines.append(f"stats: parse {result.timings['parse_s']:.3f}s")
+        per_rule = result.timings.get("per_rule_s", {})
+        for rid, secs in sorted(per_rule.items(),
+                                key=lambda kv: -kv[1]):
+            lines.append(f"stats: rule {rid} {secs:.3f}s")
+        lines.append(
+            f"stats: total {result.timings['total_s']:.3f}s "
+            f"({result.files_scanned} files)")
     if verbose:
         for f in result.suppressed:
             lines.append(f"  suppressed {f.location()}: {f.rule}")
@@ -467,6 +539,9 @@ def render_json(result: RunResult) -> dict:
         "suppressed": [f.to_dict() for f in result.suppressed],
         "baselined": [f.to_dict() for f in result.baselined],
         "stale_baseline": [list(k) for k in result.stale_baseline],
+        "dead_baseline": [[list(k), why]
+                          for k, why in result.dead_baseline],
+        "timings": result.timings,
         "exit_code": result.exit_code,
     }
 

@@ -1,0 +1,687 @@
+"""Flow-sensitive intraprocedural dataflow engine for jaxlint.
+
+The per-statement AST rules (retrace, host-sync, telemetry naming) match
+*shapes*; the two worst bugs in this repo's history were *paths*:
+
+- PR 13: orbax-restored arrays donated into an AOT executable that has
+  no copy fallback — a fact about where a binding flowed, not about any
+  single line.
+- PR 15: a paged decode step raised mid-dispatch after its donated KV
+  pool buffers were already consumed, and the failure handler touched
+  the dead buffers — a fact about the *exception edge* of the call.
+
+This module gives rules the representation those facts live in:
+
+- :func:`build_cfg` — a per-function control-flow graph whose blocks
+  hold *events* (use / assign / call / call-return / exception-binding)
+  flattened in evaluation order.  Branches and loops join; ``return``
+  and ``raise`` edge to distinct exit blocks; every call lexically
+  inside a ``try`` gets an exception edge to the handler (and/or
+  ``finally``) entries, taken *after* the call's side effects but
+  *before* the statement's assignments land — exactly the mid-dispatch
+  state PR 15 hit.
+- :func:`run_forward` — worklist forward dataflow with union join over
+  per-binding fact sets.
+- :class:`ModuleModel` — one cached-per-file index of functions, local
+  imports and ``jax.jit`` aliases, with the same callee resolution
+  contract as ``rules_locks`` (``self.method()``, same-module functions,
+  from-imports) so rules can build interprocedural *summaries* on top.
+
+Bindings are tracked by printable expression text (:func:`expr_text`):
+``x``, ``self.pool.k``, ``self._stepFns['step']``.  Anything the text
+cannot print (computed subscripts, call results) decays to uses of its
+printable parts — the analysis under-approximates, so rule findings
+stay real.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.jaxlint.core import dotted
+
+__all__ = ["Event", "Block", "CFG", "FuncInfo", "ModuleModel",
+           "build_cfg", "expr_text", "run_forward",
+           "USE", "ASSIGN", "CALL", "CALLRET", "EXCDEF"]
+
+#: event kinds, in the order a statement produces them: reads and call
+#: dispatches first (the "expression phase" an exception edge observes),
+#: then normal-path call returns and assignment defs
+USE = "use"          # a binding is read               text = binding
+ASSIGN = "assign"    # a binding is (re)defined        text = binding
+CALL = "call"        # a call dispatches               text = callee text
+CALLRET = "callret"  # the same call returned normally text = callee text
+EXCDEF = "excdef"    # `except E as name:` bound name  text = name
+
+
+class Event:
+    __slots__ = ("kind", "text", "node")
+
+    def __init__(self, kind: str, text: str, node: ast.AST):
+        self.kind = kind
+        self.text = text
+        self.node = node
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Event({self.kind}, {self.text!r}, L{getattr(self.node, 'lineno', '?')})"
+
+
+class Block:
+    __slots__ = ("idx", "events", "succ")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.events: List[Event] = []
+        self.succ: Set[int] = set()
+
+
+class CFG:
+    """Per-function CFG.  ``blocks[entry]`` is the entry; ``exit_idx``
+    collects normal exits (returns and fall-off), ``raise_idx`` collects
+    uncaught raises — both are empty sink blocks."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.blocks: List[Block] = []
+        self.entry = self._new().idx
+        self.exit_idx = self._new().idx
+        self.raise_idx = self._new().idx
+        self.globals_: Set[str] = set()
+        self.nonlocals_: Set[str] = set()
+
+    def _new(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def param_names(self) -> List[str]:
+        a = self.fn.args
+        names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+        names += [p.arg for p in a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+# -- binding text ---------------------------------------------------------
+
+def expr_text(node: Optional[ast.AST]) -> str:
+    """Printable binding text for Name / Attribute / constant-Subscript
+    chains ('' when the expression has no stable spelling)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_text(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    if isinstance(node, ast.Subscript):
+        base = expr_text(node.value)
+        sl = node.slice
+        if base and isinstance(sl, ast.Constant):
+            return f"{base}[{sl.value!r}]"
+        return ""
+    return ""
+
+
+def covers(binding: str, text: str) -> bool:
+    """True when a fact about ``binding`` is observable through ``text``
+    (equal, or ``text`` reads deeper into it: ``self.pool.k`` covers
+    ``self.pool.k.shape``)."""
+    return text == binding or text.startswith(binding + ".") or \
+        text.startswith(binding + "[")
+
+
+# -- event extraction -----------------------------------------------------
+
+def _expr_events(node: Optional[ast.AST], out: List[Event]) -> None:
+    """Flatten an expression into events, approximately in evaluation
+    order (reads before the calls that consume them)."""
+    if node is None or isinstance(node, ast.Constant):
+        return
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+        t = expr_text(node)
+        if t:
+            out.append(Event(USE, t, node))
+            return
+        # unprintable chain: decay to the printable parts
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.Load, ast.Store, ast.Del)):
+                _expr_events(child, out)
+        return
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            _expr_events(f.value, out)     # reading the receiver
+        elif not isinstance(f, ast.Name):
+            _expr_events(f, out)           # e.g. jit(...)(args): inner call
+        for a in node.args:
+            _expr_events(a.value if isinstance(a, ast.Starred) else a, out)
+        for kw in node.keywords:
+            _expr_events(kw.value, out)
+        out.append(Event(CALL, expr_text(f), node))
+        return
+    if isinstance(node, ast.Lambda):
+        return                             # runs on its own schedule
+    for child in ast.iter_child_nodes(node):
+        _expr_events(child, out)
+
+
+def _target_events(node: ast.AST, out: List[Event]) -> None:
+    """Flatten an assignment target: index/receiver reads first, then
+    the define of the printable binding (if any)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            _target_events(e, out)
+    elif isinstance(node, ast.Starred):
+        _target_events(node.value, out)
+    elif isinstance(node, ast.Subscript):
+        _expr_events(node.slice, out)
+        base = expr_text(node.value)
+        if base:
+            # storing INTO a container reads (and mutates) the container
+            out.append(Event(USE, base, node))
+        else:
+            _expr_events(node.value, out)
+        t = expr_text(node)
+        if t:
+            out.append(Event(ASSIGN, t, node))
+    elif isinstance(node, (ast.Name, ast.Attribute)):
+        t = expr_text(node)
+        if t:
+            out.append(Event(ASSIGN, t, node))
+
+
+def _split_phases(events: List[Event]) -> Tuple[List[Event], List[Event]]:
+    """(expression-phase, normal-return-phase): CALLRET events for every
+    CALL are synthesized into the second phase so transfer functions can
+    apply normal-path-only effects (summary kills) after the exception
+    edge has already left the block."""
+    rets = [Event(CALLRET, e.text, e.node) for e in events if e.kind == CALL]
+    return events, rets
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST):
+        self.cfg = CFG(fn)
+        self.cur = self.cfg.blocks[self.cfg.entry]
+        self.loops: List[Tuple[int, int]] = []   # (header idx, after idx)
+        self.excs: List[List[int]] = []          # handler/finally entries
+        self.finallys: List[int] = []            # enclosing finally entries
+
+    # -- plumbing --------------------------------------------------------
+    def _block(self) -> Block:
+        return self.cfg._new()
+
+    def _edge(self, a: Block, b_idx: int) -> None:
+        a.succ.add(b_idx)
+
+    def _goto(self, b: Block) -> None:
+        self.cur = b
+
+    def _has_call(self, events: List[Event]) -> bool:
+        return any(e.kind == CALL for e in events)
+
+    def _emit(self, expr_evs: List[Event], tail_evs: List[Event]) -> None:
+        """Place one statement's events; when its expression phase can
+        raise inside a try, split the block so the exception edge leaves
+        after the calls but before the tail (assignments)."""
+        self.cur.events.extend(expr_evs)
+        if self.excs and self._has_call(expr_evs):
+            for t in self.excs[-1]:
+                self._edge(self.cur, t)
+            nxt = self._block()
+            self._edge(self.cur, nxt.idx)
+            self._goto(nxt)
+        self.cur.events.extend(tail_evs)
+
+    # -- statements ------------------------------------------------------
+    def build(self) -> CFG:
+        for st in self.cfg.fn.body:
+            self._stmt(st)
+        self._edge(self.cur, self.cfg.exit_idx)
+        return self.cfg
+
+    def _stmt(self, s: ast.stmt) -> None:
+        m = getattr(self, "_stmt_" + type(s).__name__, None)
+        if m is not None:
+            m(s)
+            return
+        # default: flatten every expression in the statement as uses
+        evs: List[Event] = []
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                _expr_events(child, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+
+    def _stmt_Assign(self, s: ast.Assign) -> None:
+        evs: List[Event] = []
+        _expr_events(s.value, evs)
+        tgt: List[Event] = []
+        for t in s.targets:
+            _target_events(t, tgt)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets + tgt)
+
+    def _stmt_AnnAssign(self, s: ast.AnnAssign) -> None:
+        if s.value is None:
+            return
+        evs: List[Event] = []
+        _expr_events(s.value, evs)
+        tgt: List[Event] = []
+        _target_events(s.target, tgt)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets + tgt)
+
+    def _stmt_AugAssign(self, s: ast.AugAssign) -> None:
+        evs: List[Event] = []
+        t = expr_text(s.target)
+        if t:
+            evs.append(Event(USE, t, s.target))
+        _expr_events(s.value, evs)
+        tgt: List[Event] = []
+        _target_events(s.target, tgt)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets + tgt)
+
+    def _stmt_Expr(self, s: ast.Expr) -> None:
+        evs: List[Event] = []
+        _expr_events(s.value, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+
+    def _stmt_Return(self, s: ast.Return) -> None:
+        evs: List[Event] = []
+        _expr_events(s.value, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+        # a return inside try..finally runs the finalbody first (the
+        # finally end carries an onward edge to exit for this path)
+        if self.finallys:
+            self._edge(self.cur, self.finallys[-1])
+        else:
+            self._edge(self.cur, self.cfg.exit_idx)
+        self._goto(self._block())       # unreachable continuation
+
+    def _stmt_Raise(self, s: ast.Raise) -> None:
+        evs: List[Event] = []
+        _expr_events(s.exc, evs)
+        _expr_events(s.cause, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+        targets = self.excs[-1] if self.excs else [self.cfg.raise_idx]
+        for t in targets:
+            self._edge(self.cur, t)
+        self._goto(self._block())       # unreachable continuation
+
+    def _stmt_Pass(self, s: ast.Pass) -> None:
+        pass
+
+    def _stmt_Break(self, s: ast.Break) -> None:
+        if self.loops:
+            self._edge(self.cur, self.loops[-1][1])
+        self._goto(self._block())
+
+    def _stmt_Continue(self, s: ast.Continue) -> None:
+        if self.loops:
+            self._edge(self.cur, self.loops[-1][0])
+        self._goto(self._block())
+
+    def _stmt_Global(self, s: ast.Global) -> None:
+        self.cfg.globals_.update(s.names)
+
+    def _stmt_Nonlocal(self, s: ast.Nonlocal) -> None:
+        self.cfg.nonlocals_.update(s.names)
+
+    def _stmt_Import(self, s: ast.Import) -> None:
+        for a in s.names:
+            name = a.asname or a.name.split(".", 1)[0]
+            self.cur.events.append(Event(ASSIGN, name, s))
+
+    def _stmt_ImportFrom(self, s: ast.ImportFrom) -> None:
+        for a in s.names:
+            self.cur.events.append(Event(ASSIGN, a.asname or a.name, s))
+
+    def _stmt_FunctionDef(self, s) -> None:
+        self.cur.events.append(Event(ASSIGN, s.name, s))
+
+    _stmt_AsyncFunctionDef = _stmt_FunctionDef
+    _stmt_ClassDef = _stmt_FunctionDef
+
+    def _stmt_Delete(self, s: ast.Delete) -> None:
+        for t in s.targets:
+            text = expr_text(t)
+            if text:
+                self.cur.events.append(Event(ASSIGN, text, s))
+
+    def _stmt_Assert(self, s: ast.Assert) -> None:
+        evs: List[Event] = []
+        _expr_events(s.test, evs)
+        _expr_events(s.msg, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+
+    def _stmt_If(self, s: ast.If) -> None:
+        evs: List[Event] = []
+        _expr_events(s.test, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+        branch = self.cur
+        after = self._block()
+        then = self._block()
+        self._edge(branch, then.idx)
+        self._goto(then)
+        for st in s.body:
+            self._stmt(st)
+        self._edge(self.cur, after.idx)
+        if s.orelse:
+            els = self._block()
+            self._edge(branch, els.idx)
+            self._goto(els)
+            for st in s.orelse:
+                self._stmt(st)
+            self._edge(self.cur, after.idx)
+        else:
+            self._edge(branch, after.idx)
+        self._goto(after)
+
+    def _stmt_While(self, s: ast.While) -> None:
+        header = self._block()
+        self._edge(self.cur, header.idx)
+        self._goto(header)
+        evs: List[Event] = []
+        _expr_events(s.test, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)     # may move cur past header on exc split
+        cond = self.cur
+        after = self._block()
+        body = self._block()
+        self._edge(cond, body.idx)
+        exit_to = after.idx
+        if s.orelse:
+            els = self._block()
+            self._edge(cond, els.idx)
+            self._goto(els)
+            for st in s.orelse:
+                self._stmt(st)
+            self._edge(self.cur, after.idx)
+        else:
+            self._edge(cond, exit_to)
+        self.loops.append((header.idx, after.idx))
+        self._goto(body)
+        for st in s.body:
+            self._stmt(st)
+        self._edge(self.cur, header.idx)
+        self.loops.pop()
+        self._goto(after)
+
+    def _stmt_For(self, s) -> None:
+        evs: List[Event] = []
+        _expr_events(s.iter, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+        header = self._block()
+        self._edge(self.cur, header.idx)
+        after = self._block()
+        body = self._block()
+        self._edge(header, body.idx)
+        if s.orelse:
+            els = self._block()
+            self._edge(header, els.idx)
+            self._goto(els)
+            for st in s.orelse:
+                self._stmt(st)
+            self._edge(self.cur, after.idx)
+        else:
+            self._edge(header, after.idx)
+        self.loops.append((header.idx, after.idx))
+        self._goto(body)
+        tgt: List[Event] = []
+        _target_events(s.target, tgt)
+        self.cur.events.extend(tgt)
+        for st in s.body:
+            self._stmt(st)
+        self._edge(self.cur, header.idx)
+        self.loops.pop()
+        self._goto(after)
+
+    _stmt_AsyncFor = _stmt_For
+
+    def _stmt_With(self, s) -> None:
+        evs: List[Event] = []
+        tgt: List[Event] = []
+        for item in s.items:
+            _expr_events(item.context_expr, evs)
+            if item.optional_vars is not None:
+                _target_events(item.optional_vars, tgt)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets + tgt)
+        for st in s.body:
+            self._stmt(st)
+
+    _stmt_AsyncWith = _stmt_With
+
+    def _stmt_Try(self, s: ast.Try) -> None:
+        after = self._block()
+        fin_entry = self._block() if s.finalbody else None
+        handler_entries = [self._block() for _ in s.handlers]
+        targets = [b.idx for b in handler_entries]
+        if fin_entry is not None:
+            # an exception matching NO handler still runs finally
+            targets.append(fin_entry.idx)
+        self.excs.append(targets)
+        if fin_entry is not None:
+            self.finallys.append(fin_entry.idx)
+        for st in s.body:
+            self._stmt(st)
+        self.excs.pop()
+        for st in s.orelse:       # runs unprotected by THIS try
+            self._stmt(st)
+        end_normal = self.cur
+        handler_ends: List[Block] = []
+        for h, entry in zip(s.handlers, handler_entries):
+            self._goto(entry)
+            if h.type is not None:
+                evs: List[Event] = []
+                _expr_events(h.type, evs)
+                entry.events.extend(evs)
+            if h.name:
+                entry.events.append(Event(EXCDEF, h.name, h))
+            for st in h.body:
+                self._stmt(st)
+            handler_ends.append(self.cur)
+        if fin_entry is not None:
+            self.finallys.pop()
+            self._edge(end_normal, fin_entry.idx)
+            for he in handler_ends:
+                self._edge(he, fin_entry.idx)
+            self._goto(fin_entry)
+            for st in s.finalbody:
+                self._stmt(st)
+            fin_end = self.cur
+            self._edge(fin_end, after.idx)
+            # the exception-propagating copy of finally: conservative
+            # single block with an extra edge onward to the outer scope
+            outer = self.excs[-1] if self.excs else [self.cfg.raise_idx]
+            for t in outer:
+                self._edge(fin_end, t)
+            # the return-continuation copy: a return routed through
+            # this finally continues to the NEXT enclosing finally, or
+            # to exit
+            self._edge(fin_end, self.finallys[-1]
+                       if self.finallys else self.cfg.exit_idx)
+        else:
+            self._edge(end_normal, after.idx)
+            for he in handler_ends:
+                self._edge(he, after.idx)
+        self._goto(after)
+
+    def _stmt_TryStar(self, s) -> None:  # pragma: no cover - 3.11+
+        self._stmt_Try(s)
+
+    def _stmt_Match(self, s) -> None:
+        evs: List[Event] = []
+        _expr_events(s.subject, evs)
+        expr, rets = _split_phases(evs)
+        self._emit(expr, rets)
+        branch = self.cur
+        after = self._block()
+        for case in s.cases:
+            entry = self._block()
+            self._edge(branch, entry.idx)
+            self._goto(entry)
+            for sub in ast.walk(case.pattern):
+                name = getattr(sub, "name", None)
+                if isinstance(name, str):
+                    entry.events.append(Event(ASSIGN, name, case.pattern))
+            if case.guard is not None:
+                gevs: List[Event] = []
+                _expr_events(case.guard, gevs)
+                g_expr, g_rets = _split_phases(gevs)
+                self._emit(g_expr, g_rets)
+            for st in case.body:
+                self._stmt(st)
+            self._edge(self.cur, after.idx)
+        self._edge(branch, after.idx)       # no case matched
+        self._goto(after)
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef (nested defs are NOT
+    inlined — each scope runs on its own schedule)."""
+    return _Builder(fn).build()
+
+
+# -- forward dataflow -----------------------------------------------------
+
+State = Dict[str, frozenset]
+
+
+def _join(into: State, frm: State) -> bool:
+    changed = False
+    for k, v in frm.items():
+        old = into.get(k)
+        if old is None:
+            into[k] = v
+            changed = True
+        elif not (v <= old):
+            into[k] = old | v
+            changed = True
+    return changed
+
+
+def run_forward(cfg: CFG, transfer, init: Optional[State] = None
+                ) -> Dict[int, State]:
+    """Worklist forward analysis.  ``transfer(state, event, block_idx)``
+    mutates ``state`` (a dict binding-text -> frozenset of facts) for
+    one event; join is per-binding union.  Returns the state AT ENTRY of
+    every reachable block (exit blocks included)."""
+    states_in: Dict[int, State] = {cfg.entry: dict(init or {})}
+    work = [cfg.entry]
+    visits: Dict[int, int] = {}
+    limit = 4 * (len(cfg.blocks) + 4)
+    while work:
+        idx = work.pop()
+        visits[idx] = visits.get(idx, 0) + 1
+        if visits[idx] > limit:     # safety valve; union join converges
+            continue                # long before this in practice
+        block = cfg.blocks[idx]
+        state: State = dict(states_in.get(idx, {}))
+        for ev in block.events:
+            transfer(state, ev, idx)
+        for succ in block.succ:
+            into = states_in.setdefault(succ, {})
+            if _join(into, state) or visits.get(succ, 0) == 0:
+                if succ not in work:
+                    work.append(succ)
+    return states_in
+
+
+# -- per-module model -----------------------------------------------------
+
+class FuncInfo:
+    __slots__ = ("cls", "node", "qualname", "_cfg")
+
+    def __init__(self, cls: Optional[str], node: ast.AST):
+        self.cls = cls
+        self.node = node
+        self.qualname = f"{cls}.{node.name}" if cls else node.name
+        self._cfg: Optional[CFG] = None
+
+    @property
+    def cfg(self) -> CFG:
+        if self._cfg is None:
+            self._cfg = build_cfg(self.node)
+        return self._cfg
+
+
+class ModuleModel:
+    """Shared per-file index for the dataflow rules (cached on the
+    SourceFile, same contract as the lock rules' _FileModel)."""
+
+    def __init__(self, src):
+        self.src = src
+        tree = src.tree
+        self.jit_names = self._jit_aliases(tree)
+        self.import_map: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.import_map[a.asname or a.name] = node.module
+        self.module_funcs: Set[str] = {
+            n.name for n in tree.body if isinstance(n, ast.FunctionDef)}
+        self.functions: List[FuncInfo] = []
+        self.by_key: Dict[Tuple[str, str], FuncInfo] = {}
+        stack: List[Tuple[Optional[str], ast.AST]] = [(None, tree)]
+        while stack:
+            cls, node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child.name, child))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    info = FuncInfo(cls, child)
+                    self.functions.append(info)
+                    self.by_key.setdefault(
+                        (src.relpath, info.qualname), info)
+                    stack.append((cls, child))
+
+    @staticmethod
+    def _jit_aliases(tree: ast.Module) -> Set[str]:
+        names = {"jax.jit"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "jit":
+                        names.add(a.asname or a.name)
+        return names
+
+    def resolve_callee(self, call: ast.Call,
+                       cls: Optional[str]) -> Optional[Tuple[str, str]]:
+        """(relpath, qualname) for self-method / same-module / imported
+        callees — identical contract to rules_locks."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and cls is not None:
+            return (self.src.relpath, f"{cls}.{f.attr}")
+        if isinstance(f, ast.Name):
+            if f.id in self.module_funcs:
+                return (self.src.relpath, f.id)
+            mod = self.import_map.get(f.id)
+            if mod:
+                return (mod.replace(".", "/") + ".py", f.id)
+        return None
+
+
+def module_model(src) -> Optional[ModuleModel]:
+    """The cached ModuleModel for a parsed SourceFile (None when the
+    file failed to parse)."""
+    if src.tree is None:
+        return None
+    model = getattr(src, "_jaxlint_dataflow_model", None)
+    if model is None:
+        model = ModuleModel(src)
+        src._jaxlint_dataflow_model = model
+    return model

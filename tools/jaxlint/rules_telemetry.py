@@ -170,6 +170,193 @@ class TelemetryRule(Rule):
                     "the shared metric to one module both import"))
 
 
+#: label kwargs on these metric-sample calls are the cardinality
+#: surface: every distinct label value is a new time series
+_SAMPLE_ATTRS = ("inc", "set", "observe")
+
+#: receiver roots that mean "raw request data" — feeding a field of an
+#: arbitrary caller payload into a label is unbounded by construction
+_REQUESTY_ROOTS = {"payload", "request", "req", "body", "headers",
+                   "query"}
+
+
+def _collect_fn_env(fn: ast.AST):
+    """(defs, exc_names): flow-insensitive name->value-exprs map and
+    the names bound by ``except E as name`` inside ``fn`` — the def-use
+    chains the cardinality classifier walks."""
+    from tools.jaxlint.core import walk_shallow
+    defs: Dict[str, List[ast.AST]] = {}
+    exc_names = set()
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name) and \
+                            isinstance(leaf.ctx, ast.Store):
+                        defs.setdefault(leaf.id, []).append(node.value)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None and \
+                    isinstance(node.target, ast.Name):
+                defs.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            exc_names.add(node.name)
+    return defs, exc_names
+
+
+def _unbounded_label(expr: ast.AST, defs, exc_names,
+                     _depth: int = 0) -> str:
+    """Why ``expr`` is an unbounded label source ('' when it is not).
+    Under-approximates: parameters and unrecognized shapes are accepted
+    (bounded-unless-proven-otherwise keeps every finding real)."""
+    if _depth > 6:
+        return ""
+
+    def rec(e: ast.AST) -> str:
+        return _unbounded_label(e, defs, exc_names, _depth + 1)
+
+    if isinstance(expr, ast.Constant):
+        return ""
+    if isinstance(expr, ast.Name):
+        if expr.id in exc_names:
+            return (f"{expr.id!r} is an exception object (bound by "
+                    "'except ... as') — its text is unbounded")
+        for d in defs.get(expr.id, ()):
+            why = rec(d)
+            if why:
+                return why
+        return ""
+    if isinstance(expr, ast.Attribute):
+        if expr.attr == "__name__":
+            return ""               # type(x).__name__ is a bounded set
+        root = expr
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in _REQUESTY_ROOTS:
+            return (f"field of raw request data ({root.id!r}) — "
+                    "caller-controlled values are unbounded")
+        return ""
+    if isinstance(expr, ast.Subscript):
+        root = expr.value
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in _REQUESTY_ROOTS:
+            return (f"field of raw request data ({root.id!r}) — "
+                    "caller-controlled values are unbounded")
+        return rec(expr.value)
+    if isinstance(expr, ast.JoinedStr):
+        for v in expr.values:
+            if isinstance(v, ast.FormattedValue):
+                why = rec(v.value)
+                if why:
+                    return why
+        return ""
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mod):
+        return rec(expr.left) or rec(expr.right)
+    if isinstance(expr, (ast.IfExp,)):
+        return rec(expr.body) or rec(expr.orelse)
+    if isinstance(expr, ast.BoolOp):
+        for v in expr.values:
+            why = rec(v)
+            if why:
+                return why
+        return ""
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        fname = f.id if isinstance(f, ast.Name) else ""
+        if fname in ("str", "repr", "format") and expr.args:
+            return rec(expr.args[0])
+        if fname == "hash":
+            return ("hash() output — every distinct input mints a new "
+                    "label value")
+        dname = ""
+        node = f
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            dname = ".".join(reversed(parts))
+        if dname.startswith("hashlib."):
+            return ("hashlib digest — every distinct input mints a "
+                    "new label value")
+        if isinstance(f, ast.Attribute) and f.attr in ("get",):
+            root = f.value
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name) and \
+                    root.id in _REQUESTY_ROOTS:
+                return (f"field of raw request data ({root.id!r}) — "
+                        "caller-controlled values are unbounded")
+        if isinstance(f, ast.Attribute) and f.attr == "format":
+            for a in list(expr.args) + \
+                    [kw.value for kw in expr.keywords]:
+                why = rec(a)
+                if why:
+                    return why
+        return ""
+    return ""
+
+
+@register_rule
+class MetricCardinalityRule(Rule):
+    """Label values on ``.inc/.set/.observe`` (and ``observe_exemplar``
+    label kwargs) traced back through the function's def-use chains to
+    an unbounded source: exception text, raw request fields, hash
+    output.  Each distinct label value is a whole new time series, so
+    an unbounded source is a slow-motion OOM of every scraper."""
+
+    id = "metric-cardinality"
+    summary = ("metric label value fed from an unbounded source "
+               "(exception text, raw request field, hash output)")
+
+    def __init__(self):
+        self.n_label_sites = 0
+
+    def collect_stats(self) -> Dict[str, int]:
+        return {"metric_label_sites": self.n_label_sites}
+
+    def visit(self, src, report) -> None:
+        from tools.jaxlint.core import iter_functions
+        if src.tree is None:
+            return
+        for _cls, fn in iter_functions(src.tree):
+            env = None
+            from tools.jaxlint.core import walk_shallow
+            for node in walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                is_sample = isinstance(f, ast.Attribute) and \
+                    f.attr in _SAMPLE_ATTRS and node.keywords
+                fname = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                is_exemplar = fname == "observe_exemplar"
+                if not (is_sample or is_exemplar):
+                    continue
+                label_kwargs = [
+                    kw for kw in node.keywords
+                    if kw.arg is not None and
+                    not (is_exemplar and kw.arg == "trace_id")]
+                if not label_kwargs:
+                    continue
+                if env is None:
+                    env = _collect_fn_env(fn)
+                defs, exc_names = env
+                self.n_label_sites += len(label_kwargs)
+                for kw in label_kwargs:
+                    why = _unbounded_label(kw.value, defs, exc_names)
+                    if why:
+                        report(Finding(
+                            self.id, src.relpath, node.lineno,
+                            node.col_offset,
+                            f"label {kw.arg!r} is fed from an "
+                            f"unbounded source: {why}; every distinct "
+                            "value is a new time series — bucket it "
+                            "(type(e).__name__, a status class, a "
+                            "bounded enum) before labeling"))
+
+
 #: span names are dot.separated lowercase segments — Chrome trace and
 #: OTLP group on them, and a stray CamelCase or space-bearing name
 #: fragments the grouping.  Single-segment legacy names ("step",
