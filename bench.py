@@ -243,6 +243,75 @@ class StreamingImageSource:
         return DataSet(x.astype(np.float32), y)
 
 
+#: step-time decomposition series (see telemetry.instrument
+#: StepPhaseMetrics) reported by --mesh and --streaming
+_STEP_PHASE_SERIES = {
+    "data_wait": "dl4j_tpu_step_data_wait_seconds",
+    "h2d": "dl4j_tpu_step_h2d_seconds",
+    "compute": "dl4j_tpu_step_compute_seconds",
+    "checkpoint": "dl4j_tpu_step_checkpoint_seconds",
+    "barrier": "dl4j_tpu_step_barrier_seconds",
+}
+
+
+def _phase_snapshot() -> dict:
+    """Cumulative bucket counts/sum/count of every step-phase histogram
+    — taken before a measured window so the decomposition reports the
+    window's delta, not the process's lifetime."""
+    from deeplearning4j_tpu.telemetry import get_registry
+    reg = get_registry()
+    snap = {}
+    for phase, name in _STEP_PHASE_SERIES.items():
+        h = reg.get(name)
+        if h is None:
+            snap[phase] = {"counts": {}, "sum": 0.0, "count": 0}
+        else:
+            snap[phase] = {"counts": dict(h.bucketCounts()),
+                           "sum": float(h.sum()), "count": int(h.count())}
+    return snap
+
+
+def _phase_decomposition(before: dict) -> dict:
+    """Step-time decomposition over the window since ``before`` (a
+    :func:`_phase_snapshot`): per-phase p50/p99 in ms (upper-bound
+    bucket attribution — the same convention as
+    ``remote.serving.histogram_quantile``) plus each phase's share of
+    the summed phase time.  Phases unobserved in the window report null
+    quantiles and share 0."""
+    import math
+    after = _phase_snapshot()
+    empty = {"counts": {}, "sum": 0.0, "count": 0}
+    deltas = {}
+    for phase in _STEP_PHASE_SERIES:
+        b = before.get(phase) or empty
+        a = after[phase]
+        dcounts = {bound: cum - b["counts"].get(bound, 0)
+                   for bound, cum in a["counts"].items()}
+        deltas[phase] = (dcounts, a["sum"] - b["sum"],
+                         a["count"] - b["count"])
+    totalSum = sum(max(d[1], 0.0) for d in deltas.values())
+    out = {}
+    for phase, (dcounts, dsum, dcount) in deltas.items():
+        if dcount <= 0:
+            out[phase] = {"p50_ms": None, "p99_ms": None, "share": 0.0}
+            continue
+
+        def _q(q, dcounts=dcounts, dcount=dcount):
+            rank = q * dcount
+            prev = 0.0
+            for bound, cum in dcounts.items():
+                if cum >= rank:
+                    return bound if not math.isinf(bound) else prev
+                prev = bound
+            return prev
+
+        out[phase] = {
+            "p50_ms": round(_q(0.5) * 1e3, 3),
+            "p99_ms": round(_q(0.99) * 1e3, 3),
+            "share": round(dsum / totalSum, 4) if totalSum > 0 else 0.0}
+    return out
+
+
 def bench_streaming(workers: int = 4, batch: int = 64, img: int = 96,
                     batches: int = 24) -> dict:
     """Streaming-ETL benchmark (ROADMAP item 2 / ISSUE 6 acceptance):
@@ -306,11 +375,16 @@ def bench_streaming(workers: int = 4, batch: int = 64, img: int = 96,
     secs0 = h0.sum() if h0 is not None else 0.0
     pit = PrefetchingDataSetIterator(src, numWorkers=workers,
                                      queueDepth=max(4, workers + 2))
+    from deeplearning4j_tpu.telemetry import etl_fetch
+    phases0 = _phase_snapshot()
     try:
         t0 = time.perf_counter()
         n_pipe = 0
         while pit.hasNext():
-            ds = pit.next()                 # already staged on device
+            # etl_fetch is the instrumented fetch seam every training
+            # loop drains through — the bench pays the same data_wait
+            # accounting the supervised loop reports
+            ds = etl_fetch(pit)             # already staged on device
             float(consume(ds.features.jax))
             n_pipe += int(ds.features.shape[0])
         pipe_s = time.perf_counter() - t0
@@ -339,6 +413,7 @@ def bench_streaming(workers: int = 4, batch: int = 64, img: int = 96,
         "h2d_mb_s": round(h2d_bytes / max(h2d_secs, 1e-9) / 1e6, 1),
         "h2d_wall_mb_s": round(h2d_bytes / pipe_s / 1e6, 1),
         "h2d_bytes": int(h2d_bytes),
+        "step_phases": _phase_decomposition(phases0),
         "workers": workers,
         "batch": batch,
         "image": img,
@@ -443,6 +518,7 @@ def bench_mesh(steps: int = 12, batch: int = 64, width: int = 512,
         pw.fitDataSet(pool[1])      # warm both staged batches
         net.score()
         m0 = misses()
+        phases0 = _phase_snapshot()
         t0 = time.perf_counter()
         for i in range(steps):
             pw.fitDataSet(pool[i % len(pool)])
@@ -460,6 +536,7 @@ def bench_mesh(steps: int = 12, batch: int = 64, width: int = 512,
             "mfu": round(ips * flops_per_image
                          / (_V5E_PEAK_FLOPS * n_dev), 6),
             "jit_cache_misses_steady": int(misses() - m0),
+            "step_phases": _phase_decomposition(phases0),
         })
 
     best = max(results, key=lambda r: r["images_per_sec"])
@@ -474,6 +551,7 @@ def bench_mesh(steps: int = 12, batch: int = 64, width: int = 512,
         "depth": depth,
         "steps": steps,
         "cpu_proxy": jax.default_backend() == "cpu",
+        "step_phases": best["step_phases"],
         "configs": results,
     }
 

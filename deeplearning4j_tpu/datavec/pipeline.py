@@ -321,6 +321,9 @@ class _StagedBatch:
                     pass
         wait = time.perf_counter() - t0
         etl_metrics().h2d_seconds().observe(self.issueSeconds + wait)
+        from deeplearning4j_tpu.telemetry.instrument import \
+            observe_step_phase
+        observe_step_phase("h2d", self.issueSeconds + wait)
         tracer().record_complete(
             "h2d_stage", self.issuedAt, self.issueSeconds + wait,
             # jaxlint: disable=host-sync -- nbytes is a Python int, not a device scalar
@@ -585,6 +588,9 @@ class PrefetchingDataSetIterator(DataSetIterator):
         self._epoch -= 1    # same ShardSpec epoch: identical stream order
         self._start()
         etl_metrics().pool_restarts().inc()
+        from deeplearning4j_tpu.telemetry.runlog import record_event
+        record_event("etl.restart", delivered=self._delivered,
+                     epoch=max(self._epoch, 0))
 
     def close(self) -> None:
         """Full teardown: pool + shared-memory slots.  Idempotent.

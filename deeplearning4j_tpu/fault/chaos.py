@@ -57,7 +57,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.fault import injection as _inj
-from deeplearning4j_tpu.telemetry import coord_metrics, get_registry
+from deeplearning4j_tpu.telemetry import (coord_metrics, flight_recorder,
+                                          get_registry)
 
 __all__ = ["ChaosSoak", "build_schedule", "EVENT_KINDS",
            "ServingChaosSoak", "build_serving_schedule",
@@ -584,12 +585,13 @@ class ChaosSoak:
                         continue
             drainThread.start()
             self._settle(coord)
-            report["invariants"] = self._checkInvariants(
-                sup, net, pw, coord, refParams, refLoss, x, y,
-                TelemetryAggregator, counter, schedule)
-            report["generation"] = coord.generation
             report["leader_failovers"] = counter(
                 "dl4j_tpu_coord_leader_failovers_total") - failovers0
+            report["invariants"] = self._checkInvariants(
+                sup, net, pw, coord, refParams, refLoss, x, y,
+                TelemetryAggregator, counter, schedule,
+                failovers=report["leader_failovers"])
+            report["generation"] = coord.generation
             report["peer_errors"] = leader.errors + follower.errors
             report["fired"] = list(firedLog)
             report["ok"] = bool(all(report["invariants"].values())
@@ -641,7 +643,7 @@ class ChaosSoak:
 
     def _checkInvariants(self, sup, net, pw, coord, refParams, refLoss,
                          x, y, TelemetryAggregator, counter,
-                         schedule) -> Dict[str, bool]:
+                         schedule, failovers: int = 0) -> Dict[str, bool]:
         from deeplearning4j_tpu.datasets import DataSet
         inv: Dict[str, bool] = {}
         # 1. exactly one sealed checkpoint lineage
@@ -682,6 +684,68 @@ class ChaosSoak:
                                   y[:self.batchSize]))
         inv["flat_jit_misses"] = counter(
             "dl4j_tpu_mesh_jit_cache_misses_total") == miss0
+        # 5. ONE causally ordered pod timeline: every host's NDJSON file
+        # merges in HLC order — per-host stamps strictly increase, every
+        # adopt sorts after the propose that caused it (the cross-host
+        # edge the leader's plan stamp creates), and the trainer plus at
+        # least one phantom peer contributed (a single-host "merge"
+        # would prove nothing)
+        timeline = TelemetryAggregator(self.runDir).timeline()
+        keys = [tuple(e.get("hlc") or (0, 0)) + (e.get("host"),)
+                for e in timeline]
+        perHost: Dict[str, list] = {}
+        for e in timeline:
+            perHost.setdefault(str(e.get("host")), []).append(
+                tuple(e.get("hlc") or (0, 0)))
+        proposeAt: Dict[int, int] = {}
+        causal = True
+        for i, e in enumerate(timeline):
+            gen = e.get("generation")
+            if e.get("kind") == "coord.propose":
+                proposeAt.setdefault(gen, i)
+            elif e.get("kind") == "coord.adopt":
+                if gen not in proposeAt or proposeAt[gen] >= i:
+                    causal = False
+        inv["timeline_merged_causal"] = bool(
+            timeline and keys == sorted(keys) and causal
+            and len(perHost) >= 2
+            and all(all(a < b for a, b in zip(v, v[1:]))
+                    for v in perHost.values()))
+        # 6. generations are monotonic per host along the merged order
+        genSeq: Dict[str, list] = {}
+        for e in timeline:
+            if e.get("kind") == "coord.adopt":
+                genSeq.setdefault(str(e.get("host")), []).append(
+                    int(e.get("generation", 0)))
+        inv["timeline_generations_monotonic"] = all(
+            all(a <= b for a, b in zip(v, v[1:]))
+            for v in genSeq.values())
+        # 7. the timeline COVERS what actually happened: a counted
+        # leader failover and any shrink re-mesh must appear as events
+        kinds = {e.get("kind") for e in timeline}
+        expected = set()
+        if failovers > 0:
+            expected.add("coord.leader_failover")
+        if any(r.get("direction") == "shrink"
+               for r in sup.stats.get("remeshes", ())):
+            expected.add("elastic.shrink")
+        inv["timeline_covers_events"] = expected <= kinds
+        # 8. every rollback's surrounding timeline window landed in the
+        # FlightRecorder ring (vacuously true when the seed produced
+        # no divergence)
+        rollbacks = [e for e in timeline
+                     if e.get("kind") == "ckpt.rollback"]
+        windows = [r for r in flight_recorder().snapshot()
+                   if r.get("event") == "timeline_window"]
+
+        def _covered(rb):
+            return any(any(ev.get("hlc") == rb.get("hlc")
+                           and ev.get("host") == rb.get("host")
+                           for ev in w.get("events", ()))
+                       for w in windows)
+
+        inv["timeline_rollback_windows"] = all(
+            _covered(rb) for rb in rollbacks)
         # event-conditional checks
         if any(e["kind"] == "torn_snapshot" for e in schedule):
             agg = TelemetryAggregator(self.runDir,

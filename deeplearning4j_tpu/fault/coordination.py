@@ -65,6 +65,10 @@ from typing import Dict, List, Optional, Sequence
 
 from deeplearning4j_tpu.fault import injection as _inj
 from deeplearning4j_tpu.telemetry import coord_metrics, tracer
+from deeplearning4j_tpu.telemetry.instrument import observe_step_phase
+from deeplearning4j_tpu.telemetry.runlog import (FleetTimeline,
+                                                 current_run_id,
+                                                 run_span_attrs)
 
 __all__ = ["PodCoordinator", "HeartbeatLease", "GenerationFence",
            "ReadmissionPolicy", "CoordinationError", "PodEvictedError",
@@ -423,6 +427,10 @@ class PodCoordinator:
         self._deadSeen: set = set()
         self._pendingReadmits: List[str] = []
         self._voteCounts: Dict[str, tuple] = {}
+        # every coordinator writes its OWN per-host timeline file into
+        # the shared run dir; the aggregator merges them (HLC order)
+        # into the pod timeline served at /v1/runs/<runId>/timeline
+        self.timeline = FleetTimeline(self.runDir, hostId=self.hostId)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "PodCoordinator":
@@ -510,6 +518,20 @@ class PodCoordinator:
         return _read_json(self._genPath())
 
     def _publish(self, plan: dict) -> None:
+        # the propose event's own HLC stamp rides in the plan (the
+        # barrier digest covers only the core topology keys, so this is
+        # wire-compatible): every adopter OBSERVES it before recording
+        # its adopt, which therefore sorts strictly after this propose
+        # in the merged fleet timeline regardless of wall-clock skew.
+        # The run id rides along too, so peers that never minted a
+        # RunContext still attribute their events to the pod's run.
+        ev = self.timeline.record("coord.propose",
+                                  generation=int(plan["generation"]),
+                                  participants=list(plan["participants"]),
+                                  reason=plan.get("reason"))
+        plan["hlc"] = ev["hlc"]
+        if not plan.get("runId"):
+            plan["runId"] = current_run_id() or self.timeline.runId
         _atomic_write_json(self._genPath(), plan)
         log.warning("coord[%s]: published generation %s: devices=%s "
                     "participants=%s (%s)", self.hostId,
@@ -532,6 +554,14 @@ class PodCoordinator:
         self.lease.generation = self.generation
         self.lease.write_now()
         coord_metrics().generation().set(self.generation)
+        # merge the publisher's clock BEFORE recording the adopt, so the
+        # adopt sorts after the propose that caused it in the merged
+        # pod timeline; inherit the run id the leader stamped
+        self.timeline.observe(plan.get("hlc"))
+        if self.timeline.runId is None and plan.get("runId"):
+            self.timeline.runId = str(plan["runId"])
+        self.timeline.record("coord.adopt", generation=self.generation,
+                             participants=list(self.participants))
         self._gcCoordDir(now)
 
     # -- establish --------------------------------------------------------
@@ -658,10 +688,18 @@ class PodCoordinator:
         self._pendingReadmits = list(readmitted)
         reason = ("readmitted " + ",".join(readmitted)) if readmitted \
             else "topology change"
-        if evicted - set(self.evictedDeviceIds):
+        newEvicted = sorted(evicted - set(self.evictedDeviceIds))
+        if newEvicted:
             reason = ("straggler eviction by quorum: devices "
-                      f"{sorted(evicted - set(self.evictedDeviceIds))}"
+                      f"{newEvicted}"
                       + ("; " + reason if readmitted else ""))
+            self.timeline.record("coord.evict",
+                                 generation=self.generation + 1,
+                                 devices=newEvicted)
+        if readmitted:
+            self.timeline.record("coord.readmit",
+                                 generation=self.generation + 1,
+                                 hosts=list(readmitted))
         return {"generation": self.generation + 1,
                 "participants": candidates, "deviceIds": devices,
                 "evictedDeviceIds": sorted(evicted),
@@ -861,8 +899,11 @@ class PodCoordinator:
         # checks every iteration — its single pass must see the state.
         nextLiveness = 0.0
         try:
+            runAttrs = run_span_attrs()
+            runAttrs.pop("generation", None)    # the plan's gen wins
             with tracer().span("coord_barrier", generation=gen,
-                               participants=len(participants)):
+                               participants=len(participants),
+                               **runAttrs):
                 while True:
                     # two leaders racing at the lease-timeout edge can
                     # both publish under the same generation number; the
@@ -908,8 +949,12 @@ class PodCoordinator:
                             f"for {missing}")
                     time.sleep(self.barrierPoll)
         finally:
-            coord_metrics().barrier_seconds().observe(
-                time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            coord_metrics().barrier_seconds().observe(dt)
+            observe_step_phase("barrier", dt)
+            self.timeline.record("coord.barrier", generation=gen,
+                                 seconds=round(dt, 6),
+                                 participants=len(participants))
 
     def _maybeAdoptOrphan(self, published: dict, digest: str,
                           deadMissing: List[str], live: set,
@@ -953,6 +998,9 @@ class PodCoordinator:
         takeover["ts"] = time.time()
         self._publish(takeover)
         coord_metrics().leader_failovers().inc()
+        self.timeline.record("coord.leader_failover",
+                             generation=int(takeover.get("generation", 0)),
+                             failed=proposer)
         # inherit the dead leader's readmission bookkeeping: a
         # participant of the orphan that we did not count as one was
         # READMITTED by the plan we just adopted as ours — the proposer

@@ -63,6 +63,8 @@ from deeplearning4j_tpu.telemetry import (coord_metrics, elastic_metrics,
                                           flight_recorder, get_registry,
                                           record_crash, replica_step_gauge,
                                           tracer)
+from deeplearning4j_tpu.telemetry.runlog import (current_run, record_event,
+                                                 run_span_attrs)
 
 __all__ = ["ElasticSupervisor", "ElasticCapacityError",
            "DeviceHealthProbe", "is_device_loss_error"]
@@ -400,7 +402,8 @@ class ElasticSupervisor(FaultTolerantTrainer):
         t0 = time.perf_counter()
         with tracer().span("elastic_remesh", direction=direction,
                            from_devices=old.numDevices(),
-                           to_devices=newMesh.numDevices()):
+                           to_devices=newMesh.numDevices(),
+                           **run_span_attrs()):
             wr.remesh(newMesh, reshard=reshard)
             self._realignIterator()
         dt = time.perf_counter() - t0
@@ -416,6 +419,18 @@ class ElasticSupervisor(FaultTolerantTrainer):
                  "seconds": round(dt, 6)}
         self.stats["remeshes"].append(entry)
         flight_recorder().record(event="remesh", **entry)
+        # a remesh IS a mesh-generation transition: standalone (no
+        # coordinator) runs advance the run's generation here; pod runs
+        # get it from the adopted plan in _coordPoll instead
+        rc = current_run()
+        if rc is not None and getattr(self, "coordinator", None) is None:
+            rc.generation += 1
+        if direction == "shrink":
+            record_event("elastic.shrink", step=entry["iteration"], **entry)
+        elif direction == "grow":
+            record_event("elastic.grow", step=entry["iteration"], **entry)
+        else:
+            record_event("elastic.remesh", step=entry["iteration"], **entry)
         self._note("remesh", **entry)
         log.warning("elastic re-mesh (%s): %d -> %d devices at iteration "
                     "%d (%s)", direction, old.numDevices(),
@@ -516,6 +531,13 @@ class ElasticSupervisor(FaultTolerantTrainer):
         """Checkpoint-boundary consensus hook: adopt a newly agreed
         generation (barrier included) and re-mesh onto it."""
         plan = self.coordinator.poll()
+        # keep the run context's generation live: spans, timeline events
+        # and step-phase exemplars recorded after this boundary must be
+        # attributed to the generation the pod just agreed on
+        rc = current_run()
+        if rc is not None:
+            # jaxlint: sync-ok -- coordinator generation is a host-side Python counter
+            rc.generation = int(self.coordinator.generation)
         if plan is not None:
             self._adoptPlan(plan)
 

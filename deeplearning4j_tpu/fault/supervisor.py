@@ -38,6 +38,7 @@ the plan-to-plan reshard path (ROADMAP item 4).
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import math
 import time
@@ -50,6 +51,13 @@ from deeplearning4j_tpu.telemetry import (DEFAULT_BUCKETS, etl_fetch,
                                           microbatch_scope, record_crash,
                                           record_logical_step,
                                           supervised_scope, tracer)
+from deeplearning4j_tpu.telemetry.instrument import observe_step_phase
+from deeplearning4j_tpu.telemetry.runlog import (FleetTimeline, RunContext,
+                                                 current_run,
+                                                 fleet_timeline,
+                                                 record_event, run_scope,
+                                                 run_span_attrs,
+                                                 set_fleet_timeline)
 from deeplearning4j_tpu.utils.sharded_checkpoint import ShardedCheckpointer
 
 __all__ = ["FaultTolerantTrainer", "TrainingDivergedError", "is_oom_error"]
@@ -186,12 +194,18 @@ class FaultTolerantTrainer:
         sync = getattr(self.wrapper, "syncToNet", None)
         if sync is not None:
             sync()
-        with tracer().span("checkpoint", step=self.net.iterationCount):
+        t0 = time.perf_counter()
+        with tracer().span("checkpoint", step=self.net.iterationCount,
+                           **run_span_attrs()):
             step = self.ckpt.saveWithManifest(
                 self.net, metadata={"stepInEpoch": int(stepInEpoch),
                                     "epoch": int(self.net.epochCount),
                                     "lrScale": self._lrScale()},
                 block=not self.asyncSeal)
+        dt = time.perf_counter() - t0
+        observe_step_phase("checkpoint", dt, step=int(step))
+        record_event("ckpt.save", step=int(step), seconds=round(dt, 6),
+                     sealed=not self.asyncSeal)
         self.stats["checkpoints"] += 1
         self._maybeRestoreCadence()
         get_registry().counter(
@@ -220,7 +234,8 @@ class FaultTolerantTrainer:
     def _timedRestore(self, step: int) -> None:
         reg = get_registry()
         t0 = time.perf_counter()
-        with tracer().span("checkpoint_restore", step=step):
+        with tracer().span("checkpoint_restore", step=step,
+                           **run_span_attrs()):
             self.ckpt.restore(self.net, step=step,
                               shardings=self._restoreShardings())
             # mesh-trainer hook: restored arrays land on one device —
@@ -229,15 +244,55 @@ class FaultTolerantTrainer:
             place = getattr(self.wrapper, "placeAfterRestore", None)
             if place is not None:
                 place()
+        dt = time.perf_counter() - t0
         reg.histogram("dl4j_tpu_fault_restore_seconds",
                       "Checkpoint restore latency",
-                      buckets=DEFAULT_BUCKETS).observe(
-                          time.perf_counter() - t0)
+                      buckets=DEFAULT_BUCKETS).observe(dt)
         reg.counter("dl4j_tpu_fault_checkpoint_restores_total",
                     "Checkpoint restores (rollback + resume)").inc()
+        record_event("ckpt.restore", step=int(step), seconds=round(dt, 6))
 
     # -- the supervised loop --------------------------------------------
+    @contextlib.contextmanager
+    def _timelineScope(self):
+        """Install the process-global fleet timeline for the run's
+        duration: reuse the coordinator's per-host timeline when one
+        exists (the elastic/coordinated path — its events and ours must
+        land in the SAME per-host NDJSON file), else write into the
+        federation run dir when configured.  Unconfigured, recording
+        stays a no-op and the hot loop pays nothing."""
+        tl = fleet_timeline()
+        if tl is None:
+            coord = getattr(self, "coordinator", None)
+            if coord is not None:
+                tl = coord.timeline
+            else:
+                from deeplearning4j_tpu.telemetry.federation import \
+                    get_federation_dir
+                runDir = get_federation_dir()
+                if runDir:
+                    tl = FleetTimeline(runDir)
+        if tl is None:
+            yield
+            return
+        prev = set_fleet_timeline(tl)
+        record_event("run.start", step=int(self.net.iterationCount))
+        try:
+            yield
+        finally:
+            record_event("run.end", step=int(self.net.iterationCount))
+            set_fleet_timeline(prev)
+
     def fit(self, iterator, epochs: int = 1) -> None:
+        # one RunContext per training run: every span/timeline event/
+        # exemplar below carries its trace id + live mesh generation, so
+        # the whole run — across restore, rollback and remesh — is ONE
+        # trace on the OTLP side and ONE timeline under /v1/runs/<id>
+        rc = current_run() or RunContext.new()
+        with run_scope(rc), self._timelineScope():
+            self._fitRun(iterator, epochs)
+
+    def _fitRun(self, iterator, epochs: int = 1) -> None:
         if self.durableExport:
             from deeplearning4j_tpu.telemetry import install_export_handlers
             install_export_handlers()
@@ -480,6 +535,16 @@ class FaultTolerantTrainer:
             flight_recorder().record(
                 event="rollback", reason=diverged,
                 iteration=net.iterationCount, epoch=net.epochCount)
+            record_event("ckpt.rollback", step=int(net.iterationCount),
+                         reason=diverged, attempt=rollbacks)
+            tl = fleet_timeline()
+            if tl is not None:
+                # dump the fleet-timeline window around the rollback into
+                # the flight ring: the divergence dump then carries the
+                # pod context (remesh? barrier? evict?) that surrounded it
+                flight_recorder().record(event="timeline_window",
+                                         around="ckpt.rollback",
+                                         events=tl.recent(16))
             if rollbacks > self.maxRollbacks:
                 reason = (f"still diverging after {self.maxRollbacks} "
                           f"rollbacks ({diverged})")
@@ -491,7 +556,7 @@ class FaultTolerantTrainer:
                        iteration=net.iterationCount, epoch=net.epochCount,
                        attempt=rollbacks)
             with tracer().span("recovery", reason=diverged,
-                               rollback=rollbacks):
+                               rollback=rollbacks, **run_span_attrs()):
                 epoch_now = net.epochCount
                 step = self._restoreLastGood()
                 self._note("checkpoint_restore", step=step,

@@ -541,9 +541,22 @@ class MeshTrainer:
         if steps <= 0:
             return
         from deeplearning4j_tpu.telemetry import mesh_metrics
+        from deeplearning4j_tpu.telemetry.instrument import observe_exemplar
+        from deeplearning4j_tpu.telemetry.runlog import current_run
         mm = mesh_metrics()
         mm.steps().inc(steps)
-        mm.step_seconds().observe(seconds / steps)
+        # ensure registration, then observe through the exemplar path so
+        # a p99 mesh-step spike links to one (trace id, generation, step)
+        mm.step_seconds()
+        rc = current_run()
+        observe_exemplar(
+            "dl4j_tpu_mesh_step_seconds", seconds / steps,
+            rc.runId if rc is not None else None,
+            attrs=None if rc is None else {
+                # jaxlint: sync-ok -- run generation is a host-side Python counter
+                "generation": int(rc.generation),
+                # jaxlint: sync-ok -- iterationCount is a host-side Python counter
+                "step": int(self.net.iterationCount)})
         if misses > 0:
             mm.jit_cache_misses().inc(misses)
         cb = mm.collective_bytes()

@@ -44,15 +44,20 @@ from deeplearning4j_tpu.telemetry.health import (  # noqa: F401
     ReplicaStragglerRule, ThresholdRule, TrainingStallRule, default_rules,
     health_summary, recsys_hash_collision_rule)
 from deeplearning4j_tpu.telemetry.instrument import (  # noqa: F401
-    AotCacheMetrics, CoordMetrics, ElasticMetrics, EtlMetrics, MeshMetrics,
-    RecsysMetrics, ReplicaTimingListener, ServingMetrics, aot_metrics,
-    clear_exemplars, coord_metrics, elastic_metrics, etl_fetch, etl_metrics,
-    exemplar_for, in_microbatch, latency_exemplars, mesh_metrics,
-    microbatch_scope, note_etl_wait, observe_exemplar, record_crash,
-    record_logical_step, recsys_metrics, replica_step_gauge, serving_metrics,
+    STEP_PHASES, AotCacheMetrics, CoordMetrics, ElasticMetrics, EtlMetrics,
+    MeshMetrics, RecsysMetrics, ReplicaTimingListener, ServingMetrics,
+    StepPhaseMetrics, aot_metrics, clear_exemplars, coord_metrics,
+    elastic_metrics, etl_fetch, etl_metrics, exemplar_for, in_microbatch,
+    latency_exemplars, mesh_metrics, microbatch_scope, note_etl_wait,
+    observe_exemplar, observe_step_phase, record_crash, record_logical_step,
+    recsys_metrics, replica_step_gauge, serving_metrics, step_phase_metrics,
     supervised_scope, train_step_span)
 from deeplearning4j_tpu.telemetry.otlp import (  # noqa: F401
     OtlpExporter, ensure_otlp_exporter, otlp_exporter, set_otlp_exporter)
+from deeplearning4j_tpu.telemetry.runlog import (  # noqa: F401
+    TIMELINE_EVENT_KINDS, FleetTimeline, HybridLogicalClock, RunContext,
+    current_run, current_run_id, fleet_timeline, merge_timelines,
+    record_event, run_scope, run_span_attrs, set_fleet_timeline)
 from deeplearning4j_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
     get_registry, set_registry)

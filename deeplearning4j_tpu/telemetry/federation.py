@@ -301,6 +301,20 @@ class TelemetryAggregator:
         self.gcFiles: List[str] = []
         self.hosts: List[str] = []
 
+    def timeline(self, run_id: Optional[str] = None,
+                 kinds=None, generation: Optional[int] = None,
+                 step_min: Optional[int] = None,
+                 step_max: Optional[int] = None):
+        """Merge every host's ``timeline_*.ndjson`` in the run dir into
+        ONE causally ordered pod timeline (hybrid-logical-clock order —
+        see :mod:`~deeplearning4j_tpu.telemetry.runlog`).  Serves
+        ``GET /v1/runs/<runId>/timeline``; same torn-file tolerance as
+        the metric-snapshot merge."""
+        from deeplearning4j_tpu.telemetry.runlog import merge_timelines
+        return merge_timelines(self.runDir, run_id=run_id, kinds=kinds,
+                               generation=generation, step_min=step_min,
+                               step_max=step_max)
+
     def _gc_max_age(self) -> Optional[float]:
         if self.gcMaxAge is not None:
             return float(self.gcMaxAge)

@@ -356,12 +356,25 @@ class HealthMonitor:
         except Exception:
             pass
 
+    @staticmethod
+    def _run_tags() -> dict:
+        """The active run's (run id, mesh generation) — stamped on every
+        event-log line and webhook payload so an alert can be JOINED to
+        the remesh/generation that caused it.  Empty when no training
+        run is active (a bare serving process)."""
+        from deeplearning4j_tpu.telemetry.runlog import current_run
+        rc = current_run()
+        if rc is None:
+            return {}
+        return {"run": rc.runId, "generation": int(rc.generation)}
+
     def note(self, event: str, **details) -> None:
         """Structured non-rule event (the supervisor's rollback/restore/
         divergence hooks land here) — same log, ``state: "event"``."""
         from deeplearning4j_tpu.telemetry.federation import host_id
         self._append({"ts": time.time(), "host": host_id(), "rule": event,
-                      "state": "event", "detail": details})
+                      "state": "event", "detail": details,
+                      **self._run_tags()})
 
     # -- evaluation ------------------------------------------------------
     def evaluate_once(self, now: Optional[float] = None) -> Dict[str, str]:
@@ -417,8 +430,14 @@ class HealthMonitor:
     def _transition(self, rule: str, state: str, detail: str) -> None:
         from deeplearning4j_tpu.telemetry.federation import host_id
         record = {"ts": time.time(), "host": host_id(), "rule": rule,
-                  "state": state, "detail": detail}
+                  "state": state, "detail": detail, **self._run_tags()}
         self._append(record)
+        if state == "firing":
+            from deeplearning4j_tpu.telemetry.runlog import record_event
+            record_event("health.firing", rule=rule, detail=detail)
+        elif state == "resolved":
+            from deeplearning4j_tpu.telemetry.runlog import record_event
+            record_event("health.resolved", rule=rule, detail=detail)
         self._reg().counter(
             "dl4j_tpu_health_alert_transitions_total",
             "Watchdog firing/resolved edges",

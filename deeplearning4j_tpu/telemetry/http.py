@@ -2,10 +2,10 @@
 
 ``JsonModelServer``, ``InferenceServer`` and ``UIServer`` expose the
 same observability surfaces — ``/metrics``, ``/metrics/federated``,
-``/metrics/query``, ``/healthz``, ``/v1/requests/<traceId>``.  One
-routing function keeps the status codes, content types, and the
-federation hint text from drifting between hand-maintained handler
-copies.
+``/metrics/query``, ``/healthz``, ``/v1/requests/<traceId>``,
+``/v1/runs/<runId>/timeline``.  One routing function keeps the status
+codes, content types, and the federation hint text from drifting
+between hand-maintained handler copies.
 """
 from __future__ import annotations
 
@@ -33,7 +33,14 @@ def observability_route(path: str) -> Optional[Tuple[int, bytes, str]]:
       queries over the in-process retention ring
       (:mod:`~deeplearning4j_tpu.telemetry.timeseries`);
     - ``/v1/requests/<traceId>`` — one request's lifecycle timeline from
-      the :class:`~deeplearning4j_tpu.telemetry.context.TimelineStore`.
+      the :class:`~deeplearning4j_tpu.telemetry.context.TimelineStore`;
+    - ``/v1/runs/<runId>/timeline`` — one training run's causally
+      ordered cross-host fleet timeline, merged from the per-host NDJSON
+      files in the federation run dir
+      (:meth:`~deeplearning4j_tpu.telemetry.federation.
+      TelemetryAggregator.timeline`); filterable with
+      ``?kind=ckpt.rollback&generation=3&step_min=100&step_max=200``
+      (``kind`` repeatable).
     """
     from deeplearning4j_tpu.telemetry.federation import \
         federated_exposition
@@ -61,6 +68,47 @@ def observability_route(path: str) -> Optional[Tuple[int, bytes, str]]:
                  "trace_id": trace_id}).encode("utf-8"),
                 "application/json")
         return 200, json.dumps(got).encode("utf-8"), "application/json"
+    if path.startswith("/v1/runs/"):
+        from deeplearning4j_tpu.telemetry.federation import (
+            TelemetryAggregator, get_federation_dir)
+        parsed = urllib.parse.urlparse(path)
+        parts = parsed.path.split("/")
+        # /v1/runs/<runId>/timeline -> ["", "v1", "runs", runId, "timeline"]
+        if len(parts) != 5 or parts[4] != "timeline" or not parts[3]:
+            return None
+        run_id = parts[3]
+        run_dir = get_federation_dir()
+        if run_dir is None:
+            return (404, json.dumps(
+                {"error": "federation unconfigured: set "
+                 "DL4J_TPU_TELEMETRY_DIR or call telemetry."
+                 "set_federation_dir(runDir)"}).encode("utf-8"),
+                "application/json")
+        qs = urllib.parse.parse_qs(parsed.query)
+
+        def _int(name):
+            vals = qs.get(name)
+            try:
+                return int(vals[-1]) if vals else None
+            except ValueError:
+                return None
+
+        events = TelemetryAggregator(run_dir).timeline(
+            run_id, kinds=qs.get("kind") or None,
+            generation=_int("generation"),
+            step_min=_int("step_min"), step_max=_int("step_max"))
+        if not events and not any(
+                e.get("run") == run_id for e in
+                TelemetryAggregator(run_dir).timeline()):
+            return (404, json.dumps(
+                {"error": "unknown run id (no timeline events recorded "
+                 "for it in the federation run dir)",
+                 "run_id": run_id}).encode("utf-8"),
+                "application/json")
+        hosts = sorted({e.get("host") for e in events if e.get("host")})
+        doc = {"run_id": run_id, "hosts": hosts,
+               "count": len(events), "events": events}
+        return 200, json.dumps(doc).encode("utf-8"), "application/json"
     if path == "/metrics":
         return (200, get_registry().exposition().encode("utf-8"),
                 PROMETHEUS_CTYPE)

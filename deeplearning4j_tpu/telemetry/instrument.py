@@ -28,6 +28,8 @@ from typing import Optional, Sequence
 from deeplearning4j_tpu.telemetry.flight import flight_recorder
 from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS,
                                                    get_registry)
+from deeplearning4j_tpu.telemetry.runlog import (current_run, record_event,
+                                                 run_span_attrs)
 from deeplearning4j_tpu.telemetry.tracing import tracer
 
 __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
@@ -38,7 +40,8 @@ __all__ = ["train_step_span", "record_crash", "etl_fetch", "note_etl_wait",
            "elastic_metrics", "CoordMetrics", "coord_metrics",
            "AotCacheMetrics", "aot_metrics", "replica_step_gauge",
            "observe_exemplar", "exemplar_for", "latency_exemplars",
-           "clear_exemplars"]
+           "clear_exemplars", "STEP_PHASES", "StepPhaseMetrics",
+           "step_phase_metrics", "observe_step_phase"]
 
 # set while a fault supervisor owns the step: a step-level
 # InvalidStepException/panic is then a RECOVERABLE divergence (the
@@ -97,6 +100,10 @@ def _report_step(model, seconds: float, batch_size: int,
             "dl4j_tpu_train_examples_per_second",
             "Dispatch-rate examples/sec (see PerformanceListener for the "
             "blocked, device-accurate rate)").set(batch_size / seconds)
+    observe_step_phase("compute", seconds, step=model.iterationCount)
+    record_event("train.step", step=int(model.iterationCount),
+                 epoch=int(model.epochCount),
+                 seconds=round(seconds, 6))
     flight_recorder().record(
         iteration=model.iterationCount, epoch=model.epochCount,
         step_seconds=round(seconds, 6), batch_size=int(batch_size),
@@ -123,7 +130,8 @@ def train_step_span(model, batch_size: int):
     t0 = time.perf_counter()
     try:
         with tracer().span("step", iteration=model.iterationCount,
-                           epoch=model.epochCount, batch=int(batch_size)):
+                           epoch=model.epochCount, batch=int(batch_size),
+                           **run_span_attrs()):
             yield
     except Exception as e:
         from deeplearning4j_tpu.optimize.solvers import InvalidStepException
@@ -533,12 +541,15 @@ _EXEMPLARS: dict = {}
 _EXEMPLAR_LOCK = threading.Lock()
 
 
-def observe_exemplar(name, value, trace_id=None, **labels):
+def observe_exemplar(name, value, trace_id=None, attrs=None, **labels):
     """Observe ``value`` into the ALREADY-REGISTERED histogram ``name``
     and attach ``trace_id`` as the exemplar when this observation is as
     slow as (or slower than) the cell's current exemplar.  A literal,
     registered metric name is required — jaxlint's telemetry-exemplar
-    rule cross-checks call sites against registration sites."""
+    rule cross-checks call sites against registration sites.  ``attrs``
+    rides along on the exemplar record WITHOUT becoming histogram labels
+    (step-phase exemplars carry unbounded (generation, step) coordinates
+    this way — pointing at one step without a cardinality explosion)."""
     hist = get_registry().get(name)
     if hist is None or not hasattr(hist, "buckets"):
         return
@@ -550,8 +561,10 @@ def observe_exemplar(name, value, trace_id=None, **labels):
     with _EXEMPLAR_LOCK:
         cur = _EXEMPLARS.get(key)
         if cur is None or bucket >= cur["bucket"]:
-            _EXEMPLARS[key] = {"trace_id": trace_id, "value": value,
-                               "bucket": bucket}
+            rec = {"trace_id": trace_id, "value": value, "bucket": bucket}
+            if attrs:
+                rec["attrs"] = dict(attrs)
+            _EXEMPLARS[key] = rec
 
 
 def exemplar_for(name, **labels):
@@ -577,6 +590,106 @@ def latency_exemplars():
 def clear_exemplars():
     with _EXEMPLAR_LOCK:
         _EXEMPLARS.clear()
+
+
+#: The five seams one logical train step decomposes into — instrumented
+#: at etl_fetch (data_wait), the prefetcher's staged-batch materialize
+#: (h2d), the fused-step dispatch (compute), the supervisor's sealed save
+#: (checkpoint) and the pod barrier (barrier).
+STEP_PHASES = ("data_wait", "h2d", "compute", "checkpoint", "barrier")
+
+
+class StepPhaseMetrics:
+    """The ``dl4j_tpu_step_*`` step-time decomposition namespace,
+    registered from ONE site.
+
+    Splits step wall time into the phases that answer "why did step time
+    double at generation 3": input wait vs host-to-device staging vs
+    fused-step compute vs checkpoint stall vs barrier wait.  Every
+    histogram takes exemplars (via :func:`observe_step_phase`) pointing
+    at the (trace id, generation, step) of the slowest observation, so a
+    p99 spike on any phase links straight to one step of one run.
+    Accessors re-resolve through :func:`get_registry` on every call
+    (tests swap the registry).
+    """
+
+    def data_wait_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_step_data_wait_seconds",
+            "Step time waiting on the input pipeline (batch fetch, "
+            "prefetch stalls)", buckets=DEFAULT_BUCKETS)
+
+    def h2d_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_step_h2d_seconds",
+            "Step time staging batches host-to-device (issue + "
+            "materialize wait)", buckets=DEFAULT_BUCKETS)
+
+    def compute_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_step_compute_seconds",
+            "Step time in the fused-step dispatch (host wall around the "
+            "jitted call)", buckets=DEFAULT_BUCKETS)
+
+    def checkpoint_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_step_checkpoint_seconds",
+            "Step time blocked on a sealed checkpoint save",
+            buckets=DEFAULT_BUCKETS)
+
+    def barrier_seconds(self):
+        return get_registry().histogram(
+            "dl4j_tpu_step_barrier_seconds",
+            "Step time blocked on the pod coordination barrier",
+            buckets=DEFAULT_BUCKETS)
+
+
+_STEP_PHASE_METRICS = StepPhaseMetrics()
+
+
+def step_phase_metrics() -> StepPhaseMetrics:
+    """Accessor for the shared step-phase namespace (see
+    :class:`StepPhaseMetrics`)."""
+    return _STEP_PHASE_METRICS
+
+
+def observe_step_phase(phase: str, seconds: float,
+                       step: Optional[int] = None) -> None:
+    """Observe one step-phase duration with a run-scoped exemplar: the
+    active :class:`~deeplearning4j_tpu.telemetry.runlog.RunContext`
+    supplies the trace id and generation, so the slowest-bucket exemplar
+    on each phase histogram resolves to (trace id, generation, step)."""
+    rc = current_run()
+    tid = rc.runId if rc is not None else None
+    attrs = None
+    if rc is not None:
+        attrs = {"generation": int(rc.generation)}
+        if step is not None:
+            attrs["step"] = int(step)
+    spm = _STEP_PHASE_METRICS
+    if phase == "data_wait":
+        spm.data_wait_seconds()
+        observe_exemplar("dl4j_tpu_step_data_wait_seconds", seconds,
+                         tid, attrs=attrs)
+    elif phase == "h2d":
+        spm.h2d_seconds()
+        observe_exemplar("dl4j_tpu_step_h2d_seconds", seconds,
+                         tid, attrs=attrs)
+    elif phase == "compute":
+        spm.compute_seconds()
+        observe_exemplar("dl4j_tpu_step_compute_seconds", seconds,
+                         tid, attrs=attrs)
+    elif phase == "checkpoint":
+        spm.checkpoint_seconds()
+        observe_exemplar("dl4j_tpu_step_checkpoint_seconds", seconds,
+                         tid, attrs=attrs)
+    elif phase == "barrier":
+        spm.barrier_seconds()
+        observe_exemplar("dl4j_tpu_step_barrier_seconds", seconds,
+                         tid, attrs=attrs)
+    else:
+        raise ValueError(f"unknown step phase {phase!r}; "
+                         f"expected one of {STEP_PHASES}")
 
 
 class MeshMetrics:
@@ -941,6 +1054,7 @@ def etl_fetch(iterator):
     # start is backdated over the hasNext wait so the trace slice spans
     # the whole time the loop stood still for data
     tracer().record_complete("etl", t0 - pending, dt)
+    observe_step_phase("data_wait", dt)
     reg.gauge("dl4j_tpu_etl_stall_seconds",
               "Host wall time the train loop spent waiting on the last "
               "batch fetch (async prefetch waits included)").set(dt)

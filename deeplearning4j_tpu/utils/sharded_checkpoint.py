@@ -426,6 +426,9 @@ class ShardedCheckpointer:
             _fsync_dir(os.path.dirname(mpath))
 
         _io_retry(_publish, f"manifest publish for step {step}")
+        from deeplearning4j_tpu.telemetry.runlog import record_event
+        record_event("ckpt.seal", step=int(step),
+                     generation=metadata.get("generation"))
         self._pruneManifests()
 
     def _pruneManifests(self) -> None:

@@ -100,6 +100,12 @@ def pytest_configure(config):
         "propagation across failover, TTFT/ITL decomposition, the "
         "request timeline endpoint, metrics retention queries, OTLP "
         "export, the NDJSON access log)")
+    config.addinivalue_line(
+        "markers", "trainobs: training-plane observability tests "
+        "(run-scoped trace ids on step/checkpoint/barrier spans, the "
+        "cross-host fleet timeline with hybrid-logical-clock merge, "
+        "the run timeline endpoint, step-time decomposition "
+        "histograms with (generation, step) exemplars)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -465,6 +465,60 @@ class TestTelemetryRules:
         assert [f.rule for f in res.suppressed] == ["telemetry-buckets"]
 
 
+class TestTimelineEventNameRule:
+    def test_bad_shape_kind_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            from deeplearning4j_tpu.telemetry.runlog import record_event
+
+            def f():
+                record_event("Ckpt Save", step=3)
+        """})
+        assert rule_ids(res) == ["timeline-event-name"]
+
+    def test_out_of_vocabulary_kind_fires(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def f(self):
+                self.timeline.record("ckpt.sealed", generation=2)
+        """})
+        assert rule_ids(res) == ["timeline-event-name"]
+
+    def test_vocabulary_kinds_pass(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            from deeplearning4j_tpu.telemetry.runlog import record_event
+
+            def f(self, tl):
+                record_event("train.step", step=7)
+                self.timeline.record("coord.barrier", generation=1)
+                tl.record("elastic.shrink")
+        """})
+        assert res.findings == []
+
+    def test_non_literal_kind_accepted(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def f(self, kind):
+                self.timeline.record(kind, step=1)
+        """})
+        assert res.findings == []
+
+    def test_unrelated_record_apis_ignored(self, tmp_path):
+        # FlightRecorder-style .record and file opens are out of scope
+        res = lint(tmp_path, {"m.py": """
+            def f(recorder, path):
+                recorder.record("whatever I want", detail=1)
+                open(path, "a")
+        """})
+        assert res.findings == []
+
+    def test_suppressible_with_reason(self, tmp_path):
+        res = lint(tmp_path, {"m.py": """
+            def f(tl):
+                # jaxlint: disable=timeline-event-name -- experimental kind behind a flag
+                tl.record("debug.probe")
+        """})
+        assert res.findings == []
+        assert [f.rule for f in res.suppressed] == ["timeline-event-name"]
+
+
 # ----------------------------------------------- suppression enforcement --
 
 class TestSuppressionEnforcement:

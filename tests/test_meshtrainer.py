@@ -14,6 +14,8 @@ parallel/.
   through MeshTrainer on a TP mesh (plus the seq/stage shapes the old
   per-strategy paths refused to supervise).
 """
+import time
+
 import numpy as np
 import pytest
 
@@ -200,9 +202,10 @@ class TestOneSteppingPath:
         assert np.abs(np.array(net.params_["0"]["router"])
                       - router0).max() > 1e-5
 
-    def test_zero_steady_state_recompiles(self):
+    def test_zero_steady_state_recompiles(self, tmp_path):
         """Acceptance bar: the mesh jit-cache-miss counter is FLAT after
-        step 1 for every mesh shape (one executable, reused)."""
+        step 1 for every mesh shape (one executable, reused) — and the
+        fleet-timeline recorder costs < 2% of a warm step."""
         x, y = _toy()
         ds = DataSet(x, y)
         for name, mesh, tp, zero in _mesh_configs():
@@ -217,6 +220,31 @@ class TestOneSteppingPath:
                 pw.fitDataSet(ds)
             m2 = _counter("dl4j_tpu_mesh_jit_cache_misses_total")
             assert m2 == m1, f"{name}: {m2 - m1} steady-state recompiles"
+
+        # timeline overhead gate (ISSUE 20): one train.step event per
+        # step on the hot path; with a LIVE FleetTimeline installed the
+        # per-event cost (HLC tick + json + open-append-close) must stay
+        # under 2% of the warm step it annotates
+        from deeplearning4j_tpu.telemetry.runlog import (FleetTimeline,
+                                                         record_event,
+                                                         set_fleet_timeline)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            pw.fitDataSet(ds)
+        warm = (time.perf_counter() - t0) / 5
+        prev = set_fleet_timeline(FleetTimeline(str(tmp_path),
+                                                hostId="gate"))
+        try:
+            n = 500
+            t0 = time.perf_counter()
+            for i in range(n):
+                record_event("train.step", step=i, seconds=warm)
+            per_event = (time.perf_counter() - t0) / n
+        finally:
+            set_fleet_timeline(prev)
+        assert per_event < 0.02 * warm, \
+            f"timeline recorder {per_event * 1e6:.0f}us/event vs warm " \
+            f"step {warm * 1e3:.1f}ms"
 
     def test_collective_bytes_estimated_per_axis(self):
         x, y = _toy()

@@ -24,7 +24,7 @@ BUILTIN = {
 # subsystem's coverage evaporates without a red test
 REQUIRED = {"tpu", "slow", "fault", "telemetry", "etl", "serving", "lint",
             "mesh", "elastic", "coord", "aot", "chaos", "cbatch", "recsys",
-            "servfault", "obsreq"}
+            "servfault", "obsreq", "trainobs"}
 
 MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_]\w*)")
 REGISTER_RE = re.compile(

@@ -401,6 +401,77 @@ class SpanNameRule(Rule):
                     "name, and mixed casings fragment the grouping"))
 
 
+#: the fleet-timeline recorder's bounded event vocabulary — MUST mirror
+#: deeplearning4j_tpu.telemetry.runlog.TIMELINE_EVENT_KINDS (the linter
+#: is AST-only and must not import the jax-heavy package, so the set is
+#: duplicated; tests/test_trainobs.py asserts the two stay identical).
+TIMELINE_EVENT_KINDS = frozenset({
+    "run.start", "run.end",
+    "train.step",
+    "ckpt.save", "ckpt.seal", "ckpt.restore", "ckpt.rollback",
+    "coord.propose", "coord.barrier", "coord.adopt",
+    "coord.leader_failover", "coord.evict", "coord.readmit",
+    "elastic.shrink", "elastic.grow", "elastic.remesh",
+    "etl.restart",
+    "health.firing", "health.resolved",
+})
+
+#: timeline recorder entry points whose FIRST argument is the event kind
+_TIMELINE_FUNCS = ("record_event",)
+
+
+@register_rule
+class TimelineEventNameRule(Rule):
+    """Literal event kinds passed to the fleet-timeline recorder
+    (``record_event("…")`` / ``<timeline>.record("…")``) must be
+    dot.separated lowercase AND come from the bounded vocabulary in
+    ``telemetry.runlog.TIMELINE_EVENT_KINDS`` — the merged pod timeline
+    is filtered/joined BY kind, so a freestyle kind is an event no
+    dashboard or invariant check will ever find.  Non-literal kinds
+    can't be checked statically and are accepted."""
+
+    id = "timeline-event-name"
+    summary = ("timeline event kinds must be dot.separated lowercase "
+               "from the bounded runlog vocabulary")
+
+    @staticmethod
+    def _is_timeline_call(f) -> bool:
+        fname = f.attr if isinstance(f, ast.Attribute) else \
+            f.id if isinstance(f, ast.Name) else ""
+        if fname in _TIMELINE_FUNCS:
+            return True
+        if fname != "record" or not isinstance(f, ast.Attribute):
+            return False
+        # `.record(...)` counts only on a receiver NAMED like a timeline
+        # (self.timeline.record, coord.timeline.record, tl.record) —
+        # FlightRecorder/other .record APIs stay out of scope
+        recv = f.value
+        rname = recv.attr if isinstance(recv, ast.Attribute) else \
+            recv.id if isinstance(recv, ast.Name) else ""
+        return rname == "tl" or rname.endswith("timeline")
+
+    def visit(self, src, report) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not self._is_timeline_call(node.func):
+                continue
+            kind = node.args[0]
+            if not (isinstance(kind, ast.Constant) and
+                    isinstance(kind.value, str)):
+                continue
+            if not SPAN_NAME_PATTERN.match(kind.value) or \
+                    kind.value not in TIMELINE_EVENT_KINDS:
+                report(Finding(
+                    self.id, src.relpath, node.lineno, node.col_offset,
+                    f"timeline event kind {kind.value!r} must be a "
+                    "dot.separated lowercase kind from the bounded "
+                    "vocabulary in telemetry.runlog.TIMELINE_EVENT_KINDS"
+                    " — the merged pod timeline filters and joins BY "
+                    "kind, so an unknown kind is invisible to every "
+                    "dashboard and invariant check"))
+
+
 @register_rule
 class ExemplarRegisteredRule(Rule):
     """``observe_exemplar("metric", …)`` sites must name a metric some
